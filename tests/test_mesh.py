@@ -1,0 +1,55 @@
+"""Mesh construction + sharding helper tests."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from uccl_tpu.parallel.mesh import AXIS, MeshConfig, make_mesh, mesh_axis_size
+from uccl_tpu.parallel import sharding
+
+
+class TestMeshConfig:
+    def test_size(self):
+        c = MeshConfig(pp=2, dp=2, cp=1, tp=2)
+        assert c.size == 8
+        assert c.ep == 2
+
+    def test_auto_8(self):
+        c = MeshConfig.auto(8)
+        assert c.size == 8
+
+    def test_auto_various(self):
+        for n in (1, 2, 3, 4, 6, 8, 16, 32, 12):
+            assert MeshConfig.auto(n).size == n
+
+    def test_auto_no_pp(self):
+        c = MeshConfig.auto(8, want_pp=False)
+        assert c.size == 8 and c.pp == 1
+
+
+class TestMakeMesh:
+    def test_mesh8(self, devices):
+        m = make_mesh(MeshConfig(pp=2, dp=2, cp=1, tp=2), devices)
+        assert m.shape == {"pp": 2, "dp": 2, "cp": 1, "tp": 2}
+        assert mesh_axis_size(m, AXIS.EP) == 2
+
+    def test_wrong_count(self, devices):
+        with pytest.raises(ValueError):
+            make_mesh(MeshConfig(dp=3), devices)
+
+    def test_default_dp(self, devices):
+        m = make_mesh(devices=devices)
+        assert m.shape["dp"] == 8
+
+
+class TestSharding:
+    def test_put_and_constraint(self, mesh8):
+        x = np.arange(2 * 4 * 6, dtype=np.float32).reshape(2, 4, 6)
+        gx = sharding.put(mesh8, x, P(AXIS.DP, AXIS.CP, None))
+        assert gx.shape == x.shape
+        np.testing.assert_array_equal(np.asarray(gx), x)
+
+    def test_activation_spec(self):
+        assert sharding.activation_spec() == P(AXIS.DP, AXIS.CP, None)
+        assert sharding.activation_spec(False) == P(AXIS.DP, None, None)

@@ -1,0 +1,135 @@
+"""Unit tests for the substrate (config/logging/latency/topology) — the analog of
+the reference's include/util unit tests (include/util/util_test.cc)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from uccl_tpu.utils import config as cfg
+from uccl_tpu.utils.latency import LatencyHistogram
+from uccl_tpu.utils.logging import CHECK, DCHECK, CheckError, get_logger, log
+from uccl_tpu.utils import topology as topo
+
+
+class TestConfig:
+    def test_default(self):
+        p = cfg.param("test_default_xyz", 42)
+        assert p.get() == 42
+
+    def test_env_override(self, monkeypatch):
+        p = cfg.param("test_env_abc", 7)
+        monkeypatch.setenv("UCCL_TPU_TEST_ENV_ABC", "99")
+        p.reset()
+        assert p.get() == 99
+
+    def test_types(self, monkeypatch):
+        pb = cfg.param("test_bool_flag", False)
+        monkeypatch.setenv("UCCL_TPU_TEST_BOOL_FLAG", "true")
+        pb.reset()
+        assert pb.get() is True
+        pf = cfg.param("test_float_val", 1.5)
+        monkeypatch.setenv("UCCL_TPU_TEST_FLOAT_VAL", "2.25")
+        pf.reset()
+        assert pf.get() == 2.25
+
+    def test_programmatic_override(self):
+        p = cfg.param("test_prog", 1)
+        p.set(5)
+        assert p.get() == 5
+        p.reset()
+        assert p.get() == 1
+
+    def test_idempotent_registry(self):
+        a = cfg.param("test_same", 1)
+        b = cfg.param("test_same", 2)
+        assert a is b
+
+    def test_env_file(self, tmp_path):
+        f = tmp_path / "env"
+        f.write_text("# comment\nUCCL_TPU_TEST_FROM_FILE=123\n")
+        p = cfg.param("test_from_file", 0)
+        cfg.set_env_file(str(f))
+        assert p.get() == 123
+        cfg.reset_all()
+
+    def test_dump(self):
+        cfg.param("test_dump_me", 3)
+        d = cfg.dump_params()
+        assert d["test_dump_me"] == 3
+
+
+class TestLogging:
+    def test_get_logger(self):
+        lg = get_logger("COLL")
+        lg.info("hello")
+
+    def test_bad_subsys(self):
+        with pytest.raises(ValueError):
+            get_logger("NOPE")
+
+    def test_fatal_raises(self):
+        with pytest.raises(RuntimeError):
+            log("FATAL", "boom", subsys="UTIL")
+
+    def test_check(self):
+        CHECK(True)
+        with pytest.raises(CheckError):
+            CHECK(False, "nope")
+        DCHECK(True)
+
+
+class TestLatency:
+    def test_basic_percentiles(self):
+        h = LatencyHistogram()
+        samples = np.linspace(1, 1000, 1000)
+        h.record_many(samples)
+        assert h.count == 1000
+        assert abs(h.mean - samples.mean()) < 1.0
+        # 5% bucket resolution
+        assert abs(h.percentile(50) - 500) / 500 < 0.10
+        assert abs(h.percentile(99) - 990) / 990 < 0.10
+        assert h.percentile(100) <= h.summary()["max_us"]
+
+    def test_empty(self):
+        h = LatencyHistogram()
+        assert h.percentile(50) == 0.0
+        assert h.summary()["count"] == 0
+
+    def test_thread_safety(self):
+        import threading
+
+        h = LatencyHistogram()
+
+        def worker():
+            for i in range(1000):
+                h.record(float(i % 100 + 1))
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert h.count == 4000
+
+
+class TestTopology:
+    def test_ring_order(self):
+        assert topo.ring_order(4) == [0, 1, 2, 3]
+        assert topo.ring_order(4, offset=1) == [1, 2, 3, 0]
+        assert topo.ring_order(4, reverse=True) == [0, 3, 2, 1]
+
+    def test_neighbors(self):
+        assert topo.ring_neighbors(0, 4) == (3, 1)
+        assert topo.ring_neighbors(0, 4, reverse=True) == (1, 3)
+
+    def test_ppermute_pairs(self):
+        assert topo.ppermute_pairs(3) == [(0, 1), (1, 2), (2, 0)]
+
+    def test_factor_2d(self):
+        assert topo.factor_2d(8) == (2, 4)
+        assert topo.factor_2d(16) == (4, 4)
+        assert topo.factor_2d(7) == (1, 7)
+
+    def test_recursive_halving(self):
+        assert topo.recursive_halving_peers(0, 8) == [4, 2, 1]
+        with pytest.raises(ValueError):
+            topo.recursive_halving_peers(0, 6)

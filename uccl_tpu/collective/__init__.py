@@ -1,0 +1,21 @@
+"""Collectives layer: NCCL-shaped host API lowered to XLA collectives on the mesh.
+
+The analog of the reference's ``collective/`` pillar (NCCL net plugin +
+transports, SURVEY.md §2.1): same API *shape* — allreduce / allgather /
+reducescatter / alltoall / broadcast / send-recv — but lowered to
+``lax.psum``/``all_gather``/``psum_scatter``/``all_to_all``/``ppermute`` inside
+``shard_map`` over the ICI mesh rather than a userspace packet transport.
+
+Two surfaces:
+
+* :class:`Communicator` — eager host API over global arrays with an explicit
+  leading rank dimension (one "NCCL buffer" per mesh-axis member). This is what
+  nccl-tests-style harnesses and the benchmark driver use.
+* :mod:`uccl_tpu.collective.ops` — per-shard wrappers for use *inside* user
+  shard_map/pjit code (the compiled path models use).
+"""
+
+from uccl_tpu.collective.communicator import Communicator, ReduceOp
+from uccl_tpu.collective import ops
+
+__all__ = ["Communicator", "ReduceOp", "ops"]

@@ -1,0 +1,68 @@
+"""Per-shard collective wrappers for use inside shard_map / pjit.
+
+These are the device-side contract of the collectives pillar: thin, uniformly-named
+wrappers over ``jax.lax`` collectives so model/parallel code never spells raw lax
+names (and so the chunk-graph scheduler can later swap implementations without
+touching call sites). All take ``axis`` as a mesh axis name or tuple of names.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from uccl_tpu.utils.topology import ppermute_pairs
+
+Axis = Union[str, Tuple[str, ...]]
+
+
+def all_reduce(x: jax.Array, axis: Axis, op: str = "sum") -> jax.Array:
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def all_gather(x: jax.Array, axis: Axis, *, dim: int = 0, tiled: bool = True) -> jax.Array:
+    return lax.all_gather(x, axis, axis=dim, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis: Axis, *, dim: int = 0) -> jax.Array:
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
+
+
+def all_to_all(
+    x: jax.Array, axis: Axis, *, split_dim: int, concat_dim: int, tiled: bool = True
+) -> jax.Array:
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=tiled)
+
+
+def ppermute(x: jax.Array, axis: Axis, perm: Sequence[Tuple[int, int]]) -> jax.Array:
+    return lax.ppermute(x, axis, perm=list(perm))
+
+
+def ring_shift(x: jax.Array, axis: str, shift: int = 1) -> jax.Array:
+    """Rotate shards around the ring: member i's value goes to member i+shift."""
+    return lax.ppermute(x, axis, perm=ppermute_pairs(lax.axis_size(axis), shift))
+
+
+def axis_index(axis: Axis) -> jax.Array:
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: Axis) -> int:
+    return lax.axis_size(axis)
+
+
+def broadcast(x: jax.Array, axis: Axis, root: int = 0) -> jax.Array:
+    """Every member ends with the root member's value."""
+    g = lax.all_gather(x, axis, axis=0, tiled=False)
+    return g[root]

@@ -1,0 +1,11 @@
+"""Parallelism layer: device meshes, sharding helpers, ring attention, Ulysses,
+pipeline parallelism.
+
+The reference is a comm substrate under torch parallelism (SURVEY.md §2.6); on TPU
+the mesh + sharding annotations ARE the parallelism API, so this package owns them.
+"""
+
+from uccl_tpu.parallel.mesh import MeshConfig, make_mesh, get_mesh, AXIS
+from uccl_tpu.parallel import sharding
+
+__all__ = ["MeshConfig", "make_mesh", "get_mesh", "AXIS", "sharding"]

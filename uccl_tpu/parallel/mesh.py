@@ -1,0 +1,127 @@
+"""Device-mesh construction and the framework's canonical parallel axes.
+
+The reference scales through NCCL communicators created per torch process group;
+the TPU-native analog is a single `jax.sharding.Mesh` whose named axes carry every
+parallelism strategy (SURVEY.md §2.6 inventory):
+
+* ``dp`` — data parallel (reference: examples/ddp_train.py over the NCCL plugin)
+* ``pp`` — pipeline parallel (reference: lite-ep 0-SM PP primitives)
+* ``cp`` — context/sequence parallel, ring attention + Ulysses
+  (reference: lite-ep 0-SM CP primitive; here first-class)
+* ``tp`` — tensor parallel (reference: Megatron over the plugin)
+* expert parallel (``ep``) runs over the combined (``dp``, ``cp``) axes — the
+  DeepSeek-style layout where EP reuses the data-parallel world, matching the
+  reference's EP ranks == torch.distributed world (ep/bench/buffer.py).
+
+Axis order is ('pp','dp','cp','tp') with ``tp`` innermost so the most
+latency-sensitive collectives ride the shortest ICI hops.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class AXIS:
+    """Canonical mesh-axis names."""
+
+    PP = "pp"
+    DP = "dp"
+    CP = "cp"
+    TP = "tp"
+    ALL: Tuple[str, ...] = ("pp", "dp", "cp", "tp")
+    # Expert parallelism runs over the flattened data+context world.
+    EP: Tuple[str, ...] = ("dp", "cp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Sizes of each parallel axis. Product must equal the device count."""
+
+    pp: int = 1
+    dp: int = 1
+    cp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.pp * self.dp * self.cp * self.tp
+
+    @property
+    def ep(self) -> int:
+        """Expert-parallel world size (dp × cp)."""
+        return self.dp * self.cp
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.pp, self.dp, self.cp, self.tp)
+
+    @staticmethod
+    def auto(n_devices: int, want_pp: bool = True, want_cp: bool = True) -> "MeshConfig":
+        """Pick a balanced config for n devices, spending factors in priority
+        order tp → dp → pp → cp, two-way at a time (mirrors how users of the
+        reference lay Megatron TP innermost on NVLink)."""
+        sizes = {"pp": 1, "dp": 1, "cp": 1, "tp": 1}
+        order = ["tp", "dp"] + (["pp"] if want_pp else []) + (["cp"] if want_cp else [])
+        remaining = n_devices
+        i = 0
+        # Round-robin factors of 2 over the axes; any odd residue folds into dp.
+        while remaining > 1:
+            if remaining % 2 == 0:
+                sizes[order[i]] *= 2
+                remaining //= 2
+            else:
+                sizes["dp"] *= remaining
+                remaining = 1
+            i = (i + 1) % len(order)
+        cfg = MeshConfig(**sizes)
+        assert cfg.size == n_devices, (cfg, n_devices)
+        return cfg
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the canonical 4-axis mesh.
+
+    With no config, all visible devices land on ``dp``. Devices are laid out in
+    their default (topology-sorted) order so contiguous ``tp`` groups occupy
+    adjacent ICI neighbors.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if config is None:
+        config = MeshConfig(dp=n)
+    if config.size != n:
+        raise ValueError(f"mesh config {config} needs {config.size} devices, have {n}")
+    dev_array = np.asarray(devices).reshape(config.axis_sizes())
+    return Mesh(dev_array, AXIS.ALL)
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_mesh() -> Mesh:
+    """The process-wide default mesh, creating a dp-only mesh lazily."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+def mesh_axis_size(mesh: Mesh, axes) -> int:
+    if isinstance(axes, str):
+        axes = (axes,)
+    return int(math.prod(mesh.shape[a] for a in axes))

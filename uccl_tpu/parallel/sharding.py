@@ -1,0 +1,51 @@
+"""PartitionSpec/NamedSharding helpers used across the framework.
+
+These are the TPU-native contract that replaces the reference's per-rank tensor
+handles: instead of each rank holding a local torch tensor and calling NCCL, arrays
+carry shardings and XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from uccl_tpu.parallel.mesh import AXIS
+
+
+def spec(*axes) -> P:
+    """PartitionSpec from axis names (None entries = replicated dims)."""
+    return P(*axes)
+
+
+def named(mesh: Mesh, pspec: P) -> NamedSharding:
+    return NamedSharding(mesh, pspec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# Canonical activation layout: [batch, seq, hidden] with batch over dp, seq over cp
+# (context parallel), hidden replicated (tp shards weights, not activations).
+def activation_spec(seq_sharded: bool = True) -> P:
+    return P(AXIS.DP, AXIS.CP if seq_sharded else None, None)
+
+
+def batch_spec() -> P:
+    return P(AXIS.DP, None, None)
+
+
+def constrain(x: Any, pspec: P) -> Any:
+    """with_sharding_constraint that is a no-op outside jit/mesh contexts."""
+    try:
+        return jax.lax.with_sharding_constraint(x, pspec)
+    except (ValueError, RuntimeError):
+        return x
+
+
+def put(mesh: Mesh, x: Any, pspec: Optional[P] = None) -> Any:
+    """Device-put a host array with the given layout on the mesh."""
+    return jax.device_put(x, NamedSharding(mesh, pspec if pspec is not None else P()))

@@ -1,0 +1,21 @@
+"""Shared substrate: config, logging, latency histograms, topology.
+
+The TPU-native equivalent of the reference's ``include/util`` + ``param.h`` layer
+(reference: collective/rdma/param.h:16-29, include/util/debug.h:1-60,
+include/util/latency.h). Built first per SURVEY.md §7 step 1.
+"""
+
+from uccl_tpu.utils.config import param, set_env_file, Param
+from uccl_tpu.utils.logging import get_logger, log, CHECK, DCHECK
+from uccl_tpu.utils.latency import LatencyHistogram
+
+__all__ = [
+    "param",
+    "set_env_file",
+    "Param",
+    "get_logger",
+    "log",
+    "CHECK",
+    "DCHECK",
+    "LatencyHistogram",
+]

@@ -1,0 +1,78 @@
+"""ICI torus topology helpers.
+
+The reference's multipath "packet spraying" picks among 32 QP paths per flow
+(reference: collective/rdma/transport_config.h:40 PORT_ENTROPY, transport.cc:2186
+EventOnSelectPath). On TPU the fabric is the ICI torus driven by XLA, so the analog
+is *ring/path selection over torus axes*: which device orderings a chunk-graph
+collective schedule rotates around, and how many independent rings (one per torus
+direction) a collective can spray chunks across.
+
+Pure-python; used by the chunk-graph planner (uccl_tpu.collective.plan) and by
+ring-attention schedules (uccl_tpu.parallel.ring).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class TorusAxis:
+    """One axis of a (possibly multi-dim) torus of devices."""
+
+    name: str
+    size: int
+
+
+def ring_order(n: int, offset: int = 0, reverse: bool = False) -> List[int]:
+    """Device ordering for a logical ring of n members.
+
+    offset rotates the starting point; reverse flips direction. Two rings with
+    reverse=False/True spray chunks over both torus directions simultaneously —
+    the ICI analog of UCCL's dual-direction path diversity.
+    """
+    order = [(i + offset) % n for i in range(n)]
+    if reverse:
+        order = [order[0]] + order[1:][::-1]
+    return order
+
+
+def ring_neighbors(rank: int, n: int, reverse: bool = False) -> Tuple[int, int]:
+    """(prev, next) neighbors of `rank` on the ring."""
+    step = -1 if reverse else 1
+    return ((rank - step) % n, (rank + step) % n)
+
+
+def ppermute_pairs(n: int, shift: int = 1) -> List[Tuple[int, int]]:
+    """(src, dst) pairs for jax.lax.ppermute implementing a ring rotation by shift."""
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def bidirectional_rings(n: int) -> List[List[int]]:
+    """The two directed rings available on a 1-D torus axis."""
+    return [ring_order(n), ring_order(n, reverse=True)]
+
+
+def factor_2d(n: int) -> Tuple[int, int]:
+    """Factor n into the most-square (rows, cols) grid — used to lay a logical
+    2-D torus over a flat device list when the physical topology is unknown."""
+    best = (1, n)
+    r = 1
+    while r * r <= n:
+        if n % r == 0:
+            best = (r, n // r)
+        r += 1
+    return best
+
+
+def recursive_halving_peers(rank: int, n: int) -> List[int]:
+    """Peer schedule for recursive-halving/doubling collectives (n power of two)."""
+    if n & (n - 1):
+        raise ValueError(f"recursive halving needs power-of-two size, got {n}")
+    peers = []
+    d = n // 2
+    while d >= 1:
+        peers.append(rank ^ d)
+        d //= 2
+    return peers

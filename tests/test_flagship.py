@@ -1,0 +1,139 @@
+"""Flagship MoE transformer: sharded (pp/dp/cp/tp + ep) vs dense oracle.
+
+The decisive test battery for the model stack: forward parity, gradient parity
+(catches missing psums in shard_map transposes), and training convergence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from uccl_tpu.models.flagship import (
+    FlagshipConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    reference_forward,
+    shard_params,
+)
+from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab=64,
+        dim=32,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=8,
+        moe_experts=4,
+        moe_topk=2,
+        moe_ffn=32,
+        capacity_factor=2.0,  # = E/k -> capacity == all local tokens, no drops
+        n_microbatches=2,
+        aux_loss_weight=0.0,
+        z_loss_weight=0.0,
+    )
+    base.update(kw)
+    return FlagshipConfig(**base)
+
+
+MESHES = {
+    "pp2_dp2_tp2": MeshConfig(pp=2, dp=2, cp=1, tp=2),
+    "dp2_cp2_tp2": MeshConfig(pp=1, dp=2, cp=2, tp=2),
+    "pp2_cp2_tp2": MeshConfig(pp=2, dp=1, cp=2, tp=2),
+}
+
+
+@pytest.fixture(params=list(MESHES))
+def mesh_cfg(request, devices):
+    return make_mesh(MESHES[request.param], devices), MESHES[request.param]
+
+
+def _data(rng, cfg, batch=4, seq=16):
+    tokens = rng.integers(0, cfg.vocab, (batch, seq)).astype(np.int32)
+    targets = rng.integers(0, cfg.vocab, (batch, seq)).astype(np.int32)
+    return jnp.asarray(tokens), jnp.asarray(targets)
+
+
+class TestForwardParity:
+    def test_matches_reference(self, mesh_cfg, rng):
+        mesh, mc = mesh_cfg
+        cfg = _cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens, _ = _data(rng, cfg)
+        want = np.asarray(reference_forward(params, tokens, cfg))
+        gp = shard_params(params, mesh, cfg)
+        got = np.asarray(jax.jit(
+            lambda p, t: forward(p, t, cfg, mesh)
+        )(gp, tokens))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_ulysses_mode(self, devices, rng):
+        mesh = make_mesh(MeshConfig(pp=1, dp=2, cp=2, tp=2), devices)
+        cfg = _cfg(seq_mode="ulysses")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens, _ = _data(rng, cfg)
+        want = np.asarray(reference_forward(params, tokens, cfg))
+        got = np.asarray(
+            jax.jit(lambda p, t: forward(p, t, cfg, mesh))(
+                shard_params(params, mesh, cfg), tokens
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+class TestGradParity:
+    def test_grads_match_dense(self, mesh_cfg, rng):
+        """Gradients through the fully sharded model == dense autodiff."""
+        mesh, mc = mesh_cfg
+        cfg = _cfg()
+        params = init_params(jax.random.PRNGKey(1), cfg)
+        tokens, targets = _data(rng, cfg)
+
+        def dense_loss(p):
+            logits = reference_forward(p, tokens, cfg)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+            return jnp.mean(lse - tgt)
+
+        def sharded_loss(p):
+            total, ce = loss_fn(p, tokens, targets, cfg, mesh)
+            return total
+
+        g_dense = jax.jit(jax.grad(dense_loss))(params)
+        gp = shard_params(params, mesh, cfg)
+        g_shard = jax.jit(jax.grad(sharded_loss))(gp)
+        flat_d, _ = jax.tree.flatten(g_dense)
+        flat_s, _ = jax.tree.flatten(g_shard)
+        for a, b in zip(flat_d, flat_s):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=5e-3, atol=1e-4
+            )
+
+
+class TestTraining:
+    def test_loss_decreases(self, devices, rng):
+        mesh = make_mesh(MeshConfig(pp=2, dp=2, cp=1, tp=2), devices)
+        cfg = _cfg(aux_loss_weight=0.01, z_loss_weight=1e-3)
+        params = shard_params(init_params(jax.random.PRNGKey(2), cfg), mesh, cfg)
+        tokens, targets = _data(rng, cfg)
+        train_step, init_opt = make_train_step(cfg, mesh, learning_rate=1e-2)
+        opt_state = init_opt(params)
+        step = jax.jit(train_step)
+        losses = []
+        for _ in range(10):
+            params, opt_state, metrics = step(params, opt_state, tokens, targets)
+            losses.append(float(metrics["ce"]))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_aux_loss_positive(self, devices, rng):
+        mesh = make_mesh(MeshConfig(pp=1, dp=2, cp=2, tp=2), devices)
+        cfg = _cfg(aux_loss_weight=0.01, z_loss_weight=1e-3)
+        params = shard_params(init_params(jax.random.PRNGKey(3), cfg), mesh, cfg)
+        tokens, targets = _data(rng, cfg)
+        total, ce = jax.jit(lambda p: loss_fn(p, tokens, targets, cfg, mesh))(params)
+        assert float(total) > float(ce)

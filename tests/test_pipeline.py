@@ -1,0 +1,100 @@
+"""GPipe SPMD schedule correctness: pipelined result == sequential application."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+from uccl_tpu.parallel.pipeline import gpipe_spmd
+
+
+@pytest.fixture(scope="module")
+def pp_mesh(devices):
+    return make_mesh(MeshConfig(pp=4, dp=2), devices)
+
+
+def test_matches_sequential(pp_mesh, rng):
+    """4 stages each apply y = tanh(x @ w_s); compare against running the four
+    matmuls sequentially on one device."""
+    m, b, h = 3, 2, 8
+    xmb = rng.standard_normal((m, b, h)).astype(np.float32)
+    ws = rng.standard_normal((4, h, h)).astype(np.float32) * 0.5
+
+    def f(w_local, x):
+        def stage_fn(xm):
+            return jnp.tanh(xm @ w_local[0]), jnp.sum(xm)
+
+        return gpipe_spmd(stage_fn, x, "pp")
+
+    mapped = jax.shard_map(
+        f,
+        mesh=pp_mesh,
+        in_specs=(P("pp", None, None), P(None, None, None)),
+        out_specs=(P(None, None, None), P()),
+        check_vma=False,
+    )
+    out, aux = jax.jit(mapped)(ws, xmb)
+    want = xmb
+    for i in range(4):
+        want = np.tanh(want @ ws[i])
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+
+
+def test_aux_sums_over_stages_and_microbatches(pp_mesh):
+    m, b, h = 2, 1, 4
+    xmb = np.ones((m, b, h), np.float32)
+
+    def f(x):
+        def stage_fn(xm):
+            return xm, jnp.asarray(1.0)  # each stage contributes 1 per valid mb
+
+        return gpipe_spmd(stage_fn, x, "pp")
+
+    mapped = jax.shard_map(
+        f,
+        mesh=pp_mesh,
+        in_specs=(P(None, None, None),),
+        out_specs=(P(None, None, None), P()),
+        check_vma=False,
+    )
+    out, aux = jax.jit(mapped)(xmb)
+    # identity stages: output == input; aux == stages * microbatches = 4*2
+    np.testing.assert_allclose(np.asarray(out), xmb)
+    assert float(aux) == 8.0
+
+
+def test_gradients_flow(pp_mesh, rng):
+    """d(sum of pipeline output)/d(stage weights) must match the sequential
+    model's gradients — exercises the scan+ppermute transpose."""
+    m, b, h = 2, 2, 4
+    xmb = rng.standard_normal((m, b, h)).astype(np.float32)
+    ws = rng.standard_normal((4, h, h)).astype(np.float32) * 0.5
+
+    def pipeline_loss(w):
+        def f(w_local, x):
+            def stage_fn(xm):
+                return jnp.tanh(xm @ w_local[0]), jnp.zeros(())
+
+            out, _ = gpipe_spmd(stage_fn, x, "pp")
+            return jnp.sum(out * out)
+
+        mapped = jax.shard_map(
+            f,
+            mesh=pp_mesh,
+            in_specs=(P("pp", None, None), P(None, None, None)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return mapped(w, xmb)
+
+    def seq_loss(w):
+        x = xmb
+        for i in range(4):
+            x = jnp.tanh(x @ w[i])
+        return jnp.sum(x * x)
+
+    g_pipe = jax.jit(jax.grad(pipeline_loss))(ws)
+    g_seq = jax.jit(jax.grad(seq_loss))(ws)
+    np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_seq), rtol=1e-4, atol=1e-5)

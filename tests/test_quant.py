@@ -1,0 +1,39 @@
+"""FP8 wire-quantization roundtrip accuracy."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from uccl_tpu.ops.quant import dequantize_fp8, quantize_fp8
+
+
+def test_roundtrip_accuracy(rng):
+    x = rng.standard_normal((4, 16, 256)).astype(np.float32)
+    q, scale = quantize_fp8(jnp.asarray(x), group_size=128)
+    assert q.dtype == jnp.float8_e4m3fn
+    assert scale.shape == (4, 16, 2)
+    back = np.asarray(dequantize_fp8(q, scale, 128, dtype=jnp.float32))
+    rel = np.abs(back - x) / (np.abs(x).max() + 1e-9)
+    assert rel.max() < 0.05  # e4m3 has ~2 decimal digits
+
+
+def test_scale_handles_outliers(rng):
+    x = rng.standard_normal((2, 256)).astype(np.float32)
+    x[0, 0] = 1e4  # huge outlier in group 0
+    q, scale = quantize_fp8(jnp.asarray(x), group_size=128)
+    back = np.asarray(dequantize_fp8(q, scale, 128, dtype=jnp.float32))
+    assert abs(back[0, 0] - 1e4) / 1e4 < 0.1
+    # other group unaffected by the outlier (e4m3 ~6% relative precision)
+    np.testing.assert_allclose(back[0, 128:], x[0, 128:], atol=0.25)
+
+
+def test_zero_input():
+    x = jnp.zeros((1, 128))
+    q, scale = quantize_fp8(x)
+    back = dequantize_fp8(q, scale, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(back), 0.0)
+
+
+def test_bad_group():
+    with pytest.raises(ValueError):
+        quantize_fp8(jnp.zeros((2, 100)), group_size=128)

@@ -1,0 +1,21 @@
+"""Expert-parallel engine: MoE dispatch/combine over the mesh.
+
+The analog of the reference's ``ep/`` pillar (DeepEP-compatible dispatch/combine
+all-to-all, SURVEY.md §2.3). The reference replaces NVIDIA IBGDA with a GPU→CPU
+command ring + CPU proxy posting RDMA (ep/include/uccl_ibgda.cuh:27,
+ep/src/proxy.cpp:701); on TPU the fabric is compiler-driven, so dispatch/combine
+lower to capacity-bucketed ``lax.all_to_all`` exchanges over the EP mesh axes —
+the GShard-lineage formulation that keeps every shape static and every matmul on
+the MXU — with optional fp8 payload packing on the wire (the analog of
+internode_ll.cu's fp8+scales message format).
+
+Surfaces:
+* :mod:`uccl_tpu.ep.ops`    — per-shard routing/dispatch/combine for shard_map code.
+* :class:`uccl_tpu.ep.Buffer` — DeepEP-shaped host API (dispatch / combine /
+  low_latency_dispatch / low_latency_combine / get_dispatch_layout).
+"""
+
+from uccl_tpu.ep import ops
+from uccl_tpu.ep.buffer import Buffer
+
+__all__ = ["ops", "Buffer"]

@@ -1,0 +1,25 @@
+"""Model families exercising every parallel axis of the framework.
+
+The reference is a comm library consumed by Megatron/vLLM/DeepEP models
+(SURVEY.md §1 L6); this framework carries its own flagship models so the
+collective/EP/sequence-parallel layers are exercised end-to-end the way those
+applications exercise UCCL.
+"""
+
+from uccl_tpu.models.flagship import (
+    FlagshipConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    param_specs,
+)
+
+__all__ = [
+    "FlagshipConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+    "param_specs",
+]

@@ -1,0 +1,388 @@
+"""Flagship model: MoE transformer exercising every parallel axis (dp/pp/cp/tp + ep).
+
+This is the framework's analog of the applications the reference serves
+(Megatron TP/PP workloads, DeepSeek-style EP MoE, long-context CP — SURVEY.md
+§2.6): a Mixtral/DeepSeek-class decoder written *manually sharded* in one
+``shard_map`` over the 4-axis mesh, TPU-first:
+
+* tensor parallel (``tp``): Megatron-style column/row splits on attention and
+  expert FFNs; vocab-parallel embedding + cross-entropy.
+* context parallel (``cp``): ring attention (default) or Ulysses over the
+  sequence dimension — the long-context layer.
+* expert parallel (``dp``×``cp``): capacity-bucketed all-to-all dispatch/combine
+  from :mod:`uccl_tpu.ep.ops`.
+* pipeline parallel (``pp``): GPipe microbatch schedule from
+  :mod:`uccl_tpu.parallel.pipeline`, layers sharded over stages.
+* data parallel (``dp``): batch sharding; gradient reduction falls out of
+  shard_map's transpose (replicated params → psum'd cotangents).
+
+Everything is static-shape, scan-based, and bfloat16-on-MXU friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from uccl_tpu.ep import ops as ep_ops
+from uccl_tpu.models.layers import rms_norm, rope, tp_cross_entropy
+from uccl_tpu.ops.attention import attention_reference, ring_attention, ulysses_attention
+from uccl_tpu.parallel.mesh import AXIS
+from uccl_tpu.parallel.pipeline import gpipe_spmd
+
+
+@dataclasses.dataclass(frozen=True)
+class FlagshipConfig:
+    vocab: int = 1024
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    head_dim: int = 32
+    moe_experts: int = 8
+    moe_topk: int = 2
+    moe_ffn: int = 512
+    capacity_factor: float = 1.5
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 1e-3
+    n_microbatches: int = 1
+    seq_mode: str = "ring"  # "ring" | "ulysses"
+    wire_fp8: bool = False
+    dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def param_specs(cfg: FlagshipConfig) -> Dict[str, Any]:
+    """PartitionSpec tree matching :func:`init_params`' pytree."""
+    ep_axes = AXIS.EP
+    return {
+        "embed": P(AXIS.TP, None),
+        "blocks": {
+            "ln1": P(AXIS.PP, None),
+            "ln2": P(AXIS.PP, None),
+            "wq": P(AXIS.PP, None, AXIS.TP),
+            "wk": P(AXIS.PP, None, AXIS.TP),
+            "wv": P(AXIS.PP, None, AXIS.TP),
+            "wo": P(AXIS.PP, AXIS.TP, None),
+            "router": P(AXIS.PP, None, None),
+            "we_gate": P(AXIS.PP, ep_axes, None, AXIS.TP),
+            "we_up": P(AXIS.PP, ep_axes, None, AXIS.TP),
+            "we_down": P(AXIS.PP, ep_axes, AXIS.TP, None),
+        },
+        "final_norm": P(None),
+        "head": P(None, AXIS.TP),
+    }
+
+
+def init_params(key: jax.Array, cfg: FlagshipConfig) -> Dict[str, Any]:
+    """Initialize the full (global) parameter pytree on host."""
+    k = jax.random.split(key, 10)
+    h, l = cfg.dim, cfg.n_layers
+    qd, kvd = cfg.n_heads * cfg.head_dim, cfg.n_kv_heads * cfg.head_dim
+    e, f = cfg.moe_experts, cfg.moe_ffn
+    s_in = 1.0 / math.sqrt(h)
+    s_ffn = 1.0 / math.sqrt(f)
+
+    def rnd(kk, shape, scale):
+        return (jax.random.normal(kk, shape, jnp.float32) * scale).astype(jnp.float32)
+
+    return {
+        "embed": rnd(k[0], (cfg.vocab, h), 0.02),
+        "blocks": {
+            "ln1": jnp.ones((l, h), jnp.float32),
+            "ln2": jnp.ones((l, h), jnp.float32),
+            "wq": rnd(k[1], (l, h, qd), s_in),
+            "wk": rnd(k[2], (l, h, kvd), s_in),
+            "wv": rnd(k[3], (l, h, kvd), s_in),
+            "wo": rnd(k[4], (l, qd, h), 1.0 / math.sqrt(qd)),
+            "router": rnd(k[5], (l, h, e), s_in),
+            "we_gate": rnd(k[6], (l, e, h, f), s_in),
+            "we_up": rnd(k[7], (l, e, h, f), s_in),
+            "we_down": rnd(k[8], (l, e, f, h), s_ffn),
+        },
+        "final_norm": jnp.ones((h,), jnp.float32),
+        "head": rnd(k[9], (h, cfg.vocab), s_in),
+    }
+
+
+def shard_params(params, mesh: Mesh, cfg: FlagshipConfig):
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+# ---------------------------------------------------------------------------
+# Per-shard forward (inside shard_map)
+
+
+def _attention(x, lp, cfg: FlagshipConfig):
+    """x: [B, S_loc, H_model] -> [B, S_loc, H_model] (pre-psum over tp)."""
+    b, s_loc, _ = x.shape
+    d = cfg.head_dim
+    nh_loc = lp["wq"].shape[-1] // d
+    nkv_loc = lp["wk"].shape[-1] // d
+    q = (x @ lp["wq"].astype(x.dtype)).reshape(b, s_loc, nh_loc, d)
+    kk = (x @ lp["wk"].astype(x.dtype)).reshape(b, s_loc, nkv_loc, d)
+    v = (x @ lp["wv"].astype(x.dtype)).reshape(b, s_loc, nkv_loc, d)
+    cp_idx = lax.axis_index(AXIS.CP)
+    positions = cp_idx * s_loc + jnp.arange(s_loc)
+    q = rope(q, positions, cfg.rope_theta)
+    kk = rope(kk, positions, cfg.rope_theta)
+    if cfg.seq_mode == "ulysses":
+        attn = ulysses_attention(q, kk, v, AXIS.CP, causal=True)
+    else:
+        attn = ring_attention(q, kk, v, AXIS.CP, causal=True)
+    out = attn.reshape(b, s_loc, nh_loc * d) @ lp["wo"].astype(x.dtype)
+    return out
+
+
+def _layer(x, lp, cfg: FlagshipConfig):
+    """One transformer block (per-shard). x: [B, S_loc, H]. Returns (x, aux)."""
+    b, s_loc, h = x.shape
+    attn_out = _attention(rms_norm(x, lp["ln1"], cfg.norm_eps), lp, cfg)
+    x = x + lax.psum(attn_out, AXIS.TP)
+
+    h2 = rms_norm(x, lp["ln2"], cfg.norm_eps)
+    flat = h2.reshape(b * s_loc, h)
+    router_logits = flat.astype(jnp.float32) @ lp["router"]
+    moe_out, aux, z = ep_ops.moe_ffn(
+        flat,
+        router_logits,
+        lp["we_gate"].astype(flat.dtype),
+        lp["we_up"].astype(flat.dtype),
+        lp["we_down"].astype(flat.dtype),
+        AXIS.EP,
+        num_selected=cfg.moe_topk,
+        capacity_factor=cfg.capacity_factor,
+        wire_fp8=cfg.wire_fp8,
+    )
+    x = x + lax.psum(moe_out.reshape(b, s_loc, h), AXIS.TP)
+    aux_scalar = cfg.aux_loss_weight * aux + cfg.z_loss_weight * z
+    return x, aux_scalar
+
+
+def _embed(tokens, embed_local, cfg: FlagshipConfig):
+    """Vocab-parallel embedding lookup. tokens: [B, S_loc] -> [B, S_loc, H]."""
+    v_loc = embed_local.shape[0]
+    off = lax.axis_index(AXIS.TP) * v_loc
+    local = tokens - off
+    in_range = (local >= 0) & (local < v_loc)
+    emb = jnp.take(embed_local, jnp.clip(local, 0, v_loc - 1), axis=0)
+    emb = jnp.where(in_range[..., None], emb, jnp.zeros_like(emb))
+    return lax.psum(emb, AXIS.TP)
+
+
+def _per_shard_logits_aux(params, tokens, cfg: FlagshipConfig):
+    """tokens: [B_loc, S_loc] -> (logits [B_loc, S_loc, V_loc], aux scalar)."""
+    b_loc, s_loc = tokens.shape
+    m = cfg.n_microbatches
+    if b_loc % m:
+        raise ValueError(f"local batch {b_loc} not divisible by {m} microbatches")
+
+    x = _embed(tokens, params["embed"], cfg).astype(cfg.dtype)
+    xmb = x.reshape(m, b_loc // m, s_loc, cfg.dim)
+
+    layer_ckpt = jax.checkpoint(partial(_layer, cfg=cfg))
+
+    def stage_fn(xm):
+        def body(carry, lp):
+            y, aux = layer_ckpt(carry, lp)
+            return y, aux
+
+        y, auxs = lax.scan(body, xm, params["blocks"])
+        return y, jnp.sum(auxs)
+
+    out, aux = gpipe_spmd(stage_fn, xmb, AXIS.PP)
+    x = out.reshape(b_loc, s_loc, cfg.dim)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["head"]
+    return logits, aux
+
+
+def _per_shard_loss(params, tokens, targets, cfg: FlagshipConfig):
+    logits, aux = _per_shard_logits_aux(params, tokens, cfg)
+    v_loc = logits.shape[-1]
+    off = lax.axis_index(AXIS.TP) * v_loc
+    per_token = tp_cross_entropy(
+        logits.reshape(-1, v_loc), targets.reshape(-1), off, AXIS.TP
+    )
+    loss = jnp.mean(per_token)
+    loss = lax.pmean(loss, AXIS.EP)  # average over dp×cp data shards
+    # aux is summed over layers and microbatches; normalize and average
+    aux_norm = lax.pmean(aux, AXIS.EP) / (cfg.n_layers * cfg.n_microbatches)
+    return loss + aux_norm, loss
+
+
+# ---------------------------------------------------------------------------
+# Host API
+
+
+def _data_spec() -> P:
+    return P(AXIS.DP, AXIS.CP)
+
+
+def forward(params, tokens, cfg: FlagshipConfig, mesh: Mesh):
+    """Global forward: tokens [B, S] -> logits [B, S, V]. Jit-compatible."""
+
+    def f(p, t):
+        logits, _ = _per_shard_logits_aux(p, t, cfg)
+        return logits
+
+    return shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(param_specs(cfg), _data_spec()),
+        out_specs=P(AXIS.DP, AXIS.CP, AXIS.TP),
+        check_vma=False,
+    )(params, tokens)
+
+
+def loss_fn(params, tokens, targets, cfg: FlagshipConfig, mesh: Mesh):
+    """Global mean loss (includes aux); returns (total_loss, ce_loss)."""
+
+    def f(p, t, y):
+        return _per_shard_loss(p, t, y, cfg)
+
+    return shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(param_specs(cfg), _data_spec(), _data_spec()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(params, tokens, targets)
+
+
+def make_train_step(cfg: FlagshipConfig, mesh: Mesh, learning_rate: float = 3e-4):
+    """Returns (train_step, init_optimizer). train_step is jittable:
+    (params, opt_state, tokens, targets) -> (params, opt_state, metrics)."""
+    import optax
+
+    tx = optax.adamw(learning_rate, weight_decay=0.01)
+
+    def total_loss(p, t, y):
+        total, ce = loss_fn(p, t, y, cfg, mesh)
+        return total, ce
+
+    def train_step(params, opt_state, tokens, targets):
+        (total, ce), grads = jax.value_and_grad(total_loss, has_aux=True)(
+            params, tokens, targets
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": total, "ce": ce}
+
+    def init_optimizer(params):
+        return tx.init(params)
+
+    return train_step, init_optimizer
+
+
+# ---------------------------------------------------------------------------
+# Dense single-device reference (oracle for tests)
+
+
+def reference_forward(params, tokens, cfg: FlagshipConfig):
+    """Unsharded oracle implementing the same math (no mesh, no collectives).
+    Capacity is computed from the *global* token count, so results match the
+    sharded model only when capacity is large enough that nothing drops."""
+    b, s = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+
+    def one_layer(x, lp):
+        h = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        d = cfg.head_dim
+        q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, d)
+        kk = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, d)
+        v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, d)
+        pos = jnp.arange(s)
+        q, kk = rope(q, pos, cfg.rope_theta), rope(kk, pos, cfg.rope_theta)
+        attn = attention_reference(q, kk, v, causal=True)
+        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+
+        h2 = rms_norm(x, lp["ln2"], cfg.norm_eps)
+        flat = h2.reshape(b * s, cfg.dim)
+        logits = flat.astype(jnp.float32) @ lp["router"]
+        cap = max(
+            1,
+            int(
+                cfg.capacity_factor * flat.shape[0] * cfg.moe_topk / cfg.moe_experts
+            ),
+        )
+        r = ep_ops.route_topk(logits, cfg.moe_topk, cap)
+        xe = jnp.einsum("tec,th->ech", r.dispatch_mask.astype(flat.dtype), flat)
+        act = jax.nn.silu(jnp.einsum("ech,ehf->ecf", xe, lp["we_gate"])) * jnp.einsum(
+            "ech,ehf->ecf", xe, lp["we_up"]
+        )
+        ye = jnp.einsum("ecf,efh->ech", act, lp["we_down"])
+        moe = jnp.einsum("tec,ech->th", r.combine_weights.astype(ye.dtype), ye)
+        x = x + moe.reshape(b, s, cfg.dim)
+        return x, None
+
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["blocks"])
+        x, _ = one_layer(x, lp)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x.astype(jnp.float32) @ params["head"]
+
+
+def reference_dense_loss(params, tokens, targets, cfg: FlagshipConfig):
+    """Naive dense-MoE baseline: every expert computes every token, outputs
+    weighted by the (renormalized) top-k gates. This is the no-dispatch-layer
+    implementation a user would write without an EP engine — the benchmark
+    baseline in bench.py."""
+    b, s = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+
+    def one_layer(x, lp):
+        h = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        d = cfg.head_dim
+        q = (h @ lp["wq"].astype(h.dtype)).reshape(b, s, cfg.n_heads, d)
+        kk = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
+        v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
+        pos = jnp.arange(s)
+        q, kk = rope(q, pos, cfg.rope_theta), rope(kk, pos, cfg.rope_theta)
+        attn = attention_reference(q, kk, v, causal=True)
+        x = x + attn.reshape(b, s, -1) @ lp["wo"].astype(attn.dtype)
+
+        h2 = rms_norm(x, lp["ln2"], cfg.norm_eps)
+        flat = h2.reshape(b * s, cfg.dim)
+        rl = flat.astype(jnp.float32) @ lp["router"]
+        gates = jax.nn.softmax(rl, axis=-1)
+        tv, ti = lax.top_k(gates, cfg.moe_topk)
+        tv = tv / jnp.maximum(tv.sum(-1, keepdims=True), 1e-9)
+        weights = (
+            jnp.zeros_like(gates)
+            .at[jnp.arange(gates.shape[0])[:, None], ti]
+            .set(tv)
+        )  # [T, E]
+        # dense: every expert computes every token
+        act = jax.nn.silu(
+            jnp.einsum("th,ehf->etf", flat, lp["we_gate"].astype(flat.dtype))
+        ) * jnp.einsum("th,ehf->etf", flat, lp["we_up"].astype(flat.dtype))
+        ye = jnp.einsum("etf,efh->eth", act, lp["we_down"].astype(act.dtype))
+        moe = jnp.einsum("te,eth->th", weights.astype(ye.dtype), ye)
+        x = x + moe.reshape(b, s, cfg.dim)
+        return x
+
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["blocks"])
+        x = one_layer(x, lp)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["head"]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)

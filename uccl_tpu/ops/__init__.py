@@ -1,0 +1,22 @@
+"""Device compute ops: attention (full / ring / Ulysses), quantization, MoE math.
+
+The reference keeps device work in CUDA kernels (ep/src/*.cu,
+collective/efa/scattered_memcpy.cu); here the device path is JAX/XLA + Pallas.
+Every op has a pure-XLA implementation that runs anywhere (CPU tests, TPU), with
+Pallas TPU kernels layered on where they beat XLA fusion.
+"""
+
+from uccl_tpu.ops.attention import (
+    attention_reference,
+    ring_attention,
+    ulysses_attention,
+)
+from uccl_tpu.ops.quant import quantize_fp8, dequantize_fp8
+
+__all__ = [
+    "attention_reference",
+    "ring_attention",
+    "ulysses_attention",
+    "quantize_fp8",
+    "dequantize_fp8",
+]

@@ -1,0 +1,46 @@
+"""FP8 payload quantization for wire transfer.
+
+The analog of the reference's fp8-packed EP payloads (ep/src/internode_ll.cu:62
+casts tokens to fp8 + per-group scales before RDMA) and the DietGPU float
+compression on the P2P wire (p2p/rdma/compression.{h,cc}): shrink what moves
+across the fabric, restore on arrival. On TPU we use native ``float8_e4m3fn``
+with per-group scales — MXU-friendly and XLA-fusable into the surrounding ops.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+FP8_DTYPE = jnp.float8_e4m3fn
+FP8_MAX = 448.0  # max normal of e4m3fn
+
+
+def quantize_fp8(
+    x: jax.Array, group_size: int = 128
+) -> Tuple[jax.Array, jax.Array]:
+    """Quantize along the last dim in groups: returns (fp8 values, f32 scales).
+
+    x: [..., D] with D % group_size == 0 → values [..., D] fp8,
+    scales [..., D // group_size] f32 such that values * scale ≈ x.
+    """
+    *lead, d = x.shape
+    if d % group_size:
+        raise ValueError(f"last dim {d} not divisible by group size {group_size}")
+    g = x.reshape(*lead, d // group_size, group_size).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / FP8_MAX
+    q = (g / scale).astype(FP8_DTYPE)
+    return q.reshape(*lead, d), scale[..., 0]
+
+
+def dequantize_fp8(
+    q: jax.Array, scale: jax.Array, group_size: int = 128, dtype=jnp.bfloat16
+) -> jax.Array:
+    """Inverse of :func:`quantize_fp8`."""
+    *lead, d = q.shape
+    g = q.reshape(*lead, d // group_size, group_size).astype(jnp.float32)
+    out = g * scale[..., None]
+    return out.reshape(*lead, d).astype(dtype)

@@ -1,0 +1,6 @@
+"""P2P transfer engine (pillar 2): NIXL-style register/connect/one-sided
+read-write over DCN, with a C++ host runtime underneath.
+
+The analog of the reference's ``p2p/engine.{h,cc}`` (SURVEY.md §2.2). The C++
+engine + ctypes bindings land here; see ``native/`` for the host runtime.
+"""

@@ -1,0 +1,84 @@
+"""SPMD pipeline parallelism over the ``pp`` mesh axis.
+
+The reference offers "0 SM PP (with RDMA)" — one-sided activation sends between
+pipeline stages with zero compute occupancy (experimental/lite/lite-ep/README.md:24,
+tests/elastic/test_pp.py). The TPU-native equivalent: a GPipe schedule written as
+a single ``lax.scan`` whose stage-to-stage hand-off is ``lax.ppermute`` over the
+``pp`` axis — XLA turns those into async ICI sends that overlap the next
+microbatch's compute, which is exactly the zero-SM property (no device compute
+spent on communication).
+
+Per-shard function (use inside shard_map). All stages run the same program; a
+stage's identity comes from ``lax.axis_index``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from uccl_tpu.utils.topology import ppermute_pairs
+
+
+def gpipe_spmd(
+    stage_fn: Callable[[jax.Array], Tuple[jax.Array, jax.Array]],
+    xmb: jax.Array,
+    axis: str = "pp",
+) -> Tuple[jax.Array, jax.Array]:
+    """Run microbatches through the pipeline stages.
+
+    Args:
+      stage_fn: per-stage computation ``x -> (y, aux)`` where x/y are one
+        microbatch of activations ``[B_mb, ...]`` (same shape in and out) and
+        aux is a scalar side-channel (e.g. MoE aux losses), summed over valid
+        microbatches.
+      xmb: ``[M, B_mb, ...]`` microbatched input activations (the stage-0
+        input stream; other stages ignore it).
+      axis: the pipeline mesh axis.
+
+    Returns:
+      (out ``[M, B_mb, ...]`` final-stage outputs replicated across pp members,
+       aux scalar summed over all stages and microbatches, replicated).
+
+    Schedule: step t has stage s working on microbatch ``t - s`` (valid when
+    0 <= t-s < M); total ``M + P - 1`` steps; bubble fraction (P-1)/(M+P-1).
+    """
+    p = lax.axis_size(axis)
+    s = lax.axis_index(axis)
+    m = xmb.shape[0]
+    perm = ppermute_pairs(p, 1)
+
+    def step(carry, t):
+        xbuf, outbuf, aux = carry
+        fresh = lax.dynamic_index_in_dim(
+            xmb, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+        )
+        x_in = jnp.where(s == 0, fresh, xbuf)
+        y, aux_step = stage_fn(x_in)
+        m_local = t - s
+        valid = (m_local >= 0) & (m_local < m)
+        aux = aux + jnp.where(valid, aux_step, jnp.zeros_like(aux_step))
+        # Collect this stage's output for microbatch t-(p-1); only the last
+        # stage's buffer survives the psum below.
+        m_out = t - (p - 1)
+        idx = jnp.clip(m_out, 0, m - 1)
+        cur = lax.dynamic_index_in_dim(outbuf, idx, axis=0, keepdims=False)
+        newv = jnp.where((m_out >= 0) & (m_out < m), y, cur)
+        outbuf = lax.dynamic_update_index_in_dim(outbuf, newv, idx, axis=0)
+        x_next = lax.ppermute(y, axis, perm)
+        return (x_next, outbuf, aux), None
+
+    xbuf0 = jnp.zeros_like(xmb[0])
+    outbuf0 = jnp.zeros_like(xmb)
+    aux0 = jnp.zeros((), jnp.float32)
+    (xbuf, outbuf, aux), _ = lax.scan(
+        step, (xbuf0, outbuf0, aux0), jnp.arange(m + p - 1)
+    )
+    # Broadcast the last stage's collected outputs (and every stage's aux) to
+    # all pp members so downstream loss code is uniform SPMD.
+    out = lax.psum(jnp.where(s == p - 1, outbuf, jnp.zeros_like(outbuf)), axis)
+    aux_total = lax.psum(aux, axis)
+    return out, aux_total

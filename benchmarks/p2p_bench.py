@@ -1,0 +1,42 @@
+"""P2P transfer-engine bandwidth over TCP loopback (2 local ranks).
+
+The analog of the reference's p2p/benchmarks (and the driver config "p2p
+send/recv over TCP loopback"). Prints one JSON line per message size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from uccl_tpu.p2p import Endpoint  # noqa: E402
+
+
+def run(sizes=(4 << 10, 64 << 10, 1 << 20, 16 << 20, 64 << 20), iters=20):
+    results = []
+    with Endpoint() as server, Endpoint() as client:
+        conn = client.connect("127.0.0.1", server.port)
+        server.accept()
+        for size in sizes:
+            dst = np.zeros(size, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            src = np.random.default_rng(0).integers(0, 255, size).astype(np.uint8)
+            client.write(conn, src, fifo)  # warmup
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                client.write(conn, src, fifo)
+            dt = (time.perf_counter() - t0) / iters
+            gbps = size / dt / 1e9
+            results.append({"size": size, "GB/s": round(gbps, 3), "lat_us": round(dt * 1e6, 1)})
+            print(json.dumps(results[-1]))
+    return results
+
+
+if __name__ == "__main__":
+    run()

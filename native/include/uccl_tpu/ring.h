@@ -1,0 +1,60 @@
+// Lock-free single-producer single-consumer ring.
+//
+// TPU-native equivalent of the reference's universal inter-thread channel
+// (include/util/jring.h, FreeBSD/DPDK lineage; used as `Channel` in
+// collective/rdma/transport.h:50 and the p2p task rings, p2p/engine.h:441).
+// Fixed power-of-two capacity, cache-line separated head/tail, acquire/release
+// ordering only — no fences on the fast path.
+
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace uccl_tpu {
+
+template <typename T>
+class SpscRing {
+ public:
+  explicit SpscRing(size_t capacity_pow2) : mask_(capacity_pow2 - 1) {
+    // capacity must be a power of two
+    if ((capacity_pow2 & mask_) != 0 || capacity_pow2 == 0) {
+      capacity_pow2 = 1024;
+      mask_ = capacity_pow2 - 1;
+    }
+    slots_.resize(capacity_pow2);
+  }
+
+  bool push(T v) {
+    const uint64_t head = head_.load(std::memory_order_relaxed);
+    const uint64_t tail = tail_.load(std::memory_order_acquire);
+    if (head - tail > mask_) return false;  // full
+    slots_[head & mask_] = std::move(v);
+    head_.store(head + 1, std::memory_order_release);
+    return true;
+  }
+
+  bool pop(T* out) {
+    const uint64_t tail = tail_.load(std::memory_order_relaxed);
+    const uint64_t head = head_.load(std::memory_order_acquire);
+    if (tail == head) return false;  // empty
+    *out = std::move(slots_[tail & mask_]);
+    tail_.store(tail + 1, std::memory_order_release);
+    return true;
+  }
+
+  size_t size() const {
+    return head_.load(std::memory_order_acquire) -
+           tail_.load(std::memory_order_acquire);
+  }
+
+ private:
+  alignas(64) std::atomic<uint64_t> head_{0};
+  alignas(64) std::atomic<uint64_t> tail_{0};
+  size_t mask_;
+  std::vector<T> slots_;
+};
+
+}  // namespace uccl_tpu

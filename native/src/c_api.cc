@@ -1,0 +1,131 @@
+// Plain-C API over the engine for ctypes bindings.
+//
+// The analog of the reference's p2p/uccl_engine.{h,cc} C API (uccl_engine.h:35:
+// engine create/connect/reg/xfer/notify for the NIXL plugin); the Python
+// package binds these with ctypes (pybind11/nanobind are not available in this
+// environment — see uccl_tpu/p2p/endpoint.py).
+
+#include <cstring>
+
+#include "uccl_tpu/engine.h"
+
+using uccl_tpu::Endpoint;
+using uccl_tpu::FifoItem;
+using uccl_tpu::XferState;
+
+extern "C" {
+
+void* ucclt_create(uint16_t port) {
+  auto* ep = new Endpoint(port);
+  if (!ep->ok()) {  // e.g. port already in use
+    delete ep;
+    return nullptr;
+  }
+  return ep;
+}
+
+void ucclt_destroy(void* ep) { delete static_cast<Endpoint*>(ep); }
+
+uint16_t ucclt_listen_port(void* ep) {
+  return static_cast<Endpoint*>(ep)->listen_port();
+}
+
+int64_t ucclt_connect(void* ep, const char* ip, uint16_t port) {
+  return static_cast<Endpoint*>(ep)->connect(ip, port);
+}
+
+int64_t ucclt_accept(void* ep, int timeout_ms) {
+  return static_cast<Endpoint*>(ep)->accept(timeout_ms);
+}
+
+int ucclt_remove_conn(void* ep, uint64_t conn_id) {
+  return static_cast<Endpoint*>(ep)->remove_conn(conn_id) ? 0 : -1;
+}
+
+uint64_t ucclt_reg(void* ep, void* ptr, size_t len) {
+  return static_cast<Endpoint*>(ep)->reg(ptr, len);
+}
+
+int ucclt_dereg(void* ep, uint64_t mr) {
+  return static_cast<Endpoint*>(ep)->dereg(mr) ? 0 : -1;
+}
+
+// out must point at 64 writable bytes (the serialized FifoItem).
+int ucclt_advertise(void* ep, uint64_t mr, size_t offset, size_t len,
+                    uint8_t* out) {
+  FifoItem item;
+  if (!static_cast<Endpoint*>(ep)->advertise(mr, offset, len, &item)) return -1;
+  std::memcpy(out, &item, sizeof(item));
+  return 0;
+}
+
+static FifoItem parse_item(const uint8_t* buf) {
+  FifoItem item;
+  std::memcpy(&item, buf, sizeof(item));
+  return item;
+}
+
+int ucclt_write(void* ep, uint64_t conn, const void* src, size_t len,
+                const uint8_t* fifo) {
+  return static_cast<Endpoint*>(ep)->write(conn, src, len, parse_item(fifo))
+             ? 0
+             : -1;
+}
+
+int ucclt_read(void* ep, uint64_t conn, void* dst, size_t len,
+               const uint8_t* fifo) {
+  return static_cast<Endpoint*>(ep)->read(conn, dst, len, parse_item(fifo))
+             ? 0
+             : -1;
+}
+
+uint64_t ucclt_write_async(void* ep, uint64_t conn, const void* src, size_t len,
+                           const uint8_t* fifo) {
+  return static_cast<Endpoint*>(ep)->write_async(conn, src, len,
+                                                 parse_item(fifo));
+}
+
+uint64_t ucclt_read_async(void* ep, uint64_t conn, void* dst, size_t len,
+                          const uint8_t* fifo) {
+  return static_cast<Endpoint*>(ep)->read_async(conn, dst, len,
+                                                parse_item(fifo));
+}
+
+// 0 = pending, 1 = done, -1 = error
+int ucclt_poll(void* ep, uint64_t xfer) {
+  switch (static_cast<Endpoint*>(ep)->poll(xfer)) {
+    case XferState::kPending:
+      return 0;
+    case XferState::kDone:
+      return 1;
+    default:
+      return -1;
+  }
+}
+
+int ucclt_wait(void* ep, uint64_t xfer, int timeout_ms) {
+  return static_cast<Endpoint*>(ep)->wait(xfer, timeout_ms) ? 0 : -1;
+}
+
+int ucclt_send(void* ep, uint64_t conn, const void* buf, size_t len) {
+  return static_cast<Endpoint*>(ep)->send(conn, buf, len) ? 0 : -1;
+}
+
+int64_t ucclt_recv(void* ep, uint64_t conn, void* buf, size_t cap,
+                   int timeout_ms) {
+  return static_cast<Endpoint*>(ep)->recv(conn, buf, cap, timeout_ms);
+}
+
+void ucclt_set_drop_rate(void* ep, double p) {
+  static_cast<Endpoint*>(ep)->set_drop_rate(p);
+}
+
+uint64_t ucclt_bytes_tx(void* ep) {
+  return static_cast<Endpoint*>(ep)->bytes_tx();
+}
+
+uint64_t ucclt_bytes_rx(void* ep) {
+  return static_cast<Endpoint*>(ep)->bytes_rx();
+}
+
+}  // extern "C"

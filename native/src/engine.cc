@@ -1,0 +1,544 @@
+// Implementation of the P2P transfer engine (see include/uccl_tpu/engine.h).
+//
+// Threading model mirrors the reference's p2p engine: application threads
+// enqueue tasks onto a lock-free ring; a dedicated tx proxy thread owns the
+// wire sends (reference send_proxy_thread_func, p2p/engine.cc:2248); one io
+// thread owns epoll dispatch of inbound frames (recv proxy, engine.cc:2286).
+
+#include "uccl_tpu/engine.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+
+namespace uccl_tpu {
+
+namespace {
+constexpr uint32_t kMagic = 0x7C71u;
+// Upper bound on a single frame payload — rejects absurd lengths from a buggy
+// or malicious peer before any allocation happens.
+constexpr uint64_t kMaxFrameLen = 1ull << 30;
+
+bool recv_all(int fd, void* buf, size_t len) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::recv(fd, p + got, len - got, MSG_WAITALL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    got += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool send_all(int fd, const void* buf, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd, p + sent, len - sent, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    sent += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+uint64_t random_token() {
+  static thread_local std::mt19937_64 gen{std::random_device{}()};
+  return gen();
+}
+}  // namespace
+
+Endpoint::Endpoint(uint16_t port) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(listen_fd_, 128) != 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  } else {
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    listen_port_ = ntohs(addr.sin_port);
+  }
+
+  epoll_fd_ = ::epoll_create1(0);
+  wake_fd_ = ::eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  // 0 => wake fd
+  ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+  if (listen_fd_ >= 0) {
+    ev.data.u64 = 1;  // 1 => listener
+    ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+  }
+
+  io_thread_ = std::thread([this] { io_loop(); });
+  tx_thread_ = std::thread([this] { tx_loop(); });
+}
+
+Endpoint::~Endpoint() {
+  stop_.store(true);
+  uint64_t one = 1;
+  ::write(wake_fd_, &one, sizeof(one));
+  task_cv_.notify_all();
+  if (io_thread_.joinable()) io_thread_.join();
+  if (tx_thread_.joinable()) tx_thread_.join();
+  {
+    std::lock_guard<std::mutex> lk(conns_mtx_);
+    for (auto& [id, c] : conns_) ::close(c->fd);
+  }
+  if (listen_fd_ >= 0) ::close(listen_fd_);
+  if (epoll_fd_ >= 0) ::close(epoll_fd_);
+  if (wake_fd_ >= 0) ::close(wake_fd_);
+  Task* t = nullptr;
+  while (task_ring_.pop(&t)) delete t;
+}
+
+int64_t Endpoint::connect(const std::string& ip, uint16_t port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (::inet_pton(AF_INET, ip.c_str(), &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto c = std::make_unique<Conn>();
+  c->fd = fd;
+  c->id = next_conn_.fetch_add(1);
+  uint64_t id = c->id;
+  {
+    std::lock_guard<std::mutex> lk(conns_mtx_);
+    conns_[id] = std::move(c);
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = (id << 2) | 2;  // tag 2 => conn
+  ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+  return static_cast<int64_t>(id);
+}
+
+int64_t Endpoint::accept(int timeout_ms) {
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  uint64_t id = 0;
+  while (!accept_queue_.pop(&id)) {
+    if (stop_.load() || std::chrono::steady_clock::now() > deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  }
+  return static_cast<int64_t>(id);
+}
+
+bool Endpoint::remove_conn(uint64_t conn_id) {
+  std::lock_guard<std::mutex> lk(conns_mtx_);
+  auto it = conns_.find(conn_id);
+  if (it == conns_.end()) return false;
+  ::epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second->fd, nullptr);
+  ::close(it->second->fd);
+  conns_.erase(it);
+  return true;
+}
+
+uint64_t Endpoint::reg(void* ptr, size_t len) {
+  Reg r{ptr, len};
+  uint64_t id = next_reg_.fetch_add(1);
+  std::lock_guard<std::mutex> lk(regs_mtx_);
+  regs_[id] = r;
+  return id;
+}
+
+bool Endpoint::dereg(uint64_t mr_id) {
+  std::lock_guard<std::mutex> lk(regs_mtx_);
+  for (auto it = windows_.begin(); it != windows_.end();) {
+    if (it->second.mr_id == mr_id) {
+      it = windows_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  return regs_.erase(mr_id) > 0;
+}
+
+bool Endpoint::advertise(uint64_t mr_id, size_t offset, size_t len,
+                         FifoItem* out) {
+  std::lock_guard<std::mutex> lk(regs_mtx_);
+  auto it = regs_.find(mr_id);
+  if (it == regs_.end() || offset > it->second.len ||
+      len > it->second.len - offset) {
+    return false;
+  }
+  uint64_t wid = next_window_.fetch_add(1);
+  windows_[wid] = Window{mr_id, offset, len, random_token()};
+  std::memset(out, 0, sizeof(*out));
+  out->rid = wid;
+  out->size = len;
+  out->token = windows_[wid].token;
+  out->offset = 0;
+  return true;
+}
+
+// Resolve a (window id, token, offset, len) quadruple from the wire into a
+// host pointer, enforcing the advertised byte range with overflow-safe math.
+// Returns nullptr if anything is off. Caller must hold regs_mtx_.
+void* Endpoint::resolve_window_locked(uint64_t wid, uint64_t token,
+                                      uint64_t offset, uint64_t len) {
+  auto wit = windows_.find(wid);
+  if (wit == windows_.end() || wit->second.token != token) return nullptr;
+  const Window& w = wit->second;
+  if (offset > w.len || len > w.len - offset) return nullptr;
+  auto rit = regs_.find(w.mr_id);
+  if (rit == regs_.end()) return nullptr;
+  return static_cast<uint8_t*>(rit->second.ptr) + w.offset + offset;
+}
+
+Endpoint::Conn* Endpoint::get_conn(uint64_t id) {
+  std::lock_guard<std::mutex> lk(conns_mtx_);
+  auto it = conns_.find(id);
+  return it == conns_.end() ? nullptr : it->second.get();
+}
+
+uint64_t Endpoint::new_xfer() {
+  uint64_t id = next_xfer_.fetch_add(1);
+  std::lock_guard<std::mutex> lk(xfers_mtx_);
+  xfers_[id] = XferState::kPending;
+  return id;
+}
+
+void Endpoint::complete(uint64_t xfer_id, XferState st) {
+  {
+    std::lock_guard<std::mutex> lk(xfers_mtx_);
+    xfers_[xfer_id] = st;
+  }
+  xfers_cv_.notify_all();
+}
+
+void Endpoint::enqueue_task(Task* t) {
+  {
+    std::lock_guard<std::mutex> lk(task_mtx_);
+    while (!task_ring_.push(t)) std::this_thread::yield();
+  }
+  task_cv_.notify_one();
+}
+
+uint64_t Endpoint::write_async(uint64_t conn_id, const void* src, size_t len,
+                               const FifoItem& item) {
+  uint64_t xid = new_xfer();
+  if (len > item.size) {  // reject over-window writes before they hit the wire
+    complete(xid, XferState::kError);
+    return xid;
+  }
+  auto* t = new Task;
+  t->conn_id = conn_id;
+  t->op = Op::kWrite;
+  t->xfer_id = xid;
+  t->src = src;
+  t->len = len;
+  t->item = item;
+  enqueue_task(t);
+  return xid;
+}
+
+uint64_t Endpoint::read_async(uint64_t conn_id, void* dst, size_t len,
+                              const FifoItem& item) {
+  uint64_t xid = new_xfer();
+  if (len > item.size) {
+    complete(xid, XferState::kError);
+    return xid;
+  }
+  {
+    std::lock_guard<std::mutex> lk(xfers_mtx_);
+    pending_reads_[xid] = PendingRead{dst, len};
+  }
+  auto* t = new Task;
+  t->conn_id = conn_id;
+  t->op = Op::kRead;
+  t->xfer_id = xid;
+  t->len = len;
+  t->item = item;
+  enqueue_task(t);
+  return xid;
+}
+
+bool Endpoint::write(uint64_t conn_id, const void* src, size_t len,
+                     const FifoItem& item) {
+  return wait(write_async(conn_id, src, len, item), 30000);
+}
+
+bool Endpoint::read(uint64_t conn_id, void* dst, size_t len,
+                    const FifoItem& item) {
+  return wait(read_async(conn_id, dst, len, item), 30000);
+}
+
+bool Endpoint::send(uint64_t conn_id, const void* buf, size_t len) {
+  Conn* c = get_conn(conn_id);
+  if (!c) return false;
+  FrameHeader h{};
+  h.magic = kMagic;
+  h.op = static_cast<uint16_t>(Op::kSend);
+  h.len = len;
+  return send_frame(c, h, buf);
+}
+
+int64_t Endpoint::recv(uint64_t conn_id, void* buf, size_t cap,
+                       int timeout_ms) {
+  std::unique_lock<std::mutex> lk(recvq_mtx_);
+  bool ok = recvq_cv_.wait_for(
+      lk, std::chrono::milliseconds(timeout_ms),
+      [&] { return !recvq_[conn_id].empty() || stop_.load(); });
+  if (!ok || recvq_[conn_id].empty()) return -1;
+  auto& front = recvq_[conn_id].front();
+  if (front.size() > cap) {
+    // Leave the message queued; tell the caller the size it needs.
+    return -static_cast<int64_t>(front.size()) - 2;
+  }
+  auto msg = std::move(front);
+  recvq_[conn_id].pop_front();
+  lk.unlock();
+  std::memcpy(buf, msg.data(), msg.size());
+  return static_cast<int64_t>(msg.size());
+}
+
+XferState Endpoint::poll(uint64_t xfer_id) {
+  std::lock_guard<std::mutex> lk(xfers_mtx_);
+  auto it = xfers_.find(xfer_id);
+  return it == xfers_.end() ? XferState::kError : it->second;
+}
+
+bool Endpoint::wait(uint64_t xfer_id, int timeout_ms) {
+  std::unique_lock<std::mutex> lk(xfers_mtx_);
+  bool ok = xfers_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+    auto it = xfers_.find(xfer_id);
+    return it != xfers_.end() && it->second != XferState::kPending;
+  });
+  if (!ok) return false;
+  return xfers_[xfer_id] == XferState::kDone;
+}
+
+bool Endpoint::send_frame(Conn* c, const FrameHeader& h, const void* payload) {
+  // Fault injection: silently drop the frame (reference kTestLoss,
+  // transport_config.h:222) — the transfer then times out at the caller.
+  double p = drop_rate_.load();
+  if (p > 0.0) {
+    static thread_local std::mt19937_64 gen{std::random_device{}()};
+    std::uniform_real_distribution<double> d(0.0, 1.0);
+    if (d(gen) < p) return true;
+  }
+  std::lock_guard<std::mutex> lk(c->tx_mtx);
+  if (!send_all(c->fd, &h, sizeof(h))) return false;
+  if (h.len > 0 && payload != nullptr) {
+    if (!send_all(c->fd, payload, h.len)) return false;
+  }
+  bytes_tx_.fetch_add(sizeof(h) + h.len);
+  return true;
+}
+
+void Endpoint::tx_loop() {
+  while (!stop_.load()) {
+    Task* t = nullptr;
+    if (!task_ring_.pop(&t)) {
+      std::unique_lock<std::mutex> lk(task_cv_mtx_);
+      task_cv_.wait_for(lk, std::chrono::milliseconds(1));
+      continue;
+    }
+    Conn* c = get_conn(t->conn_id);
+    if (!c) {
+      complete(t->xfer_id, XferState::kError);
+      delete t;
+      continue;
+    }
+    FrameHeader h{};
+    h.magic = kMagic;
+    h.op = static_cast<uint16_t>(t->op);
+    h.xfer_id = t->xfer_id;
+    h.rid = t->item.rid;
+    h.token = t->item.token;
+    h.offset = t->item.offset;
+    h.flags = t->flags;
+    if (t->op == Op::kWrite) {
+      h.len = t->len;
+      if (!send_frame(c, h, t->src)) complete(t->xfer_id, XferState::kError);
+      // completion arrives as kWriteAck
+    } else if (t->op == Op::kRead) {
+      // kRead frames carry the *requested* length in len, no payload bytes.
+      h.len = t->len;
+      if (!send_frame(c, h, nullptr)) complete(t->xfer_id, XferState::kError);
+    } else if (t->op == Op::kReadResp) {
+      // Read responses are sent from here (not the io thread) so a blocked
+      // peer can never wedge the frame-dispatch loop: the io thread stays
+      // free to drain inbound bytes while this send backpressures.
+      h.rid = 0;
+      h.token = 0;
+      h.offset = 0;
+      h.len = t->owned.size();
+      send_frame(c, h, t->owned.data());
+    }
+    delete t;
+  }
+}
+
+void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
+                            std::vector<uint8_t>& payload) {
+  switch (static_cast<Op>(h.op)) {
+    case Op::kWrite: {
+      bool ok = false;
+      {
+        std::lock_guard<std::mutex> lk(regs_mtx_);
+        void* dst = resolve_window_locked(h.rid, h.token, h.offset, h.len);
+        if (dst != nullptr) {
+          std::memcpy(dst, payload.data(), h.len);
+          ok = true;
+        }
+      }
+      FrameHeader ack{};
+      ack.magic = kMagic;
+      ack.op = static_cast<uint16_t>(Op::kWriteAck);
+      ack.xfer_id = h.xfer_id;
+      ack.flags = ok ? 0 : 1;
+      send_frame(c, ack, nullptr);  // header-only: cannot wedge the io thread
+      break;
+    }
+    case Op::kWriteAck:
+      complete(h.xfer_id, h.flags == 0 ? XferState::kDone : XferState::kError);
+      break;
+    case Op::kRead: {
+      // Copy the window contents into a task-owned buffer and hand the
+      // (possibly large, blocking) send to the tx proxy thread.
+      auto* t = new Task;
+      t->conn_id = c->id;
+      t->op = Op::kReadResp;
+      t->xfer_id = h.xfer_id;
+      {
+        std::lock_guard<std::mutex> lk(regs_mtx_);
+        void* src = resolve_window_locked(h.rid, h.token, h.offset, h.len);
+        if (src != nullptr) {
+          t->owned.assign(static_cast<uint8_t*>(src),
+                          static_cast<uint8_t*>(src) + h.len);
+        } else {
+          t->flags = 1;
+        }
+      }
+      enqueue_task(t);
+      break;
+    }
+    case Op::kReadResp: {
+      PendingRead pr{};
+      {
+        std::lock_guard<std::mutex> lk(xfers_mtx_);
+        auto it = pending_reads_.find(h.xfer_id);
+        if (it != pending_reads_.end()) {
+          pr = it->second;
+          pending_reads_.erase(it);
+        }
+      }
+      if (h.flags == 0 && pr.dst != nullptr && h.len <= pr.len) {
+        std::memcpy(pr.dst, payload.data(), h.len);
+        complete(h.xfer_id, XferState::kDone);
+      } else {
+        complete(h.xfer_id, XferState::kError);
+      }
+      break;
+    }
+    case Op::kSend: {
+      {
+        std::lock_guard<std::mutex> lk(recvq_mtx_);
+        recvq_[c->id].push_back(std::move(payload));
+      }
+      recvq_cv_.notify_all();
+      break;
+    }
+    default:
+      break;
+  }
+}
+
+void Endpoint::io_loop() {
+  constexpr int kMaxEvents = 64;
+  epoll_event events[kMaxEvents];
+  while (!stop_.load()) {
+    int n = ::epoll_wait(epoll_fd_, events, kMaxEvents, 100);
+    for (int i = 0; i < n; ++i) {
+      uint64_t tag = events[i].data.u64;
+      if (tag == 0) {  // wake fd
+        uint64_t v;
+        ::read(wake_fd_, &v, sizeof(v));
+        continue;
+      }
+      if (tag == 1) {  // listener
+        int fd = ::accept(listen_fd_, nullptr, nullptr);
+        if (fd < 0) continue;
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        auto c = std::make_unique<Conn>();
+        c->fd = fd;
+        c->id = next_conn_.fetch_add(1);
+        uint64_t id = c->id;
+        {
+          std::lock_guard<std::mutex> lk(conns_mtx_);
+          conns_[id] = std::move(c);
+        }
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.u64 = (id << 2) | 2;
+        ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+        accept_queue_.push(id);
+        continue;
+      }
+      // connection frame
+      uint64_t conn_id = tag >> 2;
+      Conn* c = get_conn(conn_id);
+      if (!c) continue;
+      FrameHeader h{};
+      if (!recv_all(c->fd, &h, sizeof(h)) || h.magic != kMagic ||
+          h.len > kMaxFrameLen) {
+        remove_conn(conn_id);
+        continue;
+      }
+      std::vector<uint8_t> payload;
+      // kRead carries requested length in h.len but no payload bytes.
+      size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
+      if (body > 0) {
+        try {
+          payload.resize(body);
+        } catch (const std::exception&) {
+          remove_conn(conn_id);
+          continue;
+        }
+        if (!recv_all(c->fd, payload.data(), body)) {
+          remove_conn(conn_id);
+          continue;
+        }
+      }
+      bytes_rx_.fetch_add(sizeof(h) + body);
+      handle_frame(c, h, payload);
+    }
+  }
+}
+
+}  // namespace uccl_tpu

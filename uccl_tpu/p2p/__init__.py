@@ -2,5 +2,9 @@
 read-write over DCN, with a C++ host runtime underneath.
 
 The analog of the reference's ``p2p/engine.{h,cc}`` (SURVEY.md §2.2). The C++
-engine + ctypes bindings land here; see ``native/`` for the host runtime.
+engine lives in ``native/``; :class:`Endpoint` binds it via ctypes.
 """
+
+from uccl_tpu.p2p.endpoint import Endpoint, FIFO_ITEM_BYTES
+
+__all__ = ["Endpoint", "FIFO_ITEM_BYTES"]

@@ -1,0 +1,295 @@
+"""Python API of the P2P transfer engine (ctypes over the C++ runtime).
+
+Mirrors the reference's ``uccl.p2p`` surface (p2p/engine_api.cc nanobind module:
+Endpoint with connect/accept/reg/advertise/read/write/[_async]/poll_async) with
+jax/numpy-aware helpers. TPU HBM arrays move via host staging (``np.asarray`` /
+``jax.device_put``) — the TPU analog of the reference's GPU-bounce paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from uccl_tpu.utils.logging import get_logger
+
+_log = get_logger("P2P")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libuccl_tpu.so")
+
+FIFO_ITEM_BYTES = 64
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_if_needed() -> str:
+    srcs = [
+        os.path.join(_NATIVE_DIR, "src", "engine.cc"),
+        os.path.join(_NATIVE_DIR, "src", "c_api.cc"),
+        os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "engine.h"),
+        os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "ring.h"),
+    ]
+
+    def fresh() -> bool:
+        if not os.path.exists(_SO_PATH):
+            return False
+        so_mtime = os.path.getmtime(_SO_PATH)
+        return all(os.path.getmtime(s) <= so_mtime for s in srcs if os.path.exists(s))
+
+    if fresh():
+        return _SO_PATH
+    # Cross-process build lock: concurrent first-use (e.g. multiprocessing
+    # tests) must not race `make` writing the same objects.
+    import fcntl
+
+    os.makedirs(os.path.join(_NATIVE_DIR, "build"), exist_ok=True)
+    lock_path = os.path.join(_NATIVE_DIR, "build", ".build.lock")
+    with open(lock_path, "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        if not fresh():  # re-check under the lock
+            _log.info("building native runtime: make -C %s", _NATIVE_DIR)
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
+            )
+    return _SO_PATH
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(_build_if_needed())
+        c = ctypes.c_void_p
+        lib.ucclt_create.restype = c
+        lib.ucclt_create.argtypes = [ctypes.c_uint16]
+        lib.ucclt_destroy.argtypes = [c]
+        lib.ucclt_listen_port.restype = ctypes.c_uint16
+        lib.ucclt_listen_port.argtypes = [c]
+        lib.ucclt_connect.restype = ctypes.c_int64
+        lib.ucclt_connect.argtypes = [c, ctypes.c_char_p, ctypes.c_uint16]
+        lib.ucclt_accept.restype = ctypes.c_int64
+        lib.ucclt_accept.argtypes = [c, ctypes.c_int]
+        lib.ucclt_remove_conn.restype = ctypes.c_int
+        lib.ucclt_remove_conn.argtypes = [c, ctypes.c_uint64]
+        lib.ucclt_reg.restype = ctypes.c_uint64
+        lib.ucclt_reg.argtypes = [c, ctypes.c_void_p, ctypes.c_size_t]
+        lib.ucclt_dereg.restype = ctypes.c_int
+        lib.ucclt_dereg.argtypes = [c, ctypes.c_uint64]
+        lib.ucclt_advertise.restype = ctypes.c_int
+        lib.ucclt_advertise.argtypes = [
+            c, ctypes.c_uint64, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        for name in ("ucclt_write", "ucclt_read"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [c, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_size_t,
+                           ctypes.c_char_p]
+        for name in ("ucclt_write_async", "ucclt_read_async"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [c, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_size_t,
+                           ctypes.c_char_p]
+        lib.ucclt_poll.restype = ctypes.c_int
+        lib.ucclt_poll.argtypes = [c, ctypes.c_uint64]
+        lib.ucclt_wait.restype = ctypes.c_int
+        lib.ucclt_wait.argtypes = [c, ctypes.c_uint64, ctypes.c_int]
+        lib.ucclt_send.restype = ctypes.c_int
+        lib.ucclt_send.argtypes = [c, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_size_t]
+        lib.ucclt_recv.restype = ctypes.c_int64
+        lib.ucclt_recv.argtypes = [c, ctypes.c_uint64, ctypes.c_void_p,
+                                   ctypes.c_size_t, ctypes.c_int]
+        lib.ucclt_set_drop_rate.argtypes = [c, ctypes.c_double]
+        lib.ucclt_bytes_tx.restype = ctypes.c_uint64
+        lib.ucclt_bytes_tx.argtypes = [c]
+        lib.ucclt_bytes_rx.restype = ctypes.c_uint64
+        lib.ucclt_bytes_rx.argtypes = [c]
+        _lib = lib
+        return _lib
+
+
+def _as_buffer(arr: np.ndarray) -> Tuple[ctypes.c_void_p, int]:
+    if not arr.flags["C_CONTIGUOUS"]:
+        raise ValueError("array must be C-contiguous")
+    return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+
+
+class Endpoint:
+    """P2P transfer endpoint (reference: p2p Endpoint, engine.h:243)."""
+
+    def __init__(self, port: int = 0):
+        self._lib = _load()
+        self._h = self._lib.ucclt_create(port)
+        if not self._h:
+            raise RuntimeError(
+                f"failed to create endpoint (port {port} in use?)"
+            )
+        self._mrs = {}  # mr_id -> ndarray (keepalive)
+        self._inflight = {}  # xfer_id -> ndarray (keepalive until completion)
+
+    def _handle(self):
+        if not self._h:
+            raise ValueError("endpoint is closed")
+        return self._h
+
+    # -- lifecycle -------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._lib.ucclt_listen_port(self._handle())
+
+    def close(self):
+        if self._h:
+            self._lib.ucclt_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- connections -----------------------------------------------------
+    def connect(self, ip: str, port: int) -> int:
+        cid = self._lib.ucclt_connect(self._handle(), ip.encode(), port)
+        if cid < 0:
+            raise ConnectionError(f"connect to {ip}:{port} failed")
+        return cid
+
+    def accept(self, timeout_ms: int = 10000) -> int:
+        cid = self._lib.ucclt_accept(self._handle(), timeout_ms)
+        if cid < 0:
+            raise TimeoutError("accept timed out")
+        return cid
+
+    def remove_conn(self, conn_id: int) -> bool:
+        return self._lib.ucclt_remove_conn(self._handle(), conn_id) == 0
+
+    # -- memory ----------------------------------------------------------
+    def reg(self, arr: np.ndarray) -> int:
+        """Register a writable numpy buffer; the endpoint keeps it alive."""
+        ptr, nbytes = _as_buffer(arr)
+        mr = self._lib.ucclt_reg(self._handle(), ptr, nbytes)
+        self._mrs[mr] = arr
+        return mr
+
+    def dereg(self, mr: int) -> bool:
+        self._mrs.pop(mr, None)
+        return self._lib.ucclt_dereg(self._handle(), mr) == 0
+
+    def advertise(self, mr: int, offset: int = 0, length: Optional[int] = None) -> bytes:
+        """Serialize a 64-byte FifoItem for out-of-band exchange (reference:
+        advertise + serialize_fifo_item, engine.h:347)."""
+        if length is None:
+            length = self._mrs[mr].nbytes - offset
+        buf = ctypes.create_string_buffer(FIFO_ITEM_BYTES)
+        if self._lib.ucclt_advertise(self._handle(), mr, offset, length, buf) != 0:
+            raise ValueError("advertise failed (bad mr/range)")
+        return buf.raw
+
+    # -- one-sided -------------------------------------------------------
+    def write(self, conn_id: int, src: np.ndarray, fifo: bytes) -> None:
+        ptr, nbytes = _as_buffer(src)
+        if self._lib.ucclt_write(self._handle(), conn_id, ptr, nbytes, fifo) != 0:
+            raise IOError("write failed")
+
+    def read(self, conn_id: int, dst: np.ndarray, fifo: bytes) -> None:
+        ptr, nbytes = _as_buffer(dst)
+        if self._lib.ucclt_read(self._handle(), conn_id, ptr, nbytes, fifo) != 0:
+            raise IOError("read failed")
+
+    def write_async(self, conn_id: int, src: np.ndarray, fifo: bytes) -> int:
+        ptr, nbytes = _as_buffer(src)
+        xid = self._lib.ucclt_write_async(self._handle(), conn_id, ptr, nbytes, fifo)
+        # Keep the buffer alive until completion: the tx proxy thread reads
+        # from the raw pointer after this call returns.
+        self._inflight[xid] = src
+        return xid
+
+    def read_async(self, conn_id: int, dst: np.ndarray, fifo: bytes) -> int:
+        ptr, nbytes = _as_buffer(dst)
+        xid = self._lib.ucclt_read_async(self._handle(), conn_id, ptr, nbytes, fifo)
+        self._inflight[xid] = dst
+        return xid
+
+    def writev(self, conn_id: int, srcs, fifos) -> None:
+        """Vectorized write (reference: writev, engine.h:311)."""
+        xids = [self.write_async(conn_id, s, f) for s, f in zip(srcs, fifos)]
+        for x in xids:
+            if not self.wait(x):
+                raise IOError("writev element failed")
+
+    def poll_async(self, xfer_id: int) -> Optional[bool]:
+        """None = pending, True = done; raises on error (reference poll_async)."""
+        r = self._lib.ucclt_poll(self._handle(), xfer_id)
+        if r == 0:
+            return None
+        self._inflight.pop(xfer_id, None)  # completed either way
+        if r == 1:
+            return True
+        raise IOError(f"transfer {xfer_id} failed")
+
+    def wait(self, xfer_id: int, timeout_ms: int = 30000) -> bool:
+        ok = self._lib.ucclt_wait(self._handle(), xfer_id, timeout_ms) == 0
+        if ok or self._lib.ucclt_poll(self._handle(), xfer_id) < 0:
+            self._inflight.pop(xfer_id, None)
+        return ok
+
+    # -- two-sided -------------------------------------------------------
+    def send(self, conn_id: int, data: Union[bytes, np.ndarray]) -> None:
+        if isinstance(data, np.ndarray):
+            ptr, nbytes = _as_buffer(data)
+        else:
+            ptr, nbytes = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p), len(data)
+        if self._lib.ucclt_send(self._handle(), conn_id, ptr, nbytes) != 0:
+            raise IOError("send failed")
+
+    def recv(self, conn_id: int, max_bytes: int = 1 << 20, timeout_ms: int = 10000) -> bytes:
+        buf = ctypes.create_string_buffer(max_bytes)
+        n = self._lib.ucclt_recv(self._handle(), conn_id, buf, max_bytes, timeout_ms)
+        if n <= -2:
+            # message larger than the buffer: engine left it queued and told
+            # us the required size — retry with an exact-size buffer
+            needed = -(n + 2)
+            buf = ctypes.create_string_buffer(needed)
+            n = self._lib.ucclt_recv(self._handle(), conn_id, buf, needed, timeout_ms)
+        if n < 0:
+            raise TimeoutError("recv timed out")
+        return buf.raw[:n]
+
+    # -- observability / fault injection ---------------------------------
+    def set_drop_rate(self, p: float) -> None:
+        self._lib.ucclt_set_drop_rate(self._handle(), p)
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "bytes_tx": self._lib.ucclt_bytes_tx(self._handle()),
+            "bytes_rx": self._lib.ucclt_bytes_rx(self._handle()),
+        }
+
+    # -- jax staging helpers ---------------------------------------------
+    def send_jax(self, conn_id: int, x) -> None:
+        """Device→host stage then two-sided send (KV-cache push path)."""
+        self.send(conn_id, np.ascontiguousarray(np.asarray(x)))
+
+    def recv_jax(self, conn_id: int, shape, dtype, device=None, timeout_ms: int = 30000):
+        import jax
+
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        raw = self.recv(conn_id, max_bytes=nbytes, timeout_ms=timeout_ms)
+        arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
+        return jax.device_put(arr, device)

@@ -1,0 +1,70 @@
+"""AllReduce performance sweep — the nccl-tests ``all_reduce_perf`` analog.
+
+The reference's acceptance benchmark is nccl-tests' all_reduce_perf driven over
+the UCCL plugin (collective/rdma/run_nccl_test.sh, SURVEY.md §4.5); this sweeps
+message sizes over the mesh and prints alg/bus bandwidth per size for both the
+XLA-scheduled and the explicit chunk-ring allreduce.
+
+Bus bandwidth uses the standard ring factor 2*(n-1)/n over the data size.
+
+Usage: python benchmarks/all_reduce_perf.py [--devices N] [--algo xla|ring|both]
+On a machine without multiple accelerators, pass --devices N to use N virtual
+CPU devices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from _bootstrap import init_devices
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force N virtual CPU devices (0 = use real devices)")
+    ap.add_argument("--algo", default="both", choices=["xla", "ring", "both"])
+    ap.add_argument("--min-bytes", type=int, default=1 << 12)
+    ap.add_argument("--max-bytes", type=int, default=1 << 26)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    jax = init_devices(args.devices)
+
+    import numpy as np
+
+    from uccl_tpu.collective import Communicator
+    from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh(MeshConfig(dp=n))
+    comm = Communicator(mesh, "dp")
+    algos = ["xla", "ring"] if args.algo == "both" else [args.algo]
+
+    print(f"# all_reduce_perf  world={n}  devices={jax.devices()[0].platform}")
+    print(f"# {'bytes':>12} {'algo':>6} {'time_us':>10} {'algbw_GB/s':>10} {'busbw_GB/s':>10}")
+    size = args.min_bytes
+    while size <= args.max_bytes:
+        elems = size // 4
+        x = comm.device_put(
+            np.random.default_rng(0).standard_normal((n, elems)).astype(np.float32)
+        )
+        for algo in algos:
+            out = comm.all_reduce(x, algo=algo)  # compile + warmup
+            np.asarray(out)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = comm.all_reduce(x, algo=algo)
+            np.asarray(out)  # host read = hard sync (axon-safe)
+            dt = (time.perf_counter() - t0) / args.iters
+            algbw = size / dt / 1e9
+            busbw = algbw * 2 * (n - 1) / n
+            print(
+                f"  {size:>12} {algo:>6} {dt * 1e6:>10.1f} {algbw:>10.3f} {busbw:>10.3f}"
+            )
+        size *= 4
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""EP dispatch+combine benchmark — the test_low_latency.py analog.
+
+Prints per-member dispatch+combine latency and bandwidth for the DeepEP-shaped
+Buffer (reference metric definition: ep/bench/test_low_latency.py:438-464 —
+per-rank dispatch/combine GB/s and µs).
+
+Usage: python benchmarks/ep_bench.py [--devices N] [--tokens T] [--hidden H]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from _bootstrap import init_devices
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0)
+    ap.add_argument("--tokens", type=int, default=512)
+    ap.add_argument("--hidden", type=int, default=1024)
+    ap.add_argument("--experts", type=int, default=32)
+    ap.add_argument("--topk", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--fp8", action="store_true")
+    args = ap.parse_args()
+
+    jax = init_devices(args.devices)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from uccl_tpu.ep import Buffer
+    from uccl_tpu.parallel.mesh import AXIS, MeshConfig, make_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh(MeshConfig(dp=n))
+    experts = max(args.experts, n)
+    experts -= experts % n
+    buf = Buffer(mesh, AXIS.EP, num_experts=experts, num_selected=args.topk)
+
+    rng = np.random.default_rng(0)
+    x = buf.device_put(
+        rng.standard_normal((n, args.tokens, args.hidden)).astype(np.float32)
+    )
+    idx = buf.device_put(
+        rng.integers(0, experts, (n, args.tokens, args.topk)).astype(np.int32)
+    )
+    wts = buf.device_put(
+        np.full((n, args.tokens, args.topk), 1.0 / args.topk, np.float32)
+    )
+
+    def roundtrip():
+        recv, handle = (
+            buf.low_latency_dispatch(x, idx, wts)
+            if args.fp8
+            else buf.dispatch(x, idx, wts)
+        )
+        out = (
+            buf.low_latency_combine(recv, handle)
+            if args.fp8
+            else buf.combine(recv, handle)
+        )
+        return out
+
+    out = roundtrip()  # compile + warmup
+    np.asarray(out)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = roundtrip()
+    np.asarray(out)
+    dt = (time.perf_counter() - t0) / args.iters
+
+    per_member_bytes = args.tokens * args.hidden * 4 * args.topk  # moved payload
+    print(
+        f"EP{n} dispatch+combine: tokens={args.tokens} hidden={args.hidden} "
+        f"experts={experts} topk={args.topk} fp8={args.fp8}"
+    )
+    print(
+        f"  avg {dt * 1e6:.1f} us | {per_member_bytes / dt / 1e9:.3f} GB/s per member"
+    )
+
+
+if __name__ == "__main__":
+    main()

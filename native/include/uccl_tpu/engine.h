@@ -12,6 +12,8 @@
 
 #pragma once
 
+#include <unistd.h>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -102,6 +104,9 @@ class Endpoint {
   int64_t recv(uint64_t conn_id, void* buf, size_t cap, int timeout_ms);
 
   // --- completion (reference: poll_async, engine.h:394)
+  // Completions are one-shot: the first poll()/wait() observing a terminal
+  // state reclaims the entry (bounds memory on long-lived endpoints);
+  // subsequent queries for that id return kError.
   XferState poll(uint64_t xfer_id);
   bool wait(uint64_t xfer_id, int timeout_ms);
 
@@ -117,6 +122,9 @@ class Endpoint {
     int fd = -1;
     uint64_t id = 0;
     std::mutex tx_mtx;  // serializes frame writes on this fd
+    ~Conn() {
+      if (fd >= 0) ::close(fd);
+    }
   };
   struct Reg {
     void* ptr = nullptr;
@@ -150,7 +158,7 @@ class Endpoint {
   bool send_frame(Conn* c, const FrameHeader& h, const void* payload);
   void handle_frame(Conn* c, const FrameHeader& h,
                     std::vector<uint8_t>& payload);
-  Conn* get_conn(uint64_t id);
+  std::shared_ptr<Conn> get_conn(uint64_t id);
   uint64_t new_xfer();
   void complete(uint64_t xfer_id, XferState st);
   void* resolve_window_locked(uint64_t wid, uint64_t token, uint64_t offset,
@@ -164,9 +172,12 @@ class Endpoint {
   std::atomic<bool> stop_{false};
 
   std::mutex conns_mtx_;
-  std::unordered_map<uint64_t, std::unique_ptr<Conn>> conns_;
+  // shared_ptr: in-flight senders keep a Conn alive across remove_conn();
+  // the fd closes when the last holder drops (Conn::~Conn).
+  std::unordered_map<uint64_t, std::shared_ptr<Conn>> conns_;
   std::atomic<uint64_t> next_conn_{1};
   SpscRing<uint64_t> accept_queue_{256};
+  std::mutex accept_mtx_;  // accept() may be called from multiple threads
 
   std::mutex regs_mtx_;
   std::unordered_map<uint64_t, Reg> regs_;

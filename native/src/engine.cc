@@ -106,7 +106,7 @@ Endpoint::~Endpoint() {
   if (tx_thread_.joinable()) tx_thread_.join();
   {
     std::lock_guard<std::mutex> lk(conns_mtx_);
-    for (auto& [id, c] : conns_) ::close(c->fd);
+    conns_.clear();  // Conn destructors close the fds
   }
   if (listen_fd_ >= 0) ::close(listen_fd_);
   if (epoll_fd_ >= 0) ::close(epoll_fd_);
@@ -130,7 +130,7 @@ int64_t Endpoint::connect(const std::string& ip, uint16_t port) {
   }
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  auto c = std::make_unique<Conn>();
+  auto c = std::make_shared<Conn>();
   c->fd = fd;
   c->id = next_conn_.fetch_add(1);
   uint64_t id = c->id;
@@ -146,6 +146,7 @@ int64_t Endpoint::connect(const std::string& ip, uint16_t port) {
 }
 
 int64_t Endpoint::accept(int timeout_ms) {
+  std::lock_guard<std::mutex> alk(accept_mtx_);  // queue pop is single-consumer
   auto deadline =
       std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
   uint64_t id = 0;
@@ -157,12 +158,18 @@ int64_t Endpoint::accept(int timeout_ms) {
 }
 
 bool Endpoint::remove_conn(uint64_t conn_id) {
-  std::lock_guard<std::mutex> lk(conns_mtx_);
-  auto it = conns_.find(conn_id);
-  if (it == conns_.end()) return false;
-  ::epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second->fd, nullptr);
-  ::close(it->second->fd);
-  conns_.erase(it);
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(conns_mtx_);
+    auto it = conns_.find(conn_id);
+    if (it == conns_.end()) return false;
+    c = it->second;
+    conns_.erase(it);
+  }
+  ::epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, c->fd, nullptr);
+  // Unblock any thread mid-send/recv on this fd; the fd itself closes when
+  // the last shared_ptr holder drops (Conn::~Conn), never under a user.
+  ::shutdown(c->fd, SHUT_RDWR);
   return true;
 }
 
@@ -218,10 +225,10 @@ void* Endpoint::resolve_window_locked(uint64_t wid, uint64_t token,
   return static_cast<uint8_t*>(rit->second.ptr) + w.offset + offset;
 }
 
-Endpoint::Conn* Endpoint::get_conn(uint64_t id) {
+std::shared_ptr<Endpoint::Conn> Endpoint::get_conn(uint64_t id) {
   std::lock_guard<std::mutex> lk(conns_mtx_);
   auto it = conns_.find(id);
-  return it == conns_.end() ? nullptr : it->second.get();
+  return it == conns_.end() ? nullptr : it->second;
 }
 
 uint64_t Endpoint::new_xfer() {
@@ -235,6 +242,7 @@ void Endpoint::complete(uint64_t xfer_id, XferState st) {
   {
     std::lock_guard<std::mutex> lk(xfers_mtx_);
     xfers_[xfer_id] = st;
+    if (st == XferState::kError) pending_reads_.erase(xfer_id);
   }
   xfers_cv_.notify_all();
 }
@@ -297,13 +305,13 @@ bool Endpoint::read(uint64_t conn_id, void* dst, size_t len,
 }
 
 bool Endpoint::send(uint64_t conn_id, const void* buf, size_t len) {
-  Conn* c = get_conn(conn_id);
+  auto c = get_conn(conn_id);
   if (!c) return false;
   FrameHeader h{};
   h.magic = kMagic;
   h.op = static_cast<uint16_t>(Op::kSend);
   h.len = len;
-  return send_frame(c, h, buf);
+  return send_frame(c.get(), h, buf);
 }
 
 int64_t Endpoint::recv(uint64_t conn_id, void* buf, size_t cap,
@@ -328,17 +336,24 @@ int64_t Endpoint::recv(uint64_t conn_id, void* buf, size_t cap,
 XferState Endpoint::poll(uint64_t xfer_id) {
   std::lock_guard<std::mutex> lk(xfers_mtx_);
   auto it = xfers_.find(xfer_id);
-  return it == xfers_.end() ? XferState::kError : it->second;
+  if (it == xfers_.end()) return XferState::kError;
+  XferState st = it->second;
+  if (st != XferState::kPending) xfers_.erase(it);  // one-shot reclaim
+  return st;
 }
 
 bool Endpoint::wait(uint64_t xfer_id, int timeout_ms) {
   std::unique_lock<std::mutex> lk(xfers_mtx_);
   bool ok = xfers_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
     auto it = xfers_.find(xfer_id);
-    return it != xfers_.end() && it->second != XferState::kPending;
+    return it == xfers_.end() || it->second != XferState::kPending;
   });
   if (!ok) return false;
-  return xfers_[xfer_id] == XferState::kDone;
+  auto it = xfers_.find(xfer_id);
+  if (it == xfers_.end()) return false;  // already consumed elsewhere
+  XferState st = it->second;
+  xfers_.erase(it);  // one-shot reclaim
+  return st == XferState::kDone;
 }
 
 bool Endpoint::send_frame(Conn* c, const FrameHeader& h, const void* payload) {
@@ -367,7 +382,7 @@ void Endpoint::tx_loop() {
       task_cv_.wait_for(lk, std::chrono::milliseconds(1));
       continue;
     }
-    Conn* c = get_conn(t->conn_id);
+    auto c = get_conn(t->conn_id);
     if (!c) {
       complete(t->xfer_id, XferState::kError);
       delete t;
@@ -383,12 +398,14 @@ void Endpoint::tx_loop() {
     h.flags = t->flags;
     if (t->op == Op::kWrite) {
       h.len = t->len;
-      if (!send_frame(c, h, t->src)) complete(t->xfer_id, XferState::kError);
+      if (!send_frame(c.get(), h, t->src))
+        complete(t->xfer_id, XferState::kError);
       // completion arrives as kWriteAck
     } else if (t->op == Op::kRead) {
       // kRead frames carry the *requested* length in len, no payload bytes.
       h.len = t->len;
-      if (!send_frame(c, h, nullptr)) complete(t->xfer_id, XferState::kError);
+      if (!send_frame(c.get(), h, nullptr))
+        complete(t->xfer_id, XferState::kError);
     } else if (t->op == Op::kReadResp) {
       // Read responses are sent from here (not the io thread) so a blocked
       // peer can never wedge the frame-dispatch loop: the io thread stays
@@ -397,7 +414,13 @@ void Endpoint::tx_loop() {
       h.token = 0;
       h.offset = 0;
       h.len = t->owned.size();
-      send_frame(c, h, t->owned.data());
+      send_frame(c.get(), h, t->owned.data());
+    } else if (t->op == Op::kWriteAck) {
+      h.rid = 0;
+      h.token = 0;
+      h.offset = 0;
+      h.len = 0;
+      send_frame(c.get(), h, nullptr);
     }
     delete t;
   }
@@ -416,12 +439,14 @@ void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
           ok = true;
         }
       }
-      FrameHeader ack{};
-      ack.magic = kMagic;
-      ack.op = static_cast<uint16_t>(Op::kWriteAck);
-      ack.xfer_id = h.xfer_id;
-      ack.flags = ok ? 0 : 1;
-      send_frame(c, ack, nullptr);  // header-only: cannot wedge the io thread
+      // Ack rides the tx proxy: the io thread never touches a conn's tx
+      // mutex, so a backpressured bulk send can't stall frame dispatch.
+      auto* ack = new Task;
+      ack->conn_id = c->id;
+      ack->op = Op::kWriteAck;
+      ack->xfer_id = h.xfer_id;
+      ack->flags = ok ? 0 : 1;
+      enqueue_task(ack);
       break;
     }
     case Op::kWriteAck:
@@ -507,13 +532,18 @@ void Endpoint::io_loop() {
         ev.events = EPOLLIN;
         ev.data.u64 = (id << 2) | 2;
         ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
-        accept_queue_.push(id);
+        if (!accept_queue_.push(id)) {
+          // accept backlog overflow: reject the connection rather than leak
+          // an id the application can never accept()
+          remove_conn(id);
+        }
         continue;
       }
       // connection frame
       uint64_t conn_id = tag >> 2;
-      Conn* c = get_conn(conn_id);
-      if (!c) continue;
+      auto conn = get_conn(conn_id);
+      if (!conn) continue;
+      Conn* c = conn.get();
       FrameHeader h{};
       if (!recv_all(c->fd, &h, sizeof(h)) || h.magic != kMagic ||
           h.len > kMaxFrameLen) {

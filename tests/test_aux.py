@@ -1,0 +1,70 @@
+"""Aux subsystems: stats registry/thread and tracing scopes."""
+
+import time
+
+import pytest
+
+from uccl_tpu.utils import stats, tracing
+
+
+class TestStats:
+    def test_registry_snapshot(self):
+        reg = stats.StatsRegistry()
+        reg.register("engine", lambda: {"tx": 10.0, "rx": 5.0})
+        reg.register("broken", lambda: 1 / 0)
+        snap = reg.snapshot()
+        assert snap["engine"] == {"tx": 10.0, "rx": 5.0}
+        assert "error" in snap["broken"]
+        reg.unregister("engine")
+        assert "engine" not in reg.snapshot()
+
+    def test_thread_lifecycle(self):
+        reg = stats.StatsRegistry()
+        calls = []
+        reg.register("c", lambda: calls.append(1) or {"n": len(calls)})
+        stats._interval.set(0.05)
+        try:
+            t = stats.StatsThread(reg)
+            t.start()
+            t.start()  # idempotent
+            time.sleep(0.3)
+            t.stop()
+        finally:
+            stats._interval.reset()
+        assert len(calls) >= 2
+
+    def test_quiet(self):
+        reg = stats.StatsRegistry()
+        calls = []
+        reg.register("c", lambda: calls.append(1) or {})
+        stats._quiet.set(True)
+        stats._interval.set(0.05)
+        try:
+            t = stats.StatsThread(reg)
+            t.start()
+            time.sleep(0.2)
+            t.stop()
+        finally:
+            stats._quiet.reset()
+            stats._interval.reset()
+        assert calls == []
+
+
+class TestTracing:
+    def test_timed_scope(self):
+        tracing.reset_scopes()
+        for _ in range(5):
+            with tracing.timed_scope("unit_test_scope"):
+                time.sleep(0.001)
+        s = tracing.scope_stats("unit_test_scope")
+        assert s is not None and s["count"] == 5 and s["p50_us"] >= 500
+
+    def test_unknown_scope(self):
+        assert tracing.scope_stats("nope") is None
+
+    def test_annotate_runs(self):
+        import jax.numpy as jnp
+
+        with tracing.annotate("region"):
+            x = jnp.ones((4,)).sum()
+        assert float(x) == 4.0

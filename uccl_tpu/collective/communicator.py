@@ -96,14 +96,27 @@ class Communicator:
 
     # -- collectives -------------------------------------------------------
 
-    def all_reduce(self, x: jax.Array, op: str = ReduceOp.SUM) -> jax.Array:
-        """out[i] = reduce_j x[j] for every rank i."""
+    def all_reduce(
+        self, x: jax.Array, op: str = ReduceOp.SUM, algo: str = "xla"
+    ) -> jax.Array:
+        """out[i] = reduce_j x[j] for every rank i.
+
+        ``algo="xla"`` lowers to lax.psum (XLA's collective schedule);
+        ``algo="ring"`` runs the explicit bidirectional chunk-ring schedule
+        from :mod:`uccl_tpu.collective.plan` (sum only).
+        """
         self._check(x)
         ax = self._axis_name()
-        key = ("ar", op, x.shape, x.dtype)
+        key = ("ar", op, algo, x.shape, x.dtype)
 
         def build():
             def f(v):
+                if algo == "ring":
+                    if op != ReduceOp.SUM:
+                        raise ValueError("ring allreduce supports sum only")
+                    from uccl_tpu.collective.plan import ring_all_reduce
+
+                    return ring_all_reduce(v, ax)
                 if op == ReduceOp.SUM:
                     return lax.psum(v, ax)
                 if op == ReduceOp.MAX:

@@ -1,0 +1,199 @@
+"""Chunk-graph collective planner: plan → lower → execute.
+
+The TPU-native re-design of the reference's next-gen ukernel CCL stack
+(experimental/ukernel: ``build_coll_algo`` emits a Chunk DAG —
+src/ccl/algo/chunk_graph.h:12-31 — ``lower_algo``/``build_tiled`` tiles it,
+and an Executor sprays ops over backends per BFS layer, src/ccl/executor.h:26)
+and of UCCL-Tran's multipath packet spraying (chunks sprayed over 32 QP paths,
+collective/rdma/transport.cc:2186). On a TPU torus the "paths" are the two ICI
+directions of each ring axis, so spraying becomes: split the buffer into chunk
+streams and run counter-rotating rings concurrently, each step a
+``lax.ppermute`` hop overlapped with the local combine — XLA schedules the hop
+asynchronously, which is the overlap the reference gets from engine threads.
+
+Layers:
+* :class:`RingPlan` — the plan: phases of ring steps with slot index formulas
+  (pure data; inspectable, testable without a mesh).
+* :func:`lower` — turns a plan into a per-shard step function for ``lax.scan``.
+* :func:`execute` — runs a plan inside shard_map code.
+* Builders: :func:`plan_all_reduce` (reduce-scatter + all-gather ring,
+  optionally bidirectional), :func:`plan_all_gather`, :func:`plan_reduce_scatter`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Literal, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from uccl_tpu.utils.topology import ppermute_pairs
+
+Axis = Union[str, Tuple[str, ...]]
+
+
+@dataclasses.dataclass(frozen=True)
+class RingStep:
+    """One hop of a ring schedule, in rank-relative slot arithmetic.
+
+    Member ``r`` sends chunk slot ``(r + dir*send_off) % n`` to its
+    ``dir``-neighbor; the chunk received lands in slot
+    ``(r + dir*recv_off) % n``. ``combine`` says whether the received chunk
+    reduces into the local slot (reduce-scatter phase) or overwrites it
+    (all-gather phase). Builders bake the step index into the offsets, so a
+    plan is a flat list of constant-offset hops — the chunk DAG in its
+    SPMD-normal form.
+    """
+
+    dir: int  # +1 = forward ring, -1 = reverse ring
+    send_off: int
+    recv_off: int
+    combine: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class RingPlan:
+    """A full collective schedule over one ring of ``world`` members."""
+
+    world: int
+    n_slots: int  # chunks the buffer is split into
+    steps: Tuple[RingStep, ...]
+    name: str = "ring"
+
+    @property
+    def n_steps(self) -> int:
+        return len(self.steps)
+
+    def validate(self) -> None:
+        for st in self.steps:
+            if st.dir not in (-1, 1):
+                raise ValueError(f"bad direction {st.dir}")
+
+
+def plan_reduce_scatter(world: int, direction: int = 1) -> RingPlan:
+    """Ring reduce-scatter: n-1 steps. Step s: member r sends slot
+    (r - dir*(s+1)) and reduces the received chunk into slot (r - dir*(s+2));
+    chunk j accumulates along the ring and lands fully-reduced at member j."""
+    steps = tuple(
+        RingStep(direction, send_off=-(s + 1), recv_off=-(s + 2), combine=True)
+        for s in range(world - 1)
+    )
+    return RingPlan(world, world, steps, "reduce_scatter")
+
+
+def plan_all_gather(world: int, direction: int = 1) -> RingPlan:
+    """Ring all-gather: n-1 steps circulating owned slots; member r owns slot
+    r at entry (which is exactly where reduce-scatter leaves things)."""
+    steps = tuple(
+        RingStep(direction, send_off=-s, recv_off=-(s + 1), combine=False)
+        for s in range(world - 1)
+    )
+    return RingPlan(world, world, steps, "all_gather")
+
+
+def plan_all_reduce(world: int, direction: int = 1) -> RingPlan:
+    """Ring allreduce = reduce-scatter phase then all-gather phase."""
+    rs = plan_reduce_scatter(world, direction).steps
+    ag = plan_all_gather(world, direction).steps
+    return RingPlan(world, world, rs + ag, "all_reduce")
+
+
+def lower(plan: RingPlan, axis: Axis):
+    """Lower a plan to a per-shard step function.
+
+    Returns ``step_fn(buf, s) -> buf`` where ``buf`` is ``[n_slots, ...]`` and
+    ``s`` is the (python int) step index; unrolled so slot indices lower to
+    constants per member.
+    """
+    plan.validate()
+    n = plan.world
+
+    def step_fn(buf, s):
+        st = plan.steps[s]
+        r = lax.axis_index(axis)
+        send_slot = (r + st.dir * st.send_off) % n
+        recv_slot = (r + st.dir * st.recv_off) % n
+        chunk = lax.dynamic_index_in_dim(buf, send_slot, axis=0, keepdims=False)
+        got = lax.ppermute(chunk, axis, ppermute_pairs(n, st.dir))
+        cur = lax.dynamic_index_in_dim(buf, recv_slot, axis=0, keepdims=False)
+        new = cur + got if st.combine else got
+        return lax.dynamic_update_index_in_dim(buf, new, recv_slot, axis=0)
+
+    return step_fn
+
+
+def execute(plan: RingPlan, x: jax.Array, axis: Axis) -> jax.Array:
+    """Run a plan on per-shard data ``x`` (any shape; flattened into slots).
+
+    For ``all_reduce`` the result is the full reduction, reshaped like ``x``.
+    Pads to a multiple of n_slots internally.
+    """
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = plan.n_slots
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    buf = flat.reshape(n, -1)
+    step_fn = lower(plan, axis)
+    for s in range(plan.n_steps):  # unrolled: slot indices become constants
+        buf = step_fn(buf, s)
+    out = buf.reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
+def ring_all_reduce(
+    x: jax.Array, axis: Axis, *, bidirectional: bool = True
+) -> jax.Array:
+    """Bandwidth-optimal ring allreduce as an explicit chunk schedule.
+
+    With ``bidirectional=True`` the buffer is split in half and two
+    counter-rotating rings run concurrently — both ICI directions of the axis
+    carry traffic every step (the torus analog of UCCL's multipath spraying).
+    """
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    if not bidirectional:
+        return execute(plan_all_reduce(n), x, axis)
+    flat = x.reshape(-1)
+    half = flat.size // 2
+    fwd = execute(plan_all_reduce(n), flat[:half], axis)
+    rev_plan = RingPlan(
+        n,
+        n,
+        tuple(dataclasses.replace(s, dir=-s.dir) for s in plan_all_reduce(n).steps),
+        "all_reduce_rev",
+    )
+    bwd = execute(rev_plan, flat[half:], axis)
+    return jnp.concatenate([fwd, bwd]).reshape(x.shape)
+
+
+def ring_reduce_scatter(x: jax.Array, axis: Axis) -> jax.Array:
+    """x: [n*k, ...] per-shard → [k, ...]: member r keeps reduced slot r."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    out = execute(plan_reduce_scatter(n), x, axis)
+    r = lax.axis_index(axis)
+    per = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(out, r * per, per, axis=0)
+
+
+def ring_all_gather(x: jax.Array, axis: Axis) -> jax.Array:
+    """x: [k, ...] per-shard → [n*k, ...] every member holds all slots."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    r = lax.axis_index(axis)
+    k = x.shape[0]
+    buf = jnp.zeros((n, k) + x.shape[1:], x.dtype)
+    buf = lax.dynamic_update_index_in_dim(buf, x, r, axis=0)
+    step_fn = lower(plan_all_gather(n), axis)
+    for s in range(n - 1):
+        buf = step_fn(buf, s)
+    return buf.reshape((n * k,) + x.shape[1:])

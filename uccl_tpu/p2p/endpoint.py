@@ -134,6 +134,10 @@ class Endpoint:
             )
         self._mrs = {}  # mr_id -> ndarray (keepalive)
         self._inflight = {}  # xfer_id -> ndarray (keepalive until completion)
+        # C++ completions are one-shot (the engine reclaims the entry on first
+        # observation); this caches the terminal result so wait() followed by
+        # poll_async() stays friendly. Entries are tiny and consumed on read.
+        self._results = {}
 
     def _handle(self):
         if not self._h:
@@ -234,19 +238,31 @@ class Endpoint:
 
     def poll_async(self, xfer_id: int) -> Optional[bool]:
         """None = pending, True = done; raises on error (reference poll_async)."""
+        if xfer_id in self._results:
+            if self._results.pop(xfer_id):
+                return True
+            raise IOError(f"transfer {xfer_id} failed")
         r = self._lib.ucclt_poll(self._handle(), xfer_id)
         if r == 0:
             return None
         self._inflight.pop(xfer_id, None)  # completed either way
         if r == 1:
+            self._results[xfer_id] = True  # allow one follow-up observation
             return True
         raise IOError(f"transfer {xfer_id} failed")
 
     def wait(self, xfer_id: int, timeout_ms: int = 30000) -> bool:
+        if xfer_id in self._results:
+            return self._results.pop(xfer_id)
         ok = self._lib.ucclt_wait(self._handle(), xfer_id, timeout_ms) == 0
-        if ok or self._lib.ucclt_poll(self._handle(), xfer_id) < 0:
+        if ok:
             self._inflight.pop(xfer_id, None)
-        return ok
+            self._results[xfer_id] = True
+            return True
+        # distinguish timeout (entry still pending) from a consumed error
+        if self._lib.ucclt_poll(self._handle(), xfer_id) != 0:
+            self._inflight.pop(xfer_id, None)
+        return False
 
     # -- two-sided -------------------------------------------------------
     def send(self, conn_id: int, data: Union[bytes, np.ndarray]) -> None:

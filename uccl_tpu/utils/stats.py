@@ -1,0 +1,81 @@
+"""Periodic stats reporting.
+
+The analog of the reference's engine stats thread (collective/rdma
+transport.cc:1797 ``stats_thread_fn`` — 2 s interval, silenced by
+``UCCL_ENGINE_QUIET``): components register counter callbacks; a daemon thread
+logs a snapshot every interval. Silence with ``UCCL_TPU_STATS_QUIET=1``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from uccl_tpu.utils.config import param
+from uccl_tpu.utils.logging import get_logger
+
+_log = get_logger("UTIL")
+
+_quiet = param("stats_quiet", False, help="silence the periodic stats thread")
+_interval = param("stats_interval_s", 2.0, help="stats reporting interval")
+
+
+class StatsRegistry:
+    """Named counter sources; snapshot() pulls every registered callback."""
+
+    def __init__(self):
+        self._sources: Dict[str, Callable[[], Dict[str, float]]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, fn: Callable[[], Dict[str, float]]) -> None:
+        with self._lock:
+            self._sources[name] = fn
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            sources = dict(self._sources)
+        out = {}
+        for name, fn in sources.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # a broken source must not kill the thread
+                out[name] = {"error": repr(e)}
+        return out
+
+
+registry = StatsRegistry()
+
+
+class StatsThread:
+    """Daemon thread logging registry snapshots every interval."""
+
+    def __init__(self, reg: Optional[StatsRegistry] = None):
+        self._reg = reg if reg is not None else registry
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(float(_interval.get())):
+            if _quiet.get():
+                continue
+            snap = self._reg.snapshot()
+            if snap:
+                _log.info("stats: %s", snap)

@@ -1,0 +1,64 @@
+"""Profiling / tracing hooks.
+
+The TPU answer to the reference's observability stack (SURVEY.md §5: STATS
+engine counters, latency histograms, NPKit GPU event tracing, nsys wrappers):
+``jax.profiler`` XPlane traces plus lightweight named annotations that show up
+on the TPU timeline, and a wall-clock scope timer feeding LatencyHistograms.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+from uccl_tpu.utils.latency import LatencyHistogram
+from uccl_tpu.utils.logging import get_logger
+
+_log = get_logger("UTIL")
+
+_scope_hists: Dict[str, LatencyHistogram] = {}
+
+
+def start_trace(log_dir: str) -> None:
+    """Begin an XPlane profiler capture (view with xprof/tensorboard)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region on the device timeline (jax.profiler.TraceAnnotation)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def timed_scope(name: str, log: bool = False) -> Iterator[None]:
+    """Wall-clock scope timer; samples land in a per-name LatencyHistogram
+    (uccl_tpu.utils.latency) retrievable via :func:`scope_stats`."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        us = (time.perf_counter() - t0) * 1e6
+        hist = _scope_hists.get(name)
+        if hist is None:
+            hist = _scope_hists.setdefault(name, LatencyHistogram())
+        hist.record(us)
+        if log:
+            _log.info("%s: %.1f us", name, us)
+
+
+def scope_stats(name: str) -> Optional[Dict[str, float]]:
+    h = _scope_hists.get(name)
+    return h.summary() if h else None
+
+
+def reset_scopes() -> None:
+    _scope_hists.clear()

@@ -72,6 +72,21 @@ class TestForwardParity:
         )(gp, tokens))
         np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
+    def test_flash_attention_path(self, devices, rng):
+        """attn_impl='flash' (pallas kernel, interpret mode on CPU) must match
+        the XLA attention path when cp == 1."""
+        mesh = make_mesh(MeshConfig(pp=2, dp=2, cp=1, tp=2), devices)
+        params = init_params(jax.random.PRNGKey(0), _cfg())
+        tokens, _ = _data(rng, _cfg())
+        outs = {}
+        for impl in ("xla", "flash"):
+            cfg = _cfg(attn_impl=impl)
+            gp = shard_params(params, mesh, cfg)
+            outs[impl] = np.asarray(
+                jax.jit(lambda p, t, c=cfg: forward(p, t, c, mesh))(gp, tokens)
+            )
+        np.testing.assert_allclose(outs["flash"], outs["xla"], rtol=2e-3, atol=2e-3)
+
     def test_ulysses_mode(self, devices, rng):
         mesh = make_mesh(MeshConfig(pp=1, dp=2, cp=2, tp=2), devices)
         cfg = _cfg(seq_mode="ulysses")

@@ -1,0 +1,68 @@
+"""Pallas flash attention vs reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from uccl_tpu.ops.attention import attention_reference
+from uccl_tpu.ops.pallas_attention import flash_attention
+
+
+def _qkv(rng, b=2, s=128, h=4, hkv=None, d=64, dtype=np.float32):
+    hkv = hkv or h
+    return (
+        jnp.asarray(rng.standard_normal((b, s, h, d)), dtype),
+        jnp.asarray(rng.standard_normal((b, s, hkv, d)), dtype),
+        jnp.asarray(rng.standard_normal((b, s, hkv, d)), dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_matches_reference(rng, causal):
+    q, k, v = _qkv(rng)
+    got = np.asarray(flash_attention(q, k, v, causal, 64, 64))
+    want = np.asarray(attention_reference(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_gqa(rng):
+    q, k, v = _qkv(rng, h=8, hkv=2)
+    got = np.asarray(flash_attention(q, k, v, True, 64, 64))
+    want = np.asarray(attention_reference(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_block_shapes(rng):
+    q, k, v = _qkv(rng, s=128)
+    a = np.asarray(flash_attention(q, k, v, True, 128, 32))
+    b = np.asarray(flash_attention(q, k, v, True, 32, 128))
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_bad_block_divisibility(rng):
+    q, k, v = _qkv(rng, s=100)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, True, 64, 64)
+
+
+def test_grad_matches_reference(rng):
+    q, k, v = _qkv(rng, b=1, s=64, h=2, d=32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, 32, 32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
+
+
+def test_bf16(rng):
+    q, k, v = _qkv(rng, dtype=jnp.bfloat16)
+    got = np.asarray(flash_attention(q, k, v, True, 64, 64)).astype(np.float32)
+    want = np.asarray(attention_reference(q, k, v, causal=True)).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)

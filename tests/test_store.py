@@ -1,0 +1,89 @@
+"""OOB rendezvous store + session exchange (single-host multiprocess, the
+shape of the reference's bootstrap handshakes)."""
+
+import multiprocessing as mp
+import threading
+import time
+
+import pytest
+
+from uccl_tpu.p2p.store import StoreClient, StoreServer
+from uccl_tpu.parallel.distributed import Session, exchange, exchange_json
+
+
+@pytest.fixture
+def store():
+    server = StoreServer()
+    client = StoreClient("127.0.0.1", server.port)
+    yield server, client
+    client.close()
+    server.close()
+
+
+class TestStore:
+    def test_set_get(self, store):
+        _, client = store
+        client.set("k1", b"v1")
+        assert client.get("k1") == b"v1"
+        assert client.get("missing") is None
+
+    def test_wait_blocks_until_set(self, store):
+        server, client = store
+        other = StoreClient("127.0.0.1", server.port)
+
+        def setter():
+            time.sleep(0.2)
+            other.set("late", b"here")
+
+        t = threading.Thread(target=setter)
+        t.start()
+        assert client.wait("late", timeout_s=5) == b"here"
+        t.join()
+        other.close()
+
+    def test_wait_timeout(self, store):
+        _, client = store
+        with pytest.raises(TimeoutError):
+            client.wait("never", timeout_s=0.3)
+
+    def test_many_clients(self, store):
+        server, _ = store
+        clients = [StoreClient("127.0.0.1", server.port) for _ in range(4)]
+        for i, c in enumerate(clients):
+            c.set(f"rank/{i}", str(i).encode())
+        for c in clients:
+            for i in range(4):
+                assert c.get(f"rank/{i}") == str(i).encode()
+        [c.close() for c in clients]
+
+    def test_binary_values(self, store):
+        _, client = store
+        blob = bytes(range(256)) * 100
+        client.set("bin", blob)
+        assert client.get("bin") == blob
+
+
+class TestExchange:
+    def test_exchange_two_ranks(self, store):
+        server, c0 = store
+        c1 = StoreClient("127.0.0.1", server.port)
+        s0 = Session(rank=0, world=2, store=c0)
+        s1 = Session(rank=1, world=2, store=c1)
+        results = {}
+
+        def run(sess, payload):
+            results[sess.rank] = exchange(sess, "meta", payload, timeout_s=5)
+
+        t0 = threading.Thread(target=run, args=(s0, b"zero"))
+        t1 = threading.Thread(target=run, args=(s1, b"one"))
+        t0.start(), t1.start()
+        t0.join(), t1.join()
+        assert results[0] == [b"zero", b"one"]
+        assert results[1] == [b"zero", b"one"]
+        c1.close()
+
+    def test_exchange_json(self, store):
+        server, c0 = store
+        s0 = Session(rank=0, world=1, store=c0)
+        out = exchange_json(s0, "cfg", {"port": 1234})
+        assert out == [{"port": 1234}]

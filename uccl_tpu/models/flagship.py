@@ -57,6 +57,7 @@ class FlagshipConfig:
     z_loss_weight: float = 1e-3
     n_microbatches: int = 1
     seq_mode: str = "ring"  # "ring" | "ulysses"
+    attn_impl: str = "auto"  # "auto" | "flash" | "xla": kernel when cp == 1
     wire_fp8: bool = False
     dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
 
@@ -129,6 +130,14 @@ def shard_params(params, mesh: Mesh, cfg: FlagshipConfig):
 # Per-shard forward (inside shard_map)
 
 
+def _pick_block(s: int) -> int:
+    """Largest power-of-two block <= 128 dividing s (1 if s is odd)."""
+    b = 128
+    while b > 1 and s % b:
+        b //= 2
+    return b
+
+
 def _attention(x, lp, cfg: FlagshipConfig):
     """x: [B, S_loc, H_model] -> [B, S_loc, H_model] (pre-psum over tp)."""
     b, s_loc, _ = x.shape
@@ -142,10 +151,28 @@ def _attention(x, lp, cfg: FlagshipConfig):
     positions = cp_idx * s_loc + jnp.arange(s_loc)
     q = rope(q, positions, cfg.rope_theta)
     kk = rope(kk, positions, cfg.rope_theta)
-    if cfg.seq_mode == "ulysses":
-        attn = ulysses_attention(q, kk, v, AXIS.CP, causal=True)
-    else:
-        attn = ring_attention(q, kk, v, AXIS.CP, causal=True)
+    attn = None
+    if lax.axis_size(AXIS.CP) == 1:
+        # No context parallelism: the single-shard Pallas flash kernel is the
+        # fast path on TPU (MXU blockwise online softmax in VMEM).
+        from uccl_tpu.ops.pallas_attention import _is_tpu, flash_attention
+
+        use_flash = cfg.attn_impl == "flash" or (
+            cfg.attn_impl == "auto" and _is_tpu()
+        )
+        blk = _pick_block(s_loc)
+        if use_flash and blk >= 8:
+            attn = flash_attention(q, kk, v, True, blk, blk)
+        elif cfg.attn_impl == "flash":
+            raise ValueError(
+                f"attn_impl='flash' requested but local seq {s_loc} has no "
+                f"usable block size (largest power-of-two divisor {blk} < 8)"
+            )
+    if attn is None:
+        if cfg.seq_mode == "ulysses":
+            attn = ulysses_attention(q, kk, v, AXIS.CP, causal=True)
+        else:
+            attn = ring_attention(q, kk, v, AXIS.CP, causal=True)
     out = attn.reshape(b, s_loc, nh_loc * d) @ lp["wo"].astype(x.dtype)
     return out
 

@@ -1,0 +1,138 @@
+"""KV-cache inference for the dense model: prefill / decode / generate.
+
+This is the serving-side path the reference's P2P pillar exists to feed
+(KV-cache transfer between prefill and decode workers — README.md:18,
+ep/bench/vllm/disagg_proxy.py): the cache produced by :func:`prefill` is a
+plain pytree of arrays, registered and moved by ``uccl_tpu.p2p`` (see
+examples/disagg_kv.py), then consumed by :func:`decode_step` on another worker.
+
+Single-device (per-replica) implementation with static-shape caches so every
+decode step hits the same compiled executable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from uccl_tpu.models.dense import DenseConfig
+from uccl_tpu.models.layers import rms_norm, rope
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, S_max, Hkv, D]
+    v: jax.Array  # [L, B, S_max, Hkv, D]
+    length: jax.Array  # [] int32 — valid prefix length
+
+    @staticmethod
+    def empty(cfg: DenseConfig, batch: int, max_seq: int, dtype=jnp.float32):
+        shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        return KVCache(
+            jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), jnp.zeros((), jnp.int32)
+        )
+
+
+def _attend_cached(q, k_cache, v_cache, length, cfg: DenseConfig):
+    """q: [B, Sq, H, D] at positions [length, length+Sq); cache: [B, Smax, Hkv, D].
+    Masked attention over the cache prefix + the new causal block."""
+    b, sq, h, d = q.shape
+    smax = k_cache.shape[1]
+    n_rep = h // cfg.n_kv_heads
+    kk = jnp.repeat(k_cache, n_rep, axis=2)
+    vv = jnp.repeat(v_cache, n_rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kk, preferred_element_type=jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(d))
+    qpos = length + jnp.arange(sq)[:, None]  # [Sq, 1]
+    kpos = jnp.arange(smax)[None, :]  # [1, Smax]
+    mask = kpos <= qpos  # attend to everything at or before own position
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(vv.dtype), vv)
+
+
+def _forward_cached(
+    params, tokens, cache: KVCache, cfg: DenseConfig
+) -> Tuple[jax.Array, KVCache]:
+    """Run tokens [B, S] starting at cache.length; returns (logits, cache')."""
+    b, s = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cache.k.dtype)
+    positions = cache.length + jnp.arange(s)
+    new_k, new_v = [], []
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["blocks"])
+        h = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        d = cfg.head_dim
+        q = (h @ lp["wq"].astype(h.dtype)).reshape(b, s, cfg.n_heads, d)
+        kk = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
+        v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
+        q = rope(q, positions, cfg.rope_theta)
+        kk = rope(kk, positions, cfg.rope_theta)
+        k_cache = lax.dynamic_update_slice(
+            cache.k[i], kk, (0, cache.length, 0, 0)
+        )
+        v_cache = lax.dynamic_update_slice(
+            cache.v[i], v, (0, cache.length, 0, 0)
+        )
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+        attn = _attend_cached(q, k_cache, v_cache, cache.length, cfg)
+        x = x + attn.reshape(b, s, -1) @ lp["wo"].astype(attn.dtype)
+        h2 = rms_norm(x, lp["ln2"], cfg.norm_eps)
+        act = jax.nn.silu(h2 @ lp["w_gate"].astype(h2.dtype)) * (
+            h2 @ lp["w_up"].astype(h2.dtype)
+        )
+        x = x + act @ lp["w_down"].astype(act.dtype)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["head"]
+    cache = KVCache(
+        jnp.stack(new_k), jnp.stack(new_v), cache.length + s
+    )
+    return logits, cache
+
+
+def prefill(params, tokens, cfg: DenseConfig, max_seq: int) -> Tuple[jax.Array, KVCache]:
+    """Process the prompt; returns (last-position logits [B, V], warm cache)."""
+    if tokens.shape[1] > max_seq:
+        raise ValueError(
+            f"prompt length {tokens.shape[1]} exceeds max_seq {max_seq}"
+        )
+    cache = KVCache.empty(cfg, tokens.shape[0], max_seq, params["embed"].dtype)
+    logits, cache = _forward_cached(params, tokens, cache, cfg)
+    return logits[:, -1], cache
+
+
+def decode_step(params, token, cache: KVCache, cfg: DenseConfig):
+    """token: [B] — one autoregressive step. Returns (logits [B, V], cache')."""
+    logits, cache = _forward_cached(params, token[:, None], cache, cfg)
+    return logits[:, 0], cache
+
+
+def generate(
+    params,
+    prompt: jax.Array,
+    cfg: DenseConfig,
+    *,
+    max_new_tokens: int = 32,
+    max_seq: int = 256,
+) -> jax.Array:
+    """Greedy generation. prompt: [B, S] → [B, max_new_tokens]."""
+    if prompt.shape[1] + max_new_tokens > max_seq:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + new {max_new_tokens} tokens exceed "
+            f"max_seq {max_seq}: the cache would overflow"
+        )
+    logits, cache = jax.jit(
+        lambda p, t: prefill(p, t, cfg, max_seq)
+    )(params, prompt)
+
+    def body(carry, _):
+        logits, cache = carry
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits, cache = decode_step(params, tok, cache, cfg)
+        return (logits, cache), tok
+
+    (_, _), toks = lax.scan(body, (logits, cache), None, length=max_new_tokens)
+    return toks.T  # [B, T]

@@ -1,0 +1,83 @@
+"""Multi-host session bootstrap.
+
+The reference's connection-setup layer (SURVEY.md §3.2: TCP bootstrap
+handshake, rank↔address registry, `jax.distributed`-compatible init per §7
+step 2). One call per process:
+
+* :func:`initialize` — wraps ``jax.distributed.initialize`` (multi-host JAX:
+  all hosts' chips form one global mesh; collectives ride ICI/DCN as laid out
+  by the mesh) and stands up the OOB rendezvous (rank 0 serves a
+  :class:`~uccl_tpu.p2p.store.StoreServer`, everyone gets a client).
+* :func:`exchange` — all-gather style metadata exchange through the store
+  (the analog of the reference's PeerMeta allgather, ep/src/proxy.cpp:210).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+import jax
+
+from uccl_tpu.p2p.store import StoreClient, StoreServer
+from uccl_tpu.utils.logging import get_logger
+
+_log = get_logger("PARALLEL")
+
+
+@dataclasses.dataclass
+class Session:
+    rank: int
+    world: int
+    store: StoreClient
+    _server: Optional[StoreServer] = None
+
+    def close(self):
+        self.store.close()
+        if self._server is not None:
+            self._server.close()
+
+
+def initialize(
+    coordinator: str,
+    rank: int,
+    world: int,
+    *,
+    store_port: int = 0,
+    init_jax: bool = True,
+) -> Session:
+    """Bring up the distributed session.
+
+    coordinator: ``ip:port`` of rank 0 (the jax coordinator); the OOB store
+    binds on rank 0 at ``store_port`` (or coordinator port + 1 when 0).
+    """
+    ip, port_s = coordinator.rsplit(":", 1)
+    if init_jax and world > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator, num_processes=world, process_id=rank
+        )
+    server = None
+    sport = store_port or int(port_s) + 1
+    if rank == 0:
+        server = StoreServer(sport)
+        sport = server.port
+    client = StoreClient(ip if rank != 0 else "127.0.0.1", sport)
+    sess = Session(rank=rank, world=world, store=client, _server=server)
+    _log.info("session up: rank %d/%d store %s:%d", rank, world, ip, sport)
+    return sess
+
+
+def exchange(sess: Session, key: str, payload: bytes, timeout_s: float = 60.0) -> List[bytes]:
+    """Every rank contributes ``payload`` under ``key``; returns all ranks'
+    payloads in rank order (the PeerMeta allgather)."""
+    sess.store.set(f"{key}/{sess.rank}", payload)
+    return [
+        sess.store.wait(f"{key}/{r}", timeout_s=timeout_s)
+        for r in range(sess.world)
+    ]
+
+
+def exchange_json(sess: Session, key: str, obj, timeout_s: float = 60.0) -> list:
+    blobs = exchange(sess, key, json.dumps(obj).encode(), timeout_s)
+    return [json.loads(b.decode()) for b in blobs]

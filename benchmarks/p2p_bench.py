@@ -18,23 +18,43 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from uccl_tpu.p2p import Endpoint  # noqa: E402
 
 
-def run(sizes=(4 << 10, 64 << 10, 1 << 20, 16 << 20, 64 << 20), iters=20):
+def run(sizes=(4 << 10, 64 << 10, 1 << 20, 16 << 20, 64 << 20), iters=20,
+        paths=(1, 4)):
+    import threading
+
+    from uccl_tpu.p2p import Channel
+
     results = []
-    with Endpoint() as server, Endpoint() as client:
-        conn = client.connect("127.0.0.1", server.port)
-        server.accept()
-        for size in sizes:
-            dst = np.zeros(size, np.uint8)
-            fifo = server.advertise(server.reg(dst))
-            src = np.random.default_rng(0).integers(0, 255, size).astype(np.uint8)
-            client.write(conn, src, fifo)  # warmup
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                client.write(conn, src, fifo)
-            dt = (time.perf_counter() - t0) / iters
-            gbps = size / dt / 1e9
-            results.append({"size": size, "GB/s": round(gbps, 3), "lat_us": round(dt * 1e6, 1)})
-            print(json.dumps(results[-1]))
+    for n_paths in paths:
+        with Endpoint(n_engines=max(2, n_paths)) as server, Endpoint(
+            n_engines=max(2, n_paths)
+        ) as client:
+            acc = {}
+            t = threading.Thread(
+                target=lambda: acc.setdefault("c", Channel.accept(server))
+            )
+            t.start()
+            chan = Channel.connect(client, "127.0.0.1", server.port, n_paths=n_paths)
+            t.join()
+            for size in sizes:
+                dst = np.zeros(size, np.uint8)
+                fifo = server.advertise(server.reg(dst))
+                src = np.random.default_rng(0).integers(0, 255, size).astype(np.uint8)
+                chan.write(src, fifo)  # warmup
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    chan.write(src, fifo)
+                dt = (time.perf_counter() - t0) / iters
+                gbps = size / dt / 1e9
+                results.append(
+                    {
+                        "size": size,
+                        "paths": n_paths,
+                        "GB/s": round(gbps, 3),
+                        "lat_us": round(dt * 1e6, 1),
+                    }
+                )
+                print(json.dumps(results[-1]))
     return results
 
 

@@ -68,8 +68,11 @@ enum class XferState : int { kPending = 0, kDone = 1, kError = -1 };
 
 class Endpoint {
  public:
-  // port==0 picks an ephemeral port (see listen_port()).
-  explicit Endpoint(uint16_t port);
+  // port==0 picks an ephemeral port (see listen_port()). n_engines is the
+  // number of io+tx thread pairs; connections are distributed across engines
+  // round-robin (the analog of the reference's UCCL_NUM_ENGINES,
+  // collective/rdma/transport_config.h:38 — per-NIC engine threads).
+  explicit Endpoint(uint16_t port, int n_engines = 2);
   ~Endpoint();
 
   // false if the listen socket could not be bound (port in use).
@@ -121,6 +124,7 @@ class Endpoint {
   struct Conn {
     int fd = -1;
     uint64_t id = 0;
+    int engine = 0;     // which engine serves this conn
     std::mutex tx_mtx;  // serializes frame writes on this fd
     ~Conn() {
       if (fd >= 0) ::close(fd);
@@ -129,6 +133,11 @@ class Endpoint {
   struct Reg {
     void* ptr = nullptr;
     size_t len = 0;
+    // In-flight zero-copy receives targeting this registration. dereg()
+    // blocks until it drains so the application can safely free the buffer
+    // once dereg returns (the io thread streams payloads into ptr without
+    // holding regs_mtx_).
+    std::shared_ptr<std::atomic<int>> pins = std::make_shared<std::atomic<int>>(0);
   };
   // An advertised byte range with its own id/token (see FifoItem).
   struct Window {
@@ -152,24 +161,39 @@ class Endpoint {
     uint16_t flags = 0;
   };
 
-  void io_loop();     // epoll: accept + frame dispatch (the rx engine thread,
-                      // analog of p2p recv proxy engine.cc:2286)
-  void tx_loop();     // drains the task ring (analog of send proxy :2248)
+  // One engine = one epoll/io thread + one tx thread + its task ring. The
+  // per-engine split is what lets multiple DCN "paths" (connections) move
+  // bytes concurrently — the TPU-framework analog of UCCL's per-NIC engine
+  // threads and multipath spraying.
+  struct EngineCtx {
+    int epoll_fd = -1;
+    int wake_fd = -1;
+    SpscRing<Task*> ring{4096};
+    std::mutex push_mtx;
+    std::condition_variable cv;
+    std::mutex cv_mtx;
+    std::thread io_thread;
+    std::thread tx_thread;
+  };
+
+  void io_loop(int engine);  // epoll frame dispatch (recv proxy analog)
+  void tx_loop(int engine);  // drains that engine's ring (send proxy analog)
   bool send_frame(Conn* c, const FrameHeader& h, const void* payload);
   void handle_frame(Conn* c, const FrameHeader& h,
                     std::vector<uint8_t>& payload);
   std::shared_ptr<Conn> get_conn(uint64_t id);
+  void register_conn(const std::shared_ptr<Conn>& c);
   uint64_t new_xfer();
   void complete(uint64_t xfer_id, XferState st);
-  void* resolve_window_locked(uint64_t wid, uint64_t token, uint64_t offset,
-                              uint64_t len);
+  void* resolve_window_locked(
+      uint64_t wid, uint64_t token, uint64_t offset, uint64_t len,
+      std::shared_ptr<std::atomic<int>>* pin_out = nullptr);
   void enqueue_task(Task* t);
 
   int listen_fd_ = -1;
-  int epoll_fd_ = -1;
-  int wake_fd_ = -1;  // eventfd to wake the io thread on shutdown/new conn
   uint16_t listen_port_ = 0;
   std::atomic<bool> stop_{false};
+  std::vector<std::unique_ptr<EngineCtx>> engines_;
 
   std::mutex conns_mtx_;
   // shared_ptr: in-flight senders keep a Conn alive across remove_conn();
@@ -195,14 +219,6 @@ class Endpoint {
   std::mutex recvq_mtx_;
   std::condition_variable recvq_cv_;
   std::map<uint64_t, std::deque<std::vector<uint8_t>>> recvq_;
-
-  SpscRing<Task*> task_ring_{4096};
-  std::mutex task_mtx_;  // write_async callers may be concurrent -> serialize push
-  std::condition_variable task_cv_;
-  std::mutex task_cv_mtx_;
-
-  std::thread io_thread_;
-  std::thread tx_thread_;
 
   std::atomic<uint64_t> bytes_tx_{0};
   std::atomic<uint64_t> bytes_rx_{0};

@@ -15,8 +15,8 @@ using uccl_tpu::XferState;
 
 extern "C" {
 
-void* ucclt_create(uint16_t port) {
-  auto* ep = new Endpoint(port);
+void* ucclt_create(uint16_t port, int n_engines) {
+  auto* ep = new Endpoint(port, n_engines);
   if (!ep->ok()) {  // e.g. port already in use
     delete ep;
     return nullptr;

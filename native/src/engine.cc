@@ -64,7 +64,7 @@ uint64_t random_token() {
 }
 }  // namespace
 
-Endpoint::Endpoint(uint16_t port) {
+Endpoint::Endpoint(uint16_t port, int n_engines) {
   listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -82,37 +82,49 @@ Endpoint::Endpoint(uint16_t port) {
     listen_port_ = ntohs(addr.sin_port);
   }
 
-  epoll_fd_ = ::epoll_create1(0);
-  wake_fd_ = ::eventfd(0, EFD_NONBLOCK);
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.u64 = 0;  // 0 => wake fd
-  ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
-  if (listen_fd_ >= 0) {
-    ev.data.u64 = 1;  // 1 => listener
-    ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+  if (n_engines < 1) n_engines = 1;
+  for (int e = 0; e < n_engines; ++e) {
+    auto ctx = std::make_unique<EngineCtx>();
+    ctx->epoll_fd = ::epoll_create1(0);
+    ctx->wake_fd = ::eventfd(0, EFD_NONBLOCK);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = 0;  // 0 => wake fd
+    ::epoll_ctl(ctx->epoll_fd, EPOLL_CTL_ADD, ctx->wake_fd, &ev);
+    if (e == 0 && listen_fd_ >= 0) {
+      ev.data.u64 = 1;  // 1 => listener (engine 0 owns accepts)
+      ::epoll_ctl(ctx->epoll_fd, EPOLL_CTL_ADD, listen_fd_, &ev);
+    }
+    engines_.push_back(std::move(ctx));
   }
-
-  io_thread_ = std::thread([this] { io_loop(); });
-  tx_thread_ = std::thread([this] { tx_loop(); });
+  for (int e = 0; e < n_engines; ++e) {
+    engines_[e]->io_thread = std::thread([this, e] { io_loop(e); });
+    engines_[e]->tx_thread = std::thread([this, e] { tx_loop(e); });
+  }
 }
 
 Endpoint::~Endpoint() {
   stop_.store(true);
   uint64_t one = 1;
-  ::write(wake_fd_, &one, sizeof(one));
-  task_cv_.notify_all();
-  if (io_thread_.joinable()) io_thread_.join();
-  if (tx_thread_.joinable()) tx_thread_.join();
+  for (auto& eng : engines_) {
+    ::write(eng->wake_fd, &one, sizeof(one));
+    eng->cv.notify_all();
+  }
+  for (auto& eng : engines_) {
+    if (eng->io_thread.joinable()) eng->io_thread.join();
+    if (eng->tx_thread.joinable()) eng->tx_thread.join();
+  }
   {
     std::lock_guard<std::mutex> lk(conns_mtx_);
     conns_.clear();  // Conn destructors close the fds
   }
   if (listen_fd_ >= 0) ::close(listen_fd_);
-  if (epoll_fd_ >= 0) ::close(epoll_fd_);
-  if (wake_fd_ >= 0) ::close(wake_fd_);
-  Task* t = nullptr;
-  while (task_ring_.pop(&t)) delete t;
+  for (auto& eng : engines_) {
+    if (eng->epoll_fd >= 0) ::close(eng->epoll_fd);
+    if (eng->wake_fd >= 0) ::close(eng->wake_fd);
+    Task* t = nullptr;
+    while (eng->ring.pop(&t)) delete t;
+  }
 }
 
 int64_t Endpoint::connect(const std::string& ip, uint16_t port) {
@@ -134,15 +146,20 @@ int64_t Endpoint::connect(const std::string& ip, uint16_t port) {
   c->fd = fd;
   c->id = next_conn_.fetch_add(1);
   uint64_t id = c->id;
+  register_conn(c);
+  return static_cast<int64_t>(id);
+}
+
+void Endpoint::register_conn(const std::shared_ptr<Conn>& c) {
+  c->engine = static_cast<int>(c->id % engines_.size());
   {
     std::lock_guard<std::mutex> lk(conns_mtx_);
-    conns_[id] = std::move(c);
+    conns_[c->id] = c;
   }
   epoll_event ev{};
   ev.events = EPOLLIN;
-  ev.data.u64 = (id << 2) | 2;  // tag 2 => conn
-  ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
-  return static_cast<int64_t>(id);
+  ev.data.u64 = (c->id << 2) | 2;  // tag 2 => conn
+  ::epoll_ctl(engines_[c->engine]->epoll_fd, EPOLL_CTL_ADD, c->fd, &ev);
 }
 
 int64_t Endpoint::accept(int timeout_ms) {
@@ -166,7 +183,7 @@ bool Endpoint::remove_conn(uint64_t conn_id) {
     c = it->second;
     conns_.erase(it);
   }
-  ::epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, c->fd, nullptr);
+  ::epoll_ctl(engines_[c->engine]->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
   // Unblock any thread mid-send/recv on this fd; the fd itself closes when
   // the last shared_ptr holder drops (Conn::~Conn), never under a user.
   ::shutdown(c->fd, SHUT_RDWR);
@@ -182,15 +199,26 @@ uint64_t Endpoint::reg(void* ptr, size_t len) {
 }
 
 bool Endpoint::dereg(uint64_t mr_id) {
-  std::lock_guard<std::mutex> lk(regs_mtx_);
-  for (auto it = windows_.begin(); it != windows_.end();) {
-    if (it->second.mr_id == mr_id) {
-      it = windows_.erase(it);
-    } else {
-      ++it;
+  std::shared_ptr<std::atomic<int>> pins;
+  {
+    std::lock_guard<std::mutex> lk(regs_mtx_);
+    for (auto it = windows_.begin(); it != windows_.end();) {
+      if (it->second.mr_id == mr_id) {
+        it = windows_.erase(it);
+      } else {
+        ++it;
+      }
     }
+    auto rit = regs_.find(mr_id);
+    if (rit == regs_.end()) return false;
+    pins = rit->second.pins;
+    regs_.erase(rit);
   }
-  return regs_.erase(mr_id) > 0;
+  // Drain in-flight zero-copy receives before the caller may free the buffer.
+  while (pins->load(std::memory_order_acquire) > 0) {
+    std::this_thread::sleep_for(std::chrono::microseconds(50));
+  }
+  return true;
 }
 
 bool Endpoint::advertise(uint64_t mr_id, size_t offset, size_t len,
@@ -214,14 +242,21 @@ bool Endpoint::advertise(uint64_t mr_id, size_t offset, size_t len,
 // Resolve a (window id, token, offset, len) quadruple from the wire into a
 // host pointer, enforcing the advertised byte range with overflow-safe math.
 // Returns nullptr if anything is off. Caller must hold regs_mtx_.
-void* Endpoint::resolve_window_locked(uint64_t wid, uint64_t token,
-                                      uint64_t offset, uint64_t len) {
+void* Endpoint::resolve_window_locked(
+    uint64_t wid, uint64_t token, uint64_t offset, uint64_t len,
+    std::shared_ptr<std::atomic<int>>* pin_out) {
   auto wit = windows_.find(wid);
   if (wit == windows_.end() || wit->second.token != token) return nullptr;
   const Window& w = wit->second;
   if (offset > w.len || len > w.len - offset) return nullptr;
   auto rit = regs_.find(w.mr_id);
   if (rit == regs_.end()) return nullptr;
+  if (pin_out != nullptr) {
+    // Caller will touch the memory after dropping regs_mtx_: pin so dereg()
+    // blocks until the access completes.
+    rit->second.pins->fetch_add(1, std::memory_order_acq_rel);
+    *pin_out = rit->second.pins;
+  }
   return static_cast<uint8_t*>(rit->second.ptr) + w.offset + offset;
 }
 
@@ -248,11 +283,14 @@ void Endpoint::complete(uint64_t xfer_id, XferState st) {
 }
 
 void Endpoint::enqueue_task(Task* t) {
+  // Route to the engine serving this conn so its tx thread owns the send.
+  auto c = get_conn(t->conn_id);
+  EngineCtx& eng = *engines_[c ? c->engine : 0];
   {
-    std::lock_guard<std::mutex> lk(task_mtx_);
-    while (!task_ring_.push(t)) std::this_thread::yield();
+    std::lock_guard<std::mutex> lk(eng.push_mtx);
+    while (!eng.ring.push(t)) std::this_thread::yield();
   }
-  task_cv_.notify_one();
+  eng.cv.notify_one();
 }
 
 uint64_t Endpoint::write_async(uint64_t conn_id, const void* src, size_t len,
@@ -374,12 +412,13 @@ bool Endpoint::send_frame(Conn* c, const FrameHeader& h, const void* payload) {
   return true;
 }
 
-void Endpoint::tx_loop() {
+void Endpoint::tx_loop(int engine) {
+  EngineCtx& eng = *engines_[engine];
   while (!stop_.load()) {
     Task* t = nullptr;
-    if (!task_ring_.pop(&t)) {
-      std::unique_lock<std::mutex> lk(task_cv_mtx_);
-      task_cv_.wait_for(lk, std::chrono::milliseconds(1));
+    if (!eng.ring.pop(&t)) {
+      std::unique_lock<std::mutex> lk(eng.cv_mtx);
+      eng.cv.wait_for(lk, std::chrono::milliseconds(1));
       continue;
     }
     auto c = get_conn(t->conn_id);
@@ -429,26 +468,7 @@ void Endpoint::tx_loop() {
 void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
                             std::vector<uint8_t>& payload) {
   switch (static_cast<Op>(h.op)) {
-    case Op::kWrite: {
-      bool ok = false;
-      {
-        std::lock_guard<std::mutex> lk(regs_mtx_);
-        void* dst = resolve_window_locked(h.rid, h.token, h.offset, h.len);
-        if (dst != nullptr) {
-          std::memcpy(dst, payload.data(), h.len);
-          ok = true;
-        }
-      }
-      // Ack rides the tx proxy: the io thread never touches a conn's tx
-      // mutex, so a backpressured bulk send can't stall frame dispatch.
-      auto* ack = new Task;
-      ack->conn_id = c->id;
-      ack->op = Op::kWriteAck;
-      ack->xfer_id = h.xfer_id;
-      ack->flags = ok ? 0 : 1;
-      enqueue_task(ack);
-      break;
-    }
+    // Op::kWrite is fully handled by io_loop's zero-copy fast path.
     case Op::kWriteAck:
       complete(h.xfer_id, h.flags == 0 ? XferState::kDone : XferState::kError);
       break;
@@ -503,35 +523,29 @@ void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
   }
 }
 
-void Endpoint::io_loop() {
+void Endpoint::io_loop(int engine) {
+  EngineCtx& eng = *engines_[engine];
   constexpr int kMaxEvents = 64;
   epoll_event events[kMaxEvents];
   while (!stop_.load()) {
-    int n = ::epoll_wait(epoll_fd_, events, kMaxEvents, 100);
+    int n = ::epoll_wait(eng.epoll_fd, events, kMaxEvents, 100);
     for (int i = 0; i < n; ++i) {
       uint64_t tag = events[i].data.u64;
       if (tag == 0) {  // wake fd
         uint64_t v;
-        ::read(wake_fd_, &v, sizeof(v));
+        ::read(eng.wake_fd, &v, sizeof(v));
         continue;
       }
-      if (tag == 1) {  // listener
+      if (tag == 1) {  // listener (engine 0 only)
         int fd = ::accept(listen_fd_, nullptr, nullptr);
         if (fd < 0) continue;
         int one = 1;
         ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-        auto c = std::make_unique<Conn>();
+        auto c = std::make_shared<Conn>();
         c->fd = fd;
         c->id = next_conn_.fetch_add(1);
         uint64_t id = c->id;
-        {
-          std::lock_guard<std::mutex> lk(conns_mtx_);
-          conns_[id] = std::move(c);
-        }
-        epoll_event ev{};
-        ev.events = EPOLLIN;
-        ev.data.u64 = (id << 2) | 2;
-        ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+        register_conn(c);
         if (!accept_queue_.push(id)) {
           // accept backlog overflow: reject the connection rather than leak
           // an id the application can never accept()
@@ -548,6 +562,47 @@ void Endpoint::io_loop() {
       if (!recv_all(c->fd, &h, sizeof(h)) || h.magic != kMagic ||
           h.len > kMaxFrameLen) {
         remove_conn(conn_id);
+        continue;
+      }
+      // Fast path: land write payloads straight into the resolved window —
+      // no intermediate buffer, one copy total (the DCN analog of the
+      // reference's zero-copy RDMA receive into registered memory).
+      if (static_cast<Op>(h.op) == Op::kWrite) {
+        void* dst = nullptr;
+        std::shared_ptr<std::atomic<int>> pin;
+        {
+          std::lock_guard<std::mutex> lk(regs_mtx_);
+          dst = resolve_window_locked(h.rid, h.token, h.offset, h.len, &pin);
+        }
+        bool ok = false;
+        if (dst != nullptr) {
+          ok = recv_all(c->fd, dst, h.len);
+          pin->fetch_sub(1, std::memory_order_acq_rel);
+          if (!ok) {
+            remove_conn(conn_id);
+            continue;
+          }
+        } else if (h.len > 0) {
+          // invalid target: drain the payload to keep the stream framed
+          std::vector<uint8_t> sink;
+          try {
+            sink.resize(h.len);
+          } catch (const std::exception&) {
+            remove_conn(conn_id);
+            continue;
+          }
+          if (!recv_all(c->fd, sink.data(), h.len)) {
+            remove_conn(conn_id);
+            continue;
+          }
+        }
+        bytes_rx_.fetch_add(sizeof(h) + h.len);
+        auto* ack = new Task;
+        ack->conn_id = c->id;
+        ack->op = Op::kWriteAck;
+        ack->xfer_id = h.xfer_id;
+        ack->flags = ok ? 0 : 1;
+        enqueue_task(ack);
         continue;
       }
       std::vector<uint8_t> payload;

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
@@ -35,54 +36,61 @@ def _is_tpu() -> bool:
         return False
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k, causal):
-    """One program: q block (iq) of one (batch*head) against all its KV blocks.
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, scale, block_q, block_k, causal,
+):
+    """Grid (bh, iq, jk): one KV block per program, streamed through VMEM.
 
-    Ref shapes: q [1, BQ, D]; k/v [1, Sk, D]; o [1, BQ, D].
+    Ref shapes: q [1, BQ, D]; k/v [1, BK, D]; o [1, BQ, D]. Scratch
+    (m/l [BQ, 1], acc [BQ, D]) carries the online softmax across the jk
+    dimension — jk is innermost, so for a fixed (bh, iq) the programs run
+    back-to-back and the scratch is private to that q block.
     """
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [BQ, D]
-    sk = k_ref.shape[1]
-    d = q_ref.shape[2]
-    n_kv = sk // block_k
+    jk = pl.program_id(2)
+    n_kv = pl.num_programs(2)
 
-    if causal:
-        # KV blocks strictly after this q block's last row are fully masked.
-        last_q_pos = (iq + 1) * block_q - 1
-        n_blocks = lax.min(n_kv, last_q_pos // block_k + 1)
-    else:
-        n_blocks = n_kv
+    @pl.when(jk == 0)
+    def _init():
+        m_ref[:, :] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:, :] = jnp.zeros_like(l_ref)
+        acc_ref[:, :] = jnp.zeros_like(acc_ref)
 
-    qpos = iq * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    # Causal: KV blocks strictly after this q block contribute nothing.
+    last_q_pos = (iq + 1) * block_q - 1
+    relevant = (not causal) or (jk * block_k <= last_q_pos)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
+    @pl.when(relevant)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32)  # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BQ, BK]
         if causal:
-            kpos = j * block_k + lax.broadcasted_iota(
+            qpos = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = jk * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        m_blk = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m, m_blk)
+        m = m_ref[:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
-        l_new = l * corr + jnp.sum(p, axis=1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+        acc_ref[:, :] = acc_ref[:, :] * corr[:, None] + lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
+        m_ref[:, 0] = m_new
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-20)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(jk == n_kv - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, 0], 1e-20)
+        o_ref[0] = (acc_ref[:, :] / l[:, None]).astype(o_ref.dtype)
 
 
 def _flash_fwd(
@@ -97,6 +105,8 @@ def _flash_fwd(
     """q: [B, S, H, D]; k/v: [B, S, Hkv, D] -> [B, S, H, D]."""
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} must be a multiple of kv heads {hkv}")
     n_rep = h // hkv
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
@@ -119,14 +129,19 @@ def _flash_fwd(
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        grid=(b * h, sq // block_q),
+        grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
-            # GQA: head bh maps to kv head bh//n_rep; whole KV slab per program
-            pl.BlockSpec((1, sk, d), lambda bh, iq: (bh // n_rep, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, iq: (bh // n_rep, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
+            # GQA: head bh maps to kv head bh//n_rep; one KV block per program
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, jk: (bh // n_rep, jk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, jk: (bh // n_rep, jk, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),  # normalizer l
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+        ],
         interpret=interpret,
     )(qt, kt, vt)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

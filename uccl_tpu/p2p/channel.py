@@ -1,0 +1,160 @@
+"""Multipath transfer channel: chunk spraying over parallel connections.
+
+The DCN re-expression of UCCL-Tran's core idea — spray chunks of one message
+over many paths and complete out-of-order (reference: 32-way packet spraying,
+collective/rdma/transport_config.h:40 PORT_ENTROPY; chunk size knob
+UCCL_CHUNK_SIZE_KB:42). A :class:`Channel` bundles ``n_paths`` engine
+connections to one peer; large writes split into chunks issued round-robin
+across paths as independent one-sided writes into the same advertised window
+(each chunk at its own offset), completing when every chunk acks. Each
+connection is served by its own engine thread pair on both ends, so paths
+genuinely move bytes in parallel.
+"""
+
+from __future__ import annotations
+
+import struct
+import uuid
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from uccl_tpu.p2p.endpoint import FIFO_ITEM_BYTES, Endpoint
+from uccl_tpu.utils.config import param
+
+_chunk_kb = param("chunk_size_kb", 1024, help="multipath chunk size in KiB")
+
+
+@dataclass(frozen=True)
+class FifoItem:
+    """Python view of the engine's 64-byte descriptor (native engine.h)."""
+
+    rid: int
+    size: int
+    token: int
+    offset: int
+
+    _FMT = "<QQQQ32x"
+
+    def pack(self) -> bytes:
+        return struct.pack(self._FMT, self.rid, self.size, self.token, self.offset)
+
+    @staticmethod
+    def unpack(raw: bytes) -> "FifoItem":
+        rid, size, token, offset = struct.unpack(FifoItem._FMT, raw)
+        return FifoItem(rid, size, token, offset)
+
+    def slice(self, offset: int, length: int) -> "FifoItem":
+        """Descriptor for a chunk inside this window (server-side bounds are
+        still enforced against the full advertised window)."""
+        if offset + length > self.size:
+            raise ValueError(f"chunk [{offset}, {offset + length}) outside window {self.size}")
+        return FifoItem(self.rid, length, self.token, self.offset + offset)
+
+
+class Channel:
+    """n_paths connections to one peer + chunked multipath transfers.
+
+    Client side: ``Channel.connect(ep, ip, port, n_paths)``.
+    Server side: ``Channel.accept(ep)`` (reads the path handshake).
+    """
+
+    _HELLO = b"UCCLT_CHAN"
+
+    def __init__(self, ep: Endpoint, conns: List[int], chunk_bytes: Optional[int] = None):
+        self.ep = ep
+        self.conns = conns
+        self.chunk_bytes = chunk_bytes or _chunk_kb.get() * 1024
+
+    @classmethod
+    def connect(
+        cls,
+        ep: Endpoint,
+        ip: str,
+        port: int,
+        n_paths: int = 4,
+        chunk_bytes: Optional[int] = None,
+    ) -> "Channel":
+        token = uuid.uuid4().bytes
+        conns = []
+        for i in range(n_paths):
+            cid = ep.connect(ip, port)
+            ep.send(cid, cls._HELLO + token + bytes([i, n_paths]))
+            conns.append(cid)
+        return cls(ep, conns, chunk_bytes)
+
+    @classmethod
+    def accept(
+        cls, ep: Endpoint, timeout_ms: int = 10000, chunk_bytes: Optional[int] = None
+    ) -> "Channel":
+        first_conn = ep.accept(timeout_ms)
+        hello = ep.recv(first_conn, timeout_ms=timeout_ms)
+        if not hello.startswith(cls._HELLO):
+            raise IOError("not a channel handshake")
+        token = hello[len(cls._HELLO) : len(cls._HELLO) + 16]
+        n_paths = hello[-1]
+        paths = {hello[-2]: first_conn}
+        while len(paths) < n_paths:
+            cid = ep.accept(timeout_ms)
+            h = ep.recv(cid, timeout_ms=timeout_ms)
+            if not h.startswith(cls._HELLO) or h[len(cls._HELLO) : len(cls._HELLO) + 16] != token:
+                raise IOError("path handshake mismatch (interleaved channels?)")
+            paths[h[-2]] = cid
+        return cls(ep, [paths[i] for i in range(n_paths)], chunk_bytes)
+
+    @property
+    def n_paths(self) -> int:
+        return len(self.conns)
+
+    # -- control-plane helpers (ride path 0, ordered) ----------------------
+    def send(self, data) -> None:
+        self.ep.send(self.conns[0], data)
+
+    def recv(self, max_bytes: int = 1 << 20, timeout_ms: int = 10000) -> bytes:
+        return self.ep.recv(self.conns[0], max_bytes, timeout_ms)
+
+    # -- data-plane: chunked multipath one-sided ops -----------------------
+    def _chunks(self, total: int):
+        """(offset, length) chunk list of `total` bytes."""
+        cb = self.chunk_bytes
+        return [(off, min(cb, total - off)) for off in range(0, total, cb)]
+
+    @staticmethod
+    def _flat_view(arr: np.ndarray) -> np.ndarray:
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("channel transfers need C-contiguous arrays")
+        return arr.view(np.uint8).reshape(-1)
+
+    def _spray(self, arr, fifo, sync_op, async_op, timeout_ms: int) -> None:
+        """Shared chunk fan-out for one-sided ops: small transfers take the
+        single-path sync op; large ones split round-robin across paths."""
+        item = FifoItem.unpack(fifo)
+        flat = self._flat_view(arr)
+        total = flat.nbytes
+        if total <= self.chunk_bytes or self.n_paths == 1:
+            sync_op(self.conns[0], arr, fifo)
+            return
+        xids = [
+            async_op(
+                self.conns[i % self.n_paths],
+                flat[off : off + ln],
+                item.slice(off, ln).pack(),
+            )
+            for i, (off, ln) in enumerate(self._chunks(total))
+        ]
+        for x in xids:
+            if not self.ep.wait(x, timeout_ms):
+                raise IOError("chunked transfer failed")
+
+    def write(self, src: np.ndarray, fifo: bytes, timeout_ms: int = 60000) -> None:
+        """Spray `src` into the peer's advertised window across all paths."""
+        self._spray(src, fifo, self.ep.write, self.ep.write_async, timeout_ms)
+
+    def read(self, dst: np.ndarray, fifo: bytes, timeout_ms: int = 60000) -> None:
+        """Chunked multipath one-sided read into `dst`."""
+        self._spray(dst, fifo, self.ep.read, self.ep.read_async, timeout_ms)
+
+    def close(self) -> None:
+        for c in self.conns:
+            self.ep.remove_conn(c)

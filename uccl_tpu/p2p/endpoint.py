@@ -70,7 +70,7 @@ def _load():
         lib = ctypes.CDLL(_build_if_needed())
         c = ctypes.c_void_p
         lib.ucclt_create.restype = c
-        lib.ucclt_create.argtypes = [ctypes.c_uint16]
+        lib.ucclt_create.argtypes = [ctypes.c_uint16, ctypes.c_int]
         lib.ucclt_destroy.argtypes = [c]
         lib.ucclt_listen_port.restype = ctypes.c_uint16
         lib.ucclt_listen_port.argtypes = [c]
@@ -125,9 +125,9 @@ def _as_buffer(arr: np.ndarray) -> Tuple[ctypes.c_void_p, int]:
 class Endpoint:
     """P2P transfer endpoint (reference: p2p Endpoint, engine.h:243)."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, n_engines: int = 2):
         self._lib = _load()
-        self._h = self._lib.ucclt_create(port)
+        self._h = self._lib.ucclt_create(port, n_engines)
         if not self._h:
             raise RuntimeError(
                 f"failed to create endpoint (port {port} in use?)"

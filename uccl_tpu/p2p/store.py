@@ -58,7 +58,12 @@ class StoreServer:
         return self._ep.port
 
     def close(self):
+        # Signal and join worker threads BEFORE destroying the native
+        # endpoint: they block inside its accept/recv calls.
         self._stop = True
+        self._acceptor.join(timeout=5)
+        for t in self._threads:
+            t.join(timeout=5)
         self._ep.close()
 
     def _accept_loop(self):

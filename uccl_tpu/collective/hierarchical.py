@@ -1,0 +1,212 @@
+"""Hierarchical cross-pod collectives: ICI inside the mesh, DCN between pods.
+
+The reference's multi-NIC/multi-engine split re-expressed for TPU scale-out
+(SURVEY.md §7 step 4): within a pod, collectives ride ICI via the mesh
+(Communicator); between pods — where the host owns the wire — the transfer
+engine moves the data. The canonical hierarchical allreduce:
+
+  1. reduce_scatter over the local mesh axis (ICI) — each host ends with a
+     reduced shard,
+  2. allreduce of that shard across pods over DCN (ring over Channels),
+  3. all_gather back over ICI.
+
+``DcnGroup`` is the cross-pod communicator: N processes, rank i connected to
+its ring neighbors through multipath Channels, bootstrap via the OOB store.
+Works between any processes with TCP reach — the same code path drives
+pod-to-pod DCN on real deployments and localhost process pairs in tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from uccl_tpu.p2p.channel import Channel
+from uccl_tpu.p2p.endpoint import Endpoint
+from uccl_tpu.parallel.distributed import Session, exchange_json
+from uccl_tpu.utils.logging import get_logger
+
+_log = get_logger("COLL")
+
+
+def _local_ip() -> str:
+    """Address peers should dial: UCCL_TPU_HOST_IP env, else the hostname's
+    address, else loopback (single-host default)."""
+    import os
+    import socket
+
+    ip = os.environ.get("UCCL_TPU_HOST_IP")
+    if ip:
+        return ip
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
+class DcnGroup:
+    """Cross-process collective group over the DCN transfer engine.
+
+    Bootstraps a bidirectional ring: every rank connects a Channel to its
+    next neighbor and accepts one from its previous neighbor (addresses via
+    the session's OOB store). ``tag`` must be unique per group per session
+    (ranks must create groups in the same order).
+    """
+
+    def __init__(self, sess: Session, n_paths: int = 2, tag: str = "0"):
+        self.rank = sess.rank
+        self.world = sess.world
+        self.ep = Endpoint(n_engines=max(2, n_paths))
+        addrs = exchange_json(
+            sess,
+            f"dcn_group/{tag}/addr",
+            {"ip": _local_ip(), "port": self.ep.port},
+        )
+        self._next: Optional[Channel] = None
+        self._prev: Optional[Channel] = None
+        self._ring_mr: Optional[int] = None
+        self._ring_recv: Optional[np.ndarray] = None
+        self._peer_fifo: Optional[bytes] = None
+        if self.world > 1:
+            nxt = addrs[(self.rank + 1) % self.world]
+            acc = {}
+            t = threading.Thread(
+                target=lambda: acc.setdefault("c", Channel.accept(self.ep, 30000))
+            )
+            t.start()
+            self._next = Channel.connect(self.ep, nxt["ip"], nxt["port"], n_paths)
+            # Channel.accept makes ~2*n_paths blocking calls of 30s each;
+            # join must outlast the worst case or we misreport failure.
+            t.join(timeout=30 * (2 * n_paths + 1))
+            self._prev = acc.get("c")
+            if self._prev is None:
+                raise ConnectionError("ring bootstrap failed: no inbound channel")
+
+    def close(self):
+        self.ep.close()
+
+    # ------------------------------------------------------------------
+    def _setup_ring_buf(self, nbytes: int, dtype) -> np.ndarray:
+        """(Re)advertise the hop landing buffer: one byte-window serves every
+        hop of every collective (no per-hop registrations to leak); it only
+        regrows — and re-exchanges descriptors — when a larger payload
+        arrives, which happens in lockstep on all ranks (SPMD collectives)."""
+        if self._ring_recv is None or self._ring_recv.nbytes < nbytes:
+            if self._ring_mr is not None:
+                self.ep.dereg(self._ring_mr)
+            self._ring_recv = np.empty(max(nbytes, 1), np.uint8)
+            self._ring_mr = self.ep.reg(self._ring_recv)
+            fifo = self.ep.advertise(self._ring_mr)
+            self._prev.send(b"FIFO" + fifo)
+            msg = self._next.recv(timeout_ms=30000)
+            if not msg.startswith(b"FIFO"):
+                raise IOError(f"ring fifo exchange broken: {msg[:16]!r}")
+            self._peer_fifo = msg[4:]
+        return self._ring_recv[:nbytes].view(dtype)
+
+    def _ring_hop(self, send_arr: np.ndarray):
+        """One hop: signal ready, one-sided write to next, confirm done.
+
+        The per-hop READY from the receiver is what licenses the writer to
+        reuse the landing window — without it hop s+1 could overwrite data
+        the receiver is still consuming from hop s.
+        """
+        self._prev.send(b"R")
+        if self._next.recv(timeout_ms=30000) != b"R":
+            raise IOError("ring protocol: expected READY")
+        from uccl_tpu.p2p.channel import FifoItem
+
+        item = FifoItem.unpack(self._peer_fifo)
+        self._next.write(
+            send_arr, item.slice(0, send_arr.nbytes).pack()
+        )
+        self._next.send(b"D")
+        if self._prev.recv(timeout_ms=30000) != b"D":
+            raise IOError("ring protocol: expected DONE")
+
+    def all_reduce(self, x: np.ndarray) -> np.ndarray:
+        """Ring allreduce of a host array across the process group (sum).
+
+        Chunked ring: reduce-scatter then all-gather, n-1 hops each, every
+        hop a one-sided chunked write through the channel.
+        """
+        n = self.world
+        if n == 1:
+            return x.copy()
+        flat = np.ascontiguousarray(x).reshape(-1).astype(x.dtype)
+        pad = (-flat.size) % n
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, x.dtype)])
+        buf = flat.reshape(n, -1).copy()
+        recv = self._setup_ring_buf(buf[0].nbytes, buf.dtype)
+        r = self.rank
+        # reduce-scatter: chunk j accumulates around the ring, lands at member j
+        for s in range(n - 1):
+            send_slot = (r - s - 1) % n
+            recv_slot = (r - s - 2) % n
+            self._ring_hop(buf[send_slot])
+            buf[recv_slot] += recv
+        # all-gather: circulate owned slots
+        for s in range(n - 1):
+            send_slot = (r - s) % n
+            recv_slot = (r - s - 1) % n
+            self._ring_hop(buf[send_slot])
+            buf[recv_slot] = recv
+        out = buf.reshape(-1)
+        if pad:
+            out = out[:-pad]
+        return out.reshape(x.shape)
+
+    def all_gather(self, x: np.ndarray) -> np.ndarray:
+        """Gather equal-shaped host arrays from every rank: out[i] = rank i's x."""
+        n = self.world
+        out = np.empty((n,) + x.shape, x.dtype)
+        out[self.rank] = x
+        if n == 1:
+            return out
+        recv = self._setup_ring_buf(x.nbytes, x.dtype).reshape(x.shape)
+        cur = np.ascontiguousarray(x)
+        for s in range(n - 1):
+            self._ring_hop(cur)
+            src = (self.rank - s - 1) % n
+            out[src] = recv
+            cur = recv.copy()  # a real copy: recv is reused as the landing
+            # buffer next hop while cur is simultaneously being sent
+        return out
+
+    def barrier(self):
+        self.all_reduce(np.zeros(1, np.float32))
+
+
+def hierarchical_all_reduce(comm, dcn: DcnGroup, x):
+    """Two-level allreduce: ICI reduce-scatter → DCN allreduce → ICI all-gather.
+
+    ``comm`` is an on-mesh :class:`~uccl_tpu.collective.Communicator`
+    (rank-dim convention, x: [local_world, N]); ``dcn`` spans pods. Each pod
+    moves only N/local_world bytes over DCN and per device only its shard
+    crosses the host link — the hierarchical bandwidth win (the moral
+    equivalent of the reference's multi-engine NIC split). Result: every
+    member of every pod holds the global sum, NCCL-allreduce shaped.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    local = comm.world
+    n = x.shape[1]
+    shard = comm.reduce_scatter(x)  # [local_world, N/local]: row i = chunk i
+    reduced = dcn.all_reduce(np.asarray(shard))  # host staging + DCN exchange
+    # back onto the mesh shard-wise (N/local per device over the host link),
+    # then the final hop is a true ICI all-gather + on-device broadcast
+    shard_dev = comm.device_put(reduced)
+    gathered = comm.all_gather(shard_dev)  # replicated [local, N/local]
+    out_sharding = NamedSharding(comm.mesh, comm._ranked(1))
+    return jax.jit(
+        lambda g: jnp.broadcast_to(g.reshape(1, -1), (local, n)),
+        out_shardings=out_sharding,
+    )(gathered)

@@ -15,6 +15,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -115,6 +116,12 @@ class Endpoint {
 
   // --- fault injection (reference kTestLoss knobs, transport_config.h:222)
   void set_drop_rate(double p) { drop_rate_ = p; }
+
+  // --- pacing (reference: Carousel timing wheel, collective/rdma/
+  // timing_wheel.h — paces chunk injection; here a token bucket on the tx
+  // proxies). bytes_per_sec == 0 disables pacing.
+  void set_rate_limit(uint64_t bytes_per_sec) { rate_bps_ = bytes_per_sec; }
+  uint64_t rate_limit() const { return rate_bps_.load(); }
 
   // --- stats
   uint64_t bytes_tx() const { return bytes_tx_.load(); }
@@ -223,6 +230,10 @@ class Endpoint {
   std::atomic<uint64_t> bytes_tx_{0};
   std::atomic<uint64_t> bytes_rx_{0};
   std::atomic<double> drop_rate_{0.0};
+  std::atomic<uint64_t> rate_bps_{0};
+  std::mutex pace_mtx_;  // one shared leaky bucket across engines
+  std::chrono::steady_clock::time_point pace_next_{};
+  void pace(EngineCtx& eng, uint64_t bytes);  // token-bucket wait in tx_loop
 };
 
 }  // namespace uccl_tpu

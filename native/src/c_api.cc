@@ -120,6 +120,10 @@ void ucclt_set_drop_rate(void* ep, double p) {
   static_cast<Endpoint*>(ep)->set_drop_rate(p);
 }
 
+void ucclt_set_rate_limit(void* ep, uint64_t bytes_per_sec) {
+  static_cast<Endpoint*>(ep)->set_rate_limit(bytes_per_sec);
+}
+
 uint64_t ucclt_bytes_tx(void* ep) {
   return static_cast<Endpoint*>(ep)->bytes_tx();
 }

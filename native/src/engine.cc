@@ -412,6 +412,41 @@ bool Endpoint::send_frame(Conn* c, const FrameHeader& h, const void* payload) {
   return true;
 }
 
+// Token-bucket pacing: before a payload send, wait until enough tokens have
+// accrued. ONE bucket shared by all engines — the cap is the endpoint's
+// aggregate egress regardless of how traffic spreads across paths (reference
+// analog: the Carousel timing wheel pacing chunk injection,
+// collective/rdma/timing_wheel.h).
+void Endpoint::pace(EngineCtx& /*eng*/, uint64_t bytes) {
+  uint64_t bps = rate_bps_.load(std::memory_order_relaxed);
+  if (bps == 0 || bytes == 0) return;
+  const double rate = static_cast<double>(bps);
+  constexpr double kBurstS = 0.01;  // at most 10ms of credit after idle
+  double wait_s = 0.0;
+  {
+    // Virtual-time leaky bucket: pace_next_ is when the next byte may go.
+    // Exact long-run rate (each send advances it by bytes/rate), bounded
+    // burst (it can lag `now` by at most kBurstS).
+    std::lock_guard<std::mutex> lk(pace_mtx_);
+    auto now = std::chrono::steady_clock::now();
+    auto floor = now - std::chrono::duration_cast<
+                           std::chrono::steady_clock::duration>(
+                           std::chrono::duration<double>(kBurstS));
+    if (pace_next_ < floor) pace_next_ = floor;
+    pace_next_ += std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+        std::chrono::duration<double>(bytes / rate));
+    // Wait until this frame's own virtual finish time: a single frame larger
+    // than the burst window is paced too, not just its successors.
+    wait_s = std::chrono::duration<double>(pace_next_ - now).count();
+  }
+  // Interruptible sleep: never outlive shutdown by more than one slice.
+  while (wait_s > 0.0 && !stop_.load(std::memory_order_relaxed)) {
+    double slice = std::min(wait_s, 0.01);
+    std::this_thread::sleep_for(std::chrono::duration<double>(slice));
+    wait_s -= slice;
+  }
+}
+
 void Endpoint::tx_loop(int engine) {
   EngineCtx& eng = *engines_[engine];
   while (!stop_.load()) {
@@ -437,6 +472,7 @@ void Endpoint::tx_loop(int engine) {
     h.flags = t->flags;
     if (t->op == Op::kWrite) {
       h.len = t->len;
+      pace(eng, t->len);
       if (!send_frame(c.get(), h, t->src))
         complete(t->xfer_id, XferState::kError);
       // completion arrives as kWriteAck
@@ -453,6 +489,7 @@ void Endpoint::tx_loop(int engine) {
       h.token = 0;
       h.offset = 0;
       h.len = t->owned.size();
+      pace(eng, h.len);
       send_frame(c.get(), h, t->owned.data());
     } else if (t->op == Op::kWriteAck) {
       h.rid = 0;

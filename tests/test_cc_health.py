@@ -1,0 +1,128 @@
+"""Congestion control math, pacing actuation, heartbeat failure detection."""
+
+import time
+
+import numpy as np
+import pytest
+
+from uccl_tpu.p2p import Endpoint
+from uccl_tpu.p2p.cc import RateController, SwiftCC, TimelyCC
+from uccl_tpu.p2p.store import StoreClient, StoreServer
+from uccl_tpu.parallel.distributed import Session
+from uccl_tpu.parallel.health import HeartbeatMonitor
+
+
+class TestTimely:
+    def test_increases_on_low_rtt(self):
+        cc = TimelyCC(rate=100e6)
+        for _ in range(10):
+            cc.on_rtt(60.0)  # below t_low
+        assert cc.rate > 100e6
+
+    def test_decreases_on_high_rtt(self):
+        cc = TimelyCC(rate=1e9)
+        for _ in range(10):
+            cc.on_rtt(10000.0)  # above t_high
+        assert cc.rate < 1e9
+
+    def test_gradient_response(self):
+        cc = TimelyCC(rate=500e6)
+        # rising RTTs in the mid band -> positive gradient -> decrease
+        for rtt in np.linspace(200, 2000, 20):
+            cc.on_rtt(float(rtt))
+        assert cc.rate < 500e6
+        # falling RTTs -> negative gradient -> increase
+        r = cc.rate
+        for rtt in np.linspace(2000, 200, 20):
+            cc.on_rtt(float(rtt))
+        assert cc.rate > r
+
+    def test_bounds(self):
+        cc = TimelyCC(rate=2e6, min_rate=1e6, max_rate=1e9)
+        for _ in range(200):
+            cc.on_rtt(50000.0)
+        assert cc.rate >= cc.min_rate
+        for _ in range(2000):
+            cc.on_rtt(10.0)
+        assert cc.rate <= cc.max_rate
+
+
+class TestSwift:
+    def test_aimd(self):
+        cc = SwiftCC(cwnd=1e6)
+        for i in range(5):
+            cc.on_delay(100.0, now=float(i))
+        assert cc.cwnd > 1e6
+        w = cc.cwnd
+        cc.on_delay(3000.0, now=100.0)
+        assert cc.cwnd < w
+
+    def test_rate_conversion(self):
+        cc = SwiftCC(cwnd=1e6)
+        assert cc.rate_for_rtt(1000.0) == pytest.approx(1e9)
+
+
+class TestPacing:
+    def test_rate_limit_slows_transfers(self, rng):
+        """With a 20 MB/s cap, a 4 MB transfer must take >= ~150 ms."""
+        with Endpoint() as server, Endpoint() as client:
+            conn = client.connect("127.0.0.1", server.port)
+            server.accept()
+            dst = np.zeros(4 << 20, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            src = rng.integers(0, 255, 4 << 20).astype(np.uint8)
+            client.write(conn, src, fifo)  # unpaced warmup
+            t0 = time.perf_counter()
+            client.write(conn, src, fifo)
+            unpaced = time.perf_counter() - t0
+            client.set_rate_limit(20 << 20)  # 20 MiB/s
+            t0 = time.perf_counter()
+            client.write(conn, src, fifo)
+            paced = time.perf_counter() - t0
+            client.set_rate_limit(0)
+            assert paced > max(0.15, unpaced * 2), (paced, unpaced)
+            np.testing.assert_array_equal(dst, src)
+
+    def test_rate_controller_actuates(self, rng):
+        with Endpoint() as server, Endpoint() as client:
+            conn = client.connect("127.0.0.1", server.port)
+            server.accept()
+            dst = np.zeros(64 << 10, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            src = rng.integers(0, 255, 64 << 10).astype(np.uint8)
+            probe_buf = np.zeros(1, np.uint8)
+            probe_fifo = server.advertise(server.reg(probe_buf))
+            rc = RateController(client, TimelyCC(rate=50e6), update_every=1)
+            for _ in range(5):
+                rtt = rc.probe(conn, probe_fifo)
+                assert rtt > 0
+            # loopback probe RTTs are tens of µs (< t_low) -> rate must grow
+            assert rc.algo.rate > 50e6
+            client.set_rate_limit(0)
+
+
+class TestHeartbeat:
+    def test_detects_silent_peer(self):
+        server = StoreServer()
+        c0 = StoreClient("127.0.0.1", server.port)
+        s0 = Session(rank=0, world=2, store=c0)
+        failures = []
+        mon = HeartbeatMonitor(
+            s0, interval_s=0.1, timeout_s=0.5, on_failure=failures.append
+        )
+        mon.start()
+        time.sleep(1.0)  # rank 1 never posts
+        assert mon.suspected() == [1]
+        assert failures == [1]
+        # rank 1 comes alive -> recovered
+        c1 = StoreClient("127.0.0.1", server.port)
+        s1 = Session(rank=1, world=2, store=c1)
+        m1 = HeartbeatMonitor(s1, interval_s=0.1, timeout_s=0.5)
+        m1.start()
+        time.sleep(0.6)
+        assert mon.suspected() == []
+        mon.stop()
+        m1.stop()
+        c0.close()
+        c1.close()
+        server.close()

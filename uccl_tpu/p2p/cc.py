@@ -1,0 +1,166 @@
+"""Congestion control for the DCN engine: Timely and Swift rate controllers.
+
+TPU-native re-design of the reference's pluggable CC layer
+(include/cc/timely.h:49 TimelyCC — RTT-gradient rate control, SIGCOMM'15;
+include/cc/swift.h:42 SwiftCC — delay-target cwnd, SIGCOMM'20). On the DCN
+engine the actuator is the endpoint's token-bucket pacer
+(``Endpoint.set_rate_limit``) rather than per-QP pacing; the sensor is the
+measured completion RTT of chunk transfers. The algorithms themselves are
+pure-python, unit-testable state machines — same role as the reference's
+header-only CC classes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from uccl_tpu.utils.logging import get_logger
+
+_log = get_logger("P2P")
+
+
+@dataclasses.dataclass
+class TimelyCC:
+    """RTT-gradient rate control.
+
+    Rate increases additively while RTT gradients are flat/negative, and
+    decreases multiplicatively proportional to the normalized gradient when
+    RTTs grow (the HAI/gradient scheme of the paper, as in the reference's
+    include/cc/timely.h parameter block :20-26).
+    """
+
+    min_rtt_us: float = 50.0
+    t_low_us: float = 100.0
+    t_high_us: float = 5000.0
+    add_step: float = 10e6  # additive increase, bytes/s
+    beta: float = 0.8  # multiplicative decrease factor
+    ewma_alpha: float = 0.46
+    rate: float = 100e6  # current rate, bytes/s
+    max_rate: float = 12.5e9
+    min_rate: float = 1e6
+
+    _prev_rtt: Optional[float] = None
+    _gradient: float = 0.0
+    _hai_count: int = 0
+
+    def on_rtt(self, rtt_us: float) -> float:
+        """Feed one RTT sample; returns the new rate (bytes/s)."""
+        if self._prev_rtt is None:
+            self._prev_rtt = rtt_us
+            return self.rate
+        delta = rtt_us - self._prev_rtt
+        self._prev_rtt = rtt_us
+        norm_grad = (
+            self.ewma_alpha * (delta / self.min_rtt_us)
+            + (1 - self.ewma_alpha) * self._gradient
+        )
+        self._gradient = norm_grad
+
+        if rtt_us < self.t_low_us:
+            self._hai_count += 1
+            boost = 5 if self._hai_count >= 5 else 1
+            self.rate += boost * self.add_step
+        elif rtt_us > self.t_high_us:
+            self._hai_count = 0
+            self.rate *= 1 - self.beta * (1 - self.t_high_us / rtt_us)
+        elif norm_grad <= 0:
+            self._hai_count += 1
+            boost = 5 if self._hai_count >= 5 else 1
+            self.rate += boost * self.add_step
+        else:
+            self._hai_count = 0
+            self.rate *= 1 - self.beta * min(norm_grad, 1.0)
+        self.rate = min(max(self.rate, self.min_rate), self.max_rate)
+        return self.rate
+
+
+@dataclasses.dataclass
+class SwiftCC:
+    """Delay-target congestion window control (cwnd in bytes).
+
+    AIMD around a target delay: grow additively when the measured delay is
+    under target, back off multiplicatively (bounded per-RTT) when over —
+    the reference's include/cc/swift.h scheme with flow-scaling omitted
+    (single flow per channel here).
+    """
+
+    target_delay_us: float = 300.0
+    additive_inc: float = 64 * 1024  # bytes per update under target
+    beta: float = 0.7  # max multiplicative decrease
+    cwnd: float = 1e6
+    min_cwnd: float = 64 * 1024
+    max_cwnd: float = 1e9
+
+    _last_decrease: float = 0.0
+
+    def on_delay(self, delay_us: float, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        if delay_us < self.target_delay_us:
+            self.cwnd += self.additive_inc
+        else:
+            # at most one multiplicative decrease per RTT-ish interval
+            if now - self._last_decrease > self.target_delay_us / 1e6:
+                factor = max(
+                    self.beta, 1 - (delay_us - self.target_delay_us) / delay_us
+                )
+                self.cwnd *= factor
+                self._last_decrease = now
+        self.cwnd = min(max(self.cwnd, self.min_cwnd), self.max_cwnd)
+        return self.cwnd
+
+    def rate_for_rtt(self, rtt_us: float) -> float:
+        """bytes/s equivalent of the current window at the given RTT."""
+        return self.cwnd / (max(rtt_us, 1.0) / 1e6)
+
+
+class RateController:
+    """Wires a CC algorithm onto an Endpoint's pacer.
+
+    Call :meth:`sample` with each chunk's completion RTT; the controller
+    updates the endpoint's token-bucket rate every ``update_every`` samples.
+    """
+
+    def __init__(self, ep, algo: Optional[TimelyCC] = None, update_every: int = 4):
+        self.ep = ep
+        self.algo = algo if algo is not None else TimelyCC()
+        self.update_every = update_every
+        self._n = 0
+
+    def sample(self, rtt_us: float) -> None:
+        rate = self.algo.on_rtt(rtt_us)
+        self._n += 1
+        if self._n % self.update_every == 0:
+            self.ep.set_rate_limit(int(rate))
+
+    _PROBE = None
+
+    def probe(self, conn_id: int, probe_fifo: bytes) -> float:
+        """Measure network delay with a 1-byte one-sided write (ack round
+        trip) and feed it to the controller. This is the right Timely signal:
+        decoupled from transfer size and (nearly) from the pacer itself —
+        feeding whole-transfer completion times instead creates a positive
+        feedback loop where the pacer's own delay drives the rate to the
+        floor.
+
+        ``probe_fifo`` MUST reference a dedicated scratch window on the peer
+        (e.g. ``peer.advertise(peer.reg(np.zeros(1, np.uint8)))``) — the
+        probe genuinely writes one byte at its offset 0, so pointing it at a
+        data window would clobber the first byte of real data."""
+        import numpy as np
+
+        if RateController._PROBE is None:
+            RateController._PROBE = np.zeros(1, np.uint8)
+        t0 = time.perf_counter()
+        self.ep.write(conn_id, RateController._PROBE, probe_fifo)
+        rtt_us = (time.perf_counter() - t0) * 1e6
+        self.sample(rtt_us)
+        return rtt_us
+
+    def timed_write(self, conn_id: int, src, fifo) -> float:
+        """Write and return the completion time in µs (diagnostic only — do
+        NOT feed transfer completion times to Timely; see :meth:`probe`)."""
+        t0 = time.perf_counter()
+        self.ep.write(conn_id, src, fifo)
+        return (time.perf_counter() - t0) * 1e6

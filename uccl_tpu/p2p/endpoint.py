@@ -108,6 +108,7 @@ def _load():
         lib.ucclt_recv.argtypes = [c, ctypes.c_uint64, ctypes.c_void_p,
                                    ctypes.c_size_t, ctypes.c_int]
         lib.ucclt_set_drop_rate.argtypes = [c, ctypes.c_double]
+        lib.ucclt_set_rate_limit.argtypes = [c, ctypes.c_uint64]
         lib.ucclt_bytes_tx.restype = ctypes.c_uint64
         lib.ucclt_bytes_tx.argtypes = [c]
         lib.ucclt_bytes_rx.restype = ctypes.c_uint64
@@ -289,6 +290,11 @@ class Endpoint:
     # -- observability / fault injection ---------------------------------
     def set_drop_rate(self, p: float) -> None:
         self._lib.ucclt_set_drop_rate(self._handle(), p)
+
+    def set_rate_limit(self, bytes_per_sec: int) -> None:
+        """Token-bucket pacing on the tx proxies; 0 disables (reference:
+        Carousel timing-wheel pacing; actuator for the CC layer in cc.py)."""
+        self._lib.ucclt_set_rate_limit(self._handle(), bytes_per_sec)
 
     @property
     def stats(self) -> dict:

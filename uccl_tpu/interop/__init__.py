@@ -1,0 +1,17 @@
+"""Interop adapters: torch tensors over the transfer engine, jax↔torch."""
+
+from uccl_tpu.interop.torch_bridge import (
+    tensor_buffer,
+    register_tensor,
+    advertise_tensor,
+    send_tensor,
+    allreduce_gradients,
+)
+
+__all__ = [
+    "tensor_buffer",
+    "register_tensor",
+    "advertise_tensor",
+    "send_tensor",
+    "allreduce_gradients",
+]

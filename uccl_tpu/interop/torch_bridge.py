@@ -1,0 +1,89 @@
+"""Torch interop: move torch tensors through the transfer engine, DDP-style
+gradient averaging over the DCN group.
+
+The reference's front doors are torch-shaped (NCCL plugin under
+torch.distributed, nanobind Endpoint taking torch tensors —
+p2p/engine_api.cc:448 `transfer` over tensor descriptor lists, examples/
+ddp_train.py). This bridge gives torch users the same entry points against the
+TPU framework's engine: zero-copy registration of CPU tensors, one-sided
+transfer, and a DDP hook that averages `model.parameters()` gradients across
+processes via :class:`~uccl_tpu.collective.hierarchical.DcnGroup`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+from uccl_tpu.utils.logging import get_logger
+
+_log = get_logger("P2P")
+
+
+def tensor_buffer(t) -> np.ndarray:
+    """Zero-copy numpy view of a contiguous CPU torch tensor.
+
+    Dtypes numpy can't express (bfloat16, fp8, …) are reinterpreted as
+    same-width integers — transfers move bytes, so the view is faithful."""
+    import torch
+
+    if t.device.type != "cpu":
+        raise ValueError("engine transfers operate on CPU tensors (stage first)")
+    if not t.is_contiguous():
+        raise ValueError("tensor must be contiguous")
+    t = t.detach()
+    try:
+        return t.numpy()
+    except TypeError:
+        widths = {1: torch.uint8, 2: torch.int16, 4: torch.int32, 8: torch.int64}
+        return t.view(widths[t.element_size()]).numpy()
+
+
+def register_tensor(ep, t) -> int:
+    """Register a torch tensor's memory with an Endpoint; returns mr id."""
+    return ep.reg(tensor_buffer(t))
+
+
+def send_tensor(ep_or_chan, conn_or_none, t, fifo: bytes) -> None:
+    """One-sided write of a torch tensor into a peer's advertised window.
+
+    Accepts either (Endpoint, conn_id) or (Channel, None).
+    """
+    buf = tensor_buffer(t)
+    if conn_or_none is None:
+        ep_or_chan.write(buf, fifo)
+    else:
+        ep_or_chan.write(conn_or_none, buf, fifo)
+
+
+def advertise_tensor(ep, t) -> bytes:
+    """Register + advertise a torch tensor in one step; returns the 64-byte
+    FifoItem to hand to the writer. One-sided writes then land in the tensor
+    in place — there is no separate receive call."""
+    return ep.advertise(register_tensor(ep, t))
+
+
+def allreduce_gradients(parameters: Iterable, dcn_group) -> None:
+    """Average gradients of torch parameters across the DCN group in place.
+
+    The DDP contract over this framework's wire: flatten all grads into one
+    bucket (like DDP's gradient bucketing), ring-allreduce it across
+    processes through the transfer engine, unflatten, divide by world.
+    """
+    import torch
+
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return
+    flats = [p.grad.detach().reshape(-1) for p in params]
+    # Reduce in float32: bf16 has no numpy dtype, and summing lower-precision
+    # grads in f32 is what DDP does anyway. Cast back per-param on copy_.
+    bucket = torch.cat(flats).float().contiguous()
+    reduced = dcn_group.all_reduce(bucket.numpy())
+    reduced = torch.from_numpy(reduced) / dcn_group.world
+    off = 0
+    for p in params:
+        n = p.grad.numel()
+        p.grad.copy_(reduced[off : off + n].reshape(p.grad.shape).to(p.grad.dtype))
+        off += n

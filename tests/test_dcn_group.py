@@ -61,6 +61,16 @@ def test_all_gather(rng):
             np.testing.assert_array_equal(out[i], xs[i])
 
 
+@pytest.mark.parametrize("world", [2, 3])
+def test_all_to_all(world, rng):
+    xs = [rng.standard_normal((world, 5)).astype(np.float32) for _ in range(world)]
+    outs = _run_group(world, lambda g, r: g.all_to_all(xs[r]))
+    for i, out in enumerate(outs):
+        for j in range(world):
+            # out[j] on rank i == rank j's row i
+            np.testing.assert_array_equal(out[j], xs[j][i])
+
+
 def test_world_one_degenerate(rng):
     x = rng.standard_normal(10).astype(np.float32)
     outs = _run_group(1, lambda g, r: g.all_reduce(x))

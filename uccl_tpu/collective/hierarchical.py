@@ -179,6 +179,22 @@ class DcnGroup:
             # buffer next hop while cur is simultaneously being sent
         return out
 
+    def all_to_all(self, x: np.ndarray) -> np.ndarray:
+        """x: [world, ...] — row j goes to rank j; out[i] = rank i's row for us.
+
+        This is the cross-pod EP exchange primitive (the DCN leg of a
+        pod-spanning dispatch/combine — reference EP spans hosts the same
+        way, through its CPU proxies). Current schedule: ring all-gather of
+        the full buffer + local column select — correct at any world size;
+        a direct pairwise schedule (n× less traffic) is a planned
+        optimization for large pod counts.
+        """
+        n = self.world
+        if x.shape[0] != n:
+            raise ValueError(f"all_to_all needs leading dim {n}, got {x.shape}")
+        gathered = self.all_gather(x)  # [n, n, ...]
+        return np.ascontiguousarray(gathered[:, self.rank])
+
     def barrier(self):
         self.all_reduce(np.zeros(1, np.float32))
 

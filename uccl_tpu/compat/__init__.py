@@ -1,0 +1,5 @@
+"""Compatibility façades for users arriving from the reference's ecosystems."""
+
+from uccl_tpu.compat import dist
+
+__all__ = ["dist"]

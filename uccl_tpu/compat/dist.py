@@ -1,0 +1,114 @@
+"""torch.distributed-shaped process-group API over the DCN engine.
+
+The reference plugs under ``torch.distributed`` (NCCL plugin) so its users
+write ``dist.init_process_group / all_reduce / all_gather / barrier``. This
+module keeps those verbs for host arrays across processes — backed by the
+rendezvous store + DcnGroup ring — so reference-style launch scripts port
+with a changed import. Device-side (on-mesh) collectives live in
+``uccl_tpu.collective.Communicator``; this is the host/process-group face.
+
+Ops mutate in place like torch.distributed: ``all_reduce(x)`` leaves the
+global sum in ``x``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from uccl_tpu.collective.hierarchical import DcnGroup
+from uccl_tpu.p2p.store import StoreClient, StoreServer
+from uccl_tpu.parallel.distributed import Session
+from uccl_tpu.utils.logging import get_logger
+
+_log = get_logger("COLL")
+
+_group: Optional[DcnGroup] = None
+_session: Optional[Session] = None
+
+
+def init_process_group(
+    rank: int,
+    world_size: int,
+    *,
+    master_addr: str = "127.0.0.1",
+    master_port: int = 29500,
+    n_paths: int = 2,
+) -> None:
+    """Bring up the default process group (rank 0 hosts the store)."""
+    global _group, _session
+    if _group is not None:
+        raise RuntimeError("process group already initialized")
+    try:
+        server = StoreServer(master_port) if rank == 0 else None
+        client = StoreClient(master_addr, master_port, connect_timeout_s=30.0)
+        _session = Session(rank=rank, world=world_size, store=client, _server=server)
+        _group = DcnGroup(_session, n_paths=n_paths, tag="default_pg")
+    except Exception:
+        destroy_process_group()  # release partial state so retry can succeed
+        raise
+    _log.info("process group up: rank %d/%d", rank, world_size)
+
+
+def is_initialized() -> bool:
+    return _group is not None
+
+
+def _require() -> DcnGroup:
+    if _group is None:
+        raise RuntimeError("call init_process_group first")
+    return _group
+
+
+def get_rank() -> int:
+    return _require().rank
+
+
+def get_world_size() -> int:
+    return _require().world
+
+
+def all_reduce(x: np.ndarray) -> None:
+    """In-place sum across the group (torch.distributed semantics)."""
+    g = _require()
+    x[...] = g.all_reduce(x)
+
+
+def all_gather(out_list: List[np.ndarray], x: np.ndarray) -> None:
+    """Fill out_list[i] with rank i's x."""
+    g = _require()
+    gathered = g.all_gather(x)
+    for i in range(g.world):
+        out_list[i][...] = gathered[i]
+
+
+def all_to_all(out: np.ndarray, x: np.ndarray) -> None:
+    """out[i] receives rank i's row for us; x[j] goes to rank j."""
+    g = _require()
+    out[...] = g.all_to_all(x)
+
+
+def broadcast(x: np.ndarray, src: int = 0) -> None:
+    """In-place: every rank ends with src's x.
+
+    NB: currently rides the gather path (world× the optimal traffic) — a
+    direct src-rooted ring forward is a planned optimization; fine for the
+    control-plane payloads this API targets."""
+    g = _require()
+    gathered = g.all_gather(x)
+    x[...] = gathered[src]
+
+
+def barrier() -> None:
+    _require().barrier()
+
+
+def destroy_process_group() -> None:
+    global _group, _session
+    if _group is not None:
+        _group.close()
+        _group = None
+    if _session is not None:
+        _session.close()  # closes store client and (on rank 0) the server
+        _session = None

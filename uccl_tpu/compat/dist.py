@@ -18,7 +18,6 @@ from typing import List, Optional
 import numpy as np
 
 from uccl_tpu.collective.hierarchical import DcnGroup
-from uccl_tpu.p2p.store import StoreClient, StoreServer
 from uccl_tpu.parallel.distributed import Session
 from uccl_tpu.utils.logging import get_logger
 
@@ -40,10 +39,18 @@ def init_process_group(
     global _group, _session
     if _group is not None:
         raise RuntimeError("process group already initialized")
+    from uccl_tpu.parallel.distributed import initialize
+
     try:
-        server = StoreServer(master_port) if rank == 0 else None
-        client = StoreClient(master_addr, master_port, connect_timeout_s=30.0)
-        _session = Session(rank=rank, world=world_size, store=client, _server=server)
+        # Reuse the session bootstrap (rank 0 serves the store at master_port
+        # and connects to itself via loopback; failures close the server).
+        _session = initialize(
+            f"{master_addr}:{master_port}",
+            rank,
+            world_size,
+            store_port=master_port,
+            init_jax=False,
+        )
         _group = DcnGroup(_session, n_paths=n_paths, tag="default_pg")
     except Exception:
         destroy_process_group()  # release partial state so retry can succeed
@@ -78,6 +85,10 @@ def all_reduce(x: np.ndarray) -> None:
 def all_gather(out_list: List[np.ndarray], x: np.ndarray) -> None:
     """Fill out_list[i] with rank i's x."""
     g = _require()
+    if len(out_list) != g.world:
+        raise ValueError(
+            f"out_list has {len(out_list)} entries; world size is {g.world}"
+        )
     gathered = g.all_gather(x)
     for i in range(g.world):
         out_list[i][...] = gathered[i]

@@ -59,10 +59,15 @@ def initialize(
         )
     server = None
     sport = store_port or int(port_s) + 1
-    if rank == 0:
-        server = StoreServer(sport)
-        sport = server.port
-    client = StoreClient(ip if rank != 0 else "127.0.0.1", sport)
+    try:
+        if rank == 0:
+            server = StoreServer(sport)
+            sport = server.port
+        client = StoreClient(ip if rank != 0 else "127.0.0.1", sport)
+    except Exception:
+        if server is not None:
+            server.close()  # don't leak the bound port on a failed bootstrap
+        raise
     sess = Session(rank=rank, world=world, store=client, _server=server)
     _log.info("session up: rank %d/%d store %s:%d", rank, world, ip, sport)
     return sess

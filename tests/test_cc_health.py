@@ -93,9 +93,13 @@ class TestPacing:
             probe_buf = np.zeros(1, np.uint8)
             probe_fifo = server.advertise(server.reg(probe_buf))
             rc = RateController(client, TimelyCC(rate=50e6), update_every=1)
-            for _ in range(5):
+            rtts = []
+            for _ in range(20):
                 rtt = rc.probe(conn, probe_fifo)
                 assert rtt > 0
+                rtts.append(rtt)
+            if max(rtts) >= rc.algo.t_low_us:
+                pytest.skip("loopback RTT above t_low; host too loaded to assert")
             # loopback probe RTTs are tens of µs (< t_low) -> rate must grow
             assert rc.algo.rate > 50e6
             client.set_rate_limit(0)

@@ -51,6 +51,42 @@ class TestRouting:
         total = np.asarray(r.combine_weights.sum(axis=(1, 2)))
         np.testing.assert_allclose(total, 1.0, atol=1e-5)
 
+    @pytest.mark.parametrize("capacity", [1, 2, 5, 64])
+    def test_sorted_matches_dense_masks(self, rng, capacity):
+        """sorted_from_topk assigns the exact same (expert, slot) per
+        assignment — including which over-capacity assignments drop — as
+        masks_from_topk."""
+        k = 3
+        idx = jnp.asarray(rng.integers(0, E, (T, k)).astype(np.int32))
+        wts = jnp.asarray(rng.random((T, k)).astype(np.float32))
+        disp, comb, counts = ep_ops.masks_from_topk(idx, wts, E, capacity)
+        token_for_slot, slot, kept = ep_ops.sorted_from_topk(idx, E, capacity)
+        np.testing.assert_array_equal(np.asarray(kept), np.asarray(counts))
+        slot_np = np.asarray(slot)
+        disp_np = np.asarray(disp)
+        for t in range(T):
+            for j in range(k):
+                s = slot_np[t, j]
+                if s == E * capacity:  # dropped; aggregate check below
+                    continue
+                e_s, c_s = divmod(int(s), capacity)
+                assert e_s == int(idx[t, j])
+                assert disp_np[t, e_s, c_s]
+                assert int(np.asarray(token_for_slot)[s]) == t
+        # aggregate: every dense slot is claimed by exactly one assignment
+        n_dense = int(disp_np.sum())
+        n_sorted = int((slot_np < E * capacity).sum())
+        assert n_dense == n_sorted
+
+    def test_route_topk_sorted_losses_match_dense(self, rng):
+        logits = jnp.asarray(rng.standard_normal((T, E)).astype(np.float32))
+        r = ep_ops.route_topk(logits, 2, capacity=4)
+        rs = ep_ops.route_topk_sorted(logits, 2, capacity=4)
+        np.testing.assert_allclose(
+            float(rs.aux_loss), float(r.aux_loss), rtol=1e-6
+        )
+        np.testing.assert_allclose(float(rs.z_loss), float(r.z_loss), rtol=1e-6)
+
 
 class TestDispatchCombine:
     def _oracle_moe(self, x, idx, wts, wg, wu, wd):
@@ -65,7 +101,8 @@ class TestDispatchCombine:
                 out[t] += wts[t, kk] * (act @ wd[e])
         return out
 
-    def test_moe_ffn_matches_dense_oracle(self, ep_mesh, rng):
+    @pytest.mark.parametrize("impl", ["sort", "dense"])
+    def test_moe_ffn_matches_dense_oracle(self, ep_mesh, rng, impl):
         """High capacity => no drops => exact match with dense computation."""
         F = 16
         e_local = E // W
@@ -79,6 +116,7 @@ class TestDispatchCombine:
             out, aux, z = ep_ops.moe_ffn(
                 xv[0], lg[0], g[0], u[0], d[0], ("dp", "cp"),
                 num_selected=2, capacity_factor=float(E) / 2 * 2,  # no drops
+                impl=impl,
             )
             return out[None]
 
@@ -98,6 +136,66 @@ class TestDispatchCombine:
                 x[w_i], np.asarray(ti)[w_i], np.asarray(tv)[w_i], wg, wu, wd
             )
             np.testing.assert_allclose(np.asarray(out)[w_i], want, rtol=5e-4, atol=5e-5)
+
+
+class TestSortedEquivalence:
+    """The sorted (ragged) impl is exactly the dense impl at ANY capacity —
+    same outputs, same drops, same gradients."""
+
+    def _run_moe(self, ep_mesh, rng, impl, capacity_factor, with_grad=False):
+        F = 16
+        e_local = E // W
+        x = rng.standard_normal((W, T, H)).astype(np.float32)
+        logits = rng.standard_normal((W, T, E)).astype(np.float32)
+        wg = (rng.standard_normal((W, e_local, H, F)) * 0.1).astype(np.float32)
+        wu = (rng.standard_normal((W, e_local, H, F)) * 0.1).astype(np.float32)
+        wd = (rng.standard_normal((W, e_local, F, H)) * 0.1).astype(np.float32)
+
+        def f(xv, lg, g, u, d):
+            out, aux, z = ep_ops.moe_ffn(
+                xv[0], lg[0], g[0], u[0], d[0], ("dp", "cp"),
+                num_selected=2, capacity_factor=capacity_factor, impl=impl,
+            )
+            return out[None], (aux + z)[None]
+
+        if not with_grad:
+            return _shard_run(
+                ep_mesh, f, (x, logits, wg, wu, wd), (2, 2, 3, 3, 3), (2, 0)
+            )
+
+        def loss(args):
+            out, auxz = _shard_run(
+                ep_mesh, f, args, (2, 2, 3, 3, 3), (2, 0)
+            )
+            return jnp.sum(out * out) + jnp.sum(auxz)
+
+        return jax.grad(lambda a: loss(a))((x, logits, wg, wu, wd))
+
+    @pytest.mark.parametrize("capacity_factor", [0.5, 1.0, 8.0])
+    def test_sort_equals_dense_any_capacity(self, ep_mesh, capacity_factor):
+        rng1 = np.random.default_rng(7)
+        rng2 = np.random.default_rng(7)
+        out_s, aux_s = self._run_moe(ep_mesh, rng1, "sort", capacity_factor)
+        out_d, aux_d = self._run_moe(ep_mesh, rng2, "dense", capacity_factor)
+        np.testing.assert_allclose(
+            np.asarray(out_s), np.asarray(out_d), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(aux_s), np.asarray(aux_d), rtol=1e-6
+        )
+
+    def test_sort_grads_equal_dense(self, ep_mesh):
+        """Tight capacity (drops happen) — gradients agree too."""
+        g_s = self._run_moe(
+            ep_mesh, np.random.default_rng(3), "sort", 0.75, with_grad=True
+        )
+        g_d = self._run_moe(
+            ep_mesh, np.random.default_rng(3), "dense", 0.75, with_grad=True
+        )
+        for a, b in zip(jax.tree.leaves(g_s), jax.tree.leaves(g_d)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+            )
 
 
 class TestBuffer:

@@ -14,6 +14,21 @@ ICI and keep the expert GEMMs on the MXU:
   the owning EP member (= Buffer.dispatch).
 * :func:`combine`      — weighted return path (= Buffer.combine).
 
+Two implementations of the same contract:
+
+* **dense** (``dispatch``/``combine``): one-hot ``[T,E,C]`` mask einsums —
+  simple, always correct, kept as the oracle. Cost O(T·E·C·H) FLOPs.
+* **sorted** (``dispatch_sorted``/``combine_sorted``): the fast path — a
+  k-major stable argsort by expert id assigns capacity slots, dispatch is one
+  [E·C, H]-row gather and combine a [T,K]-row gather, so cost is O(T·K·H)
+  data movement with no mask tensor at all. This is the TPU re-design of the
+  reference's ragged message packing (ep/src/internode_ll.cu:62 packs per-
+  expert token messages; ep/src/layout.cu computes the layout): the argsort
+  plays the role of the layout kernel, the gathers the role of the pack/unpack
+  copies. Drop priority is identical to the dense path (earlier k-slots fill
+  expert queues first, then token order), so the two paths agree exactly —
+  including which tokens drop — at any capacity.
+
 Token layout convention: ``E`` global experts, EP world ``W``, ``E_local=E/W``
 experts per member, per-member capacity ``C`` tokens per expert per source
 member. Dropped tokens (over capacity) contribute zero, matching
@@ -44,18 +59,11 @@ class Routing(NamedTuple):
     # demand is counts_raw; kept counts reflect drops)
 
 
-def route_topk(
-    router_logits: jax.Array,
-    num_selected: int,
-    capacity: int,
-    *,
-    renormalize: bool = True,
-) -> Routing:
-    """Top-k gating with per-expert capacity and in-expert position assignment.
-
-    router_logits: [T, E]. Returns masks/weights of shape [T, E, C].
-    """
-    t, e = router_logits.shape
+def _gate_topk(router_logits, num_selected: int, renormalize: bool):
+    """Shared gating math for both routing impls: softmax gates, z-loss,
+    (renormalized) top-k selection, GShard load-balance loss.
+    Returns (topk_vals [T,K], topk_idx [T,K], aux_loss, z_loss)."""
+    e = router_logits.shape[-1]
     logits32 = router_logits.astype(jnp.float32)
     gates = jax.nn.softmax(logits32, axis=-1)  # [T, E]
     # z-loss stabilizes router logits; load-balance loss follows GShard.
@@ -68,16 +76,32 @@ def route_topk(
             jnp.sum(topk_vals, axis=-1, keepdims=True), 1e-9
         )
 
-    dispatch, combine, counts_running = masks_from_topk(
-        topk_idx, topk_vals, e, capacity
-    )
-
     # GShard load-balance loss: E * mean(fraction routed) . mean(gate prob)
     me = jnp.mean(gates, axis=0)  # [E]
     raw_onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [T, K, E]
     ce = jnp.mean(jnp.sum(raw_onehot, axis=1), axis=0)  # [E] fraction demand
     aux_loss = jnp.sum(me * ce) * (e / num_selected)
+    return topk_vals, topk_idx, aux_loss, z_loss
 
+
+def route_topk(
+    router_logits: jax.Array,
+    num_selected: int,
+    capacity: int,
+    *,
+    renormalize: bool = True,
+) -> Routing:
+    """Top-k gating with per-expert capacity and in-expert position assignment.
+
+    router_logits: [T, E]. Returns masks/weights of shape [T, E, C].
+    """
+    e = router_logits.shape[-1]
+    topk_vals, topk_idx, aux_loss, z_loss = _gate_topk(
+        router_logits, num_selected, renormalize
+    )
+    dispatch, combine, counts_running = masks_from_topk(
+        topk_idx, topk_vals, e, capacity
+    )
     return Routing(dispatch, combine, aux_loss, z_loss, counts_running)
 
 
@@ -106,6 +130,123 @@ def masks_from_topk(
         combine = combine + d_j.astype(jnp.float32) * wts[:, j][:, None, None]
         counts = counts + jnp.sum(keep.astype(jnp.int32), axis=0)
     return dispatch, combine, counts
+
+
+class SortedRouting(NamedTuple):
+    """Routing decision in sorted/ragged form (no [T,E,C] mask tensor)."""
+
+    token_for_slot: jax.Array  # [E*C] int32 source token per slot (T = empty)
+    slot: jax.Array  # [T, K] int32 slot per assignment (E*C = dropped)
+    weights: jax.Array  # [T, K] f32 gate weights (renormalized)
+    aux_loss: jax.Array  # load-balance loss (scalar)
+    z_loss: jax.Array  # router z-loss (scalar)
+    counts: jax.Array  # [E] tokens kept per expert
+
+
+def sorted_from_topk(
+    idx: jax.Array, num_experts: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Slot assignment from explicit top-k expert ids via one stable argsort.
+
+    idx: [T, K]. Flattening is k-major so earlier k-slots fill expert queues
+    first (then token order) — byte-identical drop semantics to
+    :func:`masks_from_topk`. Returns (token_for_slot [E*C] with T as the
+    empty sentinel, slot [T, K] with E*C as the dropped sentinel,
+    kept counts [E]).
+    """
+    t, k = idx.shape
+    tk = t * k
+    flat_e = idx.T.reshape(tk)  # k-major
+    flat_t = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    sorted_t = flat_t[order]
+    counts = jnp.bincount(flat_e, length=num_experts)  # [E] raw demand
+    seg_start = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    pos = jnp.arange(tk, dtype=jnp.int32) - seg_start[sorted_e].astype(jnp.int32)
+    keep = pos < capacity
+    slot_sorted = jnp.where(
+        keep, sorted_e * capacity + pos, num_experts * capacity
+    ).astype(jnp.int32)
+    slot = (
+        jnp.zeros((tk,), jnp.int32).at[order].set(slot_sorted).reshape(k, t).T
+    )
+    # Inverse view: which sorted position feeds slot (e, p)?
+    slot_ids = jnp.arange(num_experts * capacity, dtype=jnp.int32)
+    e_of_slot = slot_ids // capacity
+    p_of_slot = slot_ids % capacity
+    j = seg_start[e_of_slot].astype(jnp.int32) + p_of_slot
+    kept = jnp.minimum(counts, capacity).astype(jnp.int32)
+    valid = p_of_slot < kept[e_of_slot]
+    token_for_slot = jnp.where(
+        valid, sorted_t[jnp.clip(j, 0, tk - 1)], t
+    ).astype(jnp.int32)
+    return token_for_slot, slot, kept
+
+
+def route_topk_sorted(
+    router_logits: jax.Array,
+    num_selected: int,
+    capacity: int,
+    *,
+    renormalize: bool = True,
+) -> SortedRouting:
+    """Top-k gating in sorted/ragged form — same math and losses as
+    :func:`route_topk`, without materializing [T,E,C] masks."""
+    e = router_logits.shape[-1]
+    topk_vals, topk_idx, aux_loss, z_loss = _gate_topk(
+        router_logits, num_selected, renormalize
+    )
+    token_for_slot, slot, kept = sorted_from_topk(topk_idx, e, capacity)
+    return SortedRouting(token_for_slot, slot, topk_vals, aux_loss, z_loss, kept)
+
+
+def dispatch_sorted(
+    x: jax.Array,
+    token_for_slot: jax.Array,
+    num_experts: int,
+    capacity: int,
+    axis: Axis,
+    *,
+    wire_fp8: bool = False,
+    quant_group: int = 128,
+) -> jax.Array:
+    """Ragged dispatch: one gather packs [E*C, H] slot payloads, then the same
+    member-major all-to-all as the dense path. Empty slots (sentinel index T,
+    out of bounds) gather as zeros. Returns [E_local, W*C, H]."""
+    w = lax.axis_size(axis)
+    if num_experts % w:
+        raise ValueError(f"experts {num_experts} not divisible by EP world {w}")
+    e_local = num_experts // w
+    h = x.shape[-1]
+    buf = jnp.take(x, token_for_slot, axis=0, mode="fill", fill_value=0)
+    buf = buf.reshape(w, e_local, capacity, h)
+    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype)
+    return buf.transpose(1, 0, 2, 3).reshape(e_local, w * capacity, h)
+
+
+def combine_sorted(
+    expert_out: jax.Array,
+    slot: jax.Array,
+    weights: jax.Array,
+    axis: Axis,
+    *,
+    wire_fp8: bool = False,
+    quant_group: int = 128,
+) -> jax.Array:
+    """Ragged combine: all-to-all the expert outputs home, then one [T, K]-row
+    gather + weighted sum. Dropped assignments (sentinel slot E*C, out of
+    bounds) gather as zeros. expert_out: [E_local, W*C, H] → [T, H]."""
+    w = lax.axis_size(axis)
+    e_local, wc, h = expert_out.shape
+    c = wc // w
+    buf = expert_out.reshape(e_local, w, c, h).transpose(1, 0, 2, 3)
+    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, expert_out.dtype)
+    y = buf.reshape(w * e_local * c, h)  # [E*C, H], expert-major
+    yk = jnp.take(y, slot, axis=0, mode="fill", fill_value=0)  # [T, K, H]
+    return jnp.einsum("tk,tkh->th", weights.astype(yk.dtype), yk)
 
 
 def dispatch(
@@ -183,22 +324,37 @@ def moe_ffn(
     num_selected: int = 2,
     capacity_factor: float = 1.25,
     wire_fp8: bool = False,
+    impl: str = "sort",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full per-shard MoE layer: route → dispatch → SwiGLU experts → combine.
 
     x: [T, H]; router_logits: [T, E]; expert weights are the *local* shard:
     w_gate/w_up: [E_local, H, F], w_down: [E_local, F, H].
+    impl: "sort" (ragged fast path, default) or "dense" (mask-einsum oracle).
     Returns (out [T, H], aux_loss, z_loss).
     """
     t, h = x.shape
     e = router_logits.shape[-1]
     w = lax.axis_size(axis)
     capacity = max(1, int(capacity_factor * t * num_selected / e))
-    r = route_topk(router_logits, num_selected, capacity)
-    xe = dispatch(x, r.dispatch_mask, axis, wire_fp8=wire_fp8)  # [E_l, W*C, H]
+    if impl == "sort":
+        rs = route_topk_sorted(router_logits, num_selected, capacity)
+        xe = dispatch_sorted(
+            x, rs.token_for_slot, e, capacity, axis, wire_fp8=wire_fp8
+        )
+        aux_loss, z_loss = rs.aux_loss, rs.z_loss
+    elif impl == "dense":
+        r = route_topk(router_logits, num_selected, capacity)
+        xe = dispatch(x, r.dispatch_mask, axis, wire_fp8=wire_fp8)
+        aux_loss, z_loss = r.aux_loss, r.z_loss
+    else:
+        raise ValueError(f"unknown moe impl {impl!r} (want 'sort' or 'dense')")
     act = jax.nn.silu(jnp.einsum("ebh,ehf->ebf", xe, w_gate)) * jnp.einsum(
         "ebh,ehf->ebf", xe, w_up
     )
     ye = jnp.einsum("ebf,efh->ebh", act, w_down)
-    out = combine(ye, r.combine_weights, axis, wire_fp8=wire_fp8)
-    return out.astype(x.dtype), r.aux_loss, r.z_loss
+    if impl == "sort":
+        out = combine_sorted(ye, rs.slot, rs.weights, axis, wire_fp8=wire_fp8)
+    else:
+        out = combine(ye, r.combine_weights, axis, wire_fp8=wire_fp8)
+    return out.astype(x.dtype), aux_loss, z_loss

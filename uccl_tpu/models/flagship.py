@@ -58,6 +58,7 @@ class FlagshipConfig:
     n_microbatches: int = 1
     seq_mode: str = "ring"  # "ring" | "ulysses"
     attn_impl: str = "auto"  # "auto" | "flash" | "xla": kernel when cp == 1
+    moe_impl: str = "sort"  # "sort" (ragged fast path) | "dense" (mask oracle)
     wire_fp8: bool = False
     dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
 
@@ -196,6 +197,7 @@ def _layer(x, lp, cfg: FlagshipConfig):
         num_selected=cfg.moe_topk,
         capacity_factor=cfg.capacity_factor,
         wire_fp8=cfg.wire_fp8,
+        impl=cfg.moe_impl,
     )
     x = x + lax.psum(moe_out.reshape(b, s_loc, h), AXIS.TP)
     aux_scalar = cfg.aux_loss_weight * aux + cfg.z_loss_weight * z

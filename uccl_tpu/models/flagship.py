@@ -366,52 +366,3 @@ def reference_forward(params, tokens, cfg: FlagshipConfig):
         x, _ = one_layer(x, lp)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return x.astype(jnp.float32) @ params["head"]
-
-
-def reference_dense_loss(params, tokens, targets, cfg: FlagshipConfig):
-    """Naive dense-MoE baseline: every expert computes every token, outputs
-    weighted by the (renormalized) top-k gates. This is the no-dispatch-layer
-    implementation a user would write without an EP engine — the benchmark
-    baseline in bench.py."""
-    b, s = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
-
-    def one_layer(x, lp):
-        h = rms_norm(x, lp["ln1"], cfg.norm_eps)
-        d = cfg.head_dim
-        q = (h @ lp["wq"].astype(h.dtype)).reshape(b, s, cfg.n_heads, d)
-        kk = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
-        v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
-        pos = jnp.arange(s)
-        q, kk = rope(q, pos, cfg.rope_theta), rope(kk, pos, cfg.rope_theta)
-        attn = attention_reference(q, kk, v, causal=True)
-        x = x + attn.reshape(b, s, -1) @ lp["wo"].astype(attn.dtype)
-
-        h2 = rms_norm(x, lp["ln2"], cfg.norm_eps)
-        flat = h2.reshape(b * s, cfg.dim)
-        rl = flat.astype(jnp.float32) @ lp["router"]
-        gates = jax.nn.softmax(rl, axis=-1)
-        tv, ti = lax.top_k(gates, cfg.moe_topk)
-        tv = tv / jnp.maximum(tv.sum(-1, keepdims=True), 1e-9)
-        weights = (
-            jnp.zeros_like(gates)
-            .at[jnp.arange(gates.shape[0])[:, None], ti]
-            .set(tv)
-        )  # [T, E]
-        # dense: every expert computes every token
-        act = jax.nn.silu(
-            jnp.einsum("th,ehf->etf", flat, lp["we_gate"].astype(flat.dtype))
-        ) * jnp.einsum("th,ehf->etf", flat, lp["we_up"].astype(flat.dtype))
-        ye = jnp.einsum("etf,efh->eth", act, lp["we_down"].astype(act.dtype))
-        moe = jnp.einsum("te,eth->th", weights.astype(ye.dtype), ye)
-        x = x + moe.reshape(b, s, cfg.dim)
-        return x
-
-    for i in range(cfg.n_layers):
-        lp = jax.tree.map(lambda a: a[i], params["blocks"])
-        x = one_layer(x, lp)
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x.astype(jnp.float32) @ params["head"]
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - tgt)

@@ -195,6 +195,9 @@ def main():
     targets = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
 
     attn_impl = os.environ.get("UCCL_TPU_BENCH_ATTN", "auto")
+    if attn_impl == "auto":
+        # resolve before reporting so the JSON names the impl actually run
+        attn_impl = "flash" if platform == "tpu" else "xla"
     flash_failed = None
     try:
         tps, dt, cfg = _measure(

@@ -131,14 +131,6 @@ def shard_params(params, mesh: Mesh, cfg: FlagshipConfig):
 # Per-shard forward (inside shard_map)
 
 
-def _pick_block(s: int) -> int:
-    """Largest power-of-two block <= 128 dividing s (1 if s is odd)."""
-    b = 128
-    while b > 1 and s % b:
-        b //= 2
-    return b
-
-
 def _attention(x, lp, cfg: FlagshipConfig):
     """x: [B, S_loc, H_model] -> [B, S_loc, H_model] (pre-psum over tp)."""
     b, s_loc, _ = x.shape
@@ -152,16 +144,17 @@ def _attention(x, lp, cfg: FlagshipConfig):
     positions = cp_idx * s_loc + jnp.arange(s_loc)
     q = rope(q, positions, cfg.rope_theta)
     kk = rope(kk, positions, cfg.rope_theta)
-    attn = None
+    from uccl_tpu.ops.attention import _auto_block
+    from uccl_tpu.ops.pallas_attention import _is_tpu, flash_attention
+
+    use_flash = cfg.attn_impl == "flash" or (
+        cfg.attn_impl == "auto" and _is_tpu()
+    )
+    impl = "flash" if use_flash else "xla"
     if lax.axis_size(AXIS.CP) == 1:
         # No context parallelism: the single-shard Pallas flash kernel is the
         # fast path on TPU (MXU blockwise online softmax in VMEM).
-        from uccl_tpu.ops.pallas_attention import _is_tpu, flash_attention
-
-        use_flash = cfg.attn_impl == "flash" or (
-            cfg.attn_impl == "auto" and _is_tpu()
-        )
-        blk = _pick_block(s_loc)
+        blk = _auto_block(s_loc)
         if use_flash and blk >= 8:
             attn = flash_attention(q, kk, v, True, blk, blk)
         elif cfg.attn_impl == "flash":
@@ -169,11 +162,14 @@ def _attention(x, lp, cfg: FlagshipConfig):
                 f"attn_impl='flash' requested but local seq {s_loc} has no "
                 f"usable block size (largest power-of-two divisor {blk} < 8)"
             )
-    if attn is None:
-        if cfg.seq_mode == "ulysses":
-            attn = ulysses_attention(q, kk, v, AXIS.CP, causal=True)
         else:
             attn = ring_attention(q, kk, v, AXIS.CP, causal=True)
+    elif cfg.seq_mode == "ulysses":
+        # Flash feasibility is ulysses's own call: it attends over the
+        # all-to-all-gathered full sequence, not the local shard.
+        attn = ulysses_attention(q, kk, v, AXIS.CP, causal=True, impl=impl)
+    else:
+        attn = ring_attention(q, kk, v, AXIS.CP, causal=True, impl=impl)
     out = attn.reshape(b, s_loc, nh_loc * d) @ lp["wo"].astype(x.dtype)
     return out
 

@@ -90,6 +90,87 @@ def _block_attend(q, k, v, m, l, o, scale, mask):
     return m_new, l_new, o_new
 
 
+def _auto_block(s: int, cap: int = 128) -> int:
+    """Largest power-of-two block <= cap dividing s (1 if s is odd)."""
+    b = cap
+    while b > 1 and s % b:
+        b //= 2
+    return b
+
+
+def _merge_blocks(o_acc, lse_acc, o_blk, lse_blk):
+    """Merge two normalized blockwise attention results via their LSEs.
+
+    o: [B,Sq,H,D] f32 (each already softmax-normalized over its own keys);
+    lse: [B,H,Sq]. Fully-masked blocks carry lse=-1e30 and merge as no-ops.
+    """
+    m = jnp.maximum(lse_acc, lse_blk)
+    w_acc = jnp.exp(lse_acc - m)
+    w_blk = jnp.exp(lse_blk - m)
+    denom = w_acc + w_blk
+
+    def bcast(w):  # [B,H,Sq] -> [B,Sq,H,1]
+        return w.transpose(0, 2, 1)[..., None]
+
+    o = (o_acc * bcast(w_acc) + o_blk * bcast(w_blk)) / bcast(denom)
+    return o, m + jnp.log(denom)
+
+
+def _flash_ring(q, k, v, axis, causal, block_q, block_k, interpret):
+    """Ring attention with the Pallas flash kernel as the per-block compute.
+
+    Step 0 is every member's own (causal-diagonal) block — a static causal
+    flash call. Later steps are either fully visible (source chunk strictly
+    earlier) or fully masked; a lax.cond picks between a non-causal flash
+    call and a skip, so no per-element ring mask is ever built and the whole
+    schedule stays SPMD. Blocks merge through the differentiable LSE merge,
+    so training works end to end with no [S, S] materialization anywhere.
+    """
+    from uccl_tpu.ops.pallas_attention import flash_attention_lse
+
+    n = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    b, sq, h, d = q.shape
+    perm = ppermute_pairs(n, 1)
+
+    o0, lse0 = flash_attention_lse(q, k, v, causal, block_q, block_k, interpret)
+    o0 = o0.astype(jnp.float32)
+    if n == 1:
+        return o0.astype(q.dtype)
+
+    def step(carry, t):
+        k_blk, v_blk, o_acc, lse_acc = carry
+        src = (r - t) % n
+
+        def full(_):
+            ob, lb = flash_attention_lse(
+                q, k_blk, v_blk, False, block_q, block_k, interpret
+            )
+            return ob.astype(jnp.float32), lb
+
+        def skip(_):
+            return (
+                jnp.zeros((b, sq, h, d), jnp.float32),
+                jnp.full((b, h, sq), _NEG_INF, jnp.float32),
+            )
+
+        if causal:
+            o_blk, lse_blk = lax.cond(src < r, full, skip, None)
+        else:
+            o_blk, lse_blk = full(None)
+        o_acc, lse_acc = _merge_blocks(o_acc, lse_acc, o_blk, lse_blk)
+        k_nxt = lax.ppermute(k_blk, axis, perm)
+        v_nxt = lax.ppermute(v_blk, axis, perm)
+        return (k_nxt, v_nxt, o_acc, lse_acc), None
+
+    k1 = lax.ppermute(k, axis, perm)
+    v1 = lax.ppermute(v, axis, perm)
+    (_, _, o, _), _ = lax.scan(
+        step, (k1, v1, o0, lse0), jnp.arange(1, n)
+    )
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -97,6 +178,10 @@ def ring_attention(
     axis: str,
     *,
     causal: bool = True,
+    impl: str = "xla",
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Context-parallel attention over mesh axis ``axis`` (per-shard fn).
 
@@ -105,7 +190,16 @@ def ring_attention(
     r, r-1, r-2, ... — with causal masking, later-origin blocks contribute
     nothing and are masked entirely (the compute is uniform across members to
     stay SPMD; XLA overlaps the ppermute with the block compute).
+
+    impl="flash" runs each block through the Pallas flash kernel and merges
+    via LSEs (:func:`_flash_ring`); impl="xla" uses einsum block attends.
     """
+    if impl == "flash":
+        bq = block_q or _auto_block(q.shape[1])
+        bk = block_k or _auto_block(k.shape[1])
+        if min(bq, bk) >= 8:
+            return _flash_ring(q, k, v, axis, causal, bq, bk, interpret)
+        # fall through to the XLA path when blocks would be degenerate
     n = lax.axis_size(axis)
     r = lax.axis_index(axis)
     n_rep = q.shape[2] // k.shape[2]
@@ -149,6 +243,8 @@ def ulysses_attention(
     axis: str,
     *,
     causal: bool = True,
+    impl: str = "xla",
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Ulysses sequence parallelism (per-shard fn): all-to-all turns the
     sequence sharding into a head sharding, full-sequence attention runs on
@@ -174,5 +270,13 @@ def ulysses_attention(
         return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if impl == "flash":
+        from uccl_tpu.ops.pallas_attention import flash_attention
+
+        bq = _auto_block(qg.shape[1])
+        bk = _auto_block(kg.shape[1])
+        if min(bq, bk) >= 8:
+            out = flash_attention(qg, kg, vg, causal, bq, bk, interpret)
+            return heads_to_seq(out)
     out = attention_reference(qg, kg, vg, causal=causal)
     return heads_to_seq(out)

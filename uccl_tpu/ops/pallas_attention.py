@@ -1,24 +1,32 @@
-"""Pallas TPU flash attention (forward kernel + recompute backward).
+"""Pallas TPU flash attention: forward + backward kernels, LSE-exposing API.
 
 The hot attention op on the MXU: blockwise online-softmax attention computed in
 VMEM, one (batch×head, q-block) program at a time, streaming KV blocks. The
-causal variant skips fully-masked KV blocks (the fori_loop upper bound depends
-on the q-block index), so wasted FLOPs shrink from 2× to ~0 at long sequence.
+causal variant skips fully-masked KV blocks, so wasted FLOPs shrink from 2× to
+~0 at long sequence.
 
 This is the framework's analog of the reference's hand-written device kernels
 (the reference's compute-heavy paths are CUDA kernels, e.g.
 ep/src/internode_ll.cu; attention itself lives in the frameworks UCCL serves).
-Backward pass recomputes through the XLA reference implementation via
-``jax.custom_vjp`` — correct everywhere, with the forward on the fast path.
 
-Falls back to interpret mode automatically off-TPU so tests run anywhere.
+Three public entry points:
+
+* :func:`flash_attention` — drop-in attention, custom VJP backed by Pallas
+  dq and dk/dv kernels (FlashAttention-2-style recomputation from the saved
+  LSE — no [S, S] matrix is ever materialized, forward or backward).
+* :func:`flash_attention_lse` — same, returning ``(out, lse)``. The LSE
+  output is differentiable: its cotangent folds into the backward row term
+  (``dS = P∘(dP − (Δ − g_lse))``), which is exactly what blockwise/ring
+  merging needs to train through merged blocks.
+* The kernels fall back to interpret mode automatically off-TPU so every
+  test runs anywhere.
 """
 
 from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,15 +44,19 @@ def _is_tpu() -> bool:
         return False
 
 
+# ---------------------------------------------------------------------------
+# Forward kernel
+
+
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, scale, block_q, block_k, causal,
 ):
     """Grid (bh, iq, jk): one KV block per program, streamed through VMEM.
 
-    Ref shapes: q [1, BQ, D]; k/v [1, BK, D]; o [1, BQ, D]. Scratch
-    (m/l [BQ, 1], acc [BQ, D]) carries the online softmax across the jk
-    dimension — jk is innermost, so for a fixed (bh, iq) the programs run
+    Ref shapes: q [1, BQ, D]; k/v [1, BK, D]; o [1, BQ, D]; lse [1, BQ].
+    Scratch (m/l [BQ, 1], acc [BQ, D]) carries the online softmax across the
+    jk dimension — jk is innermost, so for a fixed (bh, iq) the programs run
     back-to-back and the scratch is private to that q block.
     """
     iq = pl.program_id(1)
@@ -91,18 +103,13 @@ def _fwd_kernel(
     def _finish():
         l = jnp.maximum(l_ref[:, 0], 1e-20)
         o_ref[0] = (acc_ref[:, :] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(l)
 
 
 def _flash_fwd(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    causal: bool,
-    block_q: int,
-    block_k: int,
-    interpret: Optional[bool],
-) -> jax.Array:
-    """q: [B, S, H, D]; k/v: [B, S, Hkv, D] -> [B, S, H, D]."""
+    q, k, v, causal, block_q, block_k, interpret
+) -> Tuple[jax.Array, jax.Array]:
+    """q: [B, S, H, D]; k/v: [B, Sk, Hkv, D] -> (out [B,S,H,D], lse [B,H,S])."""
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     if h % hkv:
@@ -126,9 +133,12 @@ def _flash_fwd(
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, causal=causal
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ),
         grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
@@ -136,7 +146,10 @@ def _flash_fwd(
             pl.BlockSpec((1, block_k, d), lambda bh, iq, jk: (bh // n_rep, jk, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, iq, jk: (bh // n_rep, jk, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, iq, jk: (bh, iq)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max m
             pltpu.VMEM((block_q, 1), jnp.float32),  # normalizer l
@@ -144,10 +157,239 @@ def _flash_fwd(
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return (
+        out.reshape(b, h, sq, d).transpose(0, 2, 1, 3),
+        lse.reshape(b, h, sq),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (FlashAttention-2 style: recompute P from saved LSE)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, scale, block_q, block_k, causal,
+):
+    """Grid (bh, iq, jk), jk innermost: accumulate dQ for one q block while
+    streaming KV blocks. delta = rowsum(dO∘O) − g_lse (the combined row term)."""
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+    last_q_pos = (iq + 1) * block_q - 1
+    relevant = (not causal) or (jk * block_k <= last_q_pos)
+
+    @pl.when(relevant)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # [BQ]
+        delta = delta_ref[0]  # [BQ]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = jk * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # masked scores underflow to 0
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * scale
+        acc_ref[:, :] += lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(jk == n_kv - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:, :].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale, block_q, block_k, causal,
+):
+    """Grid (bh, jk, iq), iq innermost: accumulate dK/dV for one KV block while
+    streaming q blocks (at full q-head resolution; GQA-reduced outside)."""
+    jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:, :] = jnp.zeros_like(dk_acc)
+        dv_acc[:, :] = jnp.zeros_like(dv_acc)
+
+    last_q_pos = (iq + 1) * block_q - 1
+    relevant = (not causal) or (jk * block_k <= last_q_pos)
+
+    @pl.when(relevant)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = jk * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [BQ, BK]
+        dv_acc[:, :] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[:, :] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(iq == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:, :].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:, :].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g_out, g_lse, causal, block_q, block_k,
+               interpret):
+    """Pallas backward: returns (dq, dk, dv) without materializing [S, S]."""
+    b, sq, h, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    n_rep = h // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if interpret is None:
+        interpret = not _is_tpu()
+    scale = 1.0 / math.sqrt(d)
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    dot = g_out.transpose(0, 2, 1, 3).reshape(b * h, sq, d).astype(q.dtype)
+    lse_t = lse.reshape(b * h, sq)
+    # Combined row term: Δ − g_lse. The g_lse fold-in makes the LSE output
+    # differentiable (dS = P∘(dP − (Δ − g_lse))), which ring merging needs.
+    delta = jnp.sum(
+        g_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1).reshape(b * h, sq)
+    if g_lse is not None:
+        delta = delta - g_lse.reshape(b * h, sq)
+
+    common = dict(scale=scale, block_q=block_q, block_k=block_k, causal=causal)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, jk: (bh // n_rep, jk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, jk: (bh // n_rep, jk, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, iq, jk: (bh, iq)),
+            pl.BlockSpec((1, block_q), lambda bh, iq, jk: (bh, iq)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_t, delta)
+
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+        ),
+        grid=(b * h, sk // block_k, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, jk, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, jk, iq: (bh // n_rep, jk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, jk, iq: (bh // n_rep, jk, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, jk, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, jk, iq: (bh, iq)),
+            pl.BlockSpec((1, block_q), lambda bh, jk, iq: (bh, iq)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda bh, jk, iq: (bh, jk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, jk, iq: (bh, jk, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_t, delta)
+
+    # GQA: fold the n_rep q-head contributions back onto each KV head.
+    dk = dk_full.reshape(b, hkv, n_rep, sk, d).sum(2).transpose(0, 2, 1, 3)
+    dv = dv_full.reshape(b, hkv, n_rep, sk, d).sum(2).transpose(0, 2, 1, 3)
+    return (
+        dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Public API
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Flash attention returning (out [B,S,H,D], lse [B,H,S]).
+
+    The lse output is differentiable, so callers may merge blocks (ring/
+    blockwise attention) and train straight through the merge.
+    """
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _lse_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    return _flash_bwd(
+        q, k, v, out, lse, g_out, g_lse, causal, block_q, block_k, interpret
+    )
+
+
+flash_attention_lse.defvjp(_lse_vjp_fwd, _lse_vjp_bwd)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -157,28 +399,8 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Flash attention. q: [B, S, H, D]; k/v: [B, S, Hkv, D] (GQA-aware)."""
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-
-
-def _ref_attention(q, k, v, causal):
-    # local import to avoid a cycle (attention.py may route here)
-    from uccl_tpu.ops.attention import attention_reference
-
-    return attention_reference(q, k, v, causal=causal)
-
-
-def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
-
-
-def _vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # Recompute-through-reference backward: one extra forward at XLA speed,
-    # exact gradients, zero extra residual memory from the kernel.
-    _, vjp = jax.vjp(lambda a, b, c: _ref_attention(a, b, c, causal), q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+    """Flash attention. q: [B, S, H, D]; k/v: [B, Sk, Hkv, D] (GQA-aware).
+    Forward and backward both run as Pallas kernels; no [S, S] tensor is
+    materialized in either direction."""
+    out, _ = flash_attention_lse(q, k, v, causal, block_q, block_k, interpret)
+    return out

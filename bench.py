@@ -205,10 +205,7 @@ def main():
             batch, seq, tokens, targets,
         )
     except Exception as e:
-        uses_flash = attn_impl == "flash" or (
-            attn_impl == "auto" and platform == "tpu"
-        )
-        if not uses_flash:
+        if attn_impl != "flash":
             raise  # nothing to fall back to — surface the real failure
         flash_failed = repr(e)
     if flash_failed is not None:

@@ -93,6 +93,11 @@ class Endpoint {
   bool advertise(uint64_t mr_id, size_t offset, size_t len, FifoItem* out);
 
   // --- one-sided ops (reference: read/write[v][_async], engine.h:308-344)
+  // Contract (as in the reference's registered-MR model): `src` must stay
+  // valid until the transfer reaches a terminal state — including after a
+  // wait() timeout, since the frame may still be queued behind a slow peer.
+  // The Python layer enforces this by holding the source array in its
+  // in-flight table until poll/wait observes completion or the conn dies.
   uint64_t write_async(uint64_t conn_id, const void* src, size_t len,
                        const FifoItem& item);
   uint64_t read_async(uint64_t conn_id, void* dst, size_t len,
@@ -128,12 +133,53 @@ class Endpoint {
   uint64_t bytes_rx() const { return bytes_rx_.load(); }
 
  private:
+  // One queued outbound frame with send progress. Frames per conn go out in
+  // order; progress lets a partially-sent frame resume after EAGAIN.
+  struct TxItem {
+    FrameHeader h{};
+    const void* src = nullptr;   // unowned payload (caller keeps alive)
+    std::vector<uint8_t> owned;  // or task-owned payload
+    // Payload bytes actually following the header on the wire. NOT always
+    // h.len: a kRead frame carries the *requested* length in h.len but no
+    // payload bytes at all.
+    size_t wire_len = 0;
+    uint64_t fail_xfer = 0;      // xfer to fail if the conn dies mid-send
+    size_t off = 0;              // bytes of (header+payload) already sent
+    const uint8_t* payload() const {
+      return owned.empty() ? static_cast<const uint8_t*>(src) : owned.data();
+    }
+    size_t total() const { return sizeof(FrameHeader) + wire_len; }
+  };
+
   struct Conn {
     int fd = -1;
     uint64_t id = 0;
-    int engine = 0;     // which engine serves this conn
-    std::mutex tx_mtx;  // serializes frame writes on this fd
+    int engine = 0;  // which engine serves this conn
+
+    // --- rx state machine (io thread only): a peer stalling mid-frame just
+    // leaves the state parked; the epoll loop never blocks on one conn.
+    enum class RxStage : uint8_t { kHdr, kBody };
+    RxStage rx_stage = RxStage::kHdr;
+    size_t rx_got = 0;             // bytes of current stage received
+    FrameHeader rx_hdr{};
+    uint8_t* rx_dst = nullptr;     // zero-copy window target (kWrite)
+    std::shared_ptr<std::atomic<int>> rx_pin;  // held while rx_dst in flight
+    std::vector<uint8_t> rx_buf;   // owned body (non-window ops / sink)
+    bool rx_ok = false;            // window resolved for current kWrite
+
+    // --- tx queue (tx thread drains; any thread appends)
+    std::mutex txq_mtx;
+    std::deque<TxItem> txq;
+    std::atomic<size_t> txq_bytes{0};  // queued wire bytes (backpressure)
+    // Set on any fatal condition; ONLY the tx thread then clears the queue
+    // and fails its transfers (single-owner teardown — no cross-thread races
+    // on queue entries a send may be touching).
+    std::atomic<bool> dead{false};
+
     ~Conn() {
+      // Safety net: if the conn dies while a zero-copy receive is parked
+      // mid-frame, release the registration pin so dereg() can't hang.
+      if (rx_pin) rx_pin->fetch_sub(1, std::memory_order_acq_rel);
       if (fd >= 0) ::close(fd);
     }
   };
@@ -181,11 +227,30 @@ class Endpoint {
     std::mutex cv_mtx;
     std::thread io_thread;
     std::thread tx_thread;
+    // conns served by this engine. Holds strong refs so a conn removed from
+    // the public map still gets one final tx pass (fail_txq) before the tx
+    // thread prunes it — queued transfers fail fast instead of timing out.
+    std::mutex conns_mtx;
+    std::vector<std::shared_ptr<Conn>> conns;
   };
 
   void io_loop(int engine);  // epoll frame dispatch (recv proxy analog)
   void tx_loop(int engine);  // drains that engine's ring (send proxy analog)
-  bool send_frame(Conn* c, const FrameHeader& h, const void* payload);
+  // rx state machine step: drain whatever bytes are available without
+  // blocking; returns false when the conn died (caller removes it).
+  bool drain_rx(Conn* c);
+  void finish_rx_frame(Conn* c);
+  // append a frame to the conn's tx queue (applies drop injection) and wake
+  // the serving engine's tx thread.
+  void enqueue_frame(const std::shared_ptr<Conn>& c, const FrameHeader& h,
+                     const void* src, std::vector<uint8_t> owned,
+                     uint64_t fail_xfer);
+  // nonblocking send of queued frames; returns false when the conn died,
+  // sets *blocked when EAGAIN left data queued. tx thread only.
+  bool service_tx(Conn* c, bool* blocked);
+  // tx thread only: fail + drop every queued frame of a dead conn.
+  void fail_txq(Conn* c);
+  void conn_error(uint64_t conn_id);
   void handle_frame(Conn* c, const FrameHeader& h,
                     std::vector<uint8_t>& payload);
   std::shared_ptr<Conn> get_conn(uint64_t id);

@@ -9,14 +9,17 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
@@ -29,33 +32,14 @@ constexpr uint32_t kMagic = 0x7C71u;
 // Upper bound on a single frame payload — rejects absurd lengths from a buggy
 // or malicious peer before any allocation happens.
 constexpr uint64_t kMaxFrameLen = 1ull << 30;
+// Per-conn tx queue watermark: above this, two-sided send() blocks (caller
+// backpressure, like the old blocking send path) and read responses to a
+// non-draining requester are dropped (it times out; it wasn't reading).
+constexpr size_t kTxqHighWater = 64ull << 20;
 
-bool recv_all(int fd, void* buf, size_t len) {
-  uint8_t* p = static_cast<uint8_t*>(buf);
-  size_t got = 0;
-  while (got < len) {
-    ssize_t n = ::recv(fd, p + got, len - got, MSG_WAITALL);
-    if (n <= 0) {
-      if (n < 0 && (errno == EINTR)) continue;
-      return false;
-    }
-    got += static_cast<size_t>(n);
-  }
-  return true;
-}
-
-bool send_all(int fd, const void* buf, size_t len) {
-  const uint8_t* p = static_cast<const uint8_t*>(buf);
-  size_t sent = 0;
-  while (sent < len) {
-    ssize_t n = ::send(fd, p + sent, len - sent, MSG_NOSIGNAL);
-    if (n < 0) {
-      if (errno == EINTR) continue;
-      return false;
-    }
-    sent += static_cast<size_t>(n);
-  }
-  return true;
+void set_nonblocking(int fd) {
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  if (flags >= 0) ::fcntl(fd, F_SETFL, flags | O_NONBLOCK);
 }
 
 uint64_t random_token() {
@@ -104,6 +88,32 @@ Endpoint::Endpoint(uint16_t port, int n_engines) {
 }
 
 Endpoint::~Endpoint() {
+  // Flush: sends are queued asynchronously, so frames an application handed
+  // over just before close (e.g. a collective's final DONE control message)
+  // may still sit in conn tx queues. Let the tx threads drain them as long
+  // as progress is being made; a peer that stopped draining only costs the
+  // short no-progress cutoff.
+  auto queued = [this]() -> size_t {
+    size_t total = 0;
+    std::lock_guard<std::mutex> lk(conns_mtx_);
+    for (auto& kv : conns_) {
+      total += kv.second->txq_bytes.load(std::memory_order_relaxed);
+    }
+    return total;
+  };
+  size_t last = queued();
+  auto last_progress = std::chrono::steady_clock::now();
+  while (last > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    size_t now_q = queued();
+    auto now = std::chrono::steady_clock::now();
+    if (now_q < last) {
+      last = now_q;
+      last_progress = now;
+    } else if (now - last_progress > std::chrono::milliseconds(250)) {
+      break;  // peer stopped draining; don't hold shutdown hostage
+    }
+  }
   stop_.store(true);
   uint64_t one = 1;
   for (auto& eng : engines_) {
@@ -152,14 +162,20 @@ int64_t Endpoint::connect(const std::string& ip, uint16_t port) {
 
 void Endpoint::register_conn(const std::shared_ptr<Conn>& c) {
   c->engine = static_cast<int>(c->id % engines_.size());
+  set_nonblocking(c->fd);  // rx state machine + queued tx never block
   {
     std::lock_guard<std::mutex> lk(conns_mtx_);
     conns_[c->id] = c;
   }
+  EngineCtx& eng = *engines_[c->engine];
+  {
+    std::lock_guard<std::mutex> lk(eng.conns_mtx);
+    eng.conns.push_back(c);
+  }
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.u64 = (c->id << 2) | 2;  // tag 2 => conn
-  ::epoll_ctl(engines_[c->engine]->epoll_fd, EPOLL_CTL_ADD, c->fd, &ev);
+  ::epoll_ctl(eng.epoll_fd, EPOLL_CTL_ADD, c->fd, &ev);
 }
 
 int64_t Endpoint::accept(int timeout_ms) {
@@ -183,6 +199,9 @@ bool Endpoint::remove_conn(uint64_t conn_id) {
     c = it->second;
     conns_.erase(it);
   }
+  // The tx thread (sole queue owner) fails queued transfers on its next
+  // pass — the engine's strong conn list keeps the object alive until then.
+  c->dead.store(true, std::memory_order_relaxed);
   ::epoll_ctl(engines_[c->engine]->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
   // Unblock any thread mid-send/recv on this fd; the fd itself closes when
   // the last shared_ptr holder drops (Conn::~Conn), never under a user.
@@ -345,11 +364,29 @@ bool Endpoint::read(uint64_t conn_id, void* dst, size_t len,
 bool Endpoint::send(uint64_t conn_id, const void* buf, size_t len) {
   auto c = get_conn(conn_id);
   if (!c) return false;
+  // Backpressure: a peer that stops reading fills its queue to the
+  // watermark, then senders block here (the old blocking-send behavior)
+  // instead of growing the owned-copy queue without bound.
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(5000);
+  while (c->txq_bytes.load(std::memory_order_relaxed) > kTxqHighWater) {
+    if (c->dead.load() || stop_.load() ||
+        std::chrono::steady_clock::now() > deadline) {
+      return false;
+    }
+    std::this_thread::sleep_for(std::chrono::microseconds(200));
+  }
+  if (c->dead.load()) return false;
   FrameHeader h{};
   h.magic = kMagic;
   h.op = static_cast<uint16_t>(Op::kSend);
   h.len = len;
-  return send_frame(c.get(), h, buf);
+  // Copy: the frame outlives this call on the conn's tx queue (delivery
+  // failure surfaces as conn death, like any reliable-stream send).
+  std::vector<uint8_t> owned(static_cast<const uint8_t*>(buf),
+                             static_cast<const uint8_t*>(buf) + len);
+  enqueue_frame(c, h, nullptr, std::move(owned), 0);
+  return true;
 }
 
 int64_t Endpoint::recv(uint64_t conn_id, void* buf, size_t cap,
@@ -394,22 +431,88 @@ bool Endpoint::wait(uint64_t xfer_id, int timeout_ms) {
   return st == XferState::kDone;
 }
 
-bool Endpoint::send_frame(Conn* c, const FrameHeader& h, const void* payload) {
+void Endpoint::enqueue_frame(const std::shared_ptr<Conn>& c,
+                             const FrameHeader& h, const void* src,
+                             std::vector<uint8_t> owned, uint64_t fail_xfer) {
   // Fault injection: silently drop the frame (reference kTestLoss,
   // transport_config.h:222) — the transfer then times out at the caller.
   double p = drop_rate_.load();
   if (p > 0.0) {
     static thread_local std::mt19937_64 gen{std::random_device{}()};
     std::uniform_real_distribution<double> d(0.0, 1.0);
-    if (d(gen) < p) return true;
+    if (d(gen) < p) return;
   }
-  std::lock_guard<std::mutex> lk(c->tx_mtx);
-  if (!send_all(c->fd, &h, sizeof(h))) return false;
-  if (h.len > 0 && payload != nullptr) {
-    if (!send_all(c->fd, payload, h.len)) return false;
+  TxItem it;
+  it.h = h;
+  it.src = src;
+  it.owned = std::move(owned);
+  it.wire_len = !it.owned.empty() ? it.owned.size()
+              : (src != nullptr ? static_cast<size_t>(h.len) : 0);
+  it.fail_xfer = fail_xfer;
+  size_t total = it.total();
+  {
+    std::lock_guard<std::mutex> lk(c->txq_mtx);
+    c->txq.push_back(std::move(it));
   }
-  bytes_tx_.fetch_add(sizeof(h) + h.len);
-  return true;
+  c->txq_bytes.fetch_add(total, std::memory_order_relaxed);
+  engines_[c->engine]->cv.notify_one();
+}
+
+bool Endpoint::service_tx(Conn* c, bool* blocked) {
+  while (true) {
+    TxItem* it = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(c->txq_mtx);
+      if (c->txq.empty()) return true;
+      // Safe to use outside the lock: this thread is the sole consumer, and
+      // deque push_back never invalidates references to existing elements.
+      it = &c->txq.front();
+    }
+    // Send syscalls run without txq_mtx so app threads can keep enqueueing.
+    while (it->off < it->total()) {
+      const uint8_t* base;
+      size_t n;
+      if (it->off < sizeof(FrameHeader)) {
+        base = reinterpret_cast<const uint8_t*>(&it->h) + it->off;
+        n = sizeof(FrameHeader) - it->off;
+      } else {
+        size_t poff = it->off - sizeof(FrameHeader);
+        base = it->payload() + poff;
+        n = it->wire_len - poff;
+      }
+      ssize_t s = ::send(c->fd, base, n, MSG_NOSIGNAL);
+      if (s < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          *blocked = true;  // kernel buffer full; resume on POLLOUT
+          return true;
+        }
+        return false;
+      }
+      it->off += static_cast<size_t>(s);
+    }
+    size_t total = it->total();
+    bytes_tx_.fetch_add(total);
+    {
+      std::lock_guard<std::mutex> lk(c->txq_mtx);
+      c->txq.pop_front();
+    }
+    c->txq_bytes.fetch_sub(total, std::memory_order_relaxed);
+  }
+}
+
+void Endpoint::fail_txq(Conn* c) {
+  std::deque<TxItem> q;
+  {
+    std::lock_guard<std::mutex> lk(c->txq_mtx);
+    q.swap(c->txq);
+  }
+  size_t bytes = 0;
+  for (auto& it : q) {
+    bytes += it.total();
+    if (it.fail_xfer != 0) complete(it.fail_xfer, XferState::kError);
+  }
+  c->txq_bytes.fetch_sub(bytes, std::memory_order_relaxed);
 }
 
 // Token-bucket pacing: before a payload send, wait until enough tokens have
@@ -450,55 +553,107 @@ void Endpoint::pace(EngineCtx& /*eng*/, uint64_t bytes) {
 void Endpoint::tx_loop(int engine) {
   EngineCtx& eng = *engines_[engine];
   while (!stop_.load()) {
+    // Phase 1: admit tasks from the ring into per-conn tx queues. Pacing
+    // throttles admission (one shared token bucket = aggregate egress cap).
     Task* t = nullptr;
-    if (!eng.ring.pop(&t)) {
+    while (eng.ring.pop(&t)) {
+      auto c = get_conn(t->conn_id);
+      if (!c || c->dead.load(std::memory_order_relaxed)) {
+        // Only locally-initiated ops carry OUR xfer ids; a kReadResp's
+        // xfer_id belongs to the remote requester's counter and must never
+        // be completed against the local table.
+        if (t->xfer_id != 0 && (t->op == Op::kWrite || t->op == Op::kRead)) {
+          complete(t->xfer_id, XferState::kError);
+        }
+        delete t;
+        continue;
+      }
+      FrameHeader h{};
+      h.magic = kMagic;
+      h.op = static_cast<uint16_t>(t->op);
+      h.xfer_id = t->xfer_id;
+      h.rid = t->item.rid;
+      h.token = t->item.token;
+      h.offset = t->item.offset;
+      h.flags = t->flags;
+      if (t->op == Op::kWrite) {
+        h.len = t->len;
+        pace(eng, t->len);
+        enqueue_frame(c, h, t->src, {}, t->xfer_id);
+        // completion arrives as kWriteAck
+      } else if (t->op == Op::kRead) {
+        // kRead frames carry the *requested* length in len, no payload.
+        h.len = t->len;
+        enqueue_frame(c, h, nullptr, {}, t->xfer_id);
+      } else if (t->op == Op::kReadResp) {
+        if (c->txq_bytes.load(std::memory_order_relaxed) > kTxqHighWater) {
+          // The requester isn't draining its own responses; dropping lets
+          // it time out without growing the owned-copy queue unboundedly.
+          delete t;
+          continue;
+        }
+        h.rid = 0;
+        h.token = 0;
+        h.offset = 0;
+        h.len = t->owned.size();
+        pace(eng, h.len);
+        enqueue_frame(c, h, nullptr, std::move(t->owned), 0);
+      } else if (t->op == Op::kWriteAck) {
+        h.rid = 0;
+        h.token = 0;
+        h.offset = 0;
+        h.len = 0;
+        enqueue_frame(c, h, nullptr, {}, 0);
+      }
+      delete t;
+    }
+
+    // Phase 2: round-robin nonblocking service of every conn with queued
+    // frames. One backpressured peer parks with POLLOUT interest; the rest
+    // keep moving — no cross-conn head-of-line blocking (the discipline of
+    // the reference engine run-loop, transport.cc:443-470).
+    std::vector<std::shared_ptr<Conn>> cs;
+    {
+      std::lock_guard<std::mutex> lk(eng.conns_mtx);
+      cs = eng.conns;
+    }
+    std::vector<pollfd> blocked_fds;
+    std::vector<uint64_t> pruned;
+    for (auto& c : cs) {
+      if (c->dead.load(std::memory_order_relaxed)) {
+        fail_txq(c.get());  // tx owns queue cleanup (sole consumer)
+        pruned.push_back(c->id);
+        continue;
+      }
+      bool blocked = false;
+      if (!service_tx(c.get(), &blocked)) {
+        // Socket died mid-send: fail queued transfers and shut the fd down;
+        // the io thread observes the error event and finishes teardown.
+        c->dead.store(true, std::memory_order_relaxed);
+        fail_txq(c.get());
+        ::shutdown(c->fd, SHUT_RDWR);
+      } else if (blocked) {
+        blocked_fds.push_back(pollfd{c->fd, POLLOUT, 0});
+      }
+    }
+    if (!pruned.empty()) {
+      std::lock_guard<std::mutex> lk(eng.conns_mtx);
+      eng.conns.erase(
+          std::remove_if(eng.conns.begin(), eng.conns.end(),
+                         [&](const std::shared_ptr<Conn>& c) {
+                           return std::find(pruned.begin(), pruned.end(),
+                                            c->id) != pruned.end();
+                         }),
+          eng.conns.end());
+    }
+
+    // Phase 3: wait for room on blocked sockets or for new work.
+    if (!blocked_fds.empty()) {
+      ::poll(blocked_fds.data(), blocked_fds.size(), 1);
+    } else {
       std::unique_lock<std::mutex> lk(eng.cv_mtx);
       eng.cv.wait_for(lk, std::chrono::milliseconds(1));
-      continue;
     }
-    auto c = get_conn(t->conn_id);
-    if (!c) {
-      complete(t->xfer_id, XferState::kError);
-      delete t;
-      continue;
-    }
-    FrameHeader h{};
-    h.magic = kMagic;
-    h.op = static_cast<uint16_t>(t->op);
-    h.xfer_id = t->xfer_id;
-    h.rid = t->item.rid;
-    h.token = t->item.token;
-    h.offset = t->item.offset;
-    h.flags = t->flags;
-    if (t->op == Op::kWrite) {
-      h.len = t->len;
-      pace(eng, t->len);
-      if (!send_frame(c.get(), h, t->src))
-        complete(t->xfer_id, XferState::kError);
-      // completion arrives as kWriteAck
-    } else if (t->op == Op::kRead) {
-      // kRead frames carry the *requested* length in len, no payload bytes.
-      h.len = t->len;
-      if (!send_frame(c.get(), h, nullptr))
-        complete(t->xfer_id, XferState::kError);
-    } else if (t->op == Op::kReadResp) {
-      // Read responses are sent from here (not the io thread) so a blocked
-      // peer can never wedge the frame-dispatch loop: the io thread stays
-      // free to drain inbound bytes while this send backpressures.
-      h.rid = 0;
-      h.token = 0;
-      h.offset = 0;
-      h.len = t->owned.size();
-      pace(eng, h.len);
-      send_frame(c.get(), h, t->owned.data());
-    } else if (t->op == Op::kWriteAck) {
-      h.rid = 0;
-      h.token = 0;
-      h.offset = 0;
-      h.len = 0;
-      send_frame(c.get(), h, nullptr);
-    }
-    delete t;
   }
 }
 
@@ -560,6 +715,122 @@ void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
   }
 }
 
+// Finish one fully-received frame (io thread only): dispatch by op, release
+// the window pin, reset the state machine for the next header.
+void Endpoint::finish_rx_frame(Conn* c) {
+  const FrameHeader& h = c->rx_hdr;
+  size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
+  bytes_rx_.fetch_add(sizeof(h) + body);
+  if (static_cast<Op>(h.op) == Op::kWrite) {
+    if (c->rx_pin) {
+      c->rx_pin->fetch_sub(1, std::memory_order_acq_rel);
+      c->rx_pin.reset();
+    }
+    auto* ack = new Task;
+    ack->conn_id = c->id;
+    ack->op = Op::kWriteAck;
+    ack->xfer_id = h.xfer_id;
+    ack->flags = c->rx_ok ? 0 : 1;
+    enqueue_task(ack);
+  } else {
+    handle_frame(c, h, c->rx_buf);
+  }
+  c->rx_stage = Conn::RxStage::kHdr;
+  c->rx_got = 0;
+  c->rx_dst = nullptr;
+  c->rx_ok = false;
+  c->rx_buf.clear();
+}
+
+// Drain available bytes through the per-conn state machine without ever
+// blocking: a peer that stalls mid-frame parks the state until more bytes
+// arrive, and every other connection on the engine keeps flowing (the fix
+// for the reference-style blocking recv dispatch; ADVICE.md round 1).
+bool Endpoint::drain_rx(Conn* c) {
+  while (true) {
+    if (c->rx_stage == Conn::RxStage::kHdr) {
+      uint8_t* p = reinterpret_cast<uint8_t*>(&c->rx_hdr);
+      while (c->rx_got < sizeof(FrameHeader)) {
+        ssize_t n = ::recv(c->fd, p + c->rx_got,
+                           sizeof(FrameHeader) - c->rx_got, 0);
+        if (n == 0) return false;
+        if (n < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+          return false;
+        }
+        c->rx_got += static_cast<size_t>(n);
+      }
+      const FrameHeader& h = c->rx_hdr;
+      if (h.magic != kMagic || h.len > kMaxFrameLen) return false;
+      size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
+      if (static_cast<Op>(h.op) == Op::kWrite) {
+        // Fast path: land write payloads straight into the resolved window —
+        // one copy total (the DCN analog of the reference's zero-copy RDMA
+        // receive into registered memory). Pin so dereg() waits for us
+        // (zero-length writes resolve too — their ack must report success —
+        // but take no pin, since no bytes will land).
+        void* dst = nullptr;
+        std::shared_ptr<std::atomic<int>> pin;
+        {
+          std::lock_guard<std::mutex> lk(regs_mtx_);
+          dst = resolve_window_locked(h.rid, h.token, h.offset, h.len,
+                                      body > 0 ? &pin : nullptr);
+        }
+        if (dst != nullptr) {
+          c->rx_dst = static_cast<uint8_t*>(dst);
+          c->rx_pin = std::move(pin);
+          c->rx_ok = true;
+        } else {
+          c->rx_dst = nullptr;
+          c->rx_ok = false;
+        }
+      }
+      if (body == 0) {
+        finish_rx_frame(c);
+        continue;
+      }
+      if (c->rx_dst == nullptr) {
+        try {
+          c->rx_buf.resize(body);  // owned body (or sink for bad windows)
+        } catch (const std::exception&) {
+          return false;
+        }
+      }
+      c->rx_stage = Conn::RxStage::kBody;
+      c->rx_got = 0;
+    }
+    // Body stage.
+    size_t body = static_cast<size_t>(c->rx_hdr.len);
+    uint8_t* dst = c->rx_dst != nullptr ? c->rx_dst : c->rx_buf.data();
+    while (c->rx_got < body) {
+      ssize_t n = ::recv(c->fd, dst + c->rx_got, body - c->rx_got, 0);
+      if (n == 0) return false;
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+        return false;
+      }
+      c->rx_got += static_cast<size_t>(n);
+    }
+    finish_rx_frame(c);
+  }
+}
+
+void Endpoint::conn_error(uint64_t conn_id) {
+  auto c = get_conn(conn_id);
+  if (c) {
+    if (c->rx_pin) {  // io thread owns rx state; we run on the io thread
+      c->rx_pin->fetch_sub(1, std::memory_order_acq_rel);
+      c->rx_pin.reset();
+    }
+    // The tx thread (sole queue consumer) fails + clears the queue on its
+    // next pass; touching it here would race a send in progress.
+    c->dead.store(true, std::memory_order_relaxed);
+  }
+  remove_conn(conn_id);
+}
+
 void Endpoint::io_loop(int engine) {
   EngineCtx& eng = *engines_[engine];
   constexpr int kMaxEvents = 64;
@@ -590,75 +861,18 @@ void Endpoint::io_loop(int engine) {
         }
         continue;
       }
-      // connection frame
+      // connection event. Drain BEFORE acting on ERR/HUP: a peer that sent
+      // its last frames and closed leaves EPOLLIN|EPOLLHUP with buffered
+      // bytes that must still be delivered (drain_rx returns false at EOF).
       uint64_t conn_id = tag >> 2;
       auto conn = get_conn(conn_id);
       if (!conn) continue;
-      Conn* c = conn.get();
-      FrameHeader h{};
-      if (!recv_all(c->fd, &h, sizeof(h)) || h.magic != kMagic ||
-          h.len > kMaxFrameLen) {
-        remove_conn(conn_id);
-        continue;
+      bool alive = drain_rx(conn.get());
+      if (alive && (events[i].events & (EPOLLERR | EPOLLHUP)) != 0) {
+        // error event with no readable bytes left — nothing more will come
+        alive = false;
       }
-      // Fast path: land write payloads straight into the resolved window —
-      // no intermediate buffer, one copy total (the DCN analog of the
-      // reference's zero-copy RDMA receive into registered memory).
-      if (static_cast<Op>(h.op) == Op::kWrite) {
-        void* dst = nullptr;
-        std::shared_ptr<std::atomic<int>> pin;
-        {
-          std::lock_guard<std::mutex> lk(regs_mtx_);
-          dst = resolve_window_locked(h.rid, h.token, h.offset, h.len, &pin);
-        }
-        bool ok = false;
-        if (dst != nullptr) {
-          ok = recv_all(c->fd, dst, h.len);
-          pin->fetch_sub(1, std::memory_order_acq_rel);
-          if (!ok) {
-            remove_conn(conn_id);
-            continue;
-          }
-        } else if (h.len > 0) {
-          // invalid target: drain the payload to keep the stream framed
-          std::vector<uint8_t> sink;
-          try {
-            sink.resize(h.len);
-          } catch (const std::exception&) {
-            remove_conn(conn_id);
-            continue;
-          }
-          if (!recv_all(c->fd, sink.data(), h.len)) {
-            remove_conn(conn_id);
-            continue;
-          }
-        }
-        bytes_rx_.fetch_add(sizeof(h) + h.len);
-        auto* ack = new Task;
-        ack->conn_id = c->id;
-        ack->op = Op::kWriteAck;
-        ack->xfer_id = h.xfer_id;
-        ack->flags = ok ? 0 : 1;
-        enqueue_task(ack);
-        continue;
-      }
-      std::vector<uint8_t> payload;
-      // kRead carries requested length in h.len but no payload bytes.
-      size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
-      if (body > 0) {
-        try {
-          payload.resize(body);
-        } catch (const std::exception&) {
-          remove_conn(conn_id);
-          continue;
-        }
-        if (!recv_all(c->fd, payload.data(), body)) {
-          remove_conn(conn_id);
-          continue;
-        }
-      }
-      bytes_rx_.fetch_add(sizeof(h) + body);
-      handle_frame(c, h, payload);
+      if (!alive) conn_error(conn_id);
     }
   }
 }

@@ -38,11 +38,26 @@ def _build_if_needed() -> str:
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "ring.h"),
     ]
 
+    # Content-hash freshness (not mtimes): a prebuilt .so is only trusted if
+    # it was produced from exactly the sources present now, so checkout-order
+    # mtime skew can neither skip a needed rebuild nor load a stale binary.
+    import hashlib
+
+    def src_digest() -> str:
+        hasher = hashlib.sha256()
+        for s in srcs:
+            if os.path.exists(s):
+                with open(s, "rb") as f:
+                    hasher.update(f.read())
+        return hasher.hexdigest()
+
+    digest_path = os.path.join(_NATIVE_DIR, "build", ".src_hash")
+
     def fresh() -> bool:
-        if not os.path.exists(_SO_PATH):
+        if not os.path.exists(_SO_PATH) or not os.path.exists(digest_path):
             return False
-        so_mtime = os.path.getmtime(_SO_PATH)
-        return all(os.path.getmtime(s) <= so_mtime for s in srcs if os.path.exists(s))
+        with open(digest_path) as f:
+            return f.read().strip() == src_digest()
 
     if fresh():
         return _SO_PATH
@@ -59,6 +74,8 @@ def _build_if_needed() -> str:
             subprocess.run(
                 ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
             )
+            with open(digest_path, "w") as f:
+                f.write(src_digest())
     return _SO_PATH
 
 

@@ -79,3 +79,55 @@ def test_world_one_degenerate(rng):
 
 def test_barrier():
     _run_group(2, lambda g, r: g.barrier())
+
+
+@pytest.mark.parametrize("world,root", [(2, 0), (3, 1), (4, 3)])
+def test_broadcast(world, root, rng):
+    xs = [rng.standard_normal((4, 9)).astype(np.float32) for _ in range(world)]
+    outs = _run_group(world, lambda g, r: g.broadcast(xs[r], root=root))
+    for out in outs:
+        np.testing.assert_array_equal(out, xs[root])
+
+
+def test_all_to_all_world4(rng):
+    world = 4
+    xs = [rng.standard_normal((world, 17)).astype(np.float32) for _ in range(world)]
+    outs = _run_group(world, lambda g, r: g.all_to_all(xs[r]))
+    for i, out in enumerate(outs):
+        for j in range(world):
+            np.testing.assert_array_equal(out[j], xs[j][i])
+
+
+def test_all_to_all_traffic_is_pairwise(rng):
+    """VERDICT round 1 #6: all_to_all must move O(rows) bytes per rank, not
+    O(world*rows) like the old ring-gather + column-select."""
+    world, row_bytes = 4, 256 << 10
+    rows = row_bytes // 4
+
+    def fn(g, r):
+        x = rng.standard_normal((world, rows)).astype(np.float32)
+        before = g.ep.stats["bytes_tx"]
+        g.all_to_all(x)
+        return g.ep.stats["bytes_tx"] - before
+
+    sent = _run_group(world, fn)
+    # pairwise: (world-1) rows + handshakes/fifo exchange. gather-based was
+    # (world-1) * world rows = 12 * row_bytes. Assert well under half that.
+    budget = (world - 1) * row_bytes + (64 << 10)
+    for r, tx in enumerate(sent):
+        assert tx < 1.6 * budget, f"rank {r} sent {tx} bytes (budget {budget})"
+
+
+def test_mixed_ops_interleave(rng):
+    """Ring and mesh collectives share one endpoint without cross-talk."""
+    def fn(g, r):
+        s = g.all_reduce(np.full(8, float(r), np.float32))
+        b = g.broadcast(np.full(8, float(r), np.float32), root=2)
+        a = g.all_to_all(np.full((3, 4), float(r), np.float32))
+        return s[0], b[0], [a[j][0] for j in range(3)]
+
+    outs = _run_group(3, fn)
+    for r, (s, b, a) in enumerate(outs):
+        assert s == 0.0 + 1.0 + 2.0
+        assert b == 2.0
+        assert a == [0.0, 1.0, 2.0]

@@ -23,7 +23,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from uccl_tpu.p2p.channel import Channel
+from uccl_tpu.p2p.channel import Channel, ChannelAcceptor, FifoItem
 from uccl_tpu.p2p.endpoint import Endpoint
 from uccl_tpu.parallel.distributed import Session, exchange_json
 from uccl_tpu.utils.logging import get_logger
@@ -61,8 +61,9 @@ class DcnGroup:
     def __init__(self, sess: Session, n_paths: int = 2, tag: str = "0"):
         self.rank = sess.rank
         self.world = sess.world
+        self.n_paths = n_paths
         self.ep = Endpoint(n_engines=max(2, n_paths))
-        addrs = exchange_json(
+        self._addrs = exchange_json(
             sess,
             f"dcn_group/{tag}/addr",
             {"ip": _local_ip(), "port": self.ep.port},
@@ -72,22 +73,54 @@ class DcnGroup:
         self._ring_mr: Optional[int] = None
         self._ring_recv: Optional[np.ndarray] = None
         self._peer_fifo: Optional[bytes] = None
+        # full-mesh state (built lazily on first pairwise op)
+        self._mesh: dict = {}  # peer rank -> Channel
+        self._mesh_buf: Optional[np.ndarray] = None
+        self._mesh_mr: Optional[int] = None
+        self._mesh_seg = 0  # bytes per source region in the landing buffer
+        self._mesh_fifos: dict = {}  # peer -> FifoItem into MY region on peer
+        # Inbound channels arrive tagged by the dialer's meta; the acceptor
+        # dispatches any interleaving of concurrent dialers (full mesh).
+        self._inbound: dict = {}
+        self._inbound_cv = threading.Condition()
+        self._broken = False  # poisoned after a failed descriptor exchange
+        self._acceptor = (
+            ChannelAcceptor(self.ep, self._on_inbound) if self.world > 1 else None
+        )
         if self.world > 1:
-            nxt = addrs[(self.rank + 1) % self.world]
-            acc = {}
-            t = threading.Thread(
-                target=lambda: acc.setdefault("c", Channel.accept(self.ep, 30000))
-            )
-            t.start()
-            self._next = Channel.connect(self.ep, nxt["ip"], nxt["port"], n_paths)
-            # Channel.accept makes ~2*n_paths blocking calls of 30s each;
-            # join must outlast the worst case or we misreport failure.
-            t.join(timeout=30 * (2 * n_paths + 1))
-            self._prev = acc.get("c")
-            if self._prev is None:
-                raise ConnectionError("ring bootstrap failed: no inbound channel")
+            try:
+                nxt = self._addrs[(self.rank + 1) % self.world]
+                self._next = Channel.connect(
+                    self.ep, nxt["ip"], nxt["port"], n_paths,
+                    meta=b"ring:%d" % self.rank,
+                )
+                self._prev = self._wait_inbound(
+                    b"ring:%d" % ((self.rank - 1) % self.world)
+                )
+            except Exception:
+                # Don't leak the acceptor thread + native endpoint when the
+                # bootstrap dies (a peer crashed post-rendezvous).
+                self.close()
+                raise
+
+    def _on_inbound(self, chan: Channel):
+        with self._inbound_cv:
+            self._inbound[bytes(chan.meta)] = chan
+            self._inbound_cv.notify_all()
+
+    def _wait_inbound(self, meta: bytes, timeout_s: float = 60.0) -> Channel:
+        with self._inbound_cv:
+            if not self._inbound_cv.wait_for(
+                lambda: meta in self._inbound, timeout=timeout_s
+            ):
+                raise ConnectionError(
+                    f"bootstrap failed: no inbound channel {meta!r}"
+                )
+            return self._inbound[meta]
 
     def close(self):
+        if self._acceptor is not None:
+            self._acceptor.close()
         self.ep.close()
 
     # ------------------------------------------------------------------
@@ -119,8 +152,6 @@ class DcnGroup:
         self._prev.send(b"R")
         if self._next.recv(timeout_ms=30000) != b"R":
             raise IOError("ring protocol: expected READY")
-        from uccl_tpu.p2p.channel import FifoItem
-
         item = FifoItem.unpack(self._peer_fifo)
         self._next.write(
             send_arr, item.slice(0, send_arr.nbytes).pack()
@@ -179,21 +210,167 @@ class DcnGroup:
             # buffer next hop while cur is simultaneously being sent
         return out
 
+    # ------------------------------------------------------------------
+    # Pairwise-mesh machinery (channels built per edge, on demand)
+
+    def _ensure_peers(self, peers):
+        """Direct channels to the given peers (SPMD: both ends of every edge
+        must request it in the same collective call).
+
+        Dialing rule: the lower rank dials, the higher rank waits for the
+        acceptor to file the inbound channel — deterministic and
+        deadlock-free since accepting happens on a background thread.
+        """
+        for j in sorted(peers):
+            if j == self.rank or j in self._mesh:
+                continue
+            if self.rank < j:
+                a = self._addrs[j]
+                self._mesh[j] = Channel.connect(
+                    self.ep, a["ip"], a["port"], self.n_paths,
+                    meta=b"mesh:%d" % self.rank,
+                )
+            else:
+                self._mesh[j] = self._wait_inbound(b"mesh:%d" % j)
+
+    def _setup_mesh_buf(self, seg: int, peers):
+        """Per-source landing regions: one buffer of world segments; peer j
+        may only write region j (its own advertised window — the engine
+        enforces the byte range). Regrows in lockstep (SPMD payload sizes).
+
+        Descriptor exchange: a regrow re-exchanges over EVERY existing mesh
+        channel (both ends of each channel are in the same collective, so
+        sends and receives pair up); otherwise only new peers exchange.
+        State commits after the exchange completes — a mid-exchange failure
+        poisons the group (control channels may hold half-consumed MF
+        messages; no later op can be trusted)."""
+        if self._broken:
+            raise IOError("DcnGroup poisoned by an earlier failed exchange")
+        peers = set(peers) - {self.rank}
+        self._ensure_peers(peers)
+        seg_needed = max(seg, 1)
+        regrow = self._mesh_buf is None or seg_needed > self._mesh_seg
+        if regrow:
+            exchange = dict(self._mesh)  # every existing channel
+        else:
+            exchange = {j: self._mesh[j] for j in peers if j not in self._mesh_fifos}
+        if not exchange:
+            return
+        try:
+            if regrow:
+                new_buf = np.empty(self.world * seg_needed, np.uint8)
+                new_mr = self.ep.reg(new_buf)
+            else:
+                new_buf, new_mr, seg_needed = (
+                    self._mesh_buf, self._mesh_mr, self._mesh_seg
+                )
+            for j, ch in exchange.items():
+                fifo = self.ep.advertise(
+                    new_mr, offset=j * seg_needed, length=seg_needed
+                )
+                ch.send(b"MF" + fifo)
+            fifos = {}
+            for j, ch in exchange.items():
+                msg = ch.recv(timeout_ms=30000)
+                if not msg.startswith(b"MF"):
+                    raise IOError(f"mesh fifo exchange broken: {msg[:8]!r}")
+                fifos[j] = FifoItem.unpack(msg[2:])
+        except Exception:
+            self._broken = True
+            raise
+        if regrow:
+            if self._mesh_mr is not None:
+                self.ep.dereg(self._mesh_mr)
+            self._mesh_buf, self._mesh_mr = new_buf, new_mr
+            self._mesh_seg = seg_needed
+            self._mesh_fifos = fifos
+        else:
+            self._mesh_fifos.update(fifos)
+
+    def _mesh_region(self, src: int, nbytes: int) -> np.ndarray:
+        off = src * self._mesh_seg
+        return self._mesh_buf[off : off + nbytes]
+
     def all_to_all(self, x: np.ndarray) -> np.ndarray:
         """x: [world, ...] — row j goes to rank j; out[i] = rank i's row for us.
 
         This is the cross-pod EP exchange primitive (the DCN leg of a
-        pod-spanning dispatch/combine — reference EP spans hosts the same
-        way, through its CPU proxies). Current schedule: ring all-gather of
-        the full buffer + local column select — correct at any world size;
-        a direct pairwise schedule (n× less traffic) is a planned
-        optimization for large pod counts.
+        pod-spanning dispatch/combine — reference EP proxies post direct
+        per-peer writes the same way, ep/src/rdma.cpp:1554,1718). Pairwise
+        stepped schedule over the full mesh: at step s, write your row for
+        rank (r+s) directly into its landing region while rank (r-s) writes
+        yours — each rank moves (world-1) rows total, not (world-1)×world
+        like the old gather+select.
         """
         n = self.world
         if x.shape[0] != n:
             raise ValueError(f"all_to_all needs leading dim {n}, got {x.shape}")
-        gathered = self.all_gather(x)  # [n, n, ...]
-        return np.ascontiguousarray(gathered[:, self.rank])
+        x = np.ascontiguousarray(x)
+        out = np.empty_like(x)
+        out[self.rank] = x[self.rank]
+        if n == 1:
+            return out
+        row = x[0]
+        self._setup_mesh_buf(row.nbytes, range(n))
+        for s in range(1, n):
+            dst = (self.rank + s) % n
+            src = (self.rank - s) % n
+            ch_src, ch_dst = self._mesh[src], self._mesh[dst]
+            ch_src.send(b"R")  # license src to write my region[src]
+            if ch_dst.recv(timeout_ms=30000) != b"R":
+                raise IOError("all_to_all: expected READY")
+            item = self._mesh_fifos[dst]
+            ch_dst.write(x[dst], item.slice(0, row.nbytes).pack())
+            ch_dst.send(b"D")
+            if ch_src.recv(timeout_ms=30000) != b"D":
+                raise IOError("all_to_all: expected DONE")
+            out[src] = (
+                self._mesh_region(src, row.nbytes).view(x.dtype).reshape(row.shape)
+            )
+        return out
+
+    def broadcast(self, x: np.ndarray, root: int = 0) -> np.ndarray:
+        """Rooted broadcast: every rank returns root's x. Binomial tree —
+        ceil(log2 world) rounds; each rank builds only its own tree edges and
+        sends at most log(world) copies (vs the old gather path's world×
+        traffic)."""
+        n = self.world
+        if n == 1:
+            return x.copy()
+        vr = (self.rank - root) % n
+        # Only this rank's tree edges — log(world) channels, not a full mesh.
+        partners = set()
+        mask = 1
+        while mask < n:
+            if vr < mask and vr + mask < n:
+                partners.add((vr + mask + root) % n)
+            elif mask <= vr < 2 * mask:
+                partners.add((vr - mask + root) % n)
+            mask <<= 1
+        self._setup_mesh_buf(x.nbytes, partners)
+        buf = np.ascontiguousarray(x).copy() if vr == 0 else np.empty_like(x)
+        mask = 1
+        while mask < n:
+            if vr < mask:  # holders fan out
+                dst_vr = vr + mask
+                if dst_vr < n:
+                    dst = (dst_vr + root) % n
+                    ch = self._mesh[dst]
+                    if ch.recv(timeout_ms=30000) != b"R":
+                        raise IOError("broadcast: expected READY")
+                    item = self._mesh_fifos[dst]
+                    ch.write(buf, item.slice(0, buf.nbytes).pack())
+                    ch.send(b"D")
+            elif vr < 2 * mask:  # this round's receivers
+                src = ((vr - mask) + root) % n
+                ch = self._mesh[src]
+                ch.send(b"R")
+                if ch.recv(timeout_ms=30000) != b"D":
+                    raise IOError("broadcast: expected DONE")
+                flat = self._mesh_region(src, buf.nbytes).view(buf.dtype)
+                buf = flat.reshape(x.shape).copy()
+            mask <<= 1
+        return buf
 
     def barrier(self):
         self.all_reduce(np.zeros(1, np.float32))
@@ -203,11 +380,13 @@ def hierarchical_all_reduce(comm, dcn: DcnGroup, x):
     """Two-level allreduce: ICI reduce-scatter → DCN allreduce → ICI all-gather.
 
     ``comm`` is an on-mesh :class:`~uccl_tpu.collective.Communicator`
-    (rank-dim convention, x: [local_world, N]); ``dcn`` spans pods. Each pod
-    moves only N/local_world bytes over DCN and per device only its shard
-    crosses the host link — the hierarchical bandwidth win (the moral
-    equivalent of the reference's multi-engine NIC split). Result: every
-    member of every pod holds the global sum, NCCL-allreduce shaped.
+    (rank-dim convention, x: [local_world, N]); ``dcn`` spans pods. Per-host
+    DCN traffic is O(N) (the ring moves all local shards, ~2N in+out per
+    host); the hierarchical win is on the *device* side: each device moves
+    only its N/local_world shard across the host link, and the pod-internal
+    reduction/broadcast legs ride ICI (the moral equivalent of the
+    reference's multi-engine NIC split). Result: every member of every pod
+    holds the global sum, NCCL-allreduce shaped.
     """
     import jax
     import jax.numpy as jnp

@@ -101,14 +101,10 @@ def all_to_all(out: np.ndarray, x: np.ndarray) -> None:
 
 
 def broadcast(x: np.ndarray, src: int = 0) -> None:
-    """In-place: every rank ends with src's x.
-
-    NB: currently rides the gather path (world× the optimal traffic) — a
-    direct src-rooted ring forward is a planned optimization; fine for the
-    control-plane payloads this API targets."""
+    """In-place: every rank ends with src's x (binomial tree over the DCN
+    full mesh — log(world) rounds, no gather blow-up)."""
     g = _require()
-    gathered = g.all_gather(x)
-    x[...] = gathered[src]
+    x[...] = g.broadcast(x, root=src)
 
 
 def barrier() -> None:

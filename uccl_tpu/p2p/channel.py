@@ -62,10 +62,19 @@ class Channel:
 
     _HELLO = b"UCCLT_CHAN"
 
-    def __init__(self, ep: Endpoint, conns: List[int], chunk_bytes: Optional[int] = None):
+    def __init__(
+        self,
+        ep: Endpoint,
+        conns: List[int],
+        chunk_bytes: Optional[int] = None,
+        meta: bytes = b"",
+    ):
         self.ep = ep
         self.conns = conns
         self.chunk_bytes = chunk_bytes or _chunk_kb.get() * 1024
+        # application tag carried in the connect handshake (e.g. which peer
+        # rank dialed, for multi-channel topologies like a DCN full mesh)
+        self.meta = meta
 
     @classmethod
     def connect(
@@ -75,14 +84,24 @@ class Channel:
         port: int,
         n_paths: int = 4,
         chunk_bytes: Optional[int] = None,
+        meta: bytes = b"",
     ) -> "Channel":
         token = uuid.uuid4().bytes
         conns = []
         for i in range(n_paths):
             cid = ep.connect(ip, port)
-            ep.send(cid, cls._HELLO + token + bytes([i, n_paths]))
+            ep.send(cid, cls._HELLO + token + bytes([i, n_paths]) + meta)
             conns.append(cid)
-        return cls(ep, conns, chunk_bytes)
+        return cls(ep, conns, chunk_bytes, meta)
+
+    @classmethod
+    def _parse_hello(cls, hello: bytes):
+        if not hello.startswith(cls._HELLO) or len(hello) < len(cls._HELLO) + 18:
+            raise IOError("not a channel handshake")
+        base = len(cls._HELLO)
+        token = hello[base : base + 16]
+        idx, n_paths = hello[base + 16], hello[base + 17]
+        return token, idx, n_paths, hello[base + 18 :]
 
     @classmethod
     def accept(
@@ -90,18 +109,17 @@ class Channel:
     ) -> "Channel":
         first_conn = ep.accept(timeout_ms)
         hello = ep.recv(first_conn, timeout_ms=timeout_ms)
-        if not hello.startswith(cls._HELLO):
-            raise IOError("not a channel handshake")
-        token = hello[len(cls._HELLO) : len(cls._HELLO) + 16]
-        n_paths = hello[-1]
-        paths = {hello[-2]: first_conn}
+        token, idx, n_paths, meta = cls._parse_hello(hello)
+        paths = {idx: first_conn}
         while len(paths) < n_paths:
             cid = ep.accept(timeout_ms)
             h = ep.recv(cid, timeout_ms=timeout_ms)
-            if not h.startswith(cls._HELLO) or h[len(cls._HELLO) : len(cls._HELLO) + 16] != token:
+            t2, i2, _, _ = cls._parse_hello(h)
+            if t2 != token:
                 raise IOError("path handshake mismatch (interleaved channels?)")
-            paths[h[-2]] = cid
-        return cls(ep, [paths[i] for i in range(n_paths)], chunk_bytes)
+            paths[i2] = cid
+        return cls(ep, [paths[i] for i in range(n_paths)], chunk_bytes, meta)
+
 
     @property
     def n_paths(self) -> int:
@@ -158,3 +176,79 @@ class Channel:
     def close(self) -> None:
         for c in self.conns:
             self.ep.remove_conn(c)
+
+
+class ChannelAcceptor:
+    """Background channel dispatcher for multi-peer topologies.
+
+    Several peers dialing one endpoint concurrently interleave their path
+    connections in the accept queue; plain :meth:`Channel.accept` would see a
+    token mismatch. This acceptor takes every inbound conn, groups handshakes
+    by token, and delivers each completed channel to ``on_channel(chan)``
+    (called on the acceptor thread; ``chan.meta`` identifies the dialer)."""
+
+    # Worst-case blocking inside the loop is one accept (200ms) + one hello
+    # recv; close() must join for longer than their sum so the native
+    # endpoint is never destroyed under a thread inside a C call.
+    _HELLO_TIMEOUT_MS = 2000
+    _PARTIAL_TTL_S = 30.0
+
+    def __init__(self, ep: Endpoint, on_channel, chunk_bytes: Optional[int] = None):
+        import threading
+
+        self.ep = ep
+        self._on_channel = on_channel
+        self._chunk_bytes = chunk_bytes
+        self._stop = False
+        self._partial = {}  # token -> (meta, n_paths, {idx: conn}, first_seen)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _expire_partials(self):
+        """Drop handshakes whose dialer died mid-way so their conns don't
+        accumulate on a long-lived endpoint."""
+        import time
+
+        now = time.monotonic()
+        for token in list(self._partial):
+            meta, np_, paths, first_seen = self._partial[token]
+            if now - first_seen > self._PARTIAL_TTL_S:
+                del self._partial[token]
+                for cid in paths.values():
+                    self.ep.remove_conn(cid)
+
+    def _run(self):
+        import time
+
+        while not self._stop:
+            self._expire_partials()
+            try:
+                cid = self.ep.accept(timeout_ms=200)
+            except TimeoutError:
+                continue
+            except Exception:
+                return  # endpoint closed
+            try:
+                hello = self.ep.recv(cid, timeout_ms=self._HELLO_TIMEOUT_MS)
+                token, idx, n_paths, meta = Channel._parse_hello(hello)
+            except Exception:
+                self.ep.remove_conn(cid)  # junk or dawdling dialer
+                continue
+            meta0, np_, paths, _ = self._partial.setdefault(
+                token, (meta, n_paths, {}, time.monotonic())
+            )
+            paths[idx] = cid
+            if len(paths) == np_:
+                del self._partial[token]
+                self._on_channel(
+                    Channel(
+                        self.ep,
+                        [paths[i] for i in range(np_)],
+                        self._chunk_bytes,
+                        meta0,
+                    )
+                )
+
+    def close(self):
+        self._stop = True
+        self._thread.join(timeout=(self._HELLO_TIMEOUT_MS / 1000.0) + 1.0)

@@ -1,0 +1,97 @@
+"""Incast microbenchmark: N senders blast one receiver over the DCN engine.
+
+The scenario receiver-driven CC (the reference's EQDS, include/cc/eqds.h)
+exists for: many senders converging on one receiver link. This bench measures
+what our transport (framed TCP streams + per-conn non-blocking engine)
+delivers under incast: aggregate goodput and per-sender fairness (Jain's
+index). Results ground the EQDS design decision in docs/EQDS.md.
+
+Usage: python benchmarks/incast_bench.py [n_senders] [mb_per_sender]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo path)
+import json
+import multiprocessing as mp
+import sys
+import time
+
+import numpy as np
+
+
+def _sender(port, mb, out_q, idx):
+    import os, sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from uccl_tpu.p2p import Endpoint
+
+    with Endpoint() as ep:
+        conn = ep.connect("127.0.0.1", port)
+        src = np.random.default_rng(idx).integers(
+            0, 255, mb << 20, dtype=np.uint8
+        )
+        ep.send(conn, b"ready")
+        fifo = ep.recv(conn, 64, timeout_ms=120000)  # the starting gun
+        t0 = time.perf_counter()
+        ep.write(conn, src, fifo)
+        dt = time.perf_counter() - t0
+        ep.send(conn, b"done")
+        out_q.put((idx, (mb << 20) / dt))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    mb = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    from uccl_tpu.p2p import Endpoint
+
+    mp.set_start_method("spawn", force=True)
+    out_q = mp.Queue()
+    with Endpoint(n_engines=4) as recv_ep:
+        procs = [
+            mp.Process(target=_sender, args=(recv_ep.port, mb, out_q, i))
+            for i in range(n)
+        ]
+        [p.start() for p in procs]
+        # Rendezvous: accept everyone and hand out windows BEFORE timing, so
+        # wall-clock measures the incast transfer, not process spawn/import.
+        conns, bufs = [], []
+        for _ in range(n):
+            c = recv_ep.accept(timeout_ms=120000)
+            dst = np.zeros(mb << 20, np.uint8)
+            fifo = recv_ep.advertise(recv_ep.reg(dst))
+            conns.append((c, bytes(fifo)))
+            bufs.append(dst)
+        for c, _ in conns:  # wait for payload generation everywhere
+            assert recv_ep.recv(c, 16, timeout_ms=120000) == b"ready"
+        t0 = time.perf_counter()
+        for c, fifo in conns:
+            recv_ep.send(c, fifo)  # the starting gun
+        for c, _ in conns:
+            assert recv_ep.recv(c, 16, timeout_ms=120000) == b"done"
+        wall = time.perf_counter() - t0
+        [p.join(30) for p in procs]
+
+    per_flow = {}
+    while not out_q.empty():
+        i, bps = out_q.get()
+        per_flow[i] = bps
+    rates = np.array([per_flow[i] for i in sorted(per_flow)])
+    jain = float(rates.sum() ** 2 / (len(rates) * (rates**2).sum()))
+    agg = n * (mb << 20) / wall
+    print(
+        json.dumps(
+            {
+                "n_senders": n,
+                "mb_per_sender": mb,
+                "aggregate_GBps": round(agg / 1e9, 3),
+                "per_flow_MBps_min": round(float(rates.min()) / 1e6, 1),
+                "per_flow_MBps_max": round(float(rates.max()) / 1e6, 1),
+                "jain_fairness": round(jain, 4),
+                "wall_s": round(wall, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

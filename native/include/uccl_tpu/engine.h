@@ -236,9 +236,11 @@ class Endpoint {
 
   void io_loop(int engine);  // epoll frame dispatch (recv proxy analog)
   void tx_loop(int engine);  // drains that engine's ring (send proxy analog)
-  // rx state machine step: drain whatever bytes are available without
-  // blocking; returns false when the conn died (caller removes it).
-  bool drain_rx(Conn* c);
+  // rx state machine step: drain available bytes without blocking.
+  // kDrained = socket empty (hit EAGAIN); kBudget = fairness budget spent
+  // with bytes possibly still buffered; kDead = conn died.
+  enum class RxResult { kDead, kDrained, kBudget };
+  RxResult drain_rx(Conn* c);
   void finish_rx_frame(Conn* c);
   // append a frame to the conn's tx queue (applies drop injection) and wake
   // the serving engine's tx thread.

@@ -36,6 +36,12 @@ constexpr uint64_t kMaxFrameLen = 1ull << 30;
 // backpressure, like the old blocking send path) and read responses to a
 // non-draining requester are dropped (it times out; it wasn't reading).
 constexpr size_t kTxqHighWater = 64ull << 20;
+// Max bytes drained from ONE conn per epoll event: a fast sender pumping a
+// large frame refills the kernel buffer faster than EAGAIN can fire, and an
+// unbudgeted drain would serve that conn forever while the listener and
+// every other conn on the engine starve. Level-triggered epoll re-reports
+// the fd immediately, so the io loop round-robins at this granularity.
+constexpr size_t kRxBudgetPerEvent = 4ull << 20;
 
 void set_nonblocking(int fd) {
   int flags = ::fcntl(fd, F_GETFL, 0);
@@ -746,23 +752,25 @@ void Endpoint::finish_rx_frame(Conn* c) {
 // blocking: a peer that stalls mid-frame parks the state until more bytes
 // arrive, and every other connection on the engine keeps flowing (the fix
 // for the reference-style blocking recv dispatch; ADVICE.md round 1).
-bool Endpoint::drain_rx(Conn* c) {
-  while (true) {
+Endpoint::RxResult Endpoint::drain_rx(Conn* c) {
+  size_t consumed = 0;
+  while (consumed < kRxBudgetPerEvent) {
     if (c->rx_stage == Conn::RxStage::kHdr) {
       uint8_t* p = reinterpret_cast<uint8_t*>(&c->rx_hdr);
       while (c->rx_got < sizeof(FrameHeader)) {
         ssize_t n = ::recv(c->fd, p + c->rx_got,
                            sizeof(FrameHeader) - c->rx_got, 0);
-        if (n == 0) return false;
+        if (n == 0) return RxResult::kDead;
         if (n < 0) {
           if (errno == EINTR) continue;
-          if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
-          return false;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return RxResult::kDrained;
+          return RxResult::kDead;
         }
         c->rx_got += static_cast<size_t>(n);
+        consumed += static_cast<size_t>(n);
       }
       const FrameHeader& h = c->rx_hdr;
-      if (h.magic != kMagic || h.len > kMaxFrameLen) return false;
+      if (h.magic != kMagic || h.len > kMaxFrameLen) return RxResult::kDead;
       size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
       if (static_cast<Op>(h.op) == Op::kWrite) {
         // Fast path: land write payloads straight into the resolved window —
@@ -794,7 +802,7 @@ bool Endpoint::drain_rx(Conn* c) {
         try {
           c->rx_buf.resize(body);  // owned body (or sink for bad windows)
         } catch (const std::exception&) {
-          return false;
+          return RxResult::kDead;
         }
       }
       c->rx_stage = Conn::RxStage::kBody;
@@ -804,17 +812,26 @@ bool Endpoint::drain_rx(Conn* c) {
     size_t body = static_cast<size_t>(c->rx_hdr.len);
     uint8_t* dst = c->rx_dst != nullptr ? c->rx_dst : c->rx_buf.data();
     while (c->rx_got < body) {
-      ssize_t n = ::recv(c->fd, dst + c->rx_got, body - c->rx_got, 0);
-      if (n == 0) return false;
+      // Header bytes above may have nudged consumed past the budget;
+      // saturating arithmetic, never wrap.
+      size_t remaining = consumed < kRxBudgetPerEvent
+                             ? kRxBudgetPerEvent - consumed
+                             : 0;
+      if (remaining == 0) return RxResult::kBudget;
+      ssize_t n = ::recv(c->fd, dst + c->rx_got,
+                         std::min(body - c->rx_got, remaining), 0);
+      if (n == 0) return RxResult::kDead;
       if (n < 0) {
         if (errno == EINTR) continue;
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
-        return false;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return RxResult::kDrained;
+        return RxResult::kDead;
       }
       c->rx_got += static_cast<size_t>(n);
+      consumed += static_cast<size_t>(n);
     }
     finish_rx_frame(c);
   }
+  return RxResult::kBudget;  // epoll re-reports any bytes still waiting
 }
 
 void Endpoint::conn_error(uint64_t conn_id) {
@@ -863,16 +880,17 @@ void Endpoint::io_loop(int engine) {
       }
       // connection event. Drain BEFORE acting on ERR/HUP: a peer that sent
       // its last frames and closed leaves EPOLLIN|EPOLLHUP with buffered
-      // bytes that must still be delivered (drain_rx returns false at EOF).
+      // bytes that must still be delivered (drain_rx reports kDead at EOF).
+      // A budget-limited drain must NOT act on HUP either — bytes may still
+      // be buffered; the level-triggered event re-fires and we resume.
       uint64_t conn_id = tag >> 2;
       auto conn = get_conn(conn_id);
       if (!conn) continue;
-      bool alive = drain_rx(conn.get());
-      if (alive && (events[i].events & (EPOLLERR | EPOLLHUP)) != 0) {
-        // error event with no readable bytes left — nothing more will come
-        alive = false;
-      }
-      if (!alive) conn_error(conn_id);
+      RxResult res = drain_rx(conn.get());
+      bool dead = res == RxResult::kDead ||
+                  (res == RxResult::kDrained &&
+                   (events[i].events & (EPOLLERR | EPOLLHUP)) != 0);
+      if (dead) conn_error(conn_id);
     }
   }
 }

@@ -26,9 +26,15 @@ import numpy as np
 from uccl_tpu.p2p.channel import Channel, ChannelAcceptor, FifoItem
 from uccl_tpu.p2p.endpoint import Endpoint
 from uccl_tpu.parallel.distributed import Session, exchange_json
+from uccl_tpu.utils.config import param
 from uccl_tpu.utils.logging import get_logger
 
 _log = get_logger("COLL")
+
+# DCN congestion control (reference: kSenderCCA, transport_config.h:96).
+# One controller per group, on the ring tx channel — every write on this
+# endpoint shares the one token-bucket pacer it actuates.
+_cc_algo = param("cc", "off", help="DCN congestion control: off|timely|swift")
 
 
 def _local_ip() -> str:
@@ -97,6 +103,9 @@ class DcnGroup:
                 self._prev = self._wait_inbound(
                     b"ring:%d" % ((self.rank - 1) % self.world)
                 )
+                algo = str(_cc_algo.get())
+                if algo != "off":
+                    self._next.enable_cc(algo)
             except Exception:
                 # Don't leak the acceptor thread + native endpoint when the
                 # bootstrap dies (a peer crashed post-rendezvous).
@@ -119,6 +128,8 @@ class DcnGroup:
             return self._inbound[meta]
 
     def close(self):
+        if self._next is not None:
+            self._next.disable_cc()
         if self._acceptor is not None:
             self._acceptor.close()
         self.ep.close()

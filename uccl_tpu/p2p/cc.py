@@ -136,13 +136,20 @@ class RateController:
 
     _PROBE = None
 
-    def probe(self, conn_id: int, probe_fifo: bytes) -> float:
+    def probe(
+        self, conn_id: int, probe_fifo: bytes, timeout_ms: int = 1000
+    ) -> float:
         """Measure network delay with a 1-byte one-sided write (ack round
         trip) and feed it to the controller. This is the right Timely signal:
         decoupled from transfer size and (nearly) from the pacer itself —
         feeding whole-transfer completion times instead creates a positive
         feedback loop where the pacer's own delay drives the rate to the
         floor.
+
+        A probe that exceeds ``timeout_ms`` (loss, or a congested peer) is
+        fed to the controller as an RTT of the full timeout — loss IS a
+        congestion signal, and bounding the wait keeps a background CC
+        thread live through drops.
 
         ``probe_fifo`` MUST reference a dedicated scratch window on the peer
         (e.g. ``peer.advertise(peer.reg(np.zeros(1, np.uint8)))``) — the
@@ -152,9 +159,22 @@ class RateController:
 
         if RateController._PROBE is None:
             RateController._PROBE = np.zeros(1, np.uint8)
+        # reap probes that timed out earlier but completed/failed since (a
+        # raise here must never propagate — it would kill a background CC
+        # thread over a bookkeeping error)
+        def _still_pending(x):
+            try:
+                return self.ep.poll_async(x) is None
+            except Exception:
+                return False  # terminal either way; drop it
+        self._stale = [x for x in getattr(self, "_stale", []) if _still_pending(x)]
         t0 = time.perf_counter()
-        self.ep.write(conn_id, RateController._PROBE, probe_fifo)
-        rtt_us = (time.perf_counter() - t0) * 1e6
+        xid = self.ep.write_async(conn_id, RateController._PROBE, probe_fifo)
+        if self.ep.wait(xid, timeout_ms):
+            rtt_us = (time.perf_counter() - t0) * 1e6
+        else:
+            self._stale.append(xid)
+            rtt_us = timeout_ms * 1000.0
         self.sample(rtt_us)
         return rtt_us
 

@@ -75,6 +75,103 @@ class Channel:
         # application tag carried in the connect handshake (e.g. which peer
         # rank dialed, for multi-channel topologies like a DCN full mesh)
         self.meta = meta
+        # CC probe scratch: a 1-byte window each side advertises at channel
+        # setup (reference analog: per-flow CC state installed at connection
+        # setup, transport.cc handle_install_flow). Populated by
+        # _exchange_probe_window on every public creation path.
+        self._probe_buf = None
+        self._probe_mr = None
+        self._peer_probe_fifo: Optional[bytes] = None
+        self._cc_stop = None
+        self._cc_thread = None
+        self.cc: Optional[object] = None  # active RateController, if any
+
+    def _exchange_probe_window(self, timeout_ms: int = 10000) -> None:
+        """Mint a 1-byte scratch window and swap descriptors with the peer on
+        path 0 — the landing pad for the CC delay probes. Symmetric send-then
+        -recv; runs before any application traffic on the channel."""
+        self._probe_buf = np.zeros(1, np.uint8)
+        self._probe_mr = self.ep.reg(self._probe_buf)
+        fifo = self.ep.advertise(self._probe_mr)
+        self.ep.send(self.conns[0], b"PF" + fifo)
+        msg = self.ep.recv(self.conns[0], timeout_ms=timeout_ms)
+        if not msg.startswith(b"PF") or len(msg) != 2 + FIFO_ITEM_BYTES:
+            raise IOError(f"probe-window exchange broken: {msg[:8]!r}")
+        self._peer_probe_fifo = msg[2:]
+
+    # -- congestion control (reference: CC in the transport hot path,
+    # transport.cc:2845 EventOnRxACK; here a per-channel probe thread
+    # actuating the endpoint's token-bucket pacer) ------------------------
+    def enable_cc(
+        self,
+        algo: str = "timely",
+        interval_s: float = 0.02,
+        probe_timeout_ms: int = 250,
+    ) -> None:
+        """Start the background delay-probe thread driving the pacer.
+
+        ``algo``: "timely" (RTT gradient) or "swift" (delay-target window).
+        Probes ride this channel's path 0 into the peer's scratch window;
+        timed-out probes feed the controller the full timeout (loss is a
+        congestion signal)."""
+        import threading
+
+        from uccl_tpu.p2p.cc import RateController, SwiftCC, TimelyCC
+
+        if self._peer_probe_fifo is None:
+            raise RuntimeError(
+                "channel has no probe window (built without a handshake?)"
+            )
+        if self._cc_thread is not None:
+            return
+        if algo == "timely":
+            rc = RateController(self.ep, TimelyCC())
+        elif algo == "swift":
+            swift = SwiftCC()
+
+            class _SwiftAdapter:
+                """Feed delays to Swift; expose on_rtt for RateController."""
+
+                def __init__(self, s):
+                    self._s = s
+                    self.rate = s.rate_for_rtt(s.target_delay_us)
+
+                def on_rtt(self, rtt_us):
+                    self._s.on_delay(rtt_us)
+                    self.rate = self._s.rate_for_rtt(rtt_us)
+                    return self.rate
+
+            rc = RateController(self.ep, _SwiftAdapter(swift))
+        else:
+            raise ValueError(f"unknown cc algo {algo!r}")
+        self.cc = rc
+        self._cc_stop = threading.Event()
+
+        def loop():
+            try:
+                while not self._cc_stop.wait(interval_s):
+                    rc.probe(
+                        self.conns[0], self._peer_probe_fifo, probe_timeout_ms
+                    )
+            except Exception:
+                pass  # endpoint/conn closed under us
+            finally:
+                # Never exit leaving the pacer stuck at a collapsed rate.
+                try:
+                    self.ep.set_rate_limit(0)
+                except Exception:
+                    pass
+
+        self._cc_thread = threading.Thread(target=loop, daemon=True)
+        self._cc_thread.start()
+
+    def disable_cc(self) -> None:
+        if self._cc_thread is None:
+            return
+        self._cc_stop.set()
+        self._cc_thread.join(timeout=5)
+        self._cc_thread = None
+        self.ep.set_rate_limit(0)
 
     @classmethod
     def connect(
@@ -92,7 +189,9 @@ class Channel:
             cid = ep.connect(ip, port)
             ep.send(cid, cls._HELLO + token + bytes([i, n_paths]) + meta)
             conns.append(cid)
-        return cls(ep, conns, chunk_bytes, meta)
+        chan = cls(ep, conns, chunk_bytes, meta)
+        chan._exchange_probe_window()
+        return chan
 
     @classmethod
     def _parse_hello(cls, hello: bytes):
@@ -118,7 +217,9 @@ class Channel:
             if t2 != token:
                 raise IOError("path handshake mismatch (interleaved channels?)")
             paths[i2] = cid
-        return cls(ep, [paths[i] for i in range(n_paths)], chunk_bytes, meta)
+        chan = cls(ep, [paths[i] for i in range(n_paths)], chunk_bytes, meta)
+        chan._exchange_probe_window(timeout_ms)
+        return chan
 
 
     @property
@@ -174,6 +275,7 @@ class Channel:
         self._spray(dst, fifo, self.ep.read, self.ep.read_async, timeout_ms)
 
     def close(self) -> None:
+        self.disable_cc()
         for c in self.conns:
             self.ep.remove_conn(c)
 
@@ -187,11 +289,16 @@ class ChannelAcceptor:
     by token, and delivers each completed channel to ``on_channel(chan)``
     (called on the acceptor thread; ``chan.meta`` identifies the dialer)."""
 
-    # Worst-case blocking inside the loop is one accept (200ms) + one hello
-    # recv; close() must join for longer than their sum so the native
-    # endpoint is never destroyed under a thread inside a C call.
+    # Worst-case blocking inside the loop: one accept (200ms) + one hello
+    # recv + one probe-window exchange recv (each _HELLO_TIMEOUT_MS).
+    # close() must join for longer than their sum so the native endpoint is
+    # never destroyed under a thread inside a C call.
     _HELLO_TIMEOUT_MS = 2000
     _PARTIAL_TTL_S = 30.0
+
+    @classmethod
+    def _join_timeout_s(cls) -> float:
+        return 0.2 + 2 * (cls._HELLO_TIMEOUT_MS / 1000.0) + 1.0
 
     def __init__(self, ep: Endpoint, on_channel, chunk_bytes: Optional[int] = None):
         import threading
@@ -240,15 +347,19 @@ class ChannelAcceptor:
             paths[idx] = cid
             if len(paths) == np_:
                 del self._partial[token]
-                self._on_channel(
-                    Channel(
-                        self.ep,
-                        [paths[i] for i in range(np_)],
-                        self._chunk_bytes,
-                        meta0,
-                    )
+                chan = Channel(
+                    self.ep,
+                    [paths[i] for i in range(np_)],
+                    self._chunk_bytes,
+                    meta0,
                 )
+                try:
+                    chan._exchange_probe_window(self._HELLO_TIMEOUT_MS)
+                except Exception:
+                    chan.close()  # dialer died mid-setup
+                    continue
+                self._on_channel(chan)
 
     def close(self):
         self._stop = True
-        self._thread.join(timeout=(self._HELLO_TIMEOUT_MS / 1000.0) + 1.0)
+        self._thread.join(timeout=self._join_timeout_s())

@@ -90,27 +90,47 @@ class DcnGroup:
         self._inbound: dict = {}
         self._inbound_cv = threading.Condition()
         self._broken = False  # poisoned after a failed descriptor exchange
+        # Elastic membership: collectives run over the ACTIVE ranks; heal()
+        # drops dead peers and re-links the ring among survivors (the group-
+        # level closure of the reference's add/remove_remote_endpoint,
+        # p2p/engine.h:269,273 — the endpoint-level verbs are connect()/
+        # remove_conn() on self.ep).
+        self._active: List[int] = list(range(self.world))
+        self._heal_epoch = 0
         self._acceptor = (
             ChannelAcceptor(self.ep, self._on_inbound) if self.world > 1 else None
         )
         if self.world > 1:
             try:
-                nxt = self._addrs[(self.rank + 1) % self.world]
-                self._next = Channel.connect(
-                    self.ep, nxt["ip"], nxt["port"], n_paths,
-                    meta=b"ring:%d" % self.rank,
-                )
-                self._prev = self._wait_inbound(
-                    b"ring:%d" % ((self.rank - 1) % self.world)
-                )
-                algo = str(_cc_algo.get())
-                if algo != "off":
-                    self._next.enable_cc(algo)
+                self._ring_connect()
             except Exception:
                 # Don't leak the acceptor thread + native endpoint when the
                 # bootstrap dies (a peer crashed post-rendezvous).
                 self.close()
                 raise
+
+    def _ring_connect(self) -> None:
+        """(Re)link the bidirectional ring over the active ranks; channel
+        metas carry the heal epoch so survivors of different heals never
+        cross-wire."""
+        n = len(self._active)
+        if n <= 1:
+            self._next = self._prev = None
+            return
+        pos = self._active.index(self.rank)
+        nxt_rank = self._active[(pos + 1) % n]
+        prv_rank = self._active[(pos - 1) % n]
+        a = self._addrs[nxt_rank]
+        self._next = Channel.connect(
+            self.ep, a["ip"], a["port"], self.n_paths,
+            meta=b"ring:%d:%d" % (self._heal_epoch, self.rank),
+        )
+        self._prev = self._wait_inbound(
+            b"ring:%d:%d" % (self._heal_epoch, prv_rank)
+        )
+        algo = str(_cc_algo.get())
+        if algo != "off":
+            self._next.enable_cc(algo)
 
     def _on_inbound(self, chan: Channel):
         with self._inbound_cv:
@@ -126,6 +146,59 @@ class DcnGroup:
                     f"bootstrap failed: no inbound channel {meta!r}"
                 )
             return self._inbound[meta]
+
+    def heal(self, dead_ranks) -> None:
+        """Drop dead peers and re-link the ring among survivors.
+
+        Every survivor must call heal() with the same dead set (e.g. from a
+        HeartbeatMonitor on_failure, or after a collective raised). After it
+        returns, ring collectives and broadcast run over the survivors; the
+        positions of remaining ranks shift to close the gap.
+        """
+        dead = set(dead_ranks)
+        if self.rank in dead:
+            raise RuntimeError("cannot heal a group from a dead rank")
+        if not dead & set(self._active):
+            return
+        self._active = [r for r in self._active if r not in dead]
+        self._heal_epoch += 1
+        # Mesh channels are torn down WHOLESALE, survivors included: an
+        # aborted collective may have left half-consumed R/D control bytes
+        # (or a poisoned descriptor exchange) on any of them; fresh epoch-
+        # tagged channels re-establish lazily with clean queues.
+        for r, ch in list(self._mesh.items()):
+            ch.close()
+        self._mesh.clear()
+        self._mesh_fifos.clear()
+        self._mesh_buf = None
+        self._mesh_seg = 0
+        if self._mesh_mr is not None:
+            self.ep.dereg(self._mesh_mr)
+            self._mesh_mr = None
+        self._broken = False
+        for ch in (self._next, self._prev):
+            if ch is not None:
+                ch.close()
+        self._next = self._prev = None
+        # ring landing state must re-exchange over the new neighbors
+        self._ring_recv = None
+        self._peer_fifo = None
+        if self._ring_mr is not None:
+            self.ep.dereg(self._ring_mr)
+            self._ring_mr = None
+        self._ring_connect()
+        _log.warning(
+            "healed ring: epoch %d, active ranks %s", self._heal_epoch,
+            self._active,
+        )
+
+    @property
+    def active_world(self) -> int:
+        return len(self._active)
+
+    @property
+    def pos(self) -> int:
+        return self._active.index(self.rank)
 
     def close(self):
         if self._next is not None:
@@ -175,9 +248,10 @@ class DcnGroup:
         """Ring allreduce of a host array across the process group (sum).
 
         Chunked ring: reduce-scatter then all-gather, n-1 hops each, every
-        hop a one-sided chunked write through the channel.
+        hop a one-sided chunked write through the channel. Runs over the
+        ACTIVE ranks (post-heal survivors included).
         """
-        n = self.world
+        n = self.active_world
         if n == 1:
             return x.copy()
         flat = np.ascontiguousarray(x).reshape(-1).astype(x.dtype)
@@ -186,7 +260,7 @@ class DcnGroup:
             flat = np.concatenate([flat, np.zeros(pad, x.dtype)])
         buf = flat.reshape(n, -1).copy()
         recv = self._setup_ring_buf(buf[0].nbytes, buf.dtype)
-        r = self.rank
+        r = self.pos
         # reduce-scatter: chunk j accumulates around the ring, lands at member j
         for s in range(n - 1):
             send_slot = (r - s - 1) % n
@@ -205,17 +279,19 @@ class DcnGroup:
         return out.reshape(x.shape)
 
     def all_gather(self, x: np.ndarray) -> np.ndarray:
-        """Gather equal-shaped host arrays from every rank: out[i] = rank i's x."""
-        n = self.world
+        """Gather equal-shaped host arrays from every active rank:
+        out[i] = the array of the i-th ACTIVE rank (== rank i before any
+        heal)."""
+        n = self.active_world
         out = np.empty((n,) + x.shape, x.dtype)
-        out[self.rank] = x
+        out[self.pos] = x
         if n == 1:
             return out
         recv = self._setup_ring_buf(x.nbytes, x.dtype).reshape(x.shape)
         cur = np.ascontiguousarray(x)
         for s in range(n - 1):
             self._ring_hop(cur)
-            src = (self.rank - s - 1) % n
+            src = (self.pos - s - 1) % n
             out[src] = recv
             cur = recv.copy()  # a real copy: recv is reused as the landing
             # buffer next hop while cur is simultaneously being sent
@@ -239,10 +315,12 @@ class DcnGroup:
                 a = self._addrs[j]
                 self._mesh[j] = Channel.connect(
                     self.ep, a["ip"], a["port"], self.n_paths,
-                    meta=b"mesh:%d" % self.rank,
+                    meta=b"mesh:%d:%d" % (self._heal_epoch, self.rank),
                 )
             else:
-                self._mesh[j] = self._wait_inbound(b"mesh:%d" % j)
+                self._mesh[j] = self._wait_inbound(
+                    b"mesh:%d:%d" % (self._heal_epoch, j)
+                )
 
     def _setup_mesh_buf(self, seg: int, peers):
         """Per-source landing regions: one buffer of world segments; peer j
@@ -313,29 +391,32 @@ class DcnGroup:
         yours — each rank moves (world-1) rows total, not (world-1)×world
         like the old gather+select.
         """
-        n = self.world
+        n = self.active_world
         if x.shape[0] != n:
             raise ValueError(f"all_to_all needs leading dim {n}, got {x.shape}")
         x = np.ascontiguousarray(x)
         out = np.empty_like(x)
-        out[self.rank] = x[self.rank]
+        me = self.pos
+        out[me] = x[me]
         if n == 1:
             return out
         row = x[0]
-        self._setup_mesh_buf(row.nbytes, range(n))
+        self._setup_mesh_buf(row.nbytes, self._active)
         for s in range(1, n):
-            dst = (self.rank + s) % n
-            src = (self.rank - s) % n
+            dst_pos = (me + s) % n
+            src_pos = (me - s) % n
+            dst = self._active[dst_pos]
+            src = self._active[src_pos]
             ch_src, ch_dst = self._mesh[src], self._mesh[dst]
             ch_src.send(b"R")  # license src to write my region[src]
             if ch_dst.recv(timeout_ms=30000) != b"R":
                 raise IOError("all_to_all: expected READY")
             item = self._mesh_fifos[dst]
-            ch_dst.write(x[dst], item.slice(0, row.nbytes).pack())
+            ch_dst.write(x[dst_pos], item.slice(0, row.nbytes).pack())
             ch_dst.send(b"D")
             if ch_src.recv(timeout_ms=30000) != b"D":
                 raise IOError("all_to_all: expected DONE")
-            out[src] = (
+            out[src_pos] = (
                 self._mesh_region(src, row.nbytes).view(x.dtype).reshape(row.shape)
             )
         return out
@@ -345,18 +426,21 @@ class DcnGroup:
         ceil(log2 world) rounds; each rank builds only its own tree edges and
         sends at most log(world) copies (vs the old gather path's world×
         traffic)."""
-        n = self.world
+        n = self.active_world
         if n == 1:
             return x.copy()
-        vr = (self.rank - root) % n
+        if root not in self._active:
+            raise ValueError(f"broadcast root {root} is not an active rank")
+        root_pos = self._active.index(root)
+        vr = (self.pos - root_pos) % n
         # Only this rank's tree edges — log(world) channels, not a full mesh.
         partners = set()
         mask = 1
         while mask < n:
             if vr < mask and vr + mask < n:
-                partners.add((vr + mask + root) % n)
+                partners.add(self._active[(vr + mask + root_pos) % n])
             elif mask <= vr < 2 * mask:
-                partners.add((vr - mask + root) % n)
+                partners.add(self._active[(vr - mask + root_pos) % n])
             mask <<= 1
         self._setup_mesh_buf(x.nbytes, partners)
         buf = np.ascontiguousarray(x).copy() if vr == 0 else np.empty_like(x)
@@ -365,7 +449,7 @@ class DcnGroup:
             if vr < mask:  # holders fan out
                 dst_vr = vr + mask
                 if dst_vr < n:
-                    dst = (dst_vr + root) % n
+                    dst = self._active[(dst_vr + root_pos) % n]
                     ch = self._mesh[dst]
                     if ch.recv(timeout_ms=30000) != b"R":
                         raise IOError("broadcast: expected READY")
@@ -373,7 +457,7 @@ class DcnGroup:
                     ch.write(buf, item.slice(0, buf.nbytes).pack())
                     ch.send(b"D")
             elif vr < 2 * mask:  # this round's receivers
-                src = ((vr - mask) + root) % n
+                src = self._active[((vr - mask) + root_pos) % n]
                 ch = self._mesh[src]
                 ch.send(b"R")
                 if ch.recv(timeout_ms=30000) != b"D":

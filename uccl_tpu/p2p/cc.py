@@ -164,9 +164,14 @@ class RateController:
         # thread over a bookkeeping error)
         def _still_pending(x):
             try:
-                return self.ep.poll_async(x) is None
+                if self.ep.poll_async(x) is None:
+                    return True
             except Exception:
-                return False  # terminal either way; drop it
+                pass  # terminal either way; fall through to reap
+            reap = getattr(self.ep, "reap", None)
+            if reap is not None:
+                reap(x)  # drop the cached result nobody will wait() on
+            return False
         self._stale = [x for x in getattr(self, "_stale", []) if _still_pending(x)]
         t0 = time.perf_counter()
         xid = self.ep.write_async(conn_id, RateController._PROBE, probe_fifo)

@@ -89,7 +89,13 @@ class Channel:
     def _exchange_probe_window(self, timeout_ms: int = 10000) -> None:
         """Mint a 1-byte scratch window and swap descriptors with the peer on
         path 0 — the landing pad for the CC delay probes. Symmetric send-then
-        -recv; runs before any application traffic on the channel."""
+        -recv; runs before any application traffic on the channel.
+
+        Eager by design even though CC may stay off: a lazy exchange would
+        race application control messages on path 0 (the peer's first recv
+        could consume the PF frame), and the cost is one 1-byte registration
+        plus one round trip at setup — the dialer's PF is already in flight
+        when the acceptor finishes assembling, so the recv is ~instant."""
         self._probe_buf = np.zeros(1, np.uint8)
         self._probe_mr = self.ep.reg(self._probe_buf)
         fifo = self.ep.advertise(self._probe_mr)
@@ -276,6 +282,12 @@ class Channel:
 
     def close(self) -> None:
         self.disable_cc()
+        if self._probe_mr is not None:
+            try:
+                self.ep.dereg(self._probe_mr)
+            except Exception:
+                pass  # endpoint already closed
+            self._probe_mr = None
         for c in self.conns:
             self.ep.remove_conn(c)
 

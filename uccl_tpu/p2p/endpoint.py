@@ -282,6 +282,14 @@ class Endpoint:
             self._inflight.pop(xfer_id, None)
         return False
 
+    def reap(self, xfer_id: int) -> None:
+        """Forget a transfer's cached terminal result. For callers that
+        observed completion via poll_async and will never wait() on the id —
+        without this, late completions of abandoned transfers (e.g. timed-out
+        CC probes) accumulate in the results cache forever."""
+        self._results.pop(xfer_id, None)
+        self._inflight.pop(xfer_id, None)
+
     # -- two-sided -------------------------------------------------------
     def send(self, conn_id: int, data: Union[bytes, np.ndarray]) -> None:
         if isinstance(data, np.ndarray):

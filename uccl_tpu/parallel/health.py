@@ -46,6 +46,11 @@ class HeartbeatMonitor:
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._started_at: Optional[float] = None
+        # Staleness is judged LOCALLY: we record the local monotonic time at
+        # which each peer's posted value last *changed*. Comparing a peer's
+        # wall clock against ours would turn cross-host clock skew into
+        # false suspicions (or masked failures).
+        self._last_seen: Dict[int, tuple] = {}  # rank -> (value, local_mono)
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -67,25 +72,33 @@ class HeartbeatMonitor:
 
     def beat_once(self) -> None:
         """Post one heartbeat (called by the monitor loop; callable directly
-        from training loops that want heartbeats tied to step progress)."""
+        from training loops that want heartbeats tied to step progress).
+
+        The value is an opaque monotonically-increasing counter — peers only
+        check that it CHANGES, never compare it against their own clocks."""
         self.sess.store.set(
-            f"{self.key}/{self.sess.rank}", json.dumps(time.time()).encode()
+            f"{self.key}/{self.sess.rank}",
+            json.dumps(time.monotonic()).encode(),
         )
 
     # ------------------------------------------------------------------
     def _check_peers(self) -> None:
-        now = time.time()
+        now = time.monotonic()
         newly_dead = []
         for r in range(self.sess.world):
             if r == self.sess.rank:
                 continue
             raw = self.sess.store.get(f"{self.key}/{r}")
-            last = json.loads(raw.decode()) if raw else None
-            if last is None:
+            value = json.loads(raw.decode()) if raw else None
+            if value is None:
                 # never-seen peer gets the full timeout as a startup grace
                 dead = (now - self._started_at) > self.timeout_s
             else:
-                dead = (now - last) > self.timeout_s
+                seen = self._last_seen.get(r)
+                if seen is None or seen[0] != value:
+                    self._last_seen[r] = (value, now)  # changed -> alive now
+                dead = (now - self._last_seen[r][1]) > self.timeout_s
+            last = value
             with self._lock:
                 if dead and r not in self._suspected:
                     self._suspected.add(r)
@@ -100,7 +113,7 @@ class HeartbeatMonitor:
                 self.on_failure(r)
 
     def _run(self) -> None:
-        self._started_at = time.time()
+        self._started_at = time.monotonic()
         self.beat_once()
         self._stop.wait(self.interval_s)
         while not self._stop.is_set():

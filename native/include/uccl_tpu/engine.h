@@ -105,6 +105,15 @@ class Endpoint {
   bool write(uint64_t conn_id, const void* src, size_t len,
              const FifoItem& item);
   bool read(uint64_t conn_id, void* dst, size_t len, const FifoItem& item);
+  // Vectorized (reference: writev/readv over descriptor lists,
+  // p2p/engine.h:311-344, engine_api.cc:448 XferDescList): n transfers
+  // enqueued as ONE batch — one ring pass, one proxy wake — with per-element
+  // completion ids written to xids_out[n].
+  void writev_async(uint64_t conn_id, const void* const* srcs,
+                    const size_t* lens, const FifoItem* items, size_t n,
+                    uint64_t* xids_out);
+  void readv_async(uint64_t conn_id, void* const* dsts, const size_t* lens,
+                   const FifoItem* items, size_t n, uint64_t* xids_out);
 
   // --- two-sided (reference: send/recv_async family)
   bool send(uint64_t conn_id, const void* buf, size_t len);
@@ -263,6 +272,8 @@ class Endpoint {
       uint64_t wid, uint64_t token, uint64_t offset, uint64_t len,
       std::shared_ptr<std::atomic<int>>* pin_out = nullptr);
   void enqueue_task(Task* t);
+  // push a whole batch under one ring lock + one proxy wake
+  void enqueue_tasks(Task* const* ts, size_t n);
 
   int listen_fd_ = -1;
   uint16_t listen_port_ = 0;

@@ -6,6 +6,7 @@
 // environment — see uccl_tpu/p2p/endpoint.py).
 
 #include <cstring>
+#include <vector>
 
 #include "uccl_tpu/engine.h"
 
@@ -89,6 +90,27 @@ uint64_t ucclt_read_async(void* ep, uint64_t conn, void* dst, size_t len,
                           const uint8_t* fifo) {
   return static_cast<Endpoint*>(ep)->read_async(conn, dst, len,
                                                 parse_item(fifo));
+}
+
+// Vectorized transfers over descriptor arrays (reference: XferDescList,
+// engine_api.cc:448). fifos is n packed 64-byte FifoItems; xids_out gets n
+// per-element completion ids. One engine wake per batch.
+void ucclt_writev_async(void* ep, uint64_t conn, const void* const* srcs,
+                        const size_t* lens, const uint8_t* fifos, size_t n,
+                        uint64_t* xids_out) {
+  std::vector<FifoItem> items(n);
+  for (size_t i = 0; i < n; ++i) items[i] = parse_item(fifos + i * 64);
+  static_cast<Endpoint*>(ep)->writev_async(conn, srcs, lens, items.data(), n,
+                                           xids_out);
+}
+
+void ucclt_readv_async(void* ep, uint64_t conn, void* const* dsts,
+                       const size_t* lens, const uint8_t* fifos, size_t n,
+                       uint64_t* xids_out) {
+  std::vector<FifoItem> items(n);
+  for (size_t i = 0; i < n; ++i) items[i] = parse_item(fifos + i * 64);
+  static_cast<Endpoint*>(ep)->readv_async(conn, dsts, lens, items.data(), n,
+                                          xids_out);
 }
 
 // 0 = pending, 1 = done, -1 = error

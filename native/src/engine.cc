@@ -308,14 +308,22 @@ void Endpoint::complete(uint64_t xfer_id, XferState st) {
 }
 
 void Endpoint::enqueue_task(Task* t) {
-  // Route to the engine serving this conn so its tx thread owns the send.
-  auto c = get_conn(t->conn_id);
+  enqueue_tasks(&t, 1);
+}
+
+void Endpoint::enqueue_tasks(Task* const* ts, size_t n) {
+  if (n == 0) return;
+  // Route to the engine serving this conn so its tx thread owns the sends
+  // (all tasks of one batch target the same conn).
+  auto c = get_conn(ts[0]->conn_id);
   EngineCtx& eng = *engines_[c ? c->engine : 0];
   {
     std::lock_guard<std::mutex> lk(eng.push_mtx);
-    while (!eng.ring.push(t)) std::this_thread::yield();
+    for (size_t i = 0; i < n; ++i) {
+      while (!eng.ring.push(ts[i])) std::this_thread::yield();
+    }
   }
-  eng.cv.notify_one();
+  eng.cv.notify_one();  // one wake for the whole batch
 }
 
 uint64_t Endpoint::write_async(uint64_t conn_id, const void* src, size_t len,
@@ -355,6 +363,57 @@ uint64_t Endpoint::read_async(uint64_t conn_id, void* dst, size_t len,
   t->item = item;
   enqueue_task(t);
   return xid;
+}
+
+void Endpoint::writev_async(uint64_t conn_id, const void* const* srcs,
+                            const size_t* lens, const FifoItem* items,
+                            size_t n, uint64_t* xids_out) {
+  std::vector<Task*> batch;
+  batch.reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    uint64_t xid = new_xfer();
+    xids_out[i] = xid;
+    if (lens[i] > items[i].size) {  // reject before it hits the wire
+      complete(xid, XferState::kError);
+      continue;
+    }
+    auto* t = new Task;
+    t->conn_id = conn_id;
+    t->op = Op::kWrite;
+    t->xfer_id = xid;
+    t->src = srcs[i];
+    t->len = lens[i];
+    t->item = items[i];
+    batch.push_back(t);
+  }
+  enqueue_tasks(batch.data(), batch.size());
+}
+
+void Endpoint::readv_async(uint64_t conn_id, void* const* dsts,
+                           const size_t* lens, const FifoItem* items,
+                           size_t n, uint64_t* xids_out) {
+  std::vector<Task*> batch;
+  batch.reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    uint64_t xid = new_xfer();
+    xids_out[i] = xid;
+    if (lens[i] > items[i].size) {
+      complete(xid, XferState::kError);
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> lk(xfers_mtx_);
+      pending_reads_[xid] = PendingRead{dsts[i], lens[i]};
+    }
+    auto* t = new Task;
+    t->conn_id = conn_id;
+    t->op = Op::kRead;
+    t->xfer_id = xid;
+    t->len = lens[i];
+    t->item = items[i];
+    batch.push_back(t);
+  }
+  enqueue_tasks(batch.data(), batch.size());
 }
 
 bool Endpoint::write(uint64_t conn_id, const void* src, size_t len,

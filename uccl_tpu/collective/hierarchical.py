@@ -109,10 +109,23 @@ class DcnGroup:
                 self.close()
                 raise
 
+    def _member_tag(self) -> bytes:
+        """Digest of the ACTIVE membership. Channel metas carry it so two
+        ranks only pair channels when their membership views agree — and a
+        survivor that healed through different intermediate batches (e.g.
+        heal([2]) then heal([2,3]) vs one heal([2,3])) still converges with
+        peers once the views match, which a per-rank call counter cannot
+        guarantee."""
+        import hashlib
+
+        return hashlib.md5(
+            ",".join(map(str, self._active)).encode()
+        ).hexdigest()[:8].encode()
+
     def _ring_connect(self) -> None:
         """(Re)link the bidirectional ring over the active ranks; channel
-        metas carry the heal epoch so survivors of different heals never
-        cross-wire."""
+        metas carry the membership digest so survivors with diverged views
+        never cross-wire."""
         n = len(self._active)
         if n <= 1:
             self._next = self._prev = None
@@ -123,10 +136,10 @@ class DcnGroup:
         a = self._addrs[nxt_rank]
         self._next = Channel.connect(
             self.ep, a["ip"], a["port"], self.n_paths,
-            meta=b"ring:%d:%d" % (self._heal_epoch, self.rank),
+            meta=b"ring:%s:%d" % (self._member_tag(), self.rank),
         )
         self._prev = self._wait_inbound(
-            b"ring:%d:%d" % (self._heal_epoch, prv_rank)
+            b"ring:%s:%d" % (self._member_tag(), prv_rank)
         )
         algo = str(_cc_algo.get())
         if algo != "off":
@@ -315,11 +328,11 @@ class DcnGroup:
                 a = self._addrs[j]
                 self._mesh[j] = Channel.connect(
                     self.ep, a["ip"], a["port"], self.n_paths,
-                    meta=b"mesh:%d:%d" % (self._heal_epoch, self.rank),
+                    meta=b"mesh:%s:%d" % (self._member_tag(), self.rank),
                 )
             else:
                 self._mesh[j] = self._wait_inbound(
-                    b"mesh:%d:%d" % (self._heal_epoch, j)
+                    b"mesh:%s:%d" % (self._member_tag(), j)
                 )
 
     def _setup_mesh_buf(self, seg: int, peers):

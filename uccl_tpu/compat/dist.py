@@ -73,7 +73,8 @@ def get_rank() -> int:
 
 
 def get_world_size() -> int:
-    return _require().world
+    """Size of the ACTIVE group (shrinks after an elastic heal)."""
+    return _require().active_world
 
 
 def all_reduce(x: np.ndarray) -> None:
@@ -83,19 +84,22 @@ def all_reduce(x: np.ndarray) -> None:
 
 
 def all_gather(out_list: List[np.ndarray], x: np.ndarray) -> None:
-    """Fill out_list[i] with rank i's x."""
+    """Fill out_list[i] with the i-th ACTIVE rank's x (== rank i before any
+    heal; after a heal, positions close the gap and the list shrinks)."""
     g = _require()
-    if len(out_list) != g.world:
+    if len(out_list) != g.active_world:
         raise ValueError(
-            f"out_list has {len(out_list)} entries; world size is {g.world}"
+            f"out_list has {len(out_list)} entries; active world size is "
+            f"{g.active_world}"
         )
     gathered = g.all_gather(x)
-    for i in range(g.world):
+    for i in range(g.active_world):
         out_list[i][...] = gathered[i]
 
 
 def all_to_all(out: np.ndarray, x: np.ndarray) -> None:
-    """out[i] receives rank i's row for us; x[j] goes to rank j."""
+    """out[i] receives the i-th active rank's row for us; x[j] goes to the
+    j-th active rank."""
     g = _require()
     out[...] = g.all_to_all(x)
 

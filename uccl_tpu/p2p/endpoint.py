@@ -115,6 +115,17 @@ def _load():
             fn.restype = ctypes.c_uint64
             fn.argtypes = [c, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_size_t,
                            ctypes.c_char_p]
+        for name in ("ucclt_writev_async", "ucclt_readv_async"):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [
+                c, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_void_p),   # srcs/dsts
+                ctypes.POINTER(ctypes.c_size_t),   # lens
+                ctypes.c_char_p,                   # packed fifos (n*64)
+                ctypes.c_size_t,                   # n
+                ctypes.POINTER(ctypes.c_uint64),   # xids_out
+            ]
         lib.ucclt_poll.restype = ctypes.c_int
         lib.ucclt_poll.argtypes = [c, ctypes.c_uint64]
         lib.ucclt_wait.restype = ctypes.c_int
@@ -247,15 +258,51 @@ class Endpoint:
         self._inflight[xid] = dst
         return xid
 
+    def _vec_async(self, c_fn, conn_id: int, arrays, fifos):
+        """Shared descriptor-array fan-out: one C call, one engine wake."""
+        n = len(arrays)
+        bufs = [_as_buffer(a) for a in arrays]
+        ptrs = (ctypes.c_void_p * n)(*[p for p, _ in bufs])
+        lens = (ctypes.c_size_t * n)(*[ln for _, ln in bufs])
+        packed = b"".join(bytes(f) for f in fifos)
+        if len(packed) != n * FIFO_ITEM_BYTES:
+            raise ValueError("fifos must be n packed 64-byte descriptors")
+        xids = (ctypes.c_uint64 * n)()
+        c_fn(self._handle(), conn_id, ptrs, lens, packed, n, xids)
+        out = list(xids)
+        for x, a in zip(out, arrays):
+            self._inflight[x] = a
+        return out
+
+    def writev_async(self, conn_id: int, srcs, fifos):
+        """Vectorized async write over descriptor arrays (reference:
+        writev_async + XferDescList, engine.h:317, engine_api.cc:448):
+        one C call enqueues the whole batch with a single proxy wake.
+        Returns per-element xfer ids."""
+        return self._vec_async(self._lib.ucclt_writev_async, conn_id, srcs, fifos)
+
+    def readv_async(self, conn_id: int, dsts, fifos):
+        """Vectorized async read (reference: readv, engine.h:324)."""
+        return self._vec_async(self._lib.ucclt_readv_async, conn_id, dsts, fifos)
+
     def writev(self, conn_id: int, srcs, fifos) -> None:
         """Vectorized write (reference: writev, engine.h:311)."""
-        xids = [self.write_async(conn_id, s, f) for s, f in zip(srcs, fifos)]
-        for x in xids:
+        for x in self.writev_async(conn_id, srcs, fifos):
             if not self.wait(x):
                 raise IOError("writev element failed")
 
+    def readv(self, conn_id: int, dsts, fifos) -> None:
+        """Vectorized read (reference: readv, engine.h:321)."""
+        for x in self.readv_async(conn_id, dsts, fifos):
+            if not self.wait(x):
+                raise IOError("readv element failed")
+
     def poll_async(self, xfer_id: int) -> Optional[bool]:
-        """None = pending, True = done; raises on error (reference poll_async)."""
+        """None = pending, True = done; raises on error (reference
+        poll_async). Completions are one-shot: the first terminal
+        observation (here or in wait()) consumes the id; polling a consumed
+        id raises. A terminal poll here leaves one cached entry for a
+        follow-up wait() — wait() consumes it."""
         if xfer_id in self._results:
             if self._results.pop(xfer_id):
                 return True
@@ -274,8 +321,10 @@ class Endpoint:
             return self._results.pop(xfer_id)
         ok = self._lib.ucclt_wait(self._handle(), xfer_id, timeout_ms) == 0
         if ok:
+            # Terminal observation consumes the id — caching a True here
+            # "for a follow-up" would leak one entry per completed transfer
+            # (nothing performs the follow-up on success paths).
             self._inflight.pop(xfer_id, None)
-            self._results[xfer_id] = True
             return True
         # distinguish timeout (entry still pending) from a consumed error
         if self._lib.ucclt_poll(self._handle(), xfer_id) != 0:

@@ -69,7 +69,10 @@ def _require() -> DcnGroup:
 
 
 def get_rank() -> int:
-    return _require().rank
+    """This rank's POSITION in the active group (torch.distributed invariant
+    rank < world_size holds across elastic heals; == the global rank until a
+    lower-numbered rank dies). Collective row indices use the same positions."""
+    return _require().pos
 
 
 def get_world_size() -> int:

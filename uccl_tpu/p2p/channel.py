@@ -276,6 +276,28 @@ class Channel:
         """Spray `src` into the peer's advertised window across all paths."""
         self._spray(src, fifo, self.ep.write, self.ep.write_async, timeout_ms)
 
+    def write_compressed(
+        self, src: np.ndarray, fifo: bytes, timeout_ms: int = 60000,
+        group: int = 128,
+    ) -> int:
+        """fp8-compress `src` and spray the blob (reference: DietGPU wire
+        compression on the P2P path, p2p/rdma/compression.h:46). The window
+        owner decodes with :func:`Channel.decode` (blobs self-describe);
+        size the window with ``compress.compressed_bound``. Returns the blob
+        byte count (for measuring the wire ratio)."""
+        from uccl_tpu.p2p.compress import encode_fp8
+
+        blob = encode_fp8(src, group)
+        self.write(blob, fifo, timeout_ms)
+        return int(blob.nbytes)
+
+    @staticmethod
+    def decode(window: np.ndarray) -> np.ndarray:
+        """Decode a compressed blob previously landed in a window."""
+        from uccl_tpu.p2p.compress import decode_fp8
+
+        return decode_fp8(window)
+
     def read(self, dst: np.ndarray, fifo: bytes, timeout_ms: int = 60000) -> None:
         """Chunked multipath one-sided read into `dst`."""
         self._spray(dst, fifo, self.ep.read, self.ep.read_async, timeout_ms)

@@ -1,0 +1,111 @@
+"""Float compression for the DCN wire: fp8 + per-group scales, host-side.
+
+The analog of the reference's DietGPU wire compression
+(p2p/rdma/compression.h:46 FloatCompressCtx — strategy + threshold knobs for
+fp16/bf16/fp32 payloads on the P2P path). Our codec quantizes to
+``float8_e4m3fn`` with per-group f32 scales — the same wire format the EP
+fast path uses on-mesh (ops/quant.py), here as a pure-numpy host codec so the
+transfer engine can move KV caches / weights at ~3.5-3.8x fewer bytes.
+
+Blobs are self-describing (header carries dtype/shape/group), so the window
+owner can decode with no side channel:
+
+    blob = encode_fp8(arr)           # np.uint8, ratio ~3.84x for f32
+    arr2 = decode_fp8(blob)          # dtype+shape restored, |err| <~ 2%
+
+``maybe_compress`` applies the reference-style threshold policy: payloads
+below ``UCCL_TPU_COMPRESS_MIN_BYTES`` or of non-float dtype pass through.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+import ml_dtypes
+import numpy as np
+
+from uccl_tpu.utils.config import param
+
+_min_bytes = param(
+    "compress_min_bytes", 64 * 1024,
+    help="payloads below this (or non-float) skip wire compression",
+)
+
+FP8 = np.dtype(ml_dtypes.float8_e4m3fn)
+FP8_MAX = 448.0  # max normal of e4m3fn
+
+_MAGIC = 0x55435138  # "UCQ8"
+# dtype codes in the header
+_DTYPES = {0: np.dtype(np.float32), 1: np.dtype(ml_dtypes.bfloat16),
+           2: np.dtype(np.float16)}
+_CODES = {v: k for k, v in _DTYPES.items()}
+_HDR = struct.Struct("<IBBBBIQ")  # magic, ver, dtype, ndim, pad, group, elems
+
+
+def compressible(arr: np.ndarray) -> bool:
+    return arr.dtype in _CODES
+
+
+def compressed_bound(shape, dtype, group: int = 128) -> int:
+    """Max blob bytes for an array of this shape/dtype — what the window
+    owner should advertise for a compressed transfer."""
+    elems = int(np.prod(shape))
+    padded = ((elems + group - 1) // group) * group
+    n_groups = padded // group
+    ndim = len(tuple(shape))
+    return _HDR.size + 8 * ndim + 4 * n_groups + padded
+
+
+def encode_fp8(arr: np.ndarray, group: int = 128) -> np.ndarray:
+    """Encode a float array into a self-describing uint8 blob."""
+    if arr.dtype not in _CODES:
+        raise TypeError(f"cannot fp8-compress dtype {arr.dtype}")
+    if arr.ndim > 255:
+        raise ValueError("too many dimensions")
+    flat = np.ascontiguousarray(arr).reshape(-1).astype(np.float32)
+    elems = flat.size
+    padded = ((elems + group - 1) // group) * group
+    if padded != elems:
+        flat = np.concatenate([flat, np.zeros(padded - elems, np.float32)])
+    g = flat.reshape(-1, group)
+    amax = np.max(np.abs(g), axis=1)
+    scale = np.maximum(amax, 1e-12) / FP8_MAX
+    q = (g / scale[:, None]).astype(FP8)
+    hdr = _HDR.pack(_MAGIC, 1, _CODES[arr.dtype], arr.ndim, 0, group, elems)
+    shape = np.asarray(arr.shape, np.uint64).tobytes()
+    return np.frombuffer(
+        hdr + shape + scale.astype(np.float32).tobytes() + q.tobytes(),
+        np.uint8,
+    ).copy()
+
+
+def decode_fp8(blob) -> np.ndarray:
+    """Decode a blob (or a window prefix containing one) back to the
+    original dtype/shape. |error| is bounded by the fp8 relative step
+    (~2^-3 of each group's max)."""
+    # zero-copy view: the window may be huge (a whole KV cache)
+    buf = memoryview(np.ascontiguousarray(np.asarray(blob, np.uint8)))
+    if len(buf) < _HDR.size:
+        raise ValueError("blob shorter than header")
+    magic, ver, dcode, ndim, _, group, elems = _HDR.unpack_from(buf, 0)
+    if magic != _MAGIC or ver != 1 or dcode not in _DTYPES:
+        raise ValueError("not an fp8 wire blob")
+    off = _HDR.size
+    shape = tuple(np.frombuffer(buf, np.uint64, ndim, off).astype(int))
+    off += 8 * ndim
+    padded = ((elems + group - 1) // group) * group
+    n_groups = padded // group
+    scale = np.frombuffer(buf, np.float32, n_groups, off)
+    off += 4 * n_groups
+    q = np.frombuffer(buf, FP8, padded, off).astype(np.float32)
+    out = (q.reshape(-1, group) * scale[:, None]).reshape(-1)[:elems]
+    return out.astype(_DTYPES[dcode]).reshape(shape)
+
+
+def maybe_compress(arr: np.ndarray, group: int = 128) -> Tuple[np.ndarray, bool]:
+    """Threshold policy (reference kMinCompressBytes, compression.h:8):
+    returns (payload, True) when compression applies, else (arr, False)."""
+    if not compressible(arr) or arr.nbytes < int(_min_bytes.get()):
+        return arr, False
+    return encode_fp8(arr, group), True

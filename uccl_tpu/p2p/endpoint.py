@@ -285,28 +285,29 @@ class Endpoint:
         """Vectorized async read (reference: readv, engine.h:324)."""
         return self._vec_async(self._lib.ucclt_readv_async, conn_id, dsts, fifos)
 
+    def _wait_all(self, xids, what: str) -> None:
+        # Drain EVERY element before raising: abandoning the rest of the
+        # batch would leak their _inflight keepalives + native completions.
+        failed = [x for x in xids if not self.wait(x)]
+        if failed:
+            raise IOError(f"{what}: {len(failed)}/{len(xids)} elements failed")
+
     def writev(self, conn_id: int, srcs, fifos) -> None:
         """Vectorized write (reference: writev, engine.h:311)."""
-        for x in self.writev_async(conn_id, srcs, fifos):
-            if not self.wait(x):
-                raise IOError("writev element failed")
+        self._wait_all(self.writev_async(conn_id, srcs, fifos), "writev")
 
     def readv(self, conn_id: int, dsts, fifos) -> None:
         """Vectorized read (reference: readv, engine.h:321)."""
-        for x in self.readv_async(conn_id, dsts, fifos):
-            if not self.wait(x):
-                raise IOError("readv element failed")
+        self._wait_all(self.readv_async(conn_id, dsts, fifos), "readv")
 
     def poll_async(self, xfer_id: int) -> Optional[bool]:
         """None = pending, True = done; raises on error (reference
         poll_async). Completions are one-shot: the first terminal
         observation (here or in wait()) consumes the id; polling a consumed
-        id raises. A terminal poll here leaves one cached entry for a
+        id raises. A successful terminal poll leaves one cached entry for a
         follow-up wait() — wait() consumes it."""
         if xfer_id in self._results:
-            if self._results.pop(xfer_id):
-                return True
-            raise IOError(f"transfer {xfer_id} failed")
+            return True  # parked success; wait() consumes it
         r = self._lib.ucclt_poll(self._handle(), xfer_id)
         if r == 0:
             return None
@@ -317,8 +318,10 @@ class Endpoint:
         raise IOError(f"transfer {xfer_id} failed")
 
     def wait(self, xfer_id: int, timeout_ms: int = 30000) -> bool:
-        if xfer_id in self._results:
-            return self._results.pop(xfer_id)
+        # _results holds only successful ids parked by poll_async for a
+        # follow-up wait (errors raise there and then); popping one is True.
+        if self._results.pop(xfer_id, None) is not None:
+            return True
         ok = self._lib.ucclt_wait(self._handle(), xfer_id, timeout_ms) == 0
         if ok:
             # Terminal observation consumes the id — caching a True here

@@ -24,7 +24,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=0,
                     help="force N virtual CPU devices (0 = use real devices)")
-    ap.add_argument("--algo", default="both", choices=["xla", "ring", "both"])
+    ap.add_argument(
+        "--algo", default="both", choices=["xla", "ring", "torus", "both", "all"]
+    )
+    ap.add_argument(
+        "--mesh2d", default="", metavar="AxB",
+        help="use a 2D mesh (e.g. 2x4) — enables the torus algo",
+    )
     ap.add_argument("--min-bytes", type=int, default=1 << 12)
     ap.add_argument("--max-bytes", type=int, default=1 << 26)
     ap.add_argument("--iters", type=int, default=10)
@@ -38,9 +44,20 @@ def main():
     from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
 
     n = len(jax.devices())
-    mesh = make_mesh(MeshConfig(dp=n))
-    comm = Communicator(mesh, "dp")
-    algos = ["xla", "ring"] if args.algo == "both" else [args.algo]
+    if args.mesh2d:
+        a, b = (int(v) for v in args.mesh2d.lower().split("x"))
+        assert a * b == n, f"mesh {a}x{b} != {n} devices"
+        mesh = make_mesh(MeshConfig(dp=a, tp=b))
+        comm = Communicator(mesh, ("dp", "tp"))
+    else:
+        mesh = make_mesh(MeshConfig(dp=n))
+        comm = Communicator(mesh, "dp")
+    if args.algo == "both":
+        algos = ["xla", "ring"]
+    elif args.algo == "all":
+        algos = ["xla", "ring"] + (["torus"] if args.mesh2d else [])
+    else:
+        algos = [args.algo]
 
     print(f"# all_reduce_perf  world={n}  devices={jax.devices()[0].platform}")
     print(f"# {'bytes':>12} {'algo':>6} {'time_us':>10} {'algbw_GB/s':>10} {'busbw_GB/s':>10}")

@@ -121,3 +121,21 @@ class TestCache:
         n = len(comm._cache)
         comm.all_reduce(gx)
         assert len(comm._cache) == n
+
+
+class TestTorusAlgo:
+    def test_torus_matches_xla(self, devices, rng):
+        from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=2, tp=4), devices)
+        comm = Communicator(mesh, ("dp", "tp"))
+        x = comm.device_put(rng.standard_normal((8, 33)).astype(np.float32))
+        got = np.asarray(comm.all_reduce(x, algo="torus"))
+        want = np.asarray(comm.all_reduce(x, algo="xla"))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_torus_needs_two_axes(self, mesh_dp8, rng):
+        comm = Communicator(mesh_dp8, "dp")
+        x = comm.device_put(rng.standard_normal((8, 8)).astype(np.float32))
+        with pytest.raises(ValueError, match="2-axis"):
+            comm.all_reduce(x, algo="torus")

@@ -98,3 +98,113 @@ class TestCommunicatorRing:
         a = np.asarray(comm.all_reduce(gx))
         b = np.asarray(comm.all_reduce(gx, algo="ring"))
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def mesh2d(devices):
+    return make_mesh(MeshConfig(dp=2, tp=4), devices)
+
+
+class TestChunkGraph:
+    def test_layers_respect_deps(self):
+        g = plan.graph_bidirectional_all_reduce(4, "dp")
+        layers = g.layers()
+        # two independent chains -> each layer holds one op per stream
+        assert all(len(layer) == 2 for layer in layers)
+        assert len(layers) == 2 * (4 - 1)
+        done = set()
+        for layer in layers:
+            for op in layer:
+                assert all(d in done for d in op.deps)
+            done |= {op.id for op in layer}
+
+    def test_cycle_detected(self):
+        ops = (
+            plan.ChunkOp(0, (1,), 0, 1, 0, -1, False),
+            plan.ChunkOp(1, (0,), 0, 1, 0, -1, False),
+        )
+        g = plan.ChunkGraph(("dp",), (8,), 1, ops)
+        with pytest.raises(ValueError, match="cycle"):
+            g.layers()
+
+    def test_validation(self):
+        bad = plan.ChunkGraph(
+            ("dp",), (8,), 1, (plan.ChunkOp(0, (), 3, 1, 0, -1, False),)
+        )
+        with pytest.raises(ValueError, match="axis"):
+            bad.validate()
+        bad2 = plan.ChunkGraph(
+            ("dp", "tp"), (2, 4), 1,
+            (plan.ChunkOp(0, (), 1, 1, 0, -1, False, shard_axis=1),),
+        )
+        with pytest.raises(ValueError, match="shard"):
+            bad2.validate()
+
+    def test_ring_graph_matches_psum(self, mesh, rng):
+        x = rng.standard_normal((8, 32)).astype(np.float32)
+        g = plan.graph_from_ring(plan.plan_all_reduce(8), "dp")
+        got = _run(mesh, lambda v: plan.execute_graph(g, v), x)
+        want = np.broadcast_to(x.sum(0, keepdims=True), x.shape)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_graph_matches_psum(self, mesh, rng):
+        x = rng.standard_normal((8, 64)).astype(np.float32)
+        g = plan.graph_bidirectional_all_reduce(8, "dp")
+        got = _run(mesh, lambda v: plan.execute_graph(g, v), x)
+        want = np.broadcast_to(x.sum(0, keepdims=True), x.shape)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestTorus2D:
+    @pytest.mark.parametrize("payload", [16, 23, 256])
+    def test_matches_psum(self, mesh2d, rng, payload):
+        x = rng.standard_normal((2, 4, payload)).astype(np.float32)
+
+        def f(v):
+            return plan.torus_all_reduce(v[0, 0], ("dp", "tp"))[None, None]
+
+        got = np.asarray(
+            jax.jit(
+                jax.shard_map(
+                    f, mesh=mesh2d, in_specs=(P("dp", "tp"),),
+                    out_specs=P("dp", "tp"), check_vma=False,
+                )
+            )(x)
+        )
+        want = x.sum(axis=(0, 1))
+        for i in range(2):
+            for j in range(4):
+                np.testing.assert_allclose(got[i, j], want, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("shape", [(1, 8), (8, 1)])
+    def test_degenerate_axis_falls_back(self, devices, rng, shape):
+        """A 1-sized torus axis routes through the flat ring on the other."""
+        a, b = shape
+        m = make_mesh(MeshConfig(dp=a, tp=b), devices[: a * b])
+        x = rng.standard_normal((a, b, 16)).astype(np.float32)
+
+        def f(v):
+            return plan.torus_all_reduce(v[0, 0], ("dp", "tp"))[None, None]
+
+        got = np.asarray(
+            jax.jit(
+                jax.shard_map(
+                    f, mesh=m, in_specs=(P("dp", "tp"),),
+                    out_specs=P("dp", "tp"), check_vma=False,
+                )
+            )(x)
+        )
+        want = x.sum(axis=(0, 1))
+        for i in range(a):
+            for j in range(b):
+                np.testing.assert_allclose(got[i, j], want, rtol=1e-4, atol=1e-5)
+
+
+class TestTreeBroadcast:
+    @pytest.mark.parametrize("root", [0, 3, 7])
+    def test_all_ranks_get_root_value(self, mesh, rng, root):
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        got = _run(mesh, lambda v: plan.tree_broadcast(v[0], "dp", root)[None], x,
+                   in_spec=P("dp"), out_spec=P("dp"))
+        for i in range(8):
+            np.testing.assert_array_equal(got[i], x[root])

@@ -103,7 +103,9 @@ class Communicator:
 
         ``algo="xla"`` lowers to lax.psum (XLA's collective schedule);
         ``algo="ring"`` runs the explicit bidirectional chunk-ring schedule
-        from :mod:`uccl_tpu.collective.plan` (sum only).
+        from :mod:`uccl_tpu.collective.plan` (sum only);
+        ``algo="torus"`` runs the 2D axis-pair chunk-graph schedule (sum
+        only; the communicator must span exactly two mesh axes).
         """
         self._check(x)
         ax = self._axis_name()
@@ -117,6 +119,16 @@ class Communicator:
                     from uccl_tpu.collective.plan import ring_all_reduce
 
                     return ring_all_reduce(v, ax)
+                if algo == "torus":
+                    if op != ReduceOp.SUM:
+                        raise ValueError("torus allreduce supports sum only")
+                    if len(self.axes) != 2:
+                        raise ValueError(
+                            "torus allreduce needs a 2-axis communicator"
+                        )
+                    from uccl_tpu.collective.plan import torus_all_reduce
+
+                    return torus_all_reduce(v, self.axes)
                 if op == ReduceOp.SUM:
                     return lax.psum(v, ax)
                 if op == ReduceOp.MAX:

@@ -100,6 +100,22 @@ def plan_all_reduce(world: int, direction: int = 1) -> RingPlan:
     return RingPlan(world, world, rs + ag, "all_reduce")
 
 
+def _hop(buf, axis, n: int, dir: int, send_off: int, recv_off: int,
+         combine: bool):
+    """The core ring-hop primitive: one rank-relative send/recv on ``buf``
+    whose dim 0 indexes the axis's chunk slots. Shared by the RingPlan
+    lowering and the chunk-graph executor so the slot arithmetic lives in
+    exactly one place."""
+    r = lax.axis_index(axis)
+    send_slot = (r + dir * send_off) % n
+    recv_slot = (r + dir * recv_off) % n
+    chunk = lax.dynamic_index_in_dim(buf, send_slot, axis=0, keepdims=False)
+    got = lax.ppermute(chunk, axis, ppermute_pairs(n, dir))
+    cur = lax.dynamic_index_in_dim(buf, recv_slot, axis=0, keepdims=False)
+    new = cur + got if combine else got
+    return lax.dynamic_update_index_in_dim(buf, new, recv_slot, axis=0)
+
+
 def lower(plan: RingPlan, axis: Axis):
     """Lower a plan to a per-shard step function.
 
@@ -112,14 +128,7 @@ def lower(plan: RingPlan, axis: Axis):
 
     def step_fn(buf, s):
         st = plan.steps[s]
-        r = lax.axis_index(axis)
-        send_slot = (r + st.dir * st.send_off) % n
-        recv_slot = (r + st.dir * st.recv_off) % n
-        chunk = lax.dynamic_index_in_dim(buf, send_slot, axis=0, keepdims=False)
-        got = lax.ppermute(chunk, axis, ppermute_pairs(n, st.dir))
-        cur = lax.dynamic_index_in_dim(buf, recv_slot, axis=0, keepdims=False)
-        new = cur + got if st.combine else got
-        return lax.dynamic_update_index_in_dim(buf, new, recv_slot, axis=0)
+        return _hop(buf, axis, n, st.dir, st.send_off, st.recv_off, st.combine)
 
     return step_fn
 
@@ -171,6 +180,261 @@ def ring_all_reduce(
     )
     bwd = execute(rev_plan, flat[half:], axis)
     return jnp.concatenate([fwd, bwd]).reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Chunk DAG (the general layer): ops with dependencies, executed by BFS layer
+#
+# The reference's ukernel emits a Chunk DAG with deps, tiles it, and executes
+# per BFS layer over async backends (chunk_graph.h:12-31, lower.h:13-41,
+# executor.h:26-60). The TPU-normal form: every op is a ring-style hop on ONE
+# mesh axis acting on ONE chunk stream; ops in the same BFS layer are
+# independent, so their ppermutes are all issued before any result is
+# consumed and XLA's async scheduler overlaps them — multi-ring and
+# multi-axis (torus) schedules fall out of the dep structure.
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkOp:
+    """One DAG node: a ring hop on ``axes[axis_idx]`` over chunk stream
+    ``stream``. Slot arithmetic is rank-relative exactly like RingStep.
+
+    ``shard_axis``: when set, the op first restricts the slot view to this
+    member's OWN slot group along that axis (dynamic index by its coordinate)
+    and rings only that group — the hierarchical-bandwidth move (e.g. the 2D
+    torus middle phase rings 1/a of the buffer, not all of it)."""
+
+    id: int
+    deps: Tuple[int, ...]
+    axis_idx: int
+    dir: int
+    send_off: int
+    recv_off: int
+    combine: bool
+    stream: int = 0
+    shard_axis: int | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkGraph:
+    """A collective as a dependency DAG of chunk ops over mesh axes.
+
+    ``worlds[i]`` is the ring size of ``axes[i]`` (validated against the mesh
+    at execution). ``n_streams`` buffer partitions let independent schedules
+    (e.g. counter-rotating rings) run concurrently.
+    """
+
+    axes: Tuple[str, ...]
+    worlds: Tuple[int, ...]
+    n_streams: int
+    ops: Tuple[ChunkOp, ...]
+    name: str = "graph"
+
+    def validate(self) -> None:
+        ids = {op.id for op in self.ops}
+        if len(ids) != len(self.ops):
+            raise ValueError("duplicate op ids")
+        for op in self.ops:
+            if not 0 <= op.axis_idx < len(self.axes):
+                raise ValueError(f"op {op.id}: bad axis index {op.axis_idx}")
+            if op.dir not in (-1, 1):
+                raise ValueError(f"op {op.id}: bad direction {op.dir}")
+            if not 0 <= op.stream < self.n_streams:
+                raise ValueError(f"op {op.id}: bad stream {op.stream}")
+            if op.shard_axis is not None:
+                if not 0 <= op.shard_axis < len(self.axes):
+                    raise ValueError(f"op {op.id}: bad shard axis")
+                if op.shard_axis == op.axis_idx:
+                    raise ValueError(f"op {op.id}: shard axis == ring axis")
+            for d in op.deps:
+                if d not in ids:
+                    raise ValueError(f"op {op.id}: unknown dep {d}")
+
+    def layers(self) -> List[List[ChunkOp]]:
+        """Topological BFS layers: ops whose deps are all satisfied by
+        earlier layers. Raises on cycles."""
+        remaining = {op.id: op for op in self.ops}
+        done: set = set()
+        out: List[List[ChunkOp]] = []
+        while remaining:
+            layer = [
+                op for op in remaining.values()
+                if all(d in done for d in op.deps)
+            ]
+            if not layer:
+                raise ValueError(f"cycle in chunk graph {self.name}")
+            layer.sort(key=lambda op: op.id)
+            out.append(layer)
+            for op in layer:
+                done.add(op.id)
+                del remaining[op.id]
+        return out
+
+
+def graph_from_ring(plan: RingPlan, axis: str) -> ChunkGraph:
+    """Lift a linear RingPlan into DAG form (each step depends on the last)."""
+    ops = tuple(
+        ChunkOp(
+            id=i,
+            deps=(i - 1,) if i else (),
+            axis_idx=0,
+            dir=st.dir,
+            send_off=st.send_off,
+            recv_off=st.recv_off,
+            combine=st.combine,
+        )
+        for i, st in enumerate(plan.steps)
+    )
+    return ChunkGraph((axis,), (plan.world,), 1, ops, plan.name)
+
+
+def graph_bidirectional_all_reduce(world: int, axis: str) -> ChunkGraph:
+    """Two counter-rotating rings on independent streams: every BFS layer
+    carries one hop in EACH ICI direction of the axis (the torus analog of
+    UCCL's multipath spraying, transport.cc:2186)."""
+    fwd = plan_all_reduce(world, 1).steps
+    ops: List[ChunkOp] = []
+    for i, st in enumerate(fwd):
+        ops.append(ChunkOp(2 * i, (2 * (i - 1),) if i else (), 0, st.dir,
+                           st.send_off, st.recv_off, st.combine, stream=0))
+        ops.append(ChunkOp(2 * i + 1, (2 * (i - 1) + 1,) if i else (), 0,
+                           -st.dir, st.send_off, st.recv_off, st.combine,
+                           stream=1))
+    return ChunkGraph((axis,), (world,), 2, tuple(ops), "all_reduce_bidir")
+
+
+def graph_torus_all_reduce(
+    worlds: Tuple[int, int], axes: Tuple[str, str]
+) -> ChunkGraph:
+    """2D-torus (axis-pair) allreduce: reduce-scatter along axis 0, allreduce
+    the scattered shard along axis 1, all-gather back along axis 0 — each
+    phase a ring on its own axis, chained by deps. Bandwidth per member:
+    2(a-1)/a + 2(b-1)/(a·b) of the buffer vs 2(ab-1)/(ab) for one flat ring,
+    but with hops only between torus NEIGHBORS on both axes (a flat ring over
+    a 2D slice must snake, paying non-neighbor links)."""
+    a, b = worlds
+    ax0, ax1 = axes
+    ops: List[ChunkOp] = []
+    nid = 0
+    last = None
+
+    def add(axis_idx, st, shard_axis=None):
+        nonlocal nid, last
+        ops.append(ChunkOp(nid, (last,) if last is not None else (), axis_idx,
+                           st.dir, st.send_off, st.recv_off, st.combine,
+                           shard_axis=shard_axis))
+        last = nid
+        nid += 1
+
+    for st in plan_reduce_scatter(a).steps:
+        add(0, st)
+    # middle phase rings ONLY the axis-0 shard this member owns: 1/a of the
+    # buffer per hop (the hierarchical bandwidth structure)
+    for st in plan_all_reduce(b).steps:
+        add(1, st, shard_axis=0)
+    for st in plan_all_gather(a).steps:
+        add(0, st)
+    return ChunkGraph((ax0, ax1), (a, b), 1, tuple(ops), "all_reduce_torus2d")
+
+
+def execute_graph(graph: ChunkGraph, x: jax.Array):
+    """Run a chunk graph on per-shard data ``x`` inside shard_map code.
+
+    The buffer is split into ``n_streams`` streams; each stream is chunked
+    into slots. Ring ops index slots rank-relatively on their own axis.
+    For the 2D torus the slot layout is hierarchical: axis-0 slots subdivide
+    into axis-1 slots ([a, b, ...] view), which is what makes phase 2 operate
+    on the axis-0 shard this member keeps.
+    """
+    graph.validate()
+    worlds = tuple(lax.axis_size(ax) for ax in graph.axes)
+    if worlds != graph.worlds:
+        raise ValueError(f"mesh axis sizes {worlds} != plan worlds {graph.worlds}")
+
+    shape = x.shape
+    flat = x.reshape(-1)
+    total_slots = 1
+    for w in graph.worlds:
+        total_slots *= w
+    per_stream = graph.n_streams * total_slots
+    pad = (-flat.size) % per_stream
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    streams = list(flat.reshape(graph.n_streams, total_slots, -1))
+
+    def ring_hop(arr, dim, op: ChunkOp):
+        """One rank-relative ring hop on `arr` whose `dim` indexes the slots
+        of the op's mesh axis."""
+        ax = graph.axes[op.axis_idx]
+        n = graph.worlds[op.axis_idx]
+        work = jnp.moveaxis(arr, dim, 0)
+        work = _hop(work, ax, n, op.dir, op.send_off, op.recv_off, op.combine)
+        return jnp.moveaxis(work, 0, dim)
+
+    def apply_op(op: ChunkOp, buf):
+        # hierarchical slot view: [w0, w1, ..., payload]
+        view = buf.reshape(graph.worlds + (-1,))
+        if op.shard_axis is None:
+            view = ring_hop(view, op.axis_idx, op)
+        else:
+            rs = lax.axis_index(graph.axes[op.shard_axis])
+            sub = lax.dynamic_index_in_dim(
+                view, rs, axis=op.shard_axis, keepdims=False
+            )
+            dim = op.axis_idx - (1 if op.axis_idx > op.shard_axis else 0)
+            sub = ring_hop(sub, dim, op)
+            view = lax.dynamic_update_index_in_dim(
+                view, sub, rs, axis=op.shard_axis
+            )
+        return view.reshape(total_slots, -1)
+
+    for layer in graph.layers():
+        # Dep-independent ops still conflict when they touch the SAME
+        # stream's buffer (their slot updates would clobber), so within a
+        # layer ops chain per stream; ops on different streams stay pure
+        # dataflow-parallel and XLA issues their ppermutes concurrently.
+        for op in layer:
+            streams[op.stream] = apply_op(op, streams[op.stream])
+
+    out = jnp.stack(streams).reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
+def torus_all_reduce(x: jax.Array, axes: Tuple[str, str]) -> jax.Array:
+    """Axis-pair allreduce over a 2D torus slice (per-shard fn)."""
+    worlds = (lax.axis_size(axes[0]), lax.axis_size(axes[1]))
+    if worlds[0] == 1:
+        return ring_all_reduce(x, axes[1])
+    if worlds[1] == 1:
+        return ring_all_reduce(x, axes[0])
+    return execute_graph(graph_torus_all_reduce(worlds, axes), x)
+
+
+def tree_broadcast(x: jax.Array, axis: Axis, root: int = 0) -> jax.Array:
+    """Binomial-tree broadcast over a mesh axis (per-shard fn): at round t,
+    members with virtual rank < 2^t forward to virtual rank + 2^t via a
+    partial ppermute; everyone else passes zeros and keeps its value. log2(n)
+    rounds vs one big all-gather."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    r = lax.axis_index(axis)
+    vr = (r - root) % n
+    cur = jnp.where(vr == 0, x, jnp.zeros_like(x))
+    mask = 1
+    while mask < n:
+        pairs = [
+            (((v + root) % n), ((v + mask + root) % n))
+            for v in range(mask)
+            if v + mask < n
+        ]
+        got = lax.ppermute(cur, axis, pairs)
+        receiving = (vr >= mask) & (vr < 2 * mask)
+        cur = jnp.where(receiving, got, cur)
+        mask <<= 1
+    return cur
 
 
 def ring_reduce_scatter(x: jax.Array, axis: Axis) -> jax.Array:

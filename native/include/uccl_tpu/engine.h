@@ -73,10 +73,21 @@ class Endpoint {
   // number of io+tx thread pairs; connections are distributed across engines
   // round-robin (the analog of the reference's UCCL_NUM_ENGINES,
   // collective/rdma/transport_config.h:38 — per-NIC engine threads).
-  explicit Endpoint(uint16_t port, int n_engines = 2);
+  // listen_ip optionally pins the listener to one interface (multi-tenant
+  // hosts); empty/null binds INADDR_ANY.
+  //
+  // THREAT MODEL (matches the reference's RDMA fabric assumptions): this
+  // engine is built for a trusted cluster network. Advertised windows are
+  // guarded by per-window 64-bit random tokens — protection against buggy
+  // peers and stale descriptors, not against an adversary with TCP reach
+  // who can observe traffic. Do not expose the listen port beyond the
+  // cluster fabric; on shared hosts, bind to the fabric interface.
+  explicit Endpoint(uint16_t port, int n_engines = 2,
+                    const char* listen_ip = nullptr);
   ~Endpoint();
 
-  // false if the listen socket could not be bound (port in use).
+  // false if the listen socket could not be bound (port in use, or an
+  // unparseable listen_ip).
   bool ok() const { return listen_fd_ >= 0; }
   uint16_t listen_port() const { return listen_port_; }
 

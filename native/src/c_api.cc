@@ -16,13 +16,18 @@ using uccl_tpu::XferState;
 
 extern "C" {
 
-void* ucclt_create(uint16_t port, int n_engines) {
-  auto* ep = new Endpoint(port, n_engines);
-  if (!ep->ok()) {  // e.g. port already in use
+// listen_ip pins the listener to one interface (nullptr/"" = INADDR_ANY).
+void* ucclt_create_bound(const char* listen_ip, uint16_t port, int n_engines) {
+  auto* ep = new Endpoint(port, n_engines, listen_ip);
+  if (!ep->ok()) {  // port in use, or unparseable listen ip
     delete ep;
     return nullptr;
   }
   return ep;
+}
+
+void* ucclt_create(uint16_t port, int n_engines) {
+  return ucclt_create_bound(nullptr, port, n_engines);
 }
 
 void ucclt_destroy(void* ep) { delete static_cast<Endpoint*>(ep); }

@@ -54,15 +54,22 @@ uint64_t random_token() {
 }
 }  // namespace
 
-Endpoint::Endpoint(uint16_t port, int n_engines) {
+Endpoint::Endpoint(uint16_t port, int n_engines, const char* listen_ip) {
   listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  bool ip_ok = true;
+  if (listen_ip != nullptr && listen_ip[0] != '\0') {
+    ip_ok = ::inet_pton(AF_INET, listen_ip, &addr.sin_addr) == 1;
+  }
   addr.sin_port = htons(port);
-  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+  // Every failure mode falls through to engine creation: a !ok() endpoint
+  // must still be safe to call into (engines_ non-empty).
+  if (!ip_ok ||
+      ::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
       ::listen(listen_fd_, 128) != 0) {
     ::close(listen_fd_);
     listen_fd_ = -1;

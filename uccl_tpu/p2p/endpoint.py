@@ -88,6 +88,10 @@ def _load():
         c = ctypes.c_void_p
         lib.ucclt_create.restype = c
         lib.ucclt_create.argtypes = [ctypes.c_uint16, ctypes.c_int]
+        lib.ucclt_create_bound.restype = ctypes.c_void_p
+        lib.ucclt_create_bound.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int,
+        ]
         lib.ucclt_destroy.argtypes = [c]
         lib.ucclt_listen_port.restype = ctypes.c_uint16
         lib.ucclt_listen_port.argtypes = [c]
@@ -152,14 +156,27 @@ def _as_buffer(arr: np.ndarray) -> Tuple[ctypes.c_void_p, int]:
 
 
 class Endpoint:
-    """P2P transfer endpoint (reference: p2p Endpoint, engine.h:243)."""
+    """P2P transfer endpoint (reference: p2p Endpoint, engine.h:243).
 
-    def __init__(self, port: int = 0, n_engines: int = 2):
+    Threat model: built for a trusted cluster fabric (the reference's RDMA
+    assumption) — window tokens guard against buggy peers and stale
+    descriptors, not adversaries with TCP reach. On multi-tenant hosts pass
+    ``listen_ip`` (or set ``UCCL_TPU_LISTEN_IP``) to pin the listener to the
+    fabric interface instead of INADDR_ANY.
+    """
+
+    def __init__(self, port: int = 0, n_engines: int = 2,
+                 listen_ip: Optional[str] = None):
         self._lib = _load()
-        self._h = self._lib.ucclt_create(port, n_engines)
+        if listen_ip is None:
+            listen_ip = os.environ.get("UCCL_TPU_LISTEN_IP")
+        self._h = self._lib.ucclt_create_bound(
+            listen_ip.encode() if listen_ip else None, port, n_engines
+        )
         if not self._h:
             raise RuntimeError(
-                f"failed to create endpoint (port {port} in use?)"
+                f"failed to create endpoint (port {port} in use, or bad "
+                f"listen ip {listen_ip!r}?)"
             )
         self._mrs = {}  # mr_id -> ndarray (keepalive)
         self._inflight = {}  # xfer_id -> ndarray (keepalive until completion)

@@ -18,8 +18,10 @@ from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from uccl_tpu.collective.plan import tree_broadcast
 from uccl_tpu.utils.topology import ppermute_pairs
 
 
@@ -77,8 +79,197 @@ def gpipe_spmd(
     (xbuf, outbuf, aux), _ = lax.scan(
         step, (xbuf0, outbuf0, aux0), jnp.arange(m + p - 1)
     )
-    # Broadcast the last stage's collected outputs (and every stage's aux) to
-    # all pp members so downstream loss code is uniform SPMD.
-    out = lax.psum(jnp.where(s == p - 1, outbuf, jnp.zeros_like(outbuf)), axis)
+    # Broadcast the last stage's collected outputs to all pp members so
+    # downstream loss code is uniform SPMD — binomial tree (log P rounds of
+    # the buffer) instead of a full-buffer psum of mostly zeros.
+    out = tree_broadcast(outbuf, axis, root=p - 1)
     aux_total = lax.psum(aux, axis)
     return out, aux_total
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (manual schedule): bounded-activation pipeline training
+#
+# GPipe above leans on autodiff: jax.grad through the scan stashes every
+# microbatch's residuals on every stage (fine with remat, but liveness is
+# O(M)). This primitive writes the backward by hand on the classic
+# one-forward-one-backward schedule, so a stage never holds more than
+# min(M, P - s) stashed microbatch INPUTS (activations are recomputed at
+# backward time from the stashed input — the recompute discipline the rest
+# of this framework already uses). The schedule table is built statically by
+# a slot-synchronous simulator; each scan slot does at most one forward and
+# one backward under lax.cond, with activations ppermuting forward and
+# cotangents ppermuting backward every slot.
+
+
+def _simulate_1f1b(m: int, p: int):
+    """Slot-synchronous 1F1B schedule. Returns four [T, P] int tables:
+    (do_fwd, fwd_mb, do_bwd, bwd_mb) — what stage s runs at slot t.
+
+    Policy per stage: run a backward as soon as a cotangent is available;
+    otherwise run the next forward if its input is available. Capping
+    in-flight forwards at (P - s) yields the classic 1F1B memory profile.
+    """
+    fwd_done = [0] * p
+    bwd_done = [0] * p
+    # activation availability: arrival_slot of mb f at stage s
+    ready_f = [[0 if s == 0 else None for _ in range(m)] for s in range(p)]
+    ready_b = [[0 if s == p - 1 else None for _ in range(m)] for s in range(p)]
+    rows = []
+    t = 0
+    while any(bwd_done[s] < m for s in range(p)) and t < 4 * (m + p):
+        row = []
+        for s in range(p):
+            do_f, f_mb, do_b, b_mb = 0, 0, 0, 0
+            inflight = fwd_done[s] - bwd_done[s]
+            b = bwd_done[s]
+            f = fwd_done[s]
+            can_b = (
+                b < m
+                and b < fwd_done[s]  # its own fwd must have run
+                and ready_b[s][b] is not None
+                and ready_b[s][b] <= t
+            )
+            can_f = (
+                f < m
+                and ready_f[s][f] is not None
+                and ready_f[s][f] <= t
+                and inflight < min(m, p - s)  # 1F1B in-flight cap
+            )
+            if can_b:
+                do_b, b_mb = 1, b
+                bwd_done[s] += 1
+            elif can_f:
+                do_f, f_mb = 1, f
+                fwd_done[s] += 1
+            row.append((do_f, f_mb, do_b, b_mb))
+        # propagate availability for slot t+1
+        for s in range(p):
+            do_f, f_mb, do_b, b_mb = row[s]
+            if do_f and s + 1 < p:
+                ready_f[s + 1][f_mb] = t + 1
+            if do_b and s - 1 >= 0:
+                ready_b[s - 1][b_mb] = t + 1
+        rows.append(row)
+        t += 1
+    if any(bwd_done[s] < m for s in range(p)):
+        raise RuntimeError(f"1F1B schedule did not converge (m={m}, p={p})")
+    tab = np.asarray(rows, np.int32)  # [T, P, 4]
+    return tab[..., 0], tab[..., 1], tab[..., 2], tab[..., 3]
+
+
+def one_f_one_b(
+    stage_fn: Callable[..., jax.Array],
+    loss_fn: Callable[[jax.Array], jax.Array],
+    params,
+    xmb: jax.Array,
+    axis: str = "pp",
+):
+    """Manual 1F1B pipeline training step (per-shard fn, inside shard_map).
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` for this member's stage; x/y are
+        one microbatch ``[B_mb, ...]`` with matching shapes across stages.
+      loss_fn: ``y -> scalar`` applied to the LAST stage's outputs, summed
+        over microbatches.
+      params: THIS stage's parameter pytree (already sharded by stage).
+      xmb: ``[M, B_mb, ...]`` microbatches (consumed by stage 0).
+
+    Returns ``(loss, d_params)``: total loss (replicated over pp) and this
+    stage's parameter cotangents. Live stashed state per stage is bounded by
+    min(M, P - s) microbatch INPUTS (buffers are allocated at the uniform
+    SPMD bound: a min(M,P)-slot stash + a min(M,P+1)-slot inbound queue of
+    single microbatches) — the 1F1B liveness profile, vs autodiff-GPipe
+    whose residual liveness grows with M.
+    """
+    p = lax.axis_size(axis)
+    s = lax.axis_index(axis)
+    m = xmb.shape[0]
+    slots = min(m, p)  # stash ring size (>= the per-stage in-flight cap)
+    qslots = min(m, p + 1)  # inbound activation queue (lag bound is p)
+    np_do_f, np_f_mb, np_do_b, np_b_mb = _simulate_1f1b(m, int(p))
+    # Arrival bookkeeping (static): an activation emitted by stage s-1 at
+    # slot t-1 lands in stage s's wire register at slot t and is banked into
+    # the inbound queue — a stage may legally sit on several unconsumed
+    # inputs while it prioritizes backwards, so a single register would drop
+    # them.
+    n_slots = np_do_f.shape[0]
+    np_arr = np.zeros_like(np_do_f)
+    np_arr[1:, 1:] = np_do_f[:-1, :-1]
+    np_arr_idx = np.zeros_like(np_do_f)
+    np_arr_idx[1:] = np.cumsum(np_arr, axis=0)[:-1]
+    do_f_t, f_mb_t = jnp.asarray(np_do_f), jnp.asarray(np_f_mb)
+    do_b_t, b_mb_t = jnp.asarray(np_do_b), jnp.asarray(np_b_mb)
+    arr_t, arr_idx_t = jnp.asarray(np_arr), jnp.asarray(np_arr_idx)
+    fwd_perm = ppermute_pairs(p, 1)
+    bwd_perm = ppermute_pairs(p, -1)
+
+    mb_shape = xmb.shape[1:]
+    zeros_mb = jnp.zeros(mb_shape, xmb.dtype)
+
+    def step(carry, t):
+        stash, queue, fwd_in, bwd_in, dparams, loss_acc = carry
+        do_f = do_f_t[t, s]
+        f_mb = f_mb_t[t, s]
+        do_b = do_b_t[t, s]
+        b_mb = b_mb_t[t, s]
+
+        # ---- bank the wire register into the inbound queue on arrival
+        arrived = arr_t[t, s]
+        bank_at = arr_idx_t[t, s] % qslots
+        cur = lax.dynamic_index_in_dim(queue, bank_at, axis=0, keepdims=False)
+        banked = jnp.where(arrived == 1, fwd_in, cur)
+        queue = lax.dynamic_update_index_in_dim(queue, banked, bank_at, axis=0)
+
+        # ---- forward slot: consume input, stash it, emit activation
+        def fwd(_):
+            x = jnp.where(
+                s == 0,
+                lax.dynamic_index_in_dim(xmb, f_mb, axis=0, keepdims=False),
+                lax.dynamic_index_in_dim(
+                    queue, f_mb % qslots, axis=0, keepdims=False
+                ),
+            )
+            y = stage_fn(params, x)
+            st = lax.dynamic_update_index_in_dim(stash, x, f_mb % slots, axis=0)
+            return y, st
+
+        y_out, stash = lax.cond(
+            do_f == 1, fwd, lambda _: (zeros_mb, stash), None
+        )
+
+        # ---- backward slot: recompute from the stashed input, push grads
+        def bwd(_):
+            x = lax.dynamic_index_in_dim(stash, b_mb % slots, axis=0,
+                                         keepdims=False)
+            y, vjp = jax.vjp(stage_fn, params, x)
+            # last stage sources its cotangent from the loss; others from
+            # the cotangent that arrived over the wire
+            gy = jnp.where(
+                s == p - 1, jax.grad(loss_fn)(y), bwd_in.astype(y.dtype)
+            )
+            dp, dx = vjp(gy)
+            lval = jnp.where(s == p - 1, loss_fn(y), 0.0)
+            return dp, dx, lval
+
+        zero_dp = jax.tree.map(jnp.zeros_like, params)
+        dp, dx_out, lval = lax.cond(
+            do_b == 1, bwd, lambda _: (zero_dp, zeros_mb, jnp.float32(0.0)),
+            None,
+        )
+        dparams = jax.tree.map(jnp.add, dparams, dp)
+        loss_acc = loss_acc + lval
+
+        fwd_next = lax.ppermute(y_out, axis, fwd_perm)
+        bwd_next = lax.ppermute(dx_out, axis, bwd_perm)
+        return (stash, queue, fwd_next, bwd_next, dparams, loss_acc), None
+
+    stash0 = jnp.zeros((slots,) + mb_shape, xmb.dtype)
+    queue0 = jnp.zeros((qslots,) + mb_shape, xmb.dtype)
+    d0 = jax.tree.map(jnp.zeros_like, params)
+    (stash, _, _, _, dparams, loss_acc), _ = lax.scan(
+        step,
+        (stash0, queue0, zeros_mb, zeros_mb, d0, jnp.float32(0.0)),
+        jnp.arange(n_slots),
+    )
+    return lax.psum(loss_acc, axis), dparams

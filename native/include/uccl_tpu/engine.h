@@ -28,6 +28,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "uccl_tpu/pool.h"
 #include "uccl_tpu/ring.h"
 
 namespace uccl_tpu {
@@ -165,6 +166,7 @@ class Endpoint {
     size_t wire_len = 0;
     uint64_t fail_xfer = 0;      // xfer to fail if the conn dies mid-send
     size_t off = 0;              // bytes of (header+payload) already sent
+    bool credited = false;       // stats counted (exactly once per frame)
     const uint8_t* payload() const {
       return owned.empty() ? static_cast<const uint8_t*>(src) : owned.data();
     }
@@ -232,6 +234,22 @@ class Endpoint {
     FifoItem item{};
     std::vector<uint8_t> owned;  // payload owned by the task (read responses)
     uint16_t flags = 0;
+
+    void reset() {  // recycle through the task pool without reallocating
+      conn_id = 0;
+      op = Op::kWrite;
+      xfer_id = 0;
+      src = nullptr;
+      len = 0;
+      item = FifoItem{};
+      owned.clear();
+      // A task freed with a large payload still attached (e.g. a dropped
+      // read response) must not pin that memory in the pool forever.
+      if (owned.capacity() > (64u << 10)) {
+        owned.shrink_to_fit();
+      }
+      flags = 0;
+    }
   };
 
   // One engine = one epoll/io thread + one tx thread + its task ring. The
@@ -241,8 +259,8 @@ class Endpoint {
   struct EngineCtx {
     int epoll_fd = -1;
     int wake_fd = -1;
-    SpscRing<Task*> ring{4096};
-    std::mutex push_mtx;
+    // multi-producer: any app thread + the io thread submit without a lock
+    MpscRing<Task*> ring{4096};
     std::condition_variable cv;
     std::mutex cv_mtx;
     std::thread io_thread;
@@ -320,6 +338,17 @@ class Endpoint {
   std::atomic<uint64_t> bytes_rx_{0};
   std::atomic<double> drop_rate_{0.0};
   std::atomic<uint64_t> rate_bps_{0};
+  // task recycling (reference: shared_pool feeding the engine hot loops,
+  // include/util/shared_pool.h:15) — tasks come from per-thread magazines
+  // instead of new/delete per op
+  SharedPool<Task> task_pool_;
+  Task* alloc_task() {
+    Task* t = task_pool_.get();
+    t->reset();
+    return t;
+  }
+  void free_task(Task* t) { task_pool_.put(t); }
+
   std::mutex pace_mtx_;  // one shared leaky bucket across engines
   std::chrono::steady_clock::time_point pace_next_{};
   void pace(EngineCtx& eng, uint64_t bytes);  // token-bucket wait in tx_loop

@@ -1,0 +1,83 @@
+// Cache-line RPC channel: fixed 64-byte messages, seq-stamped slots.
+//
+// TPU-native equivalent of the reference's lrpc channels (include/util/
+// lrpc.h:18, Barrelfish-style): one cache line per message; the producer
+// stamps a monotonically increasing sequence into the line's header word,
+// the consumer spins on the stamp of ITS next slot — the data-ready check
+// touches only the message line itself (no head/tail ping-pong), which is
+// the property that makes lrpc the right primitive for ultra-hot control
+// paths (doorbells, completions). The consumer additionally publishes a
+// consumed counter the producer reads only when a slot might still be in
+// use, i.e. once per lap.
+
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace uccl_tpu {
+
+// One cache line: 8-byte sequence stamp + 56 bytes of payload.
+struct alignas(64) LrpcMsg {
+  std::atomic<uint64_t> seq{0};  // 0 = never written; else 1-based msg index
+  uint8_t data[56];
+};
+static_assert(sizeof(LrpcMsg) == 64, "LrpcMsg must be one cache line");
+
+constexpr size_t kLrpcPayload = sizeof(LrpcMsg::data);
+
+// SPSC channel over a ring of stamped cache lines.
+class LrpcChannel {
+ public:
+  explicit LrpcChannel(size_t capacity_pow2 = 128) {
+    if (capacity_pow2 == 0 || (capacity_pow2 & (capacity_pow2 - 1)) != 0) {
+      capacity_pow2 = 128;
+    }
+    slots_ = std::vector<LrpcMsg>(capacity_pow2);
+    mask_ = capacity_pow2 - 1;
+  }
+
+  // Producer. False when the ring is full (consumer a full lap behind).
+  bool send(const void* payload, size_t len) {
+    if (len > kLrpcPayload) return false;
+    const uint64_t idx = next_send_;
+    const size_t cap = mask_ + 1;
+    if (idx >= cap &&
+        consumed_.load(std::memory_order_acquire) < idx - cap + 1) {
+      return false;  // slot (idx % cap) still holds an unconsumed message
+    }
+    LrpcMsg& m = slots_[idx & mask_];
+    std::memcpy(m.data, payload, len);
+    if (len < kLrpcPayload) {
+      std::memset(m.data + len, 0, kLrpcPayload - len);
+    }
+    m.seq.store(idx + 1, std::memory_order_release);  // publish
+    next_send_ = idx + 1;
+    return true;
+  }
+
+  // Consumer. False when no new message. The ready check reads only the
+  // target cache line.
+  bool recv(void* out, size_t len) {
+    const uint64_t idx = next_recv_;
+    LrpcMsg& m = slots_[idx & mask_];
+    if (m.seq.load(std::memory_order_acquire) != idx + 1) return false;
+    std::memcpy(out, m.data, len > kLrpcPayload ? kLrpcPayload : len);
+    consumed_.store(idx + 1, std::memory_order_release);
+    next_recv_ = idx + 1;
+    return true;
+  }
+
+  size_t capacity() const { return mask_ + 1; }
+
+ private:
+  std::vector<LrpcMsg> slots_;
+  uint64_t mask_ = 0;
+  uint64_t next_send_ = 0;                // producer-local
+  uint64_t next_recv_ = 0;                // consumer-local
+  std::atomic<uint64_t> consumed_{0};     // consumer progress (per-lap read)
+};
+
+}  // namespace uccl_tpu

@@ -1,0 +1,110 @@
+// Object pool: global freelist + per-thread caches.
+//
+// TPU-native equivalent of the reference's shared_pool (include/util/
+// shared_pool.h:15 — global pool with per-CPU caches feeding the hot
+// engine loops). Objects recycle through a per-thread magazine; refills and
+// flushes hit the mutex-guarded global list in batches, so the steady-state
+// alloc/free path takes no lock. Thread caches reference the pool's core
+// through a weak_ptr, so pools and threads may die in either order.
+
+#pragma once
+
+#include <cstddef>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace uccl_tpu {
+
+template <typename T>
+class SharedPool {
+  struct Core {
+    std::mutex mtx;
+    std::vector<T*> global;
+    size_t magazine;
+    ~Core() {
+      for (T* p : global) delete p;
+    }
+  };
+
+  struct Cache {
+    std::weak_ptr<Core> core;
+    std::vector<T*> items;
+    ~Cache() {  // thread exit: hand items back (or free if pool is gone)
+      if (auto c = core.lock()) {
+        std::lock_guard<std::mutex> lk(c->mtx);
+        for (T* p : items) c->global.push_back(p);
+      } else {
+        for (T* p : items) delete p;
+      }
+    }
+  };
+
+ public:
+  explicit SharedPool(size_t magazine = 32)
+      : core_(std::make_shared<Core>()) {
+    core_->magazine = magazine ? magazine : 32;
+  }
+
+  SharedPool(const SharedPool&) = delete;
+  SharedPool& operator=(const SharedPool&) = delete;
+
+  T* get() {
+    Cache& cache = tls();
+    if (cache.items.empty()) refill(cache.items);
+    if (cache.items.empty()) return new T();  // pool dry: allocate fresh
+    T* p = cache.items.back();
+    cache.items.pop_back();
+    return p;
+  }
+
+  void put(T* p) {
+    Cache& cache = tls();
+    cache.items.push_back(p);
+    if (cache.items.size() >= 2 * core_->magazine) flush(cache.items);
+  }
+
+  size_t global_size() {
+    std::lock_guard<std::mutex> lk(core_->mtx);
+    return core_->global.size();
+  }
+
+ private:
+  Cache& tls() {
+    // Memoize the last-used pool per thread: the steady-state get()/put()
+    // path (one pool instance, by far the common case) skips the map.
+    thread_local const void* last_key = nullptr;
+    thread_local Cache* last_cache = nullptr;
+    if (last_key == core_.get() && last_cache != nullptr) return *last_cache;
+    thread_local std::unordered_map<const void*, Cache> caches;
+    Cache& c = caches[core_.get()];
+    if (c.core.expired()) c.core = core_;
+    last_key = core_.get();
+    last_cache = &c;
+    return c;
+  }
+
+  void refill(std::vector<T*>& items) {
+    std::lock_guard<std::mutex> lk(core_->mtx);
+    size_t take = core_->magazine < core_->global.size()
+                      ? core_->magazine
+                      : core_->global.size();
+    for (size_t i = 0; i < take; ++i) {
+      items.push_back(core_->global.back());
+      core_->global.pop_back();
+    }
+  }
+
+  void flush(std::vector<T*>& items) {
+    std::lock_guard<std::mutex> lk(core_->mtx);
+    while (items.size() > core_->magazine) {
+      core_->global.push_back(items.back());
+      items.pop_back();
+    }
+  }
+
+  std::shared_ptr<Core> core_;
+};
+
+}  // namespace uccl_tpu

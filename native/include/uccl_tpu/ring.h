@@ -57,4 +57,72 @@ class SpscRing {
   std::vector<T> slots_;
 };
 
+
+
+// Bounded multi-producer ring (Vyukov MPMC queue, used single-consumer):
+// producers CAS-claim a slot and publish via its per-slot sequence stamp —
+// the reference uses jring's MPSC mode the same way for task submission
+// from many app threads into one engine (include/util/jring.h).
+template <typename T>
+class MpscRing {
+  struct Cell {
+    std::atomic<uint64_t> seq;
+    T data;
+  };
+
+ public:
+  explicit MpscRing(size_t capacity_pow2) {
+    if (capacity_pow2 == 0 || (capacity_pow2 & (capacity_pow2 - 1)) != 0) {
+      capacity_pow2 = 1024;
+    }
+    cells_ = std::vector<Cell>(capacity_pow2);
+    mask_ = capacity_pow2 - 1;
+    for (size_t i = 0; i < capacity_pow2; ++i) {
+      cells_[i].seq.store(i, std::memory_order_relaxed);
+    }
+  }
+
+  bool push(T v) {
+    uint64_t pos = head_.load(std::memory_order_relaxed);
+    Cell* cell;
+    while (true) {
+      cell = &cells_[pos & mask_];
+      uint64_t seq = cell->seq.load(std::memory_order_acquire);
+      intptr_t dif = static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos);
+      if (dif == 0) {
+        if (head_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed)) {
+          break;
+        }
+      } else if (dif < 0) {
+        return false;  // full
+      } else {
+        pos = head_.load(std::memory_order_relaxed);
+      }
+    }
+    cell->data = std::move(v);
+    cell->seq.store(pos + 1, std::memory_order_release);
+    return true;
+  }
+
+  bool pop(T* out) {
+    uint64_t pos = tail_.load(std::memory_order_relaxed);
+    Cell* cell = &cells_[pos & mask_];
+    uint64_t seq = cell->seq.load(std::memory_order_acquire);
+    intptr_t dif =
+        static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos + 1);
+    if (dif != 0) return false;  // empty (or producer mid-publish)
+    *out = std::move(cell->data);
+    cell->seq.store(pos + mask_ + 1, std::memory_order_release);
+    tail_.store(pos + 1, std::memory_order_relaxed);
+    return true;
+  }
+
+ private:
+  std::vector<Cell> cells_;
+  uint64_t mask_ = 0;
+  alignas(64) std::atomic<uint64_t> head_{0};
+  alignas(64) std::atomic<uint64_t> tail_{0};
+};
+
 }  // namespace uccl_tpu

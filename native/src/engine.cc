@@ -146,7 +146,7 @@ Endpoint::~Endpoint() {
     if (eng->epoll_fd >= 0) ::close(eng->epoll_fd);
     if (eng->wake_fd >= 0) ::close(eng->wake_fd);
     Task* t = nullptr;
-    while (eng->ring.pop(&t)) delete t;
+    while (eng->ring.pop(&t)) free_task(t);
   }
 }
 
@@ -324,11 +324,8 @@ void Endpoint::enqueue_tasks(Task* const* ts, size_t n) {
   // (all tasks of one batch target the same conn).
   auto c = get_conn(ts[0]->conn_id);
   EngineCtx& eng = *engines_[c ? c->engine : 0];
-  {
-    std::lock_guard<std::mutex> lk(eng.push_mtx);
-    for (size_t i = 0; i < n; ++i) {
-      while (!eng.ring.push(ts[i])) std::this_thread::yield();
-    }
+  for (size_t i = 0; i < n; ++i) {  // MPSC ring: lock-free from any thread
+    while (!eng.ring.push(ts[i])) std::this_thread::yield();
   }
   eng.cv.notify_one();  // one wake for the whole batch
 }
@@ -340,7 +337,7 @@ uint64_t Endpoint::write_async(uint64_t conn_id, const void* src, size_t len,
     complete(xid, XferState::kError);
     return xid;
   }
-  auto* t = new Task;
+  Task* t = alloc_task();
   t->conn_id = conn_id;
   t->op = Op::kWrite;
   t->xfer_id = xid;
@@ -362,7 +359,7 @@ uint64_t Endpoint::read_async(uint64_t conn_id, void* dst, size_t len,
     std::lock_guard<std::mutex> lk(xfers_mtx_);
     pending_reads_[xid] = PendingRead{dst, len};
   }
-  auto* t = new Task;
+  Task* t = alloc_task();
   t->conn_id = conn_id;
   t->op = Op::kRead;
   t->xfer_id = xid;
@@ -384,7 +381,7 @@ void Endpoint::writev_async(uint64_t conn_id, const void* const* srcs,
       complete(xid, XferState::kError);
       continue;
     }
-    auto* t = new Task;
+    Task* t = alloc_task();
     t->conn_id = conn_id;
     t->op = Op::kWrite;
     t->xfer_id = xid;
@@ -412,7 +409,7 @@ void Endpoint::readv_async(uint64_t conn_id, void* const* dsts,
       std::lock_guard<std::mutex> lk(xfers_mtx_);
       pending_reads_[xid] = PendingRead{dsts[i], lens[i]};
     }
-    auto* t = new Task;
+    Task* t = alloc_task();
     t->conn_id = conn_id;
     t->op = Op::kRead;
     t->xfer_id = xid;
@@ -540,6 +537,16 @@ bool Endpoint::service_tx(Conn* c, bool* blocked) {
       // deque push_back never invalidates references to existing elements.
       it = &c->txq.front();
     }
+    // Stats credit up front: a peer can receive (and ack) the final bytes
+    // while this thread is between its last send syscall and any post-hoc
+    // accounting, which would let a completed blocking write observe a
+    // stale counter. Counting at transmit-start makes "transfer complete
+    // implies counted" a real ordering guarantee (at the price of counting
+    // a frame a dying conn never finished — acceptable for stats).
+    if (!it->credited) {
+      bytes_tx_.fetch_add(it->total());
+      it->credited = true;  // EAGAIN re-entries must not credit again
+    }
     // Send syscalls run without txq_mtx so app threads can keep enqueueing.
     while (it->off < it->total()) {
       const uint8_t* base;
@@ -564,7 +571,6 @@ bool Endpoint::service_tx(Conn* c, bool* blocked) {
       it->off += static_cast<size_t>(s);
     }
     size_t total = it->total();
-    bytes_tx_.fetch_add(total);
     {
       std::lock_guard<std::mutex> lk(c->txq_mtx);
       c->txq.pop_front();
@@ -637,7 +643,7 @@ void Endpoint::tx_loop(int engine) {
         if (t->xfer_id != 0 && (t->op == Op::kWrite || t->op == Op::kRead)) {
           complete(t->xfer_id, XferState::kError);
         }
-        delete t;
+        free_task(t);
         continue;
       }
       FrameHeader h{};
@@ -661,7 +667,7 @@ void Endpoint::tx_loop(int engine) {
         if (c->txq_bytes.load(std::memory_order_relaxed) > kTxqHighWater) {
           // The requester isn't draining its own responses; dropping lets
           // it time out without growing the owned-copy queue unboundedly.
-          delete t;
+          free_task(t);
           continue;
         }
         h.rid = 0;
@@ -677,7 +683,7 @@ void Endpoint::tx_loop(int engine) {
         h.len = 0;
         enqueue_frame(c, h, nullptr, {}, 0);
       }
-      delete t;
+      free_task(t);
     }
 
     // Phase 2: round-robin nonblocking service of every conn with queued
@@ -739,7 +745,7 @@ void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
     case Op::kRead: {
       // Copy the window contents into a task-owned buffer and hand the
       // (possibly large, blocking) send to the tx proxy thread.
-      auto* t = new Task;
+      Task* t = alloc_task();
       t->conn_id = c->id;
       t->op = Op::kReadResp;
       t->xfer_id = h.xfer_id;
@@ -798,7 +804,7 @@ void Endpoint::finish_rx_frame(Conn* c) {
       c->rx_pin->fetch_sub(1, std::memory_order_acq_rel);
       c->rx_pin.reset();
     }
-    auto* ack = new Task;
+    Task* ack = alloc_task();
     ack->conn_id = c->id;
     ack->op = Op::kWriteAck;
     ack->xfer_id = h.xfer_id;

@@ -1,0 +1,48 @@
+"""Native substrate unit tests (rings, lrpc, pool) + sanitizer gate.
+
+The reference validates its substrate with pure-CPU unit mains
+(util_lrpc_test.cc, util_test.cc — SURVEY.md §4.1) and ships NO sanitizer
+coverage (§5: "the TPU build can do better cheaply") — so our CI runs the
+threaded substrate tests under ThreadSanitizer too.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_NATIVE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+
+
+def _make(target: str, timeout: int = 300):
+    return subprocess.run(
+        ["make", "-C", _NATIVE, target],
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_substrate_units():
+    r = _make("test")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL SUBSTRATE TESTS PASSED" in r.stdout
+
+
+def test_substrate_under_tsan():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    r = _make("tsan", timeout=600)
+    if r.returncode != 0 and "unrecognized" in r.stderr:
+        pytest.skip("toolchain lacks -fsanitize=thread")
+    assert r.returncode == 0, r.stdout + r.stderr[-3000:]
+    assert "ALL SUBSTRATE TESTS PASSED" in r.stdout
+
+
+def test_substrate_under_asan():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    r = _make("asan", timeout=600)
+    if r.returncode != 0 and "unrecognized" in r.stderr:
+        pytest.skip("toolchain lacks -fsanitize=address")
+    assert r.returncode == 0, r.stdout + r.stderr[-3000:]
+    assert "ALL SUBSTRATE TESTS PASSED" in r.stdout

@@ -36,6 +36,8 @@ def _build_if_needed() -> str:
         os.path.join(_NATIVE_DIR, "src", "c_api.cc"),
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "engine.h"),
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "ring.h"),
+        os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "lrpc.h"),
+        os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "pool.h"),
     ]
 
     # Content-hash freshness (not mtimes): a prebuilt .so is only trusted if

@@ -1,0 +1,99 @@
+"""Permutation-traffic microbenchmark: every rank streams to one distinct peer.
+
+The reference's paper microbench pair is incast + permutation traffic
+(collective/rdma/incast/, azure_perm_traffic/ — SURVEY.md §2.1); this is the
+permutation half for the DCN engine: N processes, a derangement pairs each
+sender with one receiver, all flows run concurrently. Healthy transports show
+per-flow bandwidth independent of N (no cross-flow interference).
+
+Usage: python benchmarks/permutation_bench.py [n_ranks] [mb_per_flow]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo path)
+import json
+import multiprocessing as mp
+import sys
+import time
+
+import numpy as np
+
+
+def _rank(idx, n, port_q, target_q, out_q, mb):
+    import os
+    import sys as s2
+
+    s2.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from uccl_tpu.p2p import Endpoint
+
+    with Endpoint(n_engines=2) as ep:
+        port_q.put((idx, ep.port))
+        target_port = target_q.get()
+        src = np.random.default_rng(idx).integers(0, 255, mb << 20, dtype=np.uint8)
+        dst = np.zeros(mb << 20, np.uint8)
+        fifo_local = ep.advertise(ep.reg(dst))
+        conn = ep.connect("127.0.0.1", target_port)
+        ep.send(conn, bytes(fifo_local))  # give MY window to my... see below
+        # Protocol: rank i dials rank perm[i] and sends ITS OWN landing
+        # window; the accepted side uses the received fifo to write into the
+        # dialer. So each rank writes to the peer that dialed it.
+        in_conn = ep.accept(timeout_ms=60000)
+        peer_fifo = ep.recv(in_conn, timeout_ms=60000)
+        ep.send(in_conn, b"go")  # both sides ready
+        assert ep.recv(conn, timeout_ms=60000) == b"go"
+        t0 = time.time()  # absolute: parent computes true overlap window
+        ep.write(in_conn, src, peer_fifo)
+        t1 = time.time()
+        ep.send(in_conn, b"done")
+        assert ep.recv(conn, timeout_ms=120000) == b"done"
+        out_q.put((idx, (mb << 20) / (t1 - t0), t0, t1))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    mb = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    mp.set_start_method("spawn", force=True)
+    port_q, out_q = mp.Queue(), mp.Queue()
+    target_qs = [mp.Queue() for _ in range(n)]
+    procs = [
+        mp.Process(target=_rank, args=(i, n, port_q, target_qs[i], out_q, mb))
+        for i in range(n)
+    ]
+    [p.start() for p in procs]
+    ports = {}
+    for _ in range(n):
+        i, port = port_q.get(timeout=120)
+        ports[i] = port
+    # derangement: rank i targets rank (i+1) % n
+    for i in range(n):
+        target_qs[i].put(ports[(i + 1) % n])
+    rates, starts, ends = {}, [], []
+    for _ in range(n):
+        i, bps, t0, t1 = out_q.get(timeout=300)
+        rates[i] = bps
+        starts.append(t0)
+        ends.append(t1)
+    # true transfer window: first flow start to last flow end (excludes RNG
+    # payload generation and rendezvous, like incast_bench)
+    wall = max(ends) - min(starts)
+    [p.join(60) for p in procs]
+    r = np.array([rates[i] for i in sorted(rates)])
+    print(
+        json.dumps(
+            {
+                "n_ranks": n,
+                "mb_per_flow": mb,
+                "aggregate_GBps": round(n * (mb << 20) / wall / 1e9, 3),
+                "per_flow_MBps_min": round(float(r.min()) / 1e6, 1),
+                "per_flow_MBps_max": round(float(r.max()) / 1e6, 1),
+                "jain_fairness": round(
+                    float(r.sum() ** 2 / (len(r) * (r**2).sum())), 4
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

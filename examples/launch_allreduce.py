@@ -1,0 +1,54 @@
+"""Minimal launched worker: DCN allreduce across launcher-spawned ranks,
+then a full hierarchical (ICI reduce-scatter -> DCN ring -> ICI all-gather)
+allreduce with each process owning its own virtual 4-device mesh.
+
+Run: python scripts/launch.py --nproc 3 --no-jax-dist examples/launch_allreduce.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from uccl_tpu.collective import Communicator
+from uccl_tpu.collective.hierarchical import DcnGroup, hierarchical_all_reduce
+from uccl_tpu.parallel.distributed import initialize_from_env
+from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def main():
+    sess = initialize_from_env()
+    g = DcnGroup(sess, n_paths=2)
+    try:
+        x = np.full(64, float(sess.rank + 1), np.float32)
+        out = g.all_reduce(x)
+        want = sum(range(1, sess.world + 1))
+        assert abs(out[0] - want) < 1e-5, (out[0], want)
+        print(f"rank {sess.rank}/{sess.world}: allreduce sum={out[0]:.1f} OK")
+
+        # hierarchical: this process's 4-device mesh is its "pod"
+        mesh = make_mesh(MeshConfig(dp=4), jax.devices()[:4])
+        comm = Communicator(mesh, "dp")
+        local = comm.device_put(
+            np.full((4, 32), float(sess.rank + 1), np.float32)
+        )
+        result = np.asarray(hierarchical_all_reduce(comm, g, local))
+        want_h = 4 * want  # 4 local members x sum over pods
+        assert np.allclose(result, want_h), (result[0, 0], want_h)
+        print(f"rank {sess.rank}/{sess.world}: hierarchical sum={result[0,0]:.1f} OK")
+    finally:
+        g.close()
+        sess.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -342,12 +342,15 @@ class Endpoint {
   // include/util/shared_pool.h:15) — tasks come from per-thread magazines
   // instead of new/delete per op
   SharedPool<Task> task_pool_;
-  Task* alloc_task() {
-    Task* t = task_pool_.get();
+  // reset at PUT time: a task freed with a large payload attached (e.g. a
+  // dropped read response) must shed that memory before it parks in a
+  // magazine, not at some future realloc. Pool-fresh tasks are default-
+  // constructed, so get() needs no reset.
+  Task* alloc_task() { return task_pool_.get(); }
+  void free_task(Task* t) {
     t->reset();
-    return t;
+    task_pool_.put(t);
   }
-  void free_task(Task* t) { task_pool_.put(t); }
 
   std::mutex pace_mtx_;  // one shared leaky bucket across engines
   std::chrono::steady_clock::time_point pace_next_{};

@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""Fan the repo out to cluster hosts — the reference's rsync.py analog
+(scripts/rsync.py + node_ips/ hostfiles, SURVEY.md §2.5).
+
+Hostfile: one host per line (optionally ``user@host``); '#' comments.
+
+    python scripts/sync.py --hostfile hosts.txt [--dest ~/uccl_tpu] [--jobs 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+EXCLUDES = [".git", "__pycache__", ".pytest_cache", "native/build"]
+
+
+def read_hostfile(path: str):
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                hosts.append(line)
+    return hosts
+
+
+def sync_one(repo: str, host: str, dest: str) -> tuple:
+    cmd = ["rsync", "-az", "--delete"]
+    for e in EXCLUDES:
+        cmd += ["--exclude", e]
+    cmd += [repo.rstrip("/") + "/", f"{host}:{dest}/"]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    return host, r.returncode, r.stderr.strip()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hostfile", required=True)
+    ap.add_argument("--dest", default="~/uccl_tpu")
+    ap.add_argument("--jobs", type=int, default=8)
+    opts = ap.parse_args()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    hosts = read_hostfile(opts.hostfile)
+    if not hosts:
+        print("hostfile is empty", file=sys.stderr)
+        return 1
+    rc = 0
+    with ThreadPoolExecutor(max_workers=opts.jobs) as pool:
+        for host, code, err in pool.map(
+            lambda h: sync_one(repo, h, opts.dest), hosts
+        ):
+            status = "ok" if code == 0 else f"FAILED: {err}"
+            print(f"{host}: {status}")
+            if code != 0:
+                rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

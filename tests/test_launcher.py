@@ -1,0 +1,36 @@
+"""scripts/launch.py smoke: spawn 3 local ranks, run a DCN allreduce."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_launch_local_allreduce():
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(_REPO, "scripts", "launch.py"),
+            "--nproc", "3", "--no-jax-dist",
+            "--coordinator", "127.0.0.1:29481",
+            os.path.join(_REPO, "examples", "launch_allreduce.py"),
+        ],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    for rank in range(3):
+        assert f"rank {rank}/3: allreduce sum=6.0 OK" in r.stdout, r.stdout
+        assert f"rank {rank}/3: hierarchical sum=24.0 OK" in r.stdout, r.stdout
+
+
+def test_launch_failure_propagates(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(_REPO, "scripts", "launch.py"),
+            "--nproc", "2", "--no-jax-dist", str(bad),
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 3

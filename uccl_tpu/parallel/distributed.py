@@ -73,6 +73,24 @@ def initialize(
     return sess
 
 
+def initialize_from_env() -> Session:
+    """Bring up the session from launcher-provided environment variables
+    (``scripts/launch.py`` sets them — the torchrun-shaped entry):
+
+      UCCL_TPU_COORD     rank 0's ip:port
+      UCCL_TPU_RANK      this process's global rank
+      UCCL_TPU_WORLD     total processes
+      UCCL_TPU_INIT_JAX  "0" to skip jax.distributed (default on)
+    """
+    import os
+
+    coord = os.environ["UCCL_TPU_COORD"]
+    rank = int(os.environ["UCCL_TPU_RANK"])
+    world = int(os.environ["UCCL_TPU_WORLD"])
+    init_jax = os.environ.get("UCCL_TPU_INIT_JAX", "1") != "0"
+    return initialize(coord, rank, world, init_jax=init_jax)
+
+
 def exchange(sess: Session, key: str, payload: bytes, timeout_s: float = 60.0) -> List[bytes]:
     """Every rank contributes ``payload`` under ``key``; returns all ranks'
     payloads in rank order (the PeerMeta allgather)."""

@@ -95,8 +95,15 @@ def main() -> int:
                     if q.poll() is None:
                         q.send_signal(signal.SIGTERM)
             time.sleep(0.05)
+        # SIGTERM -> grace -> SIGKILL: a worker wedged in native code must
+        # not hang the launcher (torchrun discipline)
+        deadline = time.time() + 10
         for p in procs:
-            p.wait()
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
     except KeyboardInterrupt:
         for p in procs:
             if p.poll() is None:

@@ -1,10 +1,26 @@
 """scripts/launch.py smoke: spawn 3 local ranks, run a DCN allreduce."""
 
 import os
+import socket
 import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_coordinator() -> str:
+    """Pick a coordinator ip:port whose store port (port+1) is also free."""
+    for _ in range(50):
+        with socket.socket() as a:
+            a.bind(("127.0.0.1", 0))
+            port = a.getsockname()[1]
+        try:
+            with socket.socket() as b:
+                b.bind(("127.0.0.1", port + 1))
+            return f"127.0.0.1:{port}"
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair")
 
 
 def test_launch_local_allreduce():
@@ -12,7 +28,7 @@ def test_launch_local_allreduce():
         [
             sys.executable, os.path.join(_REPO, "scripts", "launch.py"),
             "--nproc", "3", "--no-jax-dist",
-            "--coordinator", "127.0.0.1:29481",
+            "--coordinator", _free_coordinator(),
             os.path.join(_REPO, "examples", "launch_allreduce.py"),
         ],
         capture_output=True, text=True, timeout=180,
@@ -29,7 +45,8 @@ def test_launch_failure_propagates(tmp_path):
     r = subprocess.run(
         [
             sys.executable, os.path.join(_REPO, "scripts", "launch.py"),
-            "--nproc", "2", "--no-jax-dist", str(bad),
+            "--nproc", "2", "--no-jax-dist",
+            "--coordinator", _free_coordinator(), str(bad),
         ],
         capture_output=True, text=True, timeout=60,
     )

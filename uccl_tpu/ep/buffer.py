@@ -32,10 +32,11 @@ _log = get_logger("EP")
 
 class DispatchHandle(NamedTuple):
     """Opaque handle threaded from dispatch to combine (the analog of the
-    reference's handle tuple, ep/bench/buffer.py dispatch returns)."""
+    reference's handle tuple, ep/bench/buffer.py dispatch returns). Compact
+    sorted-form routing — O(T·K) per rank, not a dense [T,E,C] mask."""
 
-    dispatch_mask: jax.Array  # [W, T, E, C] bool
-    combine_weights: jax.Array  # [W, T, E, C] f32
+    slot: jax.Array  # [W, T, K] int32 slot per assignment (E*C = dropped)
+    weights: jax.Array  # [W, T, K] f32 gate weights
 
 
 class Buffer:
@@ -155,15 +156,21 @@ class Buffer:
 
         def f(xv, idx, wts):
             xv, idx, wts = xv[0], idx[0], wts[0]
-            mask, weights, _ = ep_ops.masks_from_topk(idx, wts, e, cap)
-            recv = ep_ops.dispatch(xv, mask, self._axis_name(), wire_fp8=wire_fp8)
-            return recv[None], mask[None], weights[None]
+            # sorted/ragged layout (the fast path): one argsort assigns
+            # capacity slots; dispatch is a gather; drops match the dense
+            # oracle exactly (ep/ops.py)
+            token_for_slot, slot, _ = ep_ops.sorted_from_topk(idx, e, cap)
+            recv = ep_ops.dispatch_sorted(
+                xv, token_for_slot, e, cap, self._axis_name(),
+                wire_fp8=wire_fp8,
+            )
+            return recv[None], slot[None], wts[None]
 
         if topk_weights is None:
             topk_weights = jnp.full(topk_idx.shape, 1.0 / k, jnp.float32)
-        fn = self._jit(key, f, (2, 2, 2), (3, 3, 3))
-        recv, mask, weights = fn(x, topk_idx, topk_weights)
-        return recv, DispatchHandle(mask, weights)
+        fn = self._jit(key, f, (2, 2, 2), (3, 2, 2))
+        recv, slot, weights = fn(x, topk_idx, topk_weights)
+        return recv, DispatchHandle(slot, weights)
 
     def combine(
         self,
@@ -173,14 +180,16 @@ class Buffer:
         wire_fp8: bool = False,
     ) -> jax.Array:
         """expert_out: [W, E_local, W*C, H] → [W, T, H]."""
-        key = ("combine", expert_out.shape, handle.combine_weights.shape, wire_fp8)
+        key = ("combine", expert_out.shape, handle.slot.shape, wire_fp8)
 
-        def f(y, wts):
-            out = ep_ops.combine(y[0], wts[0], self._axis_name(), wire_fp8=wire_fp8)
+        def f(y, slot, wts):
+            out = ep_ops.combine_sorted(
+                y[0], slot[0], wts[0], self._axis_name(), wire_fp8=wire_fp8
+            )
             return out[None]
 
-        fn = self._jit(key, f, (3, 3), 2)
-        return fn(expert_out, handle.combine_weights)
+        fn = self._jit(key, f, (3, 2, 2), 2)
+        return fn(expert_out, handle.slot, handle.weights)
 
     # -- low-latency mode: fp8 payloads on the wire ---------------------
     def low_latency_dispatch(self, x, topk_idx, topk_weights=None):

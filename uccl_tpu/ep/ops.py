@@ -281,6 +281,14 @@ def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype):
     """Member-major all-to-all of a [W, ...] buffer, optionally fp8 on the wire
     (the analog of internode_ll.cu's fp8+scales message packing)."""
     if wire_fp8:
+        h = buf.shape[-1]
+        if h % quant_group:
+            # adapt to the hidden size: the largest divisor of h no bigger
+            # than the requested group (trace-time loop; keeps the scale
+            # overhead minimal instead of gcd's tiny-group collapse)
+            quant_group = max(
+                d for d in range(min(quant_group, h), 0, -1) if h % d == 0
+            )
         q, scale = quantize_fp8(buf, quant_group)
         q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
         scale = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=True)

@@ -24,6 +24,11 @@ def main():
     ap.add_argument("--topk", type=int, default=4)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--fp8", action="store_true")
+    ap.add_argument(
+        "--compare-dense", action="store_true",
+        help="also time the dense [T,E,C] mask-einsum oracle path (the pre-"
+             "round-2 formulation) and print the sorted-path speedup",
+    )
     args = ap.parse_args()
 
     jax = init_devices(args.devices)
@@ -71,6 +76,40 @@ def main():
         out = roundtrip()
     np.asarray(out)
     dt = (time.perf_counter() - t0) / args.iters
+
+    if args.compare_dense:
+        from jax.sharding import PartitionSpec as P
+
+        from uccl_tpu.ep import ops as ep_ops
+
+        cap = buf.capacity(args.tokens)
+
+        # Fair comparison: same precomputed idx/wts as the sorted timing
+        # (no routing math on either side)
+        def dense_f(xv, iv, wv):
+            xv, iv, wv = xv[0], iv[0], wv[0]
+            mask, weights, _ = ep_ops.masks_from_topk(iv, wv, experts, cap)
+            xe = ep_ops.dispatch(xv, mask, "dp")
+            return ep_ops.combine(xe, weights, "dp")[None]
+
+        import jax as _jax
+
+        dense_fn = _jax.jit(
+            _jax.shard_map(
+                dense_f, mesh=mesh, in_specs=(P("dp"), P("dp"), P("dp")),
+                out_specs=P("dp"), check_vma=False,
+            )
+        )
+        np.asarray(dense_fn(x, idx, wts))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(max(1, args.iters // 5)):
+            o = dense_fn(x, idx, wts)
+        np.asarray(o)
+        dt_dense = (time.perf_counter() - t0) / max(1, args.iters // 5)
+        print(
+            f"  dense-mask oracle: {dt_dense * 1e6:.0f} us "
+            f"(sorted path speedup {dt_dense / dt:.1f}x)"
+        )
 
     per_member_bytes = args.tokens * args.hidden * 4 * args.topk  # moved payload
     print(

@@ -24,6 +24,7 @@ import signal
 import subprocess
 import sys
 import threading
+import time
 
 
 def _stream(prefix: str, pipe, out):
@@ -79,8 +80,6 @@ def main() -> int:
     try:
         # Poll ALL workers: a crash in any rank (not just the lowest) must
         # tear the job down even while earlier ranks block in collectives.
-        import time
-
         live = set(range(len(procs)))
         while live and rc == 0:
             for i in sorted(live):
@@ -108,6 +107,13 @@ def main() -> int:
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in procs:  # same TERM -> grace -> KILL discipline on Ctrl-C
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
         rc = 130
     for t in streams:
         t.join(timeout=5)

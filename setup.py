@@ -1,0 +1,45 @@
+"""Wheel build with the native runtime compiled in.
+
+The reference ships one wheel bundling its native libraries per target
+(setup.py:1-120, build.sh containerized builds — SURVEY.md §2.5). Here a
+single `pip wheel .` compiles native/ via its Makefile and packages
+libuccl_tpu.so inside the package (uccl_tpu/_native/), where the lazy loader
+picks it up before falling back to an in-tree source build.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+
+class BinaryDistribution(Distribution):
+    """The wheel carries a compiled .so: tag it platform-specific, never
+    py3-none-any (an any-wheel would install cross-platform and crash at
+    ctypes load time)."""
+
+    def has_ext_modules(self):
+        return True
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        root = os.path.dirname(os.path.abspath(__file__))
+        native = os.path.join(root, "native")
+        subprocess.run(["make", "-C", native], check=True)
+        super().run()
+        dest = os.path.join(self.build_lib, "uccl_tpu", "_native")
+        os.makedirs(dest, exist_ok=True)
+        shutil.copy2(
+            os.path.join(native, "build", "libuccl_tpu.so"),
+            os.path.join(dest, "libuccl_tpu.so"),
+        )
+
+
+setup(
+    cmdclass={"build_py": BuildWithNative},
+    distclass=BinaryDistribution,
+)

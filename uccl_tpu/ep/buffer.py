@@ -154,8 +154,8 @@ class Buffer:
         e = self.num_experts
         key = ("dispatch", x.shape, topk_idx.shape, wire_fp8, x.dtype)
 
-        def f(xv, idx, wts):
-            xv, idx, wts = xv[0], idx[0], wts[0]
+        def f(xv, idx):
+            xv, idx = xv[0], idx[0]
             # sorted/ragged layout (the fast path): one argsort assigns
             # capacity slots; dispatch is a gather; drops match the dense
             # oracle exactly (ep/ops.py)
@@ -164,13 +164,14 @@ class Buffer:
                 xv, token_for_slot, e, cap, self._axis_name(),
                 wire_fp8=wire_fp8,
             )
-            return recv[None], slot[None], wts[None]
+            return recv[None], slot[None]
 
         if topk_weights is None:
             topk_weights = jnp.full(topk_idx.shape, 1.0 / k, jnp.float32)
-        fn = self._jit(key, f, (2, 2, 2), (3, 2, 2))
-        recv, slot, weights = fn(x, topk_idx, topk_weights)
-        return recv, DispatchHandle(slot, weights)
+        fn = self._jit(key, f, (2, 2), (3, 2))
+        recv, slot = fn(x, topk_idx)
+        # weights go straight into the handle (combine reshards them itself)
+        return recv, DispatchHandle(slot, topk_weights)
 
     def combine(
         self,

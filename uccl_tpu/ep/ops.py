@@ -289,6 +289,11 @@ def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype):
             quant_group = max(
                 d for d in range(min(quant_group, h), 0, -1) if h % d == 0
             )
+        if quant_group < 8:
+            # 1 fp8 byte + 4/g scale bytes per element beats bf16's 2 only
+            # for g > 4; awkward hidden sizes (e.g. prime) would INFLATE
+            # wire traffic — ship raw instead.
+            return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
         q, scale = quantize_fp8(buf, quant_group)
         q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
         scale = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=True)

@@ -23,6 +23,12 @@ _log = get_logger("P2P")
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "build", "libuccl_tpu.so")
+# Installed-wheel location: setup.py packages the prebuilt runtime inside the
+# package (uccl_tpu/_native/); present there, no source tree is needed.
+_WHEEL_SO = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "_native", "libuccl_tpu.so",
+)
 
 FIFO_ITEM_BYTES = 64
 
@@ -31,6 +37,10 @@ _lib_lock = threading.Lock()
 
 
 def _build_if_needed() -> str:
+    # Installed wheel: the runtime ships prebuilt inside the package and
+    # there is no source tree to hash or rebuild against.
+    if not os.path.isdir(_NATIVE_DIR) and os.path.exists(_WHEEL_SO):
+        return _WHEEL_SO
     srcs = [
         os.path.join(_NATIVE_DIR, "src", "engine.cc"),
         os.path.join(_NATIVE_DIR, "src", "c_api.cc"),

@@ -17,5 +17,6 @@ Surfaces:
 
 from uccl_tpu.ep import ops
 from uccl_tpu.ep.buffer import Buffer
+from uccl_tpu.ep.cross_pod import CrossPodMoE
 
-__all__ = ["ops", "Buffer"]
+__all__ = ["ops", "Buffer", "CrossPodMoE"]

@@ -36,6 +36,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Renamed from TPUCompilerParams in older jax releases.
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 
 def _is_tpu() -> bool:
     try:
@@ -54,7 +59,11 @@ def _fwd_kernel(
 ):
     """Grid (bh, iq, jk): one KV block per program, streamed through VMEM.
 
-    Ref shapes: q [1, BQ, D]; k/v [1, BK, D]; o [1, BQ, D]; lse [1, BQ].
+    Ref shapes: q [1, BQ, D]; k/v [1, BK, D]; o [1, BQ, D]; lse [1, BQ, 1].
+    The LSE rides as a [BQ, 1] column (trailing singleton) so its block spec
+    is TPU-tileable — a 2-D [1, BQ] block over [B*H, S] violates Mosaic's
+    (8, 128) tiling rule, which only surfaces on real hardware. All kernel
+    arithmetic stays rank-2 for the same reason.
     Scratch (m/l [BQ, 1], acc [BQ, D]) carries the online softmax across the
     jk dimension — jk is innermost, so for a fixed (bh, iq) the programs run
     back-to-back and the scratch is private to that q block.
@@ -89,21 +98,21 @@ def _fwd_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        m = m_ref[:, 0]
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        m = m_ref[:, :]  # [BQ, 1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
-        acc_ref[:, :] = acc_ref[:, :] * corr[:, None] + lax.dot_general(
+        p = jnp.exp(s - m_new)
+        l_ref[:, :] = l_ref[:, :] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:, :] = acc_ref[:, :] * corr + lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        m_ref[:, 0] = m_new
+        m_ref[:, :] = m_new
 
     @pl.when(jk == n_kv - 1)
     def _finish():
-        l = jnp.maximum(l_ref[:, 0], 1e-20)
-        o_ref[0] = (acc_ref[:, :] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, 0] + jnp.log(l)
+        l = jnp.maximum(l_ref[:, :], 1e-20)  # [BQ, 1]
+        o_ref[0] = (acc_ref[:, :] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :] + jnp.log(l)
 
 
 def _flash_fwd(
@@ -137,7 +146,7 @@ def _flash_fwd(
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ),
         grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
@@ -148,13 +157,18 @@ def _flash_fwd(
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, iq, jk: (bh, iq)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, jk: (bh, iq, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max m
             pltpu.VMEM((block_q, 1), jnp.float32),  # normalizer l
             pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
         ],
+        compiler_params=_CompilerParams(
+            # scratch carries state only across jk (innermost); bh/iq programs
+            # are independent, so let megacore split them.
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qt, kt, vt)
     return (
@@ -190,8 +204,8 @@ def _bwd_dq_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]  # [BQ]
-        delta = delta_ref[0]  # [BQ]
+        lse = lse_ref[0]  # [BQ, 1]
+        delta = delta_ref[0]  # [BQ, 1]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -203,11 +217,11 @@ def _bwd_dq_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # masked scores underflow to 0
+        p = jnp.exp(s - lse)  # masked scores underflow to 0
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         acc_ref[:, :] += lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -241,8 +255,8 @@ def _bwd_dkv_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0]  # [BQ, 1]
+        delta = delta_ref[0]  # [BQ, 1]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -254,14 +268,14 @@ def _bwd_dkv_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [BQ, BK]
+        p = jnp.exp(s - lse)  # [BQ, BK]
         dv_acc[:, :] += lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk_acc[:, :] += lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -288,14 +302,16 @@ def _flash_bwd(q, k, v, out, lse, g_out, g_lse, causal, block_q, block_k,
     kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     dot = g_out.transpose(0, 2, 1, 3).reshape(b * h, sq, d).astype(q.dtype)
-    lse_t = lse.reshape(b * h, sq)
+    # LSE/delta travel as [B*H, S, 1] columns (TPU-tileable blocks, see
+    # _fwd_kernel docstring).
+    lse_t = lse.reshape(b * h, sq, 1)
     # Combined row term: Δ − g_lse. The g_lse fold-in makes the LSE output
     # differentiable (dS = P∘(dP − (Δ − g_lse))), which ring merging needs.
     delta = jnp.sum(
         g_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    ).transpose(0, 2, 1).reshape(b * h, sq)
+    ).transpose(0, 2, 1).reshape(b * h, sq, 1)
     if g_lse is not None:
-        delta = delta - g_lse.reshape(b * h, sq)
+        delta = delta - g_lse.reshape(b * h, sq, 1)
 
     common = dict(scale=scale, block_q=block_q, block_k=block_k, causal=causal)
 
@@ -308,11 +324,14 @@ def _flash_bwd(q, k, v, out, lse, g_out, g_lse, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda bh, iq, jk: (bh // n_rep, jk, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, iq, jk: (bh // n_rep, jk, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, iq, jk: (bh, iq)),
-            pl.BlockSpec((1, block_q), lambda bh, iq, jk: (bh, iq)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, jk: (bh, iq, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, jk: (bh, iq, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qt, kt, vt, dot, lse_t, delta)
 
@@ -328,8 +347,8 @@ def _flash_bwd(q, k, v, out, lse, g_out, g_lse, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda bh, jk, iq: (bh // n_rep, jk, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, jk, iq: (bh // n_rep, jk, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, jk, iq: (bh, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, jk, iq: (bh, iq)),
-            pl.BlockSpec((1, block_q), lambda bh, jk, iq: (bh, iq)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, jk, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, jk, iq: (bh, iq, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, d), lambda bh, jk, iq: (bh, jk, 0)),
@@ -339,6 +358,9 @@ def _flash_bwd(q, k, v, out, lse, g_out, g_lse, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qt, kt, vt, dot, lse_t, delta)
 

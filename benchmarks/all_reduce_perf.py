@@ -25,7 +25,8 @@ def main():
     ap.add_argument("--devices", type=int, default=0,
                     help="force N virtual CPU devices (0 = use real devices)")
     ap.add_argument(
-        "--algo", default="both", choices=["xla", "ring", "torus", "both", "all"]
+        "--algo", default="both",
+        choices=["xla", "ring", "hd", "torus", "both", "all"]
     )
     ap.add_argument(
         "--mesh2d", default="", metavar="AxB",
@@ -55,7 +56,7 @@ def main():
     if args.algo == "both":
         algos = ["xla", "ring"]
     elif args.algo == "all":
-        algos = ["xla", "ring"] + (["torus"] if args.mesh2d else [])
+        algos = ["xla", "ring", "hd"] + (["torus"] if args.mesh2d else [])
     else:
         algos = [args.algo]
 
@@ -68,6 +69,10 @@ def main():
             np.random.default_rng(0).standard_normal((n, elems)).astype(np.float32)
         )
         for algo in algos:
+            if algo == "hd" and n & (n - 1):
+                # hd falls back to ring off power-of-two worlds; skip rather
+                # than record ring timings under the hd label
+                continue
             out = comm.all_reduce(x, algo=algo)  # compile + warmup
             np.asarray(out)
             t0 = time.perf_counter()

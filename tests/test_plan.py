@@ -208,3 +208,91 @@ class TestTreeBroadcast:
                    in_spec=P("dp"), out_spec=P("dp"))
         for i in range(8):
             np.testing.assert_array_equal(got[i], x[root])
+
+
+class TestHalvingDoubling:
+    def test_hd_matches_sum(self, mesh, rng):
+        # padding path: 130 elems is not a multiple of world 8
+        x = rng.standard_normal((8, 130)).astype(np.float32)
+        got = _run(mesh, lambda v: plan.hd_all_reduce(v[0], "dp")[None], x,
+                   in_spec=P("dp"), out_spec=P("dp"))
+        want = x.sum(0)
+        for r in range(8):
+            np.testing.assert_allclose(got[r], want, rtol=1e-5, atol=1e-5)
+
+    def test_hd_non_power_of_two_falls_back_to_ring(self, devices, rng):
+        m = make_mesh(MeshConfig(dp=6), devices[:6])
+        comm = Communicator(m, "dp")
+        x = rng.standard_normal((6, 33)).astype(np.float32)
+        gx = comm.device_put(x)
+        np.testing.assert_allclose(
+            np.asarray(comm.all_reduce(gx, algo="hd")),
+            np.asarray(comm.all_reduce(gx)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_hd_small_world_comm(self, devices, rng):
+        m = make_mesh(MeshConfig(dp=2, tp=4), devices)
+        comm = Communicator(m, "dp")
+        x = rng.standard_normal((2, 64)).astype(np.float32)
+        gx = comm.device_put(x)
+        np.testing.assert_allclose(
+            np.asarray(comm.all_reduce(gx, algo="hd")),
+            np.asarray(comm.all_reduce(gx)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_hd_algo_matches_xla_comm(self, mesh, rng):
+        comm = Communicator(mesh, "dp")
+        x = rng.standard_normal((8, 257)).astype(np.float32)
+        gx = comm.device_put(x)
+        np.testing.assert_allclose(
+            np.asarray(comm.all_reduce(gx, algo="hd")),
+            np.asarray(comm.all_reduce(gx)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+class TestSelector:
+    def test_small_power_of_two_prefers_hd(self):
+        assert plan.select_all_reduce_algo(1024, 8) == "hd"
+
+    def test_large_single_axis_prefers_xla(self):
+        assert plan.select_all_reduce_algo(1 << 24, 8) == "xla"
+
+    def test_large_two_axis_prefers_torus(self):
+        assert plan.select_all_reduce_algo(1 << 24, 8, n_axes=2) == "torus"
+
+    def test_world_one_is_xla(self):
+        assert plan.select_all_reduce_algo(1024, 1) == "xla"
+
+    def test_non_power_of_two_small_is_xla(self):
+        assert plan.select_all_reduce_algo(1024, 6) == "xla"
+
+    def test_env_override(self, monkeypatch):
+        from uccl_tpu.utils import config as cfg
+        monkeypatch.setenv("UCCL_TPU_AR_ALGO", "ring")
+        cfg.reset_all()
+        try:
+            assert plan.select_all_reduce_algo(1 << 24, 8) == "ring"
+        finally:
+            monkeypatch.delenv("UCCL_TPU_AR_ALGO")
+            cfg.reset_all()
+
+    def test_auto_algo_through_communicator(self, mesh, rng):
+        comm = Communicator(mesh, "dp")
+        x = rng.standard_normal((8, 64)).astype(np.float32)  # small -> hd
+        gx = comm.device_put(x)
+        np.testing.assert_allclose(
+            np.asarray(comm.all_reduce(gx, algo="auto")),
+            np.asarray(comm.all_reduce(gx)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_auto_non_sum_routes_to_xla(self, mesh, rng):
+        from uccl_tpu.collective.communicator import ReduceOp
+        comm = Communicator(mesh, "dp")
+        x = rng.standard_normal((8, 64)).astype(np.float32)
+        gx = comm.device_put(x)
+        got = np.asarray(comm.all_reduce(gx, op=ReduceOp.MAX, algo="auto"))
+        np.testing.assert_allclose(got, np.tile(x.max(0), (8, 1)), rtol=1e-6)

@@ -104,21 +104,42 @@ class Communicator:
         ``algo="xla"`` lowers to lax.psum (XLA's collective schedule);
         ``algo="ring"`` runs the explicit bidirectional chunk-ring schedule
         from :mod:`uccl_tpu.collective.plan` (sum only);
+        ``algo="hd"`` runs the log-step recursive halving-doubling plan
+        (sum only; power-of-two worlds, ring fallback otherwise);
         ``algo="torus"`` runs the 2D axis-pair chunk-graph schedule (sum
-        only; the communicator must span exactly two mesh axes).
+        only; the communicator must span exactly two mesh axes);
+        ``algo="auto"`` asks :func:`~uccl_tpu.collective.plan.
+        select_all_reduce_algo` (size/world/topology policy, env-overridable
+        via UCCL_TPU_AR_ALGO).
         """
         self._check(x)
         ax = self._axis_name()
+        if algo == "auto":
+            if op != ReduceOp.SUM:
+                algo = "xla"  # the explicit plans are sum-only
+            else:
+                from uccl_tpu.collective.plan import select_all_reduce_algo
+
+                per_rank = x.size // max(1, x.shape[0])
+                algo = select_all_reduce_algo(
+                    per_rank * x.dtype.itemsize, self.world, len(self.axes)
+                )
+        if algo not in ("xla", "ring", "hd", "torus"):
+            raise ValueError(f"unknown all_reduce algo {algo!r}")
         key = ("ar", op, algo, x.shape, x.dtype)
 
         def build():
             def f(v):
-                if algo == "ring":
+                if algo in ("ring", "hd"):
                     if op != ReduceOp.SUM:
-                        raise ValueError("ring allreduce supports sum only")
-                    from uccl_tpu.collective.plan import ring_all_reduce
+                        raise ValueError(f"{algo} allreduce supports sum only")
+                    from uccl_tpu.collective.plan import (
+                        hd_all_reduce,
+                        ring_all_reduce,
+                    )
 
-                    return ring_all_reduce(v, ax)
+                    fn = hd_all_reduce if algo == "hd" else ring_all_reduce
+                    return fn(v, ax)
                 if algo == "torus":
                     if op != ReduceOp.SUM:
                         raise ValueError("torus allreduce supports sum only")

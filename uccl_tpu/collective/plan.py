@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from uccl_tpu.utils import config as _config
 from uccl_tpu.utils.topology import ppermute_pairs
 
 Axis = Union[str, Tuple[str, ...]]
@@ -461,3 +462,112 @@ def ring_all_gather(x: jax.Array, axis: Axis) -> jax.Array:
     for s in range(n - 1):
         buf = step_fn(buf, s)
     return buf.reshape((n * k,) + x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Recursive halving-doubling (latency-optimal) + the algorithm selector
+#
+# The reference's lite-collective ships an *algorithm selector over many
+# execution plans* (experimental/lite/lite-collective/collective/: selector +
+# allreduce kernel variants); NCCL itself switches ring<->tree by size. This
+# is that role for the TPU build: halving-doubling gives 2*log2(W) hops
+# (vs the ring's 2(W-1)) at the same per-member byte volume, so it wins when
+# the alpha (per-hop latency) term dominates — small payloads, large worlds.
+
+
+def hd_all_reduce(x: jax.Array, axis: Axis) -> jax.Array:
+    """Recursive-halving reduce-scatter + recursive-doubling all-gather
+    (per-shard fn). Power-of-two axis size; falls back to the ring plan
+    otherwise. 2*log2(W) ppermute steps, bandwidth-optimal total volume.
+
+    Rank-relative bookkeeping: reduce-scatter consumes rank bits MSB-first
+    (distance W/2 .. 1); member r ends owning chunk slot r. All-gather
+    mirrors LSB-first (distance 1 .. W/2), merging base = base & ~dist each
+    step. Slice sizes are python ints (static); offsets are traced.
+    """
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    if n & (n - 1):
+        return ring_all_reduce(x, axis)
+    shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    buf = flat.reshape(n, -1)
+    r = lax.axis_index(axis)
+
+    # Reduce-scatter: halve the live span every step, reduce into the kept half.
+    base = jnp.zeros((), jnp.int32)
+    span, dist = n, n // 2
+    while dist >= 1:
+        half = span // 2
+        upper = (r & dist) != 0  # this member keeps the upper half?
+        keep_start = base + jnp.where(upper, half, 0)
+        send_start = base + jnp.where(upper, 0, half)
+        chunk = lax.dynamic_slice_in_dim(buf, send_start, half, axis=0)
+        got = lax.ppermute(chunk, axis, [(i, i ^ dist) for i in range(n)])
+        kept = lax.dynamic_slice_in_dim(buf, keep_start, half, axis=0)
+        buf = lax.dynamic_update_slice_in_dim(buf, kept + got, keep_start, 0)
+        base, span, dist = keep_start, half, dist // 2
+
+    # All-gather: double the owned span every step (base ends at 0, span n).
+    span, dist = 1, 1
+    while dist < n:
+        chunk = lax.dynamic_slice_in_dim(buf, base, span, axis=0)
+        got = lax.ppermute(chunk, axis, [(i, i ^ dist) for i in range(n)])
+        buf = lax.dynamic_update_slice_in_dim(buf, got, base ^ dist, 0)
+        base = base & ~dist
+        span, dist = span * 2, dist * 2
+
+    out = buf.reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
+_AR_SMALL_BYTES = _config.param(
+    "AR_HD_MAX_BYTES",
+    1 << 18,
+    int,
+    "all_reduce auto-selector: payloads at or under this many bytes prefer "
+    "the log-step halving-doubling plan over a ring (alpha-dominated range)",
+)
+_AR_FORCE_ALGO = _config.param(
+    "AR_ALGO",
+    "",
+    str,
+    "override the all_reduce auto-selector with a fixed algorithm "
+    "(xla|ring|hd|torus)",
+)
+
+
+def select_all_reduce_algo(
+    nbytes: int, world: int, n_axes: int = 1
+) -> str:
+    """Pick an allreduce algorithm from the plan library (the lite-collective
+    selector role). Policy is the standard alpha-beta model, recalibratable
+    via UCCL_TPU_AR_HD_MAX_BYTES / overridable via UCCL_TPU_AR_ALGO:
+
+    * world 1 → "xla" (no comm; let the compiler elide it).
+    * explicit override set → that.
+    * small payloads (≤ AR_HD_MAX_BYTES), power-of-two world → "hd"
+      (2 log W hops beat 2(W-1) when per-hop latency dominates).
+    * large payloads over a 2D axis pair → "torus" (both ICI axis rings
+      carry traffic, shard-restricted middle phase).
+    * everything else → "xla": measured on this repo's substrates XLA's own
+      schedule wins the bandwidth range on-mesh (docs/PLAN_BENCH.md — honest
+      default; the explicit plans exist for the cross-pod/overlap cases and
+      for recalibration on real multi-chip ICI).
+    """
+    forced = _AR_FORCE_ALGO.get()
+    if forced:
+        return forced
+    if world <= 1:
+        return "xla"
+    if nbytes <= _AR_SMALL_BYTES.get() and world & (world - 1) == 0:
+        return "hd"
+    if n_axes == 2:
+        return "torus"
+    return "xla"

@@ -96,6 +96,10 @@ class Endpoint {
   int64_t connect(const std::string& ip, uint16_t port);  // >=0 conn id
   int64_t accept(int timeout_ms);                         // >=0 conn id
   bool remove_conn(uint64_t conn_id);  // reference: remove_remote_endpoint
+  // true while the conn is registered and not marked dead — lets pollers
+  // distinguish "nothing queued yet" from "peer is gone" (recv() returns -1
+  // for both).
+  bool conn_alive(uint64_t conn_id);
 
   // --- memory registry (reference: reg/regv/dereg, engine.h:300-305)
   uint64_t reg(void* ptr, size_t len);

@@ -1,0 +1,92 @@
+// Loadable network-plugin ABI: an NCCL-net-shaped C vtable over the engine.
+//
+// The reference ships its transport as a loadable NCCL net plugin
+// (collective/rdma/nccl_plugin.cc: pluginInit/Listen/Connect/Accept/RegMr/
+// Isend/Irecv/Test/Close exported as the `ncclNetPlugin_v8` vtable symbol,
+// selected via NCCL_NET_PLUGIN=libnccl-net-uccl.so). TPU hosts run no NCCL,
+// so binary compatibility with NCCL is meaningless here — what carries over
+// is the *shape*: a dlopen-able .so exporting one versioned struct of C
+// function pointers, opaque listen handles shipped out-of-band by the caller,
+// comm/mr/request objects owned by the plugin, and nonblocking test()
+// completion. Anything that can drive an NCCL-style net plugin (a collective
+// runtime, a test harness, a future interop shim) can drive this over the
+// DCN engine.
+//
+// Semantics:
+//  * isend copies the payload into the engine tx queue — a request is
+//    complete when the user buffer is reusable (NCCL's contract), and the
+//    framed-TCP engine below guarantees in-order delivery or connection
+//    death.
+//  * irecv posts (buffer, size, tag); test() drains engine messages and
+//    tag-matches, failing the request if the arrived message exceeds the
+//    posted size.
+//  * listen handles carry {ip, port, listen_id}; connect() sends a hello
+//    naming the listen_id so concurrent listens (one per NCCL channel, in
+//    the reference's world) accept their own peers even when connections
+//    land interleaved.
+
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define UCCLT_NET_HANDLE_BYTES 128
+#define UCCLT_NET_OK 0
+#define UCCLT_NET_ERR (-1)
+
+typedef struct {
+  char name[32];       // device name
+  int speed_mbps;      // advertised link speed
+  int port;            // listen port of the underlying endpoint
+  int max_comms;       // soft cap on simultaneous comms
+  int max_recvs;       // irecv batch width (1 in v1)
+  int reg_is_global;   // mr handles valid across comms on this device
+} ucclt_net_props_t;
+
+typedef struct {
+  const char* name;  // "uccl_tpu_dcn"
+
+  int (*init)(void);
+  int (*devices)(int* ndev);
+  int (*get_properties)(int dev, ucclt_net_props_t* props);
+
+  // handle: caller-provided UCCLT_NET_HANDLE_BYTES buffer, filled by listen
+  // and shipped out-of-band (verbatim bytes) to the connecting side.
+  int (*listen)(int dev, void* handle, void** listen_comm);
+  int (*connect)(int dev, const void* handle, void** send_comm);
+  int (*accept)(void* listen_comm, void** recv_comm);
+
+  int (*reg_mr)(void* comm, void* data, size_t size, int type,
+                void** mhandle);
+  int (*dereg_mr)(void* comm, void* mhandle);
+
+  int (*isend)(void* send_comm, const void* data, size_t size, uint64_t tag,
+               void* mhandle, void** request);
+  int (*irecv)(void* recv_comm, void* data, size_t size, uint64_t tag,
+               void* mhandle, void** request);
+  // done=1 when terminal; *size = delivered bytes (recv) or queued bytes
+  // (send). Returns UCCLT_NET_ERR for a failed request. A done/failed
+  // request is freed by this call.
+  int (*test)(void* request, int* done, size_t* size);
+  // No GPUDirect analog on the DCN path: completion already implies host
+  // visibility, so iflush returns a pre-completed request (kept for shape
+  // parity with the reference vtable).
+  int (*iflush)(void* recv_comm, void* data, size_t size, void* mhandle,
+                void** request);
+
+  int (*close_send)(void* send_comm);
+  int (*close_recv)(void* recv_comm);
+  int (*close_listen)(void* listen_comm);
+  int (*finalize)(void);
+} ucclt_net_v1_t;
+
+// The exported vtable (dlsym "ucclt_net_v1").
+extern const ucclt_net_v1_t ucclt_net_v1;
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif

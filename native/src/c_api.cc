@@ -48,6 +48,11 @@ int ucclt_remove_conn(void* ep, uint64_t conn_id) {
   return static_cast<Endpoint*>(ep)->remove_conn(conn_id) ? 0 : -1;
 }
 
+// 1 = registered and not dead, 0 otherwise
+int ucclt_conn_alive(void* ep, uint64_t conn_id) {
+  return static_cast<Endpoint*>(ep)->conn_alive(conn_id) ? 1 : 0;
+}
+
 uint64_t ucclt_reg(void* ep, void* ptr, size_t len) {
   return static_cast<Endpoint*>(ep)->reg(ptr, len);
 }

@@ -203,6 +203,12 @@ int64_t Endpoint::accept(int timeout_ms) {
   return static_cast<int64_t>(id);
 }
 
+bool Endpoint::conn_alive(uint64_t conn_id) {
+  std::lock_guard<std::mutex> lk(conns_mtx_);
+  auto it = conns_.find(conn_id);
+  return it != conns_.end() && !it->second->dead.load(std::memory_order_relaxed);
+}
+
 bool Endpoint::remove_conn(uint64_t conn_id) {
   std::shared_ptr<Conn> c;
   {

@@ -1,0 +1,389 @@
+// NCCL-net-shaped loadable plugin over the DCN engine. See net_plugin.h.
+
+#include "uccl_tpu/net_plugin.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "uccl_tpu/engine.h"
+
+namespace {
+
+using uccl_tpu::Endpoint;
+
+constexpr uint32_t kHandleMagic = 0x75636e74;  // "ucnt"
+
+struct Handle {
+  uint32_t magic;
+  uint32_t listen_id;
+  uint16_t port;
+  char ip[64];
+};
+static_assert(sizeof(Handle) <= UCCLT_NET_HANDLE_BYTES, "handle too big");
+
+struct ListenComm {
+  uint32_t listen_id;
+};
+
+// One tagged message as delivered by the engine (tag prefix stripped).
+struct TaggedMsg {
+  uint64_t tag;
+  std::vector<uint8_t> data;
+};
+
+struct Comm {
+  uint64_t conn_id = 0;
+  bool sender = false;
+  // recv side: engine messages drained but not yet matched to an irecv
+  std::deque<TaggedMsg> unmatched;
+};
+
+struct Request {
+  enum class Kind { kSend, kRecv, kFlush } kind = Kind::kSend;
+  Comm* comm = nullptr;
+  void* data = nullptr;
+  size_t posted = 0;
+  uint64_t tag = 0;
+  // terminal state reached at creation (send/flush) or by test() (recv)
+  int done = 0;
+  int failed = 0;
+  size_t size = 0;
+};
+
+// The global mutex guards plugin bookkeeping (listen registry, accept
+// routing, comm unmatched queues) and is NEVER held across an engine wait
+// (accept/recv with a timeout) — those run on a shared_ptr copy of the
+// endpoint, which also makes finalize() safe against in-flight calls (the
+// last holder destroys the engine).
+struct Plugin {
+  std::mutex mtx;
+  std::shared_ptr<Endpoint> ep;
+  uint32_t next_listen = 1;
+  std::set<uint32_t> live_listens;
+  // conns that said hello for a live listen_id nobody accepted yet
+  std::map<uint32_t, std::deque<uint64_t>> pending_accepts;
+  std::vector<uint8_t> staging;  // drain buffer (under mtx)
+
+  std::shared_ptr<Endpoint> endpoint_locked() {
+    if (!ep) {
+      int n_engines = 2;
+      if (const char* e = std::getenv("UCCL_TPU_NET_ENGINES")) {
+        n_engines = std::max(1, atoi(e));
+      }
+      const char* ip = std::getenv("UCCL_TPU_HOST_IP");
+      auto cand = std::make_shared<Endpoint>(0, n_engines, ip);
+      if (cand->ok()) ep = std::move(cand);
+    }
+    return ep;
+  }
+  std::shared_ptr<Endpoint> endpoint() {
+    std::lock_guard<std::mutex> lk(mtx);
+    return endpoint_locked();
+  }
+};
+
+Plugin& plugin() {
+  static Plugin p;
+  return p;
+}
+
+const char* local_ip() {
+  const char* ip = std::getenv("UCCL_TPU_HOST_IP");
+  return (ip && ip[0]) ? ip : "127.0.0.1";
+}
+
+int pi_init(void) { return plugin().endpoint() ? UCCLT_NET_OK : UCCLT_NET_ERR; }
+
+int pi_devices(int* ndev) {
+  // One logical DCN device; multipath/engine fan-out lives inside the
+  // endpoint (the reference reports one plugin dev per NIC; TPU hosts
+  // expose the host NIC(s) behind one engine with n_engines paths).
+  *ndev = 1;
+  return UCCLT_NET_OK;
+}
+
+int pi_get_properties(int dev, ucclt_net_props_t* props) {
+  if (dev != 0 || !props) return UCCLT_NET_ERR;
+  auto ep = plugin().endpoint();
+  if (!ep) return UCCLT_NET_ERR;
+  std::memset(props, 0, sizeof(*props));
+  std::snprintf(props->name, sizeof(props->name), "uccl_tpu_dcn");
+  props->speed_mbps = 100000;  // nominal DCN host link
+  props->port = ep->listen_port();
+  props->max_comms = 65536;
+  props->max_recvs = 1;
+  props->reg_is_global = 1;
+  return UCCLT_NET_OK;
+}
+
+int pi_listen(int dev, void* handle, void** listen_comm) {
+  if (dev != 0 || !handle || !listen_comm) return UCCLT_NET_ERR;
+  Plugin& p = plugin();
+  std::lock_guard<std::mutex> lk(p.mtx);
+  auto ep = p.endpoint_locked();
+  if (!ep) return UCCLT_NET_ERR;
+  auto* lc = new ListenComm{p.next_listen++};
+  p.live_listens.insert(lc->listen_id);
+  Handle h{};
+  h.magic = kHandleMagic;
+  h.listen_id = lc->listen_id;
+  h.port = ep->listen_port();
+  std::snprintf(h.ip, sizeof(h.ip), "%s", local_ip());
+  std::memset(handle, 0, UCCLT_NET_HANDLE_BYTES);
+  std::memcpy(handle, &h, sizeof(h));
+  *listen_comm = lc;
+  return UCCLT_NET_OK;
+}
+
+int pi_connect(int dev, const void* handle, void** send_comm) {
+  if (dev != 0 || !handle || !send_comm) return UCCLT_NET_ERR;
+  Handle h{};
+  std::memcpy(&h, handle, sizeof(h));
+  if (h.magic != kHandleMagic) return UCCLT_NET_ERR;
+  auto ep = plugin().endpoint();
+  if (!ep) return UCCLT_NET_ERR;
+  int64_t conn = ep->connect(h.ip, h.port);
+  if (conn < 0) return UCCLT_NET_ERR;
+  // hello: route this conn to the right accept() queue on the peer
+  uint32_t listen_id = h.listen_id;
+  if (!ep->send(static_cast<uint64_t>(conn), &listen_id, sizeof(listen_id))) {
+    ep->remove_conn(static_cast<uint64_t>(conn));
+    return UCCLT_NET_ERR;
+  }
+  auto* c = new Comm;
+  c->conn_id = static_cast<uint64_t>(conn);
+  c->sender = true;
+  *send_comm = c;
+  return UCCLT_NET_OK;
+}
+
+int pi_accept(void* listen_comm, void** recv_comm) {
+  if (!listen_comm || !recv_comm) return UCCLT_NET_ERR;
+  auto* lc = static_cast<ListenComm*>(listen_comm);
+  Plugin& p = plugin();
+  auto ep = p.endpoint();
+  if (!ep) return UCCLT_NET_ERR;
+  for (int spin = 0; spin < 100; ++spin) {
+    {
+      std::lock_guard<std::mutex> lk(p.mtx);
+      if (!p.live_listens.count(lc->listen_id)) return UCCLT_NET_ERR;
+      auto& q = p.pending_accepts[lc->listen_id];
+      if (!q.empty()) {
+        auto* c = new Comm;
+        c->conn_id = q.front();
+        q.pop_front();
+        *recv_comm = c;
+        return UCCLT_NET_OK;
+      }
+    }
+    // Engine waits run unlocked so concurrent test()/close on other comms
+    // never stall behind a pending accept.
+    int64_t conn = ep->accept(100);
+    if (conn < 0) continue;
+    uint32_t listen_id = 0;
+    int64_t n = ep->recv(static_cast<uint64_t>(conn), &listen_id,
+                         sizeof(listen_id), 2000);
+    std::lock_guard<std::mutex> lk(p.mtx);
+    if (n != sizeof(listen_id) || !p.live_listens.count(listen_id)) {
+      // malformed hello, or a listen that closed (or never existed): don't
+      // park the conn where nobody will ever pop it
+      ep->remove_conn(static_cast<uint64_t>(conn));
+      continue;
+    }
+    p.pending_accepts[listen_id].push_back(static_cast<uint64_t>(conn));
+  }
+  return UCCLT_NET_ERR;  // nothing arrived for this listen
+}
+
+int pi_reg_mr(void* comm, void* data, size_t size, int type, void** mhandle) {
+  // The engine's kSend path copies through its own framing; registration is
+  // a handle-shaped no-op kept for vtable parity (type mirrors NCCL's
+  // host/device flag — only host memory exists on the DCN side).
+  (void)comm;
+  (void)data;
+  (void)size;
+  (void)type;
+  if (!mhandle) return UCCLT_NET_ERR;
+  *mhandle = nullptr;
+  return UCCLT_NET_OK;
+}
+
+int pi_dereg_mr(void* comm, void* mhandle) {
+  (void)comm;
+  (void)mhandle;
+  return UCCLT_NET_OK;
+}
+
+int pi_isend(void* send_comm, const void* data, size_t size, uint64_t tag,
+             void* mhandle, void** request) {
+  (void)mhandle;
+  if (!send_comm || !request || (!data && size)) return UCCLT_NET_ERR;
+  auto* c = static_cast<Comm*>(send_comm);
+  auto ep = plugin().endpoint();
+  if (!ep) return UCCLT_NET_ERR;
+  // wire format: [tag u64][payload]
+  std::vector<uint8_t> framed(sizeof(tag) + size);
+  std::memcpy(framed.data(), &tag, sizeof(tag));
+  if (size) std::memcpy(framed.data() + sizeof(tag), data, size);
+  auto* r = new Request;
+  r->kind = Request::Kind::kSend;
+  r->comm = c;
+  r->posted = size;
+  r->size = size;
+  if (ep->send(c->conn_id, framed.data(), framed.size())) {
+    r->done = 1;  // payload copied into the engine tx queue: buffer reusable
+  } else {
+    r->done = 1;
+    r->failed = 1;
+  }
+  *request = r;
+  return UCCLT_NET_OK;
+}
+
+int pi_irecv(void* recv_comm, void* data, size_t size, uint64_t tag,
+             void* mhandle, void** request) {
+  (void)mhandle;
+  if (!recv_comm || !request || (!data && size)) return UCCLT_NET_ERR;
+  auto* r = new Request;
+  r->kind = Request::Kind::kRecv;
+  r->comm = static_cast<Comm*>(recv_comm);
+  r->data = data;
+  r->posted = size;
+  r->tag = tag;
+  *request = r;
+  return UCCLT_NET_OK;
+}
+
+// Drain every queued engine message for this comm into its unmatched list.
+// Caller holds the plugin mutex (recv with timeout 0 never blocks).
+void drain_comm(Plugin& p, Endpoint* ep, Comm* c) {
+  for (;;) {
+    if (p.staging.size() < (1u << 16)) p.staging.resize(1u << 16);
+    int64_t n = ep->recv(c->conn_id, p.staging.data(), p.staging.size(), 0);
+    if (n == -1) return;  // nothing queued
+    if (n <= -2) {        // message larger than staging: grow and retry
+      p.staging.resize(static_cast<size_t>(-(n + 2)));
+      continue;
+    }
+    if (static_cast<size_t>(n) < sizeof(uint64_t)) continue;  // malformed
+    TaggedMsg m;
+    std::memcpy(&m.tag, p.staging.data(), sizeof(uint64_t));
+    m.data.assign(p.staging.begin() + sizeof(uint64_t),
+                  p.staging.begin() + static_cast<size_t>(n));
+    c->unmatched.push_back(std::move(m));
+  }
+}
+
+int pi_test(void* request, int* done, size_t* size) {
+  if (!request || !done) return UCCLT_NET_ERR;
+  auto* r = static_cast<Request*>(request);
+  if (!r->done && r->kind == Request::Kind::kRecv) {
+    Plugin& p = plugin();
+    std::lock_guard<std::mutex> lk(p.mtx);
+    auto ep = p.endpoint_locked();
+    if (!ep) {
+      r->done = 1;
+      r->failed = 1;  // engine torn down under a posted recv
+    } else {
+      // Liveness snapshot BEFORE draining: messages delivered before the
+      // conn died are still drained and matched; only when the conn was
+      // already dead and nothing matches can nothing ever arrive.
+      bool alive = ep->conn_alive(r->comm->conn_id);
+      drain_comm(p, ep.get(), r->comm);
+      auto& q = r->comm->unmatched;
+      for (auto it = q.begin(); it != q.end(); ++it) {
+        if (it->tag != r->tag) continue;
+        if (it->data.size() > r->posted) {
+          r->failed = 1;  // peer sent more than posted (NCCL contract breach)
+        } else {
+          std::memcpy(r->data, it->data.data(), it->data.size());
+          r->size = it->data.size();
+        }
+        r->done = 1;
+        q.erase(it);
+        break;
+      }
+      if (!r->done && !alive) {
+        r->done = 1;
+        r->failed = 1;  // peer gone, nothing queued: surface the error
+      }
+    }
+  }
+  *done = r->done;
+  if (size) *size = r->size;
+  int rc = r->failed ? UCCLT_NET_ERR : UCCLT_NET_OK;
+  if (r->done) delete r;
+  return rc;
+}
+
+int pi_iflush(void* recv_comm, void* data, size_t size, void* mhandle,
+              void** request) {
+  (void)recv_comm;
+  (void)data;
+  (void)size;
+  (void)mhandle;
+  if (!request) return UCCLT_NET_ERR;
+  // No GPUDirect analog on the DCN path: completion already implies host
+  // visibility, so flush is a pre-completed request.
+  auto* r = new Request;
+  r->kind = Request::Kind::kFlush;
+  r->done = 1;
+  *request = r;
+  return UCCLT_NET_OK;
+}
+
+int close_comm(void* comm) {
+  if (!comm) return UCCLT_NET_ERR;
+  auto* c = static_cast<Comm*>(comm);
+  auto ep = plugin().endpoint();
+  if (ep) ep->remove_conn(c->conn_id);
+  delete c;
+  return UCCLT_NET_OK;
+}
+
+int pi_close_send(void* c) { return close_comm(c); }
+int pi_close_recv(void* c) { return close_comm(c); }
+
+int pi_close_listen(void* listen_comm) {
+  if (!listen_comm) return UCCLT_NET_ERR;
+  auto* lc = static_cast<ListenComm*>(listen_comm);
+  Plugin& p = plugin();
+  std::lock_guard<std::mutex> lk(p.mtx);
+  p.live_listens.erase(lc->listen_id);
+  auto it = p.pending_accepts.find(lc->listen_id);
+  if (it != p.pending_accepts.end()) {
+    // conns queued for this listen will never be accepted: release them
+    if (auto ep = p.endpoint_locked()) {
+      for (uint64_t conn : it->second) ep->remove_conn(conn);
+    }
+    p.pending_accepts.erase(it);
+  }
+  delete lc;
+  return UCCLT_NET_OK;
+}
+
+int pi_finalize(void) {
+  Plugin& p = plugin();
+  std::lock_guard<std::mutex> lk(p.mtx);
+  p.ep.reset();  // in-flight calls hold shared_ptr copies; last one destroys
+  p.live_listens.clear();
+  p.pending_accepts.clear();
+  return UCCLT_NET_OK;
+}
+
+}  // namespace
+
+extern "C" const ucclt_net_v1_t ucclt_net_v1 = {
+    "uccl_tpu_dcn", pi_init,       pi_devices,    pi_get_properties,
+    pi_listen,      pi_connect,    pi_accept,     pi_reg_mr,
+    pi_dereg_mr,    pi_isend,      pi_irecv,      pi_test,
+    pi_iflush,      pi_close_send, pi_close_recv, pi_close_listen,
+    pi_finalize,
+};

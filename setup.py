@@ -33,10 +33,10 @@ class BuildWithNative(build_py):
         super().run()
         dest = os.path.join(self.build_lib, "uccl_tpu", "_native")
         os.makedirs(dest, exist_ok=True)
-        shutil.copy2(
-            os.path.join(native, "build", "libuccl_tpu.so"),
-            os.path.join(dest, "libuccl_tpu.so"),
-        )
+        for so in ("libuccl_tpu.so", "libuccl_tpu_net.so"):
+            shutil.copy2(
+                os.path.join(native, "build", so), os.path.join(dest, so)
+            )
 
 
 setup(

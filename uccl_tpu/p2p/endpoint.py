@@ -44,10 +44,18 @@ def _build_if_needed() -> str:
     srcs = [
         os.path.join(_NATIVE_DIR, "src", "engine.cc"),
         os.path.join(_NATIVE_DIR, "src", "c_api.cc"),
+        os.path.join(_NATIVE_DIR, "src", "net_plugin.cc"),
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "engine.h"),
+        os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "net_plugin.h"),
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "ring.h"),
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "lrpc.h"),
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "pool.h"),
+    ]
+    # `make all` produces every artifact; freshness requires them all so a
+    # consumer of any one (e.g. the net plugin tests) can trust the build.
+    _artifacts = [
+        _SO_PATH,
+        os.path.join(_NATIVE_DIR, "build", "libuccl_tpu_net.so"),
     ]
 
     # Content-hash freshness (not mtimes): a prebuilt .so is only trusted if
@@ -66,7 +74,9 @@ def _build_if_needed() -> str:
     digest_path = os.path.join(_NATIVE_DIR, "build", ".src_hash")
 
     def fresh() -> bool:
-        if not os.path.exists(_SO_PATH) or not os.path.exists(digest_path):
+        if not all(os.path.exists(a) for a in _artifacts):
+            return False
+        if not os.path.exists(digest_path):
             return False
         with open(digest_path) as f:
             return f.read().strip() == src_digest()
@@ -89,6 +99,18 @@ def _build_if_needed() -> str:
             with open(digest_path, "w") as f:
                 f.write(src_digest())
     return _SO_PATH
+
+
+def net_plugin_path() -> str:
+    """Path to the loadable NCCL-net-shaped plugin .so (built if needed).
+
+    Consumers dlopen it and read the exported ``ucclt_net_v1`` vtable
+    (native/include/uccl_tpu/net_plugin.h) — the analog of pointing
+    NCCL_NET_PLUGIN at the reference's libnccl-net-uccl.so."""
+    main = _build_if_needed()
+    if main == _WHEEL_SO:
+        return os.path.join(os.path.dirname(_WHEEL_SO), "libuccl_tpu_net.so")
+    return os.path.join(_NATIVE_DIR, "build", "libuccl_tpu_net.so")
 
 
 def _load():

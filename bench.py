@@ -157,14 +157,25 @@ def _model_flops_per_token(cfg, seq: int) -> float:
 
 
 def _time_steps(step, params, opt_state, tokens, targets, warmup=2, iters=5):
-    # NB: sync via a host read of the loss — on tunneled/remote platforms
-    # block_until_ready can return before the computation actually finishes.
-    for _ in range(max(1, warmup)):  # at least one call so the sync read exists
-        params, opt_state, m = step(params, opt_state, tokens, targets)
-    float(m["loss"])
+    # Two tunneled-platform hazards shape this: block_until_ready can return
+    # before device work finishes (so: sync via a host read of the loss), and
+    # per-dispatch overhead is ~10 ms (so: run all iterations inside ONE
+    # jitted fori_loop dispatch instead of one dispatch per step).
+    from jax import lax
+
+    def run(params, opt_state, n):
+        def body(_, state):
+            p, o, _m = state
+            return step(p, o, tokens, targets)
+
+        init = step(params, opt_state, tokens, targets)
+        return lax.fori_loop(0, n - 1, body, init)
+
+    run = jax.jit(run)  # n traced -> one compile serves warmup and timing
+    params, opt_state, m = run(params, opt_state, max(1, warmup))
+    float(m["loss"])  # sync warmup + compile
     t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, m = step(params, opt_state, tokens, targets)
+    _, _, m = run(params, opt_state, iters)
     float(m["loss"])
     return (time.perf_counter() - t0) / iters
 

@@ -93,8 +93,15 @@ class Endpoint {
   uint16_t listen_port() const { return listen_port_; }
 
   // --- connections (reference: Endpoint::connect/accept, engine.h:286-297)
-  int64_t connect(const std::string& ip, uint16_t port);  // >=0 conn id
-  int64_t accept(int timeout_ms);                         // >=0 conn id
+  // local_ip optionally binds the outgoing conn's source address to one
+  // interface — the multi-NIC data-path selection knob (reference: per-GPU
+  // NIC selection, p2p/rdma/rdma_endpoint.h; here per-path source binding).
+  int64_t connect(const std::string& ip, uint16_t port,
+                  const char* local_ip = nullptr);  // >=0 conn id
+  int64_t accept(int timeout_ms);                   // >=0 conn id
+  // Peer address of an established conn ("ip:port" into out); false if the
+  // conn is unknown. Lets multipath layers verify per-path NIC placement.
+  bool peer_addr(uint64_t conn_id, char* out, size_t cap);
   bool remove_conn(uint64_t conn_id);  // reference: remove_remote_endpoint
   // true while the conn is registered and not marked dead — lets pollers
   // distinguish "nothing queued yet" from "peer is gone" (recv() returns -1

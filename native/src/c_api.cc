@@ -40,6 +40,18 @@ int64_t ucclt_connect(void* ep, const char* ip, uint16_t port) {
   return static_cast<Endpoint*>(ep)->connect(ip, port);
 }
 
+// Bind the outgoing conn's source address to local_ip (multi-NIC data-path
+// selection); local_ip nullptr/"" behaves like ucclt_connect.
+int64_t ucclt_connect_from(void* ep, const char* ip, uint16_t port,
+                           const char* local_ip) {
+  return static_cast<Endpoint*>(ep)->connect(ip, port, local_ip);
+}
+
+// Writes "ip:port" of the conn's peer into out (cap bytes); -1 if unknown.
+int ucclt_peer_addr(void* ep, uint64_t conn_id, char* out, size_t cap) {
+  return static_cast<Endpoint*>(ep)->peer_addr(conn_id, out, cap) ? 0 : -1;
+}
+
 int64_t ucclt_accept(void* ep, int timeout_ms) {
   return static_cast<Endpoint*>(ep)->accept(timeout_ms);
 }

@@ -150,7 +150,8 @@ Endpoint::~Endpoint() {
   }
 }
 
-int64_t Endpoint::connect(const std::string& ip, uint16_t port) {
+int64_t Endpoint::connect(const std::string& ip, uint16_t port,
+                          const char* local_ip) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
@@ -158,6 +159,19 @@ int64_t Endpoint::connect(const std::string& ip, uint16_t port) {
   if (::inet_pton(AF_INET, ip.c_str(), &addr.sin_addr) != 1) {
     ::close(fd);
     return -1;
+  }
+  if (local_ip && local_ip[0]) {
+    // Multi-NIC data-path selection (reference: per-GPU NIC selection and
+    // data channels spread across NICs, p2p/rdma/rdma_endpoint.h:117):
+    // bind the outgoing conn's source address to the chosen interface.
+    sockaddr_in src{};
+    src.sin_family = AF_INET;
+    src.sin_port = 0;
+    if (::inet_pton(AF_INET, local_ip, &src.sin_addr) != 1 ||
+        ::bind(fd, reinterpret_cast<sockaddr*>(&src), sizeof(src)) != 0) {
+      ::close(fd);
+      return -1;
+    }
   }
   if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
     ::close(fd);
@@ -201,6 +215,20 @@ int64_t Endpoint::accept(int timeout_ms) {
     std::this_thread::sleep_for(std::chrono::microseconds(100));
   }
   return static_cast<int64_t>(id);
+}
+
+bool Endpoint::peer_addr(uint64_t conn_id, char* out, size_t cap) {
+  auto c = get_conn(conn_id);
+  if (!c || cap == 0) return false;
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getpeername(c->fd, reinterpret_cast<sockaddr*>(&addr), &len) != 0) {
+    return false;
+  }
+  char ip[INET_ADDRSTRLEN] = {0};
+  if (!::inet_ntop(AF_INET, &addr.sin_addr, ip, sizeof(ip))) return false;
+  std::snprintf(out, cap, "%s:%u", ip, ntohs(addr.sin_port));
+  return true;
 }
 
 bool Endpoint::conn_alive(uint64_t conn_id) {

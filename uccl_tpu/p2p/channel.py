@@ -24,6 +24,16 @@ from uccl_tpu.p2p.endpoint import FIFO_ITEM_BYTES, Endpoint
 from uccl_tpu.utils.config import param
 
 _chunk_kb = param("chunk_size_kb", 1024, help="multipath chunk size in KiB")
+_nic_list = param(
+    "nic_list",
+    "",
+    str,
+    "comma-separated local source IPs to stripe channel paths across "
+    "(multi-NIC data path; path 0 — which also carries channel control "
+    "messages — is striped like any path, while the OOB store/bootstrap "
+    "stay on the default route). Reference: per-GPU NIC selection + data "
+    "channels across NICs, p2p/rdma/rdma_endpoint.h:117",
+)
 
 
 @dataclass(frozen=True)
@@ -188,13 +198,30 @@ class Channel:
         n_paths: int = 4,
         chunk_bytes: Optional[int] = None,
         meta: bytes = b"",
+        nics: Optional[list] = None,
     ) -> "Channel":
+        """``nics`` (or UCCL_TPU_NIC_LIST) stripes the data paths across
+        local source interfaces round-robin: path i binds nics[i % len] —
+        the multi-NIC data/ctrl split (control messages ride path 0 like
+        any path, but the OOB store and bootstrap use the default route)."""
+        if nics is None:
+            raw = _nic_list.get()
+            nics = [s.strip() for s in raw.split(",") if s.strip()] if raw else []
         token = uuid.uuid4().bytes
         conns = []
-        for i in range(n_paths):
-            cid = ep.connect(ip, port)
-            ep.send(cid, cls._HELLO + token + bytes([i, n_paths]) + meta)
-            conns.append(cid)
+        try:
+            for i in range(n_paths):
+                local_ip = nics[i % len(nics)] if nics else None
+                cid = ep.connect(ip, port, local_ip=local_ip)
+                ep.send(cid, cls._HELLO + token + bytes([i, n_paths]) + meta)
+                conns.append(cid)
+        except Exception:
+            # A later path failing (e.g. a misconfigured NIC bind) must not
+            # leak the established ones; tearing them down also unblocks the
+            # server's accept loop immediately instead of at its timeout.
+            for cid in conns:
+                ep.remove_conn(cid)
+            raise
         chan = cls(ep, conns, chunk_bytes, meta)
         chan._exchange_probe_window()
         return chan

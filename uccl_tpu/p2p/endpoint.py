@@ -131,6 +131,16 @@ def _load():
         lib.ucclt_listen_port.argtypes = [c]
         lib.ucclt_connect.restype = ctypes.c_int64
         lib.ucclt_connect.argtypes = [c, ctypes.c_char_p, ctypes.c_uint16]
+        lib.ucclt_connect_from.restype = ctypes.c_int64
+        lib.ucclt_connect_from.argtypes = [
+            c, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
+        ]
+        lib.ucclt_peer_addr.restype = ctypes.c_int
+        lib.ucclt_peer_addr.argtypes = [
+            c, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.ucclt_conn_alive.restype = ctypes.c_int
+        lib.ucclt_conn_alive.argtypes = [c, ctypes.c_uint64]
         lib.ucclt_accept.restype = ctypes.c_int64
         lib.ucclt_accept.argtypes = [c, ctypes.c_int]
         lib.ucclt_remove_conn.restype = ctypes.c_int
@@ -247,11 +257,37 @@ class Endpoint:
             pass
 
     # -- connections -----------------------------------------------------
-    def connect(self, ip: str, port: int) -> int:
-        cid = self._lib.ucclt_connect(self._handle(), ip.encode(), port)
+    def connect(self, ip: str, port: int, local_ip: str = None) -> int:
+        """``local_ip`` binds the conn's source address to one interface —
+        per-path NIC selection for multipath channels (the reference's
+        multi-NIC data channels, p2p/rdma/rdma_endpoint.h:117)."""
+        if local_ip:
+            cid = self._lib.ucclt_connect_from(
+                self._handle(), ip.encode(), port, local_ip.encode()
+            )
+        else:
+            cid = self._lib.ucclt_connect(self._handle(), ip.encode(), port)
         if cid < 0:
-            raise ConnectionError(f"connect to {ip}:{port} failed")
+            raise ConnectionError(
+                f"connect to {ip}:{port} failed"
+                + (f" (local_ip={local_ip})" if local_ip else "")
+            )
         return cid
+
+    def peer_addr(self, conn_id: int) -> str:
+        """'ip:port' of the conn's peer (verifies per-path NIC placement)."""
+        buf = ctypes.create_string_buffer(64)
+        if self._lib.ucclt_peer_addr(self._handle(), conn_id, buf, 64) != 0:
+            # Unknown id OR getpeername failed (peer reset a registered conn)
+            raise KeyError(
+                f"conn {conn_id}: unknown, or peer address unavailable "
+                "(disconnected?)"
+            )
+        return buf.value.decode()
+
+    def conn_alive(self, conn_id: int) -> bool:
+        """True while the conn is registered and not marked dead."""
+        return bool(self._lib.ucclt_conn_alive(self._handle(), conn_id))
 
     def accept(self, timeout_ms: int = 10000) -> int:
         cid = self._lib.ucclt_accept(self._handle(), timeout_ms)

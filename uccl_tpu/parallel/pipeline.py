@@ -245,11 +245,10 @@ def one_f_one_b(
             y, vjp = jax.vjp(stage_fn, params, x)
             # last stage sources its cotangent from the loss; others from
             # the cotangent that arrived over the wire
-            gy = jnp.where(
-                s == p - 1, jax.grad(loss_fn)(y), bwd_in.astype(y.dtype)
-            )
+            lv, gl = jax.value_and_grad(loss_fn)(y)
+            gy = jnp.where(s == p - 1, gl, bwd_in.astype(y.dtype))
             dp, dx = vjp(gy)
-            lval = jnp.where(s == p - 1, loss_fn(y), 0.0)
+            lval = jnp.where(s == p - 1, lv, 0.0).astype(jnp.float32)
             return dp, dx, lval
 
         zero_dp = jax.tree.map(jnp.zeros_like, params)
@@ -271,5 +270,362 @@ def one_f_one_b(
         step,
         (stash0, queue0, zeros_mb, zeros_mb, d0, jnp.float32(0.0)),
         jnp.arange(n_slots),
+    )
+    return lax.psum(loss_acc, axis), dparams
+
+
+# ---------------------------------------------------------------------------
+# Interleaved 1F1B (virtual pipeline chunks): each device holds V model
+# chunks, so the pipeline has L = P*V logical stages and the warm-up/drain
+# bubble shrinks by ~V (each ramp slot is 1/V of a device's layer budget).
+# The reference's PP story is one-sided activation sends with zero compute
+# occupancy (lite-ep/README.md:24); here the analog stays "two ppermutes per
+# slot" because of the stage numbering below — interleaving adds no new
+# communication structure, only a denser static schedule.
+
+
+def _simulate_interleaved(m: int, p: int, v: int, policy: str = "best"):
+    """Slot-synchronous interleaved 1F1B schedule builder.
+
+    Chunk ``c`` on device ``s`` is global stage ``g = c*p + s`` of ``L = p*v``
+    stages. Every forward hop g -> g+1 is then a ring ``+1`` hop over the pp
+    axis (the chunk wrap (c, p-1) -> (c+1, 0) included) and every backward
+    hop a ring ``-1`` hop, so the runtime needs exactly one forward and one
+    backward wire regardless of V.
+
+    Policies (one op per device per slot, like :func:`_simulate_1f1b`):
+
+    * ``greedy`` — backward-first in Megatron order preference; forwards
+      choose the ready candidate earliest in Megatron's interleaved order
+      ``(mb//p, c, mb%p)``, capped per chunk at ``min(m, (v-1-c)*p + p - s)``
+      in-flight (= downstream stages + 1, the interleaved generalization of
+      the classic ``p - s`` cap).
+    * ``strict`` — the Megatron static schedule: ``2(p-s-1) + (v-1)p + 1``
+      warm-up forwards in strict order, then backward-preferred alternation,
+      idling when the next op in order isn't ready.
+    * ``best`` (default) — build both and keep the shorter table.
+
+    Queue/stash slots are allocated by a free-list here at build time, so the
+    runtime's ring buffers are plain static-size arrays with precomputed
+    bank/read indices. Returns a dict of [T, P] int32 tables + capacities.
+    """
+    if policy == "best":
+        cands = [_simulate_interleaved(m, p, v, pol)
+                 for pol in ("greedy", "strict")]
+        cands = [c for c in cands if c is not None]
+        if not cands:
+            raise RuntimeError(
+                f"interleaved 1F1B schedule did not converge "
+                f"(m={m}, p={p}, v={v})"
+            )
+        return min(cands, key=lambda c: c["do_f"].shape[0])
+    L = p * v
+    fwd_done = [[0] * v for _ in range(p)]
+    bwd_done = [[0] * v for _ in range(p)]
+    ready_f = [[[None] * m for _ in range(v)] for _ in range(p)]
+    ready_b = [[[None] * m for _ in range(v)] for _ in range(p)]
+    for mb in range(m):
+        ready_f[0][0][mb] = 0
+
+    class _Alloc:
+        def __init__(self):
+            self.used = set()
+            self.high = 0
+
+        def get(self):
+            i = 0
+            while i in self.used:
+                i += 1
+            self.used.add(i)
+            self.high = max(self.high, i + 1)
+            return i
+
+        def put(self, i):
+            self.used.discard(i)
+
+    qf_a = [_Alloc() for _ in range(p)]
+    qb_a = [_Alloc() for _ in range(p)]
+    st_a = [_Alloc() for _ in range(p)]
+    qf_slot = [[[None] * m for _ in range(v)] for _ in range(p)]
+    qb_slot = [[[None] * m for _ in range(v)] for _ in range(p)]
+    st_slot = [[[None] * m for _ in range(v)] for _ in range(p)]
+
+    # Megatron interleaved op order per device: microbatches in groups of p,
+    # chunks inner-sequenced within the group; backwards mirror with chunks
+    # reversed (deepest drains first).
+    fseq = sorted(
+        ((mb // p, c, mb % p), c, mb) for c in range(v) for mb in range(m)
+    )
+    bseq = sorted(
+        ((mb // p, v - 1 - c, mb % p), c, mb)
+        for c in range(v)
+        for mb in range(m)
+    )
+    fi, bi = [0] * p, [0] * p
+    warm = [min(2 * (p - s - 1) + (v - 1) * p + 1, m * v) for s in range(p)]
+
+    def _f_ready(s, c, f, t):
+        if s == 0 and c == 0:
+            return True
+        return ready_f[s][c][f] is not None and ready_f[s][c][f] <= t
+
+    def _b_ready(s, c, b, t):
+        if fwd_done[s][c] <= b:
+            return False
+        if s == p - 1 and c == v - 1:
+            return True
+        return ready_b[s][c][b] is not None and ready_b[s][c][b] <= t
+
+    def _pick(s, t):
+        """Returns ('f'|'b', chunk) or None for this device this slot."""
+        if policy == "strict":
+            nf = fseq[fi[s]] if fi[s] < m * v else None
+            nb = bseq[bi[s]] if bi[s] < m * v else None
+            if fi[s] >= warm[s] and nb and _b_ready(s, nb[1], nb[2], t):
+                return "b", nb[1]
+            if nf and _f_ready(s, nf[1], nf[2], t):
+                return "f", nf[1]
+            return None
+        cand_b = []
+        for c in range(v):
+            b = bwd_done[s][c]
+            if b < m and _b_ready(s, c, b, t):
+                cand_b.append(((b // p, -c, b % p), c))
+        if cand_b:
+            return "b", min(cand_b)[1]
+        cand_f = []
+        for c in range(v):
+            f = fwd_done[s][c]
+            if f >= m or not _f_ready(s, c, f, t):
+                continue
+            cap = min(m, (v - 1 - c) * p + (p - s))
+            if fwd_done[s][c] - bwd_done[s][c] >= cap:
+                continue
+            cand_f.append(((f // p, c, f % p), c))
+        if cand_f:
+            return "f", min(cand_f)[1]
+        return None
+
+    rows, qf_banks, qb_banks = [], [], []
+    next_qf_bank = [-1] * p
+    next_qb_bank = [-1] * p
+    t = 0
+    limit = 8 * (v * m + p) + 16
+    while (
+        any(bwd_done[s][c] < m for s in range(p) for c in range(v))
+        and t < limit
+    ):
+        qf_banks.append(next_qf_bank)
+        qb_banks.append(next_qb_bank)
+        next_qf_bank = [-1] * p
+        next_qb_bank = [-1] * p
+        row = []
+        for s in range(p):
+            do_f = f_c = f_mb = st_put = 0
+            do_b = b_c = b_mb = st_get = 0
+            f_src = b_src = -1
+            pick = _pick(s, t)
+            if pick and pick[0] == "b":
+                c = pick[1]
+                b = bwd_done[s][c]
+                do_b, b_c, b_mb = 1, c, b
+                bwd_done[s][c] += 1
+                bi[s] += 1
+                st_get = st_slot[s][c][b]
+                st_a[s].put(st_get)
+                g = c * p + s
+                if g < L - 1:
+                    b_src = qb_slot[s][c][b]
+                    qb_a[s].put(b_src)
+                if g > 0:
+                    d = (s - 1) % p
+                    c2 = c if s > 0 else c - 1
+                    a = qb_a[d].get()
+                    qb_slot[d][c2][b] = a
+                    ready_b[d][c2][b] = t + 1
+                    next_qb_bank[d] = a
+            elif pick and pick[0] == "f":
+                c = pick[1]
+                f = fwd_done[s][c]
+                do_f, f_c, f_mb = 1, c, f
+                fwd_done[s][c] += 1
+                fi[s] += 1
+                if not (s == 0 and c == 0):
+                    f_src = qf_slot[s][c][f]
+                    qf_a[s].put(f_src)
+                st_put = st_a[s].get()
+                st_slot[s][c][f] = st_put
+                g = c * p + s
+                if g < L - 1:
+                    d = (s + 1) % p
+                    c2 = c if s < p - 1 else c + 1
+                    a = qf_a[d].get()
+                    qf_slot[d][c2][f] = a
+                    ready_f[d][c2][f] = t + 1
+                    next_qf_bank[d] = a
+            row.append(
+                (do_f, f_c, f_mb, f_src, st_put, do_b, b_c, b_mb, b_src, st_get)
+            )
+        rows.append(row)
+        t += 1
+    if any(bwd_done[s][c] < m for s in range(p) for c in range(v)):
+        return None
+    tab = np.asarray(rows, np.int32)  # [T, P, 10]
+    return {
+        "do_f": tab[..., 0],
+        "f_c": tab[..., 1],
+        "f_mb": tab[..., 2],
+        "f_src": tab[..., 3],
+        "st_put": tab[..., 4],
+        "do_b": tab[..., 5],
+        "b_c": tab[..., 6],
+        "b_mb": tab[..., 7],
+        "b_src": tab[..., 8],
+        "st_get": tab[..., 9],
+        "qf_bank": np.asarray(qf_banks, np.int32),
+        "qb_bank": np.asarray(qb_banks, np.int32),
+        "n_qf": max(1, max(a.high for a in qf_a)),
+        "n_qb": max(1, max(a.high for a in qb_a)),
+        "n_stash": max(1, max(a.high for a in st_a)),
+    }
+
+
+def interleaved_1f1b(
+    stage_fn: Callable[..., jax.Array],
+    loss_fn: Callable[[jax.Array], jax.Array],
+    params,
+    xmb: jax.Array,
+    n_chunks: int,
+    axis: str = "pp",
+):
+    """Interleaved-schedule pipeline training step (per-shard, in shard_map).
+
+    Args:
+      stage_fn: ``(chunk_params, x) -> y`` for ONE model chunk; x/y are one
+        microbatch ``[B_mb, ...]`` with matching shapes across all chunks.
+      loss_fn: ``y -> scalar`` applied to the final stage's outputs.
+      params: this device's chunk parameters STACKED on a leading axis of
+        size ``n_chunks``: leaf ``[V, ...]`` where chunk ``c`` holds global
+        stage ``c*P + s`` (Megatron-interleaved assignment).
+      xmb: ``[M, B_mb, ...]`` microbatches (consumed by stage 0 = chunk 0 of
+        device 0).
+      n_chunks: V, the virtual-chunk count per device.
+
+    Returns ``(loss, d_params)`` with d_params stacked like ``params``.
+    The warm-up/drain bubble is ~1/V of :func:`one_f_one_b`'s in wall-clock
+    terms (each slot runs one chunk = 1/V of a device's layers).
+    """
+    p = lax.axis_size(axis)
+    s = lax.axis_index(axis)
+    m = xmb.shape[0]
+    v = int(n_chunks)
+    if m < 1 or v < 1:
+        raise ValueError(f"need >=1 microbatch and >=1 chunk (m={m}, v={v})")
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(params)}
+    if leading != {v}:
+        raise ValueError(
+            f"params leaves must stack {v} chunks on axis 0; got leading "
+            f"dims {sorted(leading)}"
+        )
+    sched = _simulate_interleaved(m, int(p), v)
+    T = sched["do_f"].shape[0]
+    tabs = {k: jnp.asarray(sched[k]) for k in sched if k.startswith(("do_", "f_", "b_", "st_", "qf_", "qb_"))}
+    n_qf, n_qb, n_st = sched["n_qf"], sched["n_qb"], sched["n_stash"]
+    fwd_perm = ppermute_pairs(p, 1)
+    bwd_perm = ppermute_pairs(p, -1)
+
+    mb_shape = xmb.shape[1:]
+    zeros_mb = jnp.zeros(mb_shape, xmb.dtype)
+    chunk_zero = jax.tree.map(lambda a: jnp.zeros_like(a[0]), params)
+
+    def _chunk(tree_v, c):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, c, axis=0, keepdims=False),
+            tree_v,
+        )
+
+    def step(carry, t):
+        stash, qf, qb, fwd_in, bwd_in, dparams, loss_acc = carry
+
+        # ---- bank this slot's wire arrivals into their precomputed slots
+        qa = tabs["qf_bank"][t, s]
+        qai = jnp.clip(qa, 0, n_qf - 1)
+        cur = lax.dynamic_index_in_dim(qf, qai, axis=0, keepdims=False)
+        qf = lax.dynamic_update_index_in_dim(
+            qf, jnp.where(qa >= 0, fwd_in, cur), qai, axis=0
+        )
+        ba = tabs["qb_bank"][t, s]
+        bai = jnp.clip(ba, 0, n_qb - 1)
+        curb = lax.dynamic_index_in_dim(qb, bai, axis=0, keepdims=False)
+        qb = lax.dynamic_update_index_in_dim(
+            qb, jnp.where(ba >= 0, bwd_in, curb), bai, axis=0
+        )
+
+        do_f = tabs["do_f"][t, s]
+        f_c = tabs["f_c"][t, s]
+        f_mb = tabs["f_mb"][t, s]
+        f_src = tabs["f_src"][t, s]
+        st_put = tabs["st_put"][t, s]
+        do_b = tabs["do_b"][t, s]
+        b_c = tabs["b_c"][t, s]
+        b_mb = tabs["b_mb"][t, s]
+        b_src = tabs["b_src"][t, s]
+        st_get = tabs["st_get"][t, s]
+
+        def fwd(_):
+            x_q = lax.dynamic_index_in_dim(
+                qf, jnp.clip(f_src, 0, n_qf - 1), axis=0, keepdims=False
+            )
+            x_0 = lax.dynamic_index_in_dim(xmb, f_mb, axis=0, keepdims=False)
+            x = jnp.where(f_src < 0, x_0, x_q)
+            y = stage_fn(_chunk(params, f_c), x)
+            st = lax.dynamic_update_index_in_dim(stash, x, st_put, axis=0)
+            return y, st
+
+        y_out, stash = lax.cond(
+            do_f == 1, fwd, lambda _: (zeros_mb, stash), None
+        )
+
+        def bwd(_):
+            x = lax.dynamic_index_in_dim(stash, st_get, axis=0, keepdims=False)
+            pc = _chunk(params, b_c)
+            y, vjp = jax.vjp(stage_fn, pc, x)
+            g_q = lax.dynamic_index_in_dim(
+                qb, jnp.clip(b_src, 0, n_qb - 1), axis=0, keepdims=False
+            )
+            # b_src < 0 marks the final logical stage: cotangent comes from
+            # the loss instead of the wire.
+            lv, gl = jax.value_and_grad(loss_fn)(y)
+            gy = jnp.where(b_src < 0, gl, g_q.astype(y.dtype))
+            dp, dx = vjp(gy)
+            lval = jnp.where(b_src < 0, lv, 0.0).astype(jnp.float32)
+            return dp, dx, lval
+
+        dp, dx_out, lval = lax.cond(
+            do_b == 1,
+            bwd,
+            lambda _: (chunk_zero, zeros_mb, jnp.float32(0.0)),
+            None,
+        )
+
+        def _acc(acc, d):
+            cur = lax.dynamic_index_in_dim(acc, b_c, axis=0, keepdims=False)
+            return lax.dynamic_update_index_in_dim(acc, cur + d, b_c, axis=0)
+
+        dparams = jax.tree.map(_acc, dparams, dp)
+        loss_acc = loss_acc + lval
+
+        fwd_next = lax.ppermute(y_out, axis, fwd_perm)
+        bwd_next = lax.ppermute(dx_out, axis, bwd_perm)
+        return (stash, qf, qb, fwd_next, bwd_next, dparams, loss_acc), None
+
+    stash0 = jnp.zeros((n_st,) + mb_shape, xmb.dtype)
+    qf0 = jnp.zeros((n_qf,) + mb_shape, xmb.dtype)
+    qb0 = jnp.zeros((n_qb,) + mb_shape, xmb.dtype)
+    d0 = jax.tree.map(jnp.zeros_like, params)
+    (stash, _, _, _, _, dparams, loss_acc), _ = lax.scan(
+        step,
+        (stash0, qf0, qb0, zeros_mb, zeros_mb, d0, jnp.float32(0.0)),
+        jnp.arange(T),
     )
     return lax.psum(loss_acc, axis), dparams

@@ -103,6 +103,15 @@ class Endpoint {
   // conn is unknown. Lets multipath layers verify per-path NIC placement.
   bool peer_addr(uint64_t conn_id, char* out, size_t cap);
   bool remove_conn(uint64_t conn_id);  // reference: remove_remote_endpoint
+  // Wait until every frame send() already queued on the conn has been
+  // handed to the kernel socket (tx queue empty), so a subsequent
+  // remove_conn/close cannot drop frames whose sends completed ("done"
+  // means copied to the tx queue, not transmitted — the graceful-close gap
+  // a raw remove_conn leaves). Covers send()-queued frames only: a
+  // write_async/read_async task still waiting in the engine ring has not
+  // reached the tx queue yet and is not waited for — wait() on its xfer id
+  // first. False on conn death or timeout.
+  bool flush_conn(uint64_t conn_id, int timeout_ms = 5000);
   // true while the conn is registered and not marked dead — lets pollers
   // distinguish "nothing queued yet" from "peer is gone" (recv() returns -1
   // for both).
@@ -299,6 +308,7 @@ class Endpoint {
   // nonblocking send of queued frames; returns false when the conn died,
   // sets *blocked when EAGAIN left data queued. tx thread only.
   bool service_tx(Conn* c, bool* blocked);
+  bool wait_txq_below(Conn* c, size_t threshold, int timeout_ms);
   // tx thread only: fail + drop every queued frame of a dead conn.
   void fail_txq(Conn* c);
   void conn_error(uint64_t conn_id);

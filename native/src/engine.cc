@@ -256,6 +256,13 @@ bool Endpoint::remove_conn(uint64_t conn_id) {
   return true;
 }
 
+bool Endpoint::flush_conn(uint64_t conn_id, int timeout_ms) {
+  auto c = get_conn(conn_id);
+  if (!c) return false;
+  if (!wait_txq_below(c.get(), 0, timeout_ms)) return false;
+  return !c->dead.load();
+}
+
 uint64_t Endpoint::reg(void* ptr, size_t len) {
   Reg r{ptr, len};
   uint64_t id = next_reg_.fetch_add(1);
@@ -464,21 +471,29 @@ bool Endpoint::read(uint64_t conn_id, void* dst, size_t len,
   return wait(read_async(conn_id, dst, len, item), 30000);
 }
 
-bool Endpoint::send(uint64_t conn_id, const void* buf, size_t len) {
-  auto c = get_conn(conn_id);
-  if (!c) return false;
-  // Backpressure: a peer that stops reading fills its queue to the
-  // watermark, then senders block here (the old blocking-send behavior)
-  // instead of growing the owned-copy queue without bound.
-  auto deadline =
-      std::chrono::steady_clock::now() + std::chrono::milliseconds(5000);
-  while (c->txq_bytes.load(std::memory_order_relaxed) > kTxqHighWater) {
+// Poll until the conn's queued tx bytes drop to `threshold` or below;
+// false on conn death, endpoint stop, or timeout. Serves both send()'s
+// high-water backpressure and flush_conn()'s drain-to-empty.
+bool Endpoint::wait_txq_below(Conn* c, size_t threshold, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (c->txq_bytes.load(std::memory_order_relaxed) > threshold) {
     if (c->dead.load() || stop_.load() ||
         std::chrono::steady_clock::now() > deadline) {
       return false;
     }
     std::this_thread::sleep_for(std::chrono::microseconds(200));
   }
+  return true;
+}
+
+bool Endpoint::send(uint64_t conn_id, const void* buf, size_t len) {
+  auto c = get_conn(conn_id);
+  if (!c) return false;
+  // Backpressure: a peer that stops reading fills its queue to the
+  // watermark, then senders block here (the old blocking-send behavior)
+  // instead of growing the owned-copy queue without bound.
+  if (!wait_txq_below(c.get(), kTxqHighWater, 5000)) return false;
   if (c->dead.load()) return false;
   FrameHeader h{};
   h.magic = kMagic;

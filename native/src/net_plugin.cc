@@ -2,6 +2,9 @@
 
 #include "uccl_tpu/net_plugin.h"
 
+#include <unistd.h>
+
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
@@ -277,6 +280,11 @@ void drain_comm(Plugin& p, Endpoint* ep, Comm* c) {
     std::memcpy(&m.tag, p.staging.data(), sizeof(uint64_t));
     m.data.assign(p.staging.begin() + sizeof(uint64_t),
                   p.staging.begin() + static_cast<size_t>(n));
+    if (std::getenv("UCCL_TPU_NET_DEBUG")) {
+      fprintf(stderr, "[net %d] drained conn=%llu tag=%llu size=%zu\n",
+              getpid(), (unsigned long long)c->conn_id,
+              (unsigned long long)m.tag, m.data.size());
+    }
     c->unmatched.push_back(std::move(m));
   }
 }
@@ -302,6 +310,10 @@ int pi_test(void* request, int* done, size_t* size) {
         if (it->tag != r->tag) continue;
         if (it->data.size() > r->posted) {
           r->failed = 1;  // peer sent more than posted (NCCL contract breach)
+          if (std::getenv("UCCL_TPU_NET_DEBUG")) {
+            fprintf(stderr, "[net] recv tag=%llu oversize: got %zu posted %zu\n",
+                    (unsigned long long)r->tag, it->data.size(), r->posted);
+          }
         } else {
           std::memcpy(r->data, it->data.data(), it->data.size());
           r->size = it->data.size();
@@ -313,6 +325,11 @@ int pi_test(void* request, int* done, size_t* size) {
       if (!r->done && !alive) {
         r->done = 1;
         r->failed = 1;  // peer gone, nothing queued: surface the error
+        if (std::getenv("UCCL_TPU_NET_DEBUG")) {
+          fprintf(stderr, "[net] recv tag=%llu: conn %llu dead, %zu unmatched\n",
+                  (unsigned long long)r->tag,
+                  (unsigned long long)r->comm->conn_id, q.size());
+        }
       }
     }
   }
@@ -343,7 +360,14 @@ int close_comm(void* comm) {
   if (!comm) return UCCLT_NET_ERR;
   auto* c = static_cast<Comm*>(comm);
   auto ep = plugin().endpoint();
-  if (ep) ep->remove_conn(c->conn_id);
+  if (ep) {
+    // isend "done" means copied to the engine tx queue; NCCL's contract is
+    // that completed sends are delivered, so drain the queue into the
+    // kernel before tearing the conn down (the kernel finishes delivery
+    // after an orderly close).
+    if (c->sender) ep->flush_conn(c->conn_id, 2000);
+    ep->remove_conn(c->conn_id);
+  }
   delete c;
   return UCCLT_NET_OK;
 }

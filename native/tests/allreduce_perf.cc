@@ -1,0 +1,365 @@
+// allreduce_perf: nccl-tests-shaped acceptance benchmark over the net plugin.
+//
+// The reference's system-level acceptance test is thirdparty/nccl-tests'
+// all_reduce_perf driven against its NCCL net plugin (SURVEY §4.5,
+// collective/rdma/run_nccl_test.sh). This is the TPU-framework analog: a
+// standalone C++ harness that dlopens libuccl_tpu_net.so, speaks ONLY the
+// ucclt_net_v1 vtable (listen/connect/accept/reg_mr/isend/irecv/test), and
+// runs a ring allreduce across N forked ranks on this host — proving the
+// plugin ABI is complete enough to build a collective runtime on, exactly
+// what NCCL proves about the reference's plugin.
+//
+// Output mirrors nccl-tests: one row per size with time, algorithm bandwidth
+// and bus bandwidth (busbw = algbw * 2*(n-1)/n), plus a #wrong correctness
+// column (rank-patterned input, exact float sum verified).
+//
+// Usage: allreduce_perf [-n ranks] [-b minbytes] [-e maxbytes] [-f factor]
+//                       [-i iters] [-w warmup] [-p plugin.so] [-c 0|1]
+
+#include <dlfcn.h>
+#include <getopt.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <array>
+#include <string>
+#include <vector>
+
+#include "uccl_tpu/net_plugin.h"
+
+namespace {
+
+double now_us() {
+  timeval tv;
+  gettimeofday(&tv, nullptr);
+  return tv.tv_sec * 1e6 + tv.tv_usec;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t k = write(fd, p, n);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t k = read(fd, p, n);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+struct SizeReport {
+  double time_us;
+  uint64_t wrong;
+};
+
+// Deterministic rank-patterned input (the nccl-tests discipline: seeded
+// data, exact expected reduction).
+float pattern(int rank, size_t i) {
+  return static_cast<float>((i * 37 + static_cast<size_t>(rank) * 101) % 97) *
+         0.25f;
+}
+
+struct Ring {
+  const ucclt_net_v1_t* net = nullptr;
+  void* send_comm = nullptr;  // to next rank
+  void* recv_comm = nullptr;  // from prev rank
+  void* send_mr = nullptr;
+  void* recv_mr = nullptr;
+
+  // Blocking send+recv pair (the harness is single-threaded per rank; the
+  // plugin's isend is buffer-reusable-on-done so polling both to completion
+  // cannot deadlock over the framed-TCP engine).
+  int rank = -1;
+
+  // Bidirectional step with per-direction sizes (ring segments may differ
+  // in length when count % n != 0; a zero-length direction is skipped on
+  // both sides, which agree on lengths by construction).
+  bool exchange2(const void* sbuf, size_t sbytes, void* rbuf, size_t rbytes,
+                 uint64_t tag) {
+    if (getenv("ARP_TRACE")) {
+      fprintf(stderr, "[r%d pid%d] xchg tag=%llu s=%zu r=%zu\n", rank,
+              getpid(), (unsigned long long)tag, sbytes, rbytes);
+    }
+    void* sreq = nullptr;
+    void* rreq = nullptr;
+    if (rbytes &&
+        net->irecv(recv_comm, rbuf, rbytes, tag, recv_mr, &rreq) !=
+            UCCLT_NET_OK) {
+      fprintf(stderr, "rank %d: irecv(tag=%llu) failed\n", rank,
+              (unsigned long long)tag);
+      return false;
+    }
+    if (sbytes &&
+        net->isend(send_comm, sbuf, sbytes, tag, send_mr, &sreq) !=
+            UCCLT_NET_OK) {
+      fprintf(stderr, "rank %d: isend(tag=%llu) failed\n", rank,
+              (unsigned long long)tag);
+      return false;
+    }
+    int sdone = sbytes ? 0 : 1, rdone = rbytes ? 0 : 1;
+    size_t got = 0;
+    while (!sdone || !rdone) {
+      if (!sdone && net->test(sreq, &sdone, &got) != UCCLT_NET_OK) {
+        fprintf(stderr, "rank %d: send test(tag=%llu) failed\n", rank,
+                (unsigned long long)tag);
+        return false;
+      }
+      if (!rdone && net->test(rreq, &rdone, &got) != UCCLT_NET_OK) {
+        fprintf(stderr, "rank %d: recv test(tag=%llu, %zuB) failed\n", rank,
+                (unsigned long long)tag, rbytes);
+        return false;
+      }
+    }
+    return true;
+  }
+
+  bool exchange(const void* sbuf, void* rbuf, size_t bytes, uint64_t tag) {
+    return exchange2(sbuf, bytes, rbuf, bytes, tag);
+  }
+
+  bool barrier(uint64_t tag) {
+    // Two token laps: everyone has entered by the time the second lap lands.
+    uint8_t tok = 1, in = 0;
+    return exchange(&tok, &in, 1, tag) && exchange(&tok, &in, 1, tag + 1);
+  }
+};
+
+// Ring allreduce (sum, f32), in place: reduce-scatter then allgather, the
+// canonical 2*(n-1)/n bus-bandwidth schedule nccl-tests rates plugins by.
+bool ring_allreduce(Ring& r, float* data, size_t count, int rank, int n,
+                    float* scratch, uint64_t tag_base) {
+  if (n == 1) return true;
+  size_t seg = (count + static_cast<size_t>(n) - 1) / n;
+  auto seg_ptr = [&](int s) { return data + static_cast<size_t>(s) * seg; };
+  auto seg_len = [&](int s) {
+    size_t lo = static_cast<size_t>(s) * seg;
+    if (lo >= count) return static_cast<size_t>(0);
+    size_t hi = lo + seg;
+    return (hi > count ? count : hi) - lo;
+  };
+  uint64_t tag = tag_base;
+  for (int step = 0; step < n - 1; ++step, ++tag) {
+    int ssend = ((rank - step) % n + n) % n;
+    int srecv = ((rank - step - 1) % n + n) % n;
+    size_t len = seg_len(srecv);
+    if (!r.exchange2(seg_ptr(ssend), seg_len(ssend) * sizeof(float), scratch,
+                     len * sizeof(float), tag))
+      return false;
+    float* dst = seg_ptr(srecv);
+    for (size_t i = 0; i < len; ++i) dst[i] += scratch[i];
+  }
+  for (int step = 0; step < n - 1; ++step, ++tag) {
+    int ssend = ((rank + 1 - step) % n + n) % n;
+    int srecv = ((rank - step) % n + n) % n;
+    size_t len = seg_len(srecv);
+    if (!r.exchange2(seg_ptr(ssend), seg_len(ssend) * sizeof(float), scratch,
+                     len * sizeof(float), tag))
+      return false;
+    memcpy(seg_ptr(srecv), scratch, len * sizeof(float));
+  }
+  return true;
+}
+
+int run_rank(int rank, int n, int oob_fd, const char* plugin_path,
+             size_t min_bytes, size_t max_bytes, int factor, int iters,
+             int warmup, int check) {
+  void* so = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
+  if (!so) {
+    fprintf(stderr, "rank %d: dlopen %s: %s\n", rank, plugin_path, dlerror());
+    return 2;
+  }
+  auto* net = static_cast<const ucclt_net_v1_t*>(dlsym(so, "ucclt_net_v1"));
+  if (!net) {
+    fprintf(stderr, "rank %d: no ucclt_net_v1 symbol\n", rank);
+    return 2;
+  }
+  if (net->init() != UCCLT_NET_OK) return 2;
+
+  // Rendezvous: ship my listen handle to the parent, get back the handle of
+  // the rank I connect to (next in ring). This is the out-of-band channel
+  // the plugin contract assumes (NCCL ships handles via its bootstrap).
+  char handle[UCCLT_NET_HANDLE_BYTES];
+  void* listen_comm = nullptr;
+  if (net->listen(0, handle, &listen_comm) != UCCLT_NET_OK) return 2;
+  if (!write_all(oob_fd, handle, sizeof(handle))) return 2;
+  char next_handle[UCCLT_NET_HANDLE_BYTES];
+  if (!read_all(oob_fd, next_handle, sizeof(next_handle))) return 2;
+
+  Ring ring;
+  ring.net = net;
+  ring.rank = rank;
+  if (net->connect(0, next_handle, &ring.send_comm) != UCCLT_NET_OK) return 2;
+  if (net->accept(listen_comm, &ring.recv_comm) != UCCLT_NET_OK) return 2;
+
+  size_t max_count = max_bytes / sizeof(float);
+  size_t seg = (max_count + static_cast<size_t>(n) - 1) / n;
+  std::vector<float> data(max_count ? max_count : 1);
+  std::vector<float> scratch((seg ? seg : 1) + 1);
+  if (net->reg_mr(ring.send_comm, data.data(), data.size() * sizeof(float), 0,
+                  &ring.send_mr) != UCCLT_NET_OK)
+    return 2;
+  if (net->reg_mr(ring.recv_comm, scratch.data(),
+                  scratch.size() * sizeof(float), 0,
+                  &ring.recv_mr) != UCCLT_NET_OK)
+    return 2;
+
+  uint64_t tag = 1000;
+  for (size_t bytes = min_bytes; bytes <= max_bytes;
+       bytes *= static_cast<size_t>(factor)) {
+    size_t count = bytes / sizeof(float);
+    if (!count) continue;
+    SizeReport rep{0.0, 0};
+    for (int it = 0; it < warmup + iters; ++it) {
+      for (size_t i = 0; i < count; ++i) data[i] = pattern(rank, i);
+      if (!ring.barrier(tag)) return 2;
+      tag += 2;
+      double t0 = now_us();
+      if (!ring_allreduce(ring, data.data(), count, rank, n, scratch.data(),
+                          tag))
+        return 2;
+      double dt = now_us() - t0;
+      tag += 2 * static_cast<uint64_t>(n);
+      if (it >= warmup) rep.time_us += dt / iters;
+      if (check && it == warmup + iters - 1) {
+        for (size_t i = 0; i < count; ++i) {
+          float want = 0.f;
+          for (int rr = 0; rr < n; ++rr) want += pattern(rr, i);
+          if (data[i] != want) ++rep.wrong;
+        }
+      }
+    }
+    if (!write_all(oob_fd, &rep, sizeof(rep))) return 2;
+  }
+
+  net->dereg_mr(ring.send_comm, ring.send_mr);
+  net->dereg_mr(ring.recv_comm, ring.recv_mr);
+  net->close_send(ring.send_comm);
+  net->close_recv(ring.recv_comm);
+  net->close_listen(listen_comm);
+  net->finalize();
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int n = 2, iters = 5, warmup = 2, factor = 2, check = 1;
+  size_t min_bytes = 1024, max_bytes = 1 << 22;
+  std::string plugin = "build/libuccl_tpu_net.so";
+  int opt;
+  while ((opt = getopt(argc, argv, "n:b:e:f:i:w:p:c:")) != -1) {
+    switch (opt) {
+      case 'n': n = atoi(optarg); break;
+      case 'b': min_bytes = strtoull(optarg, nullptr, 0); break;
+      case 'e': max_bytes = strtoull(optarg, nullptr, 0); break;
+      case 'f': factor = atoi(optarg); break;
+      case 'i': iters = atoi(optarg); break;
+      case 'w': warmup = atoi(optarg); break;
+      case 'p': plugin = optarg; break;
+      case 'c': check = atoi(optarg); break;
+      default:
+        fprintf(stderr, "bad flag\n");
+        return 2;
+    }
+  }
+  if (n < 2 || factor < 2 || min_bytes < sizeof(float) ||
+      max_bytes < min_bytes) {
+    fprintf(stderr, "need -n>=2, -f>=2, 4 <= -b <= -e\n");
+    return 2;
+  }
+
+  std::vector<int> fds(n);
+  std::vector<pid_t> pids(n);
+  for (int r = 0; r < n; ++r) {
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+      perror("socketpair");
+      return 2;
+    }
+    pid_t pid = fork();
+    if (pid == 0) {
+      close(sv[0]);
+      for (int k = 0; k < r; ++k) close(fds[k]);
+      int rc = run_rank(r, n, sv[1], plugin.c_str(), min_bytes, max_bytes,
+                        factor, iters, warmup, check);
+      _exit(rc);
+    }
+    close(sv[1]);
+    fds[r] = sv[0];
+    pids[r] = pid;
+  }
+
+  // Handle exchange: collect every rank's listen handle, hand rank r the
+  // handle of rank (r+1)%n.
+  std::vector<std::array<char, UCCLT_NET_HANDLE_BYTES>> handles(n);
+  bool ok = true;
+  for (int r = 0; r < n; ++r)
+    ok = ok && read_all(fds[r], handles[r].data(), UCCLT_NET_HANDLE_BYTES);
+  for (int r = 0; r < n && ok; ++r)
+    ok = ok && write_all(fds[r], handles[(r + 1) % n].data(),
+                         UCCLT_NET_HANDLE_BYTES);
+  if (!ok) {
+    fprintf(stderr, "handle exchange failed\n");
+    return 2;
+  }
+
+  printf("# allreduce_perf over ucclt_net_v1 (%s), %d ranks, ring, f32 sum\n",
+         plugin.c_str(), n);
+  printf("# %10s %10s %12s %12s %12s %8s\n", "size_B", "count", "time_us",
+         "algbw_GBps", "busbw_GBps", "wrong");
+  uint64_t total_wrong = 0;
+  for (size_t bytes = min_bytes; bytes <= max_bytes;
+       bytes *= static_cast<size_t>(factor)) {
+    size_t count = bytes / sizeof(float);
+    if (!count) continue;
+    double worst = 0.0;
+    uint64_t wrong = 0;
+    for (int r = 0; r < n; ++r) {
+      SizeReport rep;
+      if (!read_all(fds[r], &rep, sizeof(rep))) {
+        fprintf(stderr, "rank %d died mid-benchmark\n", r);
+        return 2;
+      }
+      if (rep.time_us > worst) worst = rep.time_us;
+      wrong += rep.wrong;
+    }
+    double algbw = worst > 0 ? bytes / (worst * 1e-6) / 1e9 : 0.0;
+    double busbw = algbw * 2.0 * (n - 1) / n;
+    printf("  %10zu %10zu %12.1f %12.3f %12.3f %8llu\n", bytes, count, worst,
+           algbw, busbw, static_cast<unsigned long long>(wrong));
+    total_wrong += wrong;
+  }
+
+  int bad = 0;
+  for (int r = 0; r < n; ++r) {
+    int st = 0;
+    waitpid(pids[r], &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) bad = 1;
+    close(fds[r]);
+  }
+  if (total_wrong) {
+    printf("# FAILED: %llu wrong elements\n",
+           static_cast<unsigned long long>(total_wrong));
+    return 1;
+  }
+  if (bad) return 2;
+  printf("# OK\n");
+  return 0;
+}

@@ -1,0 +1,63 @@
+"""nccl-tests-shaped acceptance benchmark over the loadable net plugin.
+
+The reference's system-level acceptance gate is nccl-tests' all_reduce_perf
+against its NCCL net plugin (SURVEY.md §4.5). Our analog binary
+(native/tests/allreduce_perf.cc) forks N ranks, speaks only the ucclt_net_v1
+vtable via dlopen, and ring-allreduces with exact correctness checks — this
+test builds and runs it at world 2 and 4.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+_NATIVE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+
+
+@pytest.fixture(scope="module")
+def binaries():
+    r = subprocess.run(
+        ["make", "-C", _NATIVE, "build/allreduce_perf",
+         "build/libuccl_tpu_net.so"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    return (
+        os.path.join(_NATIVE, "build", "allreduce_perf"),
+        os.path.join(_NATIVE, "build", "libuccl_tpu_net.so"),
+    )
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_allreduce_perf_correct(binaries, world):
+    exe, plugin = binaries
+    r = subprocess.run(
+        [exe, "-n", str(world), "-b", "1024", "-e", "65536", "-i", "2",
+         "-w", "1", "-p", plugin],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "# OK" in r.stdout
+    rows = [l for l in r.stdout.splitlines() if not l.startswith("#")]
+    assert len(rows) == 7  # 1K..64K at factor 2
+    for row in rows:
+        cols = row.split()
+        assert cols[-1] == "0"  # wrong column
+        assert float(cols[2]) > 0  # measured time
+
+
+@pytest.mark.parametrize("world,bytes_", [(3, 1024), (7, 64)])
+def test_allreduce_perf_ragged_segments(binaries, world, bytes_):
+    """Rank counts that don't divide the element count produce short and
+    empty ring segments — both sides must agree on per-direction sizes."""
+    exe, plugin = binaries
+    r = subprocess.run(
+        [exe, "-n", str(world), "-b", str(bytes_), "-e", str(bytes_),
+         "-i", "2", "-w", "1", "-p", plugin],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "# OK" in r.stdout

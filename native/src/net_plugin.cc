@@ -97,6 +97,13 @@ Plugin& plugin() {
   return p;
 }
 
+bool net_debug() {
+  // Cached once: getenv scans environ linearly and drain_comm runs per
+  // received message under the plugin mutex.
+  static const bool dbg = std::getenv("UCCL_TPU_NET_DEBUG") != nullptr;
+  return dbg;
+}
+
 const char* local_ip() {
   const char* ip = std::getenv("UCCL_TPU_HOST_IP");
   return (ip && ip[0]) ? ip : "127.0.0.1";
@@ -280,7 +287,7 @@ void drain_comm(Plugin& p, Endpoint* ep, Comm* c) {
     std::memcpy(&m.tag, p.staging.data(), sizeof(uint64_t));
     m.data.assign(p.staging.begin() + sizeof(uint64_t),
                   p.staging.begin() + static_cast<size_t>(n));
-    if (std::getenv("UCCL_TPU_NET_DEBUG")) {
+    if (net_debug()) {
       fprintf(stderr, "[net %d] drained conn=%llu tag=%llu size=%zu\n",
               getpid(), (unsigned long long)c->conn_id,
               (unsigned long long)m.tag, m.data.size());
@@ -310,7 +317,7 @@ int pi_test(void* request, int* done, size_t* size) {
         if (it->tag != r->tag) continue;
         if (it->data.size() > r->posted) {
           r->failed = 1;  // peer sent more than posted (NCCL contract breach)
-          if (std::getenv("UCCL_TPU_NET_DEBUG")) {
+          if (net_debug()) {
             fprintf(stderr, "[net] recv tag=%llu oversize: got %zu posted %zu\n",
                     (unsigned long long)r->tag, it->data.size(), r->posted);
           }
@@ -325,7 +332,7 @@ int pi_test(void* request, int* done, size_t* size) {
       if (!r->done && !alive) {
         r->done = 1;
         r->failed = 1;  // peer gone, nothing queued: surface the error
-        if (std::getenv("UCCL_TPU_NET_DEBUG")) {
+        if (net_debug()) {
           fprintf(stderr, "[net] recv tag=%llu: conn %llu dead, %zu unmatched\n",
                   (unsigned long long)r->tag,
                   (unsigned long long)r->comm->conn_id, q.size());

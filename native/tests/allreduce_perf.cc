@@ -82,6 +82,14 @@ struct Ring {
   void* recv_comm = nullptr;  // from prev rank
   void* send_mr = nullptr;
   void* recv_mr = nullptr;
+  // Barrier token buffers, registered in their own right (the harness must
+  // honor the reg_mr contract it exists to validate — a conforming plugin
+  // may DMA from exactly the registered range).
+  uint8_t tok_out = 0;
+  uint8_t tok_in = 0;
+  void* tok_out_mr = nullptr;
+  void* tok_in_mr = nullptr;
+  int nranks = 0;
 
   // Blocking send+recv pair (the harness is single-threaded per rank; the
   // plugin's isend is buffer-reusable-on-done so polling both to completion
@@ -134,10 +142,30 @@ struct Ring {
     return exchange2(sbuf, bytes, rbuf, bytes, tag);
   }
 
+  // Dissemination barrier on the ring: after k neighbor exchanges a rank
+  // has (transitively) heard from every rank within distance k, so n-1
+  // rounds make a true barrier. Consumes n-1 tags starting at `tag`.
   bool barrier(uint64_t tag) {
-    // Two token laps: everyone has entered by the time the second lap lands.
-    uint8_t tok = 1, in = 0;
-    return exchange(&tok, &in, 1, tag) && exchange(&tok, &in, 1, tag + 1);
+    for (int round = 0; round < nranks - 1; ++round) {
+      tok_out = 1;
+      void* sreq = nullptr;
+      void* rreq = nullptr;
+      if (net->irecv(recv_comm, &tok_in, 1, tag + round, tok_in_mr, &rreq) !=
+          UCCLT_NET_OK)
+        return false;
+      if (net->isend(send_comm, &tok_out, 1, tag + round, tok_out_mr,
+                     &sreq) != UCCLT_NET_OK)
+        return false;
+      int sdone = 0, rdone = 0;
+      size_t got = 0;
+      while (!sdone || !rdone) {
+        if (!sdone && net->test(sreq, &sdone, &got) != UCCLT_NET_OK)
+          return false;
+        if (!rdone && net->test(rreq, &rdone, &got) != UCCLT_NET_OK)
+          return false;
+      }
+    }
+    return true;
   }
 };
 
@@ -205,8 +233,15 @@ int run_rank(int rank, int n, int oob_fd, const char* plugin_path,
   Ring ring;
   ring.net = net;
   ring.rank = rank;
+  ring.nranks = n;
   if (net->connect(0, next_handle, &ring.send_comm) != UCCLT_NET_OK) return 2;
   if (net->accept(listen_comm, &ring.recv_comm) != UCCLT_NET_OK) return 2;
+  if (net->reg_mr(ring.send_comm, &ring.tok_out, 1, 0, &ring.tok_out_mr) !=
+      UCCLT_NET_OK)
+    return 2;
+  if (net->reg_mr(ring.recv_comm, &ring.tok_in, 1, 0, &ring.tok_in_mr) !=
+      UCCLT_NET_OK)
+    return 2;
 
   size_t max_count = max_bytes / sizeof(float);
   size_t seg = (max_count + static_cast<size_t>(n) - 1) / n;
@@ -229,7 +264,7 @@ int run_rank(int rank, int n, int oob_fd, const char* plugin_path,
     for (int it = 0; it < warmup + iters; ++it) {
       for (size_t i = 0; i < count; ++i) data[i] = pattern(rank, i);
       if (!ring.barrier(tag)) return 2;
-      tag += 2;
+      tag += static_cast<uint64_t>(n);  // barrier consumed n-1 tags
       double t0 = now_us();
       if (!ring_allreduce(ring, data.data(), count, rank, n, scratch.data(),
                           tag))
@@ -250,6 +285,8 @@ int run_rank(int rank, int n, int oob_fd, const char* plugin_path,
 
   net->dereg_mr(ring.send_comm, ring.send_mr);
   net->dereg_mr(ring.recv_comm, ring.recv_mr);
+  net->dereg_mr(ring.send_comm, ring.tok_out_mr);
+  net->dereg_mr(ring.recv_comm, ring.tok_in_mr);
   net->close_send(ring.send_comm);
   net->close_recv(ring.recv_comm);
   net->close_listen(listen_comm);

@@ -18,5 +18,6 @@ Surfaces:
 from uccl_tpu.ep import ops
 from uccl_tpu.ep.buffer import Buffer
 from uccl_tpu.ep.cross_pod import CrossPodMoE
+from uccl_tpu.ep.elastic import ElasticBuffer, ElasticKVCache
 
-__all__ = ["ops", "Buffer", "CrossPodMoE"]
+__all__ = ["ops", "Buffer", "CrossPodMoE", "ElasticBuffer", "ElasticKVCache"]

@@ -110,6 +110,30 @@ def decode_step(params, token, cache: KVCache, cfg: DenseConfig):
     return logits[:, 0], cache
 
 
+def decode_step_elastic(params, token, ekv, cfg: DenseConfig):
+    """One autoregressive step over an :class:`uccl_tpu.ep.elastic.ElasticKVCache`.
+
+    Same contract as :func:`decode_step`, but the KV context comes from the
+    elastic cache (hot blocks in HBM, cold blocks staged from host memory),
+    so decode length is bounded by host memory, not HBM. Returns
+    logits [B, V]; the cache is updated in place with the new token's KV.
+
+    The gathered context is a dense [L, B, S_blocks, Hkv, D] view whose
+    first ``length`` positions are valid — position ``length`` itself is the
+    partial block's next empty slot, which is exactly where
+    :func:`_forward_cached` writes the new token. The dense forward path is
+    therefore reused verbatim (one compiled step per block-count bucket),
+    so the elastic path inherits every dense-path improvement by
+    construction.
+    """
+    k_ctx, v_ctx, length = ekv.kv()
+    view = KVCache(k_ctx, v_ctx, jnp.asarray(length, jnp.int32))
+    logits, view = _forward_cached(params, token[:, None], view, cfg)
+    sl = (slice(None), slice(None), slice(length, length + 1))
+    ekv.append_tokens(view.k[sl], view.v[sl])
+    return logits[:, 0]
+
+
 def generate(
     params,
     prompt: jax.Array,

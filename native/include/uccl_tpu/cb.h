@@ -1,0 +1,58 @@
+// Fixed-capacity circular buffer (single-owner bookkeeping).
+//
+// TPU-native equivalent of the reference's include/util/cb.h: a plain
+// ring of slots for tracking in-flight work (chunks awaiting acks, recent
+// samples) inside one thread — no atomics, unlike ring.h's inter-thread
+// SPSC/MPSC queues. Capacity is rounded up to a power of two so indexing
+// is a mask.
+
+#pragma once
+
+#include <cstddef>
+#include <utility>
+#include <vector>
+
+namespace uccl_tpu {
+
+template <typename T>
+class CircularBuffer {
+ public:
+  explicit CircularBuffer(size_t capacity) {
+    size_t cap = 1;
+    while (cap < capacity) cap <<= 1;
+    buf_.resize(cap);
+    mask_ = cap - 1;
+  }
+
+  size_t capacity() const { return buf_.size(); }
+  size_t size() const { return head_ - tail_; }
+  bool empty() const { return head_ == tail_; }
+  bool full() const { return size() == buf_.size(); }
+
+  // false when full (caller decides: drop, grow elsewhere, or pop first).
+  bool push(T v) {
+    if (full()) return false;
+    buf_[head_++ & mask_] = std::move(v);
+    return true;
+  }
+
+  // false when empty.
+  bool pop(T* out) {
+    if (empty()) return false;
+    *out = std::move(buf_[tail_++ & mask_]);
+    return true;
+  }
+
+  // Oldest element (undefined when empty — check first).
+  T& front() { return buf_[tail_ & mask_]; }
+  // i-th oldest, 0 <= i < size().
+  T& at(size_t i) { return buf_[(tail_ + i) & mask_]; }
+
+ private:
+  std::vector<T> buf_;
+  size_t mask_ = 0;
+  size_t head_ = 0;
+  size_t tail_ = 0;
+};
+
+}  // namespace uccl_tpu

@@ -1,0 +1,98 @@
+// Hashed timing wheel for paced / deadline-scheduled work.
+//
+// TPU-native equivalent of the reference's Carousel pacing wheel
+// (collective/rdma/timing_wheel.h: slotted wheel that holds per-chunk
+// transmit times so the engine loop injects traffic at the CC-prescribed
+// rate) and of its RTO bookkeeping. The DCN engine's aggregate egress cap
+// uses a token bucket (engine.cc pace()); this wheel is the finer-grained
+// facility for per-item schedules — CC probe timers, retransmit deadlines,
+// heal backoff — owned by one thread, no locks.
+//
+// Design: H slots of G microseconds each; an item due at time T lands in
+// slot (T / G) % H. advance(now) sweeps slots from the last sweep position
+// through `now`, popping items whose due time has truly arrived (items
+// further than one horizon out stay parked in their slot and are skipped
+// until their lap comes — the classic hashed-wheel re-lap rule).
+
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+namespace uccl_tpu {
+
+template <typename T>
+class TimingWheel {
+ public:
+  // granularity_us: slot width; horizon_slots: wheel size (one lap covers
+  // granularity_us * horizon_slots microseconds).
+  explicit TimingWheel(uint64_t granularity_us = 64,
+                       size_t horizon_slots = 1024)
+      : gran_(granularity_us ? granularity_us : 1),
+        slots_(horizon_slots ? horizon_slots : 1),
+        cursor_(0),
+        size_(0) {}
+
+  // Schedule `item` to fire at absolute time `due_us`. Items due in the
+  // past (relative to the last advance) fire on the next advance(). Ticks
+  // round UP: an item never fires before its due time, at most one slot
+  // (granularity_us) late — the right discipline for pacing (early
+  // injection defeats the rate cap).
+  void schedule(uint64_t due_us, T item) {
+    uint64_t tick = (due_us + gran_ - 1) / gran_;
+    if (tick < cursor_) tick = cursor_;  // past-due: next sweep's slot
+    slots_[tick % slots_.size()].push_back(Entry{tick, std::move(item)});
+    ++size_;
+  }
+
+  // Pop every item due at or before `now_us` into `out` (appended in slot
+  // order; within a slot, schedule order). Returns the number popped.
+  // Cost is bounded by one lap per call regardless of how long the wheel
+  // sat idle: the pop test compares against `now`, so a single full lap
+  // releases everything due and the cursor can jump straight to now.
+  size_t advance(uint64_t now_us, std::vector<T>* out) {
+    uint64_t now_tick = now_us / gran_;
+    if (now_tick < cursor_) return 0;
+    if (size_ == 0) {  // idle fast path: nothing to sweep, just catch up
+      cursor_ = now_tick;
+      return 0;
+    }
+    size_t popped = 0;
+    uint64_t end = now_tick;
+    if (end - cursor_ >= slots_.size()) {
+      end = cursor_ + slots_.size() - 1;  // one full lap visits every slot
+    }
+    for (uint64_t t = cursor_; t <= end; ++t) {
+      auto& slot = slots_[t % slots_.size()];
+      size_t keep = 0;
+      for (size_t i = 0; i < slot.size(); ++i) {
+        if (slot[i].tick <= now_tick) {
+          out->push_back(std::move(slot[i].item));
+          ++popped;
+          --size_;
+        } else {
+          if (keep != i) slot[keep] = std::move(slot[i]);
+          ++keep;  // parked for a later lap, order preserved
+        }
+      }
+      slot.resize(keep);
+    }
+    cursor_ = now_tick;
+    return popped;
+  }
+
+  size_t size() const { return size_; }
+  bool empty() const { return size_ == 0; }
+
+ private:
+  struct Entry {
+    uint64_t tick;
+    T item;
+  };
+  uint64_t gran_;
+  std::vector<std::vector<Entry>> slots_;
+  uint64_t cursor_;  // tick of the last advance (next sweep starts here)
+  size_t size_;
+};
+
+}  // namespace uccl_tpu

@@ -12,9 +12,11 @@
 #include <thread>
 #include <vector>
 
+#include "uccl_tpu/cb.h"
 #include "uccl_tpu/lrpc.h"
 #include "uccl_tpu/pool.h"
 #include "uccl_tpu/ring.h"
+#include "uccl_tpu/timing_wheel.h"
 
 #define CHECK(cond)                                                      \
   do {                                                                   \
@@ -152,12 +154,83 @@ static void test_pool_threaded() {
   std::puts("pool_threaded ok");
 }
 
+static void test_circular_buffer() {
+  CircularBuffer<int> cb(6);  // rounds to 8
+  CHECK(cb.capacity() == 8);
+  CHECK(cb.empty() && !cb.full());
+  for (int i = 0; i < 8; ++i) CHECK(cb.push(i));
+  CHECK(cb.full());
+  CHECK(!cb.push(99));  // full rejected
+  CHECK(cb.front() == 0);
+  CHECK(cb.at(3) == 3);
+  int v = -1;
+  for (int i = 0; i < 5; ++i) {
+    CHECK(cb.pop(&v) && v == i);  // FIFO
+  }
+  // wrap: indices cross the mask boundary and stay FIFO
+  for (int i = 100; i < 105; ++i) CHECK(cb.push(i));
+  CHECK(cb.size() == 8);
+  for (int want : {5, 6, 7, 100, 101, 102, 103, 104}) {
+    CHECK(cb.pop(&v) && v == want);
+  }
+  CHECK(cb.empty() && !cb.pop(&v));
+  std::puts("circular_buffer ok");
+}
+
+static void test_timing_wheel() {
+  TimingWheel<int> w(/*granularity_us=*/10, /*horizon_slots=*/16);
+  std::vector<int> fired;
+  // nothing scheduled: advance is a no-op
+  CHECK(w.advance(1000, &fired) == 0);
+
+  w.schedule(1000, 1);
+  w.schedule(1050, 2);
+  w.schedule(1049, 3);  // rounds up into the same slot as 2 (tick 105)
+  w.schedule(990, 4);   // already past the cursor: next advance
+  CHECK(w.size() == 4);
+
+  CHECK(w.advance(1000, &fired) == 2);  // 1 and 4 due
+  CHECK(fired.size() == 2 && fired[0] == 1 && fired[1] == 4);
+
+  fired.clear();
+  CHECK(w.advance(1044, &fired) == 0);  // never-early: 1049/1050 not due
+  CHECK(w.advance(1050, &fired) == 2);
+  CHECK(fired[0] == 2 && fired[1] == 3);  // same slot: schedule order
+
+  // beyond-horizon item parks and fires on its lap, not a whole lap early
+  fired.clear();
+  uint64_t far = 1050 + 10 * 16 * 3;  // 3 laps out, slot-aligned
+  w.schedule(far, 7);
+  CHECK(w.advance(far - 200, &fired) == 0);  // mid-lap sweep skips it
+  CHECK(w.advance(far, &fired) == 1 && fired[0] == 7);
+  CHECK(w.empty());
+
+  // far-first-then-near: the near deadline must not be dragged to the far
+  // item's slot (cursor tracks advance time, not the first schedule)
+  fired.clear();
+  w.schedule(far + 100000, 8);  // 100ms out
+  w.schedule(far + 20, 9);      // 20us out
+  CHECK(w.advance(far + 20, &fired) == 1 && fired[0] == 9);
+
+  // long idle gap then a burst: one advance catches everything due, and
+  // the cursor jump keeps later advances cheap
+  fired.clear();
+  uint64_t late = far + 100000;
+  CHECK(w.advance(late, &fired) == 1 && fired[0] == 8);
+  w.schedule(late + 5, 10);
+  CHECK(w.advance(late + 10 * 16 * 50, &fired) == 1);  // 50-lap gap
+  CHECK(fired[1] == 10 && w.empty());
+  std::puts("timing_wheel ok");
+}
+
 int main() {
   test_spsc_threaded();
   test_mpsc_threaded();
   test_lrpc_threaded();
   test_lrpc_full_and_payload();
   test_pool_threaded();
+  test_circular_buffer();
+  test_timing_wheel();
   std::puts("ALL SUBSTRATE TESTS PASSED");
   return 0;
 }

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Wheel build entry point — the analog of the reference's build.sh
+# (containerized per-target wheel builds; SURVEY.md §2.5). One target here:
+#
+#   scripts/build.sh          native wheel build into dist/ (needs g++, jax)
+#   scripts/build.sh docker   hermetic build inside docker/Dockerfile.tpu
+#   scripts/build.sh test     build + run the full test ladder first
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+mode="${1:-wheel}"
+case "$mode" in
+  wheel)
+    make -C native
+    python -m build --wheel --no-isolation
+    ls -l dist/*.whl
+    ;;
+  test)
+    make -C native test
+    python -m pytest tests/ -q
+    python -m build --wheel --no-isolation
+    ls -l dist/*.whl
+    ;;
+  docker)
+    docker build -f docker/Dockerfile.tpu -t uccl-tpu .
+    mkdir -p dist
+    docker run --rm -v "$PWD/dist:/out" uccl-tpu sh -c 'cp /build/dist/*.whl /out/'
+    ls -l dist/*.whl
+    ;;
+  *)
+    echo "usage: scripts/build.sh [wheel|test|docker]" >&2
+    exit 2
+    ;;
+esac

@@ -1,0 +1,71 @@
+// Intrusive doubly-linked list (kernel-style).
+//
+// TPU-native equivalent of the reference's include/util/list.h (the
+// list_head idiom its EQDS active/idle pacer queues are built on): a node
+// embeds the link, so membership costs no allocation and unlink is O(1)
+// from the node itself. Single-owner (no locks), like cb.h.
+
+#pragma once
+
+#include <cstddef>
+
+namespace uccl_tpu {
+
+struct ListHead {
+  ListHead* prev;
+  ListHead* next;
+
+  ListHead() { reset(); }
+  // A linked node's neighbors point AT it — copying or moving one would
+  // leave them pointing at the original while the copy claims membership.
+  ListHead(const ListHead&) = delete;
+  ListHead& operator=(const ListHead&) = delete;
+
+  void reset() { prev = next = this; }
+  bool empty() const { return next == this; }
+  bool linked() const { return next != this; }
+
+  // Insert `n` at the tail (before this sentinel). A node already on a
+  // list is detached first — re-homing must never cross-link two lists.
+  void push_back(ListHead* n) {
+    n->unlink();
+    n->prev = prev;
+    n->next = this;
+    prev->next = n;
+    prev = n;
+  }
+
+  // Insert `n` at the head (after this sentinel).
+  void push_front(ListHead* n) {
+    n->unlink();
+    n->prev = this;
+    n->next = next;
+    next->prev = n;
+    next = n;
+  }
+
+  // Unlink this node from whatever list holds it; safe on unlinked nodes.
+  void unlink() {
+    prev->next = next;
+    next->prev = prev;
+    reset();
+  }
+
+  // nullptr when empty — callers can't accidentally rebase the sentinel
+  // into a garbage object pointer via UCCL_LIST_ENTRY.
+  ListHead* front() const {
+    return next == this ? nullptr : next;
+  }
+  ListHead* back() const {
+    return prev == this ? nullptr : prev;
+  }
+};
+
+// Recover the owning object from an embedded ListHead — the container_of
+// idiom, via offsetof (fully defined for standard-layout owners, which
+// every flow/queue bookkeeping struct here is; conditionally-supported and
+// accepted by GCC/Clang beyond that).
+#define UCCL_LIST_ENTRY(node, T, member) \
+  (reinterpret_cast<T*>(reinterpret_cast<char*>(node) - offsetof(T, member)))
+
+}  // namespace uccl_tpu

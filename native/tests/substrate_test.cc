@@ -13,6 +13,7 @@
 #include <vector>
 
 #include "uccl_tpu/cb.h"
+#include "uccl_tpu/list.h"
 #include "uccl_tpu/lrpc.h"
 #include "uccl_tpu/pool.h"
 #include "uccl_tpu/ring.h"
@@ -223,6 +224,38 @@ static void test_timing_wheel() {
   std::puts("timing_wheel ok");
 }
 
+struct Flow {
+  int id = 0;
+  ListHead link;
+};
+
+static void test_intrusive_list() {
+  ListHead active;
+  ListHead idle;
+  Flow flows[4];
+  for (int i = 0; i < 4; ++i) flows[i].id = i;
+  CHECK(active.empty());
+  CHECK(active.front() == nullptr && active.back() == nullptr);
+  active.push_back(&flows[0].link);
+  active.push_back(&flows[1].link);
+  active.push_front(&flows[2].link);  // order: 2, 0, 1
+  CHECK(UCCL_LIST_ENTRY(active.front(), Flow, link)->id == 2);
+  CHECK(UCCL_LIST_ENTRY(active.back(), Flow, link)->id == 1);
+  flows[0].link.unlink();  // O(1) removal from the middle
+  CHECK(UCCL_LIST_ENTRY(flows[2].link.next, Flow, link)->id == 1);
+  CHECK(!flows[0].link.linked());
+  flows[0].link.unlink();  // unlink twice is safe
+  // re-homing a linked node detaches it from its old list first
+  idle.push_back(&flows[1].link);
+  CHECK(UCCL_LIST_ENTRY(idle.front(), Flow, link)->id == 1);
+  CHECK(UCCL_LIST_ENTRY(active.front(), Flow, link)->id == 2);
+  CHECK(active.front() == active.back());  // only flow 2 remains
+  flows[2].link.unlink();
+  flows[1].link.unlink();
+  CHECK(active.empty() && idle.empty());
+  std::puts("intrusive_list ok");
+}
+
 int main() {
   test_spsc_threaded();
   test_mpsc_threaded();
@@ -231,6 +264,7 @@ int main() {
   test_pool_threaded();
   test_circular_buffer();
   test_timing_wheel();
+  test_intrusive_list();
   std::puts("ALL SUBSTRATE TESTS PASSED");
   return 0;
 }

@@ -36,7 +36,7 @@ from uccl_tpu.ep import ops as ep_ops
 from uccl_tpu.models.layers import rms_norm, rope, tp_cross_entropy
 from uccl_tpu.ops.attention import attention_reference, ring_attention, ulysses_attention
 from uccl_tpu.parallel.mesh import AXIS
-from uccl_tpu.parallel.pipeline import gpipe_spmd
+from uccl_tpu.parallel.pipeline import gpipe_spmd, pipeline_train
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +56,7 @@ class FlagshipConfig:
     aux_loss_weight: float = 0.01
     z_loss_weight: float = 1e-3
     n_microbatches: int = 1
+    pp_schedule: str = "gpipe"  # "gpipe" (autodiff+remat) | "1f1b" (manual)
     seq_mode: str = "ring"  # "ring" | "ulysses"
     attn_impl: str = "auto"  # "auto" | "flash" | "xla": kernel when cp == 1
     moe_impl: str = "sort"  # "sort" (ragged fast path) | "dense" (mask oracle)
@@ -163,7 +164,13 @@ def _attention(x, lp, cfg: FlagshipConfig):
                 f"usable block size (largest power-of-two divisor {blk} < 8)"
             )
         else:
-            attn = ring_attention(q, kk, v, AXIS.CP, causal=True)
+            # Direct single-shard attention, NOT ring_attention at n=1: the
+            # math is identical, but the ring's self-ppermute would poison
+            # manual-schedule vjps (ppermute's transpose silently drops
+            # cotangents under check_vma=False when the vjp runs inside a
+            # non-uniformly-predicated cond — the sharp edge check_vma=True
+            # exists to catch).
+            attn = attention_reference(q, kk, v, causal=True)
     elif cfg.seq_mode == "ulysses":
         # Flash feasibility is ulysses's own call: it attends over the
         # all-to-all-gathered full sequence, not the local shard.
@@ -253,6 +260,137 @@ def _per_shard_loss(params, tokens, targets, cfg: FlagshipConfig):
 
 
 # ---------------------------------------------------------------------------
+# Manual-schedule training path (pp_schedule="1f1b")
+#
+# The gpipe path above differentiates THROUGH the pipeline scan (autodiff +
+# remat: simple, but residual liveness grows with M). This path runs the
+# hand-written 1F1B schedule (parallel/pipeline.py pipeline_train): bounded
+# activation liveness, explicit boundary gradients — the embedding backward
+# runs through the returned input cotangents, the loss head through its own
+# gradient outputs, and the MoE aux/z losses ride the aux channel.
+
+
+def _grad_sync_specs(cfg: FlagshipConfig):
+    """Per-leaf mesh axes a manual gradient must be psum'd over: every axis
+    the parameter is REPLICATED on — except pp, whose reduction
+    pipeline_train already performed (loss params / input cotangents) or
+    which shards the leaf (stage params)."""
+    def axes_of(spec):
+        used = set()
+        for part in spec:
+            if part is None:
+                continue
+            if isinstance(part, (tuple, list)):
+                used.update(part)
+            else:
+                used.add(part)
+        return tuple(
+            a for a in AXIS.ALL if a not in used and a != AXIS.PP
+        )
+
+    return jax.tree.map(axes_of, param_specs(cfg))
+
+
+def _per_shard_manual_grads(params, tokens, targets, cfg: FlagshipConfig):
+    """Per-shard (total, ce, grads) on the manual 1F1B schedule. Gradient
+    semantics match autodiff-of-pmean(loss over dp×cp): per-member partials,
+    psum over each leaf's replicated axes, divided by the EP world."""
+    if lax.axis_size(AXIS.CP) != 1:
+        # Ring/Ulysses CP rotate KV via lax.ppermute inside the stage, and
+        # ppermute's TRANSPOSE inside the manual schedule's per-slot cond
+        # silently zeroes cotangents under check_vma=False (psum and
+        # all_to_all transpose correctly; ppermute does not — verified by
+        # bisection). Until the attention stack is vma-annotated end to end,
+        # manual schedules require the cp axis to be trivial.
+        raise NotImplementedError(
+            "pp_schedule='1f1b' requires cp=1: context-parallel attention's "
+            "ppermute does not transpose correctly inside the manual "
+            "schedule (use pp_schedule='gpipe' with cp>1)"
+        )
+    b_loc, s_loc = tokens.shape
+    m = cfg.n_microbatches
+    if b_loc % m:
+        raise ValueError(f"local batch {b_loc} not divisible by {m} microbatches")
+
+    def embed_fn(emb):
+        return _embed(tokens, emb, cfg).astype(cfg.dtype)
+
+    x, embed_vjp = jax.vjp(embed_fn, params["embed"])
+    xmb = x.reshape(m, b_loc // m, s_loc, cfg.dim)
+    tmb = targets.reshape(m, b_loc // m, s_loc)
+
+    layer_ckpt = jax.checkpoint(partial(_layer, cfg=cfg))
+
+    def stage_fn(blocks, xm):
+        def body(carry, lp):
+            y, aux = layer_ckpt(carry, lp)
+            return y, aux
+
+        y, auxs = lax.scan(body, xm, blocks)
+        return y, jnp.sum(auxs)
+
+    n_tok = b_loc * s_loc  # per-shard tokens: summed mb losses == local mean
+
+    def loss_head(lp, y, tgt):
+        xln = rms_norm(y, lp["final_norm"], cfg.norm_eps)
+        logits = xln.astype(jnp.float32) @ lp["head"]
+        v_loc = logits.shape[-1]
+        off = lax.axis_index(AXIS.TP) * v_loc
+        per_token = tp_cross_entropy(
+            logits.reshape(-1, v_loc), tgt.reshape(-1), off, AXIS.TP
+        )
+        return jnp.sum(per_token) / n_tok
+
+    loss_params = {
+        "final_norm": params["final_norm"], "head": params["head"]
+    }
+    total, ce, dblocks, dlp, dxmb = pipeline_train(
+        stage_fn, loss_head, params["blocks"], loss_params, xmb, tmb,
+        AXIS.PP, aux_weight=1.0 / (cfg.n_layers * m),
+    )
+    (d_embed,) = embed_vjp(dxmb.reshape(b_loc, s_loc, cfg.dim).astype(x.dtype))
+
+    grads = {
+        "embed": d_embed,
+        "blocks": dblocks,
+        "final_norm": dlp["final_norm"],
+        "head": dlp["head"],
+    }
+    n_ep = lax.axis_size(AXIS.EP)
+    # Seed redundancy: the loss value is replicated across tp, and seeding
+    # every member's vjp with 1 differentiates n_tp copies of it (the psum
+    # transposes under check_vma=False mix the redundant seeds) — every
+    # partial comes out exactly n_tp too large, uniformly. One global
+    # divide restores d(L)/dθ; the autodiff path never sees this because
+    # shard_map's own transpose accounts for replicated outputs.
+    n_tp = lax.axis_size(AXIS.TP)
+
+    def sync(g, axes):
+        if axes:
+            g = lax.psum(g, tuple(axes))
+        return g / (n_ep * n_tp)
+
+    grads = jax.tree.map(sync, grads, _grad_sync_specs(cfg))
+    return lax.pmean(total, AXIS.EP), lax.pmean(ce, AXIS.EP), grads
+
+
+def manual_loss_and_grads(params, tokens, targets, cfg: FlagshipConfig, mesh: Mesh):
+    """Global (total, ce, grads) on the manual 1F1B schedule — the
+    grads-producing counterpart of value_and_grad over :func:`loss_fn`."""
+
+    def f(p, t, y):
+        return _per_shard_manual_grads(p, t, y, cfg)
+
+    return shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(param_specs(cfg), _data_spec(), _data_spec()),
+        out_specs=(P(), P(), param_specs(cfg)),
+        check_vma=False,
+    )(params, tokens, targets)
+
+
+# ---------------------------------------------------------------------------
 # Host API
 
 
@@ -303,9 +441,19 @@ def make_train_step(cfg: FlagshipConfig, mesh: Mesh, learning_rate: float = 3e-4
         return total, ce
 
     def train_step(params, opt_state, tokens, targets):
-        (total, ce), grads = jax.value_and_grad(total_loss, has_aux=True)(
-            params, tokens, targets
-        )
+        if cfg.pp_schedule == "1f1b":
+            total, ce, grads = manual_loss_and_grads(
+                params, tokens, targets, cfg, mesh
+            )
+        elif cfg.pp_schedule == "gpipe":
+            (total, ce), grads = jax.value_and_grad(total_loss, has_aux=True)(
+                params, tokens, targets
+            )
+        else:
+            raise ValueError(
+                f"unknown pp_schedule {cfg.pp_schedule!r}: expected 'gpipe' "
+                "or '1f1b'"
+            )
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, {"loss": total, "ce": ce}

@@ -181,97 +181,22 @@ def one_f_one_b(
     SPMD bound: a min(M,P)-slot stash + a min(M,P+1)-slot inbound queue of
     single microbatches) — the 1F1B liveness profile, vs autodiff-GPipe
     whose residual liveness grows with M.
+
+    Thin adapter over :func:`pipeline_train` (no aux channel, no loss
+    parameters, input cotangents discarded).
     """
-    p = lax.axis_size(axis)
-    s = lax.axis_index(axis)
+    def stage2(p_, x):
+        return stage_fn(p_, x), jnp.zeros((), jnp.float32)
+
+    def loss2(_lp, y, _tgt):
+        return loss_fn(y)
+
     m = xmb.shape[0]
-    slots = min(m, p)  # stash ring size (>= the per-stage in-flight cap)
-    qslots = min(m, p + 1)  # inbound activation queue (lag bound is p)
-    np_do_f, np_f_mb, np_do_b, np_b_mb = _simulate_1f1b(m, int(p))
-    # Arrival bookkeeping (static): an activation emitted by stage s-1 at
-    # slot t-1 lands in stage s's wire register at slot t and is banked into
-    # the inbound queue — a stage may legally sit on several unconsumed
-    # inputs while it prioritizes backwards, so a single register would drop
-    # them.
-    n_slots = np_do_f.shape[0]
-    np_arr = np.zeros_like(np_do_f)
-    np_arr[1:, 1:] = np_do_f[:-1, :-1]
-    np_arr_idx = np.zeros_like(np_do_f)
-    np_arr_idx[1:] = np.cumsum(np_arr, axis=0)[:-1]
-    do_f_t, f_mb_t = jnp.asarray(np_do_f), jnp.asarray(np_f_mb)
-    do_b_t, b_mb_t = jnp.asarray(np_do_b), jnp.asarray(np_b_mb)
-    arr_t, arr_idx_t = jnp.asarray(np_arr), jnp.asarray(np_arr_idx)
-    fwd_perm = ppermute_pairs(p, 1)
-    bwd_perm = ppermute_pairs(p, -1)
-
-    mb_shape = xmb.shape[1:]
-    zeros_mb = jnp.zeros(mb_shape, xmb.dtype)
-
-    def step(carry, t):
-        stash, queue, fwd_in, bwd_in, dparams, loss_acc = carry
-        do_f = do_f_t[t, s]
-        f_mb = f_mb_t[t, s]
-        do_b = do_b_t[t, s]
-        b_mb = b_mb_t[t, s]
-
-        # ---- bank the wire register into the inbound queue on arrival
-        arrived = arr_t[t, s]
-        bank_at = arr_idx_t[t, s] % qslots
-        cur = lax.dynamic_index_in_dim(queue, bank_at, axis=0, keepdims=False)
-        banked = jnp.where(arrived == 1, fwd_in, cur)
-        queue = lax.dynamic_update_index_in_dim(queue, banked, bank_at, axis=0)
-
-        # ---- forward slot: consume input, stash it, emit activation
-        def fwd(_):
-            x = jnp.where(
-                s == 0,
-                lax.dynamic_index_in_dim(xmb, f_mb, axis=0, keepdims=False),
-                lax.dynamic_index_in_dim(
-                    queue, f_mb % qslots, axis=0, keepdims=False
-                ),
-            )
-            y = stage_fn(params, x)
-            st = lax.dynamic_update_index_in_dim(stash, x, f_mb % slots, axis=0)
-            return y, st
-
-        y_out, stash = lax.cond(
-            do_f == 1, fwd, lambda _: (zeros_mb, stash), None
-        )
-
-        # ---- backward slot: recompute from the stashed input, push grads
-        def bwd(_):
-            x = lax.dynamic_index_in_dim(stash, b_mb % slots, axis=0,
-                                         keepdims=False)
-            y, vjp = jax.vjp(stage_fn, params, x)
-            # last stage sources its cotangent from the loss; others from
-            # the cotangent that arrived over the wire
-            lv, gl = jax.value_and_grad(loss_fn)(y)
-            gy = jnp.where(s == p - 1, gl, bwd_in.astype(y.dtype))
-            dp, dx = vjp(gy)
-            lval = jnp.where(s == p - 1, lv, 0.0).astype(jnp.float32)
-            return dp, dx, lval
-
-        zero_dp = jax.tree.map(jnp.zeros_like, params)
-        dp, dx_out, lval = lax.cond(
-            do_b == 1, bwd, lambda _: (zero_dp, zeros_mb, jnp.float32(0.0)),
-            None,
-        )
-        dparams = jax.tree.map(jnp.add, dparams, dp)
-        loss_acc = loss_acc + lval
-
-        fwd_next = lax.ppermute(y_out, axis, fwd_perm)
-        bwd_next = lax.ppermute(dx_out, axis, bwd_perm)
-        return (stash, queue, fwd_next, bwd_next, dparams, loss_acc), None
-
-    stash0 = jnp.zeros((slots,) + mb_shape, xmb.dtype)
-    queue0 = jnp.zeros((qslots,) + mb_shape, xmb.dtype)
-    d0 = jax.tree.map(jnp.zeros_like, params)
-    (stash, _, _, _, dparams, loss_acc), _ = lax.scan(
-        step,
-        (stash0, queue0, zeros_mb, zeros_mb, d0, jnp.float32(0.0)),
-        jnp.arange(n_slots),
+    total, _loss, dparams, _dlp, _dxmb = pipeline_train(
+        stage2, loss2, params, {}, xmb, jnp.zeros((m, 1), jnp.float32),
+        axis, aux_weight=0.0,
     )
-    return lax.psum(loss_acc, axis), dparams
+    return total, dparams
 
 
 # ---------------------------------------------------------------------------
@@ -629,3 +554,176 @@ def interleaved_1f1b(
         jnp.arange(T),
     )
     return lax.psum(loss_acc, axis), dparams
+
+
+# ---------------------------------------------------------------------------
+# Full-model manual-schedule training: the 1F1B above trains the pipeline
+# BODY; a real model also has parameters outside it — an embedding feeding
+# stage 0 and a loss head consuming the last stage — plus per-stage scalar
+# side losses (MoE aux/z). pipeline_train closes those three gaps so a
+# whole transformer can run on the manual schedule: it returns the input
+# cotangents d(xmb) (backprop them through the embedding outside), the
+# loss-side parameter grads, and threads an aux channel whose gradient
+# flows into the stage parameters via the vjp cotangent.
+
+
+def pipeline_train(
+    stage_fn: Callable[..., Tuple[jax.Array, jax.Array]],
+    loss_fn: Callable[..., jax.Array],
+    params,
+    loss_params,
+    xmb: jax.Array,
+    ymb,
+    axis: str = "pp",
+    aux_weight: float = 1.0,
+):
+    """Manual 1F1B training step with boundary gradients (in shard_map).
+
+    Args:
+      stage_fn: ``(stage_params, x) -> (y, aux)`` — one microbatch through
+        this member's stage; ``aux`` is a scalar side loss (0 if unused).
+      loss_fn: ``(loss_params, y, target) -> scalar`` applied to the LAST
+        stage's outputs, summed over microbatches; ``target`` is that
+        microbatch's slice of ``ymb``.
+      params: THIS stage's parameter pytree.
+      loss_params: the loss-side parameters (final norm, unembedding, ...);
+        passed on every member (uniform SPMD), differentiated only where
+        the last stage computes the loss.
+      xmb: ``[M, B_mb, ...]`` microbatches (consumed by stage 0).
+      ymb: per-microbatch loss targets, a pytree with leading dim M
+        (labels, target logits, masks, ...), replicated across members.
+      aux_weight: weight of the summed aux losses in the total.
+
+    Returns ``(total, loss, dparams, d_loss_params, d_xmb)``:
+      total — loss + aux_weight * sum(aux), replicated over pp;
+      loss — the loss_fn sum alone (no aux), replicated over pp;
+      dparams — this stage's parameter cotangents (aux grads included);
+      d_loss_params — cotangents of loss_params, replicated over pp;
+      d_xmb — ``[M, B_mb, ...]`` cotangents of the stage-0 inputs,
+      replicated over pp (backprop them through the embedding).
+    """
+    p = lax.axis_size(axis)
+    s = lax.axis_index(axis)
+    m = xmb.shape[0]
+    slots = min(m, p)
+    qslots = min(m, p + 1)
+    np_do_f, np_f_mb, np_do_b, np_b_mb = _simulate_1f1b(m, int(p))
+    n_slots = np_do_f.shape[0]
+    np_arr = np.zeros_like(np_do_f)
+    np_arr[1:, 1:] = np_do_f[:-1, :-1]
+    np_arr_idx = np.zeros_like(np_do_f)
+    np_arr_idx[1:] = np.cumsum(np_arr, axis=0)[:-1]
+    do_f_t, f_mb_t = jnp.asarray(np_do_f), jnp.asarray(np_f_mb)
+    do_b_t, b_mb_t = jnp.asarray(np_do_b), jnp.asarray(np_b_mb)
+    arr_t, arr_idx_t = jnp.asarray(np_arr), jnp.asarray(np_arr_idx)
+    fwd_perm = ppermute_pairs(p, 1)
+    bwd_perm = ppermute_pairs(p, -1)
+
+    mb_shape = xmb.shape[1:]
+    zeros_mb = jnp.zeros(mb_shape, xmb.dtype)
+    zero_lp = jax.tree.map(jnp.zeros_like, loss_params)
+    is_last = s == p - 1
+    is_first = s == 0
+
+    def step(carry, t):
+        (stash, queue, fwd_in, bwd_in, dparams, dlp, dx_buf, loss_acc,
+         aux_acc) = carry
+        do_f = do_f_t[t, s]
+        f_mb = f_mb_t[t, s]
+        do_b = do_b_t[t, s]
+        b_mb = b_mb_t[t, s]
+
+        arrived = arr_t[t, s]
+        bank_at = arr_idx_t[t, s] % qslots
+        cur = lax.dynamic_index_in_dim(queue, bank_at, axis=0, keepdims=False)
+        banked = jnp.where(arrived == 1, fwd_in, cur)
+        queue = lax.dynamic_update_index_in_dim(queue, banked, bank_at, axis=0)
+
+        def fwd(_):
+            x = jnp.where(
+                is_first,
+                lax.dynamic_index_in_dim(xmb, f_mb, axis=0, keepdims=False),
+                lax.dynamic_index_in_dim(
+                    queue, f_mb % qslots, axis=0, keepdims=False
+                ),
+            )
+            y, aux = stage_fn(params, x)
+            st = lax.dynamic_update_index_in_dim(stash, x, f_mb % slots,
+                                                 axis=0)
+            return y, st, aux.astype(jnp.float32)
+
+        y_out, stash, aux_step = lax.cond(
+            do_f == 1, fwd,
+            lambda _: (zeros_mb, stash, jnp.zeros((), jnp.float32)),
+            None,
+        )
+        aux_acc = aux_acc + aux_step
+
+        def bwd(_):
+            x = lax.dynamic_index_in_dim(stash, b_mb % slots, axis=0,
+                                         keepdims=False)
+            (y, _aux), vjp = jax.vjp(stage_fn, params, x)
+            tgt = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, b_mb, axis=0,
+                                                   keepdims=False),
+                ymb,
+            )
+
+            # Loss head only where it's real: (P-1)/P of the schedule's
+            # backward slots are non-final stages, and the head (unembedding
+            # matmul + CE in a transformer) is expensive. The predicate is
+            # uniform across every non-pp axis, so collectives inside
+            # loss_fn stay matched within their groups.
+            def loss_part(_):
+                lv_, (g_lp_, gy_) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1)
+                )(loss_params, y, tgt)
+                return lv_.astype(jnp.float32), g_lp_, gy_.astype(y.dtype)
+
+            def no_loss(_):
+                return jnp.zeros((), jnp.float32), zero_lp, jnp.zeros_like(y)
+
+            lval, g_lp, gy_loss = lax.cond(is_last, loss_part, no_loss, None)
+            gy = jnp.where(is_last, gy_loss, bwd_in.astype(y.dtype))
+            # aux cotangent: d(total)/d(aux) = aux_weight on every stage
+            dp, dx = vjp((gy, jnp.asarray(aux_weight, _aux.dtype)))
+            return dp, dx, g_lp, lval
+
+        zero_dp = jax.tree.map(jnp.zeros_like, params)
+        dp, dx_out, g_lp, lval = lax.cond(
+            do_b == 1,
+            bwd,
+            lambda _: (zero_dp, zeros_mb, zero_lp, jnp.float32(0.0)),
+            None,
+        )
+        dparams = jax.tree.map(jnp.add, dparams, dp)
+        dlp = jax.tree.map(jnp.add, dlp, g_lp)
+        loss_acc = loss_acc + lval
+        # stage 0's dx is the cotangent of xmb[b_mb] (zeros when no bwd ran)
+        mb_at = jnp.where(do_b == 1, b_mb, 0)
+        curx = lax.dynamic_index_in_dim(dx_buf, mb_at, axis=0, keepdims=False)
+        newx = jnp.where((do_b == 1) & is_first, dx_out, curx)
+        dx_buf = lax.dynamic_update_index_in_dim(dx_buf, newx, mb_at, axis=0)
+
+        fwd_next = lax.ppermute(y_out, axis, fwd_perm)
+        bwd_next = lax.ppermute(dx_out, axis, bwd_perm)
+        return (stash, queue, fwd_next, bwd_next, dparams, dlp, dx_buf,
+                loss_acc, aux_acc), None
+
+    stash0 = jnp.zeros((slots,) + mb_shape, xmb.dtype)
+    queue0 = jnp.zeros((qslots,) + mb_shape, xmb.dtype)
+    d0 = jax.tree.map(jnp.zeros_like, params)
+    dx0 = jnp.zeros_like(xmb)
+    (_, _, _, _, dparams, dlp, dx_buf, loss_acc, aux_acc), _ = lax.scan(
+        step,
+        (stash0, queue0, zeros_mb, zeros_mb, d0, zero_lp, dx0,
+         jnp.float32(0.0), jnp.float32(0.0)),
+        jnp.arange(n_slots),
+    )
+    loss = lax.psum(loss_acc, axis)
+    total = loss + aux_weight * lax.psum(aux_acc, axis)
+    d_loss_params = jax.tree.map(lambda g: lax.psum(g, axis), dlp)
+    d_xmb = lax.psum(
+        jnp.where(is_first, dx_buf, jnp.zeros_like(dx_buf)), axis
+    )
+    return total, loss, dparams, d_loss_params, d_xmb

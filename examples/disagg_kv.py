@@ -59,10 +59,13 @@ def decode_worker(port_q, result_q, new_tokens):
     import jax.numpy as jnp
     import numpy as np
 
-    from uccl_tpu.models.inference import KVCache, decode_step
+    from uccl_tpu.models.inference import (
+        KVCache, decode_step, decode_step_elastic,
+    )
     from uccl_tpu.p2p import Endpoint
 
     compress = os.environ.get("UCCL_TPU_EXAMPLE_COMPRESS") == "1"
+    elastic = os.environ.get("UCCL_TPU_EXAMPLE_ELASTIC") == "1"
     cfg, params = _make()
     ep = Endpoint()
     port_q.put(ep.port)
@@ -93,10 +96,28 @@ def decode_worker(port_q, result_q, new_tokens):
     cache = KVCache(jnp.asarray(k_arr), jnp.asarray(v_arr), jnp.int32(length))
     toks = [first_tok]
     tok = jnp.asarray(first_tok)
-    for _ in range(new_tokens - 1):
-        logits, cache = decode_step(params, tok, cache, cfg)
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        toks.append(np.asarray(tok))
+    if elastic:
+        # Re-home the received cache elastically: hot ring of 1 block in
+        # device memory, the rest of the prefix offloaded to pinned host
+        # memory — the decode worker's context is then bounded by host RAM,
+        # not HBM (lite-ep's host-window elasticity, TPU-style).
+        from uccl_tpu.ep import ElasticKVCache
+
+        ekv = ElasticKVCache.from_cache(cache, block_tokens=8, hot_blocks=1)
+        for _ in range(new_tokens - 1):
+            logits = decode_step_elastic(params, tok, ekv, cfg)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            toks.append(np.asarray(tok))
+        print(
+            f"decode: elastic cache held {ekv.cold_blocks} cold blocks in "
+            f"host memory, {ekv.device_committed_bytes() / 1e3:.1f} KB "
+            f"committed HBM, context {ekv.length}"
+        )
+    else:
+        for _ in range(new_tokens - 1):
+            logits, cache = decode_step(params, tok, cache, cfg)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            toks.append(np.asarray(tok))
     result_q.put(np.stack(toks, axis=1))
     ep.close()
 
@@ -109,11 +130,17 @@ def main():
         "--compress", action="store_true",
         help="ship the KV cache fp8-compressed (prints the wire ratio)",
     )
+    ap.add_argument(
+        "--elastic", action="store_true",
+        help="decode over an elastic KV cache (cold blocks in host memory)",
+    )
     args = ap.parse_args()
     if args.cpu:
         os.environ["UCCL_TPU_EXAMPLE_CPU"] = "1"  # inherited by the worker
     if args.compress:
         os.environ["UCCL_TPU_EXAMPLE_COMPRESS"] = "1"
+    if args.elastic:
+        os.environ["UCCL_TPU_EXAMPLE_ELASTIC"] = "1"
     _maybe_force_cpu()
 
     ctx = mp.get_context("spawn")

@@ -1,11 +1,15 @@
 """Data-parallel training with explicit Communicator gradient sync.
 
-The analog of the reference's examples/ddp_train.py (PyTorch DDP over the
-UCCL NCCL plugin): per-replica forward/backward, then an explicit allreduce of
-gradients through the collectives layer — the same contract DDP has with NCCL,
-expressed over the mesh. A small CNN classifier on synthetic data.
+The analog of the reference's examples/ddp_train.py (PyTorch DDP training
+ResNet-50 over the UCCL NCCL plugin): per-replica forward/backward, then an
+explicit allreduce of gradients through the collectives layer — the same
+contract DDP has with NCCL, expressed over the mesh. --model picks the
+workload: resnet50 is the reference's exact benchmark network
+(models/resnet.py, 25.6M params), resnet18 a lighter variant, cnn a tiny
+smoke-test net.
 
-Usage: python examples/ddp_train.py [--devices N] [--steps 20] [--algo xla|ring]
+Usage: python examples/ddp_train.py [--devices N] [--steps 20]
+       [--model cnn|resnet18|resnet50] [--algo xla|ring]
 """
 
 from __future__ import annotations
@@ -24,6 +28,11 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--algo", default="xla", choices=["xla", "ring"])
+    ap.add_argument(
+        "--model", default="cnn", choices=["cnn", "resnet18", "resnet50"]
+    )
+    ap.add_argument("--image-size", type=int, default=0,
+                    help="input resolution (default: 16 for cnn, 32 resnet18, 64 resnet50)")
     args = ap.parse_args()
 
     if args.devices:
@@ -48,36 +57,77 @@ def main():
     mesh = make_mesh(MeshConfig(dp=n))
     comm = Communicator(mesh, "dp")
 
-    # --- tiny CNN (NCHW) on synthetic 16x16 10-class data -----------------
-    def init(key):
-        k = jax.random.split(key, 4)
-        return {
-            "conv1": jax.random.normal(k[0], (16, 3, 3, 3)) * 0.1,
-            "conv2": jax.random.normal(k[1], (32, 16, 3, 3)) * 0.1,
-            "fc_w": jax.random.normal(k[2], (32 * 4 * 4, 10)) * 0.05,
-            "fc_b": jnp.zeros((10,)),
-        }
+    # --- workload: tiny CNN or the reference's ResNet benchmark network ----
+    if args.model == "cnn":
+        img = args.image_size or 16
+        # two SAME stride-2 convs: spatial dims ceil-divide per conv
+        fc_side = (((img + 1) // 2) + 1) // 2
 
-    def model(p, x):
-        x = jax.lax.conv_general_dilated(x, p["conv1"], (2, 2), "SAME")
-        x = jax.nn.relu(x)
-        x = jax.lax.conv_general_dilated(x, p["conv2"], (2, 2), "SAME")
-        x = jax.nn.relu(x)
-        return x.reshape(x.shape[0], -1) @ p["fc_w"] + p["fc_b"]
+        def init(key):
+            k = jax.random.split(key, 4)
+            return {
+                "conv1": jax.random.normal(k[0], (16, 3, 3, 3)) * 0.1,
+                "conv2": jax.random.normal(k[1], (32, 16, 3, 3)) * 0.1,
+                "fc_w": jax.random.normal(
+                    k[2], (32 * fc_side * fc_side, 10)
+                ) * 0.05,
+                "fc_b": jnp.zeros((10,)),
+            }
 
-    def loss_fn(p, x, y):
-        logits = model(p, x)
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        def model(p, x):  # NCHW
+            x = jax.lax.conv_general_dilated(x, p["conv1"], (2, 2), "SAME")
+            x = jax.nn.relu(x)
+            x = jax.lax.conv_general_dilated(x, p["conv2"], (2, 2), "SAME")
+            x = jax.nn.relu(x)
+            return x.reshape(x.shape[0], -1) @ p["fc_w"] + p["fc_b"]
+
+        def loss_fn(p, x, y):
+            logits = model(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        params = init(jax.random.PRNGKey(0))
+        state0 = None
+        data_shape = lambda b: (b, 3, img, img)  # noqa: E731
+    else:
+        from uccl_tpu.models import resnet
+
+        depth = 18 if args.model == "resnet18" else 50
+        img = args.image_size or (32 if depth == 18 else 64)
+        rcfg = resnet.ResNetConfig(depth=depth, num_classes=10)
+        params, state0 = resnet.init_params(jax.random.PRNGKey(0), rcfg)
+        print(
+            f"{args.model}: {resnet.num_params(params) / 1e6:.2f}M params, "
+            f"{img}x{img} inputs"
+        )
+
+        def loss_fn(p, x, y, s):
+            loss, new_s = resnet.loss_fn(p, s, x, y, rcfg)
+            return loss, new_s
+
+        data_shape = lambda b: (b, img, img, 3)  # noqa: E731 (NHWC)
 
     tx = optax.sgd(0.05, momentum=0.9)
-    params = init(jax.random.PRNGKey(0))
     opt = tx.init(params)
     w = comm.world
     # per-replica grads: each row of the leading dim is one replica's local
-    # gradient over its batch shard (the DDP contract)
-    replica_grads = jax.jit(
-        jax.vmap(jax.value_and_grad(loss_fn), in_axes=(None, 0, 0))
-    )
+    # gradient over its batch shard (the DDP contract). ResNet also carries
+    # per-replica BN statistics (torch DDP leaves BN local too).
+    if state0 is None:
+        replica_grads = jax.jit(
+            jax.vmap(jax.value_and_grad(loss_fn), in_axes=(None, 0, 0))
+        )
+    else:
+        state0 = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (w,) + a.shape), state0
+        )
+        replica_grads = jax.jit(
+            jax.vmap(
+                jax.value_and_grad(loss_fn, has_aux=True),
+                in_axes=(None, 0, 0, 0),
+            )
+        )
     apply_fn = jax.jit(
         lambda p, o, g: (lambda u, o2: (optax.apply_updates(p, u), o2))(
             *tx.update(g, o, p)
@@ -103,12 +153,17 @@ def main():
     b_local = max(1, args.batch // w)
     for step in range(args.steps):
         x = jnp.asarray(
-            rng.standard_normal((w, b_local, 3, 16, 16)), jnp.float32
+            rng.standard_normal((w,) + data_shape(b_local)), jnp.float32
         )
         y = jnp.asarray(
-            (np.asarray(x).mean(axis=(2, 3, 4)) > 0).astype(np.int32) * 5 % 10
+            (np.asarray(x).mean(axis=tuple(range(2, x.ndim))) > 0).astype(
+                np.int32
+            ) * 5 % 10
         )
-        losses, grads = replica_grads(params, x, y)
+        if state0 is None:
+            losses, grads = replica_grads(params, x, y)
+        else:
+            (losses, state0), grads = replica_grads(params, x, y, state0)
         loss = losses.mean()
         grads = allreduce_grads(grads)
         params, opt = apply_fn(params, opt, grads)

@@ -1,0 +1,245 @@
+"""ResNet family (v1.5 bottleneck) — the reference's DDP benchmark workload.
+
+The reference's data-parallel example trains torchvision ResNet-50 under DDP
+over its NCCL plugin (examples/ddp_train.py; experimental/misc/resnet_ddp*.py
+hand-rolled per-layer allreduce variants); the driver's baseline configs name
+"DDP ResNet-50" explicitly. This is the TPU-native counterpart: NHWC layout
+(the TPU conv sweet spot), ``lax.conv_general_dilated`` on the MXU,
+batch-norm with tracked running statistics carried in an explicit state
+pytree (functional, donation-friendly), and a pure ``(params, state, x) ->
+(logits, state')`` step that drops straight into the DDP example's explicit
+gradient-allreduce loop.
+
+Depths: 18/34 (basic blocks), 50/101/152 (bottleneck).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DEPTHS: Dict[int, Tuple[str, List[int]]] = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64  # stem channels; stages use width * (1, 2, 4, 8)
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.depth not in _DEPTHS:
+            raise ValueError(
+                f"depth {self.depth} not supported (choose {sorted(_DEPTHS)})"
+            )
+
+    @property
+    def block_kind(self) -> str:
+        return _DEPTHS[self.depth][0]
+
+    @property
+    def stage_sizes(self) -> List[int]:
+        return _DEPTHS[self.depth][1]
+
+
+# ---------------------------------------------------------------------------
+# Layers
+
+
+def _conv(x, w, stride=1):
+    """NHWC conv, SAME padding, HWIO kernel."""
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn_apply(x, p, s, train: bool, momentum: float, eps: float):
+    """Batch norm over N,H,W. Returns (y, new_state_entry)."""
+    if train:
+        mean = jnp.mean(x, axis=(0, 1, 2))
+        var = jnp.var(x, axis=(0, 1, 2))
+        new_s = {
+            "mean": momentum * s["mean"] + (1 - momentum) * mean,
+            "var": momentum * s["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
+    y = (x - mean.astype(x.dtype)) * inv * p["scale"].astype(x.dtype) + p[
+        "bias"
+    ].astype(x.dtype)
+    return y, new_s
+
+
+def _init_conv(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    std = math.sqrt(2.0 / fan_in)  # He init for ReLU nets
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * std
+
+
+def _init_bn(c, zero_scale=False):
+    return {
+        "scale": jnp.zeros((c,), jnp.float32)
+        if zero_scale
+        else jnp.ones((c,), jnp.float32),
+        "bias": jnp.zeros((c,), jnp.float32),
+    }
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+
+
+def _init_block(key, kind, cin, cmid, stride):
+    """One residual block's params + state. Output channels: cmid*4
+    (bottleneck) or cmid (basic). The last BN's scale starts at zero
+    (zero-init residual: each block begins as identity, the standard
+    large-batch trick)."""
+    ks = jax.random.split(key, 4)
+    cout = cmid * 4 if kind == "bottleneck" else cmid
+    if kind == "bottleneck":
+        p = {
+            "conv1": _init_conv(ks[0], 1, 1, cin, cmid),
+            "bn1": _init_bn(cmid),
+            "conv2": _init_conv(ks[1], 3, 3, cmid, cmid),
+            "bn2": _init_bn(cmid),
+            "conv3": _init_conv(ks[2], 1, 1, cmid, cout),
+            "bn3": _init_bn(cout, zero_scale=True),
+        }
+        s = {"bn1": _bn_state(cmid), "bn2": _bn_state(cmid), "bn3": _bn_state(cout)}
+    else:
+        p = {
+            "conv1": _init_conv(ks[0], 3, 3, cin, cmid),
+            "bn1": _init_bn(cmid),
+            "conv2": _init_conv(ks[1], 3, 3, cmid, cout),
+            "bn2": _init_bn(cout, zero_scale=True),
+        }
+        s = {"bn1": _bn_state(cmid), "bn2": _bn_state(cout)}
+    if stride != 1 or cin != cout:
+        p["proj"] = _init_conv(ks[3], 1, 1, cin, cout)
+        p["bn_proj"] = _init_bn(cout)
+        s["bn_proj"] = _bn_state(cout)
+    return p, s, cout
+
+
+def _block_apply(x, p, s, kind, stride, train, cfg: ResNetConfig):
+    bn = partial(_bn_apply, train=train, momentum=cfg.bn_momentum, eps=cfg.bn_eps)
+    new_s = {}
+    if "proj" in p:
+        shortcut = _conv(x, p["proj"], stride)
+        shortcut, new_s["bn_proj"] = bn(shortcut, p["bn_proj"], s["bn_proj"])
+    else:
+        shortcut = x
+    if kind == "bottleneck":
+        y = _conv(x, p["conv1"], 1)
+        y, new_s["bn1"] = bn(y, p["bn1"], s["bn1"])
+        y = jax.nn.relu(y)
+        y = _conv(y, p["conv2"], stride)
+        y, new_s["bn2"] = bn(y, p["bn2"], s["bn2"])
+        y = jax.nn.relu(y)
+        y = _conv(y, p["conv3"], 1)
+        y, new_s["bn3"] = bn(y, p["bn3"], s["bn3"])
+    else:
+        y = _conv(x, p["conv1"], stride)
+        y, new_s["bn1"] = bn(y, p["bn1"], s["bn1"])
+        y = jax.nn.relu(y)
+        y = _conv(y, p["conv2"], 1)
+        y, new_s["bn2"] = bn(y, p["bn2"], s["bn2"])
+    return jax.nn.relu(y + shortcut), new_s
+
+
+# ---------------------------------------------------------------------------
+# Model
+
+
+def init_params(key, cfg: ResNetConfig):
+    """Returns (params, state): state carries the BN running statistics."""
+    keys = jax.random.split(key, 2 + sum(cfg.stage_sizes))
+    kind = cfg.block_kind
+    params: Dict[str, Any] = {
+        "stem": _init_conv(keys[0], 7, 7, 3, cfg.width),
+        "bn_stem": _init_bn(cfg.width),
+    }
+    state: Dict[str, Any] = {"bn_stem": _bn_state(cfg.width)}
+    cin = cfg.width
+    ki = 1
+    blocks_p, blocks_s = [], []
+    for stage, n_blocks in enumerate(cfg.stage_sizes):
+        cmid = cfg.width * (2**stage)
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            p, s, cin = _init_block(keys[ki], kind, cin, cmid, stride)
+            blocks_p.append(p)
+            blocks_s.append(s)
+            ki += 1
+    params["blocks"] = blocks_p
+    params["head"] = (
+        jax.random.normal(keys[ki], (cin, cfg.num_classes), jnp.float32)
+        / math.sqrt(cin)
+    )
+    params["head_bias"] = jnp.zeros((cfg.num_classes,), jnp.float32)
+    state["blocks"] = blocks_s
+    return params, state
+
+
+def forward(params, state, x, cfg: ResNetConfig, train: bool = True):
+    """x: [N, H, W, 3] NHWC float -> (logits [N, classes], new_state)."""
+    x = x.astype(cfg.dtype)
+    y = _conv(x, params["stem"], 2)
+    y, bn_stem = _bn_apply(
+        y, params["bn_stem"], state["bn_stem"], train, cfg.bn_momentum, cfg.bn_eps
+    )
+    y = jax.nn.relu(y)
+    y = lax.reduce_window(
+        y, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    new_state: Dict[str, Any] = {"bn_stem": bn_stem, "blocks": []}
+    bi = 0
+    kind = cfg.block_kind
+    for stage, n_blocks in enumerate(cfg.stage_sizes):
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            y, s_new = _block_apply(
+                y, params["blocks"][bi], state["blocks"][bi], kind, stride,
+                train, cfg,
+            )
+            new_state["blocks"].append(s_new)
+            bi += 1
+    y = jnp.mean(y, axis=(1, 2))  # global average pool
+    logits = (
+        y.astype(jnp.float32) @ params["head"] + params["head_bias"]
+    )
+    return logits, new_state
+
+
+def num_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def loss_fn(params, state, x, labels, cfg: ResNetConfig):
+    """Mean softmax cross-entropy; returns (loss, new_state)."""
+    logits, new_state = forward(params, state, x, cfg, train=True)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(lse - tgt), new_state

@@ -1,10 +1,14 @@
 """EP dispatch+combine benchmark — the test_low_latency.py analog.
 
-Prints per-member dispatch+combine latency and bandwidth for the DeepEP-shaped
-Buffer (reference metric definition: ep/bench/test_low_latency.py:438-464 —
-per-rank dispatch/combine GB/s and µs).
+Reports per-member dispatch latency, combine latency, and bandwidth for both
+the normal (sorted, capacity-padded) path and the packed low-latency path
+(reference metric definition: ep/bench/test_low_latency.py:438-464 — per-rank
+dispatch/combine GB/s and avg/min/max µs).
 
-Usage: python benchmarks/ep_bench.py [--devices N] [--tokens T] [--hidden H]
+Usage:
+  python benchmarks/ep_bench.py [--devices N] [--tokens T] [--hidden H]
+  python benchmarks/ep_bench.py --ll            # low-latency packed path
+  python benchmarks/ep_bench.py --table         # E ∈ {8, 32} latency table
 """
 
 from __future__ import annotations
@@ -13,6 +17,89 @@ import argparse
 import time
 
 from _bootstrap import init_devices
+
+
+def _time_fn(fn, args, iters):
+    """Per-call latency via a timed loop with a final host sync (see
+    memory/tpu-tunnel-discipline: chain + host scalar read)."""
+    out = fn(*args)  # compile + warmup
+    jax_block(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax_block(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def jax_block(tree):
+    import jax
+    import numpy as np
+
+    leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "block_until_ready")]
+    if leaves:
+        np.asarray(leaves[0]).reshape(-1)[:1]  # host read: real sync
+
+
+def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8):
+    """Time dispatch and combine separately for one config. Returns a dict."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from uccl_tpu.ep import Buffer
+    from uccl_tpu.parallel.mesh import AXIS, MeshConfig, make_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh(MeshConfig(dp=n))
+    experts = max(experts, n)
+    experts -= experts % n
+    buf = Buffer(mesh, AXIS.EP, num_experts=experts, num_selected=topk)
+
+    rng = np.random.default_rng(0)
+    x = buf.device_put(
+        rng.standard_normal((n, tokens, hidden)).astype(np.float32)
+    )
+    idx = buf.device_put(
+        rng.integers(0, experts, (n, tokens, topk)).astype(np.int32)
+    )
+    wts = buf.device_put(
+        np.full((n, tokens, topk), 1.0 / topk, np.float32)
+    )
+
+    if mode == "ll":
+        recv, counts, handle = buf.low_latency_dispatch(
+            x, idx, None, wts, wire_fp8=fp8
+        )
+        dt_dispatch = _time_fn(
+            lambda a, b, c: buf.low_latency_dispatch(a, b, None, c,
+                                                     wire_fp8=fp8),
+            (x, idx, wts), iters,
+        )
+        dt_combine = _time_fn(
+            lambda y: buf.low_latency_combine(y, handle), (recv,), iters
+        )
+        wire_rows = tokens * topk  # actual rows moved (ragged wire)
+    else:
+        recv, handle = buf.dispatch(x, idx, wts, wire_fp8=fp8)
+        dt_dispatch = _time_fn(
+            lambda a, b, c: buf.dispatch(a, b, c, wire_fp8=fp8)[0],
+            (x, idx, wts), iters,
+        )
+        dt_combine = _time_fn(
+            lambda y: buf.combine(y, handle, wire_fp8=fp8), (recv,), iters
+        )
+        wire_rows = experts // n * buf.capacity(tokens) * n  # padded slots
+
+    bytes_per_row = hidden * (1 if fp8 else 4)
+    return {
+        "mode": mode,
+        "experts": experts,
+        "tokens": tokens,
+        "hidden": hidden,
+        "topk": topk,
+        "dispatch_us": dt_dispatch * 1e6,
+        "combine_us": dt_combine * 1e6,
+        "gbps": wire_rows * bytes_per_row / (dt_dispatch + dt_combine) / 1e9,
+    }
 
 
 def main():
@@ -25,100 +112,110 @@ def main():
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--fp8", action="store_true")
     ap.add_argument(
+        "--ll", action="store_true",
+        help="packed low-latency path (ragged wire on TPU/GPU, grouped "
+             "recv buffers + counts; the DeepEP LL contract)",
+    )
+    ap.add_argument(
+        "--table", action="store_true",
+        help="print the per-rank latency table at E ∈ {8, 32} for both the "
+             "normal and low-latency paths (the BASELINE.md north-star "
+             "metric shape)",
+    )
+    ap.add_argument(
         "--compare-dense", action="store_true",
-        help="also time the dense [T,E,C] mask-einsum oracle path (the pre-"
-             "round-2 formulation) and print the sorted-path speedup",
+        help="also time the dense [T,E,C] mask-einsum oracle path and print "
+             "the sorted-path speedup",
     )
     args = ap.parse_args()
 
     jax = init_devices(args.devices)
-
-    import jax.numpy as jnp
-    import numpy as np
-
-    from uccl_tpu.ep import Buffer
-    from uccl_tpu.parallel.mesh import AXIS, MeshConfig, make_mesh
-
     n = len(jax.devices())
-    mesh = make_mesh(MeshConfig(dp=n))
-    experts = max(args.experts, n)
-    experts -= experts % n
-    buf = Buffer(mesh, AXIS.EP, num_experts=experts, num_selected=args.topk)
 
-    rng = np.random.default_rng(0)
-    x = buf.device_put(
-        rng.standard_normal((n, args.tokens, args.hidden)).astype(np.float32)
-    )
-    idx = buf.device_put(
-        rng.integers(0, experts, (n, args.tokens, args.topk)).astype(np.int32)
-    )
-    wts = buf.device_put(
-        np.full((n, args.tokens, args.topk), 1.0 / args.topk, np.float32)
-    )
+    if args.table:
+        print(f"EP latency table ({n} members, tokens={args.tokens}, "
+              f"hidden={args.hidden}, topk={args.topk})")
+        print(f"{'mode':>8} {'E':>4} {'fp8':>5} {'dispatch us':>12} "
+              f"{'combine us':>11} {'GB/s':>8}")
+        for experts in (8, 32):
+            for mode in ("normal", "ll"):
+                for fp8 in (False, True):
+                    r = bench_config(
+                        jax, tokens=args.tokens, hidden=args.hidden,
+                        experts=experts, topk=args.topk, iters=args.iters,
+                        mode=mode, fp8=fp8,
+                    )
+                    print(
+                        f"{mode:>8} {r['experts']:>4} {str(fp8):>5} "
+                        f"{r['dispatch_us']:>12.1f} {r['combine_us']:>11.1f} "
+                        f"{r['gbps']:>8.3f}"
+                    )
+        return
 
-    def roundtrip():
-        recv, handle = (
-            buf.low_latency_dispatch(x, idx, wts)
-            if args.fp8
-            else buf.dispatch(x, idx, wts)
-        )
-        out = (
-            buf.low_latency_combine(recv, handle)
-            if args.fp8
-            else buf.combine(recv, handle)
-        )
-        return out
-
-    out = roundtrip()  # compile + warmup
-    np.asarray(out)
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        out = roundtrip()
-    np.asarray(out)
-    dt = (time.perf_counter() - t0) / args.iters
+    mode = "ll" if args.ll else "normal"
+    r = bench_config(
+        jax, tokens=args.tokens, hidden=args.hidden, experts=args.experts,
+        topk=args.topk, iters=args.iters, mode=mode, fp8=args.fp8,
+    )
+    print(
+        f"EP{n} {mode}: tokens={r['tokens']} hidden={r['hidden']} "
+        f"experts={r['experts']} topk={r['topk']} fp8={args.fp8}"
+    )
+    print(
+        f"  dispatch {r['dispatch_us']:.1f} us | combine "
+        f"{r['combine_us']:.1f} us | {r['gbps']:.3f} GB/s per member"
+    )
 
     if args.compare_dense:
+        import numpy as np
         from jax.sharding import PartitionSpec as P
 
+        import jax.numpy as jnp
+
+        from uccl_tpu.ep import Buffer
         from uccl_tpu.ep import ops as ep_ops
+        from uccl_tpu.parallel.mesh import AXIS, MeshConfig, make_mesh
 
+        mesh = make_mesh(MeshConfig(dp=n))
+        experts = max(args.experts, n)
+        experts -= experts % n
+        buf = Buffer(mesh, AXIS.EP, num_experts=experts,
+                     num_selected=args.topk)
         cap = buf.capacity(args.tokens)
+        rng = np.random.default_rng(0)
+        x = buf.device_put(
+            rng.standard_normal((n, args.tokens, args.hidden)).astype(
+                np.float32
+            )
+        )
+        idx = buf.device_put(
+            rng.integers(0, experts, (n, args.tokens, args.topk)).astype(
+                np.int32
+            )
+        )
+        wts = buf.device_put(
+            np.full((n, args.tokens, args.topk), 1.0 / args.topk, np.float32)
+        )
 
-        # Fair comparison: same precomputed idx/wts as the sorted timing
-        # (no routing math on either side)
         def dense_f(xv, iv, wv):
             xv, iv, wv = xv[0], iv[0], wv[0]
             mask, weights, _ = ep_ops.masks_from_topk(iv, wv, experts, cap)
             xe = ep_ops.dispatch(xv, mask, "dp")
             return ep_ops.combine(xe, weights, "dp")[None]
 
-        import jax as _jax
-
-        dense_fn = _jax.jit(
-            _jax.shard_map(
+        dense_fn = jax.jit(
+            jax.shard_map(
                 dense_f, mesh=mesh, in_specs=(P("dp"), P("dp"), P("dp")),
                 out_specs=P("dp"), check_vma=False,
             )
         )
-        np.asarray(dense_fn(x, idx, wts))  # compile + warm
-        t0 = time.perf_counter()
-        for _ in range(max(1, args.iters // 5)):
-            o = dense_fn(x, idx, wts)
-        np.asarray(o)
-        dt_dense = (time.perf_counter() - t0) / max(1, args.iters // 5)
+        iters = max(1, args.iters // 5)
+        dt_dense = _time_fn(dense_fn, (x, idx, wts), iters)
+        total = (r["dispatch_us"] + r["combine_us"]) / 1e6
         print(
             f"  dense-mask oracle: {dt_dense * 1e6:.0f} us "
-            f"(sorted path speedup {dt_dense / dt:.1f}x)"
+            f"({mode} path speedup {dt_dense / total:.1f}x)"
         )
-
-    per_member_bytes = args.tokens * args.hidden * 4 * args.topk  # moved payload
-    print(
-        f"EP{n} dispatch+combine: tokens={args.tokens} hidden={args.hidden} "
-        f"experts={experts} topk={args.topk} fp8={args.fp8}"
-    )
-    print(
-        f"  avg {dt * 1e6:.1f} us | {per_member_bytes / dt / 1e9:.3f} GB/s per member"
-    )
 
 
 if __name__ == "__main__":

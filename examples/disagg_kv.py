@@ -64,7 +64,7 @@ def decode_worker(port_q, result_q, new_tokens):
     )
     from uccl_tpu.p2p import Endpoint
 
-    compress = os.environ.get("UCCL_TPU_EXAMPLE_COMPRESS") == "1"
+    compress = os.environ.get("UCCL_TPU_EXAMPLE_COMPRESS", "off")
     elastic = os.environ.get("UCCL_TPU_EXAMPLE_ELASTIC") == "1"
     cfg, params = _make()
     ep = Endpoint()
@@ -73,11 +73,16 @@ def decode_worker(port_q, result_q, new_tokens):
 
     # advertise host buffers shaped like the cache the prefill side will send
     shape = (cfg.n_layers, BATCH, MAX_SEQ, cfg.n_kv_heads, cfg.head_dim)
-    if compress:
-        # fp8 blobs land here (reference: DietGPU-compressed KV transfer)
-        from uccl_tpu.p2p.compress import compressed_bound, decode_fp8
+    if compress != "off":
+        # compressed blobs land here (reference: DietGPU KV transfer)
+        from uccl_tpu.p2p.compress import compressed_bound, decode_any
 
-        bound = compressed_bound(shape, np.float32)
+        raw_bytes = int(np.prod(shape)) * 4
+        bound = (
+            compressed_bound(shape, np.float32)
+            if compress == "fp8"
+            else raw_bytes + (1 << 14)  # lossless: raw + header slack
+        )
         k_host = np.zeros(bound, np.uint8)
         v_host = np.zeros(bound, np.uint8)
     else:
@@ -89,8 +94,8 @@ def decode_worker(port_q, result_q, new_tokens):
     meta = np.frombuffer(ep.recv(conn, timeout_ms=30000), np.int32)
     length, first_tok = int(meta[0]), meta[1 : 1 + BATCH]
 
-    if compress:
-        k_arr, v_arr = decode_fp8(k_host), decode_fp8(v_host)
+    if compress != "off":
+        k_arr, v_arr = decode_any(k_host), decode_any(v_host)
     else:
         k_arr, v_arr = k_host, v_host
     cache = KVCache(jnp.asarray(k_arr), jnp.asarray(v_arr), jnp.int32(length))
@@ -127,8 +132,10 @@ def main():
     ap.add_argument("--new-tokens", type=int, default=12)
     ap.add_argument("--cpu", action="store_true", help="force CPU jax")
     ap.add_argument(
-        "--compress", action="store_true",
-        help="ship the KV cache fp8-compressed (prints the wire ratio)",
+        "--compress", nargs="?", const="fp8", default="off",
+        choices=["off", "fp8", "lossless"],
+        help="ship the KV cache compressed: fp8 (lossy ~3.8x) or lossless "
+             "(exact, byte-plane + native rANS; prints the wire ratio)",
     )
     ap.add_argument(
         "--elastic", action="store_true",
@@ -137,8 +144,8 @@ def main():
     args = ap.parse_args()
     if args.cpu:
         os.environ["UCCL_TPU_EXAMPLE_CPU"] = "1"  # inherited by the worker
-    if args.compress:
-        os.environ["UCCL_TPU_EXAMPLE_COMPRESS"] = "1"
+    if args.compress != "off":
+        os.environ["UCCL_TPU_EXAMPLE_COMPRESS"] = args.compress
     if args.elastic:
         os.environ["UCCL_TPU_EXAMPLE_ELASTIC"] = "1"
     _maybe_force_cpu()
@@ -170,16 +177,17 @@ def main():
     fifo_v = ep.recv(conn, timeout_ms=30000)
     k_host = np.ascontiguousarray(np.asarray(cache.k, np.float32))
     v_host = np.ascontiguousarray(np.asarray(cache.v, np.float32))
-    if args.compress:
-        from uccl_tpu.p2p.compress import encode_fp8
+    if args.compress != "off":
+        from uccl_tpu.p2p.compress import encode
 
-        k_blob, v_blob = encode_fp8(k_host), encode_fp8(v_host)
+        k_blob = encode(k_host, args.compress)
+        v_blob = encode(v_host, args.compress)
         ep.write(conn, k_blob, fifo_k)  # one-sided compressed cache push
         ep.write(conn, v_blob, fifo_v)
         wire = k_blob.nbytes + v_blob.nbytes
         raw = k_host.nbytes + v_host.nbytes
         print(
-            f"prefill: shipped fp8 KV cache {wire / 1e6:.3f} MB "
+            f"prefill: shipped {args.compress} KV cache {wire / 1e6:.3f} MB "
             f"(raw {raw / 1e6:.3f} MB, ratio {raw / wire:.2f}x)"
         )
     else:
@@ -187,7 +195,7 @@ def main():
         ep.write(conn, v_host, fifo_v)
     meta = np.concatenate([[int(cache.length)], first_tok]).astype(np.int32)
     ep.send(conn, np.ascontiguousarray(meta))
-    if not args.compress:
+    if args.compress == "off":
         print(
             f"prefill: shipped KV cache {k_host.nbytes * 2 / 1e6:.2f} MB "
             f"(stats {ep.stats})"
@@ -201,7 +209,7 @@ def main():
     want = np.asarray(
         generate(params, prompt, cfg, max_new_tokens=args.new_tokens, max_seq=MAX_SEQ)
     )
-    if args.compress:
+    if args.compress == "fp8":
         # fp8 KV is lossy; exact token equality is not guaranteed. Require
         # generation to complete and mostly agree with the oracle.
         agree = float(np.mean(disagg == want))
@@ -209,6 +217,7 @@ def main():
         if disagg.shape != want.shape or agree < 0.5:
             sys.exit(1)
     else:
+        # raw and lossless wires are exact: tokens must match bit-for-bit
         ok = np.array_equal(disagg, want)
         print(f"disaggregated tokens match single-worker generation: {ok}")
         if not ok:

@@ -237,7 +237,12 @@ class TestBuffer:
         idx = rng.integers(0, E, (W, T, 1)).astype(np.int32)
         wts = np.ones((W, T, 1), np.float32)
         gx = buf.device_put(x)
-        recv, handle = buf.low_latency_dispatch(gx, buf.device_put(idx), buf.device_put(wts))
+        recv, counts, handle = buf.low_latency_dispatch(
+            gx, buf.device_put(idx), None, buf.device_put(wts),
+            wire="dense",  # virtual CPU mesh: no ragged-all-to-all thunk
+        )
+        # the DeepEP contract returns per-expert recv counts alongside
+        assert np.asarray(counts).sum() == W * T * 1
         out = np.asarray(buf.low_latency_combine(recv, handle))
         rel = np.abs(out - x) / (np.abs(x).max() + 1e-9)
         assert rel.max() < 0.08  # two fp8 quantization hops
@@ -375,3 +380,126 @@ class TestCrossPod:
         assert not errors, errors[0][1]
         for p in range(P_pods):
             assert np.isfinite(results[p]).all()
+
+
+class TestCrossPodTraining:
+    """Training-grade cross-pod EP: backward runs the same DCN exchanges and
+    gradients match a single-process jax oracle (the reference serves EP
+    inside torch autograd — ep/src/proxy.cpp:701 posts RDMA in fwd AND bwd)."""
+
+    def _run_pods(self, devices, rng, n_chunks):
+        import threading
+
+        from uccl_tpu.collective.hierarchical import DcnGroup
+        from uccl_tpu.ep.cross_pod import CrossPodMoE
+        from uccl_tpu.p2p.store import StoreClient, StoreServer
+        from uccl_tpu.parallel.distributed import Session
+        from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        P_pods, E, T, H, F, K = 2, 8, 24, 16, 32, 2
+        epp = E // P_pods
+        wg = (rng.standard_normal((E, H, F)) * 0.2).astype(np.float32)
+        wd = (rng.standard_normal((E, F, H)) * 0.2).astype(np.float32)
+        x = rng.standard_normal((P_pods, T, H)).astype(np.float32)
+        logits = rng.standard_normal((P_pods, T, E)).astype(np.float32)
+        gates = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        ti = np.argsort(-gates, axis=-1)[..., :K].astype(np.int32)
+        tv = np.take_along_axis(gates, ti, -1)
+        tv = (tv / tv.sum(-1, keepdims=True)).astype(np.float32)
+
+        def expert_fn(buf, w):
+            hmid = jnp.maximum(jnp.einsum("ech,ehf->ecf", buf, w["wg"]), 0.0)
+            return jnp.einsum("ecf,efh->ech", hmid, w["wd"])
+
+        server = StoreServer()
+        results = {}
+        errors = []
+
+        def pod_main(p):
+            try:
+                client = StoreClient("127.0.0.1", server.port)
+                sess = Session(rank=p, world=P_pods, store=client)
+                dcn = DcnGroup(sess, n_paths=2, tag=f"xpodtr{n_chunks}")
+                mesh = make_mesh(
+                    MeshConfig(dp=4), devices[p * 4 : (p + 1) * 4]
+                )
+                moe = CrossPodMoE(
+                    dcn, mesh, num_global_experts=E, num_selected=K,
+                    capacity_factor=float(E), n_chunks=n_chunks,
+                )
+                w_local = {
+                    "fn": expert_fn,
+                    "wg": jnp.asarray(wg[p * epp : (p + 1) * epp]),
+                    "wd": jnp.asarray(wd[p * epp : (p + 1) * epp]),
+                }
+                out = moe.forward(x[p], ti[p], tv[p], w_local)
+                # loss = sum(out^2) per pod -> dout = 2*out
+                dx, dw, dwarr = moe.backward(2.0 * out)
+                results[p] = (out, dx, dw, dwarr)
+                dcn.close()
+                client.close()
+            except Exception as e:  # pragma: no cover
+                import traceback
+
+                errors.append((p, e, traceback.format_exc()))
+
+        ts = [threading.Thread(target=pod_main, args=(p,))
+              for p in range(P_pods)]
+        [t.start() for t in ts]
+        [t.join(timeout=180) for t in ts]
+        server.close()
+        assert not errors, errors[0][2]
+        return results, (x, ti, tv, wg, wd, P_pods, E, T, H, F, K, epp)
+
+    @pytest.mark.parametrize("n_chunks", [1, 2], ids=["serial", "overlap"])
+    def test_grads_match_oracle(self, devices, rng, n_chunks):
+        results, (x, ti, tv, wg, wd, P_pods, E, T, H, F, K, epp) = (
+            self._run_pods(devices, rng, n_chunks)
+        )
+
+        # oracle: global loss = sum over pods of sum(out_p^2); autodiff
+        def oracle_loss(xg, tvg, wgg, wdg):
+            total = 0.0
+            for p in range(P_pods):
+                out = jnp.zeros((T, H), jnp.float32)
+                for j in range(K):
+                    e = ti[p, :, j]
+                    hmid = jnp.maximum(
+                        jnp.einsum("th,thf->tf", xg[p], wgg[e]), 0.0
+                    )
+                    y = jnp.einsum("tf,tfh->th", hmid, wdg[e])
+                    out = out + tvg[p, :, j][:, None] * y
+                total = total + jnp.sum(out**2)
+            return total
+
+        g_x, g_tv, g_wg, g_wd = jax.grad(oracle_loss, argnums=(0, 1, 2, 3))(
+            jnp.asarray(x), jnp.asarray(tv), jnp.asarray(wg), jnp.asarray(wd)
+        )
+        for p in range(P_pods):
+            out, dx, dw, dwarr = results[p]
+            np.testing.assert_allclose(
+                dx, np.asarray(g_x[p]), rtol=2e-3, atol=2e-4
+            )
+            np.testing.assert_allclose(
+                dw, np.asarray(g_tv[p]), rtol=2e-3, atol=2e-4
+            )
+            np.testing.assert_allclose(
+                dwarr["wg"], np.asarray(g_wg[p * epp:(p + 1) * epp]),
+                rtol=2e-3, atol=2e-4,
+            )
+            np.testing.assert_allclose(
+                dwarr["wd"], np.asarray(g_wd[p * epp:(p + 1) * epp]),
+                rtol=2e-3, atol=2e-4,
+            )
+
+    def test_overlap_matches_serial_forward(self, devices, rng):
+        """n_chunks=2 (pipelined exchanges) is numerically identical to the
+        serial schedule."""
+        r1, _ = self._run_pods(devices, rng, 1)
+        rng2 = np.random.default_rng(0)
+        r2, _ = self._run_pods(devices, rng2, 2)
+        # same rng fixture seed drives both runs via _run_pods args
+        for p in r1:
+            np.testing.assert_allclose(
+                r1[p][0], r2[p][0], rtol=1e-5, atol=1e-6
+            )

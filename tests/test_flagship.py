@@ -87,6 +87,21 @@ class TestForwardParity:
             )
         np.testing.assert_allclose(outs["flash"], outs["xla"], rtol=2e-3, atol=2e-3)
 
+    def test_ll_moe_path(self, devices, rng):
+        """moe_impl='ll' (packed grouped-GEMM path, no padded FLOPs) matches
+        the dense oracle at drop-free settings."""
+        mesh = make_mesh(MeshConfig(pp=1, dp=2, cp=2, tp=2), devices)
+        cfg = _cfg(moe_impl="ll")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens, _ = _data(rng, cfg)
+        want = np.asarray(reference_forward(params, tokens, cfg))
+        got = np.asarray(
+            jax.jit(lambda p, t: forward(p, t, cfg, mesh))(
+                shard_params(params, mesh, cfg), tokens
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
     def test_ulysses_mode(self, devices, rng):
         mesh = make_mesh(MeshConfig(pp=1, dp=2, cp=2, tp=2), devices)
         cfg = _cfg(seq_mode="ulysses")
@@ -140,9 +155,15 @@ class TestManualSchedule:
             (MeshConfig(pp=2, dp=2, cp=1, tp=2), {}),
             (MeshConfig(pp=2, dp=2, cp=1, tp=2), {"attn_impl": "flash"}),
             (MeshConfig(pp=2, dp=2, cp=1, tp=2), {"moe_impl": "dense"}),
+            (MeshConfig(pp=2, dp=2, cp=1, tp=2), {"moe_impl": "ll"}),
             (MeshConfig(pp=4, dp=2, cp=1, tp=1), {"n_layers": 4}),
+            (MeshConfig(pp=2, dp=1, cp=2, tp=2), {}),
+            (MeshConfig(pp=2, dp=2, cp=2, tp=1), {"seq_mode": "ulysses"}),
         ],
-        ids=["pp2_dp2_tp2", "flash", "dense_moe", "pp4_dp2"],
+        ids=[
+            "pp2_dp2_tp2", "flash", "dense_moe", "ll_moe", "pp4_dp2",
+            "pp2_cp2_tp2", "pp2_dp2_cp2_ulysses",
+        ],
     )
     def test_matches_gpipe_grads(self, devices, rng, mc, kw):
         from uccl_tpu.models.flagship import manual_loss_and_grads
@@ -172,20 +193,6 @@ class TestManualSchedule:
                 np.asarray(b), np.asarray(a), rtol=5e-3, atol=1e-5,
                 err_msg=str(pw),
             )
-
-    def test_cp_guarded(self, devices, rng):
-        """cp>1 must be rejected with a clear error (ppermute transpose is
-        unsound inside the manual schedule's cond; see flagship.py)."""
-        from uccl_tpu.models.flagship import manual_loss_and_grads
-
-        mesh = make_mesh(MeshConfig(pp=2, dp=1, cp=2, tp=2), devices)
-        cfg = _cfg()
-        params = shard_params(init_params(jax.random.PRNGKey(6), cfg), mesh, cfg)
-        tokens, targets = _data(rng, cfg)
-        with pytest.raises(NotImplementedError, match="cp=1"):
-            jax.jit(
-                lambda p: manual_loss_and_grads(p, tokens, targets, cfg, mesh)
-            )(params)
 
     def test_trains(self, devices, rng):
         mesh = make_mesh(MeshConfig(pp=2, dp=2, cp=1, tp=2), devices)

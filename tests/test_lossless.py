@@ -1,0 +1,113 @@
+"""Lossless wire codec: exact round-trips, ratios, codec routing.
+
+The DietGPU-analog requirements (reference p2p/rdma/compression.h:46 —
+DietGPU is a LOSSLESS ANS float codec): bit-identical round trips on every
+supported dtype, ratio > 1.5x on checkpoint-like bf16 tensors, and blobs
+routable off the same wire as fp8 blobs."""
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from uccl_tpu.p2p import lossless as lz
+from uccl_tpu.p2p.compress import decode_any, encode, encode_fp8
+
+
+@pytest.fixture(scope="module")
+def rng_():
+    return np.random.default_rng(0)
+
+
+DTYPES = [
+    np.dtype(np.float32),
+    np.dtype(ml_dtypes.bfloat16),
+    np.dtype(np.float16),
+    np.dtype(np.float64),
+    np.dtype(np.int32),
+    np.dtype(np.int8),
+    np.dtype(np.uint8),
+    np.dtype(np.int64),
+]
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("dtype", DTYPES, ids=str)
+    def test_bit_exact(self, rng_, dtype):
+        if dtype.kind in "iu":
+            a = rng_.integers(-100 if dtype.kind == "i" else 0, 100,
+                              4097).astype(dtype)
+        else:
+            a = (rng_.standard_normal(4097) * 0.02).astype(dtype)
+        a = a.reshape(17, 241)
+        back = lz.decode_lossless(lz.encode_lossless(a))
+        assert back.dtype == a.dtype and back.shape == a.shape
+        np.testing.assert_array_equal(
+            back.view(np.uint8), a.view(np.uint8)
+        )
+
+    def test_specials_survive(self):
+        """NaN payloads, infs, -0.0, denormals round-trip bit-exactly
+        (a lossless codec must not normalize anything)."""
+        a = np.array(
+            [0.0, -0.0, np.inf, -np.inf, np.nan, 1e-40, -1e-40, 448.0],
+            np.float32,
+        )
+        a = np.concatenate([a, np.frombuffer(b"\x01\x00\x80\x7f" * 4,
+                                             np.float32)])
+        back = lz.decode_lossless(lz.encode_lossless(a))
+        np.testing.assert_array_equal(back.view(np.uint32), a.view(np.uint32))
+
+    def test_empty_and_scalarish(self):
+        for a in (np.zeros((0,), np.float32), np.ones((1,), np.float32)):
+            back = lz.decode_lossless(lz.encode_lossless(a))
+            np.testing.assert_array_equal(back, a)
+
+
+class TestRatio:
+    def test_bf16_checkpoint_beats_1p5(self, rng_):
+        """Weight-like bf16 (the checkpoint dtype): > 1.5x, the DietGPU-class
+        target (VERDICT r2 missing #5)."""
+        w = (rng_.standard_normal(1 << 19) * 0.02).astype(ml_dtypes.bfloat16)
+        assert lz.ratio(w) > 1.5
+
+    def test_low_entropy_tensors_compress_hard(self, rng_):
+        gains = (1.0 + rng_.standard_normal(1 << 15) * 0.01).astype(
+            ml_dtypes.bfloat16
+        )
+        assert lz.ratio(gains) > 3.0
+        sparse = (
+            np.where(rng_.random(1 << 18) < 0.05,
+                     rng_.standard_normal(1 << 18), 0.0) * 0.01
+        ).astype(np.float32)
+        assert lz.ratio(sparse) > 5.0
+
+    def test_incompressible_overhead_is_bounded(self, rng_):
+        """Pure-noise uint8 must not blow up: planes ship raw, overhead is
+        just the header."""
+        noise = rng_.integers(0, 256, 1 << 16).astype(np.uint8)
+        blob = lz.encode_lossless(noise)
+        assert blob.nbytes < noise.nbytes + 256
+
+
+class TestCodecRouting:
+    def test_decode_any_routes_both_magics(self, rng_):
+        a = (rng_.standard_normal(2048) * 0.1).astype(np.float32)
+        exact = decode_any(encode(a, "lossless"))
+        np.testing.assert_array_equal(exact, a)
+        lossy = decode_any(encode_fp8(a))
+        assert np.abs(lossy - a).max() < 0.05
+
+    def test_unknown_magic_rejected(self):
+        with pytest.raises(ValueError):
+            decode_any(np.zeros(64, np.uint8))
+
+
+class TestZlibFallback:
+    def test_roundtrip_without_native(self, rng_, monkeypatch):
+        """With the native coder disabled the codec stays correct (zlib
+        planes) and can still decode its own blobs."""
+        monkeypatch.setattr(lz, "_codec_lib", False)
+        a = (rng_.standard_normal(8192) * 0.02).astype(ml_dtypes.bfloat16)
+        blob = lz.encode_lossless(a)
+        back = lz.decode_lossless(blob)
+        np.testing.assert_array_equal(back.view(np.uint8), a.view(np.uint8))

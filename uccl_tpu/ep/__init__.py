@@ -11,13 +11,23 @@ internode_ll.cu's fp8+scales message format).
 
 Surfaces:
 * :mod:`uccl_tpu.ep.ops`    — per-shard routing/dispatch/combine for shard_map code.
+* :mod:`uccl_tpu.ep.ll`     — packed low-latency path: ragged wire + grouped
+  GEMMs over receive counts (the DeepEP LL contract, internode_ll.cu analog).
 * :class:`uccl_tpu.ep.Buffer` — DeepEP-shaped host API (dispatch / combine /
   low_latency_dispatch / low_latency_combine / get_dispatch_layout).
 """
 
-from uccl_tpu.ep import ops
-from uccl_tpu.ep.buffer import Buffer
+from uccl_tpu.ep import ll, ops
+from uccl_tpu.ep.buffer import Buffer, LowLatencyHandle
 from uccl_tpu.ep.cross_pod import CrossPodMoE
 from uccl_tpu.ep.elastic import ElasticBuffer, ElasticKVCache
 
-__all__ = ["ops", "Buffer", "CrossPodMoE", "ElasticBuffer", "ElasticKVCache"]
+__all__ = [
+    "ops",
+    "ll",
+    "Buffer",
+    "LowLatencyHandle",
+    "CrossPodMoE",
+    "ElasticBuffer",
+    "ElasticKVCache",
+]

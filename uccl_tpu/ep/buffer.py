@@ -23,6 +23,7 @@ import numpy as np
 from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from uccl_tpu.ep import ll as ep_ll
 from uccl_tpu.ep import ops as ep_ops
 from uccl_tpu.parallel.mesh import AXIS, get_mesh, mesh_axis_size
 from uccl_tpu.utils.logging import get_logger
@@ -37,6 +38,22 @@ class DispatchHandle(NamedTuple):
 
     slot: jax.Array  # [W, T, K] int32 slot per assignment (E*C = dropped)
     weights: jax.Array  # [W, T, K] f32 gate weights
+
+
+class LowLatencyHandle(NamedTuple):
+    """Handle for the packed low-latency path (ep/ll.py): the global [W, ...]
+    form of :class:`uccl_tpu.ep.ll.LLState` plus the static wire choice —
+    DeepEP keeps the same bookkeeping inside its returned handle tuple
+    (ep/bench/buffer.py:285-454)."""
+
+    send_slot: jax.Array  # [W, T, K]
+    weights: jax.Array  # [W, T, K]
+    send_mat: jax.Array  # [W, W, E_local]
+    recv_mat: jax.Array  # [W, W, E_local]
+    regroup: jax.Array  # [W, R_max]
+    src_in_offsets: jax.Array  # [W, W]
+    wire: str
+    wire_fp8: bool
 
 
 class Buffer:
@@ -192,9 +209,86 @@ class Buffer:
         fn = self._jit(key, f, (3, 2, 2), 2)
         return fn(expert_out, handle.slot, handle.weights)
 
-    # -- low-latency mode: fp8 payloads on the wire ---------------------
-    def low_latency_dispatch(self, x, topk_idx, topk_weights=None):
-        return self.dispatch(x, topk_idx, topk_weights, wire_fp8=True)
+    # -- low-latency mode: packed fp8 payloads + recv counts -------------
+    def low_latency_dispatch(
+        self,
+        x: jax.Array,
+        topk_idx: jax.Array,
+        num_max_dispatch_tokens_per_rank: Optional[int] = None,
+        topk_weights: Optional[jax.Array] = None,
+        *,
+        pair_capacity_factor: Optional[float] = None,
+        wire: str = "auto",
+        wire_fp8: bool = True,
+    ):
+        """The DeepEP low-latency contract (ep/bench/buffer.py:285-454):
+        packed per-expert buffers sized by ``num_max_dispatch_tokens_per_rank``
+        plus per-expert receive counts, fp8 on the wire.
 
-    def low_latency_combine(self, expert_out, handle):
-        return self.combine(expert_out, handle, wire_fp8=True)
+        x: [W, T, H]; topk_idx: [W, T, K]. Returns
+        (recv_x [W, R_max, H] group-major packed,
+         recv_count [W, E_local],
+         handle) — the consumer feeds (recv_x, recv_count) straight into
+        grouped GEMMs (:func:`uccl_tpu.ep.ll.grouped_ffn`) so neither wire
+        nor MXU touches padding."""
+        w, t, h = x.shape
+        k = topk_idx.shape[-1]
+        if wire == "auto":
+            wire = "ragged" if ep_ll.wire_supports_ragged() else "dense"
+        if topk_weights is None:
+            topk_weights = jnp.full(topk_idx.shape, 1.0 / k, jnp.float32)
+        key = (
+            "ll_dispatch", x.shape, topk_idx.shape, x.dtype,
+            num_max_dispatch_tokens_per_rank, pair_capacity_factor, wire,
+            wire_fp8,
+        )
+
+        def f(xv, idx, wts):
+            r = ep_ll.ll_dispatch(
+                xv[0], idx[0], wts[0], self.num_experts, self._axis_name(),
+                num_max_dispatch_tokens_per_rank=(
+                    num_max_dispatch_tokens_per_rank
+                ),
+                pair_capacity_factor=pair_capacity_factor,
+                wire=wire, wire_fp8=wire_fp8,
+            )
+            s = r.state
+            return (
+                r.recv_x[None], r.group_sizes[None], s.send_slot[None],
+                s.weights[None], s.send_mat[None], s.recv_mat[None],
+                s.regroup[None], s.src_in_offsets[None],
+            )
+
+        fn = self._jit(key, f, (2, 2, 2), (2, 1, 2, 2, 2, 2, 1, 1))
+        (recv_x, counts, send_slot, weights, send_mat, recv_mat, regroup,
+         src_in_offsets) = fn(x, topk_idx, topk_weights)
+        handle = LowLatencyHandle(
+            send_slot, weights, send_mat, recv_mat, regroup,
+            src_in_offsets, wire, wire_fp8,
+        )
+        return recv_x, counts, handle
+
+    def low_latency_combine(
+        self, expert_out: jax.Array, handle: LowLatencyHandle
+    ) -> jax.Array:
+        """expert_out: [W, R_max, H] group-major → [W, T, H]."""
+        key = (
+            "ll_combine", expert_out.shape, handle.send_slot.shape,
+            expert_out.dtype, handle.wire, handle.wire_fp8,
+        )
+
+        def f(y, send_slot, wts, send_mat, recv_mat, regroup, src_off):
+            state = ep_ll.LLState(
+                send_slot[0], wts[0], send_mat[0], recv_mat[0],
+                regroup[0], src_off[0], handle.wire,
+            )
+            out = ep_ll.ll_combine(
+                y[0], state, self._axis_name(), wire_fp8=handle.wire_fp8
+            )
+            return out[None]
+
+        fn = self._jit(key, f, (2, 2, 2, 2, 2, 1, 1), 2)
+        return fn(
+            expert_out, handle.send_slot, handle.weights, handle.send_mat,
+            handle.recv_mat, handle.regroup, handle.src_in_offsets,
+        )

@@ -2,22 +2,37 @@
 
 The reference's EP pillar spans hosts through its CPU proxies posting RDMA
 (ep/src/proxy.cpp:701, rdma.cpp:1554 — the dispatch/combine all-to-all runs
-over the NIC fabric between nodes). On TPU the intra-pod leg is
-compiler-driven ICI (`ep.ops` / `ep.Buffer`); this module adds the inter-pod
-leg over the DCN transfer engine: global experts are sharded across pods,
-tokens bucket by destination pod with the same sorted/capacity machinery the
-on-mesh path uses, payloads + routing metadata ride
-``DcnGroup.all_to_all`` (direct pairwise writes), each pod computes its own
-experts' contributions on its mesh, and the weighted partials return over
-the same exchange.
+over the NIC fabric between nodes, *inside torch autograd*: training fwd+bwd
+both cross the wire). On TPU the intra-pod leg is compiler-driven ICI
+(`ep.ops` / `ep.Buffer`); this module adds the inter-pod leg over the DCN
+transfer engine — training-grade:
+
+* **forward**: tokens bucket by destination pod (vectorized numpy — one
+  broadcasting pass, no Python loops over k), payloads + routing metadata
+  ride ``DcnGroup.all_to_all`` (direct pairwise writes), each pod computes
+  its own experts' contributions on its mesh, the weighted partials return
+  over the same exchange.
+* **backward**: the same two DCN exchanges in cotangent space —
+  ``backward(dout)`` ships per-slot output cotangents to the pods that
+  computed them, runs ``jax.vjp`` of the local expert compute on the saved
+  received buffers, and returns (d_x, d_topk_weights) to the source pods
+  while d_expert_weights stays where the experts live. Gradients match a
+  single-process oracle exactly (tests/test_ep.py).
+* **overlap**: ``n_chunks > 1`` pipelines the slot space — the exchange of
+  chunk c+1 overlaps the (asynchronously dispatched) expert compute of
+  chunk c, and the return exchange of chunk c overlaps compute of c+1; the
+  moral analog of the reference's proxy threads running ahead of the GPU
+  (proxy.cpp:701 drains rings while kernels run).
 
 Semantics: drop-and-renormalize like the on-mesh path, with capacity applied
 per (token, pod) bucket — a token reaching experts in ``p`` pods occupies
-``p`` slots. Every pod calls :meth:`CrossPodMoE.forward` collectively
-(SPMD across pods).
+``p`` slots. Every pod calls forward/backward collectively (SPMD across
+pods).
 """
 
 from __future__ import annotations
+
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +54,8 @@ class CrossPodMoE:
       num_global_experts: total experts; pod i owns the contiguous block
         ``[i*E/P, (i+1)*E/P)``.
       capacity_factor: per-(token, pod) bucketing slack.
+      n_chunks: slot-space pipelining depth (1 = no overlap; 2+ overlaps DCN
+        exchanges with expert compute).
     """
 
     def __init__(
@@ -49,6 +66,7 @@ class CrossPodMoE:
         num_global_experts: int,
         num_selected: int = 2,
         capacity_factor: float = 1.25,
+        n_chunks: int = 1,
     ):
         self.dcn = dcn
         self.mesh = mesh
@@ -61,38 +79,30 @@ class CrossPodMoE:
         self.experts_per_pod = num_global_experts // self.n_pods
         self.num_selected = num_selected
         self.capacity_factor = capacity_factor
+        self.n_chunks = max(1, int(n_chunks))
         self._compute_cache = {}
+        self._vjp_cache = {}
+        self._ctx = None
 
     # ------------------------------------------------------------------
     def _pod_capacity(self, t: int) -> int:
         # worst case every one of a token's K experts lives in one pod; the
         # expected per-pod demand is T*K/P, bucketed with slack
-        return max(
+        cap = max(
             1,
-            int(
-                self.capacity_factor
-                * t
-                * self.num_selected
-                / self.n_pods
-            ),
+            int(self.capacity_factor * t * self.num_selected / self.n_pods),
         )
+        # chunked pipelining slices the slot axis evenly
+        if cap % self.n_chunks:
+            cap += self.n_chunks - cap % self.n_chunks
+        return cap
 
-    def _local_compute(self, shape_key, expert_fn):
-        """Jitted per-pod expert compute over received foreign tokens.
-
-        xs: [S, H] slot payloads; idx: [S, K] LOCAL expert ids (-1 = not
-        ours/invalid); wts: [S, K]; warrs: the expert weight arrays (a jit
-        ARGUMENT, so updated weights are never baked in as stale constants).
-        Returns weighted partial sums [S, H].
-        """
-        cached = self._compute_cache.get(shape_key)
-        if cached is not None:
-            return cached
-
+    def _local_fn(self, expert_fn):
+        """The pure per-pod compute: (xs [S,H], idx [S,K] local ids with -1
+        invalid, wts [S,K], warrs) -> weighted partial sums [S,H]."""
         epp = self.experts_per_pod
 
         def f(xs, idx, wts, warrs):
-            # mask assignments that don't belong to this pod
             valid = (idx >= 0) & (idx < epp)
             safe_idx = jnp.where(valid, idx, 0)
             w = jnp.where(valid, wts, 0.0)
@@ -113,9 +123,95 @@ class CrossPodMoE:
             yk = jnp.take(out_e, slot, axis=0, mode="fill", fill_value=0)
             return jnp.einsum("sk,skh->sh", w, yk)
 
-        fn = jax.jit(f)
-        self._compute_cache[shape_key] = fn
-        return fn
+        return f
+
+    def _local_compute(self, shape_key, expert_fn):
+        cached = self._compute_cache.get(shape_key)
+        if cached is None:
+            cached = jax.jit(self._local_fn(expert_fn))
+            self._compute_cache[shape_key] = cached
+        return cached
+
+    def _local_vjp(self, shape_key, expert_fn):
+        """Jitted vjp of the local compute w.r.t. (xs, wts, warrs)."""
+        cached = self._vjp_cache.get(shape_key)
+        if cached is None:
+            f = self._local_fn(expert_fn)
+
+            def g(xs, idx, wts, warrs, ct):
+                _, vjp = jax.vjp(
+                    lambda a, w_, ww: f(a, idx, w_, ww), xs, wts, warrs
+                )
+                return vjp(ct)
+
+            cached = jax.jit(g)
+            self._vjp_cache[shape_key] = cached
+        return cached
+
+    # ------------------------------------------------------------------
+    def _bucket(self, x, topk_idx, topk_weights):
+        """Vectorized host bucketing: slots, payload, per-slot metadata.
+
+        Returns (tfs [P*cap], valid_slot, safe_tfs, hits [P*cap, K],
+        meta_idx, meta_w, payload [P*cap, H])."""
+        t, h = x.shape
+        k = topk_idx.shape[-1]
+        n_pods = self.n_pods
+        cap = self._pod_capacity(t)
+        epp = self.experts_per_pod
+
+        pod_of = topk_idx // epp  # [T, K]
+        # dedup (token, pod): keep the FIRST k hitting each pod — one
+        # broadcasting compare against earlier k-slots, no Python loop
+        eq = pod_of[:, :, None] == pod_of[:, None, :]  # [T, K, K]
+        dup = np.tril(eq, -1).any(axis=-1)  # [T, K] matches an earlier k
+        coarse = np.where(~dup, pod_of, n_pods)  # sentinel: no slot
+        tfs, _slot, _ = (
+            np.asarray(a)
+            for a in ep_ops.sorted_from_topk(
+                jnp.asarray(coarse), n_pods + 1, cap
+            )
+        )
+        tfs = tfs[: n_pods * cap]  # drop the sentinel bucket
+
+        valid_slot = tfs < t
+        safe_tfs = np.where(valid_slot, tfs, 0)
+        payload = np.where(valid_slot[:, None], x[safe_tfs], 0).astype(
+            np.float32
+        )
+        slot_pod = np.repeat(np.arange(n_pods), cap)  # [P*cap]
+        # hits[s, j]: assignment (token(s), j) targets slot s's pod
+        hits = valid_slot[:, None] & (pod_of[safe_tfs] == slot_pod[:, None])
+        meta_idx = np.where(hits, topk_idx[safe_tfs] % epp, -1).astype(
+            np.int32
+        )
+        meta_w = np.where(hits, topk_weights[safe_tfs], 0.0).astype(
+            np.float32
+        )
+        return tfs, valid_slot, safe_tfs, hits, meta_idx, meta_w, payload
+
+    def _chunked_exchange_compute(self, wire, fn_args_builder, fn):
+        """Pipelined: all_to_all chunk c, dispatch compute c asynchronously
+        (jax dispatch returns before the device finishes), exchange c+1
+        while c computes, then return-exchange each chunk's result as it
+        resolves. wire: [P, cap, D]. Returns [P*cap, H] numpy."""
+        n_pods, cap = wire.shape[0], wire.shape[1]
+        cs = cap // self.n_chunks
+        partials = []
+        for c in range(self.n_chunks):
+            sl = slice(c * cs, (c + 1) * cs)
+            recv = self.dcn.all_to_all(np.ascontiguousarray(wire[:, sl]))
+            partials.append(fn(*fn_args_builder(recv)))  # async dispatch
+        backs = []
+        for c in range(self.n_chunks):
+            part = np.asarray(partials[c])  # blocks on chunk c only
+            h = part.shape[-1]
+            backs.append(
+                self.dcn.all_to_all(
+                    np.ascontiguousarray(part.reshape(n_pods, cs, h))
+                )
+            )
+        return np.concatenate(backs, axis=1).reshape(n_pods * cap, -1)
 
     # ------------------------------------------------------------------
     def forward(
@@ -124,13 +220,15 @@ class CrossPodMoE:
         topk_idx: np.ndarray,
         topk_weights: np.ndarray,
         expert_weights,
+        *,
+        save_for_backward: bool = True,
     ) -> np.ndarray:
         """x: [T, H] host tokens; topk_idx: [T, K] GLOBAL expert ids;
         topk_weights: [T, K]. ``expert_weights`` is a dict with ``"fn"``:
-        ``(buf [epp, cap, H], weights) -> [epp, cap, H]`` computing every
+        ``(buf [epp, cap, H], warrs) -> [epp, cap, H]`` computing every
         local expert on its bucketed tokens (plus whatever arrays fn needs).
-        Returns [T, H].
-        """
+        Returns [T, H]. With save_for_backward, :meth:`backward` afterwards
+        produces exact gradients."""
         t, h = x.shape
         k = topk_idx.shape[-1]
         if k != self.num_selected:
@@ -140,75 +238,112 @@ class CrossPodMoE:
             )
         n_pods = self.n_pods
         cap = self._pod_capacity(t)
-        epp = self.experts_per_pod
 
-        # 1) bucket (token, k) assignments by destination pod — same sorted
-        #    machinery as on-mesh dispatch, with pod id as the coarse expert.
-        #    A token with multiple experts in ONE pod occupies one slot per
-        #    distinct (token, pod... k) assignment; dedup to (token, pod)
-        #    pairs so its payload travels once per pod.
-        pod_of = topk_idx // epp  # [T, K]
-        # dedup: keep the FIRST k hitting each (token, pod); later ks merge
-        # their expert ids into the same slot's metadata below.
-        first_hit = np.ones_like(pod_of, dtype=bool)
-        for j in range(1, k):
-            for jj in range(j):
-                first_hit[:, j] &= pod_of[:, j] != pod_of[:, jj]
-        coarse = np.where(first_hit, pod_of, n_pods)  # sentinel: no slot
-        tfs, slot, _ = (
-            np.asarray(a)
-            for a in ep_ops.sorted_from_topk(
-                jnp.asarray(coarse), n_pods + 1, cap
-            )
+        tfs, valid_slot, safe_tfs, hits, meta_idx, meta_w, payload = (
+            self._bucket(x, topk_idx, topk_weights)
         )
-        # drop the sentinel bucket
-        tfs = tfs[: n_pods * cap]
 
-        # 2) build the wire arrays: payload + per-slot (local idx, weight)
-        #    metadata for EVERY k of the slot's token that targets that pod.
-        valid_slot = tfs < t
-        safe_tfs = np.where(valid_slot, tfs, 0)
-        payload = np.where(valid_slot[:, None], x[safe_tfs], 0).astype(
-            np.float32
-        )  # [P*cap, H]
-        slot_pod = np.repeat(np.arange(n_pods), cap)  # [P*cap]
-        tok_idx = np.where(valid_slot, safe_tfs, -1)
-        meta_idx = np.full((n_pods * cap, k), -1, np.int32)
-        meta_w = np.zeros((n_pods * cap, k), np.float32)
-        for j in range(k):
-            hits = valid_slot & (pod_of[safe_tfs, j] == slot_pod) & (
-                tok_idx >= 0
-            )
-            meta_idx[hits, j] = (topk_idx[safe_tfs, j] % epp)[hits]
-            meta_w[hits, j] = topk_weights[safe_tfs, j][hits]
-
-        # 3) DCN exchange (direct pairwise writes): rows bucket by dest pod
+        # wire rows: payload + (local idx, weight) metadata per k
         wire = np.concatenate(
             [payload, meta_idx.astype(np.float32), meta_w], axis=1
         ).reshape(n_pods, cap, h + 2 * k)
-        recv = self.dcn.all_to_all(wire)  # [P, cap, H+2K], row i from pod i
 
-        # 4) local expert compute on this pod's mesh: slots shard over the
-        #    first mesh axis when divisible (data-parallel expert compute
-        #    with replicated weights), else run replicated
-        flat = recv.reshape(n_pods * cap, h + 2 * k)
-        ax0 = next(iter(self.mesh.shape))
-        n_slots = n_pods * cap
-        spec = P(ax0) if n_slots % self.mesh.shape[ax0] == 0 else P()
-        sharding = NamedSharding(self.mesh, spec)
-        xs = jax.device_put(jnp.asarray(flat[:, :h]), sharding)
-        idx_r = jax.device_put(
-            jnp.asarray(flat[:, h : h + k].astype(np.int32)), sharding
-        )
-        w_r = jax.device_put(jnp.asarray(flat[:, h + k :]), sharding)
         warrs = {kk: v for kk, v in expert_weights.items() if kk != "fn"}
-        fn = self._local_compute((xs.shape, k), expert_weights["fn"])
-        partial = np.asarray(fn(xs, idx_r, w_r, warrs))  # [P*cap, H]
+        cs = cap // self.n_chunks
+        shape_key = ((n_pods * cs, h), k)
+        fn = self._local_compute(shape_key, expert_weights["fn"])
+        sharding = self._slot_sharding(n_pods * cs)
+        recvs = []
 
-        # 5) return partials to their source pods + combine by slot map
-        back = self.dcn.all_to_all(
-            partial.reshape(n_pods, cap, h)
-        ).reshape(n_pods * cap, h)
+        def build_args(recv):
+            flat = recv.reshape(-1, h + 2 * k)
+            xs = jax.device_put(jnp.asarray(flat[:, :h]), sharding)
+            idx_r = jax.device_put(
+                jnp.asarray(flat[:, h:h + k].astype(np.int32)), sharding
+            )
+            w_r = jax.device_put(jnp.asarray(flat[:, h + k:]), sharding)
+            recvs.append((xs, idx_r, w_r))
+            return xs, idx_r, w_r, warrs
+
+        back = self._chunked_exchange_compute(wire, build_args, fn)
+
         out = np.zeros((t, h), np.float32)
         np.add.at(out, safe_tfs[valid_slot], back[valid_slot])
+
+        if save_for_backward:
+            self._ctx = dict(
+                t=t, h=h, k=k, cap=cap, recvs=recvs, hits=hits,
+                valid_slot=valid_slot, safe_tfs=safe_tfs,
+                expert_fn=expert_weights["fn"], warrs=warrs,
+                shape_key=shape_key,
+            )
         return out
+
+    def backward(self, dout: np.ndarray):
+        """Cotangent pass: dout [T, H] → (d_x [T, H], d_topk_weights [T, K],
+        d_expert_weights dict). Runs the same two DCN exchanges as forward,
+        in cotangent space; every pod calls it collectively."""
+        ctx = self._ctx
+        if ctx is None:
+            raise RuntimeError("backward() without a saved forward")
+        t, h, k, cap = ctx["t"], ctx["h"], ctx["k"], ctx["cap"]
+        n_pods = self.n_pods
+        valid_slot, safe_tfs = ctx["valid_slot"], ctx["safe_tfs"]
+        cs = cap // self.n_chunks
+
+        # leg 1 (cotangent of the partial-return exchange): each slot's
+        # output cotangent is dout at its source token; ship to the pod
+        # that computed that slot's partial
+        dpart = np.where(
+            valid_slot[:, None], dout[safe_tfs], 0.0
+        ).astype(np.float32).reshape(n_pods, cap, h)
+
+        vjp_fn = self._local_vjp(ctx["shape_key"], ctx["expert_fn"])
+        warrs = ctx["warrs"]
+        d_warrs_acc = None
+        outs = []
+        chunk_i = [0]
+
+        def build_args(recv_ct):
+            xs, idx_r, w_r = ctx["recvs"][chunk_i[0]]
+            chunk_i[0] += 1
+            ct = jnp.asarray(recv_ct.reshape(-1, h))
+            return xs, idx_r, w_r, warrs, ct
+
+        # local vjp returns (dxs, dwts, dwarrs); the wire carries dxs+dwts,
+        # dwarrs stays on this pod (experts live here)
+        def fn(xs, idx_r, w_r, warrs_, ct):
+            dxs, dwts, dwarrs = vjp_fn(xs, idx_r, w_r, warrs_, ct)
+            outs.append(dwarrs)
+            return jnp.concatenate([dxs, dwts.astype(dxs.dtype)], axis=1)
+
+        back = self._chunked_exchange_compute(dpart, build_args, fn)
+        for dwarrs in outs:
+            dwarrs = jax.tree.map(np.asarray, dwarrs)
+            if d_warrs_acc is None:
+                d_warrs_acc = dwarrs
+            else:
+                d_warrs_acc = jax.tree.map(np.add, d_warrs_acc, dwarrs)
+
+        dxs_back = back[:, :h]
+        dwts_back = back[:, h:]
+
+        d_x = np.zeros((t, h), np.float32)
+        np.add.at(d_x, safe_tfs[valid_slot], dxs_back[valid_slot])
+        d_w = np.zeros((t, k), np.float32)
+        hits = ctx["hits"]  # [P*cap, K]
+        rows = np.repeat(safe_tfs, k).reshape(-1, k)
+        np.add.at(
+            d_w,
+            (rows[hits], np.broadcast_to(np.arange(k), hits.shape)[hits]),
+            dwts_back[hits],
+        )
+        return d_x, d_w, d_warrs_acc
+
+    # ------------------------------------------------------------------
+    def _slot_sharding(self, n_slots: int) -> NamedSharding:
+        """Slots shard over the first mesh axis when divisible (data-parallel
+        expert compute with replicated weights), else run replicated."""
+        ax0 = next(iter(self.mesh.shape))
+        spec = P(ax0) if n_slots % self.mesh.shape[ax0] == 0 else P()
+        return NamedSharding(self.mesh, spec)

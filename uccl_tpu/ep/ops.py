@@ -343,13 +343,25 @@ def moe_ffn(
 
     x: [T, H]; router_logits: [T, E]; expert weights are the *local* shard:
     w_gate/w_up: [E_local, H, F], w_down: [E_local, F, H].
-    impl: "sort" (ragged fast path, default) or "dense" (mask-einsum oracle).
+    impl: "sort" (ragged fast path, default), "dense" (mask-einsum oracle),
+    or "ll" (packed low-latency path: grouped GEMMs over receive counts, no
+    padded FLOPs — :mod:`uccl_tpu.ep.ll`; capacity_factor maps to its
+    pair_capacity_factor bound).
     Returns (out [T, H], aux_loss, z_loss).
     """
     t, h = x.shape
     e = router_logits.shape[-1]
     w = lax.axis_size(axis)
     capacity = max(1, int(capacity_factor * t * num_selected / e))
+    if impl == "ll":
+        from uccl_tpu.ep.ll import ll_moe_ffn
+
+        return ll_moe_ffn(
+            x, router_logits, w_gate, w_up, w_down, axis,
+            num_selected=num_selected,
+            pair_capacity_factor=capacity_factor,
+            wire_fp8=wire_fp8,
+        )
     if impl == "sort":
         rs = route_topk_sorted(router_logits, num_selected, capacity)
         xe = dispatch_sorted(
@@ -361,7 +373,9 @@ def moe_ffn(
         xe = dispatch(x, r.dispatch_mask, axis, wire_fp8=wire_fp8)
         aux_loss, z_loss = r.aux_loss, r.z_loss
     else:
-        raise ValueError(f"unknown moe impl {impl!r} (want 'sort' or 'dense')")
+        raise ValueError(
+            f"unknown moe impl {impl!r} (want 'sort', 'dense', or 'll')"
+        )
     act = jax.nn.silu(jnp.einsum("ebh,ehf->ebf", xe, w_gate)) * jnp.einsum(
         "ebh,ehf->ebf", xe, w_up
     )

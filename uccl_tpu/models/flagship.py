@@ -59,7 +59,8 @@ class FlagshipConfig:
     pp_schedule: str = "gpipe"  # "gpipe" (autodiff+remat) | "1f1b" (manual)
     seq_mode: str = "ring"  # "ring" | "ulysses"
     attn_impl: str = "auto"  # "auto" | "flash" | "xla": kernel when cp == 1
-    moe_impl: str = "sort"  # "sort" (ragged fast path) | "dense" (mask oracle)
+    moe_impl: str = "sort"  # "sort" (ragged) | "dense" (oracle) | "ll" (packed
+    # grouped-GEMM path, no padded FLOPs — ep/ll.py)
     wire_fp8: bool = False
     dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
 
@@ -295,18 +296,17 @@ def _per_shard_manual_grads(params, tokens, targets, cfg: FlagshipConfig):
     """Per-shard (total, ce, grads) on the manual 1F1B schedule. Gradient
     semantics match autodiff-of-pmean(loss over dp×cp): per-member partials,
     psum over each leaf's replicated axes, divided by the EP world."""
-    if lax.axis_size(AXIS.CP) != 1:
-        # Ring/Ulysses CP rotate KV via lax.ppermute inside the stage, and
-        # ppermute's TRANSPOSE inside the manual schedule's per-slot cond
-        # silently zeroes cotangents under check_vma=False (psum and
-        # all_to_all transpose correctly; ppermute does not — verified by
-        # bisection). Until the attention stack is vma-annotated end to end,
-        # manual schedules require the cp axis to be trivial.
-        raise NotImplementedError(
-            "pp_schedule='1f1b' requires cp=1: context-parallel attention's "
-            "ppermute does not transpose correctly inside the manual "
-            "schedule (use pp_schedule='gpipe' with cp>1)"
-        )
+    # Ring/Ulysses CP rotate KV via lax.ppermute inside the stage. XLA's
+    # collective-permute has no replica groups (its source-target pairs are
+    # global), so a ppermute inside the schedule's per-slot lax.cond would
+    # deadlock: members on stages whose predicate is false never post their
+    # sends (root-caused round 3 — the round-2 "zeroed cotangents" were this
+    # same unmatched-collective unsoundness). psum/all_to_all are safe under
+    # cond because their replica groups never cross pp. Fix: run the
+    # schedule in uniform (select-not-branch) mode whenever cp > 1 — the
+    # same discipline gpipe_spmd always uses — at ~(P-1)/M extra masked
+    # compute on the ramp slots.
+    uniform = lax.axis_size(AXIS.CP) != 1
     b_loc, s_loc = tokens.shape
     m = cfg.n_microbatches
     if b_loc % m:
@@ -346,7 +346,7 @@ def _per_shard_manual_grads(params, tokens, targets, cfg: FlagshipConfig):
     }
     total, ce, dblocks, dlp, dxmb = pipeline_train(
         stage_fn, loss_head, params["blocks"], loss_params, xmb, tmb,
-        AXIS.PP, aux_weight=1.0 / (cfg.n_layers * m),
+        AXIS.PP, aux_weight=1.0 / (cfg.n_layers * m), uniform=uniform,
     )
     (d_embed,) = embed_vjp(dxmb.reshape(b_loc, s_loc, cfg.dim).astype(x.dtype))
 

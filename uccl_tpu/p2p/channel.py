@@ -305,25 +305,28 @@ class Channel:
 
     def write_compressed(
         self, src: np.ndarray, fifo: bytes, timeout_ms: int = 60000,
-        group: int = 128,
+        group: int = 128, codec: str = "fp8",
     ) -> int:
-        """fp8-compress `src` and spray the blob (reference: DietGPU wire
-        compression on the P2P path, p2p/rdma/compression.h:46). The window
-        owner decodes with :func:`Channel.decode` (blobs self-describe);
-        size the window with ``compress.compressed_bound``. Returns the blob
-        byte count (for measuring the wire ratio)."""
-        from uccl_tpu.p2p.compress import encode_fp8
+        """Compress `src` and spray the blob (reference: DietGPU wire
+        compression on the P2P path, p2p/rdma/compression.h:46). codec:
+        "fp8" (lossy, ~3.8x) or "lossless" (exact, byte-plane + native rANS —
+        the DietGPU-faithful mode). The window owner decodes with
+        :func:`Channel.decode` (blobs self-describe); size the window with
+        ``compress.compressed_bound`` (fp8) or raw nbytes + 16 KiB slack
+        (lossless). Returns the blob byte count (for the wire ratio)."""
+        from uccl_tpu.p2p.compress import encode
 
-        blob = encode_fp8(src, group)
+        blob = encode(src, codec, group)
         self.write(blob, fifo, timeout_ms)
         return int(blob.nbytes)
 
     @staticmethod
     def decode(window: np.ndarray) -> np.ndarray:
-        """Decode a compressed blob previously landed in a window."""
-        from uccl_tpu.p2p.compress import decode_fp8
+        """Decode a compressed blob previously landed in a window (either
+        codec; routed by magic)."""
+        from uccl_tpu.p2p.compress import decode_any
 
-        return decode_fp8(window)
+        return decode_any(window)
 
     def read(self, dst: np.ndarray, fifo: bytes, timeout_ms: int = 60000) -> None:
         """Chunked multipath one-sided read into `dst`."""

@@ -45,6 +45,7 @@ def _build_if_needed() -> str:
         os.path.join(_NATIVE_DIR, "src", "engine.cc"),
         os.path.join(_NATIVE_DIR, "src", "c_api.cc"),
         os.path.join(_NATIVE_DIR, "src", "net_plugin.cc"),
+        os.path.join(_NATIVE_DIR, "src", "float_codec.cc"),
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "engine.h"),
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "net_plugin.h"),
         os.path.join(_NATIVE_DIR, "include", "uccl_tpu", "ring.h"),

@@ -1,0 +1,239 @@
+"""Lossless float compression for the DCN wire: exponent planes + native rANS.
+
+The honest analog of the reference's DietGPU integration
+(p2p/rdma/compression.h:46; thirdparty/dietgpu): DietGPU is a *lossless* ANS
+float codec that splits each float into an exponent part (low entropy in real
+tensors — neighboring weights share scale) and a sign+mantissa part
+(near-random), entropy-coding only what compresses. For RL weight transfer —
+a headline reference use case (README.md:18) — lossy fp8 is not a substitute,
+so this codec rides next to :mod:`uccl_tpu.p2p.compress`'s fp8 path under
+``compress="lossless"``.
+
+Scheme (host-side; the DCN wire is host-owned on TPU pods):
+
+1. **Rotate the sign bit to the LSB** (``v' = rotl(v, 1)``). For every IEEE
+   width this lands the full exponent in the TOP byte with no sign pollution
+   — the sign is ~1 random bit and would otherwise double the top plane's
+   alphabet (measured: bf16 plane ratio 2.6x with sign vs 3.14x without).
+2. **Byte-plane split** of the rotated values (transpose of the
+   [elems, itemsize] uint8 view).
+3. **Per-plane entropy coding** with the native order-0 rANS coder
+   (native/src/float_codec.cc, within ~0.2% of order-0 entropy; zlib
+   fallback when the native runtime is unavailable), keeping the coded form
+   only when it actually shrank — mantissa planes of trained weights are
+   incompressible and ship raw, exactly DietGPU's split-and-skip strategy.
+
+Measured on weight-like bf16 (σ=0.02): ~1.52x, the order-0 information
+bound for that distribution (sign+7 mantissa bits are irreducible; the
+8-bit exponent plane carries ~2.5 bits). Low-entropy tensors (norm gains,
+biases, embeddings, sparse grads) compress far harder.
+
+Blobs are self-describing and tagged with a distinct magic, so
+:func:`uccl_tpu.p2p.compress.decode_any` can route fp8 and lossless blobs
+off the same wire.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+import zlib
+from typing import Optional
+
+import ml_dtypes
+import numpy as np
+
+from uccl_tpu.utils.config import param
+from uccl_tpu.utils.logging import get_logger
+
+_log = get_logger("P2P")
+
+_use_native = param(
+    "lossless_native", 1,
+    help="use the native rANS coder for lossless planes (0 = zlib only)",
+)
+
+MAGIC = 0x55434C5A  # "UCLZ"
+_HDR = struct.Struct("<IBBBBQ")  # magic, ver, dtype, ndim, itemsize, elems
+
+_FLOATS = {
+    np.dtype(np.float32),
+    np.dtype(ml_dtypes.bfloat16),
+    np.dtype(np.float16),
+    np.dtype(np.float64),
+}
+_DTYPES = {
+    0: np.dtype(np.float32),
+    1: np.dtype(ml_dtypes.bfloat16),
+    2: np.dtype(np.float16),
+    3: np.dtype(np.float64),
+    4: np.dtype(np.int32),
+    5: np.dtype(np.int8),
+    6: np.dtype(np.uint8),
+    7: np.dtype(np.int64),
+}
+_CODES = {v: k for k, v in _DTYPES.items()}
+_UINT = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+# plane coding tags
+_RAW, _RANS, _ZLIB = 0, 1, 2
+
+_codec_lib = None
+
+
+def _native():
+    """The rANS coder from the native runtime, or None (zlib fallback)."""
+    global _codec_lib
+    if not int(_use_native.get()):
+        return None
+    if _codec_lib is None:
+        try:
+            from uccl_tpu.p2p.endpoint import _build_if_needed
+
+            lib = ctypes.CDLL(_build_if_needed())
+            lib.ucclt_codec_encode.restype = ctypes.c_int64
+            lib.ucclt_codec_encode.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64,
+            ]
+            lib.ucclt_codec_decode.restype = ctypes.c_int64
+            lib.ucclt_codec_decode.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64,
+            ]
+            _codec_lib = lib
+        except Exception as e:  # no toolchain: stay pure-python
+            _log.info("native codec unavailable (%s); zlib fallback", e)
+            _codec_lib = False
+    return _codec_lib or None
+
+
+def compressible(arr: np.ndarray) -> bool:
+    return arr.dtype in _CODES
+
+
+def _rotl1(flat: np.ndarray) -> np.ndarray:
+    """Rotate each element left by one bit (sign -> LSB)."""
+    u = _UINT[flat.dtype.itemsize]
+    bits = flat.dtype.itemsize * 8
+    v = flat.view(u)
+    return ((v << u(1)) | (v >> u(bits - 1))).astype(u)
+
+
+def _rotr1(v: np.ndarray, itemsize: int) -> np.ndarray:
+    u = _UINT[itemsize]
+    bits = itemsize * 8
+    return ((v >> u(1)) | (v << u(bits - 1))).astype(u)
+
+
+def _encode_plane(plane: bytes) -> tuple[int, bytes]:
+    n = len(plane)
+    buf = np.frombuffer(plane, np.uint8)
+    if n >= 64:
+        # order-0 entropy estimate first: mantissa planes are ~8 bits/byte
+        # and coding them would waste a full pass to learn they ship raw
+        # (DietGPU's split strategy decides this statically per float part)
+        counts = np.bincount(buf, minlength=256)
+        p = counts[counts > 0] / n
+        est = n * float(-(p * np.log2(p)).sum()) / 8.0 + 522
+        if est >= n * 0.98:
+            return _RAW, plane
+    lib = _native()
+    if lib is not None and n >= 64:
+        out = np.empty(n, np.uint8)  # beyond raw size = not worth it
+        m = lib.ucclt_codec_encode(
+            buf.ctypes.data_as(ctypes.c_void_p), n,
+            out.ctypes.data_as(ctypes.c_void_p), out.nbytes,
+        )
+        if 0 < m < n:
+            return _RANS, out[:m].tobytes()
+    coded = zlib.compress(plane, 1)
+    if len(coded) < n:
+        return _ZLIB, coded
+    return _RAW, plane
+
+
+def _decode_plane(tag: int, data: bytes, n: int) -> bytes:
+    if tag == _RAW:
+        return data
+    if tag == _ZLIB:
+        return zlib.decompress(data)
+    if tag == _RANS:
+        lib = _native()
+        if lib is None:
+            raise RuntimeError(
+                "blob has rANS planes but the native codec is unavailable"
+            )
+        src = np.frombuffer(data, np.uint8)
+        out = np.empty(n, np.uint8)
+        r = lib.ucclt_codec_decode(
+            src.ctypes.data_as(ctypes.c_void_p), len(data),
+            out.ctypes.data_as(ctypes.c_void_p), n,
+        )
+        if r != n:
+            raise ValueError("corrupt rANS plane")
+        return out.tobytes()
+    raise ValueError(f"unknown plane tag {tag}")
+
+
+def encode_lossless(arr: np.ndarray) -> np.ndarray:
+    """Encode an array into a self-describing uint8 blob, bit-exactly."""
+    if arr.dtype not in _CODES:
+        raise TypeError(f"cannot lossless-compress dtype {arr.dtype}")
+    if arr.ndim > 255:
+        raise ValueError("too many dimensions")
+    itemsize = arr.dtype.itemsize
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    elems = flat.size
+    if itemsize == 1:
+        planes = [flat.view(np.uint8)]
+    else:
+        v = _rotl1(flat) if arr.dtype in _FLOATS else flat.view(
+            _UINT[itemsize]
+        )
+        raw = v.view(np.uint8).reshape(elems, itemsize)
+        planes = [np.ascontiguousarray(raw[:, b]) for b in range(itemsize)]
+    parts, meta = [], []
+    for p in planes:
+        tag, data = _encode_plane(p.tobytes())
+        meta.append((tag, len(data)))
+        parts.append(data)
+    hdr = _HDR.pack(MAGIC, 1, _CODES[arr.dtype], arr.ndim, itemsize, elems)
+    shape = np.asarray(arr.shape, np.uint64).tobytes()
+    metab = b"".join(struct.pack("<BQ", t, n) for t, n in meta)
+    return np.frombuffer(hdr + shape + metab + b"".join(parts), np.uint8).copy()
+
+
+def decode_lossless(blob) -> np.ndarray:
+    """Exact inverse of :func:`encode_lossless` (bit-identical round trip)."""
+    buf = bytes(memoryview(np.ascontiguousarray(np.asarray(blob, np.uint8))))
+    if len(buf) < _HDR.size:
+        raise ValueError("blob shorter than header")
+    magic, ver, dcode, ndim, itemsize, elems = _HDR.unpack_from(buf, 0)
+    if magic != MAGIC or ver != 1 or dcode not in _DTYPES:
+        raise ValueError("not a lossless wire blob")
+    off = _HDR.size
+    shape = tuple(np.frombuffer(buf, np.uint64, ndim, off).astype(int))
+    off += 8 * ndim
+    meta = []
+    for _ in range(itemsize):
+        t, n = struct.unpack_from("<BQ", buf, off)
+        meta.append((t, n))
+        off += 9
+    raw = np.empty((elems, itemsize), np.uint8)
+    for b, (tag, n) in enumerate(meta):
+        plane = _decode_plane(tag, buf[off:off + n], elems)
+        off += n
+        raw[:, b] = np.frombuffer(plane, np.uint8, elems)
+    dtype = _DTYPES[dcode]
+    if itemsize == 1:
+        return raw.reshape(-1).view(dtype)[:elems].reshape(shape)
+    v = raw.reshape(-1).view(_UINT[itemsize])[:elems]
+    if dtype in _FLOATS:
+        v = _rotr1(v, itemsize)
+    return v.view(dtype).reshape(shape)
+
+
+def ratio(arr: np.ndarray) -> float:
+    """Measured compression ratio on one array (for benchmarks/tests)."""
+    return arr.nbytes / float(encode_lossless(arr).nbytes)

@@ -576,6 +576,7 @@ def pipeline_train(
     ymb,
     axis: str = "pp",
     aux_weight: float = 1.0,
+    uniform: bool = False,
 ):
     """Manual 1F1B training step with boundary gradients (in shard_map).
 
@@ -593,6 +594,17 @@ def pipeline_train(
       ymb: per-microbatch loss targets, a pytree with leading dim M
         (labels, target logits, masks, ...), replicated across members.
       aux_weight: weight of the summed aux losses in the total.
+      uniform: run every slot's forward/backward on every member and mask the
+        results, instead of gating them behind ``lax.cond``. REQUIRED when
+        stage_fn contains collectives without replica groups — ``ppermute``
+        (ring-attention CP): XLA lowers collective-permute with *global*
+        source-target pairs, so members on stages whose cond predicate is
+        false never post their sends and the matched members deadlock (or
+        read garbage on fabrics with static schedules). psum/all_to_all are
+        safe under cond because their replica groups never cross the pp axis.
+        Uniform mode is the same select-not-branch discipline
+        :func:`gpipe_spmd` uses; it costs ~(P-1)/M extra compute (idle ramp
+        slots run masked work instead of skipping).
 
     Returns ``(total, loss, dparams, d_loss_params, d_xmb)``:
       total — loss + aux_weight * sum(aux), replicated over pp;
@@ -648,15 +660,27 @@ def pipeline_train(
                 ),
             )
             y, aux = stage_fn(params, x)
-            st = lax.dynamic_update_index_in_dim(stash, x, f_mb % slots,
-                                                 axis=0)
+            st_idx = f_mb % slots
+            cur_st = lax.dynamic_index_in_dim(
+                stash, st_idx, axis=0, keepdims=False
+            )
+            st = lax.dynamic_update_index_in_dim(
+                stash, jnp.where(do_f == 1, x, cur_st), st_idx, axis=0
+            )
             return y, st, aux.astype(jnp.float32)
 
-        y_out, stash, aux_step = lax.cond(
-            do_f == 1, fwd,
-            lambda _: (zeros_mb, stash, jnp.zeros((), jnp.float32)),
-            None,
-        )
+        if uniform:
+            # select-not-branch: the stage (and any ppermute inside it) runs
+            # on every member every slot; tables only gate what is kept
+            y_raw, stash, aux_raw = fwd(None)
+            y_out = jnp.where(do_f == 1, y_raw, zeros_mb)
+            aux_step = jnp.where(do_f == 1, aux_raw, 0.0)
+        else:
+            y_out, stash, aux_step = lax.cond(
+                do_f == 1, fwd,
+                lambda _: (zeros_mb, stash, jnp.zeros((), jnp.float32)),
+                None,
+            )
         aux_acc = aux_acc + aux_step
 
         def bwd(_):
@@ -690,12 +714,24 @@ def pipeline_train(
             return dp, dx, g_lp, lval
 
         zero_dp = jax.tree.map(jnp.zeros_like, params)
-        dp, dx_out, g_lp, lval = lax.cond(
-            do_b == 1,
-            bwd,
-            lambda _: (zero_dp, zeros_mb, zero_lp, jnp.float32(0.0)),
-            None,
-        )
+        if uniform:
+            dp_raw, dx_raw, g_lp_raw, lval_raw = bwd(None)
+            on = do_b == 1
+            dp = jax.tree.map(
+                lambda a: jnp.where(on, a, jnp.zeros_like(a)), dp_raw
+            )
+            dx_out = jnp.where(on, dx_raw, zeros_mb)
+            g_lp = jax.tree.map(
+                lambda a: jnp.where(on, a, jnp.zeros_like(a)), g_lp_raw
+            )
+            lval = jnp.where(on, lval_raw, 0.0)
+        else:
+            dp, dx_out, g_lp, lval = lax.cond(
+                do_b == 1,
+                bwd,
+                lambda _: (zero_dp, zeros_mb, zero_lp, jnp.float32(0.0)),
+                None,
+            )
         dparams = jax.tree.map(jnp.add, dparams, dp)
         dlp = jax.tree.map(jnp.add, dlp, g_lp)
         loss_acc = loss_acc + lval

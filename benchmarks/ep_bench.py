@@ -36,8 +36,8 @@ def jax_block(tree):
     import numpy as np
 
     leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "block_until_ready")]
-    if leaves:
-        np.asarray(leaves[0]).reshape(-1)[:1]  # host read: real sync
+    for x in leaves:  # host-read EVERY leaf: tunnel's block_until_ready lies
+        np.asarray(x).reshape(-1)[:1]
 
 
 def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8):

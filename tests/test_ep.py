@@ -503,3 +503,39 @@ class TestCrossPodTraining:
             np.testing.assert_allclose(
                 r1[p][0], r2[p][0], rtol=1e-5, atol=1e-6
             )
+
+
+class TestCrossPodCaches:
+    def test_cache_keys_include_expert_fn_identity(self):
+        """Same shapes + a different expert_fn must not reuse the stale
+        jitted closure (the caches close over expert_fn)."""
+        from uccl_tpu.ep.cross_pod import CrossPodMoE
+
+        moe = object.__new__(CrossPodMoE)
+        moe.experts_per_pod = 2
+        moe._compute_cache = {}
+        moe._vjp_cache = {}
+
+        def fn_a(buf, w):
+            return buf * 2.0
+
+        def fn_b(buf, w):
+            return buf * 3.0
+
+        shape_key = ((4, 8), 2)
+        fa = moe._local_compute(shape_key, fn_a)
+        fb = moe._local_compute(shape_key, fn_b)
+        assert fa is not fb
+
+        xs = jnp.ones((4, 8), jnp.float32)
+        idx = np.zeros((4, 2), np.int32)
+        idx[:, 1] = 1
+        wts = jnp.full((4, 2), 0.5, jnp.float32)
+        ya = np.asarray(fa(xs, jnp.asarray(idx), wts, {}))
+        yb = np.asarray(fb(xs, jnp.asarray(idx), wts, {}))
+        assert not np.allclose(ya, yb)
+        np.testing.assert_allclose(yb, ya * 1.5, rtol=1e-6)
+
+        va = moe._local_vjp(shape_key, fn_a)
+        vb = moe._local_vjp(shape_key, fn_b)
+        assert va is not vb

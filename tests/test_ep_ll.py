@@ -411,3 +411,55 @@ class TestBufferContract:
                                        pair_capacity_factor=1.25)
         assert per_cf < per_lossless
         assert per_cf == -(-int(1.25 * 256 * 4) // 8)
+
+
+class TestNoExpertIds:
+    """topk_idx == -1 means "no expert" (DeepEP contract,
+    ep/bench/buffer.py:285): such assignments claim no wire slot, do not
+    perturb other tokens' packing, and combine to zero."""
+
+    def test_counts_and_roundtrip_with_minus_one(self, epmesh):
+        e, t, h, k = 8, 16, 32, 2
+        x, idx, wts = _make_case(t=t, h=h, e=e, k=k)
+        rng = np.random.default_rng(3)
+        drop = rng.random((W, t, k)) < 0.3
+        idx_m = np.where(drop, -1, idx).astype(np.int32)
+
+        def f(xv, iv, wv):
+            r = ep_ll.ll_dispatch(
+                xv[0], iv[0], wv[0], e, "ep", wire="dense", wire_fp8=False
+            )
+            # identity experts: combine = per-token weighted sums of x
+            out = ep_ll.ll_combine(r.recv_x, r.state, "ep", wire_fp8=False)
+            return out[None], r.group_sizes[None]
+
+        out, gs = _run_sharded(epmesh, f, x, idx_m, wts, out_extra=(1, 1))
+        # recv counts see only the valid assignments
+        valid = idx_m.reshape(-1)[idx_m.reshape(-1) >= 0]
+        demand = np.bincount(valid, minlength=e).reshape(W, e // W)
+        np.testing.assert_array_equal(np.asarray(gs), demand)
+        # clean tokens round-trip exactly; -1 slots contribute zero
+        want = np.einsum(
+            "wtk,wth->wth", np.where(drop, 0.0, wts), x
+        ).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(out), want, atol=2e-5,
+                                   rtol=2e-5)
+
+    def test_all_minus_one_token_is_zero_and_others_clean(self, epmesh):
+        """A token with every assignment dropped outputs exactly zero."""
+        e, t, h, k = 8, 8, 16, 2
+        x, idx, wts = _make_case(t=t, h=h, e=e, k=k)
+        idx_m = idx.copy()
+        idx_m[:, 0, :] = -1  # first token of every rank: no experts
+
+        def f(xv, iv, wv):
+            r = ep_ll.ll_dispatch(
+                xv[0], iv[0], wv[0], e, "ep", wire="dense", wire_fp8=False
+            )
+            out = ep_ll.ll_combine(r.recv_x, r.state, "ep", wire_fp8=False)
+            return out[None]
+
+        out = np.asarray(_run_sharded(epmesh, f, x, idx_m, wts))
+        assert np.all(out[:, 0] == 0.0)
+        want = np.einsum("wtk,wth->wth", wts, x)[:, 1:]
+        np.testing.assert_allclose(out[:, 1:], want, atol=2e-5, rtol=2e-5)

@@ -111,3 +111,30 @@ class TestZlibFallback:
         blob = lz.encode_lossless(a)
         back = lz.decode_lossless(blob)
         np.testing.assert_array_equal(back.view(np.uint8), a.view(np.uint8))
+
+
+class TestPurePythonRansDecode:
+    """The sender's toolchain picks the encoding, so a receiver without the
+    native runtime must decode rANS planes too (pure-Python fallback)."""
+
+    def test_plane_decode_matches_native_encode(self, rng_):
+        if lz._native() is None:
+            pytest.skip("native codec unavailable")
+        plane = (rng_.standard_normal(8192) * 3).astype(np.int8).tobytes()
+        tag, data = lz._encode_plane(plane)
+        if tag != lz._RANS:
+            pytest.skip("plane did not take the rANS path")
+        assert lz._rans_decode_py(data, len(plane)) == plane
+
+    def test_blob_decodes_without_native(self, rng_, monkeypatch):
+        if lz._native() is None:
+            pytest.skip("native codec unavailable")
+        a = (rng_.standard_normal(8192) * 0.02).astype(ml_dtypes.bfloat16)
+        blob = lz.encode_lossless(a)
+        monkeypatch.setattr(lz, "_codec_lib", False)  # receiver: no native
+        back = lz.decode_lossless(blob)
+        np.testing.assert_array_equal(back.view(np.uint8), a.view(np.uint8))
+
+    def test_corrupt_plane_rejected(self):
+        with pytest.raises(ValueError):
+            lz._rans_decode_py(b"\x01" + b"\x00" * 600, 64)

@@ -225,7 +225,9 @@ class Buffer:
         packed per-expert buffers sized by ``num_max_dispatch_tokens_per_rank``
         plus per-expert receive counts, fp8 on the wire.
 
-        x: [W, T, H]; topk_idx: [W, T, K]. Returns
+        x: [W, T, H]; topk_idx: [W, T, K] — entries of ``-1`` mean "no
+        expert" (DeepEP-supported): such assignments claim no wire slot and
+        contribute zero in combine. Returns
         (recv_x [W, R_max, H] group-major packed,
          recv_count [W, E_local],
          handle) — the consumer feeds (recv_x, recv_count) straight into

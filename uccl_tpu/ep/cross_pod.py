@@ -125,18 +125,36 @@ class CrossPodMoE:
 
         return f
 
-    def _local_compute(self, shape_key, expert_fn):
-        cached = self._compute_cache.get(shape_key)
+    # Bounded: callers that build a fresh expert_fn closure per step would
+    # otherwise grow the caches (and their pinned executables) without limit.
+    # 8 generously covers the steady state of (a few shapes) x (a few fns);
+    # a per-step-fresh closure simply pays a recompile per step, which is
+    # also the signal to hoist the fn out of the loop.
+    _CACHE_MAX = 8
+
+    def _cached_jit(self, cache, shape_key, expert_fn, build):
+        """LRU over ((shape_key, id(fn)) -> (fn pin, jitted)). The entry pins
+        expert_fn so its id() cannot be recycled while the entry lives; same
+        shapes with a different expert_fn never reuse a stale closure."""
+        key = (shape_key, id(expert_fn))
+        cached = cache.pop(key, None)
         if cached is None:
-            cached = jax.jit(self._local_fn(expert_fn))
-            self._compute_cache[shape_key] = cached
-        return cached
+            cached = (expert_fn, jax.jit(build(expert_fn)))
+        cache[key] = cached  # (re)insert at the end: dict order = recency
+        while len(cache) > self._CACHE_MAX:
+            cache.pop(next(iter(cache)))  # evict least-recently-used
+        return cached[1]
+
+    def _local_compute(self, shape_key, expert_fn):
+        return self._cached_jit(
+            self._compute_cache, shape_key, expert_fn, self._local_fn
+        )
 
     def _local_vjp(self, shape_key, expert_fn):
         """Jitted vjp of the local compute w.r.t. (xs, wts, warrs)."""
-        cached = self._vjp_cache.get(shape_key)
-        if cached is None:
-            f = self._local_fn(expert_fn)
+
+        def build(fn):
+            f = self._local_fn(fn)
 
             def g(xs, idx, wts, warrs, ct):
                 _, vjp = jax.vjp(
@@ -144,9 +162,9 @@ class CrossPodMoE:
                 )
                 return vjp(ct)
 
-            cached = jax.jit(g)
-            self._vjp_cache[shape_key] = cached
-        return cached
+            return g
+
+        return self._cached_jit(self._vjp_cache, shape_key, expert_fn, build)
 
     # ------------------------------------------------------------------
     def _bucket(self, x, topk_idx, topk_weights):

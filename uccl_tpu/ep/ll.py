@@ -23,7 +23,9 @@ CPU proxy, here:
 
 Contracts (per-shard, inside ``shard_map`` over the EP axis):
 
-``ll_dispatch(x[T,H], topk_idx[T,K], ...)`` →
+``ll_dispatch(x[T,H], topk_idx[T,K], ...)`` (``topk_idx`` entries of ``-1``
+    mean "no expert" — DeepEP-supported; they claim no wire slot and combine
+    to zero) →
     ``(recv_x [R_max, H], group_sizes [E_local], state)`` with ``recv_x``
     packed group-major (rows of local expert 0 first, then 1, ...; zeros past
     ``sum(group_sizes)``) — DeepEP's packed_recv_x + packed_recv_count.
@@ -126,18 +128,24 @@ def _layout(topk_idx, num_experts: int, e_local: int, per_pair: int, wire: str):
     w = num_experts // e_local
     flat_e = topk_idx.T.reshape(tk)  # k-major: earlier k-slots win on drops
     flat_t = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)
-    order = jnp.argsort(flat_e, stable=True)
-    sorted_e = flat_e[order]
+    # DeepEP's contract admits -1 "no expert" assignments
+    # (ep/bench/buffer.py:285). Map them to a sort-last sentinel id so they
+    # never claim a wire slot or shift the packed positions of real rows.
+    valid = flat_e >= 0
+    key_e = jnp.where(valid, flat_e, num_experts).astype(jnp.int32)
+    order = jnp.argsort(key_e, stable=True)
+    sorted_e = key_e[order]
     sorted_t = flat_t[order]
-    dest = (sorted_e // e_local).astype(jnp.int32)  # non-decreasing
+    is_real = sorted_e < num_experts
+    dest = jnp.where(is_real, sorted_e // e_local, 0).astype(jnp.int32)
 
-    counts_e = jnp.bincount(flat_e, length=num_experts)
+    counts_e = jnp.bincount(key_e, length=num_experts + 1)[:num_experts]
     dest_sizes = counts_e.reshape(w, e_local).sum(-1)
     dest_start = _exclusive_cumsum(dest_sizes)
     pos_in_dest = (
         jnp.arange(tk, dtype=jnp.int32) - dest_start[dest].astype(jnp.int32)
     )
-    keep = pos_in_dest < per_pair  # bound violation drops dest-tail rows
+    keep = is_real & (pos_in_dest < per_pair)  # drop dest-tail + no-expert
 
     kept_e = jax.ops.segment_sum(
         keep.astype(jnp.int32), sorted_e, num_segments=num_experts

@@ -18,8 +18,9 @@ Scheme (host-side; the DCN wire is host-owned on TPU pods):
 2. **Byte-plane split** of the rotated values (transpose of the
    [elems, itemsize] uint8 view).
 3. **Per-plane entropy coding** with the native order-0 rANS coder
-   (native/src/float_codec.cc, within ~0.2% of order-0 entropy; zlib
-   fallback when the native runtime is unavailable), keeping the coded form
+   (native/src/float_codec.cc, within ~0.2% of order-0 entropy; encoders
+   without the native runtime fall back to zlib, and a pure-Python rANS
+   decoder keeps rANS blobs readable there too), keeping the coded form
    only when it actually shrank — mantissa planes of trained weights are
    incompressible and ship raw, exactly DietGPU's split-and-skip strategy.
 
@@ -153,6 +154,45 @@ def _encode_plane(plane: bytes) -> tuple[int, bytes]:
     return _RAW, plane
 
 
+def _rans_decode_py(data: bytes, n: int) -> bytes:
+    """Pure-Python decode of the native rANS format (float_codec.cc:10-17).
+
+    The sender's toolchain decides the wire encoding, so a receiver without
+    the native runtime must still be able to decode rANS planes. Sequential
+    by construction (single rANS state) — ~1 MB/s — but a fallback only;
+    hosts with the native codec never take this path."""
+    header = 1 + 8 + 256 * 2
+    if len(data) < header + 4 or data[0] != 1:
+        raise ValueError("corrupt rANS plane (header)")
+    (n64,) = struct.unpack_from("<Q", data, 1)
+    if n64 != n:
+        raise ValueError("corrupt rANS plane (length)")
+    freq = np.frombuffer(data, np.uint16, 256, 9).astype(np.uint32)
+    if int(freq.sum()) != 1 << 12:
+        raise ValueError("corrupt rANS plane (freq table)")
+    cum = np.concatenate([[0], np.cumsum(freq)[:-1]]).astype(np.uint32)
+    slot2sym = np.repeat(
+        np.arange(256, dtype=np.int64), freq.astype(np.int64)
+    ).tolist()
+    freq_l, cum_l = freq.tolist(), cum.tolist()
+    p = header
+    x = int.from_bytes(data[p:p + 4], "little")
+    p += 4
+    out = bytearray(n)
+    lo, end = 1 << 23, len(data)
+    for i in range(n):
+        slot = x & 0xFFF
+        s = slot2sym[slot]
+        out[i] = s
+        x = freq_l[s] * (x >> 12) + slot - cum_l[s]
+        while x < lo:
+            if p >= end:
+                raise ValueError("corrupt rANS plane (stream underrun)")
+            x = (x << 8) | data[p]
+            p += 1
+    return bytes(out)
+
+
 def _decode_plane(tag: int, data: bytes, n: int) -> bytes:
     if tag == _RAW:
         return data
@@ -161,9 +201,7 @@ def _decode_plane(tag: int, data: bytes, n: int) -> bytes:
     if tag == _RANS:
         lib = _native()
         if lib is None:
-            raise RuntimeError(
-                "blob has rANS planes but the native codec is unavailable"
-            )
+            return _rans_decode_py(data, n)
         src = np.frombuffer(data, np.uint8)
         out = np.empty(n, np.uint8)
         r = lib.ucclt_codec_decode(

@@ -334,3 +334,99 @@ class TestVectorized:
         assert not client.wait(xids[0])   # over-window element fails
         assert client.wait(xids[1])       # sibling still lands
         np.testing.assert_array_equal(dst2, ok)
+
+
+class TestJaxStaging:
+    """Pipelined HBM<->host<->wire staging (SURVEY §7 hard-part 3): chunked
+    send_jax/recv_jax round-trips, and interop with monolithic senders."""
+
+    def _roundtrip(self, pair, x, shape, dtype, *, chunk_bytes=None):
+        import threading
+
+        server, client, conn_s, conn_c = pair
+        kw = {} if chunk_bytes is None else {"chunk_bytes": chunk_bytes}
+        t = threading.Thread(
+            target=client.send_jax, args=(conn_c, x), kwargs=kw
+        )
+        t.start()
+        y = server.recv_jax(conn_s, shape, dtype)
+        t.join(timeout=60)
+        assert not t.is_alive()
+        return y
+
+    def test_chunked_roundtrip(self, pair):
+        import jax.numpy as jnp
+
+        x = jnp.arange(1 << 16, dtype=jnp.float32).reshape(256, 256)
+        y = self._roundtrip(
+            pair, x, (256, 256), np.float32, chunk_bytes=64 << 10
+        )
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    def test_uneven_tail_chunk(self, pair):
+        import jax.numpy as jnp
+
+        x = jnp.arange(1000, dtype=jnp.int32)  # 4000 B, 1024-B chunks
+        y = self._roundtrip(pair, x, (1000,), np.int32, chunk_bytes=1024)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    def test_monolithic_sender_chunked_receiver_interop(self, pair):
+        import jax.numpy as jnp
+
+        x = jnp.ones((64, 64), jnp.float32) * 3.5
+        # huge chunk_bytes => single-message path on the sender
+        y = self._roundtrip(
+            pair, x, (64, 64), np.float32, chunk_bytes=1 << 30
+        )
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    def test_bf16_payload(self, pair):
+        import jax.numpy as jnp
+
+        x = jnp.linspace(-4.0, 4.0, 8192, dtype=jnp.bfloat16)
+        y = self._roundtrip(
+            pair, x, (8192,), jnp.bfloat16, chunk_bytes=4096
+        )
+        np.testing.assert_array_equal(
+            np.asarray(y).view(np.uint8), np.asarray(x).view(np.uint8)
+        )
+
+    def test_numpy_input_still_works(self, pair):
+        x = np.random.default_rng(0).standard_normal(512).astype(np.float32)
+        y = self._roundtrip(pair, x, (512,), np.float32, chunk_bytes=512)
+        np.testing.assert_array_equal(np.asarray(y), x)
+
+
+class TestEngineStats:
+    """Hot-loop observability (reference: transport.cc:1797 stats thread +
+    util/latency.h): per-engine frame counts, service-latency percentiles,
+    queue depths, and the periodic stats thread heartbeat."""
+
+    def test_stats_shape_and_latency_percentiles(self, pair, rng):
+        server, client, conn_s, conn_c = pair
+        dst = np.zeros(1 << 16, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, 1 << 16).astype(np.uint8)
+        for _ in range(20):
+            client.write(conn_c, src, fifo)
+        s = client.stats
+        assert s["bytes_tx"] > 20 * (1 << 16)
+        engines = s["engines"]
+        assert len(engines) >= 1
+        tx_frames = sum(e["tx_frames"] for e in engines)
+        assert tx_frames >= 20
+        busy = [e for e in engines if e["tx_frames"] > 0]
+        for e in busy:
+            assert e["tx_p99_us"] >= e["tx_p50_us"] > 0
+        r = server.stats
+        rx_frames = sum(e["rx_frames"] for e in r["engines"])
+        assert rx_frames >= 20
+        for e in r["engines"]:
+            if e["rx_frames"]:
+                assert e["rx_p99_us"] >= e["rx_p50_us"] > 0
+
+    def test_stats_thread_ticks(self, monkeypatch):
+        monkeypatch.setenv("UCCL_TPU_ENGINE_STATS_MS", "40")
+        with Endpoint() as ep:
+            time.sleep(0.5)
+            assert ep.stats["stats_ticks"] >= 2

@@ -16,9 +16,15 @@ from typing import Optional, Tuple, Union
 
 import numpy as np
 
+from uccl_tpu.utils.config import param
 from uccl_tpu.utils.logging import get_logger
 
 _log = get_logger("P2P")
+
+_stage_chunk_bytes = param(
+    "stage_chunk_bytes", 8 << 20,
+    help="HBM<->host staging pipeline chunk size for send_jax/recv_jax",
+)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
@@ -190,6 +196,8 @@ def _load():
         lib.ucclt_bytes_tx.argtypes = [c]
         lib.ucclt_bytes_rx.restype = ctypes.c_uint64
         lib.ucclt_bytes_rx.argtypes = [c]
+        lib.ucclt_stats_json.restype = ctypes.c_int64
+        lib.ucclt_stats_json.argtypes = [c, ctypes.c_char_p, ctypes.c_size_t]
         _lib = lib
         return _lib
 
@@ -452,6 +460,24 @@ class Endpoint:
             raise TimeoutError("recv timed out")
         return buf.raw[:n]
 
+    def recv_into(self, conn_id: int, out: np.ndarray, timeout_ms: int = 10000) -> int:
+        """Receive one message directly into a caller buffer (no allocation,
+        no zero-fill — ``create_string_buffer`` memsets its whole capacity,
+        which the chunked staging loop cannot afford). ``out`` must be a
+        C-contiguous uint8 array; returns the message length."""
+        assert out.dtype == np.uint8 and out.flags["C_CONTIGUOUS"]
+        ptr = out.ctypes.data_as(ctypes.c_void_p)
+        n = self._lib.ucclt_recv(
+            self._handle(), conn_id, ptr, out.nbytes, timeout_ms
+        )
+        if n <= -2:
+            raise IOError(
+                f"recv_into: {-(n + 2)} B message exceeds {out.nbytes} B buffer"
+            )
+        if n < 0:
+            raise TimeoutError("recv timed out")
+        return n
+
     # -- observability / fault injection ---------------------------------
     def set_drop_rate(self, p: float) -> None:
         self._lib.ucclt_set_drop_rate(self._handle(), p)
@@ -463,20 +489,92 @@ class Endpoint:
 
     @property
     def stats(self) -> dict:
-        return {
-            "bytes_tx": self._lib.ucclt_bytes_tx(self._handle()),
-            "bytes_rx": self._lib.ucclt_bytes_rx(self._handle()),
-        }
+        """Hot-loop engine stats (reference: periodic transport stats,
+        collective/rdma/transport.cc:1797 + util/latency.h histograms):
+        ``bytes_tx/rx``, ``stats_ticks`` (heartbeats of the 2s stats
+        thread; UCCL_TPU_ENGINE_STATS=1 also logs each tick), and per-engine
+        ``engines[i]`` dicts with tx/rx frame counts, frame service latency
+        p50/p99 (µs), queued tx bytes, and task-ring depth."""
+        import json as _json
+
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.ucclt_stats_json(self._handle(), buf, len(buf))
+        return _json.loads(buf.raw[:n].decode())
 
     # -- jax staging helpers ---------------------------------------------
-    def send_jax(self, conn_id: int, x) -> None:
-        """Device→host stage then two-sided send (KV-cache push path)."""
-        self.send(conn_id, np.ascontiguousarray(np.asarray(x)))
+    def send_jax(self, conn_id: int, x, *, chunk_bytes: Optional[int] = None) -> None:
+        """Device→host stage then two-sided send (KV-cache push path).
 
-    def recv_jax(self, conn_id: int, shape, dtype, device=None, timeout_ms: int = 30000):
+        Pipelined (SURVEY §7 hard-part 3; the reference hides staging with
+        GPUDirect/bounce-pool pipelining, p2p/engine.cc staged paths): the
+        tensor is sliced on-device into ``chunk_bytes`` pieces whose
+        device→host DMAs all start up-front (``copy_to_host_async``); each
+        chunk is enqueued on the wire the moment it lands, so TX of chunk i
+        overlaps D2H of chunks i+1..  ``Endpoint.send`` itself only copies
+        into the conn's tx queue (engine.cc:490-507) — the tx proxy thread
+        drains it concurrently. One message per chunk; ``recv_jax``
+        reassembles by total byte count, so chunked and monolithic senders
+        interoperate."""
         import jax
 
-        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
-        raw = self.recv(conn_id, max_bytes=nbytes, timeout_ms=timeout_ms)
-        arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
-        return jax.device_put(arr, device)
+        if chunk_bytes is None:
+            chunk_bytes = int(_stage_chunk_bytes.get())
+        if not isinstance(x, jax.Array) or x.nbytes <= chunk_bytes:
+            self.send(conn_id, np.ascontiguousarray(np.asarray(x)))
+            return
+        flat = x.reshape(-1)  # row-major flatten: layout-preserving
+        elems = max(1, chunk_bytes // x.dtype.itemsize)
+        parts = [flat[i:i + elems] for i in range(0, flat.shape[0], elems)]
+        for p in parts:
+            try:
+                p.copy_to_host_async()  # start every D2H DMA now
+            except AttributeError:  # non-ArrayImpl (e.g. tracer-free numpy)
+                break
+        for p in parts:
+            self.send(conn_id, np.ascontiguousarray(np.asarray(p)))
+
+    def recv_jax(self, conn_id: int, shape, dtype, device=None, timeout_ms: int = 30000):
+        """Receive a tensor staged by :meth:`send_jax` (either monolithic or
+        chunked): messages are reassembled by total byte count, and each
+        chunk's host→device transfer starts as soon as it arrives
+        (``jax.device_put`` dispatches asynchronously), overlapping H2D with
+        the remaining wire receives."""
+        import jax
+        import jax.numpy as jnp
+
+        itemsize = np.dtype(dtype).itemsize
+        nbytes = int(np.prod(shape)) * itemsize
+        if nbytes == 0:
+            return jax.device_put(np.empty(shape, dtype), device)
+        host = np.empty(nbytes, np.uint8)  # one buffer, messages land in place
+        # Per-chunk H2D pipelining applies to single-Device targets on real
+        # accelerators. A Sharding target (multi-axis specs shard the FULL
+        # shape — flat chunks can't be placed) and the CPU backend (put is a
+        # zero-copy view) both take the assemble-then-put path.
+        plat = getattr(device, "platform", None)
+        if device is None:
+            plat = jax.default_backend()
+        pipelined = plat is not None and plat != "cpu"
+        parts, got = [], 0
+        while got < nbytes:
+            n = self.recv_into(conn_id, host[got:], timeout_ms=timeout_ms)
+            if n % itemsize:
+                raise IOError(
+                    f"recv_jax: {n} B message misaligned with dtype "
+                    f"{np.dtype(dtype)}"
+                )
+            if pipelined:
+                # start this chunk's H2D DMA now (device_put dispatches
+                # asynchronously) — overlaps with the remaining wire recvs
+                parts.append(
+                    jax.device_put(
+                        host[got:got + n].view(dtype), device
+                    )
+                )
+            got += n
+        if not pipelined:
+            return jax.device_put(
+                host.view(dtype).reshape(shape), device
+            )
+        dev = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        return dev.reshape(shape)

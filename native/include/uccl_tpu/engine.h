@@ -47,6 +47,33 @@ struct FifoItem {
 };
 static_assert(sizeof(FifoItem) == 64, "FifoItem must stay 64 bytes");
 
+// Lock-free power-of-two latency histogram — the role of the reference's
+// include/util/latency.h percentile tracker wired into the transport hot
+// loops (collective/rdma/transport.cc:1797 stats thread). record() costs one
+// CLZ + two relaxed increments; percentiles are derived off the hot path
+// (bucket b spans [2^b, 2^(b+1)) ns, so a percentile is exact to 2x).
+struct LatHist {
+  std::atomic<uint64_t> buckets[64] = {};
+  std::atomic<uint64_t> count{0};
+  void record(uint64_t ns) {
+    int b = 63 - __builtin_clzll(ns | 1);
+    buckets[b].fetch_add(1, std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+  }
+  // Upper edge (ns) of the bucket holding the p-th percentile; 0 when empty.
+  uint64_t percentile_ns(double p) const {
+    uint64_t n = count.load(std::memory_order_relaxed);
+    if (n == 0) return 0;
+    double target = n * p / 100.0;
+    uint64_t acc = 0;
+    for (int b = 0; b < 64; ++b) {
+      acc += buckets[b].load(std::memory_order_relaxed);
+      if (static_cast<double>(acc) >= target) return 2ull << b;
+    }
+    return ~0ull;
+  }
+};
+
 enum class Op : uint16_t {
   kWrite = 1,      // payload lands in advertised region
   kWriteAck = 2,   // completion notification back to the writer
@@ -172,6 +199,9 @@ class Endpoint {
   // --- stats
   uint64_t bytes_tx() const { return bytes_tx_.load(); }
   uint64_t bytes_rx() const { return bytes_rx_.load(); }
+  // JSON snapshot of per-engine hot-loop stats (frame counts, service
+  // latency percentiles, queue depths). Returns bytes written (excl. NUL).
+  size_t stats_json(char* out, size_t cap);
 
  private:
   // One queued outbound frame with send progress. Frames per conn go out in
@@ -187,6 +217,7 @@ class Endpoint {
     uint64_t fail_xfer = 0;      // xfer to fail if the conn dies mid-send
     size_t off = 0;              // bytes of (header+payload) already sent
     bool credited = false;       // stats counted (exactly once per frame)
+    uint64_t t_enq_ns = 0;       // enqueue time: tx service-latency sample
     const uint8_t* payload() const {
       return owned.empty() ? static_cast<const uint8_t*>(src) : owned.data();
     }
@@ -205,6 +236,7 @@ class Endpoint {
     size_t rx_got = 0;             // bytes of current stage received
     FrameHeader rx_hdr{};
     uint8_t* rx_dst = nullptr;     // zero-copy window target (kWrite)
+    uint64_t rx_t0_ns = 0;         // first header byte: rx latency sample
     std::shared_ptr<std::atomic<int>> rx_pin;  // held while rx_dst in flight
     std::vector<uint8_t> rx_buf;   // owned body (non-window ops / sink)
     bool rx_ok = false;            // window resolved for current kWrite
@@ -290,6 +322,11 @@ class Endpoint {
     // thread prunes it — queued transfers fail fast instead of timing out.
     std::mutex conns_mtx;
     std::vector<std::shared_ptr<Conn>> conns;
+    // hot-loop observability (reference transport.cc:1797 stats thread)
+    LatHist tx_lat;                       // enqueue → last byte sent
+    LatHist rx_lat;                       // first header byte → dispatched
+    std::atomic<uint64_t> tx_frames{0};
+    std::atomic<uint64_t> rx_frames{0};
   };
 
   void io_loop(int engine);  // epoll frame dispatch (recv proxy analog)
@@ -329,6 +366,14 @@ class Endpoint {
   uint16_t listen_port_ = 0;
   std::atomic<bool> stop_{false};
   std::vector<std::unique_ptr<EngineCtx>> engines_;
+
+  // Periodic stats thread (reference: per-engine stats cadence in
+  // transport.cc:1797). Always counts ticks; prints only when
+  // UCCL_TPU_ENGINE_STATS=1 (quiet by default). Cadence from
+  // UCCL_TPU_ENGINE_STATS_MS (default 2000).
+  void stats_loop();
+  std::thread stats_thread_;
+  std::atomic<uint64_t> stats_ticks_{0};
 
   std::mutex conns_mtx_;
   // shared_ptr: in-flight senders keep a Conn alive across remove_conn();

@@ -118,6 +118,14 @@ class MpscRing {
     return true;
   }
 
+  // Approximate depth for observability (racy by nature; never used for
+  // correctness decisions).
+  size_t size() const {
+    uint64_t h = head_.load(std::memory_order_acquire);
+    uint64_t t = tail_.load(std::memory_order_acquire);
+    return h > t ? static_cast<size_t>(h - t) : 0;
+  }
+
  private:
   std::vector<Cell> cells_;
   uint64_t mask_ = 0;

@@ -176,4 +176,11 @@ uint64_t ucclt_bytes_rx(void* ep) {
   return static_cast<Endpoint*>(ep)->bytes_rx();
 }
 
+// Per-engine hot-loop stats snapshot as JSON (reference analog: the periodic
+// transport stats, collective/rdma/transport.cc:1797). Returns bytes written.
+int64_t ucclt_stats_json(void* ep, char* out, size_t cap) {
+  return static_cast<int64_t>(
+      static_cast<Endpoint*>(ep)->stats_json(out, cap));
+}
+
 }  // extern "C"

@@ -52,6 +52,13 @@ uint64_t random_token() {
   static thread_local std::mt19937_64 gen{std::random_device{}()};
   return gen();
 }
+
+uint64_t now_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
 }  // namespace
 
 Endpoint::Endpoint(uint16_t port, int n_engines, const char* listen_ip) {
@@ -98,6 +105,7 @@ Endpoint::Endpoint(uint16_t port, int n_engines, const char* listen_ip) {
     engines_[e]->io_thread = std::thread([this, e] { io_loop(e); });
     engines_[e]->tx_thread = std::thread([this, e] { tx_loop(e); });
   }
+  stats_thread_ = std::thread([this] { stats_loop(); });
 }
 
 Endpoint::~Endpoint() {
@@ -137,6 +145,7 @@ Endpoint::~Endpoint() {
     if (eng->io_thread.joinable()) eng->io_thread.join();
     if (eng->tx_thread.joinable()) eng->tx_thread.join();
   }
+  if (stats_thread_.joinable()) stats_thread_.join();
   {
     std::lock_guard<std::mutex> lk(conns_mtx_);
     conns_.clear();  // Conn destructors close the fds
@@ -567,6 +576,7 @@ void Endpoint::enqueue_frame(const std::shared_ptr<Conn>& c,
   it.wire_len = !it.owned.empty() ? it.owned.size()
               : (src != nullptr ? static_cast<size_t>(h.len) : 0);
   it.fail_xfer = fail_xfer;
+  it.t_enq_ns = now_ns();
   size_t total = it.total();
   {
     std::lock_guard<std::mutex> lk(c->txq_mtx);
@@ -620,11 +630,15 @@ bool Endpoint::service_tx(Conn* c, bool* blocked) {
       it->off += static_cast<size_t>(s);
     }
     size_t total = it->total();
+    uint64_t t_enq = it->t_enq_ns;
     {
       std::lock_guard<std::mutex> lk(c->txq_mtx);
       c->txq.pop_front();
     }
     c->txq_bytes.fetch_sub(total, std::memory_order_relaxed);
+    auto& eng = *engines_[c->engine];
+    eng.tx_lat.record(now_ns() - t_enq);
+    eng.tx_frames.fetch_add(1, std::memory_order_relaxed);
   }
 }
 
@@ -848,6 +862,9 @@ void Endpoint::finish_rx_frame(Conn* c) {
   const FrameHeader& h = c->rx_hdr;
   size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
   bytes_rx_.fetch_add(sizeof(h) + body);
+  auto& eng = *engines_[c->engine];
+  eng.rx_lat.record(now_ns() - c->rx_t0_ns);
+  eng.rx_frames.fetch_add(1, std::memory_order_relaxed);
   if (static_cast<Op>(h.op) == Op::kWrite) {
     if (c->rx_pin) {
       c->rx_pin->fetch_sub(1, std::memory_order_acq_rel);
@@ -887,6 +904,7 @@ Endpoint::RxResult Endpoint::drain_rx(Conn* c) {
           if (errno == EAGAIN || errno == EWOULDBLOCK) return RxResult::kDrained;
           return RxResult::kDead;
         }
+        if (c->rx_got == 0) c->rx_t0_ns = now_ns();  // frame service starts
         c->rx_got += static_cast<size_t>(n);
         consumed += static_cast<size_t>(n);
       }
@@ -1012,6 +1030,72 @@ void Endpoint::io_loop(int engine) {
                   (res == RxResult::kDrained &&
                    (events[i].events & (EPOLLERR | EPOLLHUP)) != 0);
       if (dead) conn_error(conn_id);
+    }
+  }
+}
+
+// JSON snapshot of the hot-loop stats: per-engine frame counts, service
+// latency percentiles (µs), queued tx bytes, task-ring depth. The analog of
+// the reference's periodic transport stats (transport.cc:1797 +
+// include/util/latency.h), readable on demand through the C API.
+size_t Endpoint::stats_json(char* out, size_t cap) {
+  size_t off = 0;
+  auto put = [&](const char* fmt, auto... args) {
+    if (off < cap) {
+      int w = std::snprintf(out + off, cap - off, fmt, args...);
+      if (w > 0) off += static_cast<size_t>(w) < cap - off
+                            ? static_cast<size_t>(w)
+                            : cap - off - 1;
+    }
+  };
+  put("{\"bytes_tx\":%llu,\"bytes_rx\":%llu,\"stats_ticks\":%llu,"
+      "\"engines\":[",
+      static_cast<unsigned long long>(bytes_tx_.load()),
+      static_cast<unsigned long long>(bytes_rx_.load()),
+      static_cast<unsigned long long>(stats_ticks_.load()));
+  for (size_t e = 0; e < engines_.size(); ++e) {
+    auto& eng = *engines_[e];
+    size_t txq_bytes = 0;
+    {
+      std::lock_guard<std::mutex> lk(eng.conns_mtx);
+      for (auto& c : eng.conns)
+        txq_bytes += c->txq_bytes.load(std::memory_order_relaxed);
+    }
+    put("%s{\"tx_frames\":%llu,\"rx_frames\":%llu,"
+        "\"tx_p50_us\":%.1f,\"tx_p99_us\":%.1f,"
+        "\"rx_p50_us\":%.1f,\"rx_p99_us\":%.1f,"
+        "\"txq_bytes\":%llu,\"ring_depth\":%llu}",
+        e == 0 ? "" : ",",
+        static_cast<unsigned long long>(eng.tx_frames.load()),
+        static_cast<unsigned long long>(eng.rx_frames.load()),
+        eng.tx_lat.percentile_ns(50) / 1e3,
+        eng.tx_lat.percentile_ns(99) / 1e3,
+        eng.rx_lat.percentile_ns(50) / 1e3,
+        eng.rx_lat.percentile_ns(99) / 1e3,
+        static_cast<unsigned long long>(txq_bytes),
+        static_cast<unsigned long long>(eng.ring.size()));
+  }
+  put("]}");
+  return off;
+}
+
+void Endpoint::stats_loop() {
+  const char* v = std::getenv("UCCL_TPU_ENGINE_STATS");
+  bool verbose = v != nullptr && v[0] == '1';
+  const char* pm = std::getenv("UCCL_TPU_ENGINE_STATS_MS");
+  int period_ms = pm != nullptr ? std::atoi(pm) : 2000;
+  if (period_ms <= 0) period_ms = 2000;
+  while (!stop_.load()) {
+    // sleep in short steps so shutdown never waits out the cadence
+    for (int slept = 0; slept < period_ms && !stop_.load(); slept += 20) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    }
+    if (stop_.load()) break;
+    stats_ticks_.fetch_add(1, std::memory_order_relaxed);
+    if (verbose) {
+      char buf[4096];
+      stats_json(buf, sizeof(buf));
+      std::fprintf(stderr, "[uccl_tpu:engine-stats] %s\n", buf);
     }
   }
 }

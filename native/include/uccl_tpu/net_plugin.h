@@ -40,6 +40,7 @@ extern "C" {
 
 typedef struct {
   char name[32];       // device name
+  char addr[64];       // the NIC address this device binds (dial target)
   int speed_mbps;      // advertised link speed
   int port;            // listen port of the underlying endpoint
   int max_comms;       // soft cap on simultaneous comms

@@ -11,7 +11,6 @@
 #include <map>
 #include <memory>
 #include <mutex>
-#include <set>
 #include <string>
 #include <vector>
 
@@ -33,6 +32,7 @@ static_assert(sizeof(Handle) <= UCCLT_NET_HANDLE_BYTES, "handle too big");
 
 struct ListenComm {
   uint32_t listen_id;
+  int dev = 0;
 };
 
 // One tagged message as delivered by the engine (tag prefix stripped).
@@ -43,6 +43,7 @@ struct TaggedMsg {
 
 struct Comm {
   uint64_t conn_id = 0;
+  int dev = 0;  // which plugin device (endpoint) carries this comm
   bool sender = false;
   // recv side: engine messages drained but not yet matched to an irecv
   std::deque<TaggedMsg> unmatched;
@@ -67,28 +68,65 @@ struct Request {
 // last holder destroys the engine).
 struct Plugin {
   std::mutex mtx;
-  std::shared_ptr<Endpoint> ep;
+  // One logical plugin device per NIC in UCCL_TPU_NIC_LIST (reference:
+  // nccl_plugin.cc enumerates one device per RDMA NIC and NCCL schedules
+  // across them); unset → one device on UCCL_TPU_HOST_IP/INADDR_ANY. Each
+  // device is its own Endpoint whose listener (and, when the list is
+  // explicit, outgoing source address) binds to that NIC.
+  std::vector<std::string> nic_ips;  // empty string = unbound (default dev)
+  bool nic_list_explicit = false;
+  std::vector<std::shared_ptr<Endpoint>> eps;
   uint32_t next_listen = 1;
-  std::set<uint32_t> live_listens;
+  // listen_id → device it listens on; membership here IS listen liveness
+  std::map<uint32_t, int> listen_dev;
   // conns that said hello for a live listen_id nobody accepted yet
   std::map<uint32_t, std::deque<uint64_t>> pending_accepts;
   std::vector<uint8_t> staging;  // drain buffer (under mtx)
 
-  std::shared_ptr<Endpoint> endpoint_locked() {
-    if (!ep) {
+  void resolve_nics_locked() {
+    if (!nic_ips.empty()) return;
+    if (const char* lst = std::getenv("UCCL_TPU_NIC_LIST")) {
+      std::string s(lst);
+      size_t pos = 0;
+      while (pos <= s.size()) {
+        size_t comma = s.find(',', pos);
+        if (comma == std::string::npos) comma = s.size();
+        std::string ip = s.substr(pos, comma - pos);
+        if (!ip.empty()) nic_ips.push_back(ip);
+        pos = comma + 1;
+      }
+      nic_list_explicit = !nic_ips.empty();
+    }
+    if (nic_ips.empty()) {
+      const char* ip = std::getenv("UCCL_TPU_HOST_IP");
+      nic_ips.push_back(ip != nullptr ? ip : "");
+    }
+    eps.resize(nic_ips.size());
+  }
+
+  int ndev() {
+    std::lock_guard<std::mutex> lk(mtx);
+    resolve_nics_locked();
+    return static_cast<int>(nic_ips.size());
+  }
+
+  std::shared_ptr<Endpoint> endpoint_locked(int dev) {
+    resolve_nics_locked();
+    if (dev < 0 || dev >= static_cast<int>(nic_ips.size())) return nullptr;
+    if (!eps[dev]) {
       int n_engines = 2;
       if (const char* e = std::getenv("UCCL_TPU_NET_ENGINES")) {
         n_engines = std::max(1, atoi(e));
       }
-      const char* ip = std::getenv("UCCL_TPU_HOST_IP");
+      const char* ip = nic_ips[dev].empty() ? nullptr : nic_ips[dev].c_str();
       auto cand = std::make_shared<Endpoint>(0, n_engines, ip);
-      if (cand->ok()) ep = std::move(cand);
+      if (cand->ok()) eps[dev] = std::move(cand);
     }
-    return ep;
+    return eps[dev];
   }
-  std::shared_ptr<Endpoint> endpoint() {
+  std::shared_ptr<Endpoint> endpoint(int dev) {
     std::lock_guard<std::mutex> lk(mtx);
-    return endpoint_locked();
+    return endpoint_locked(dev);
   }
 };
 
@@ -104,27 +142,43 @@ bool net_debug() {
   return dbg;
 }
 
-const char* local_ip() {
+// The address a peer should dial for device `dev` (already resolved).
+std::string dev_ip_locked(Plugin& p, int dev) {
+  if (dev >= 0 && dev < static_cast<int>(p.nic_ips.size()) &&
+      !p.nic_ips[dev].empty()) {
+    return p.nic_ips[dev];
+  }
   const char* ip = std::getenv("UCCL_TPU_HOST_IP");
   return (ip && ip[0]) ? ip : "127.0.0.1";
 }
 
-int pi_init(void) { return plugin().endpoint() ? UCCLT_NET_OK : UCCLT_NET_ERR; }
+int pi_init(void) {
+  return plugin().endpoint(0) ? UCCLT_NET_OK : UCCLT_NET_ERR;
+}
 
 int pi_devices(int* ndev) {
-  // One logical DCN device; multipath/engine fan-out lives inside the
-  // endpoint (the reference reports one plugin dev per NIC; TPU hosts
-  // expose the host NIC(s) behind one engine with n_engines paths).
-  *ndev = 1;
+  // One logical plugin device per NIC (reference: nccl_plugin.cc reports one
+  // device per RDMA NIC and NCCL schedules rings/channels across them).
+  // UCCL_TPU_NIC_LIST unset → 1; engine fan-out within a device still comes
+  // from its own n_engines io/tx pairs.
+  *ndev = plugin().ndev();
   return UCCLT_NET_OK;
 }
 
 int pi_get_properties(int dev, ucclt_net_props_t* props) {
-  if (dev != 0 || !props) return UCCLT_NET_ERR;
-  auto ep = plugin().endpoint();
+  if (!props) return UCCLT_NET_ERR;
+  Plugin& p = plugin();
+  std::lock_guard<std::mutex> lk(p.mtx);
+  auto ep = p.endpoint_locked(dev);
   if (!ep) return UCCLT_NET_ERR;
   std::memset(props, 0, sizeof(*props));
-  std::snprintf(props->name, sizeof(props->name), "uccl_tpu_dcn");
+  if (p.nic_list_explicit) {
+    std::snprintf(props->name, sizeof(props->name), "uccl_tpu_dcn%d", dev);
+  } else {
+    std::snprintf(props->name, sizeof(props->name), "uccl_tpu_dcn");
+  }
+  std::snprintf(props->addr, sizeof(props->addr), "%s",
+                dev_ip_locked(p, dev).c_str());
   props->speed_mbps = 100000;  // nominal DCN host link
   props->port = ep->listen_port();
   props->max_comms = 65536;
@@ -134,18 +188,18 @@ int pi_get_properties(int dev, ucclt_net_props_t* props) {
 }
 
 int pi_listen(int dev, void* handle, void** listen_comm) {
-  if (dev != 0 || !handle || !listen_comm) return UCCLT_NET_ERR;
+  if (!handle || !listen_comm) return UCCLT_NET_ERR;
   Plugin& p = plugin();
   std::lock_guard<std::mutex> lk(p.mtx);
-  auto ep = p.endpoint_locked();
+  auto ep = p.endpoint_locked(dev);
   if (!ep) return UCCLT_NET_ERR;
-  auto* lc = new ListenComm{p.next_listen++};
-  p.live_listens.insert(lc->listen_id);
+  auto* lc = new ListenComm{p.next_listen++, dev};
+  p.listen_dev[lc->listen_id] = dev;
   Handle h{};
   h.magic = kHandleMagic;
   h.listen_id = lc->listen_id;
   h.port = ep->listen_port();
-  std::snprintf(h.ip, sizeof(h.ip), "%s", local_ip());
+  std::snprintf(h.ip, sizeof(h.ip), "%s", dev_ip_locked(p, dev).c_str());
   std::memset(handle, 0, UCCLT_NET_HANDLE_BYTES);
   std::memcpy(handle, &h, sizeof(h));
   *listen_comm = lc;
@@ -153,13 +207,23 @@ int pi_listen(int dev, void* handle, void** listen_comm) {
 }
 
 int pi_connect(int dev, const void* handle, void** send_comm) {
-  if (dev != 0 || !handle || !send_comm) return UCCLT_NET_ERR;
+  if (!handle || !send_comm) return UCCLT_NET_ERR;
   Handle h{};
   std::memcpy(&h, handle, sizeof(h));
   if (h.magic != kHandleMagic) return UCCLT_NET_ERR;
-  auto ep = plugin().endpoint();
+  Plugin& p = plugin();
+  std::string src;
+  std::shared_ptr<Endpoint> ep;
+  {
+    std::lock_guard<std::mutex> lk(p.mtx);
+    ep = p.endpoint_locked(dev);  // null for out-of-range dev
+    // bind the outgoing source address to this device's NIC only when the
+    // operator gave an explicit list (a default/implicit device must not
+    // pin loopback as the source of a cross-host dial)
+    if (ep && p.nic_list_explicit) src = p.nic_ips[dev];
+  }
   if (!ep) return UCCLT_NET_ERR;
-  int64_t conn = ep->connect(h.ip, h.port);
+  int64_t conn = ep->connect(h.ip, h.port, src.empty() ? nullptr : src.c_str());
   if (conn < 0) return UCCLT_NET_ERR;
   // hello: route this conn to the right accept() queue on the peer
   uint32_t listen_id = h.listen_id;
@@ -169,6 +233,7 @@ int pi_connect(int dev, const void* handle, void** send_comm) {
   }
   auto* c = new Comm;
   c->conn_id = static_cast<uint64_t>(conn);
+  c->dev = dev;
   c->sender = true;
   *send_comm = c;
   return UCCLT_NET_OK;
@@ -178,16 +243,17 @@ int pi_accept(void* listen_comm, void** recv_comm) {
   if (!listen_comm || !recv_comm) return UCCLT_NET_ERR;
   auto* lc = static_cast<ListenComm*>(listen_comm);
   Plugin& p = plugin();
-  auto ep = p.endpoint();
+  auto ep = p.endpoint(lc->dev);
   if (!ep) return UCCLT_NET_ERR;
   for (int spin = 0; spin < 100; ++spin) {
     {
       std::lock_guard<std::mutex> lk(p.mtx);
-      if (!p.live_listens.count(lc->listen_id)) return UCCLT_NET_ERR;
+      if (!p.listen_dev.count(lc->listen_id)) return UCCLT_NET_ERR;
       auto& q = p.pending_accepts[lc->listen_id];
       if (!q.empty()) {
         auto* c = new Comm;
         c->conn_id = q.front();
+        c->dev = lc->dev;
         q.pop_front();
         *recv_comm = c;
         return UCCLT_NET_OK;
@@ -201,9 +267,12 @@ int pi_accept(void* listen_comm, void** recv_comm) {
     int64_t n = ep->recv(static_cast<uint64_t>(conn), &listen_id,
                          sizeof(listen_id), 2000);
     std::lock_guard<std::mutex> lk(p.mtx);
-    if (n != sizeof(listen_id) || !p.live_listens.count(listen_id)) {
-      // malformed hello, or a listen that closed (or never existed): don't
-      // park the conn where nobody will ever pop it
+    auto ld = p.listen_dev.find(listen_id);
+    if (n != sizeof(listen_id) || ld == p.listen_dev.end() ||
+        ld->second != lc->dev) {
+      // malformed hello, a closed/unknown listen, or a hello for a listen
+      // on a DIFFERENT device (its conn lives on this device's endpoint —
+      // parking it would hand that listen a conn its endpoint can't serve)
       ep->remove_conn(static_cast<uint64_t>(conn));
       continue;
     }
@@ -236,7 +305,7 @@ int pi_isend(void* send_comm, const void* data, size_t size, uint64_t tag,
   (void)mhandle;
   if (!send_comm || !request || (!data && size)) return UCCLT_NET_ERR;
   auto* c = static_cast<Comm*>(send_comm);
-  auto ep = plugin().endpoint();
+  auto ep = plugin().endpoint(c->dev);
   if (!ep) return UCCLT_NET_ERR;
   // wire format: [tag u64][payload]
   std::vector<uint8_t> framed(sizeof(tag) + size);
@@ -302,7 +371,7 @@ int pi_test(void* request, int* done, size_t* size) {
   if (!r->done && r->kind == Request::Kind::kRecv) {
     Plugin& p = plugin();
     std::lock_guard<std::mutex> lk(p.mtx);
-    auto ep = p.endpoint_locked();
+    auto ep = p.endpoint_locked(r->comm->dev);
     if (!ep) {
       r->done = 1;
       r->failed = 1;  // engine torn down under a posted recv
@@ -366,7 +435,7 @@ int pi_iflush(void* recv_comm, void* data, size_t size, void* mhandle,
 int close_comm(void* comm) {
   if (!comm) return UCCLT_NET_ERR;
   auto* c = static_cast<Comm*>(comm);
-  auto ep = plugin().endpoint();
+  auto ep = plugin().endpoint(c->dev);
   if (ep) {
     // isend "done" means copied to the engine tx queue; NCCL's contract is
     // that completed sends are delivered, so drain the queue into the
@@ -387,11 +456,11 @@ int pi_close_listen(void* listen_comm) {
   auto* lc = static_cast<ListenComm*>(listen_comm);
   Plugin& p = plugin();
   std::lock_guard<std::mutex> lk(p.mtx);
-  p.live_listens.erase(lc->listen_id);
+  p.listen_dev.erase(lc->listen_id);
   auto it = p.pending_accepts.find(lc->listen_id);
   if (it != p.pending_accepts.end()) {
     // conns queued for this listen will never be accepted: release them
-    if (auto ep = p.endpoint_locked()) {
+    if (auto ep = p.endpoint_locked(lc->dev)) {
       for (uint64_t conn : it->second) ep->remove_conn(conn);
     }
     p.pending_accepts.erase(it);
@@ -403,8 +472,12 @@ int pi_close_listen(void* listen_comm) {
 int pi_finalize(void) {
   Plugin& p = plugin();
   std::lock_guard<std::mutex> lk(p.mtx);
-  p.ep.reset();  // in-flight calls hold shared_ptr copies; last one destroys
-  p.live_listens.clear();
+  // in-flight calls hold shared_ptr copies; the last one destroys. Clearing
+  // nic_ips lets a re-init re-read UCCL_TPU_NIC_LIST.
+  p.eps.clear();
+  p.nic_ips.clear();
+  p.nic_list_explicit = false;
+  p.listen_dev.clear();
   p.pending_accepts.clear();
   return UCCLT_NET_OK;
 }

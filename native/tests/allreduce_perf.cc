@@ -220,12 +220,20 @@ int run_rank(int rank, int n, int oob_fd, const char* plugin_path,
   }
   if (net->init() != UCCLT_NET_OK) return 2;
 
+  // Multi-NIC: ranks round-robin across the plugin's logical devices
+  // (reference: NCCL schedules channels across the devices nccl_plugin.cc
+  // enumerates). With UCCL_TPU_NIC_LIST set this exercises listens bound to
+  // distinct NICs and cross-device dials in one ring.
+  int ndev = 1;
+  if (net->devices(&ndev) != UCCLT_NET_OK || ndev < 1) return 2;
+  int dev = rank % ndev;
+
   // Rendezvous: ship my listen handle to the parent, get back the handle of
   // the rank I connect to (next in ring). This is the out-of-band channel
   // the plugin contract assumes (NCCL ships handles via its bootstrap).
   char handle[UCCLT_NET_HANDLE_BYTES];
   void* listen_comm = nullptr;
-  if (net->listen(0, handle, &listen_comm) != UCCLT_NET_OK) return 2;
+  if (net->listen(dev, handle, &listen_comm) != UCCLT_NET_OK) return 2;
   if (!write_all(oob_fd, handle, sizeof(handle))) return 2;
   char next_handle[UCCLT_NET_HANDLE_BYTES];
   if (!read_all(oob_fd, next_handle, sizeof(next_handle))) return 2;
@@ -234,7 +242,8 @@ int run_rank(int rank, int n, int oob_fd, const char* plugin_path,
   ring.net = net;
   ring.rank = rank;
   ring.nranks = n;
-  if (net->connect(0, next_handle, &ring.send_comm) != UCCLT_NET_OK) return 2;
+  if (net->connect(dev, next_handle, &ring.send_comm) != UCCLT_NET_OK)
+    return 2;
   if (net->accept(listen_comm, &ring.recv_comm) != UCCLT_NET_OK) return 2;
   if (net->reg_mr(ring.send_comm, &ring.tok_out, 1, 0, &ring.tok_out_mr) !=
       UCCLT_NET_OK)

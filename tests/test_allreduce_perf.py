@@ -61,3 +61,20 @@ def test_allreduce_perf_ragged_segments(binaries, world, bytes_):
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "# OK" in r.stdout
+
+
+def test_allreduce_perf_multi_nic_devices(binaries):
+    """UCCL_TPU_NIC_LIST exposes one plugin device per NIC (reference:
+    nccl_plugin.cc device enumeration); ranks round-robin devices, so this
+    ring crosses two logical devices bound to distinct loopback NICs."""
+    exe, plugin = binaries
+    env = dict(os.environ, UCCL_TPU_NIC_LIST="127.0.0.41,127.0.0.42")
+    r = subprocess.run(
+        [exe, "-n", "2", "-b", "1024", "-e", "16384", "-i", "2",
+         "-w", "1", "-p", plugin],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "# OK" in r.stdout
+    for row in [l for l in r.stdout.splitlines() if not l.startswith("#")]:
+        assert row.split()[-1] == "0"

@@ -21,6 +21,7 @@ OK, ERR = 0, -1
 class Props(ctypes.Structure):
     _fields_ = [
         ("name", ctypes.c_char * 32),
+        ("addr", ctypes.c_char * 64),
         ("speed_mbps", ctypes.c_int),
         ("port", ctypes.c_int),
         ("max_comms", ctypes.c_int),
@@ -217,3 +218,67 @@ class TestVtable:
         assert rc_r == ERR
         net.close_recv(rc)
         net.close_listen(lc)
+
+
+class TestMultiNicDevices:
+    """One plugin device per UCCL_TPU_NIC_LIST entry (reference:
+    nccl_plugin.cc enumerates one device per NIC). Runs in a subprocess:
+    the plugin singleton in THIS process may already be initialized with
+    the default single device."""
+
+    def test_enumeration_props_and_cross_device_traffic(self, tmp_path):
+        code = r"""
+import ctypes, os, sys
+sys.path.insert(0, "@TESTDIR@"); sys.path.insert(0, "@REPO@")
+from test_net_plugin import NetV1, Props, OK, _wait
+so = ctypes.CDLL("@SOPATH@")
+net = NetV1.in_dll(so, "ucclt_net_v1")
+assert net.init() == OK
+n = ctypes.c_int(0)
+assert net.devices(ctypes.byref(n)) == OK and n.value == 2, n.value
+for dev, ip in ((0, b"127.0.0.41"), (1, b"127.0.0.42")):
+    p = Props()
+    assert net.get_properties(dev, ctypes.byref(p)) == OK
+    assert p.name == b"uccl_tpu_dcn%d" % dev
+    assert p.addr == ip
+    assert p.port > 0
+p0, p1 = Props(), Props()
+net.get_properties(0, ctypes.byref(p0)); net.get_properties(1, ctypes.byref(p1))
+assert p0.port != p1.port  # distinct endpoints
+# listen on dev 1, dial from dev 0: cross-device conn moves real bytes
+h = ctypes.create_string_buffer(128)
+lc = ctypes.c_void_p()
+assert net.listen(1, h, ctypes.byref(lc)) == OK
+sc, rc = ctypes.c_void_p(), ctypes.c_void_p()
+assert net.connect(0, h, ctypes.byref(sc)) == OK
+assert net.accept(lc, ctypes.byref(rc)) == OK
+payload = os.urandom(50_000)
+sbuf = ctypes.create_string_buffer(payload, len(payload))
+rbuf = ctypes.create_string_buffer(len(payload))
+req_r = ctypes.c_void_p()
+assert net.irecv(rc, rbuf, len(payload), 7, None, ctypes.byref(req_r)) == OK
+req_s = ctypes.c_void_p()
+assert net.isend(sc, sbuf, len(payload), 7, None, ctypes.byref(req_s)) == OK
+_wait(net, req_s)
+code_, size = _wait(net, req_r)
+assert code_ == OK and size == len(payload)
+assert rbuf.raw[:size] == payload
+print("MULTI_NIC_OK")
+"""
+        import subprocess as sp
+        import sys as _sys
+
+        script = tmp_path / "multi_nic.py"
+        script.write_text(
+            code.replace("@TESTDIR@", os.path.dirname(os.path.abspath(__file__)))
+            .replace("@REPO@", os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            .replace("@SOPATH@", net_plugin_path())
+        )
+        env = dict(os.environ, UCCL_TPU_NIC_LIST="127.0.0.41,127.0.0.42")
+        r = sp.run(
+            [_sys.executable, str(script)], capture_output=True, text=True,
+            timeout=120, env=env,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "MULTI_NIC_OK" in r.stdout

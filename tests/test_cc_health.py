@@ -198,3 +198,80 @@ class TestChannelCC:
         finally:
             c_chan.disable_cc()
             client.close(); server.close()
+
+
+class TestProbeIsolation:
+    """CC probes must not ride the control path (VERDICT round-2 weak #7):
+    a large in-flight control message on path 0 queues ahead of a same-conn
+    probe and inflates its RTT with zero network congestion. Probes ride the
+    LAST path instead."""
+
+    def test_probe_conn_is_last_path(self):
+        from uccl_tpu.p2p.channel import Channel
+
+        chan = Channel.__new__(Channel)
+        chan.conns = [10, 11, 12]
+        assert chan.probe_conn == 12
+        chan.conns = [10]
+        assert chan.probe_conn == 10
+
+    def test_probe_rtt_immune_to_control_hol(self):
+        """While a control burst saturates path 0, a probe on the isolated
+        path stays fast; the same probe ON path 0 queues behind the burst.
+        Relative comparison (isolated < busy/4) keeps the test robust to
+        absolute machine speed."""
+        import threading
+        import time as _time
+
+        import numpy as np
+
+        from uccl_tpu.p2p.cc import RateController, TimelyCC
+
+        server = Endpoint(n_engines=2)
+        client = Endpoint(n_engines=2)
+        try:
+            import threading as _th
+
+            from uccl_tpu.p2p.channel import Channel
+
+            result = {}
+            t = _th.Thread(
+                target=lambda: result.setdefault("c", Channel.accept(server))
+            )
+            t.start()
+            c_chan = Channel.connect(
+                client, "127.0.0.1", server.port, n_paths=2
+            )
+            t.join(timeout=20)
+            s_chan = result["c"]
+            assert c_chan.probe_conn != c_chan.conns[0]
+
+            rc = RateController(client, TimelyCC())
+            burst = np.zeros(16 << 20, np.uint8)  # 16 MB control messages
+
+            def control_burst():
+                for _ in range(4):
+                    c_chan.send(burst)  # path 0, FIFO ahead of any probe
+
+            def timed_probe(conn):
+                t0 = _time.perf_counter()
+                rc.probe(conn, c_chan._peer_probe_fifo, timeout_ms=20000)
+                return _time.perf_counter() - t0
+
+            # drain thread on the server so the burst completes
+            drained = _th.Thread(
+                target=lambda: [s_chan.recv(max_bytes=16 << 20,
+                                            timeout_ms=30000)
+                                for _ in range(4)]
+            )
+            hol = _th.Thread(target=control_burst)
+            drained.start(); hol.start()
+            _time.sleep(0.05)  # let the burst occupy path 0's tx queue
+            t_isolated = timed_probe(c_chan.probe_conn)
+            t_busy = timed_probe(c_chan.conns[0])
+            hol.join(timeout=60); drained.join(timeout=60)
+            assert t_isolated < max(t_busy / 4, 0.005), (
+                f"isolated {t_isolated*1e3:.1f}ms vs busy {t_busy*1e3:.1f}ms"
+            )
+        finally:
+            client.close(); server.close()

@@ -1,0 +1,167 @@
+"""EQDS-style receiver-driven pull mode (reference include/cc/eqds.h; pacer
+collective/rdma/eqds.h:93): senders issue chunks only under receiver
+credit, a PullPacer fair-shares the receiver's downlink across inbound
+channels, and credits ride the isolated probe path as one-sided writes."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from uccl_tpu.p2p import Endpoint, PullPacer
+from uccl_tpu.p2p.channel import Channel
+
+
+def _chan_pair(server, client, n_paths=2):
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.setdefault("c", Channel.accept(server))
+    )
+    t.start()
+    c_chan = Channel.connect(client, "127.0.0.1", server.port, n_paths=n_paths)
+    t.join(timeout=20)
+    return result["c"], c_chan
+
+
+class TestPullMode:
+    def test_write_blocks_until_credit(self):
+        """No credit => the pull-mode write stalls; granting releases it at
+        chunk granularity."""
+        with Endpoint(n_engines=2) as server, Endpoint(n_engines=2) as client:
+            s_chan, c_chan = _chan_pair(server, client)
+            c_chan.chunk_bytes = 64 << 10
+            c_chan.enable_pull_sender()
+            dst = np.zeros(256 << 10, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            src = np.arange(256 << 10, dtype=np.uint8) % 251
+
+            done = threading.Event()
+
+            def tx():
+                c_chan.write(src, fifo, timeout_ms=30000)
+                done.set()
+
+            t = threading.Thread(target=tx)
+            t.start()
+            time.sleep(0.15)
+            assert not done.is_set(), "write proceeded without any credit"
+            s_chan.grant_credit(128 << 10)  # half: still blocked
+            time.sleep(0.2)
+            assert not done.is_set(), "write finished on partial credit"
+            s_chan.grant_credit(128 << 10)  # remainder
+            t.join(timeout=20)
+            assert done.is_set()
+            np.testing.assert_array_equal(dst, src)
+            assert c_chan.pull_credit == 256 << 10
+            assert s_chan.pull_granted == 256 << 10
+
+    def test_credit_stall_times_out(self):
+        with Endpoint(n_engines=2) as server, Endpoint(n_engines=2) as client:
+            s_chan, c_chan = _chan_pair(server, client)
+            c_chan.enable_pull_sender()
+            dst = np.zeros(4096, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            with pytest.raises(TimeoutError, match="pull credit stalled"):
+                c_chan.write(np.ones(4096, np.uint8), fifo, timeout_ms=300)
+
+    def test_pacer_rate_bounds_transfer(self):
+        """8 MB at a 32 MB/s grant rate cannot finish in under ~200 ms (the
+        pacer is the clock; generous bound for a 1-core sandbox)."""
+        with Endpoint(n_engines=2) as server, Endpoint(n_engines=2) as client:
+            s_chan, c_chan = _chan_pair(server, client)
+            c_chan.chunk_bytes = 256 << 10
+            c_chan.enable_pull_sender()
+            total = 8 << 20
+            dst = np.zeros(total, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            src = (np.arange(total) % 256).astype(np.uint8)
+            pacer = PullPacer(32e6, tick_s=0.002)
+            pacer.attach(s_chan)
+            pacer.start()
+            try:
+                t0 = time.perf_counter()
+                c_chan.write(src, fifo, timeout_ms=60000)
+                dt = time.perf_counter() - t0
+            finally:
+                pacer.stop()
+            np.testing.assert_array_equal(dst, src)
+            assert dt > 0.2, f"8MB at 32MB/s finished in {dt*1e3:.0f}ms"
+
+    def test_pacer_fair_shares_incast(self):
+        """Two pull-mode senders into one receiver: the pacer splits grants
+        evenly, so granted totals track each other."""
+        with Endpoint(n_engines=2) as server, \
+             Endpoint(n_engines=2) as c1, Endpoint(n_engines=2) as c2:
+            s1, ch1 = _chan_pair(server, c1)
+            s2, ch2 = _chan_pair(server, c2)
+            for ch in (ch1, ch2):
+                ch.chunk_bytes = 128 << 10
+                ch.enable_pull_sender()
+            total = 2 << 20
+            d1 = np.zeros(total, np.uint8)
+            d2 = np.zeros(total, np.uint8)
+            f1 = server.advertise(server.reg(d1))
+            f2 = server.advertise(server.reg(d2))
+            src = (np.arange(total) % 256).astype(np.uint8)
+            pacer = PullPacer(64e6, tick_s=0.002)
+            pacer.attach(s1)
+            pacer.attach(s2)
+            pacer.start()
+            try:
+                ts = [
+                    threading.Thread(
+                        target=lambda ch=ch, f=f: ch.write(src, f, timeout_ms=60000)
+                    )
+                    for ch, f in ((ch1, f1), (ch2, f2))
+                ]
+                [t.start() for t in ts]
+                [t.join(timeout=60) for t in ts]
+            finally:
+                pacer.stop(flush_bytes=total)
+            np.testing.assert_array_equal(d1, src)
+            np.testing.assert_array_equal(d2, src)
+            g1, g2 = s1.pull_granted, s2.pull_granted
+            assert abs(g1 - g2) <= max(g1, g2) * 0.25 + (2 << 20), (g1, g2)
+
+    def test_normal_channels_unaffected(self):
+        """Channels that never enable pull mode keep push semantics."""
+        with Endpoint(n_engines=2) as server, Endpoint(n_engines=2) as client:
+            s_chan, c_chan = _chan_pair(server, client)
+            dst = np.zeros(4096, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            src = np.ones(4096, np.uint8)
+            c_chan.write(src, fifo)
+            np.testing.assert_array_equal(dst, src)
+
+
+class TestPullReenable:
+    def test_reenable_does_not_inherit_stale_credit(self):
+        """Credits are cumulative per connection; a re-enabled sender must
+        baseline at the current grant, not treat history as fresh credit."""
+        with Endpoint(n_engines=2) as server, Endpoint(n_engines=2) as client:
+            s_chan, c_chan = _chan_pair(server, client)
+            c_chan.chunk_bytes = 16 << 10
+            c_chan.enable_pull_sender()
+            dst = np.zeros(64 << 10, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            src = np.arange(64 << 10, dtype=np.uint8) % 251
+            s_chan.grant_credit(64 << 10)
+            c_chan.write(src, fifo, timeout_ms=20000)  # consumes all credit
+            np.testing.assert_array_equal(dst, src)
+
+            c_chan.disable_pull_sender()
+            c_chan.enable_pull_sender()  # baseline = 64 KiB already granted
+            done = threading.Event()
+
+            def tx():
+                c_chan.write(src, fifo, timeout_ms=30000)
+                done.set()
+
+            t = threading.Thread(target=tx)
+            t.start()
+            time.sleep(0.2)
+            assert not done.is_set(), "re-enable inherited stale credit"
+            s_chan.grant_credit(64 << 10)  # NEW credit releases it
+            t.join(timeout=20)
+            assert done.is_set()

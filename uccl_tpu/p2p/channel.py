@@ -95,6 +95,15 @@ class Channel:
         self._cc_stop = None
         self._cc_thread = None
         self.cc: Optional[object] = None  # active RateController, if any
+        # EQDS-style pull mode (receiver-driven credit; reference
+        # include/cc/eqds.h, pacer collective/rdma/eqds.h:93): the peer
+        # one-sided-writes a CUMULATIVE byte allowance into _credit_buf;
+        # when _pull_mode is set, write() gates chunk issue on it.
+        self._credit_buf = None
+        self._credit_mr = None
+        self._peer_credit_fifo: Optional[bytes] = None
+        self._pull_mode = False
+        self._pull_sent = 0  # cumulative bytes issued under pull mode
 
     def _exchange_probe_window(self, timeout_ms: int = 10000) -> None:
         """Mint a 1-byte scratch window and swap descriptors with the peer on
@@ -114,6 +123,16 @@ class Channel:
         if not msg.startswith(b"PF") or len(msg) != 2 + FIFO_ITEM_BYTES:
             raise IOError(f"probe-window exchange broken: {msg[:8]!r}")
         self._peer_probe_fifo = msg[2:]
+        # credit window for EQDS-style pull mode: the peer writes a
+        # cumulative uint64 byte allowance here (same eager rationale as PF)
+        self._credit_buf = np.zeros(1, np.uint64)
+        self._credit_mr = self.ep.reg(self._credit_buf)
+        cw = self.ep.advertise(self._credit_mr)
+        self.ep.send(self.conns[0], b"CW" + cw)
+        msg = self.ep.recv(self.conns[0], timeout_ms=timeout_ms)
+        if not msg.startswith(b"CW") or len(msg) != 2 + FIFO_ITEM_BYTES:
+            raise IOError(f"credit-window exchange broken: {msg[:8]!r}")
+        self._peer_credit_fifo = msg[2:]
 
     # -- congestion control (reference: CC in the transport hot path,
     # transport.cc:2845 EventOnRxACK; here a per-channel probe thread
@@ -127,9 +146,9 @@ class Channel:
         """Start the background delay-probe thread driving the pacer.
 
         ``algo``: "timely" (RTT gradient) or "swift" (delay-target window).
-        Probes ride this channel's path 0 into the peer's scratch window;
-        timed-out probes feed the controller the full timeout (loss is a
-        congestion signal)."""
+        Probes ride the channel's LAST path into the peer's scratch window
+        (see :meth:`probe_conn`); timed-out probes feed the controller the
+        full timeout (loss is a congestion signal)."""
         import threading
 
         from uccl_tpu.p2p.cc import RateController, SwiftCC, TimelyCC
@@ -167,7 +186,8 @@ class Channel:
             try:
                 while not self._cc_stop.wait(interval_s):
                     rc.probe(
-                        self.conns[0], self._peer_probe_fifo, probe_timeout_ms
+                        self.probe_conn, self._peer_probe_fifo,
+                        probe_timeout_ms,
                     )
             except Exception:
                 pass  # endpoint/conn closed under us
@@ -188,6 +208,52 @@ class Channel:
         self._cc_thread.join(timeout=5)
         self._cc_thread = None
         self.ep.set_rate_limit(0)
+
+    # -- EQDS-style receiver-driven pull mode ------------------------------
+    def enable_pull_sender(self) -> None:
+        """Gate this channel's writes on receiver credit (EQDS pull mode,
+        reference include/cc/eqds.h). Until the peer grants (via
+        :class:`uccl_tpu.p2p.eqds.PullPacer` or :meth:`grant_credit`),
+        writes block at chunk granularity.
+
+        The grant counter is cumulative over the CONNECTION (never reset —
+        zeroing it would race in-flight grant writes, and a re-enable would
+        otherwise inherit all historically granted bytes as free unpaced
+        credit). Gating instead resumes from the current cumulative grant:
+        bytes issued while pull mode was off are treated as already
+        licensed, and new issues wait for NEW credit."""
+        if self._peer_credit_fifo is None:
+            raise RuntimeError(
+                "channel has no credit window (built without a handshake?)"
+            )
+        self._pull_sent = int(self._credit_buf[0])
+        self._pull_mode = True
+
+    def disable_pull_sender(self) -> None:
+        self._pull_mode = False
+
+    @property
+    def pull_credit(self) -> int:
+        """Cumulative bytes the peer has licensed us to send."""
+        return int(self._credit_buf[0])
+
+    @property
+    def pull_granted(self) -> int:
+        """Cumulative bytes WE have granted the peer (receiver side)."""
+        return getattr(self, "_granted", 0)
+
+    def grant_credit(self, nbytes: int) -> int:
+        """Receiver side: extend the peer's cumulative allowance by
+        ``nbytes`` — one 8-byte one-sided write into the peer's credit
+        window on the isolated probe path (ordered per conn, so the
+        cumulative counter is monotonic on the peer). Returns the new
+        cumulative grant. The EQDS 'pull quantum'."""
+        if self._peer_credit_fifo is None:
+            raise RuntimeError("channel has no peer credit window")
+        self._granted = getattr(self, "_granted", 0) + int(nbytes)
+        arr = np.asarray([self._granted], np.uint64)
+        self.ep.write(self.probe_conn, arr, self._peer_credit_fifo)
+        return self._granted
 
     @classmethod
     def connect(
@@ -259,6 +325,18 @@ class Channel:
     def n_paths(self) -> int:
         return len(self.conns)
 
+    @property
+    def probe_conn(self) -> int:
+        """The conn CC delay probes ride: the LAST path when there is more
+        than one. Path 0 also carries application control messages, whose
+        frames queue ahead of a probe on the same conn — a multi-MB control
+        message would then inflate probe RTT and collapse the rate with no
+        network congestion at all (per-conn queues are FIFO). The last data
+        path shares the data plane's fate — queueing behind striped data
+        chunks IS the congestion signal delay-CC wants — without the
+        control-plane noise. Single-path channels have no choice."""
+        return self.conns[-1] if len(self.conns) > 1 else self.conns[0]
+
     # -- control-plane helpers (ride path 0, ordered) ----------------------
     def send(self, data) -> None:
         self.ep.send(self.conns[0], data)
@@ -276,31 +354,72 @@ class Channel:
     def _flat_view(arr: np.ndarray) -> np.ndarray:
         if not arr.flags["C_CONTIGUOUS"]:
             raise ValueError("channel transfers need C-contiguous arrays")
-        return arr.view(np.uint8).reshape(-1)
+        # reshape BEFORE the uint8 view: a 0-d row (e.g. a 1-D all_to_all's
+        # scalar slice) rejects view() but reshapes to (1,) for free
+        return arr.reshape(-1).view(np.uint8)
+
+    def _await_credit(self, needed: int, timeout_ms: int) -> None:
+        """Block until the peer's cumulative grant covers ``needed`` bytes.
+
+        The receiver one-sided-writes a growing uint64 into our credit
+        window (ordered per conn, so the counter never regresses); polling
+        local memory costs nothing on the wire — the EQDS pull-quanta
+        mechanism with the grant carried by an RDMA-style write instead of a
+        pull packet."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_ms / 1e3
+        while int(self._credit_buf[0]) < needed:
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pull credit stalled: need {needed}, have "
+                    f"{int(self._credit_buf[0])}"
+                )
+            _time.sleep(0.0005)
 
     def _spray(self, arr, fifo, sync_op, async_op, timeout_ms: int) -> None:
         """Shared chunk fan-out for one-sided ops: small transfers take the
-        single-path sync op; large ones split round-robin across paths."""
+        single-path sync op; large ones split round-robin across paths.
+        Under pull mode every chunk issue is licensed by receiver credit."""
         item = FifoItem.unpack(fifo)
+        if not isinstance(arr, np.ndarray):
+            # lists/bytes would be silently copied — fatal on the read path
+            # (the transfer would land in a discarded temporary)
+            raise TypeError(
+                f"channel transfers need numpy arrays, got {type(arr)}"
+            )
+        if arr.ndim == 0:
+            arr = arr.reshape(1)  # 0-d → (1,) view: same memory, both paths
         flat = self._flat_view(arr)
         total = flat.nbytes
         if total <= self.chunk_bytes or self.n_paths == 1:
+            if self._pull_mode:
+                self._await_credit(self._pull_sent + total, timeout_ms)
+                self._pull_sent += total
             sync_op(self.conns[0], arr, fifo)
             return
-        xids = [
-            async_op(
-                self.conns[i % self.n_paths],
-                flat[off : off + ln],
-                item.slice(off, ln).pack(),
+        xids = []
+        for i, (off, ln) in enumerate(self._chunks(total)):
+            if self._pull_mode:
+                self._await_credit(self._pull_sent + ln, timeout_ms)
+                self._pull_sent += ln
+            xids.append(
+                async_op(
+                    self.conns[i % self.n_paths],
+                    flat[off : off + ln],
+                    item.slice(off, ln).pack(),
+                )
             )
-            for i, (off, ln) in enumerate(self._chunks(total))
-        ]
         for x in xids:
             if not self.ep.wait(x, timeout_ms):
                 raise IOError("chunked transfer failed")
 
     def write(self, src: np.ndarray, fifo: bytes, timeout_ms: int = 60000) -> None:
         """Spray `src` into the peer's advertised window across all paths."""
+        if isinstance(src, np.generic):
+            # numpy scalar (e.g. a 1-D array's row slice): value-copy is
+            # fine for a TX source — never for a read destination
+            src = np.asarray(src).reshape(1)
         self._spray(src, fifo, self.ep.write, self.ep.write_async, timeout_ms)
 
     def write_compressed(
@@ -334,12 +453,14 @@ class Channel:
 
     def close(self) -> None:
         self.disable_cc()
-        if self._probe_mr is not None:
-            try:
-                self.ep.dereg(self._probe_mr)
-            except Exception:
-                pass  # endpoint already closed
-            self._probe_mr = None
+        for attr in ("_probe_mr", "_credit_mr"):
+            mr = getattr(self, attr)
+            if mr is not None:
+                try:
+                    self.ep.dereg(mr)
+                except Exception:
+                    pass  # endpoint already closed
+                setattr(self, attr, None)
         for c in self.conns:
             self.ep.remove_conn(c)
 
@@ -354,15 +475,16 @@ class ChannelAcceptor:
     (called on the acceptor thread; ``chan.meta`` identifies the dialer)."""
 
     # Worst-case blocking inside the loop: one accept (200ms) + one hello
-    # recv + one probe-window exchange recv (each _HELLO_TIMEOUT_MS).
-    # close() must join for longer than their sum so the native endpoint is
-    # never destroyed under a thread inside a C call.
+    # recv + the setup-exchange recvs (PF probe window AND CW credit
+    # window), each _HELLO_TIMEOUT_MS. close() must join for longer than
+    # their sum so the native endpoint is never destroyed under a thread
+    # inside a C call.
     _HELLO_TIMEOUT_MS = 2000
     _PARTIAL_TTL_S = 30.0
 
     @classmethod
     def _join_timeout_s(cls) -> float:
-        return 0.2 + 2 * (cls._HELLO_TIMEOUT_MS / 1000.0) + 1.0
+        return 0.2 + 3 * (cls._HELLO_TIMEOUT_MS / 1000.0) + 1.0
 
     def __init__(self, ep: Endpoint, on_channel, chunk_bytes: Optional[int] = None):
         import threading

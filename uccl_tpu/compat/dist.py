@@ -9,6 +9,12 @@ with a changed import. Device-side (on-mesh) collectives live in
 
 Ops mutate in place like torch.distributed: ``all_reduce(x)`` leaves the
 global sum in ``x``.
+
+Device arrays are first-class: passing a ``jax.Array`` stages it to host,
+runs the DCN collective, and RETURNS a new device array placed with the
+input's sharding (jax arrays are immutable, so the torch in-place contract
+becomes a functional one — ``x = dist.all_reduce(x)``). numpy inputs keep
+the exact torch.distributed in-place semantics.
 """
 
 from __future__ import annotations
@@ -80,38 +86,91 @@ def get_world_size() -> int:
     return _require().active_world
 
 
-def all_reduce(x: np.ndarray) -> None:
-    """In-place sum across the group (torch.distributed semantics)."""
+def _as_jax(x):
+    """(is_jax, host_view): stage a jax.Array to host, pass numpy through."""
+    try:
+        import jax
+
+        if isinstance(x, jax.Array):
+            return True, np.asarray(x)
+    except ImportError:  # pragma: no cover - jax is a hard dep in practice
+        pass
+    return False, x
+
+
+def _placed_like(host: np.ndarray, ref):
+    """Put a host result back on ref's device/sharding."""
+    import jax
+
+    return jax.device_put(host, ref.sharding)
+
+
+def all_reduce(x):
+    """Sum across the group. numpy: in place (torch.distributed semantics),
+    returns None. jax.Array: returns the reduced array placed with x's
+    sharding (jax arrays are immutable)."""
     g = _require()
+    is_jax, host = _as_jax(x)
+    if is_jax:
+        return _placed_like(g.all_reduce(host), x)
     x[...] = g.all_reduce(x)
+    return None
 
 
-def all_gather(out_list: List[np.ndarray], x: np.ndarray) -> None:
+def all_gather(out_list: Optional[List[np.ndarray]], x):
     """Fill out_list[i] with the i-th ACTIVE rank's x (== rank i before any
-    heal; after a heal, positions close the gap and the list shrinks)."""
+    heal; after a heal, positions close the gap and the list shrinks).
+    jax.Array input: pass ``out_list=None`` and receive the gathered list of
+    device arrays as the return value."""
     g = _require()
-    if len(out_list) != g.active_world:
+    is_jax, host = _as_jax(x)
+    if is_jax and out_list is not None:
+        # jax arrays are immutable — filling out_list is impossible, and
+        # silently ignoring it would hand torch-ported callers stale buffers
         raise ValueError(
-            f"out_list has {len(out_list)} entries; active world size is "
-            f"{g.active_world}"
+            "all_gather with a jax.Array input takes out_list=None and "
+            "returns the gathered list"
         )
-    gathered = g.all_gather(x)
+    gathered = g.all_gather(host)
+    if is_jax:
+        return [_placed_like(gathered[i], x) for i in range(g.active_world)]
+    if out_list is None or len(out_list) != g.active_world:
+        raise ValueError(
+            f"out_list has {0 if out_list is None else len(out_list)} "
+            f"entries; active world size is {g.active_world}"
+        )
     for i in range(g.active_world):
         out_list[i][...] = gathered[i]
+    return None
 
 
-def all_to_all(out: np.ndarray, x: np.ndarray) -> None:
+def all_to_all(out: Optional[np.ndarray], x):
     """out[i] receives the i-th active rank's row for us; x[j] goes to the
-    j-th active rank."""
+    j-th active rank. jax.Array input: pass ``out=None`` and take the result
+    as the return value."""
     g = _require()
+    is_jax, host = _as_jax(x)
+    if is_jax:
+        if out is not None:
+            raise ValueError(
+                "all_to_all with a jax.Array input takes out=None and "
+                "returns the result"
+            )
+        return _placed_like(g.all_to_all(host), x)
     out[...] = g.all_to_all(x)
+    return None
 
 
-def broadcast(x: np.ndarray, src: int = 0) -> None:
-    """In-place: every rank ends with src's x (binomial tree over the DCN
-    full mesh — log(world) rounds, no gather blow-up)."""
+def broadcast(x, src: int = 0):
+    """Every rank ends with src's x (binomial tree over the DCN full mesh —
+    log(world) rounds, no gather blow-up). numpy: in place; jax.Array:
+    returned."""
     g = _require()
+    is_jax, host = _as_jax(x)
+    if is_jax:
+        return _placed_like(g.broadcast(host, root=src), x)
     x[...] = g.broadcast(x, root=src)
+    return None
 
 
 def barrier() -> None:

@@ -539,3 +539,39 @@ class TestCrossPodCaches:
         va = moe._local_vjp(shape_key, fn_a)
         vb = moe._local_vjp(shape_key, fn_b)
         assert va is not vb
+
+
+class TestBufferStats:
+    """Per-op EP stats (reference: Stats class bound at uccl_ep.cc:2411)."""
+
+    def test_counters_and_drop_aggregates(self, devices):
+        import jax.numpy as jnp
+
+        from uccl_tpu.ep import Buffer
+        from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=8), devices)
+        e, t, k, h, w = 8, 16, 2, 32, 8
+        buf = Buffer(mesh, num_experts=e, capacity_factor=0.25)  # tight: drops
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((w, t, h)).astype(np.float32))
+        idx = jnp.asarray(rng.integers(0, e, (w, t, k)).astype(np.int32))
+        recv, handle = buf.dispatch(x, idx)
+        buf.combine(recv, handle)
+        rx, counts, ll_handle = buf.low_latency_dispatch(
+            x, idx, wire="dense", wire_fp8=False
+        )
+        buf.low_latency_combine(rx, ll_handle)
+        s = buf.stats()
+        assert s["ops"]["dispatch"] == 1
+        assert s["ops"]["combine"] == 1
+        assert s["ops"]["low_latency_dispatch"] == 1
+        assert s["ops"]["low_latency_combine"] == 1
+        d = s["dispatch"]
+        assert d["routed_rows"] == w * t * k
+        assert d["kept_rows"] + d["dropped_rows"] == d["routed_rows"]
+        assert d["dropped_rows"] > 0  # cf=0.25 must drop
+        assert 0 < d["drop_fraction"] < 1
+        ll = s["low_latency"]
+        assert ll["recv_rows"] == w * t * k  # LL default bound is lossless
+        assert ll["wire_payload_bytes"] == ll["recv_rows"] * h * 2

@@ -84,6 +84,17 @@ class Buffer:
         self.num_selected = num_selected
         self.capacity_factor = capacity_factor
         self._cache = {}
+        # per-op stats (reference: EP Stats bound at uccl_ep.cc:2411 and the
+        # dispatch_wait_recv_cost_stats tensor plumbed through
+        # internode_ll.cu:66): op counters update eagerly; row/byte
+        # aggregates are computed lazily from saved device refs in stats()
+        self._op_counts = {
+            "dispatch": 0, "combine": 0,
+            "low_latency_dispatch": 0, "low_latency_combine": 0,
+            "get_dispatch_layout": 0,
+        }
+        self._last_dispatch = None  # (topk_idx ref, capacity)
+        self._last_ll = None  # (group_sizes ref, r_max, hidden, wire_fp8)
 
     # ------------------------------------------------------------------
     def _axis_name(self):
@@ -108,6 +119,49 @@ class Buffer:
             )
             self._cache[key] = cached
         return cached
+
+    def stats(self) -> dict:
+        """Per-op EP stats (reference: the `Stats` class bound at
+        uccl_ep.cc:2411 + the dispatch cost tensors internode_ll.cu:66):
+        op counters plus aggregates of the LAST dispatch of each mode —
+        routed/kept/dropped rows for the capacity path (computed from the
+        routing demand vs capacity, the exact drop rule of the sorted
+        layout), and recv rows + approximate wire payload bytes for the
+        low-latency path. Reading materializes saved device values (a sync
+        point) — call it off the hot loop, like the reference's stats
+        thread."""
+        out = {"ops": dict(self._op_counts)}
+        if self._last_dispatch is not None:
+            idx, cap = self._last_dispatch
+            idx_np = np.asarray(idx)  # [W, T, K]
+            # capacity bounds each SOURCE shard's rows per expert (the
+            # sorted layout assigns cap slots per expert per shard), so the
+            # drop rule applies shard-wise before summing
+            routed = kept = 0
+            for r in range(idx_np.shape[0]):
+                d = np.bincount(
+                    idx_np[r].reshape(-1).clip(min=0),
+                    minlength=self.num_experts,
+                )
+                routed += int(d.sum())
+                kept += int(np.minimum(d, cap).sum())
+            out["dispatch"] = {
+                "capacity": int(cap),
+                "routed_rows": routed,
+                "kept_rows": kept,
+                "dropped_rows": routed - kept,
+                "drop_fraction": float((routed - kept) / max(1, routed)),
+            }
+        if self._last_ll is not None:
+            counts, r_max, hidden, wire_fp8 = self._last_ll
+            rows = int(np.asarray(counts).sum())
+            payload = hidden * (1 if wire_fp8 else 2)
+            out["low_latency"] = {
+                "recv_rows": rows,
+                "r_max_per_rank": int(r_max),
+                "wire_payload_bytes": rows * payload,
+            }
+        return out
 
     def capacity(self, num_tokens: int) -> int:
         return max(
@@ -153,6 +207,7 @@ class Buffer:
             )
 
         fn = self._jit(key, f, (2,), (1, 1, 2))
+        self._op_counts["get_dispatch_layout"] += 1
         return fn(topk_idx)
 
     def dispatch(
@@ -187,6 +242,8 @@ class Buffer:
             topk_weights = jnp.full(topk_idx.shape, 1.0 / k, jnp.float32)
         fn = self._jit(key, f, (2, 2), (3, 2))
         recv, slot = fn(x, topk_idx)
+        self._op_counts["dispatch"] += 1
+        self._last_dispatch = (topk_idx, cap)
         # weights go straight into the handle (combine reshards them itself)
         return recv, DispatchHandle(slot, topk_weights)
 
@@ -207,6 +264,7 @@ class Buffer:
             return out[None]
 
         fn = self._jit(key, f, (3, 2, 2), 2)
+        self._op_counts["combine"] += 1
         return fn(expert_out, handle.slot, handle.weights)
 
     # -- low-latency mode: packed fp8 payloads + recv counts -------------
@@ -268,6 +326,8 @@ class Buffer:
             send_slot, weights, send_mat, recv_mat, regroup,
             src_in_offsets, wire, wire_fp8,
         )
+        self._op_counts["low_latency_dispatch"] += 1
+        self._last_ll = (counts, recv_x.shape[1], x.shape[-1], wire_fp8)
         return recv_x, counts, handle
 
     def low_latency_combine(
@@ -290,6 +350,7 @@ class Buffer:
             return out[None]
 
         fn = self._jit(key, f, (2, 2, 2, 2, 2, 1, 1), 2)
+        self._op_counts["low_latency_combine"] += 1
         return fn(
             expert_out, handle.send_slot, handle.weights, handle.send_mat,
             handle.recv_mat, handle.regroup, handle.src_in_offsets,

@@ -1,0 +1,39 @@
+#!/bin/bash
+# On-chip measurement ladder: run the moment the axon tunnel is healthy.
+#
+# Captures, IN ORDER OF VALUE (the tunnel can wedge mid-session — see
+# memory/tpu-tunnel-discipline), the round's missing TPU evidence:
+#   1. bench.py            — the driver metric (device, MFU, vs_baseline)
+#   2. attention sweep     — flash-vs-XLA crossover at S=1k..8k (fori_loop
+#                            harness: one dispatch, host-scalar sync)
+#   3. ep_bench            — sorted-vs-dense + LL dispatch/combine µs,
+#                            ragged wire (TPU-only lowering)
+# Everything appends to docs/ONCHIP_$(date +%Y%m%d).log; transcribe wins
+# into PERF.md immediately.
+#
+# Usage: scripts/onchip_ladder.sh   (run sequentially; ONE process at a
+# time on the chip — concurrent tunnel access wedges it)
+
+set -u
+cd "$(dirname "$0")/.."
+LOG="docs/ONCHIP_$(date +%Y%m%d).log"
+say() { echo "=== $* ===" | tee -a "$LOG"; }
+
+say "tunnel probe $(date +%H:%M:%S)"
+if ! timeout 150 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds"; then
+  say "tunnel DOWN - aborting ladder"
+  exit 1
+fi
+say "tunnel healthy"
+
+say "1/3 bench.py"
+timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
+
+say "2/3 attention sweep (flash vs xla crossover)"
+timeout 2400 python benchmarks/attention_bench.py \
+  --seqs 1024,2048,4096,8192 --iters 10 2>&1 | tee -a "$LOG"
+
+say "3/3 ep_bench --compare-dense + LL latency"
+timeout 2400 python benchmarks/ep_bench.py --compare-dense 2>&1 | tee -a "$LOG"
+
+say "ladder complete $(date +%H:%M:%S) - transcribe into PERF.md now"

@@ -171,7 +171,11 @@ def _time_steps(step, params, opt_state, tokens, targets, warmup=2, iters=5):
         init = step(params, opt_state, tokens, targets)
         return lax.fori_loop(0, n - 1, body, init)
 
-    run = jax.jit(run)  # n traced -> one compile serves warmup and timing
+    # n traced -> one compile serves warmup and timing. params/opt_state are
+    # DONATED: XLA aliases them into the loop-carried outputs, so the step
+    # never pays an input copy of the largest buffers (each call site
+    # rebinds to the returned state, keeping the donated references dead).
+    run = jax.jit(run, donate_argnums=(0, 1))
     params, opt_state, m = run(params, opt_state, max(1, warmup))
     float(m["loss"])  # sync warmup + compile
     t0 = time.perf_counter()

@@ -21,6 +21,59 @@
 
 namespace uccl_tpu {
 
+// Action recorder (reference wheel_record_t, collective/rdma/
+// timing_wheel.h:31): a bounded ring of (due, fired) pairs capturing how
+// late each item actually fired — the pacing-forensics trail. Overwrites
+// oldest when full; owned by the wheel's thread, no locks.
+struct WheelRecord {
+  uint64_t due_us;
+  uint64_t fired_us;
+  uint64_t lateness_us() const {
+    return fired_us > due_us ? fired_us - due_us : 0;
+  }
+};
+
+class WheelRecorder {
+ public:
+  explicit WheelRecorder(size_t capacity = 4096)
+      : ring_(capacity ? capacity : 1), head_(0), count_(0) {}
+
+  void record(uint64_t due_us, uint64_t fired_us) {
+    ring_[head_] = WheelRecord{due_us, fired_us};
+    head_ = (head_ + 1) % ring_.size();
+    if (count_ < ring_.size()) ++count_;
+  }
+
+  // Oldest-first copy of the retained records.
+  std::vector<WheelRecord> snapshot() const {
+    std::vector<WheelRecord> out;
+    out.reserve(count_);
+    size_t start = (head_ + ring_.size() - count_) % ring_.size();
+    for (size_t i = 0; i < count_; ++i) {
+      out.push_back(ring_[(start + i) % ring_.size()]);
+    }
+    return out;
+  }
+
+  // Max lateness across retained records (the pacing health number).
+  // In-place scan: a stats path may poll this every tick.
+  uint64_t max_lateness_us() const {
+    uint64_t m = 0;
+    for (size_t i = 0; i < count_; ++i) {
+      uint64_t l = ring_[i].lateness_us();
+      if (l > m) m = l;
+    }
+    return m;
+  }
+
+  size_t count() const { return count_; }
+
+ private:
+  std::vector<WheelRecord> ring_;
+  size_t head_;
+  size_t count_;
+};
+
 template <typename T>
 class TimingWheel {
  public:
@@ -41,7 +94,11 @@ class TimingWheel {
   void schedule(uint64_t due_us, T item) {
     uint64_t tick = (due_us + gran_ - 1) / gran_;
     if (tick < cursor_) tick = cursor_;  // past-due: next sweep's slot
-    slots_[tick % slots_.size()].push_back(Entry{tick, std::move(item)});
+    // Entry keeps the ORIGINAL due time: the recorder must measure lateness
+    // against what the caller asked for, not the clamped/rounded slot tick
+    // (a past-due item is exactly the late event the trail exists to show).
+    slots_[tick % slots_.size()].push_back(
+        Entry{tick, due_us, std::move(item)});
     ++size_;
   }
 
@@ -67,6 +124,7 @@ class TimingWheel {
       size_t keep = 0;
       for (size_t i = 0; i < slot.size(); ++i) {
         if (slot[i].tick <= now_tick) {
+          if (rec_ != nullptr) rec_->record(slot[i].due_us, now_us);
           out->push_back(std::move(slot[i].item));
           ++popped;
           --size_;
@@ -84,15 +142,21 @@ class TimingWheel {
   size_t size() const { return size_; }
   bool empty() const { return size_ == 0; }
 
+  // Attach an action recorder (nullptr detaches): every pop logs
+  // (due, fired). Same-thread discipline as the wheel itself.
+  void set_recorder(WheelRecorder* rec) { rec_ = rec; }
+
  private:
   struct Entry {
     uint64_t tick;
+    uint64_t due_us;  // caller's original deadline (recorder ground truth)
     T item;
   };
   uint64_t gran_;
   std::vector<std::vector<Entry>> slots_;
   uint64_t cursor_;  // tick of the last advance (next sweep starts here)
   size_t size_;
+  WheelRecorder* rec_ = nullptr;
 };
 
 }  // namespace uccl_tpu

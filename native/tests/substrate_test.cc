@@ -224,6 +224,40 @@ static void test_timing_wheel() {
   std::puts("timing_wheel ok");
 }
 
+static void test_wheel_recorder() {
+  // the action trail (reference wheel_record_t): every pop logs (due,
+  // fired); lateness is fired - due, never negative; ring overwrites oldest
+  TimingWheel<int> w(/*granularity_us=*/10, /*horizon_slots=*/16);
+  WheelRecorder rec(/*capacity=*/4);
+  w.set_recorder(&rec);
+  std::vector<int> fired;
+  // same-lap, non-aliasing slots so pop (= record) order is due order
+  w.schedule(100, 1);
+  w.schedule(140, 2);
+  w.advance(250, &fired);  // both fire late (at 250)
+  CHECK(rec.count() == 2);
+  auto snap = rec.snapshot();
+  CHECK(snap[0].due_us == 100 && snap[0].fired_us == 250);
+  CHECK(snap[1].due_us == 140 && snap[1].lateness_us() == 110);
+  CHECK(rec.max_lateness_us() == 150);
+  // past-due schedule: lateness measured against the CALLER's deadline,
+  // not the clamped slot tick
+  fired.clear();
+  w.schedule(40, 5);  // cursor is already past tick 4
+  w.advance(260, &fired);
+  CHECK(fired.size() == 1 && fired[0] == 5);
+  CHECK(rec.max_lateness_us() == 220);  // 260 - 40, not ~0
+  // overflow: capacity 4 keeps the newest 4, oldest-first order
+  for (int i = 0; i < 6; ++i) w.schedule(300 + 10 * i, 10 + i);
+  fired.clear();
+  w.advance(1000, &fired);
+  CHECK(fired.size() == 6);
+  CHECK(rec.count() == 4);
+  snap = rec.snapshot();
+  CHECK(snap.front().due_us == 320 && snap.back().due_us == 350);
+  std::puts("wheel_recorder ok");
+}
+
 struct Flow {
   int id = 0;
   ListHead link;
@@ -264,6 +298,7 @@ int main() {
   test_pool_threaded();
   test_circular_buffer();
   test_timing_wheel();
+  test_wheel_recorder();
   test_intrusive_list();
   std::puts("ALL SUBSTRATE TESTS PASSED");
   return 0;

@@ -127,10 +127,33 @@ def main():
         help="also time the dense [T,E,C] mask-einsum oracle path and print "
              "the sorted-path speedup",
     )
+    ap.add_argument(
+        "--cross-pod", action="store_true",
+        help="2-pod cross-pod MoE forward over DCN loopback: per-pod "
+             "dispatch+compute+combine µs and a compute-only baseline "
+             "(reference: proxy-served inter-node EP, ep/src/proxy.cpp:701)",
+    )
+    ap.add_argument("--ffn", type=int, default=256,
+                    help="expert FFN width for --cross-pod")
     args = ap.parse_args()
 
     jax = init_devices(args.devices)
     n = len(jax.devices())
+
+    if args.cross_pod:
+        out = bench_cross_pod(
+            args.tokens, args.hidden, args.ffn, args.experts, args.topk,
+            args.iters,
+        )
+        for p, (fwd_us, comp_us) in sorted(out.items()):
+            print(
+                f"cross-pod pod {p}: forward {fwd_us:.0f} us "
+                f"(compute-only {comp_us:.0f} us, comm+host share "
+                f"{max(0.0, 1 - comp_us / max(fwd_us, 1e-9)) * 100:.0f}%) "
+                f"tokens={args.tokens} hidden={args.hidden} "
+                f"E={args.experts} k={args.topk}"
+            )
+        return
 
     if args.table:
         print(f"EP latency table ({n} members, tokens={args.tokens}, "
@@ -216,6 +239,110 @@ def main():
             f"  dense-mask oracle: {dt_dense * 1e6:.0f} us "
             f"({mode} path speedup {dt_dense / total:.1f}x)"
         )
+
+
+
+
+def bench_cross_pod(tokens, hidden, ffn, experts, topk, iters):
+    """Cross-pod MoE forward latency over the DCN loopback (reference:
+    proxy-served inter-node EP, ep/src/proxy.cpp:701): 2 pods, experts
+    split across them, per-pod µs for the full dispatch+compute+combine
+    forward plus a local-compute-only baseline to expose the comm share."""
+    import threading
+
+    import numpy as np
+
+    from uccl_tpu.collective.hierarchical import DcnGroup
+    from uccl_tpu.ep.cross_pod import CrossPodMoE
+    from uccl_tpu.p2p.store import StoreClient, StoreServer
+    from uccl_tpu.parallel.distributed import Session
+    from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    import jax
+    import jax.numpy as jnp
+
+    P_pods = 2
+    epp = experts // P_pods
+    rng = np.random.default_rng(0)
+    wg = (rng.standard_normal((experts, hidden, ffn)) * 0.2).astype(
+        np.float32
+    )
+    wd = (rng.standard_normal((experts, ffn, hidden)) * 0.2).astype(
+        np.float32
+    )
+    x = rng.standard_normal((P_pods, tokens, hidden)).astype(np.float32)
+    logits = rng.standard_normal((P_pods, tokens, experts)).astype(np.float32)
+    gates = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    ti = np.argsort(-gates, axis=-1)[..., :topk].astype(np.int32)
+    tv = np.take_along_axis(gates, ti, -1)
+    tv = (tv / tv.sum(-1, keepdims=True)).astype(np.float32)
+
+    def expert_fn(buf, w):
+        hmid = jnp.maximum(jnp.einsum("ech,ehf->ecf", buf, w["wg"]), 0.0)
+        return jnp.einsum("ecf,efh->ech", hmid, w["wd"])
+
+    server = StoreServer()
+    out = {}
+    errors = []
+
+    def pod_main(p):
+        try:
+            client = StoreClient("127.0.0.1", server.port)
+            sess = Session(rank=p, world=P_pods, store=client)
+            dcn = DcnGroup(sess, n_paths=2, tag="epbench")
+            mesh = make_mesh(MeshConfig(dp=1), jax.devices()[:1])
+            # cf = P guarantees no drops (per-pod demand is <= T after the
+            # per-(token,pod) dedup: cf*T*K/P >= T) at 1/E-th the buffer an
+            # experts-scaled factor would allocate
+            moe = CrossPodMoE(
+                dcn, mesh, num_global_experts=experts, num_selected=topk,
+                capacity_factor=float(P_pods),
+            )
+            w_local = {
+                "fn": expert_fn,
+                "wg": jnp.asarray(wg[p * epp:(p + 1) * epp]),
+                "wd": jnp.asarray(wd[p * epp:(p + 1) * epp]),
+            }
+            fwd = lambda: moe.forward(
+                x[p], ti[p], tv[p], w_local, save_for_backward=False
+            )
+            fwd()  # warmup + compile
+            dcn.barrier()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fwd()
+            dcn.barrier()
+            fwd_us = (time.perf_counter() - t0) / iters * 1e6
+            # local-only baseline: the same expert compute, no wire
+            fn = moe._local_compute(
+                ((P_pods * moe._pod_capacity(tokens), hidden), topk),
+                expert_fn,
+            )
+            cap = moe._pod_capacity(tokens)
+            xs = jnp.zeros((P_pods * cap, hidden), jnp.float32)
+            idx = jnp.zeros((P_pods * cap, topk), jnp.int32)
+            wts = jnp.ones((P_pods * cap, topk), jnp.float32)
+            warrs = {k: v for k, v in w_local.items() if k != "fn"}
+            comp_us = _time_fn(fn, (xs, idx, wts, warrs), iters) * 1e6
+            out[p] = (fwd_us, comp_us)
+            dcn.close()
+            client.close()
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            errors.append((p, e, traceback.format_exc()))
+
+    ts = [threading.Thread(target=pod_main, args=(p,), daemon=True)
+          for p in range(P_pods)]
+    [t.start() for t in ts]
+    [t.join(timeout=600) for t in ts]
+    hung = [i for i, t in enumerate(ts) if t.is_alive()]
+    server.close()
+    if errors:
+        raise RuntimeError(errors[0][2])
+    if hung:
+        raise RuntimeError(f"pod threads hung past join timeout: {hung}")
+    return out
 
 
 if __name__ == "__main__":

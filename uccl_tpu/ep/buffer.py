@@ -34,10 +34,17 @@ _log = get_logger("EP")
 class DispatchHandle(NamedTuple):
     """Opaque handle threaded from dispatch to combine (the analog of the
     reference's handle tuple, ep/bench/buffer.py dispatch returns). Compact
-    sorted-form routing — O(T·K) per rank, not a dense [T,E,C] mask."""
+    sorted-form routing — O(T·K) per rank, not a dense [T,E,C] mask.
+
+    ``recv_counts`` mirrors the reference handle's received-row bookkeeping:
+    entry [w, s, le] is how many of source s's rows landed for shard w's
+    local expert le — i.e. the occupancy of the [s*C, s*C+C) chunk of
+    ``recv_x[w, le]``. A consumer can skip empty slots or size grouped GEMMs
+    from it instead of assuming full capacity."""
 
     slot: jax.Array  # [W, T, K] int32 slot per assignment (E*C = dropped)
     weights: jax.Array  # [W, T, K] f32 gate weights
+    recv_counts: jax.Array  # [W, W_src, E_local] int32 (always populated)
 
 
 class LowLatencyHandle(NamedTuple):
@@ -231,21 +238,32 @@ class Buffer:
             # sorted/ragged layout (the fast path): one argsort assigns
             # capacity slots; dispatch is a gather; drops match the dense
             # oracle exactly (ep/ops.py)
-            token_for_slot, slot, _ = ep_ops.sorted_from_topk(idx, e, cap)
+            token_for_slot, slot, kept = ep_ops.sorted_from_topk(idx, e, cap)
             recv = ep_ops.dispatch_sorted(
                 xv, token_for_slot, e, cap, self._axis_name(),
                 wire_fp8=wire_fp8,
             )
-            return recv[None], slot[None]
+            # per-(source, local-expert) received-row counts: kept[E] is MY
+            # contribution per global expert; the all_to_all hands each
+            # member row s = source s's counts for ITS experts (the same
+            # counts exchange as the LL path's recv_mat). Always on — the
+            # DeepEP handle always carries receive bookkeeping, and the
+            # [W, E_local] int32 exchange is launch-latency-only next to
+            # the payload all_to_all it accompanies.
+            rc = ep_ll._counts_exchange(
+                kept.reshape(-1, self.num_local_experts).astype(jnp.int32),
+                self._axis_name(),
+            )
+            return recv[None], slot[None], rc[None]
 
         if topk_weights is None:
             topk_weights = jnp.full(topk_idx.shape, 1.0 / k, jnp.float32)
-        fn = self._jit(key, f, (2, 2), (3, 2))
-        recv, slot = fn(x, topk_idx)
+        fn = self._jit(key, f, (2, 2), (3, 2, 2))
+        recv, slot, recv_counts = fn(x, topk_idx)
         self._op_counts["dispatch"] += 1
         self._last_dispatch = (topk_idx, cap)
         # weights go straight into the handle (combine reshards them itself)
-        return recv, DispatchHandle(slot, topk_weights)
+        return recv, DispatchHandle(slot, topk_weights, recv_counts)
 
     def combine(
         self,

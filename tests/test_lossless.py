@@ -138,3 +138,49 @@ class TestPurePythonRansDecode:
     def test_corrupt_plane_rejected(self):
         with pytest.raises(ValueError):
             lz._rans_decode_py(b"\x01" + b"\x00" * 600, 64)
+
+
+class TestFuzzRoundTrip:
+    """Randomized sweep: every supported dtype × shapes × distributions must
+    round-trip bit-exactly through whichever plane encodings the content
+    selects (raw / rANS / zlib), including the pure-Python decode path."""
+
+    def test_fuzz_bit_exact(self, rng_):
+        shapes = [(0,), (1,), (7,), (256,), (33, 5), (4, 3, 2, 5), (1023,)]
+        dists = [
+            lambda s: rng_.standard_normal(s) * 0.02,        # weight-like
+            lambda s: rng_.standard_normal(s) * 1e8,          # huge scale
+            lambda s: np.zeros(s),                            # constant
+            lambda s: rng_.integers(-3, 3, s).astype(float),  # tiny alphabet
+            lambda s: rng_.uniform(-1, 1, s),                 # dense mantissa
+        ]
+        cases = 0
+        for dtype in DTYPES:
+            for shape in shapes:
+                for make in dists:
+                    with np.errstate(over="ignore"):  # f16 inf: intentional
+                        a = np.asarray(make(shape)).astype(dtype)
+                    blob = lz.encode_lossless(a)
+                    back = lz.decode_lossless(blob)
+                    assert back.dtype == a.dtype and back.shape == a.shape
+                    np.testing.assert_array_equal(
+                        back.view(np.uint8), a.view(np.uint8)
+                    )
+                    cases += 1
+        assert cases == len(DTYPES) * len(shapes) * len(dists)
+
+    def test_fuzz_python_decode_of_native_blobs(self, rng_, monkeypatch):
+        if lz._native() is None:
+            pytest.skip("native codec unavailable")
+        arrays = [
+            (rng_.standard_normal(4096) * 0.02).astype(ml_dtypes.bfloat16),
+            rng_.integers(-2, 2, 2048).astype(np.int8),
+            (rng_.standard_normal(1000) * 5).astype(np.float32),
+        ]
+        blobs = [lz.encode_lossless(a) for a in arrays]
+        monkeypatch.setattr(lz, "_codec_lib", False)  # decode w/o native
+        for a, blob in zip(arrays, blobs):
+            back = lz.decode_lossless(blob)
+            np.testing.assert_array_equal(
+                back.view(np.uint8), a.view(np.uint8)
+            )

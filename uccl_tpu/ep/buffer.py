@@ -250,7 +250,7 @@ class Buffer:
             # DeepEP handle always carries receive bookkeeping, and the
             # [W, E_local] int32 exchange is launch-latency-only next to
             # the payload all_to_all it accompanies.
-            rc = ep_ll._counts_exchange(
+            rc = ep_ops.counts_exchange(
                 kept.reshape(-1, self.num_local_experts).astype(jnp.int32),
                 self._axis_name(),
             )

@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from uccl_tpu.ep.ops import counts_exchange as _counts_exchange
 from uccl_tpu.ops.quant import dequantize_fp8, quantize_fp8
 
 Axis = Union[str, Tuple[str, ...]]
@@ -221,12 +222,6 @@ def _ragged_exchange(rows, out_rows: int, spec: _RaggedSpec, axis):
         spec.recv_sizes.astype(jnp.int32),
         axis_name=axis,
     )
-
-
-def _counts_exchange(mat, axis):
-    """[W, ...] per-destination rows → [W, ...] per-source rows (row s of the
-    result is what source s computed for me)."""
-    return lax.all_to_all(mat, axis, split_axis=0, concat_axis=0, tiled=True)
 
 
 def _dense_exchange(rows, w: int, axis):

@@ -143,6 +143,14 @@ class SortedRouting(NamedTuple):
     counts: jax.Array  # [E] tokens kept per expert
 
 
+def counts_exchange(mat, axis):
+    """[W, ...] per-destination rows → [W, ...] per-source rows (row s of
+    the result is what source s computed for me). The counts/offsets
+    exchange both dispatch paths use for receive bookkeeping (sorted-path
+    recv_counts, LL recv_mat/offsets)."""
+    return lax.all_to_all(mat, axis, split_axis=0, concat_axis=0, tiled=True)
+
+
 def sorted_from_topk(
     idx: jax.Array, num_experts: int, capacity: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:

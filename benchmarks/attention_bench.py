@@ -83,6 +83,16 @@ def main():
     ap.add_argument("--seqs", default="1024,2048,4096,8192")
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument(
+        "--block-sweep", action="store_true",
+        help="sweep flash (block_q, block_k) tiles at each seq and print "
+        "the fastest — the on-chip tuning pass (VERDICT: tune blocks at "
+        "long sequence until flash beats XLA in its claimed regime)",
+    )
+    ap.add_argument(
+        "--blocks", default="128,256,512",
+        help="candidate tile sizes for --block-sweep",
+    )
+    ap.add_argument(
         "--skip-xla-bwd-at",
         type=int,
         default=16384,
@@ -129,6 +139,34 @@ def main():
                     v + 1e-9 * dv.astype(v.dtype),
                 )
             return jax.jit(step)
+
+        if args.block_sweep:
+            cands = [int(x) for x in args.blocks.split(",")]
+            best = None
+            for bq in cands:
+                for bk in cands:
+                    # a tile larger than S would silently clamp inside the
+                    # kernel and re-measure (S, S) under a wrong label
+                    if bq > s or bk > s or s % bq or s % bk:
+                        continue
+                    fn = _chain_fwd(functools.partial(
+                        flash_attention, causal=True, block_q=bq, block_k=bk
+                    ))
+                    try:
+                        dt = _time(fn, q, k, v, iters=args.iters)
+                    except Exception as e:
+                        print(json.dumps({"seq": s, "bq": bq, "bk": bk,
+                                          "error": type(e).__name__}))
+                        continue
+                    rec = {"seq": s, "bq": bq, "bk": bk,
+                           "ms": round(dt * 1e3, 3),
+                           "tflops": round(fwd_flops / dt / 1e12, 2)}
+                    print(json.dumps(rec))
+                    if best is None or dt < best[0]:
+                        best = (dt, rec)
+            if best:
+                print(json.dumps({"seq": s, "best": best[1]}))
+            continue
 
         flash = _chain_fwd(functools.partial(flash_attention, causal=True))
         xla = _chain_fwd(functools.partial(_ref_attention, causal=True))

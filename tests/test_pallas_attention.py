@@ -145,3 +145,41 @@ def test_backward_no_quadratic_memory():
         f"flash backward temps {mem.temp_size_in_bytes} >= one score matrix "
         f"{score_bytes}"
     )
+
+
+class TestBlockConfig:
+    def test_env_knob_sets_default_blocks(self, monkeypatch):
+        """UCCL_TPU_FLASH_BLOCK_Q/K retune the default tiles without code
+        changes (the on-chip sweep's actuation path)."""
+        from uccl_tpu.ops import pallas_attention as pa
+        from uccl_tpu.utils import config as cfg
+
+        monkeypatch.setenv("UCCL_TPU_FLASH_BLOCK_Q", "64")
+        monkeypatch.setenv("UCCL_TPU_FLASH_BLOCK_K", "32")
+        # params cache their env reads; force a re-read
+        for name in ("flash_block_q", "flash_block_k"):
+            p = cfg.param(name, 128)
+            p.reset()
+        try:
+            assert pa._default_blocks() == (64, 32)
+        finally:
+            monkeypatch.undo()
+            for name in ("flash_block_q", "flash_block_k"):
+                cfg.param(name, 128).reset()
+
+    def test_grad_with_default_blocks(self):
+        """Differentiation with blocks left at their defaults must work —
+        custom_vjp routes through the vjp fwd, so None-resolution has to sit
+        outside the custom_vjp boundary (regression for exactly that)."""
+        import jax
+        import jax.numpy as jnp
+
+        from uccl_tpu.ops.pallas_attention import flash_attention
+
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 2, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 1, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 128, 1, 32))
+        g = jax.grad(
+            lambda q_: jnp.sum(flash_attention(q_, k, v).astype(jnp.float32))
+        )(q)
+        assert g.shape == q.shape and bool(jnp.isfinite(g).all())

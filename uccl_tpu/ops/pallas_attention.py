@@ -378,21 +378,23 @@ def _flash_bwd(q, k, v, out, lse, g_out, g_lse, causal, block_q, block_k,
 # Public API
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention_lse(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
-    interpret: Optional[bool] = None,
-) -> Tuple[jax.Array, jax.Array]:
-    """Flash attention returning (out [B,S,H,D], lse [B,H,S]).
+def _default_blocks():
+    """Tile sizes from config (UCCL_TPU_FLASH_BLOCK_Q/K): the on-chip tuning
+    knob — the flash-vs-XLA crossover moves with (BQ, BKV) at long sequence,
+    and an env sweep (benchmarks/attention_bench.py --block-sweep) must be
+    able to retune without code changes."""
+    from uccl_tpu.utils.config import param
 
-    The lse output is differentiable, so callers may merge blocks (ring/
-    blockwise attention) and train straight through the merge.
-    """
+    bq = param("flash_block_q", 128, help="flash attention q-tile rows")
+    bk = param("flash_block_k", 128, help="flash attention kv-tile rows")
+    return int(bq.get()), int(bk.get())
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse_core(q, k, v, causal, block_q, block_k, interpret):
+    # block_q/block_k are CONCRETE here: custom_vjp routes differentiation
+    # through _lse_vjp_fwd (not this body), so any None-resolution must
+    # happen in the public wrapper below, before the custom_vjp boundary.
     return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
 
 
@@ -409,7 +411,30 @@ def _lse_vjp_bwd(causal, block_q, block_k, interpret, res, g):
     )
 
 
-flash_attention_lse.defvjp(_lse_vjp_fwd, _lse_vjp_bwd)
+_flash_lse_core.defvjp(_lse_vjp_fwd, _lse_vjp_bwd)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Flash attention returning (out [B,S,H,D], lse [B,H,S]).
+
+    The lse output is differentiable, so callers may merge blocks (ring/
+    blockwise attention) and train straight through the merge. block_q/k
+    default from UCCL_TPU_FLASH_BLOCK_Q/K (128 each).
+    """
+    dq, dk = _default_blocks()
+    if block_q is None:
+        block_q = dq
+    if block_k is None:
+        block_k = dk
+    return _flash_lse_core(q, k, v, causal, block_q, block_k, interpret)
 
 
 def flash_attention(
@@ -417,8 +442,8 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention. q: [B, S, H, D]; k/v: [B, Sk, Hkv, D] (GQA-aware).

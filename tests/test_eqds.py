@@ -165,3 +165,34 @@ class TestPullReenable:
             s_chan.grant_credit(64 << 10)  # NEW credit releases it
             t.join(timeout=20)
             assert done.is_set()
+
+
+class TestPacerFlush:
+    def test_stop_flush_releases_stragglers(self):
+        """stop(flush_bytes=N) hands attached channels a final allowance so
+        an in-flight sender finishes instead of stalling when the pacer
+        goes away."""
+        with Endpoint(n_engines=2) as server, Endpoint(n_engines=2) as client:
+            s_chan, c_chan = _chan_pair(server, client)
+            c_chan.chunk_bytes = 64 << 10
+            c_chan.enable_pull_sender()
+            dst = np.zeros(256 << 10, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            src = (np.arange(256 << 10) % 251).astype(np.uint8)
+            pacer = PullPacer(1.0, tick_s=0.01)  # ~zero rate: never enough
+            pacer.attach(s_chan)
+            pacer.start()
+            done = threading.Event()
+
+            def tx():
+                c_chan.write(src, fifo, timeout_ms=30000)
+                done.set()
+
+            t = threading.Thread(target=tx)
+            t.start()
+            time.sleep(0.3)
+            assert not done.is_set()  # starved by the near-zero rate
+            pacer.stop(flush_bytes=1 << 20)  # final allowance
+            t.join(timeout=20)
+            assert done.is_set()
+            np.testing.assert_array_equal(dst, src)

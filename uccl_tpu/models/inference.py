@@ -54,9 +54,13 @@ def _attend_cached(q, k_cache, v_cache, length, cfg: DenseConfig):
 
 
 def _forward_cached(
-    params, tokens, cache: KVCache, cfg: DenseConfig
+    params, tokens, cache: KVCache, cfg, ffn=None
 ) -> Tuple[jax.Array, KVCache]:
-    """Run tokens [B, S] starting at cache.length; returns (logits, cache')."""
+    """Run tokens [B, S] starting at cache.length; returns (logits, cache').
+
+    ``ffn(h2, layer_params) -> [B, S, H]`` overrides the dense SwiGLU block
+    — the hook the MoE serving loop uses so the attention/KV-cache math
+    exists exactly once (uccl_tpu/models/moe_inference.py)."""
     b, s = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0).astype(cache.k.dtype)
     positions = cache.length + jnp.arange(s)
@@ -81,10 +85,13 @@ def _forward_cached(
         attn = _attend_cached(q, k_cache, v_cache, cache.length, cfg)
         x = x + attn.reshape(b, s, -1) @ lp["wo"].astype(attn.dtype)
         h2 = rms_norm(x, lp["ln2"], cfg.norm_eps)
-        act = jax.nn.silu(h2 @ lp["w_gate"].astype(h2.dtype)) * (
-            h2 @ lp["w_up"].astype(h2.dtype)
-        )
-        x = x + act @ lp["w_down"].astype(act.dtype)
+        if ffn is None:
+            act = jax.nn.silu(h2 @ lp["w_gate"].astype(h2.dtype)) * (
+                h2 @ lp["w_up"].astype(h2.dtype)
+            )
+            x = x + act @ lp["w_down"].astype(act.dtype)
+        else:
+            x = x + ffn(h2, lp)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["head"]
     cache = KVCache(

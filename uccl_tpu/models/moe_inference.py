@@ -1,0 +1,281 @@
+"""MoE serving: KV-cache prefill / decode / generate with EP-sharded experts.
+
+DeepEP's low-latency mode exists for DECODE (reference ep/README — the LL
+kernels target inference token-by-token latency, ep/src/internode_ll.cu).
+This module puts the framework's EP paths into the serving loop they were
+built for:
+
+* **prefill** routes the whole prompt through the throughput path
+  (``impl="sort"``: one argsort + capacity-bucketed all-to-all);
+* **decode** runs each autoregressive step through the packed low-latency
+  path (``impl="ll"``: per-expert packed rows + recv counts, grouped
+  ``lax.ragged_dot`` — no padding on wire or MXU at batch-sized token
+  counts, exactly the LL regime).
+
+Experts shard over the mesh's ``dp`` axis (contiguous ownership: expert e
+lives on shard ``e // E_local``, the layout both EP paths assume); the
+batch shards with them and every array carries the Buffer-convention
+leading shard dim. Attention/caches reuse the dense serving math
+(:mod:`uccl_tpu.models.inference`).
+
+Parity property (tested): the same weights served on a 1-shard mesh and a
+W-shard mesh generate identical tokens — sharding is semantics-free.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from uccl_tpu.ep import ops as ep_ops
+from uccl_tpu.models.inference import KVCache, _forward_cached
+
+_AXIS = "dp"  # the EP/serving axis of the mesh
+
+
+@dataclass(frozen=True)
+class MoEServeConfig:
+    vocab: int = 512
+    dim: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    head_dim: int = 32
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    moe_experts: int = 8
+    moe_topk: int = 2
+    moe_ffn: int = 256
+    capacity_factor: float = 8.0  # ample by default: serving wants no drops
+
+
+class MoEKVCache(NamedTuple):
+    k: jax.Array  # [W, L, B_loc, S_max, Hkv, D]
+    v: jax.Array
+    length: jax.Array  # [W] int32
+
+    @staticmethod
+    def empty(cfg: MoEServeConfig, world: int, batch_local: int,
+              max_seq: int, dtype=jnp.float32) -> "MoEKVCache":
+        shape = (world, cfg.n_layers, batch_local, max_seq,
+                 cfg.n_kv_heads, cfg.head_dim)
+        return MoEKVCache(
+            jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+            jnp.zeros((world,), jnp.int32),
+        )
+
+
+def init_params(key: jax.Array, cfg: MoEServeConfig) -> Dict[str, Any]:
+    """Global parameter tree (experts carry the full [E, ...] axis)."""
+    k = jax.random.split(key, 12)
+    h, l, f, e = cfg.dim, cfg.n_layers, cfg.moe_ffn, cfg.moe_experts
+    qd = cfg.n_heads * cfg.head_dim
+    kvd = cfg.n_kv_heads * cfg.head_dim
+    s_in, s_f = 1.0 / math.sqrt(h), 1.0 / math.sqrt(f)
+
+    def rnd(kk, shape, scale):
+        return jax.random.normal(kk, shape, jnp.float32) * scale
+
+    return {
+        "embed": rnd(k[0], (cfg.vocab, h), 0.02),
+        "blocks": {
+            "ln1": jnp.ones((l, h), jnp.float32),
+            "ln2": jnp.ones((l, h), jnp.float32),
+            "wq": rnd(k[1], (l, h, qd), s_in),
+            "wk": rnd(k[2], (l, h, kvd), s_in),
+            "wv": rnd(k[3], (l, h, kvd), s_in),
+            "wo": rnd(k[4], (l, qd, h), 1.0 / math.sqrt(qd)),
+            "router": rnd(k[5], (l, h, e), s_in),
+            "we_gate": rnd(k[6], (l, e, h, f), s_in),
+            "we_up": rnd(k[7], (l, e, h, f), s_in),
+            "we_down": rnd(k[8], (l, e, f, h), s_f),
+        },
+        "final_norm": jnp.ones((h,), jnp.float32),
+        "head": rnd(k[9], (h, cfg.vocab), s_in),
+    }
+
+
+def _forward_shard(params, tokens, k_cache, v_cache, length,
+                   cfg: MoEServeConfig, impl: str):
+    """Per-shard cached forward: the dense serving loop
+    (inference._forward_cached — attention/rope/KV updates exist exactly
+    once) with the FFN block swapped for the EP MoE layer. Experts are the
+    LOCAL shard ([E_local, ...]); the MoE FFN exchanges tokens over the EP
+    axis (sorted path for prefill throughput, packed LL for decode)."""
+
+    def moe_block(h2, lp):
+        b, sq, hd = h2.shape
+        flat = h2.reshape(b * sq, hd)
+        router_logits = flat.astype(jnp.float32) @ lp["router"]
+        out, _, _ = ep_ops.moe_ffn(
+            flat, router_logits,
+            lp["we_gate"], lp["we_up"], lp["we_down"],
+            _AXIS,
+            num_selected=cfg.moe_topk,
+            capacity_factor=cfg.capacity_factor,
+            impl=impl,
+        )
+        return out.reshape(b, sq, hd)
+
+    cache = KVCache(k_cache, v_cache, length)
+    logits, cache = _forward_cached(params, tokens, cache, cfg, ffn=moe_block)
+    return logits, cache.k, cache.v, cache.length
+
+
+class MoEServer:
+    """Cached jitted prefill/decode over an EP mesh (one compile per shape).
+
+    ``mesh`` must carry a ``dp`` axis; experts and batch shard over it.
+    """
+
+    def __init__(self, cfg: MoEServeConfig, mesh: Mesh):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.world = mesh.shape[_AXIS]
+        if cfg.moe_experts % self.world:
+            raise ValueError(
+                f"the dp world {self.world} must divide moe_experts "
+                f"{cfg.moe_experts}"
+            )
+        self._fns = {}
+
+    # -- parameter placement ------------------------------------------------
+    def shard_params(self, params):
+        """Place the global tree for serving, ONCE: expert [E, ...] axes
+        become the Buffer-convention sharded [L, W, E_local, ...]; every
+        replicated leaf gains a broadcast [W] leading dim. Done here (not
+        per forward) so each decode step feeds the SAME arrays through the
+        jit boundary instead of re-tiling params every token."""
+        w = self.world
+        e_local = self.cfg.moe_experts // w
+
+        def place(name, leaf):
+            if name in ("we_gate", "we_up", "we_down"):
+                l = leaf.shape[0]
+                return leaf.reshape((l, w, e_local) + leaf.shape[2:])
+            return jnp.broadcast_to(leaf, (w,) + leaf.shape)
+
+        blocks = {
+            name: place(name, leaf)
+            for name, leaf in params["blocks"].items()
+        }
+        return {
+            "embed": jnp.broadcast_to(
+                params["embed"], (w,) + params["embed"].shape
+            ),
+            "blocks": blocks,
+            "final_norm": jnp.broadcast_to(
+                params["final_norm"], (w,) + params["final_norm"].shape
+            ),
+            "head": jnp.broadcast_to(
+                params["head"], (w,) + params["head"].shape
+            ),
+        }
+
+    def _fn(self, key, build):
+        cached = self._fns.get(key)
+        if cached is None:
+            cached = self._fns[key] = build()
+        return cached
+
+    def _forward(self, params, tokens, cache: MoEKVCache, impl: str):
+        cfg = self.cfg
+
+        def f(p, tok, kc, vc, ln):
+            # strip the shard dim: replicated leaves carry it LEADING
+            # ([1, ...] broadcast slice); expert leaves carry it at axis 1
+            # ([L, 1, E_local, ...] — the sharded W axis of shard_params)
+            blocks = {}
+            for name, leaf in p["blocks"].items():
+                if name in ("we_gate", "we_up", "we_down"):
+                    blocks[name] = leaf[:, 0]
+                else:
+                    blocks[name] = leaf[0]
+            pp = {
+                "embed": p["embed"][0],
+                "blocks": blocks,
+                "final_norm": p["final_norm"][0],
+                "head": p["head"][0],
+            }
+            logits, nk, nv, nlen = _forward_shard(
+                pp, tok[0], kc[0], vc[0], ln[0], cfg, impl
+            )
+            return logits[None], nk[None], nv[None], nlen[None]
+
+        key = ("fwd", impl, tokens.shape, cache.k.shape)
+
+        def build():
+            # replicated leaves shard their broadcast leading [W] dim;
+            # expert leaves shard the [W] at axis 1 ([L, W, E_local, ...])
+            def block_spec(name):
+                if name in ("we_gate", "we_up", "we_down"):
+                    return P(None, _AXIS)
+                return P(_AXIS)
+
+            p_specs = {
+                "embed": P(_AXIS),
+                "blocks": {
+                    name: block_spec(name)
+                    for name in ("ln1", "ln2", "wq", "wk", "wv", "wo",
+                                 "router", "we_gate", "we_up", "we_down")
+                },
+                "final_norm": P(_AXIS),
+                "head": P(_AXIS),
+            }
+            return jax.jit(
+                shard_map(
+                    f, mesh=self.mesh,
+                    in_specs=(p_specs, P(_AXIS), P(_AXIS), P(_AXIS),
+                              P(_AXIS)),
+                    out_specs=(P(_AXIS), P(_AXIS), P(_AXIS), P(_AXIS)),
+                    check_vma=False,
+                )
+            )
+
+        fn = self._fn(key, build)
+        logits, nk, nv, nlen = fn(params, tokens, cache.k, cache.v,
+                                  cache.length)
+        return logits, MoEKVCache(nk, nv, nlen)
+
+    # -- public serving API -------------------------------------------------
+    def prefill(self, params, tokens, max_seq: int):
+        """tokens: [W, B_loc, S_prompt] → (last logits [W, B_loc, V], cache).
+        Throughput path (sorted dispatch)."""
+        w, b, s = tokens.shape
+        if s > max_seq:
+            raise ValueError(f"prompt {s} exceeds max_seq {max_seq}")
+        cache = MoEKVCache.empty(self.cfg, w, b, max_seq)
+        logits, cache = self._forward(params, tokens, cache, impl="sort")
+        return logits[:, :, -1], cache
+
+    def decode_step(self, params, token, cache: MoEKVCache,
+                    impl: str = "ll"):
+        """token: [W, B_loc] → (logits [W, B_loc, V], cache'). Low-latency
+        packed EP path by default — the DeepEP LL decode regime."""
+        logits, cache = self._forward(
+            params, token[..., None], cache, impl=impl
+        )
+        return logits[:, :, 0], cache
+
+    def generate(self, params, prompt, new_tokens: int, max_seq: int,
+                 impl: str = "ll"):
+        """Greedy decode. prompt: [W, B_loc, S] → tokens [W, B_loc, N]."""
+        if prompt.shape[-1] + new_tokens > max_seq:
+            raise ValueError(
+                f"prompt {prompt.shape[-1]} + new {new_tokens} tokens "
+                f"exceed max_seq {max_seq}: the cache would overflow"
+            )
+        logits, cache = self.prefill(params, prompt, max_seq)
+        out = []
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        for _ in range(new_tokens):
+            out.append(tok)
+            logits, cache = self.decode_step(params, tok, cache, impl=impl)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jnp.stack(out, axis=-1)

@@ -195,6 +195,16 @@ def _measure(cfg_kw, batch, seq, tokens, targets):
 def main():
     import numpy as np
 
+    # Fast-path MoE impl: "sort" (ragged layout, capacity-padded GEMMs) or
+    # "ll" (packed grouped GEMMs via ragged_dot — no padded FLOPs; wins on
+    # MXU, loses on XLA:CPU where ragged_dot lowers to loops — measured in
+    # PERF.md). Env knob so the on-chip session can sweep without edits.
+    # Validated BEFORE the device probe: a typo'd knob must fail in
+    # milliseconds, not after minutes of tunnel-probe retries.
+    moe_impl = os.environ.get("UCCL_TPU_BENCH_MOE", "sort")
+    if moe_impl not in ("sort", "ll", "dense"):
+        sys.exit(f"[bench] UCCL_TPU_BENCH_MOE={moe_impl!r}: want sort|ll|dense")
+
     healthy, platform, device_kind = _probe_device()
     if not healthy:
         # CPU can't run the full-size model at benchmark cadence
@@ -216,7 +226,7 @@ def main():
     flash_failed = None
     try:
         tps, dt, cfg = _measure(
-            {"attn_impl": attn_impl, "moe_impl": "sort", **cfg_shrink},
+            {"attn_impl": attn_impl, "moe_impl": moe_impl, **cfg_shrink},
             batch, seq, tokens, targets,
         )
     except Exception as e:
@@ -230,7 +240,7 @@ def main():
         print(f"[bench] flash path failed ({flash_failed}); retrying with "
               "attn=xla", file=sys.stderr)
         tps, dt, cfg = _measure(
-            {"attn_impl": "xla", "moe_impl": "sort", **cfg_shrink},
+            {"attn_impl": "xla", "moe_impl": moe_impl, **cfg_shrink},
             batch, seq, tokens, targets,
         )
         attn_impl = "xla"
@@ -251,6 +261,7 @@ def main():
         "baseline_tokens_per_sec": round(base_tps, 1),
         "device": device_kind,
         "attn_impl": attn_impl,
+        "moe_impl": moe_impl,
     }
     peak = _peak_flops(device_kind)
     if peak:

@@ -231,3 +231,37 @@ class TestTraining:
         tokens, targets = _data(rng, cfg)
         total, ce = jax.jit(lambda p: loss_fn(p, tokens, targets, cfg, mesh))(params)
         assert float(total) > float(ce)
+
+
+class TestRematModes:
+    """remat="full"|"dots"|"none" change only the backward recompute
+    schedule (_remat_wrap) — training must be bit-identical across them."""
+
+    def test_remat_modes_bit_identical(self, devices, rng):
+        mesh = make_mesh(MeshConfig(pp=2, dp=2, cp=1, tp=2), devices)
+        tokens = targets = None
+        losses = {}
+        for mode in ("full", "dots", "none"):
+            cfg = _cfg(remat=mode, aux_loss_weight=0.01, z_loss_weight=1e-3)
+            if tokens is None:
+                tokens, targets = _data(rng, cfg)
+            params = shard_params(
+                init_params(jax.random.PRNGKey(5), cfg), mesh, cfg
+            )
+            train_step, init_opt = make_train_step(cfg, mesh)
+            opt_state = init_opt(params)
+            step = jax.jit(train_step)
+            for _ in range(3):
+                params, opt_state, metrics = step(
+                    params, opt_state, tokens, targets
+                )
+            losses[mode] = float(metrics["loss"])
+        assert losses["full"] == losses["dots"] == losses["none"], losses
+
+    def test_unknown_remat_mode_raises(self, devices, rng):
+        mesh = make_mesh(MeshConfig(), devices[:1])
+        cfg = _cfg(remat="bogus")
+        params = shard_params(init_params(jax.random.PRNGKey(5), cfg), mesh, cfg)
+        tokens, targets = _data(rng, cfg)
+        with pytest.raises(ValueError, match="remat"):
+            jax.jit(lambda p: loss_fn(p, tokens, targets, cfg, mesh))(params)

@@ -37,6 +37,7 @@ class DenseConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
     n_microbatches: int = 1
+    remat: str = "full"  # "full" | "dots" | "none" — flagship._remat_wrap
     seq_mode: str = "ring"
     attn_impl: str = "auto"
     dtype: Any = jnp.float32
@@ -125,7 +126,7 @@ def _per_shard_logits(params, tokens, cfg: DenseConfig):
         raise ValueError(f"local batch {b_loc} not divisible by {m} microbatches")
     x = _fs._embed(tokens, params["embed"], cfg).astype(cfg.dtype)
     xmb = x.reshape(m, b_loc // m, s_loc, cfg.dim)
-    layer_ckpt = jax.checkpoint(partial(_layer, cfg=cfg))
+    layer_ckpt = _fs._remat_wrap(partial(_layer, cfg=cfg), cfg.remat)
 
     def stage_fn(xm):
         def body(carry, lp):

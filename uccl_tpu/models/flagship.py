@@ -62,6 +62,7 @@ class FlagshipConfig:
     moe_impl: str = "sort"  # "sort" (ragged) | "dense" (oracle) | "ll" (packed
     # grouped-GEMM path, no padded FLOPs — ep/ll.py)
     wire_fp8: bool = False
+    remat: str = "full"  # "full" | "dots" | "none" — see _remat_wrap
     dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
 
 
@@ -208,6 +209,27 @@ def _layer(x, lp, cfg: FlagshipConfig):
     return x, aux_scalar
 
 
+def _remat_wrap(f, mode: str):
+    """Rematerialization wrapper for one transformer block under the
+    per-stage ``lax.scan``. ``"full"`` recomputes the whole block in
+    backward (minimum activation liveness — the conservative default);
+    ``"dots"`` saves matmul/einsum outputs and recomputes only the cheap
+    elementwise ops between them (``dots_with_no_batch_dims_saveable`` —
+    the standard MFU lever: backward re-runs no forward GEMM); ``"none"``
+    disables remat (the scan saves every residual — fastest when
+    activations fit). Gradients are bit-identical across modes; only the
+    memory/recompute schedule changes."""
+    if mode == "full":
+        return jax.checkpoint(f)
+    if mode == "dots":
+        return jax.checkpoint(
+            f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if mode == "none":
+        return f
+    raise ValueError(f"unknown remat mode {mode!r} (want full|dots|none)")
+
+
 def _embed(tokens, embed_local, cfg: FlagshipConfig):
     """Vocab-parallel embedding lookup. tokens: [B, S_loc] -> [B, S_loc, H]."""
     v_loc = embed_local.shape[0]
@@ -229,7 +251,7 @@ def _per_shard_logits_aux(params, tokens, cfg: FlagshipConfig):
     x = _embed(tokens, params["embed"], cfg).astype(cfg.dtype)
     xmb = x.reshape(m, b_loc // m, s_loc, cfg.dim)
 
-    layer_ckpt = jax.checkpoint(partial(_layer, cfg=cfg))
+    layer_ckpt = _remat_wrap(partial(_layer, cfg=cfg), cfg.remat)
 
     def stage_fn(xm):
         def body(carry, lp):
@@ -319,7 +341,7 @@ def _per_shard_manual_grads(params, tokens, targets, cfg: FlagshipConfig):
     xmb = x.reshape(m, b_loc // m, s_loc, cfg.dim)
     tmb = targets.reshape(m, b_loc // m, s_loc)
 
-    layer_ckpt = jax.checkpoint(partial(_layer, cfg=cfg))
+    layer_ckpt = _remat_wrap(partial(_layer, cfg=cfg), cfg.remat)
 
     def stage_fn(blocks, xm):
         def body(carry, lp):

@@ -8,56 +8,46 @@ workload: resnet50 is the reference's exact benchmark network
 (models/resnet.py, 25.6M params), resnet18 a lighter variant, cnn a tiny
 smoke-test net.
 
+Two gradient-sync substrates, matching the reference's two deployment
+shapes:
+
+* default: replicas are mesh devices; sync is `Communicator.all_reduce`
+  (XLA collectives over ICI) — the single-host multi-chip shape.
+* ``--processes N``: replicas are OS processes; sync is
+  `uccl_tpu.compat.dist` (torch.distributed-shaped) over the DCN engine —
+  the "DDP over the plugin" shape the reference's example actually runs
+  (torchrun + NCCL plugin). Each rank computes local grads, one flat
+  bucket rides `dist.all_reduce`, and training trajectories match the
+  mesh path on the same global batch (same seed → same data partition).
+
 Usage: python examples/ddp_train.py [--devices N] [--steps 20]
        [--model cnn|resnet18|resnet50] [--algo xla|ring]
+       [--processes N]
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import socket
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--devices", type=int, default=0)
-    ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--batch", type=int, default=64)
-    ap.add_argument("--algo", default="xla", choices=["xla", "ring"])
-    ap.add_argument(
-        "--model", default="cnn", choices=["cnn", "resnet18", "resnet50"]
-    )
-    ap.add_argument("--image-size", type=int, default=0,
-                    help="input resolution (default: 16 for cnn, 32 resnet18, 64 resnet50)")
-    args = ap.parse_args()
+def build_workload(args):
+    """Build the training workload (jax must already be initialized).
 
-    if args.devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.devices}"
-        ).strip()
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    else:
-        import jax
-
+    Returns (params, state0, loss_fn, data_shape): state0 is None for
+    stateless models, else the BN-statistics pytree (kept per-replica —
+    torch DDP leaves BN local too). loss_fn is (p, x, y[, s]) -> loss
+    (or (loss, new_state))."""
+    import jax
     import jax.numpy as jnp
-    import numpy as np
     import optax
 
-    from uccl_tpu.collective import Communicator
-    from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
-
-    n = len(jax.devices())
-    mesh = make_mesh(MeshConfig(dp=n))
-    comm = Communicator(mesh, "dp")
-
-    # --- workload: tiny CNN or the reference's ResNet benchmark network ----
     if args.model == "cnn":
         img = args.image_size or 16
         # two SAME stride-2 convs: spatial dims ceil-divide per conv
@@ -87,29 +77,204 @@ def main():
                 logits, y
             ).mean()
 
-        params = init(jax.random.PRNGKey(0))
-        state0 = None
-        data_shape = lambda b: (b, 3, img, img)  # noqa: E731
-    else:
-        from uccl_tpu.models import resnet
-
-        depth = 18 if args.model == "resnet18" else 50
-        img = args.image_size or (32 if depth == 18 else 64)
-        rcfg = resnet.ResNetConfig(depth=depth, num_classes=10)
-        params, state0 = resnet.init_params(jax.random.PRNGKey(0), rcfg)
-        print(
-            f"{args.model}: {resnet.num_params(params) / 1e6:.2f}M params, "
-            f"{img}x{img} inputs"
+        return init(jax.random.PRNGKey(0)), None, loss_fn, (
+            lambda b: (b, 3, img, img)
         )
 
-        def loss_fn(p, x, y, s):
-            loss, new_s = resnet.loss_fn(p, s, x, y, rcfg)
-            return loss, new_s
+    from uccl_tpu.models import resnet
 
-        data_shape = lambda b: (b, img, img, 3)  # noqa: E731 (NHWC)
+    depth = 18 if args.model == "resnet18" else 50
+    img = args.image_size or (32 if depth == 18 else 64)
+    rcfg = resnet.ResNetConfig(depth=depth, num_classes=10)
+    params, state0 = resnet.init_params(jax.random.PRNGKey(0), rcfg)
+    print(
+        f"{args.model}: {resnet.num_params(params) / 1e6:.2f}M params, "
+        f"{img}x{img} inputs"
+    )
+
+    def loss_fn(p, x, y, s):
+        loss, new_s = resnet.loss_fn(p, s, x, y, rcfg)
+        return loss, new_s
+
+    return params, state0, loss_fn, (lambda b: (b, img, img, 3))  # NHWC
+
+
+def _batch(rng, data_shape, w, b_local):
+    """One global batch [w, b_local, ...] — identical in both sync modes so
+    trajectories are comparable (process rank r trains on row r)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.asarray(
+        rng.standard_normal((w,) + data_shape(b_local)), jnp.float32
+    )
+    y = jnp.asarray(
+        (np.asarray(x).mean(axis=tuple(range(2, x.ndim))) > 0).astype(
+            np.int32
+        ) * 5 % 10
+    )
+    return x, y
+
+
+def make_optimizer(params):
+    """SGD + jitted apply, shared by both sync modes: the loss-trajectory
+    parity test requires the hyperparameters to stay identical."""
+    import jax
+    import optax
 
     tx = optax.sgd(0.05, momentum=0.9)
-    opt = tx.init(params)
+    apply_fn = jax.jit(
+        lambda p, o, g: (lambda u, o2: (optax.apply_updates(p, u), o2))(
+            *tx.update(g, o, p)
+        )
+    )
+    return tx.init(params), apply_fn
+
+
+def run_process_rank(args, rank: int):
+    """One DDP process rank: local grads, flat-bucket allreduce over the
+    DCN engine via the torch.distributed-shaped compat API."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from uccl_tpu.compat import dist
+
+    w = args.processes
+    dist.init_process_group(
+        rank, w, master_port=int(os.environ["DDP_MASTER_PORT"])
+    )
+    try:
+        params, state0, loss_fn, data_shape = build_workload(args)
+        opt, apply_fn = make_optimizer(params)
+        grad_fn = jax.jit(
+            jax.value_and_grad(loss_fn, has_aux=state0 is not None)
+        )
+
+        rng = np.random.default_rng(0)
+        b_local = max(1, args.batch // w)
+        t0 = time.perf_counter()
+        for step in range(args.steps):
+            x, y = _batch(rng, data_shape, w, b_local)
+            if state0 is None:
+                loss, grads = grad_fn(params, x[rank], y[rank])
+            else:
+                (loss, state0), grads = grad_fn(params, x[rank], y[rank], state0)
+            # one flat bucket: [K] grads + the loss scalar (so rank 0 can
+            # report the true global mean), one dist.all_reduce per step
+            leaves, treedef = jax.tree.flatten(grads)
+            flat = np.concatenate(
+                [np.asarray(l, np.float32).reshape(-1) for l in leaves]
+                + [np.asarray([float(loss)], np.float32)]
+            )
+            dist.all_reduce(flat)  # in-place sum across ranks
+            flat /= w
+            out, i = [], 0
+            for l in leaves:
+                out.append(jnp.asarray(flat[i : i + l.size].reshape(l.shape)))
+                i += l.size
+            grads = jax.tree.unflatten(treedef, out)
+            params, opt = apply_fn(params, opt, grads)
+            if rank == 0 and step % 5 == 0:
+                print(f"step {step:3d} loss {flat[-1]:.4f}", flush=True)
+        dt = time.perf_counter() - t0
+        if rank == 0:
+            print(
+                f"done: {args.steps} steps in {dt:.2f}s "
+                f"({args.steps / dt:.1f} steps/s), world={w} (process ranks)"
+            )
+    finally:
+        dist.destroy_process_group()
+
+
+def spawn_processes(args):
+    """Parent: launch one child per rank, stream rank 0, propagate failure.
+
+    A dead rank leaves its peers blocked inside the DcnGroup ring, so the
+    parent polls and kills the survivors the moment any child exits
+    nonzero (instead of waiting on a hang)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(args.processes):
+        env = dict(
+            os.environ,
+            DDP_CHILD_RANK=str(rank),
+            DDP_MASTER_PORT=str(port),
+            JAX_PLATFORMS="cpu",
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable] + sys.argv,
+                env=env,
+                stdout=None if rank == 0 else subprocess.DEVNULL,
+            )
+        )
+    while any(p.poll() is None for p in procs):
+        if any(p.poll() not in (None, 0) for p in procs):
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            break
+        time.sleep(0.2)
+    rcs = [p.wait() for p in procs]
+    if any(rcs):
+        sys.exit(f"rank failures: {rcs}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--algo", default="xla", choices=["xla", "ring"])
+    ap.add_argument(
+        "--model", default="cnn", choices=["cnn", "resnet18", "resnet50"]
+    )
+    ap.add_argument("--image-size", type=int, default=0,
+                    help="input resolution (default: 16 for cnn, 32 resnet18, 64 resnet50)")
+    ap.add_argument("--processes", type=int, default=0,
+                    help="run N OS-process ranks syncing over the DCN engine "
+                         "(compat.dist) instead of mesh-device replicas")
+    args = ap.parse_args()
+
+    if args.processes:
+        # Children are identified by a variable ONLY spawn_processes sets
+        # (together with the rendezvous port) — a leaked DDP_RANK from some
+        # other launcher must not make the parent think it's a child.
+        rank = os.environ.get("DDP_CHILD_RANK")
+        if rank is None or "DDP_MASTER_PORT" not in os.environ:
+            return spawn_processes(args)
+        return run_process_rank(args, int(rank))
+
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from uccl_tpu.collective import Communicator
+    from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh(MeshConfig(dp=n))
+    comm = Communicator(mesh, "dp")
+
+    params, state0, loss_fn, data_shape = build_workload(args)
+
+    opt, apply_fn = make_optimizer(params)
     w = comm.world
     # per-replica grads: each row of the leading dim is one replica's local
     # gradient over its batch shard (the DDP contract). ResNet also carries
@@ -128,12 +293,6 @@ def main():
                 in_axes=(None, 0, 0, 0),
             )
         )
-    apply_fn = jax.jit(
-        lambda p, o, g: (lambda u, o2: (optax.apply_updates(p, u), o2))(
-            *tx.update(g, o, p)
-        )
-    )
-
     def allreduce_grads(grads):
         """Average per-replica gradients through the comm layer: flatten every
         leaf into one [world, K] bucket (DDP-style bucketing), one fused
@@ -152,14 +311,7 @@ def main():
     t0 = time.perf_counter()
     b_local = max(1, args.batch // w)
     for step in range(args.steps):
-        x = jnp.asarray(
-            rng.standard_normal((w,) + data_shape(b_local)), jnp.float32
-        )
-        y = jnp.asarray(
-            (np.asarray(x).mean(axis=tuple(range(2, x.ndim))) > 0).astype(
-                np.int32
-            ) * 5 % 10
-        )
+        x, y = _batch(rng, data_shape, w, b_local)
         if state0 is None:
             losses, grads = replica_grads(params, x, y)
         else:

@@ -186,6 +186,12 @@ class Endpoint {
   // subsequent queries for that id return kError.
   XferState poll(uint64_t xfer_id);
   bool wait(uint64_t xfer_id, int timeout_ms);
+  // Abandon a transfer the caller will never poll/wait again (e.g. a
+  // timed-out chunk being retransmitted): erases the tracking entry in any
+  // state so lost-frame xfers — which never complete — cannot accumulate.
+  // A late completion of a still-in-flight abandoned id re-inserts a
+  // terminal entry (pre-existing behavior, bounded by real completions).
+  void reap(uint64_t xfer_id);
 
   // --- fault injection (reference kTestLoss knobs, transport_config.h:222)
   void set_drop_rate(double p) { drop_rate_ = p; }

@@ -151,6 +151,10 @@ int ucclt_wait(void* ep, uint64_t xfer, int timeout_ms) {
   return static_cast<Endpoint*>(ep)->wait(xfer, timeout_ms) ? 0 : -1;
 }
 
+void ucclt_reap(void* ep, uint64_t xfer) {
+  static_cast<Endpoint*>(ep)->reap(xfer);
+}
+
 int ucclt_send(void* ep, uint64_t conn, const void* buf, size_t len) {
   return static_cast<Endpoint*>(ep)->send(conn, buf, len) ? 0 : -1;
 }

@@ -535,6 +535,11 @@ int64_t Endpoint::recv(uint64_t conn_id, void* buf, size_t cap,
   return static_cast<int64_t>(msg.size());
 }
 
+void Endpoint::reap(uint64_t xfer_id) {
+  std::lock_guard<std::mutex> lk(xfers_mtx_);
+  xfers_.erase(xfer_id);
+}
+
 XferState Endpoint::poll(uint64_t xfer_id) {
   std::lock_guard<std::mutex> lk(xfers_mtx_);
   auto it = xfers_.find(xfer_id);

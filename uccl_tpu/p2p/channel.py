@@ -14,6 +14,7 @@ genuinely move bytes in parallel.
 from __future__ import annotations
 
 import struct
+import time
 import uuid
 from dataclasses import dataclass
 from typing import List, Optional
@@ -24,6 +25,16 @@ from uccl_tpu.p2p.endpoint import FIFO_ITEM_BYTES, Endpoint
 from uccl_tpu.utils.config import param
 
 _chunk_kb = param("chunk_size_kb", 1024, help="multipath chunk size in KiB")
+_chunk_retries = param(
+    "chunk_retries",
+    2,
+    help="extra attempts for chunks whose completion times out: the chunk "
+    "is re-issued on the next path (rotation = failover). The engine wire "
+    "is reliable TCP, so a timeout means injected loss (set_drop_rate), a "
+    "dead path, or a stalled peer — the channel-level analog of the "
+    "reference's SACK retransmit path (collective/rdma/pcb.h:20, "
+    "__retransmit_for_flow transport.cc:3376)",
+)
 _nic_list = param(
     "nic_list",
     "",
@@ -82,6 +93,8 @@ class Channel:
         self.ep = ep
         self.conns = conns
         self.chunk_bytes = chunk_bytes or _chunk_kb.get() * 1024
+        self.retries = _chunk_retries.get()
+        self.retransmitted_chunks = 0  # lifetime count of re-issued chunks
         # application tag carried in the connect handshake (e.g. which peer
         # rank dialed, for multi-channel topologies like a DCN full mesh)
         self.meta = meta
@@ -392,27 +405,64 @@ class Channel:
             arr = arr.reshape(1)  # 0-d → (1,) view: same memory, both paths
         flat = self._flat_view(arr)
         total = flat.nbytes
+        # Pull-mode credit is charged ONCE per payload byte, at first issue:
+        # the receiver granted an allowance for the message, and a
+        # retransmission replaces a lost frame rather than sending new
+        # payload — re-debiting would wedge exact-credit receivers.
         if total <= self.chunk_bytes or self.n_paths == 1:
             if self._pull_mode:
                 self._await_credit(self._pull_sent + total, timeout_ms)
                 self._pull_sent += total
-            sync_op(self.conns[0], arr, fifo)
-            return
-        xids = []
-        for i, (off, ln) in enumerate(self._chunks(total)):
-            if self._pull_mode:
-                self._await_credit(self._pull_sent + ln, timeout_ms)
-                self._pull_sent += ln
-            xids.append(
-                async_op(
-                    self.conns[i % self.n_paths],
-                    flat[off : off + ln],
-                    item.slice(off, ln).pack(),
+            # async + wait so the caller's timeout_ms governs each attempt
+            # (the native sync op carries its own fixed internal timeout)
+            for attempt in range(self.retries + 1):
+                xid = async_op(
+                    self.conns[attempt % self.n_paths], arr, fifo
                 )
+                if self.ep.wait(xid, timeout_ms):
+                    return
+                self.ep.reap(xid)  # abandoned: lost frames never complete
+                if attempt < self.retries:
+                    self.retransmitted_chunks += 1
+            raise IOError(
+                f"transfer failed: undelivered after {self.retries + 1} "
+                "attempts"
             )
-        for x in xids:
-            if not self.ep.wait(x, timeout_ms):
-                raise IOError("chunked transfer failed")
+        # Chunked path with retransmission: a chunk whose completion times
+        # out is re-issued on the NEXT path (rotation doubles as failover).
+        # Re-writes are idempotent — same bytes into the same window slice.
+        pending = list(enumerate(self._chunks(total)))  # (chunk_idx, (off, ln))
+        for attempt in range(self.retries + 1):
+            xids = []
+            for ci, (off, ln) in pending:
+                if self._pull_mode and attempt == 0:
+                    self._await_credit(self._pull_sent + ln, timeout_ms)
+                    self._pull_sent += ln
+                xids.append(
+                    async_op(
+                        self.conns[(ci + attempt) % self.n_paths],
+                        flat[off : off + ln],
+                        item.slice(off, ln).pack(),
+                    )
+                )
+            # chunks complete concurrently: one attempt-wide deadline keeps
+            # worst-case blocking at ~timeout_ms per attempt, not per chunk
+            deadline = time.monotonic() + timeout_ms / 1e3
+            failed = []
+            for j, x in enumerate(xids):
+                left_ms = max(1, int((deadline - time.monotonic()) * 1e3))
+                if not self.ep.wait(x, left_ms):
+                    self.ep.reap(x)
+                    failed.append(pending[j])
+            if not failed:
+                return
+            if attempt < self.retries:
+                self.retransmitted_chunks += len(failed)
+            pending = failed
+        raise IOError(
+            f"chunked transfer failed: {len(pending)} chunks undelivered "
+            f"after {self.retries + 1} attempts"
+        )
 
     def write(self, src: np.ndarray, fifo: bytes, timeout_ms: int = 60000) -> None:
         """Spray `src` into the peer's advertised window across all paths."""

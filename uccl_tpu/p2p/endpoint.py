@@ -190,6 +190,9 @@ def _load():
         lib.ucclt_recv.restype = ctypes.c_int64
         lib.ucclt_recv.argtypes = [c, ctypes.c_uint64, ctypes.c_void_p,
                                    ctypes.c_size_t, ctypes.c_int]
+        if hasattr(lib, "ucclt_reap"):  # added after the v1 ABI
+            lib.ucclt_reap.restype = None
+            lib.ucclt_reap.argtypes = [c, ctypes.c_uint64]
         lib.ucclt_set_drop_rate.argtypes = [c, ctypes.c_double]
         lib.ucclt_set_rate_limit.argtypes = [c, ctypes.c_uint64]
         lib.ucclt_bytes_tx.restype = ctypes.c_uint64
@@ -431,12 +434,17 @@ class Endpoint:
         return False
 
     def reap(self, xfer_id: int) -> None:
-        """Forget a transfer's cached terminal result. For callers that
-        observed completion via poll_async and will never wait() on the id —
-        without this, late completions of abandoned transfers (e.g. timed-out
-        CC probes) accumulate in the results cache forever."""
+        """Forget an abandoned transfer on BOTH sides of the boundary. For
+        callers that observed completion via poll_async and will never
+        wait() on the id, and for timed-out chunks being retransmitted —
+        without this, late completions accumulate in the results cache and
+        lost-frame xfers (which never complete) accumulate in the native
+        tracking map forever."""
         self._results.pop(xfer_id, None)
         self._inflight.pop(xfer_id, None)
+        reap = getattr(self._lib, "ucclt_reap", None)
+        if reap is not None:
+            reap(self._handle(), ctypes.c_uint64(xfer_id))
 
     # -- two-sided -------------------------------------------------------
     def send(self, conn_id: int, data: Union[bytes, np.ndarray]) -> None:

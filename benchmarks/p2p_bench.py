@@ -19,7 +19,7 @@ from uccl_tpu.p2p import Endpoint  # noqa: E402
 
 
 def run(sizes=(4 << 10, 64 << 10, 1 << 20, 16 << 20, 64 << 20), iters=20,
-        paths=(1, 4)):
+        paths=(1, 4), quiet=False):
     import threading
 
     from uccl_tpu.p2p import Channel
@@ -54,7 +54,8 @@ def run(sizes=(4 << 10, 64 << 10, 1 << 20, 16 << 20, 64 << 20), iters=20,
                         "lat_us": round(dt * 1e6, 1),
                     }
                 )
-                print(json.dumps(results[-1]))
+                if not quiet:
+                    print(json.dumps(results[-1]))
     return results
 
 

@@ -80,6 +80,9 @@ enum class Op : uint16_t {
   kRead = 3,       // request remote advertised region
   kReadResp = 4,   // payload answer
   kSend = 5,       // two-sided send (matches a recv() on the peer)
+  kNotif = 6,      // out-of-band notification (NIXL notify pattern: a small
+                   // tagged message the target drains non-blocking across
+                   // ALL conns — reference p2p/uccl_engine.h:20-26,218-226)
 };
 
 struct FrameHeader {
@@ -179,6 +182,16 @@ class Endpoint {
   // >=0: bytes copied out. -1: timeout. <=-2: buffer too small, message left
   // queued; required size is -(ret + 2).
   int64_t recv(uint64_t conn_id, void* buf, size_t cap, int timeout_ms);
+
+  // --- out-of-band notifications (reference: NIXL notify,
+  // p2p/uccl_engine.h uccl_engine_send_notif/get_notifs). Unlike send/recv
+  // these do not pair with a per-conn recv(): the target drains one global
+  // queue non-blocking, each message tagged with the source conn id.
+  bool send_notif(uint64_t conn_id, const void* buf, size_t len);
+  // Pop the oldest pending notification. Returns -1 if none, the message
+  // size on success (conn_out receives the source conn), or -(size)-2 if
+  // cap is too small (message stays queued).
+  int64_t get_notif(uint64_t* conn_out, void* buf, size_t cap);
 
   // --- completion (reference: poll_async, engine.h:394)
   // Completions are one-shot: the first poll()/wait() observing a terminal
@@ -405,6 +418,8 @@ class Endpoint {
   std::mutex recvq_mtx_;
   std::condition_variable recvq_cv_;
   std::map<uint64_t, std::deque<std::vector<uint8_t>>> recvq_;
+  std::mutex notifq_mtx_;
+  std::deque<std::pair<uint64_t, std::vector<uint8_t>>> notifq_;
 
   std::atomic<uint64_t> bytes_tx_{0};
   std::atomic<uint64_t> bytes_rx_{0};

@@ -155,6 +155,15 @@ void ucclt_reap(void* ep, uint64_t xfer) {
   static_cast<Endpoint*>(ep)->reap(xfer);
 }
 
+// NIXL notify pattern (reference p2p/uccl_engine.h:218-226)
+int ucclt_send_notif(void* ep, uint64_t conn, const void* buf, size_t len) {
+  return static_cast<Endpoint*>(ep)->send_notif(conn, buf, len) ? 0 : -1;
+}
+
+int64_t ucclt_get_notif(void* ep, uint64_t* conn_out, void* buf, size_t cap) {
+  return static_cast<Endpoint*>(ep)->get_notif(conn_out, buf, cap);
+}
+
 int ucclt_send(void* ep, uint64_t conn, const void* buf, size_t len) {
   return static_cast<Endpoint*>(ep)->send(conn, buf, len) ? 0 : -1;
 }

@@ -535,6 +535,34 @@ int64_t Endpoint::recv(uint64_t conn_id, void* buf, size_t cap,
   return static_cast<int64_t>(msg.size());
 }
 
+bool Endpoint::send_notif(uint64_t conn_id, const void* buf, size_t len) {
+  auto c = get_conn(conn_id);
+  if (!c) return false;
+  if (!wait_txq_below(c.get(), kTxqHighWater, 5000)) return false;
+  if (c->dead.load()) return false;
+  FrameHeader h{};
+  h.magic = kMagic;
+  h.op = static_cast<uint16_t>(Op::kNotif);
+  h.len = len;
+  std::vector<uint8_t> owned(static_cast<const uint8_t*>(buf),
+                             static_cast<const uint8_t*>(buf) + len);
+  enqueue_frame(c, h, nullptr, std::move(owned), 0);
+  return true;
+}
+
+int64_t Endpoint::get_notif(uint64_t* conn_out, void* buf, size_t cap) {
+  std::lock_guard<std::mutex> lk(notifq_mtx_);
+  if (notifq_.empty()) return -1;
+  auto& front = notifq_.front();
+  if (front.second.size() > cap)
+    return -static_cast<int64_t>(front.second.size()) - 2;
+  *conn_out = front.first;
+  std::memcpy(buf, front.second.data(), front.second.size());
+  int64_t n = static_cast<int64_t>(front.second.size());
+  notifq_.pop_front();
+  return n;
+}
+
 void Endpoint::reap(uint64_t xfer_id) {
   std::lock_guard<std::mutex> lk(xfers_mtx_);
   xfers_.erase(xfer_id);
@@ -854,6 +882,11 @@ void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
         recvq_[c->id].push_back(std::move(payload));
       }
       recvq_cv_.notify_all();
+      break;
+    }
+    case Op::kNotif: {
+      std::lock_guard<std::mutex> lk(notifq_mtx_);
+      notifq_.emplace_back(c->id, std::move(payload));
       break;
     }
     default:

@@ -241,3 +241,27 @@ class TestRetransmission:
         finally:
             client.set_drop_rate(0.0)
         assert _time.perf_counter() - t0 < 5.0
+
+    def test_fence_clears_abandoned(self, chan_pair, rng):
+        """After a lossy write, fence() must either drain every abandoned
+        transfer to terminal or raise — here with 0 drop restored and no
+        genuinely-lost frames pending, any deferred ids resolve quickly."""
+        server, client, s_chan, c_chan = chan_pair
+        c_chan.retries = 8
+        n = 1 << 20
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        client.set_drop_rate(0.25)
+        try:
+            c_chan.write(src, fifo, timeout_ms=500)
+        finally:
+            client.set_drop_rate(0.0)
+        # drop-injected frames never terminate; fence must say so (raise)
+        # or, if all abandoned ids happened to be slow-acks, clear them.
+        try:
+            c_chan.fence(timeout_ms=1000)
+            assert c_chan._abandoned == []
+        except IOError as e:
+            assert "still in flight" in str(e)
+        np.testing.assert_array_equal(dst, src)

@@ -430,3 +430,50 @@ class TestEngineStats:
         with Endpoint() as ep:
             time.sleep(0.5)
             assert ep.stats["stats_ticks"] >= 2
+
+
+class TestNotifs:
+    """NIXL notify pattern (reference p2p/uccl_engine.h:218-226): small
+    tagged messages drained non-blocking across all conns — the
+    "data has landed" side channel for one-sided transfers."""
+
+    def test_notif_roundtrip_after_write(self, pair, rng):
+        server, client, conn_s, conn_c = pair
+        assert server.get_notifs() == []  # non-blocking empty drain
+        dst = np.zeros(4096, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, 4096).astype(np.uint8)
+        client.write(conn_c, src, fifo)
+        client.send_notif(conn_c, b"xfer:kv:done")
+        for _ in range(100):
+            notifs = server.get_notifs()
+            if notifs:
+                break
+            time.sleep(0.02)
+        assert notifs == [(conn_s, b"xfer:kv:done")]
+        np.testing.assert_array_equal(dst, src)
+
+    def test_notifs_do_not_consume_recv_queue(self, pair):
+        server, client, conn_s, conn_c = pair
+        client.send_notif(conn_c, b"n1")
+        client.send(conn_c, b"regular")
+        assert server.recv(conn_s) == b"regular"
+        for _ in range(100):
+            notifs = server.get_notifs()
+            if notifs:
+                break
+            time.sleep(0.02)
+        assert notifs == [(conn_s, b"n1")]
+
+    def test_notif_ordering_and_large(self, pair):
+        server, client, conn_s, conn_c = pair
+        big = b"B" * 10000  # larger than the 4096 drain buffer
+        client.send_notif(conn_c, b"first")
+        client.send_notif(conn_c, big)
+        got = []
+        for _ in range(200):
+            got += server.get_notifs()
+            if len(got) == 2:
+                break
+            time.sleep(0.02)
+        assert got == [(conn_s, b"first"), (conn_s, big)]

@@ -95,6 +95,7 @@ class Channel:
         self.chunk_bytes = chunk_bytes or _chunk_kb.get() * 1024
         self.retries = _chunk_retries.get()
         self.retransmitted_chunks = 0  # lifetime count of re-issued chunks
+        self._abandoned: List[int] = []  # timed-out xids awaiting terminal
         # application tag carried in the connect handshake (e.g. which peer
         # rank dialed, for multi-channel topologies like a DCN full mesh)
         self.meta = meta
@@ -390,10 +391,11 @@ class Channel:
                 )
             _time.sleep(0.0005)
 
-    def _spray(self, arr, fifo, sync_op, async_op, timeout_ms: int) -> None:
-        """Shared chunk fan-out for one-sided ops: small transfers take the
-        single-path sync op; large ones split round-robin across paths.
-        Under pull mode every chunk issue is licensed by receiver credit."""
+    def _spray(self, arr, fifo, async_op, timeout_ms: int) -> None:
+        """Shared chunk fan-out for one-sided ops: small transfers ride one
+        path; large ones split round-robin across paths. Under pull mode
+        every chunk issue is licensed by receiver credit. Everything issues
+        through the async op so the caller's timeout_ms governs waits."""
         item = FifoItem.unpack(fifo)
         if not isinstance(arr, np.ndarray):
             # lists/bytes would be silently copied — fatal on the read path
@@ -405,6 +407,7 @@ class Channel:
             arr = arr.reshape(1)  # 0-d → (1,) view: same memory, both paths
         flat = self._flat_view(arr)
         total = flat.nbytes
+        self._prune_abandoned()
         # Pull-mode credit is charged ONCE per payload byte, at first issue:
         # the receiver granted an allowance for the message, and a
         # retransmission replaces a lost frame rather than sending new
@@ -421,7 +424,7 @@ class Channel:
                 )
                 if self.ep.wait(xid, timeout_ms):
                     return
-                self.ep.reap(xid)  # abandoned: lost frames never complete
+                self._abandon(xid)
                 if attempt < self.retries:
                     self.retransmitted_chunks += 1
             raise IOError(
@@ -445,17 +448,49 @@ class Channel:
                         item.slice(off, ln).pack(),
                     )
                 )
-            # chunks complete concurrently: one attempt-wide deadline keeps
-            # worst-case blocking at ~timeout_ms per attempt, not per chunk
-            deadline = time.monotonic() + timeout_ms / 1e3
-            failed = []
-            for j, x in enumerate(xids):
-                left_ms = max(1, int((deadline - time.monotonic()) * 1e3))
-                if not self.ep.wait(x, left_ms):
-                    self.ep.reap(x)
-                    failed.append(pending[j])
-            if not failed:
+            # Progress-based deadline: chunks complete concurrently, so an
+            # attempt times out only after timeout_ms with ZERO completions
+            # — a slow-but-moving transfer keeps extending its budget (no
+            # mass-retransmit of in-flight chunks), while total loss is
+            # detected within ~one timeout. Detection is a non-blocking
+            # poll sweep + one short sleep per pass, so scan cost per pass
+            # is O(1) in wall time regardless of chunk count.
+            pend = list(zip(xids, pending))
+            dead = []  # terminal-error chunks (conn died): retry immediately
+            last_progress = time.monotonic()
+            while pend:
+                # Block on the oldest pending chunk: completion-driven wake,
+                # O(n) waits total in the no-loss case. Only when the oldest
+                # TIMES OUT (loss suspected) does a non-blocking sweep
+                # classify the rest — so sweeps are paced at ≥50 ms apart,
+                # not run per completion.
+                if self.ep.wait(pend[0][0], 50):
+                    last_progress = time.monotonic()
+                    pend.pop(0)
+                    continue
+                nxt = []
+                progressed = False
+                for x, p in pend:
+                    try:
+                        r = self.ep.poll_async(x)
+                    except IOError:
+                        dead.append(p)  # consumed error; no keepalive held
+                        continue
+                    if r is None:
+                        nxt.append((x, p))
+                    else:
+                        self.ep.wait(x, 0)  # consume the parked success
+                        progressed = True
+                pend = nxt
+                if progressed:
+                    last_progress = time.monotonic()
+                elif time.monotonic() - last_progress > timeout_ms / 1e3:
+                    break
+            if not pend and not dead:
                 return
+            for x, _ in pend:
+                self._abandon(x)
+            failed = dead + [p for _, p in pend]
             if attempt < self.retries:
                 self.retransmitted_chunks += len(failed)
             pending = failed
@@ -464,13 +499,74 @@ class Channel:
             f"after {self.retries + 1} attempts"
         )
 
+    def _abandon(self, xid: int) -> None:
+        """Stop waiting on a timed-out transfer WITHOUT freeing its
+        keepalive: the native tx path may still hold a zero-copy pointer
+        into the source buffer (queued or mid-send frame), so the memory
+        must stay alive until a terminal state is observed. Every abandoned
+        id terminates eventually in production — a late ack completes it, a
+        dead conn fails it — and the next _spray call prunes it. (Only
+        injected frame loss produces never-terminating ids; those keep
+        their keepalive for the endpoint's lifetime — a test-only cost.)"""
+        self._abandoned.append(xid)
+
+    def _prune_abandoned(self) -> None:
+        still = []
+        for x in self._abandoned:
+            try:
+                r = self.ep.poll_async(x)
+            except IOError:
+                self.ep.reap(x)  # consumed error: clear parked state
+                continue
+            if r is None:
+                still.append(x)  # still in flight: keepalive must live on
+            else:
+                self.ep.reap(x)  # parked success: release result+keepalive
+        self._abandoned = still
+
+    def fence(self, timeout_ms: int = 60000) -> None:
+        """Block until every abandoned transfer reaches a terminal state.
+
+        After a write/read that retransmitted, a stale attempt's frame can
+        still be in flight on a recovering path; if the caller then REUSES
+        the same advertised window (or read destination) for a *different*
+        message, that late frame would land over the new bytes. fence()
+        makes window reuse safe again: once every abandoned id is terminal
+        (late ack — the peer consumed the frame — or conn death — the
+        frame died with it), no stale data can arrive. Raises IOError if
+        any id is still in flight at the deadline. Fresh-advertise-per-
+        message callers never need this (a stale frame NACKs on the old
+        token)."""
+        deadline = time.monotonic() + timeout_ms / 1e3
+        still = []
+        for x in self._abandoned:
+            while True:
+                try:
+                    r = self.ep.poll_async(x)
+                except IOError:
+                    r = False  # terminal error: consumed
+                if r is not None:
+                    if r:
+                        self.ep.wait(x, 0)  # consume the parked success
+                    self.ep.reap(x)
+                    break
+                if time.monotonic() > deadline:
+                    still.append(x)
+                    break
+                time.sleep(0.005)
+        self._abandoned = still
+        if still:
+            raise IOError(
+                f"fence: {len(still)} abandoned transfers still in flight"
+            )
+
     def write(self, src: np.ndarray, fifo: bytes, timeout_ms: int = 60000) -> None:
         """Spray `src` into the peer's advertised window across all paths."""
         if isinstance(src, np.generic):
             # numpy scalar (e.g. a 1-D array's row slice): value-copy is
             # fine for a TX source — never for a read destination
             src = np.asarray(src).reshape(1)
-        self._spray(src, fifo, self.ep.write, self.ep.write_async, timeout_ms)
+        self._spray(src, fifo, self.ep.write_async, timeout_ms)
 
     def write_compressed(
         self, src: np.ndarray, fifo: bytes, timeout_ms: int = 60000,
@@ -499,7 +595,7 @@ class Channel:
 
     def read(self, dst: np.ndarray, fifo: bytes, timeout_ms: int = 60000) -> None:
         """Chunked multipath one-sided read into `dst`."""
-        self._spray(dst, fifo, self.ep.read, self.ep.read_async, timeout_ms)
+        self._spray(dst, fifo, self.ep.read_async, timeout_ms)
 
     def close(self) -> None:
         self.disable_cc()

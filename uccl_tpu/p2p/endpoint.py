@@ -193,6 +193,16 @@ def _load():
         if hasattr(lib, "ucclt_reap"):  # added after the v1 ABI
             lib.ucclt_reap.restype = None
             lib.ucclt_reap.argtypes = [c, ctypes.c_uint64]
+        if hasattr(lib, "ucclt_send_notif"):
+            lib.ucclt_send_notif.restype = ctypes.c_int
+            lib.ucclt_send_notif.argtypes = [
+                c, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_size_t
+            ]
+            lib.ucclt_get_notif.restype = ctypes.c_int64
+            lib.ucclt_get_notif.argtypes = [
+                c, ctypes.POINTER(ctypes.c_uint64), ctypes.c_void_p,
+                ctypes.c_size_t,
+            ]
         lib.ucclt_set_drop_rate.argtypes = [c, ctypes.c_double]
         lib.ucclt_set_rate_limit.argtypes = [c, ctypes.c_uint64]
         lib.ucclt_bytes_tx.restype = ctypes.c_uint64
@@ -428,10 +438,15 @@ class Endpoint:
             # (nothing performs the follow-up on success paths).
             self._inflight.pop(xfer_id, None)
             return True
-        # distinguish timeout (entry still pending) from a consumed error
-        if self._lib.ucclt_poll(self._handle(), xfer_id) != 0:
+        # Distinguish timeout (entry still pending) from a consumed
+        # terminal. The completion can land in the race window between the
+        # native wait's deadline and this poll — a kDone here IS success
+        # (returning False would make retry loops count a delivered
+        # transfer as lost, raising on the final attempt).
+        r = self._lib.ucclt_poll(self._handle(), xfer_id)
+        if r != 0:
             self._inflight.pop(xfer_id, None)
-        return False
+        return r == 1
 
     def reap(self, xfer_id: int) -> None:
         """Forget an abandoned transfer on BOTH sides of the boundary. For
@@ -454,6 +469,39 @@ class Endpoint:
             ptr, nbytes = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p), len(data)
         if self._lib.ucclt_send(self._handle(), conn_id, ptr, nbytes) != 0:
             raise IOError("send failed")
+
+    def send_notif(self, conn_id: int, data: bytes) -> None:
+        """Send an out-of-band notification (NIXL notify: reference
+        p2p/uccl_engine.h uccl_engine_send_notif). The peer drains these
+        with :meth:`get_notifs` — across ALL connections, non-blocking —
+        instead of a per-connection recv()."""
+        fn = getattr(self._lib, "ucclt_send_notif", None)
+        if fn is None:
+            raise RuntimeError("loaded libuccl_tpu.so predates notif ABI")
+        ptr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
+        if fn(self._handle(), conn_id, ptr, len(data)) != 0:
+            raise IOError("send_notif failed")
+
+    def get_notifs(self, max_n: int = 0) -> list:
+        """Drain pending notifications non-blocking (NIXL get_notifs).
+        Returns [(conn_id, bytes), ...] oldest-first; at most max_n if >0."""
+        fn = getattr(self._lib, "ucclt_get_notif", None)
+        if fn is None:
+            return []  # old ABI: nothing can have been sent either
+        out = []
+        cap = 4096
+        buf = ctypes.create_string_buffer(cap)
+        conn = ctypes.c_uint64()
+        while not max_n or len(out) < max_n:
+            n = fn(self._handle(), ctypes.byref(conn), buf, cap)
+            if n <= -2:  # message larger than buf: resize and retry
+                cap = -(int(n) + 2)
+                buf = ctypes.create_string_buffer(cap)
+                continue
+            if n < 0:
+                break
+            out.append((conn.value, buf.raw[: int(n)]))
+        return out
 
     def recv(self, conn_id: int, max_bytes: int = 1 << 20, timeout_ms: int = 10000) -> bytes:
         buf = ctypes.create_string_buffer(max_bytes)

@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import multiprocessing as mp
 import os
+import time
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -90,8 +91,17 @@ def decode_worker(port_q, result_q, new_tokens):
         v_host = np.zeros(shape, np.float32)
     ep.send(conn, ep.advertise(ep.reg(k_host)))
     ep.send(conn, ep.advertise(ep.reg(v_host)))
-    # prefill side signals completion + sends (length, first generated token)
-    meta = np.frombuffer(ep.recv(conn, timeout_ms=30000), np.int32)
+    # Data-arrival signal rides the NIXL notify pattern (reference
+    # p2p/uccl_engine.h:218-226): the prefill side one-sided-writes the
+    # cache, then sends a notif carrying (length, first generated token);
+    # the decode side drains non-blocking — free to do other work (e.g.
+    # serve other requests) between polls.
+    deadline = time.monotonic() + 30.0
+    while not (notifs := ep.get_notifs(max_n=1)):
+        if time.monotonic() > deadline:
+            raise TimeoutError("no KV-arrival notif within 30s")
+        time.sleep(0.002)
+    meta = np.frombuffer(notifs[0][1], np.int32)
     length, first_tok = int(meta[0]), meta[1 : 1 + BATCH]
 
     if compress != "off":
@@ -194,7 +204,7 @@ def main():
         ep.write(conn, k_host, fifo_k)  # one-sided cache push
         ep.write(conn, v_host, fifo_v)
     meta = np.concatenate([[int(cache.length)], first_tok]).astype(np.int32)
-    ep.send(conn, np.ascontiguousarray(meta))
+    ep.send_notif(conn, np.ascontiguousarray(meta).tobytes())
     if args.compress == "off":
         print(
             f"prefill: shipped KV cache {k_host.nbytes * 2 / 1e6:.2f} MB "

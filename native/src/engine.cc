@@ -28,6 +28,51 @@
 namespace uccl_tpu {
 
 namespace {
+// Detect ThreadSanitizer under both gcc (__SANITIZE_THREAD__) and clang
+// (__has_feature). The wire-order fence and the syscall-read suppression
+// below exist purely for the race detector; production builds compile to
+// the exact pre-fence code.
+#if defined(__SANITIZE_THREAD__)
+#define UCCLT_TSAN 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define UCCLT_TSAN 1
+#endif
+#endif
+#ifndef UCCLT_TSAN
+#define UCCLT_TSAN 0
+#endif
+
+#if UCCLT_TSAN
+// Wire-order fence: a kernel TCP socket orders a sender's ::send before the
+// peer's matching read, but TSAN cannot see through the socket — under
+// single-process loopback a completed transfer's buffer reuse would be
+// flagged as a race on the payload pointer. A release RMW BEFORE each
+// ::send (bytes cannot reach the peer until the syscall copies them, which
+// is after the release) and an acquire load per fully-received frame make
+// the real ordering visible to the detector. The one access this cannot
+// cover is the syscall's own read of the payload (it follows the release
+// by construction), so that read is explicitly ignored — its safety is the
+// keepalive contract (source buffers outlive the transfer until a terminal
+// state) plus kernel ordering, the exact invariant the Python/channel
+// layers enforce.
+std::atomic<uint64_t> g_wire_order{0};
+extern "C" void AnnotateIgnoreReadsBegin(const char* f, int l);
+extern "C" void AnnotateIgnoreReadsEnd(const char* f, int l);
+#define UCCLT_WIRE_RELEASE() \
+  g_wire_order.fetch_add(1, std::memory_order_release)
+#define UCCLT_WIRE_ACQUIRE() \
+  ((void)g_wire_order.load(std::memory_order_acquire))
+#define UCCLT_TSAN_IGNORE_READS_BEGIN() \
+  AnnotateIgnoreReadsBegin(__FILE__, __LINE__)
+#define UCCLT_TSAN_IGNORE_READS_END() AnnotateIgnoreReadsEnd(__FILE__, __LINE__)
+#else
+#define UCCLT_WIRE_RELEASE() ((void)0)
+#define UCCLT_WIRE_ACQUIRE() ((void)0)
+#define UCCLT_TSAN_IGNORE_READS_BEGIN() ((void)0)
+#define UCCLT_TSAN_IGNORE_READS_END() ((void)0)
+#endif
+
 constexpr uint32_t kMagic = 0x7C71u;
 // Upper bound on a single frame payload — rejects absurd lengths from a buggy
 // or malicious peer before any allocation happens.
@@ -651,7 +696,12 @@ bool Endpoint::service_tx(Conn* c, bool* blocked) {
         base = it->payload() + poff;
         n = it->wire_len - poff;
       }
+      // Release precedes the syscall: every prior write to the payload is
+      // published before any byte can reach the peer (see g_wire_order).
+      UCCLT_WIRE_RELEASE();
+      UCCLT_TSAN_IGNORE_READS_BEGIN();
       ssize_t s = ::send(c->fd, base, n, MSG_NOSIGNAL);
+      UCCLT_TSAN_IGNORE_READS_END();
       if (s < 0) {
         if (errno == EINTR) continue;
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
@@ -897,6 +947,9 @@ void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
 // Finish one fully-received frame (io thread only): dispatch by op, release
 // the window pin, reset the state machine for the next header.
 void Endpoint::finish_rx_frame(Conn* c) {
+  // Acquire side of the wire-order fence (see g_wire_order): the sender's
+  // pre-send writes happen-before everything after this frame's dispatch.
+  UCCLT_WIRE_ACQUIRE();
   const FrameHeader& h = c->rx_hdr;
   size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
   bytes_rx_.fetch_add(sizeof(h) + body);

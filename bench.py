@@ -213,6 +213,20 @@ def main():
     moe_impl = os.environ.get("UCCL_TPU_BENCH_MOE", "sort")
     if moe_impl not in ("sort", "ll", "dense"):
         sys.exit(f"[bench] UCCL_TPU_BENCH_MOE={moe_impl!r}: want sort|ll|dense")
+    # Remat schedule for BOTH the fast path and the baseline (identical
+    # numerics across modes — tests/test_flagship.py::TestRematModes):
+    # "dots" trades activation memory for zero backward GEMM recompute.
+    remat = os.environ.get("UCCL_TPU_BENCH_REMAT", "full")
+    if remat not in ("full", "dots", "none"):
+        sys.exit(f"[bench] UCCL_TPU_BENCH_REMAT={remat!r}: want full|dots|none")
+    # Batch/seq overrides validated here too — before the probe.
+    try:
+        batch_env = int(os.environ.get("UCCL_TPU_BENCH_BATCH", "0"))
+        seq_env = int(os.environ.get("UCCL_TPU_BENCH_SEQ", "0"))
+    except ValueError as e:
+        sys.exit(f"[bench] bad UCCL_TPU_BENCH_BATCH/SEQ: {e}")
+    if batch_env < 0 or seq_env < 0:
+        sys.exit("[bench] UCCL_TPU_BENCH_BATCH/SEQ must not be negative")
 
     healthy, platform, device_kind = _probe_device()
     if not healthy:
@@ -223,6 +237,11 @@ def main():
         }
     else:
         batch, seq, cfg_shrink = 8, 1024, {}
+    # On-chip MFU levers, sweepable without code edits (ladder step 7):
+    # larger batch raises MXU utilization until HBM runs out. Applied to
+    # the baseline too, so vs_baseline stays apples-to-apples.
+    batch = batch_env or batch
+    seq = seq_env or seq
     rng = np.random.default_rng(0)
     vocab = cfg_shrink.get("vocab", _BASE_VOCAB)
     tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
@@ -235,7 +254,8 @@ def main():
     flash_failed = None
     try:
         tps, dt, cfg = _measure(
-            {"attn_impl": attn_impl, "moe_impl": moe_impl, **cfg_shrink},
+            {"attn_impl": attn_impl, "moe_impl": moe_impl, "remat": remat,
+             **cfg_shrink},
             batch, seq, tokens, targets,
         )
     except Exception as e:
@@ -249,7 +269,8 @@ def main():
         print(f"[bench] flash path failed ({flash_failed}); retrying with "
               "attn=xla", file=sys.stderr)
         tps, dt, cfg = _measure(
-            {"attn_impl": "xla", "moe_impl": moe_impl, **cfg_shrink},
+            {"attn_impl": "xla", "moe_impl": moe_impl, "remat": remat,
+             **cfg_shrink},
             batch, seq, tokens, targets,
         )
         attn_impl = "xla"
@@ -257,7 +278,8 @@ def main():
     # Vendor baseline: stock XLA lowering of the same model — dense GShard
     # einsum dispatch, plain XLA attention. Same shapes, same optimizer.
     base_tps, base_dt, _ = _measure(
-        {"attn_impl": "xla", "moe_impl": "dense", **cfg_shrink},
+        {"attn_impl": "xla", "moe_impl": "dense", "remat": remat,
+         **cfg_shrink},
         batch, seq, tokens, targets,
     )
 
@@ -271,6 +293,9 @@ def main():
         "device": device_kind,
         "attn_impl": attn_impl,
         "moe_impl": moe_impl,
+        "remat": remat,
+        "batch": batch,
+        "seq": seq,
     }
     peak = _peak_flops(device_kind)
     if peak:

@@ -56,19 +56,38 @@ namespace {
 // keepalive contract (source buffers outlive the transfer until a terminal
 // state) plus kernel ordering, the exact invariant the Python/channel
 // layers enforce.
-std::atomic<uint64_t> g_wire_order{0};
+//
+// SCOPING: one global atomic would add happens-before edges between ALL
+// threads touching ANY connection, masking unrelated real races from the
+// detector. Instead the fence is an array slot keyed by the connection's
+// NORMALIZED 4-tuple hash — both ends of one socket compute the same slot
+// (addresses sorted), so edges form (essentially) only along the real
+// kernel-ordered channel; hash collisions can only ADD edges, never remove
+// detection of the fenced pair.
+std::atomic<uint64_t> g_wire_order[256];
 extern "C" void AnnotateIgnoreReadsBegin(const char* f, int l);
 extern "C" void AnnotateIgnoreReadsEnd(const char* f, int l);
-#define UCCLT_WIRE_RELEASE() \
-  g_wire_order.fetch_add(1, std::memory_order_release)
-#define UCCLT_WIRE_ACQUIRE() \
-  ((void)g_wire_order.load(std::memory_order_acquire))
+uint32_t wire_slot_for_fd(int fd) {
+  sockaddr_in a{}, b{};
+  socklen_t al = sizeof(a), bl = sizeof(b);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&a), &al);
+  ::getpeername(fd, reinterpret_cast<sockaddr*>(&b), &bl);
+  uint64_t x = (static_cast<uint64_t>(a.sin_addr.s_addr) << 16) ^ a.sin_port;
+  uint64_t y = (static_cast<uint64_t>(b.sin_addr.s_addr) << 16) ^ b.sin_port;
+  uint64_t lo = x < y ? x : y, hi = x < y ? y : x;
+  uint64_t h = lo * 0x9E3779B97F4A7C15ull ^ hi;
+  return static_cast<uint32_t>((h >> 13) & 255);
+}
+#define UCCLT_WIRE_RELEASE(slot) \
+  g_wire_order[slot].fetch_add(1, std::memory_order_release)
+#define UCCLT_WIRE_ACQUIRE(slot) \
+  ((void)g_wire_order[slot].load(std::memory_order_acquire))
 #define UCCLT_TSAN_IGNORE_READS_BEGIN() \
   AnnotateIgnoreReadsBegin(__FILE__, __LINE__)
 #define UCCLT_TSAN_IGNORE_READS_END() AnnotateIgnoreReadsEnd(__FILE__, __LINE__)
 #else
-#define UCCLT_WIRE_RELEASE() ((void)0)
-#define UCCLT_WIRE_ACQUIRE() ((void)0)
+#define UCCLT_WIRE_RELEASE(slot) ((void)0)
+#define UCCLT_WIRE_ACQUIRE(slot) ((void)0)
 #define UCCLT_TSAN_IGNORE_READS_BEGIN() ((void)0)
 #define UCCLT_TSAN_IGNORE_READS_END() ((void)0)
 #endif
@@ -698,7 +717,7 @@ bool Endpoint::service_tx(Conn* c, bool* blocked) {
       }
       // Release precedes the syscall: every prior write to the payload is
       // published before any byte can reach the peer (see g_wire_order).
-      UCCLT_WIRE_RELEASE();
+      UCCLT_WIRE_RELEASE(wire_slot_for_fd(c->fd));
       UCCLT_TSAN_IGNORE_READS_BEGIN();
       ssize_t s = ::send(c->fd, base, n, MSG_NOSIGNAL);
       UCCLT_TSAN_IGNORE_READS_END();
@@ -949,7 +968,7 @@ void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
 void Endpoint::finish_rx_frame(Conn* c) {
   // Acquire side of the wire-order fence (see g_wire_order): the sender's
   // pre-send writes happen-before everything after this frame's dispatch.
-  UCCLT_WIRE_ACQUIRE();
+  UCCLT_WIRE_ACQUIRE(wire_slot_for_fd(c->fd));
   const FrameHeader& h = c->rx_hdr;
   size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
   bytes_rx_.fetch_add(sizeof(h) + body);

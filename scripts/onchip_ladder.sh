@@ -8,8 +8,9 @@
 #                            harness: one dispatch, host-scalar sync)
 #   3. ep_bench            — sorted-vs-dense + LL dispatch/combine µs,
 #                            ragged wire (TPU-only lowering)
-#   then: flash block-size sweep at long sequence, and the bench.py MoE
-#   impl sweep (UCCL_TPU_BENCH_MOE=ll — ragged grouped-GEMM path on MXU)
+#   then: flash block-size sweep at long sequence; bench.py MoE impl sweep
+#   (UCCL_TPU_BENCH_MOE=ll — ragged grouped-GEMM path on MXU); batch sweep
+#   (UCCL_TPU_BENCH_BATCH — the MFU lever); remat sweep (UCCL_TPU_BENCH_REMAT)
 # Everything appends to docs/ONCHIP_$(date +%Y%m%d).log; transcribe wins
 # into PERF.md immediately.
 #
@@ -28,24 +29,31 @@ if ! timeout 150 python -c "import jax; ds=jax.devices(); assert ds[0].platform=
 fi
 say "tunnel healthy"
 
-say "1/6 bench.py"
+say "1/8 bench.py"
 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
 
-say "2/6 attention sweep (flash vs xla crossover)"
+say "2/8 attention sweep (flash vs xla crossover)"
 timeout 2400 python benchmarks/attention_bench.py \
   --seqs 1024,2048,4096,8192 --iters 10 2>&1 | tee -a "$LOG"
 
-say "3/6 ep_bench latency table (E in {8,32}, normal + LL)"
+say "3/8 ep_bench latency table (E in {8,32}, normal + LL)"
 timeout 2400 python benchmarks/ep_bench.py --table 2>&1 | tee -a "$LOG"
 
-say "4/6 ep_bench --compare-dense"
+say "4/8 ep_bench --compare-dense"
 timeout 2400 python benchmarks/ep_bench.py --compare-dense 2>&1 | tee -a "$LOG"
 
-say "5/6 flash block-size sweep at long sequence"
+say "5/8 flash block-size sweep at long sequence"
 timeout 2400 python benchmarks/attention_bench.py --block-sweep \
   --seqs 4096,8192 --iters 10 2>&1 | tee -a "$LOG"
 
-say "6/6 bench.py MoE-impl sweep (ragged grouped-GEMM path on MXU)"
+say "6/8 bench.py MoE-impl sweep (ragged grouped-GEMM path on MXU)"
 UCCL_TPU_BENCH_MOE=ll timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
+
+say "7/8 bench.py batch sweep (MFU vs batch; HBM permitting)"
+UCCL_TPU_BENCH_BATCH=16 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
+UCCL_TPU_BENCH_BATCH=32 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
+
+say "8/8 bench.py remat sweep (dots saves fwd GEMMs from bwd recompute)"
+UCCL_TPU_BENCH_REMAT=dots timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
 
 say "ladder complete $(date +%H:%M:%S) - transcribe into PERF.md now"

@@ -609,3 +609,53 @@ class TestDispatchRecvCounts:
                     )
         # occupancy bound: each (src, expert) chunk holds <= capacity rows
         assert rc.max() <= cap
+
+
+class TestCrossImplFuzz:
+    """Randomized shape/seed sweep: the three moe_ffn implementations
+    (dense mask-einsum oracle, sorted/ragged fast path, packed low-latency
+    grouped-GEMM) must agree at ample capacity across arbitrary
+    (T, E, K, H, F) — the property the fixed-shape oracle tests pin at one
+    point each. Catches shape-dependent layout bugs (odd T, K > 2,
+    non-power-of-two H) that single-shape tests cannot."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_impls_agree_on_random_shapes(self, ep_mesh, seed):
+        rng = np.random.default_rng(1000 + seed)
+        t = int(rng.integers(5, 40))
+        e = int(rng.choice([8, 16]))  # divisible by W=4
+        k = int(rng.integers(1, 4))
+        h = int(rng.choice([8, 24, 48]))
+        f = int(rng.choice([8, 32]))
+        e_local = e // W
+        x = rng.standard_normal((W, t, h)).astype(np.float32)
+        logits = rng.standard_normal((W, t, e)).astype(np.float32)
+        wg = (rng.standard_normal((W, e_local, h, f)) * 0.1).astype(np.float32)
+        wu = (rng.standard_normal((W, e_local, h, f)) * 0.1).astype(np.float32)
+        wd = (rng.standard_normal((W, e_local, f, h)) * 0.1).astype(np.float32)
+
+        outs = {}
+        for impl in ("dense", "sort", "ll"):
+            def fn(xv, lg, g, u, d, impl=impl):
+                out, aux, z = ep_ops.moe_ffn(
+                    xv[0], lg[0], g[0], u[0], d[0], ("dp", "cp"),
+                    num_selected=k, capacity_factor=float(e),  # no drops
+                    impl=impl,
+                )
+                return out[None]
+
+            outs[impl] = np.asarray(
+                _shard_run(
+                    ep_mesh, fn, (x, logits, wg, wu, wd), (2, 2, 3, 3, 3), 2
+                )
+            )
+            assert outs[impl].shape == (W, t, h), (impl, outs[impl].shape)
+        shapes = f"T={t} E={e} K={k} H={h} F={f}"
+        np.testing.assert_allclose(
+            outs["sort"], outs["dense"], rtol=2e-3, atol=1e-5,
+            err_msg=f"sort vs dense at {shapes}",
+        )
+        np.testing.assert_allclose(
+            outs["ll"], outs["dense"], rtol=2e-3, atol=1e-5,
+            err_msg=f"ll vs dense at {shapes}",
+        )

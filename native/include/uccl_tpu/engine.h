@@ -247,6 +247,11 @@ class Endpoint {
     int fd = -1;
     uint64_t id = 0;
     int engine = 0;  // which engine serves this conn
+    // TSAN wire-order fence slot (engine.cc g_wire_order): hash of the
+    // NORMALIZED 4-tuple, computed ONCE at registration while the socket
+    // is healthy — both ends hash to the same slot, and a later peer abort
+    // (getpeername ENOTCONN) can no longer desynchronize the two sides.
+    uint32_t wire_slot = 0;
 
     // --- rx state machine (io thread only): a peer stalling mid-frame just
     // leaves the state parked; the epoll loop never blocks on one conn.

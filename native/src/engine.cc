@@ -67,17 +67,6 @@ namespace {
 std::atomic<uint64_t> g_wire_order[256];
 extern "C" void AnnotateIgnoreReadsBegin(const char* f, int l);
 extern "C" void AnnotateIgnoreReadsEnd(const char* f, int l);
-uint32_t wire_slot_for_fd(int fd) {
-  sockaddr_in a{}, b{};
-  socklen_t al = sizeof(a), bl = sizeof(b);
-  ::getsockname(fd, reinterpret_cast<sockaddr*>(&a), &al);
-  ::getpeername(fd, reinterpret_cast<sockaddr*>(&b), &bl);
-  uint64_t x = (static_cast<uint64_t>(a.sin_addr.s_addr) << 16) ^ a.sin_port;
-  uint64_t y = (static_cast<uint64_t>(b.sin_addr.s_addr) << 16) ^ b.sin_port;
-  uint64_t lo = x < y ? x : y, hi = x < y ? y : x;
-  uint64_t h = lo * 0x9E3779B97F4A7C15ull ^ hi;
-  return static_cast<uint32_t>((h >> 13) & 255);
-}
 #define UCCLT_WIRE_RELEASE(slot) \
   g_wire_order[slot].fetch_add(1, std::memory_order_release)
 #define UCCLT_WIRE_ACQUIRE(slot) \
@@ -115,6 +104,24 @@ void set_nonblocking(int fd) {
 uint64_t random_token() {
   static thread_local std::mt19937_64 gen{std::random_device{}()};
   return gen();
+}
+
+// Fence slot for a connected fd: hash of the normalized 4-tuple so both
+// ends of one socket agree (see g_wire_order). On syscall failure falls
+// back to slot 0 — a collision can only ADD detector edges. Computed once
+// per connection at registration (the 4-tuple is immutable afterwards).
+[[maybe_unused]] uint32_t wire_slot_for_fd(int fd) {
+  sockaddr_in a{}, b{};
+  socklen_t al = sizeof(a), bl = sizeof(b);
+  if (::getsockname(fd, reinterpret_cast<sockaddr*>(&a), &al) != 0 ||
+      ::getpeername(fd, reinterpret_cast<sockaddr*>(&b), &bl) != 0) {
+    return 0;
+  }
+  uint64_t x = (static_cast<uint64_t>(a.sin_addr.s_addr) << 16) ^ a.sin_port;
+  uint64_t y = (static_cast<uint64_t>(b.sin_addr.s_addr) << 16) ^ b.sin_port;
+  uint64_t lo = x < y ? x : y, hi = x < y ? y : x;
+  uint64_t h = lo * 0x9E3779B97F4A7C15ull ^ hi;
+  return static_cast<uint32_t>((h >> 13) & 255);
 }
 
 uint64_t now_ns() {
@@ -262,6 +269,7 @@ int64_t Endpoint::connect(const std::string& ip, uint16_t port,
 
 void Endpoint::register_conn(const std::shared_ptr<Conn>& c) {
   c->engine = static_cast<int>(c->id % engines_.size());
+  c->wire_slot = wire_slot_for_fd(c->fd);
   set_nonblocking(c->fd);  // rx state machine + queued tx never block
   {
     std::lock_guard<std::mutex> lk(conns_mtx_);
@@ -717,7 +725,7 @@ bool Endpoint::service_tx(Conn* c, bool* blocked) {
       }
       // Release precedes the syscall: every prior write to the payload is
       // published before any byte can reach the peer (see g_wire_order).
-      UCCLT_WIRE_RELEASE(wire_slot_for_fd(c->fd));
+      UCCLT_WIRE_RELEASE(c->wire_slot);
       UCCLT_TSAN_IGNORE_READS_BEGIN();
       ssize_t s = ::send(c->fd, base, n, MSG_NOSIGNAL);
       UCCLT_TSAN_IGNORE_READS_END();
@@ -968,7 +976,7 @@ void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
 void Endpoint::finish_rx_frame(Conn* c) {
   // Acquire side of the wire-order fence (see g_wire_order): the sender's
   // pre-send writes happen-before everything after this frame's dispatch.
-  UCCLT_WIRE_ACQUIRE(wire_slot_for_fd(c->fd));
+  UCCLT_WIRE_ACQUIRE(c->wire_slot);
   const FrameHeader& h = c->rx_hdr;
   size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
   bytes_rx_.fetch_add(sizeof(h) + body);

@@ -265,3 +265,28 @@ class TestRetransmission:
         except IOError as e:
             assert "still in flight" in str(e)
         np.testing.assert_array_equal(dst, src)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_lossy_write_never_corrupts(self, chan_pair, seed):
+        """THE retransmission invariant, fuzzed: whatever the (drop rate,
+        retry budget, message size) combination, a write() that RETURNS
+        implies the peer window holds exactly the sent bytes; the only
+        other allowed outcome is IOError. Silent corruption — returning
+        with partial/stale data — fails the assert."""
+        server, client, s_chan, c_chan = chan_pair
+        rng = np.random.default_rng(7000 + seed)
+        c_chan.retries = int(rng.choice([0, 2, 8]))
+        drop = float(rng.choice([0.0, 0.1, 0.4]))
+        n = int(rng.integers(1, 20)) * (32 << 10)  # 32K..640K, 64K chunks
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        client.set_drop_rate(drop)
+        try:
+            try:
+                c_chan.write(src, fifo, timeout_ms=400)
+            except IOError:
+                return  # allowed outcome under loss; nothing to assert
+        finally:
+            client.set_drop_rate(0.0)
+        np.testing.assert_array_equal(dst, src)

@@ -1,0 +1,229 @@
+"""Unified trainer entry: ``python -m uccl_tpu.train``.
+
+The consumer-facing front door the reference's users reach through
+torchrun + Megatron/DDP scripts (examples/ddp_train.py there; OSDI AE
+workloads, collective/utran_osdi26ae.md:151-163): pick a model family,
+describe the mesh, train — with periodic orbax checkpoints and
+bit-identical resume (tests/test_checkpoint.py proves the state trees are
+checkpoint-transparent; this wires the loop around them).
+
+    python -m uccl_tpu.train --model flagship --mesh dp=2,cp=2,tp=2 \
+        --devices 8 --steps 20 --batch 8 --seq 64 \
+        --ckpt-dir /tmp/run1 --ckpt-every 10
+    # later, continue from the newest checkpoint:
+    python -m uccl_tpu.train ... --ckpt-dir /tmp/run1 --resume
+
+Data is a seeded synthetic stream where step i's batch depends only on i,
+so an interrupted+resumed run replays the exact uninterrupted trajectory
+(the resume test's contract). Swap ``_batch_for_step`` for a real loader
+in production.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+
+def parse_mesh(spec: str):
+    """"dp=2,cp=2,tp=2" -> MeshConfig (unnamed axes default to 1)."""
+    from uccl_tpu.parallel.mesh import MeshConfig
+
+    sizes = {}
+    if spec:
+        for part in spec.split(","):
+            m = re.fullmatch(r"(pp|dp|cp|tp)=(\d+)", part.strip())
+            if not m:
+                raise SystemExit(
+                    f"bad --mesh entry {part!r} (want e.g. dp=2,tp=2)"
+                )
+            sizes[m.group(1)] = int(m.group(2))
+    return MeshConfig(**sizes)
+
+
+def build(args, mesh):
+    """Returns (cfg, params, train_step, init_opt) for the model family."""
+    import jax
+
+    if args.model == "flagship":
+        from uccl_tpu.models import flagship as fam
+    else:
+        from uccl_tpu.models import dense as fam
+
+    size_kw = dict(
+        vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+        n_heads=args.heads, n_kv_heads=args.kv_heads,
+        head_dim=args.dim // args.heads,
+        n_microbatches=args.microbatches,
+    )
+    if args.model == "flagship":
+        size_kw.update(
+            moe_experts=args.experts, moe_ffn=args.ffn,
+            moe_topk=2, remat=args.remat,
+        )
+    else:
+        size_kw.update(ffn=args.ffn, remat=args.remat)
+    cfg = (fam.FlagshipConfig if args.model == "flagship"
+           else fam.DenseConfig)(**size_kw)
+    params = fam.shard_params(
+        fam.init_params(jax.random.PRNGKey(args.seed), cfg), mesh, cfg
+    )
+    train_step, init_opt = fam.make_train_step(cfg, mesh, learning_rate=args.lr)
+    return cfg, params, train_step, init_opt
+
+
+def _batch_for_step(step_i, batch, seq, vocab):
+    """Deterministic synthetic batch: a function of the step index ONLY, so
+    resumed runs see the same stream."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(10_000 + step_i)
+    tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    targets = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    return tokens, targets
+
+
+def _latest_step(ckpt_dir):
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        m = re.fullmatch(r"step_(\d+)", name)
+        if m:
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+def _save(ckpt_dir, step_i, params, opt_state):
+    """ONE orbax save of the combined state tree: the write is a single
+    atomic directory rename, so an interrupted run can never leave a
+    half-checkpoint that _latest_step would pick but _restore cannot load."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(ckpt_dir, f"step_{step_i}")
+    ocp.PyTreeCheckpointer().save(path, {"params": params, "opt": opt_state})
+
+
+def _restore(ckpt_dir, step_i, params, opt_state):
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(ckpt_dir, f"step_{step_i}")
+    tree = ocp.PyTreeCheckpointer().restore(
+        path, item={"params": params, "opt": opt_state}
+    )
+    return tree["params"], tree["opt"]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m uccl_tpu.train")
+    ap.add_argument("--model", default="flagship",
+                    choices=["flagship", "dense"])
+    ap.add_argument("--mesh", default="", help="e.g. pp=2,dp=2,tp=2")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force an N-device virtual CPU mesh (tests/dev)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--log-every", type=int, default=5)
+    # model size
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--ffn", type=int, default=128)
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--microbatches", type=int, default=1)
+    ap.add_argument("--remat", default="full", choices=["full", "dots", "none"])
+    # checkpointing
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--ckpt-every", type=int, default=0)
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    from uccl_tpu.parallel.mesh import make_mesh
+
+    mcfg = parse_mesh(args.mesh)
+    devices = jax.devices()
+    if args.mesh and mcfg.size != len(devices):
+        raise SystemExit(
+            f"mesh size {mcfg.size} != device count {len(devices)}"
+        )
+    mesh = make_mesh(mcfg if args.mesh else None, devices)
+    dp = mcfg.dp if args.mesh else len(devices)
+    cp = mcfg.cp if args.mesh else 1
+    if args.batch % dp or args.seq % cp:
+        raise SystemExit(
+            f"--batch {args.batch} must divide by dp={dp} and --seq "
+            f"{args.seq} by cp={cp} (data is sharded [batch/dp, seq/cp])"
+        )
+    cfg, params, train_step, init_opt = build(args, mesh)
+    opt_state = init_opt(params)
+
+    start = 0
+    if args.resume:
+        if not (args.ckpt_dir and os.path.isdir(args.ckpt_dir)):
+            raise SystemExit("--resume needs an existing --ckpt-dir")
+        latest = _latest_step(args.ckpt_dir)
+        if latest is None:
+            raise SystemExit(f"no step_N checkpoints in {args.ckpt_dir}")
+        params, opt_state = _restore(args.ckpt_dir, latest, params, opt_state)
+        start = latest
+        print(f"resumed from {args.ckpt_dir}/step_{latest}", flush=True)
+    elif args.ckpt_dir and os.path.isdir(args.ckpt_dir) \
+            and _latest_step(args.ckpt_dir) is not None:
+        # fail BEFORE training, not at the first save (orbax refuses to
+        # overwrite an existing step_N and would waste the whole run)
+        raise SystemExit(
+            f"{args.ckpt_dir} already holds checkpoints; pass --resume to "
+            "continue from them or choose a fresh --ckpt-dir"
+        )
+
+    step = jax.jit(train_step)
+    t0 = time.perf_counter()
+    metrics = None
+    for i in range(start, args.steps):
+        tokens, targets = _batch_for_step(i, args.batch, args.seq, args.vocab)
+        params, opt_state, metrics = step(params, opt_state, tokens, targets)
+        if args.log_every and (i + 1) % args.log_every == 0:
+            extra = (
+                f" ce {float(metrics['ce']):.6f}" if "ce" in metrics else ""
+            )
+            print(
+                f"step {i + 1:5d} loss {float(metrics['loss']):.6f}{extra}",
+                flush=True,
+            )
+        if args.ckpt_dir and args.ckpt_every and (i + 1) % args.ckpt_every == 0:
+            _save(args.ckpt_dir, i + 1, params, opt_state)
+            print(f"checkpointed step {i + 1}", flush=True)
+    dt = time.perf_counter() - t0
+    done = args.steps - start
+    summary = {
+        "model": args.model,
+        "mesh": {"pp": mcfg.pp, "dp": mcfg.dp, "cp": mcfg.cp, "tp": mcfg.tp}
+        if args.mesh else {"dp": len(devices)},
+        "steps": done,
+        "final_loss": round(float(metrics["loss"]), 6) if metrics else None,
+        "steps_per_sec": round(done / dt, 3) if done else 0.0,
+    }
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -269,7 +269,11 @@ int64_t Endpoint::connect(const std::string& ip, uint16_t port,
 
 void Endpoint::register_conn(const std::shared_ptr<Conn>& c) {
   c->engine = static_cast<int>(c->id % engines_.size());
+#if UCCLT_TSAN
+  // populated only for the race detector's wire-order fence; production
+  // builds skip the two syscalls and never read the field
   c->wire_slot = wire_slot_for_fd(c->fd);
+#endif
   set_nonblocking(c->fd);  // rx state machine + queued tx never block
   {
     std::lock_guard<std::mutex> lk(conns_mtx_);

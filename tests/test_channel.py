@@ -277,7 +277,7 @@ class TestRetransmission:
         rng = np.random.default_rng(7000 + seed)
         c_chan.retries = int(rng.choice([0, 2, 8]))
         drop = float(rng.choice([0.0, 0.1, 0.4]))
-        n = int(rng.integers(1, 20)) * (32 << 10)  # 32K..640K, 64K chunks
+        n = int(rng.integers(1, 21)) * (32 << 10)  # 32K..640K, 64K chunks
         dst = np.zeros(n, np.uint8)
         fifo = server.advertise(server.reg(dst))
         src = rng.integers(0, 255, n).astype(np.uint8)

@@ -21,6 +21,7 @@ from uccl_tpu.ep import ll, ops
 from uccl_tpu.ep.buffer import Buffer, LowLatencyHandle
 from uccl_tpu.ep.cross_pod import CrossPodMoE
 from uccl_tpu.ep.elastic import ElasticBuffer, ElasticKVCache
+from uccl_tpu.ep.engram import EngramTable, mesh_fetch
 
 __all__ = [
     "ops",
@@ -30,4 +31,6 @@ __all__ = [
     "CrossPodMoE",
     "ElasticBuffer",
     "ElasticKVCache",
+    "EngramTable",
+    "mesh_fetch",
 ]

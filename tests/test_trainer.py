@@ -57,3 +57,38 @@ def test_mesh_size_mismatch_fails_cleanly(tmp_path):
     )
     assert r.returncode != 0
     assert "mesh size 3 != device count 8" in r.stderr
+
+
+def test_joins_launcher_session(tmp_path):
+    """UCCL_TPU_COORD et al (set by scripts/launch.py) make the trainer
+    join the multi-host session before touching devices."""
+    import socket
+
+    # the store binds coordinator-port + 1, so reserve the PAIR
+    port = None
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            cand = s.getsockname()[1]
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", cand + 1))
+            port = cand
+            break
+        except OSError:
+            continue
+    assert port is not None
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        UCCL_TPU_COORD=f"127.0.0.1:{port}", UCCL_TPU_RANK="0",
+        UCCL_TPU_WORLD="1", UCCL_TPU_INIT_JAX="0",
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "uccl_tpu.train", "--devices", "8",
+         "--mesh", "dp=2,cp=2,tp=2", "--batch", "4", "--seq", "32",
+         "--steps", "1", "--log-every", "1"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "joined session rank 0/1" in r.stdout
+    assert "step     1 loss" in r.stdout

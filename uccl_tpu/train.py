@@ -157,6 +157,32 @@ def main(argv=None):
     else:
         import jax
 
+    session = None
+    if "UCCL_TPU_COORD" in os.environ:
+        # Launched by scripts/launch.py (torchrun-shaped): join the
+        # session BEFORE any device query so jax.distributed can assemble
+        # the global device view.
+        from uccl_tpu.parallel.distributed import initialize_from_env
+
+        session = initialize_from_env()
+        print(
+            f"joined session rank {session.rank}/{session.world}", flush=True
+        )
+        if session.world > 1:
+            # Honest gate: the loop below feeds process-local batches and
+            # saves single-process checkpoints; a world>1 run would crash
+            # inside jit on sharding mismatch. Multi-host training needs
+            # per-host global-array feeding (make_array_from_process_local
+            # _data) + multihost-aware checkpointing — fail fast with the
+            # reason instead. Multi-process DATA-parallel training IS
+            # available today via examples/ddp_train.py --processes.
+            raise SystemExit(
+                "python -m uccl_tpu.train drives one controller; for "
+                "multi-process data-parallel training use "
+                "examples/ddp_train.py --processes N (compat.dist), or run "
+                "one trainer over all local devices"
+            )
+
     from uccl_tpu.parallel.mesh import make_mesh
 
     mcfg = parse_mesh(args.mesh)
@@ -223,6 +249,8 @@ def main(argv=None):
         "steps_per_sec": round(done / dt, 3) if done else 0.0,
     }
     print(json.dumps(summary), flush=True)
+    if session is not None:
+        session.close()  # release the OOB store port/threads promptly
 
 
 if __name__ == "__main__":

@@ -1170,11 +1170,17 @@ size_t Endpoint::stats_json(char* out, size_t cap) {
                             : cap - off - 1;
     }
   };
+  size_t notifs_pending = 0;
+  {
+    std::lock_guard<std::mutex> lk(notifq_mtx_);
+    notifs_pending = notifq_.size();
+  }
   put("{\"bytes_tx\":%llu,\"bytes_rx\":%llu,\"stats_ticks\":%llu,"
-      "\"engines\":[",
+      "\"notifs_pending\":%llu,\"engines\":[",
       static_cast<unsigned long long>(bytes_tx_.load()),
       static_cast<unsigned long long>(bytes_rx_.load()),
-      static_cast<unsigned long long>(stats_ticks_.load()));
+      static_cast<unsigned long long>(stats_ticks_.load()),
+      static_cast<unsigned long long>(notifs_pending));
   for (size_t e = 0; e < engines_.size(); ++e) {
     auto& eng = *engines_[e];
     size_t txq_bytes = 0;

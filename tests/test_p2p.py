@@ -465,6 +465,17 @@ class TestNotifs:
             time.sleep(0.02)
         assert notifs == [(conn_s, b"n1")]
 
+    def test_pending_notifs_visible_in_stats(self, pair):
+        server, client, conn_s, conn_c = pair
+        client.send_notif(conn_c, b"queued")
+        for _ in range(100):
+            if server.stats.get("notifs_pending", 0) == 1:
+                break
+            time.sleep(0.02)
+        assert server.stats["notifs_pending"] == 1
+        assert server.get_notifs() == [(conn_s, b"queued")]
+        assert server.stats["notifs_pending"] == 0
+
     def test_notif_ordering_and_large(self, pair):
         server, client, conn_s, conn_c = pair
         big = b"B" * 10000  # larger than the 4096 drain buffer

@@ -548,9 +548,11 @@ class Endpoint:
         """Hot-loop engine stats (reference: periodic transport stats,
         collective/rdma/transport.cc:1797 + util/latency.h histograms):
         ``bytes_tx/rx``, ``stats_ticks`` (heartbeats of the 2s stats
-        thread; UCCL_TPU_ENGINE_STATS=1 also logs each tick), and per-engine
-        ``engines[i]`` dicts with tx/rx frame counts, frame service latency
-        p50/p99 (µs), queued tx bytes, and task-ring depth."""
+        thread; UCCL_TPU_ENGINE_STATS=1 also logs each tick),
+        ``notifs_pending`` (undrained out-of-band notifications), and
+        per-engine ``engines[i]`` dicts with tx/rx frame counts, frame
+        service latency p50/p99 (µs), queued tx bytes, and task-ring
+        depth."""
         import json as _json
 
         buf = ctypes.create_string_buffer(1 << 16)

@@ -28,6 +28,15 @@ import re
 import sys
 import time
 
+# Runnable both as `python -m uccl_tpu.train` and as a plain script path
+# (the launcher's contract: scripts/launch.py train.py ...). Only the
+# script-path case needs the repo root on sys.path — a library import must
+# not mutate it (it could shadow an installed uccl_tpu).
+if __package__ in (None, ""):
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
 
 def parse_mesh(spec: str):
     """"dp=2,cp=2,tp=2" -> MeshConfig (unnamed axes default to 1)."""
@@ -77,14 +86,15 @@ def build(args, mesh):
 
 
 def _batch_for_step(step_i, batch, seq, vocab):
-    """Deterministic synthetic batch: a function of the step index ONLY, so
-    resumed runs see the same stream."""
-    import jax.numpy as jnp
+    """Deterministic synthetic batch (host arrays): a function of the step
+    index ONLY, so resumed runs see the same stream. Device placement is
+    the caller's job — single-controller jit takes numpy directly;
+    multihost shards it via make_array_from_callback."""
     import numpy as np
 
     rng = np.random.default_rng(10_000 + step_i)
-    tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
-    targets = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    tokens = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
+    targets = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
     return tokens, targets
 
 
@@ -107,12 +117,32 @@ def _save(ckpt_dir, step_i, params, opt_state):
     ocp.PyTreeCheckpointer().save(path, {"params": params, "opt": opt_state})
 
 
-def _restore(ckpt_dir, step_i, params, opt_state):
+def _restore(ckpt_dir, step_i, params, opt_state, mesh):
+    """Restore WITH explicit target shardings: the live trees' shardings
+    become orbax restore_args, so a checkpoint saved under one process
+    topology resumes under another (elastic restart; without this, orbax
+    can only re-apply the save-time shardings and cross-topology resume
+    dies with a 'sharding ... should be specified' error). Leaves without
+    a mesh sharding (optimizer scalars like adam's count are born on one
+    device) restore REPLICATED over the mesh — a committed single-device
+    scalar would conflict with the 8-device params inside jit."""
+    import jax
     import orbax.checkpoint as ocp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     path = os.path.join(ckpt_dir, f"step_{step_i}")
+    item = {"params": params, "opt": opt_state}
+
+    def args_for(x):
+        sh = getattr(x, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            sh = NamedSharding(mesh, P())
+        return ocp.ArrayRestoreArgs(
+            sharding=sh, global_shape=x.shape, dtype=x.dtype
+        )
+
     tree = ocp.PyTreeCheckpointer().restore(
-        path, item={"params": params, "opt": opt_state}
+        path, item=item, restore_args=jax.tree.map(args_for, item)
     )
     return tree["params"], tree["opt"]
 
@@ -168,23 +198,14 @@ def main(argv=None):
         print(
             f"joined session rank {session.rank}/{session.world}", flush=True
         )
-        if session.world > 1:
-            # Honest gate: the loop below feeds process-local batches and
-            # saves single-process checkpoints; a world>1 run would crash
-            # inside jit on sharding mismatch. Multi-host training needs
-            # per-host global-array feeding (make_array_from_process_local
-            # _data) + multihost-aware checkpointing — fail fast with the
-            # reason instead. Multi-process DATA-parallel training IS
-            # available today via examples/ddp_train.py --processes.
-            raise SystemExit(
-                "python -m uccl_tpu.train drives one controller; for "
-                "multi-process data-parallel training use "
-                "examples/ddp_train.py --processes N (compat.dist), or run "
-                "one trainer over all local devices"
-            )
 
     from uccl_tpu.parallel.mesh import make_mesh
 
+    # Multi-controller mode (scripts/launch.py with jax.distributed on):
+    # every process sees the GLOBAL device list; batches must be assembled
+    # as global arrays and only rank 0 narrates.
+    multihost = session is not None and session.world > 1
+    chatty = not multihost or session.rank == 0
     mcfg = parse_mesh(args.mesh)
     devices = jax.devices()
     if args.mesh and mcfg.size != len(devices):
@@ -209,9 +230,14 @@ def main(argv=None):
         latest = _latest_step(args.ckpt_dir)
         if latest is None:
             raise SystemExit(f"no step_N checkpoints in {args.ckpt_dir}")
-        params, opt_state = _restore(args.ckpt_dir, latest, params, opt_state)
+        params, opt_state = _restore(
+            args.ckpt_dir, latest, params, opt_state, mesh
+        )
         start = latest
-        print(f"resumed from {args.ckpt_dir}/step_{latest}", flush=True)
+        if chatty:
+            print(
+                f"resumed from {args.ckpt_dir}/step_{latest}", flush=True
+            )
     elif args.ckpt_dir and os.path.isdir(args.ckpt_dir) \
             and _latest_step(args.ckpt_dir) is not None:
         # fail BEFORE training, not at the first save (orbax refuses to
@@ -222,12 +248,29 @@ def main(argv=None):
         )
 
     step = jax.jit(train_step)
+    if multihost:
+        # Every process builds the SAME deterministic global batch (cheap,
+        # synthetic); make_array_from_callback hands each process only its
+        # addressable shards of the [batch, seq] arrays, laid out exactly
+        # as the model's data spec expects — no resharding inside jit.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data_sharding = NamedSharding(mesh, P("dp", "cp"))
+
+        def place(arr):
+            return jax.make_array_from_callback(
+                arr.shape, data_sharding, lambda idx: arr[idx]
+            )
+    else:
+        place = None
     t0 = time.perf_counter()
     metrics = None
     for i in range(start, args.steps):
         tokens, targets = _batch_for_step(i, args.batch, args.seq, args.vocab)
+        if place is not None:
+            tokens, targets = place(tokens), place(targets)
         params, opt_state, metrics = step(params, opt_state, tokens, targets)
-        if args.log_every and (i + 1) % args.log_every == 0:
+        if chatty and args.log_every and (i + 1) % args.log_every == 0:
             extra = (
                 f" ce {float(metrics['ce']):.6f}" if "ce" in metrics else ""
             )
@@ -237,7 +280,8 @@ def main(argv=None):
             )
         if args.ckpt_dir and args.ckpt_every and (i + 1) % args.ckpt_every == 0:
             _save(args.ckpt_dir, i + 1, params, opt_state)
-            print(f"checkpointed step {i + 1}", flush=True)
+            if chatty:
+                print(f"checkpointed step {i + 1}", flush=True)
     dt = time.perf_counter() - t0
     done = args.steps - start
     summary = {
@@ -248,7 +292,10 @@ def main(argv=None):
         "final_loss": round(float(metrics["loss"]), 6) if metrics else None,
         "steps_per_sec": round(done / dt, 3) if done else 0.0,
     }
-    print(json.dumps(summary), flush=True)
+    if multihost:
+        summary["processes"] = session.world
+    if chatty:
+        print(json.dumps(summary), flush=True)
     if session is not None:
         session.close()  # release the OOB store port/threads promptly
 

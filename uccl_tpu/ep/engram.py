@@ -23,7 +23,7 @@ same two deployment shapes as the rest of the EP pillar:
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Sequence, Tuple
+from typing import Callable, Dict, List, Tuple
 
 import numpy as np
 

@@ -1,0 +1,203 @@
+"""Serving entry: ``python -m uccl_tpu.serve`` — the inference face of the
+trainer's checkpoints.
+
+Train → checkpoint → serve, end to end: `python -m uccl_tpu.train
+--ckpt-dir d --ckpt-every k` writes orbax state whose parameter tree is
+layout-identical to the serving model's, so this entry restores the params
+subtree and generates through :class:`uccl_tpu.models.moe_inference.
+MoEServer` — EP-sharded KV-cache prefill (sorted throughput path) +
+decode (packed low-latency path, the DeepEP LL regime). The reference's
+consumers reach this shape through vLLM + its transfer/EP plugins
+(ep/bench/vllm/disagg_proxy.py); here it is one command:
+
+    python -m uccl_tpu.serve --devices 8 --ckpt-dir /tmp/run1 \
+        --batch 8 --prompt-len 8 --new-tokens 16
+
+Without --ckpt-dir, params initialize from --seed (smoke/benchmark mode).
+Prompts are deterministic synthetic token ids (no tokenizer in scope).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+if __package__ in (None, ""):
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _load_params(ckpt_dir, step):
+    """Restore the params subtree of a trainer checkpoint as HOST arrays.
+
+    Restoring to numpy (restore_args built from the checkpoint's own
+    metadata tree) decouples serving from the training topology: a
+    checkpoint saved on 8 devices loads on any serving host — a plain
+    restore would try to re-apply the save-time shardings and die when
+    the device counts differ."""
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from uccl_tpu.train import _latest_step
+
+    if step is None:
+        step = _latest_step(ckpt_dir)
+        if step is None:
+            raise SystemExit(f"no step_N checkpoints in {ckpt_dir}")
+    path = os.path.join(ckpt_dir, f"step_{step}")
+    ckpt = ocp.PyTreeCheckpointer()
+    meta = ckpt.metadata(path).item_metadata  # dict-shaped pytree metadata
+
+    # walk the metadata tree by mapping structure (its leaves are metadata
+    # objects that jax.tree would descend into)
+    def to_args(node):
+        if hasattr(node, "keys"):
+            return {k: to_args(node[k]) for k in node.keys()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(to_args(v) for v in node)
+        return ocp.RestoreArgs(restore_type=np.ndarray)
+
+    tree = ckpt.restore(path, restore_args=to_args(meta))
+    if "params" not in tree:
+        raise SystemExit(f"{path} is not a trainer checkpoint (no params)")
+    return tree["params"], step
+
+
+def _check_sizes(params, cfg):
+    """Friendly mismatch errors for EVERY size flag, before any placement:
+    embed pins (vocab, dim), we_gate pins (layers, experts, ffn), wq pins
+    heads*head_dim."""
+    import numpy as np
+
+    checks = [
+        ("embed", (cfg.vocab, cfg.dim), "--vocab/--dim"),
+        ("blocks.we_gate",
+         (cfg.n_layers, cfg.moe_experts, cfg.dim, cfg.moe_ffn),
+         "--layers/--experts/--dim/--ffn"),
+        ("blocks.wq",
+         (cfg.n_layers, cfg.dim, cfg.n_heads * cfg.head_dim),
+         "--layers/--dim/--heads"),
+    ]
+    for name, want, flags in checks:
+        leaf = params
+        for part in name.split("."):
+            leaf = leaf[part]
+        got = tuple(np.shape(leaf))
+        if got != want:
+            raise SystemExit(
+                f"checkpoint {name} {got} != model {want} ({flags}): "
+                "pass the training run's size flags"
+            )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m uccl_tpu.serve")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force an N-device virtual CPU mesh (tests/dev)")
+    ap.add_argument("--dp", type=int, default=0,
+                    help="serving world (default: all devices)")
+    ap.add_argument("--batch", type=int, default=8, help="global batch")
+    ap.add_argument("--prompt-len", type=int, default=8)
+    ap.add_argument("--new-tokens", type=int, default=16)
+    ap.add_argument("--max-seq", type=int, default=0,
+                    help="KV capacity (default: prompt+new)")
+    ap.add_argument("--impl", default="ll", choices=["ll", "sort"],
+                    help="decode-step EP path (prefill always uses sort)")
+    ap.add_argument("--seed", type=int, default=0)
+    # model size — must match the checkpoint when --ckpt-dir is given
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--ffn", type=int, default=128)
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--step", type=int, default=None,
+                    help="checkpoint step (default: latest)")
+    args = ap.parse_args(argv)
+
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from uccl_tpu.models.moe_inference import (
+        MoEServeConfig, MoEServer, init_params,
+    )
+    from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = MoEServeConfig(
+        vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+        n_heads=args.heads, n_kv_heads=args.kv_heads,
+        head_dim=args.dim // args.heads, moe_experts=args.experts,
+        moe_ffn=args.ffn,
+    )
+    n = len(jax.devices())
+    world = args.dp or n
+    # fail the cheap flag checks in milliseconds, BEFORE any restore work
+    if args.batch % world:
+        raise SystemExit(f"--batch {args.batch} must divide by world {world}")
+    max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
+    if args.prompt_len + args.new_tokens > max_seq:
+        raise SystemExit(
+            f"--prompt-len {args.prompt_len} + --new-tokens "
+            f"{args.new_tokens} exceed --max-seq {max_seq}"
+        )
+    mesh = make_mesh(MeshConfig(dp=world), jax.devices()[:world])
+    server = MoEServer(cfg, mesh)
+
+    step = None
+    if args.ckpt_dir:
+        params, step = _load_params(args.ckpt_dir, args.step)
+        _check_sizes(params, cfg)
+        params = jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), params)
+        print(f"serving {args.ckpt_dir}/step_{step}", flush=True)
+    else:
+        params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    placed = server.shard_params(params)
+
+    b_local = args.batch // world
+    rng = np.random.default_rng(args.seed)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab, (world, b_local, args.prompt_len)),
+        jnp.int32,
+    )
+
+    # Warmup compiles the prefill + decode programs (same shapes as the
+    # timed run) so tokens_per_sec measures decode, not XLA compilation.
+    server.generate(placed, prompt, 1, max_seq, impl=args.impl)
+    t0 = time.perf_counter()
+    out = server.generate(
+        placed, prompt, args.new_tokens, max_seq, impl=args.impl
+    )
+    out = np.asarray(out)  # [W, B_loc, N]
+    dt = time.perf_counter() - t0
+    total = args.batch * args.new_tokens
+    print(f"first sequence: {out[0, 0].tolist()}", flush=True)
+    print(json.dumps({
+        "mode": "serve",
+        "ckpt_step": step,
+        "impl": args.impl,
+        "world": world,
+        "batch": args.batch,
+        "new_tokens": args.new_tokens,
+        "tokens_per_sec": round(total / dt, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

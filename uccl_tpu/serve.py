@@ -54,16 +54,26 @@ def _load_params(ckpt_dir, step):
 
     # walk the metadata tree by mapping structure (its leaves are metadata
     # objects that jax.tree would descend into)
-    def to_args(node):
+    def to_args(node, as_args):
         if hasattr(node, "keys"):
-            return {k: to_args(node[k]) for k in node.keys()}
+            return {k: to_args(node[k], as_args) for k in node.keys()}
         if isinstance(node, (list, tuple)):
-            return type(node)(to_args(v) for v in node)
-        return ocp.RestoreArgs(restore_type=np.ndarray)
+            return type(node)(to_args(v, as_args) for v in node)
+        if as_args:
+            return ocp.RestoreArgs(restore_type=np.ndarray)
+        return 0  # placeholder leaf for the item template
 
-    tree = ckpt.restore(path, restore_args=to_args(meta))
-    if "params" not in tree:
+    if "params" not in (meta.keys() if hasattr(meta, "keys") else ()):
         raise SystemExit(f"{path} is not a trainer checkpoint (no params)")
+    # Restore ONLY the params subtree (transforms-based partial restore):
+    # the optimizer moments are ~2x the param bytes and serving never
+    # touches them.
+    tree = ckpt.restore(
+        path,
+        item={"params": to_args(meta["params"], as_args=False)},
+        restore_args={"params": to_args(meta["params"], as_args=True)},
+        transforms={},
+    )
     return tree["params"], step
 
 
@@ -140,6 +150,35 @@ def main(argv=None):
     )
     from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
 
+    # A trainer checkpoint records its model family + sizes in config.json;
+    # prefer that over flags (flags that DIFFER are an error — shapes like
+    # heads vs kv-heads cannot all be recovered from param shapes alone,
+    # so silent flag drift would serve silently-wrong tokens).
+    saved_cfg = None
+    if args.ckpt_dir:
+        cfg_path = os.path.join(args.ckpt_dir, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                saved_cfg = json.load(f)
+            if saved_cfg.get("model") != "flagship":
+                raise SystemExit(
+                    f"{args.ckpt_dir} holds a {saved_cfg.get('model')!r} "
+                    "checkpoint; serve generates from flagship (MoE) "
+                    "checkpoints only"
+                )
+            defaults = ap.parse_args([])
+            for flag, key in [
+                ("vocab", "vocab"), ("dim", "dim"), ("layers", "layers"),
+                ("heads", "heads"), ("kv_heads", "kv_heads"),
+                ("ffn", "ffn"), ("experts", "experts"),
+            ]:
+                given = getattr(args, flag)
+                if given != getattr(defaults, flag) and given != saved_cfg[key]:
+                    raise SystemExit(
+                        f"--{flag.replace('_', '-')} {given} != checkpoint "
+                        f"config {saved_cfg[key]} ({cfg_path})"
+                    )
+                setattr(args, flag, saved_cfg[key])
     cfg = MoEServeConfig(
         vocab=args.vocab, dim=args.dim, n_layers=args.layers,
         n_heads=args.heads, n_kv_heads=args.kv_heads,

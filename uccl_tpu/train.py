@@ -85,17 +85,52 @@ def build(args, mesh):
     return cfg, params, train_step, init_opt
 
 
-def _batch_for_step(step_i, batch, seq, vocab):
-    """Deterministic synthetic batch (host arrays): a function of the step
-    index ONLY, so resumed runs see the same stream. Device placement is
-    the caller's job — single-controller jit takes numpy directly;
-    multihost shards it via make_array_from_callback."""
+def _batch_for_step(step_i, batch, seq, vocab, corpus=None):
+    """Deterministic batch (host arrays): a function of the step index
+    ONLY, so resumed runs see the same stream. Device placement is the
+    caller's job — single-controller jit takes numpy directly; multihost
+    shards it via make_array_from_callback.
+
+    With a ``corpus`` (a 1-D int token memmap from --data), batch rows are
+    contiguous windows at deterministic step-indexed offsets and targets
+    are the next-token shift — the standard LM objective. Without one, the
+    stream is seeded synthetic noise."""
     import numpy as np
 
+    if corpus is not None:
+        n = corpus.shape[0] - seq - 1
+        rng = np.random.default_rng(10_000 + step_i)
+        starts = rng.integers(0, n, batch)
+        tokens = np.stack([corpus[s : s + seq] for s in starts])
+        targets = np.stack([corpus[s + 1 : s + seq + 1] for s in starts])
+        return tokens.astype(np.int32), targets.astype(np.int32)
     rng = np.random.default_rng(10_000 + step_i)
     tokens = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
     targets = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
     return tokens, targets
+
+
+def _open_corpus(path, vocab, seq):
+    """Memmap a 1-D int token file (.npy). Validated once over the WHOLE
+    corpus: every id in [0, vocab), long enough for one window — an
+    out-of-range id would otherwise clamp in the embedding gather and
+    silently corrupt training."""
+    import numpy as np
+
+    corpus = np.load(path, mmap_mode="r")
+    if corpus.ndim != 1 or not np.issubdtype(corpus.dtype, np.integer):
+        raise SystemExit(f"--data {path}: want a 1-D integer token array")
+    if corpus.shape[0] < seq + 2:
+        raise SystemExit(
+            f"--data {path}: {corpus.shape[0]} tokens < one {seq}-token window"
+        )
+    hi, lo = int(corpus.max()), int(corpus.min())
+    if lo < 0 or hi >= vocab:
+        raise SystemExit(
+            f"--data {path}: token ids span [{lo}, {hi}], outside "
+            f"[0, {vocab})"
+        )
+    return corpus
 
 
 def _latest_step(ckpt_dir):
@@ -107,12 +142,23 @@ def _latest_step(ckpt_dir):
     return max(steps) if steps else None
 
 
-def _save(ckpt_dir, step_i, params, opt_state):
+def _save(ckpt_dir, step_i, params, opt_state, model_cfg=None):
     """ONE orbax save of the combined state tree: the write is a single
     atomic directory rename, so an interrupted run can never leave a
-    half-checkpoint that _latest_step would pick but _restore cannot load."""
+    half-checkpoint that _latest_step would pick but _restore cannot load.
+    ``model_cfg`` (model family + size flags) is recorded ONCE as
+    config.json beside the checkpoints — serving reads it back instead of
+    guessing sizes from flags."""
     import orbax.checkpoint as ocp
 
+    if model_cfg is not None:
+        cfg_path = os.path.join(ckpt_dir, "config.json")
+        if not os.path.exists(cfg_path):
+            os.makedirs(ckpt_dir, exist_ok=True)
+            tmp = f"{cfg_path}.{os.getpid()}.tmp"  # rank-unique
+            with open(tmp, "w") as f:
+                json.dump(model_cfg, f)
+            os.replace(tmp, cfg_path)
     path = os.path.join(ckpt_dir, f"step_{step_i}")
     ocp.PyTreeCheckpointer().save(path, {"params": params, "opt": opt_state})
 
@@ -170,6 +216,9 @@ def main(argv=None):
     ap.add_argument("--experts", type=int, default=8)
     ap.add_argument("--microbatches", type=int, default=1)
     ap.add_argument("--remat", default="full", choices=["full", "dots", "none"])
+    ap.add_argument("--data", default="",
+                    help="1-D int token .npy (memmapped); batches are "
+                         "next-token windows at step-indexed offsets")
     # checkpointing
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--ckpt-every", type=int, default=0)
@@ -220,6 +269,8 @@ def main(argv=None):
             f"--batch {args.batch} must divide by dp={dp} and --seq "
             f"{args.seq} by cp={cp} (data is sharded [batch/dp, seq/cp])"
         )
+    corpus = _open_corpus(args.data, args.vocab, args.seq) if args.data \
+        else None
     cfg, params, train_step, init_opt = build(args, mesh)
     opt_state = init_opt(params)
 
@@ -266,7 +317,9 @@ def main(argv=None):
     t0 = time.perf_counter()
     metrics = None
     for i in range(start, args.steps):
-        tokens, targets = _batch_for_step(i, args.batch, args.seq, args.vocab)
+        tokens, targets = _batch_for_step(
+            i, args.batch, args.seq, args.vocab, corpus
+        )
         if place is not None:
             tokens, targets = place(tokens), place(targets)
         params, opt_state, metrics = step(params, opt_state, tokens, targets)
@@ -279,7 +332,12 @@ def main(argv=None):
                 flush=True,
             )
         if args.ckpt_dir and args.ckpt_every and (i + 1) % args.ckpt_every == 0:
-            _save(args.ckpt_dir, i + 1, params, opt_state)
+            _save(args.ckpt_dir, i + 1, params, opt_state, model_cfg={
+                "model": args.model, "vocab": args.vocab, "dim": args.dim,
+                "layers": args.layers, "heads": args.heads,
+                "kv_heads": args.kv_heads, "ffn": args.ffn,
+                "experts": args.experts,
+            })
             if chatty:
                 print(f"checkpointed step {i + 1}", flush=True)
     dt = time.perf_counter() - t0

@@ -81,3 +81,21 @@ def test_serve_cross_topology(tmp_path):
     ])
     summary = json.loads(out.strip().splitlines()[-1])
     assert summary["world"] == 4 and summary["ckpt_step"] == 1
+
+
+def test_serve_dense_checkpoint(tmp_path):
+    """Dense-family trainer checkpoints generate through the cached
+    single-shard KV path (config.json routes the family)."""
+    ck = str(tmp_path / "ck")
+    _run("uccl_tpu.train", [
+        "--devices", "8", "--model", "dense", "--batch", "8", "--seq", "32",
+        "--steps", "1", "--log-every", "0",
+        "--ckpt-dir", ck, "--ckpt-every", "1",
+    ])
+    out = _run("uccl_tpu.serve", [
+        "--devices", "8", "--ckpt-dir", ck, "--batch", "4",
+        "--prompt-len", "4", "--new-tokens", "6",
+    ])
+    assert "(dense)" in out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["impl"] == "dense" and summary["new_tokens"] == 6

@@ -83,6 +83,13 @@ def _check_sizes(params, cfg):
     heads*head_dim."""
     import numpy as np
 
+    if "we_gate" not in params.get("blocks", {}):
+        raise SystemExit(
+            "checkpoint parameter tree has no expert weights — this looks "
+            "like a dense-family checkpoint; serve routes families via the "
+            "checkpoint dir's config.json (re-save with the current trainer "
+            "or restore it manually)"
+        )
     checks = [
         ("embed", (cfg.vocab, cfg.dim), "--vocab/--dim"),
         ("blocks.we_gate",
@@ -160,18 +167,20 @@ def main(argv=None):
         if os.path.exists(cfg_path):
             with open(cfg_path) as f:
                 saved_cfg = json.load(f)
-            if saved_cfg.get("model") != "flagship":
+            if saved_cfg.get("model") not in ("flagship", "dense"):
                 raise SystemExit(
                     f"{args.ckpt_dir} holds a {saved_cfg.get('model')!r} "
-                    "checkpoint; serve generates from flagship (MoE) "
-                    "checkpoints only"
+                    "checkpoint; serve handles flagship (MoE) and dense"
                 )
             defaults = ap.parse_args([])
-            for flag, key in [
+            pairs = [
                 ("vocab", "vocab"), ("dim", "dim"), ("layers", "layers"),
                 ("heads", "heads"), ("kv_heads", "kv_heads"),
-                ("ffn", "ffn"), ("experts", "experts"),
-            ]:
+                ("ffn", "ffn"),
+            ]
+            if saved_cfg.get("model") == "flagship":
+                pairs.append(("experts", "experts"))  # MoE-only flag
+            for flag, key in pairs:
                 given = getattr(args, flag)
                 if given != getattr(defaults, flag) and given != saved_cfg[key]:
                     raise SystemExit(
@@ -179,6 +188,54 @@ def main(argv=None):
                         f"config {saved_cfg[key]} ({cfg_path})"
                     )
                 setattr(args, flag, saved_cfg[key])
+    if saved_cfg is not None and saved_cfg.get("model") == "dense":
+        # Dense (Llama-family) checkpoints generate through the cached
+        # single-shard KV path (models/inference.py) — no EP mesh. The
+        # prefill/decode programs jit ONCE here and the decode loop reuses
+        # them, so the timed window measures decode, not compilation
+        # (inference.generate re-jits per call and bakes the scan length,
+        # which would make a warmup call useless).
+        from uccl_tpu.models.dense import DenseConfig
+        from uccl_tpu.models.inference import decode_step, prefill
+
+        dcfg = DenseConfig(
+            vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+            n_heads=args.heads, n_kv_heads=args.kv_heads,
+            head_dim=args.dim // args.heads, ffn=args.ffn,
+        )
+        max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
+        if args.prompt_len + args.new_tokens > max_seq:
+            raise SystemExit("--prompt-len + --new-tokens exceed --max-seq")
+        params, step = _load_params(args.ckpt_dir, args.step)
+        params = jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), params)
+        print(f"serving {args.ckpt_dir}/step_{step} (dense)", flush=True)
+        rng = np.random.default_rng(args.seed)
+        prompt = jnp.asarray(
+            rng.integers(0, dcfg.vocab, (args.batch, args.prompt_len)),
+            jnp.int32,
+        )
+        prefill_j = jax.jit(lambda p, t: prefill(p, t, dcfg, max_seq))
+        decode_j = jax.jit(lambda p, tok, c: decode_step(p, tok, c, dcfg))
+        logits, cache = prefill_j(params, prompt)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        decode_j(params, tok, cache)[0].block_until_ready()  # warm decode
+        t0 = time.perf_counter()
+        out = []
+        for _ in range(args.new_tokens):
+            out.append(tok)
+            logits, cache = decode_j(params, tok, cache)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = np.stack([np.asarray(t) for t in out], axis=1)
+        dt = time.perf_counter() - t0
+        print(f"first sequence: {out[0].tolist()}", flush=True)
+        print(json.dumps({
+            "mode": "serve", "ckpt_step": step, "impl": "dense",
+            "world": 1, "batch": args.batch,
+            "new_tokens": args.new_tokens,
+            "tokens_per_sec": round(args.batch * args.new_tokens / dt, 1),
+        }), flush=True)
+        return
+
     cfg = MoEServeConfig(
         vocab=args.vocab, dim=args.dim, n_layers=args.layers,
         n_heads=args.heads, n_kv_heads=args.kv_heads,

@@ -29,31 +29,36 @@ if ! timeout 150 python -c "import jax; ds=jax.devices(); assert ds[0].platform=
 fi
 say "tunnel healthy"
 
-say "1/8 bench.py"
+say "1/9 bench.py"
 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
 
-say "2/8 attention sweep (flash vs xla crossover)"
+say "2/9 attention sweep (flash vs xla crossover)"
 timeout 2400 python benchmarks/attention_bench.py \
   --seqs 1024,2048,4096,8192 --iters 10 2>&1 | tee -a "$LOG"
 
-say "3/8 ep_bench latency table (E in {8,32}, normal + LL)"
+say "3/9 ep_bench latency table (E in {8,32}, normal + LL)"
 timeout 2400 python benchmarks/ep_bench.py --table 2>&1 | tee -a "$LOG"
 
-say "4/8 ep_bench --compare-dense"
+say "4/9 ep_bench --compare-dense"
 timeout 2400 python benchmarks/ep_bench.py --compare-dense 2>&1 | tee -a "$LOG"
 
-say "5/8 flash block-size sweep at long sequence"
+say "5/9 flash block-size sweep at long sequence"
 timeout 2400 python benchmarks/attention_bench.py --block-sweep \
   --seqs 4096,8192 --iters 10 2>&1 | tee -a "$LOG"
 
-say "6/8 bench.py MoE-impl sweep (ragged grouped-GEMM path on MXU)"
+say "6/9 bench.py MoE-impl sweep (ragged grouped-GEMM path on MXU)"
 UCCL_TPU_BENCH_MOE=ll timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
 
-say "7/8 bench.py batch sweep (MFU vs batch; HBM permitting)"
+say "7/9 bench.py batch sweep (MFU vs batch; HBM permitting)"
 UCCL_TPU_BENCH_BATCH=16 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
 UCCL_TPU_BENCH_BATCH=32 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
 
-say "8/8 bench.py remat sweep (dots saves fwd GEMMs from bwd recompute)"
+say "8/9 bench.py remat sweep (dots saves fwd GEMMs from bwd recompute)"
 UCCL_TPU_BENCH_REMAT=dots timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
+
+say "9/9 serve decode throughput (EP LL path, seed params)"
+timeout 2400 python -m uccl_tpu.serve --batch 8 --prompt-len 128 \
+  --new-tokens 64 --vocab 16384 --dim 1024 --layers 4 --heads 16 \
+  --kv-heads 4 --ffn 2816 2>&1 | tee -a "$LOG"
 
 say "ladder complete $(date +%H:%M:%S) - transcribe into PERF.md now"

@@ -1,0 +1,51 @@
+#!/bin/bash
+# Full CPU-runnable acceptance ladder in one command — everything the repo
+# can prove without the TPU tunnel (the on-chip ladder is
+# scripts/onchip_ladder.sh). Mirrors CI plus the example workloads the
+# driver/judge spot-check.
+#
+# Usage: scripts/qa.sh [quick]   (quick = suite + native tests only)
+set -u
+cd "$(dirname "$0")/.."
+fail=0
+note() { echo; echo "=== $* ==="; }
+check() { if [ "$1" -ne 0 ]; then echo "^^^ FAILED"; fail=1; fi; }
+
+note "pytest (full suite, virtual 8-device mesh)"
+timeout 2700 python -m pytest tests/ -q; check $?
+
+note "native substrate + engine tests"
+timeout 900 make -C native test; check $?
+note "native tests under ThreadSanitizer"
+timeout 900 make -C native tsan; check $?
+note "native tests under ASan+UBSan"
+timeout 900 make -C native asan; check $?
+note "net-plugin allreduce acceptance (dlopen vtable, 4 ranks)"
+timeout 900 make -C native perf; check $?
+
+if [ "${1:-}" != "quick" ]; then
+  note "examples: disagg KV (exact, fp8, lossless, elastic)"
+  UCCL_TPU_EXAMPLE_CPU=1 timeout 900 python examples/disagg_kv.py --cpu; check $?
+  UCCL_TPU_EXAMPLE_CPU=1 timeout 900 python examples/disagg_kv.py --cpu --compress lossless; check $?
+  note "examples: 2-pod hierarchical allreduce"
+  UCCL_TPU_EXAMPLE_CPU=1 timeout 900 python examples/multipod_allreduce.py; check $?
+  note "examples: DDP (mesh + process ranks)"
+  timeout 900 python examples/ddp_train.py --devices 2 --steps 4 --batch 8; check $?
+  timeout 900 python examples/ddp_train.py --processes 2 --steps 4 --batch 8; check $?
+  note "examples: RL weight sync"
+  timeout 900 python examples/rl_weight_sync.py; check $?
+  note "trainer + serve handoff"
+  rm -rf /tmp/qa_ck
+  timeout 900 python -m uccl_tpu.train --devices 8 --mesh dp=2,cp=2,tp=2 \
+    --batch 4 --seq 32 --steps 2 --log-every 0 \
+    --ckpt-dir /tmp/qa_ck --ckpt-every 2; check $?
+  timeout 900 python -m uccl_tpu.serve --devices 8 --ckpt-dir /tmp/qa_ck \
+    --batch 8 --prompt-len 6 --new-tokens 8; check $?
+  note "bench.py (driver metric; CPU fallback when the tunnel is down)"
+  UCCL_TPU_BENCH_PROBE_ATTEMPTS=1 UCCL_TPU_BENCH_PROBE_TIMEOUT=30 \
+    timeout 1800 python bench.py; check $?
+fi
+
+echo
+if [ "$fail" -eq 0 ]; then echo "QA LADDER: ALL GREEN"; else echo "QA LADDER: FAILURES ABOVE"; fi
+exit $fail

@@ -28,6 +28,10 @@ if ! timeout 150 python -c "import jax; ds=jax.devices(); assert ds[0].platform=
   exit 1
 fi
 say "tunnel healthy"
+# bench.py steps probe once with a short deadline: the ladder already
+# verified the tunnel, and a mid-ladder wedge should cost minutes, not
+# 11 min of retries per step
+export UCCL_TPU_BENCH_PROBE_ATTEMPTS=1 UCCL_TPU_BENCH_PROBE_TIMEOUT=120
 
 say "1/9 bench.py"
 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"

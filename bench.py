@@ -156,49 +156,75 @@ def _model_flops_per_token(cfg, seq: int) -> float:
     return 3.0 * fwd
 
 
-def _time_steps(step, params, opt_state, tokens, targets, warmup=2, iters=5):
-    # Two tunneled-platform hazards shape this: block_until_ready can return
-    # before device work finishes (so: sync via a host read of the loss), and
-    # per-dispatch overhead is ~10 ms (so: run all iterations inside ONE
-    # jitted fori_loop dispatch instead of one dispatch per step).
-    from jax import lax
+class _Harness:
+    """One config variant held resident so samples can be interleaved with
+    another variant's (A-B-A-B): back-to-back measurement of ours/baseline
+    is what let a single scheduling-noise window swing the round-4 recorded
+    vs_baseline to 0.925 while the clean number was 1.254.
 
-    def run(params, opt_state, n):
-        def body(_, state):
-            p, o, _m = state
-            return step(p, o, tokens, targets)
+    Two tunneled-platform hazards shape the timing loop: block_until_ready
+    can return before device work finishes (so: sync via a host read of the
+    loss), and per-dispatch overhead is ~10 ms (so: run all iterations
+    inside ONE jitted fori_loop dispatch instead of one dispatch per step).
+    """
 
-        init = step(params, opt_state, tokens, targets)
-        return lax.fori_loop(0, n - 1, body, init)
+    def __init__(self, cfg_kw, tokens, targets):
+        from jax import lax
 
-    # n traced -> one compile serves warmup and timing. params/opt_state are
-    # DONATED: XLA aliases them into the loop-carried outputs, so the step
-    # never pays an input copy of the largest buffers (each call site
-    # rebinds to the returned state, keeping the donated references dead).
-    run = jax.jit(run, donate_argnums=(0, 1))
-    params, opt_state, m = run(params, opt_state, max(1, warmup))
-    float(m["loss"])  # sync warmup + compile
-    # The first call returns the state with XLA's canonicalized output
-    # shardings, which can differ from the inputs' NamedShardings (observed
-    # on 1-device meshes: named specs come back replicated) — so the NEXT
-    # call recompiles for the new argument shardings. Without this second
-    # throwaway call the timed call was ~95% XLA compile (measured 2078
-    # "ms/step" vs 175 ms real on the CPU config). After it, shardings are
-    # at their fixed point and the timed call is a pure cache hit.
-    params, opt_state, m = run(params, opt_state, 1)
-    float(m["loss"])
-    t0 = time.perf_counter()
-    _, _, m = run(params, opt_state, iters)
-    float(m["loss"])
-    return (time.perf_counter() - t0) / iters
+        self.cfg, mesh, self._params, step, self._opt = _build(cfg_kw)
+        step = jax.jit(step)
+
+        def run(params, opt_state, n):
+            def body(_, state):
+                p, o, _m = state
+                return step(p, o, tokens, targets)
+
+            init = step(params, opt_state, tokens, targets)
+            return lax.fori_loop(0, n - 1, body, init)
+
+        # n traced -> one compile serves warmup and timing. params/opt_state
+        # are DONATED: XLA aliases them into the loop-carried outputs, so the
+        # step never pays an input copy of the largest buffers (each call
+        # rebinds self._params/_opt to the returned state, keeping the
+        # donated references dead).
+        self._run = jax.jit(run, donate_argnums=(0, 1))
+
+    def _call(self, n):
+        self._params, self._opt, m = self._run(self._params, self._opt, n)
+        return float(m["loss"])  # host read = real sync on the tunnel
+
+    def warmup(self):
+        self._call(2)  # compile + warm
+        # The first call returns the state with XLA's canonicalized output
+        # shardings, which can differ from the inputs' NamedShardings
+        # (observed on 1-device meshes: named specs come back replicated) —
+        # so the NEXT call recompiles for the new argument shardings.
+        # Without this throwaway call the timed call was ~95% XLA compile
+        # (measured 2078 "ms/step" vs 175 ms real on the CPU config). After
+        # it, shardings are at their fixed point and every later call is a
+        # pure cache hit.
+        self._call(1)
+
+    def sample(self, iters):
+        """Median-able single observation: seconds per step over `iters`."""
+        t0 = time.perf_counter()
+        self._call(iters)
+        return (time.perf_counter() - t0) / iters
+
+    def free(self):
+        self._params = self._opt = self._run = None
 
 
-def _measure(cfg_kw, batch, seq, tokens, targets):
-    """Build + time one config variant; returns (tokens/s, step dt, cfg)."""
-    cfg, mesh, params, train_step, opt_state = _build(cfg_kw)
-    dt = _time_steps(jax.jit(train_step), params, opt_state, tokens, targets)
-    del params, opt_state  # free HBM before the next variant builds
-    return batch * seq / dt, dt, cfg
+from statistics import median as _median  # noqa: E402
+
+
+def _interleaved_dts(ours, base, rounds, iters):
+    """A-B-A-B sample schedule; returns (ours_dts, base_dts) lists."""
+    ours_dts, base_dts = [], []
+    for _ in range(rounds):
+        ours_dts.append(ours.sample(iters))
+        base_dts.append(base.sample(iters))
+    return ours_dts, base_dts
 
 
 def main():
@@ -223,13 +249,26 @@ def main():
     try:
         batch_env = int(os.environ.get("UCCL_TPU_BENCH_BATCH", "0"))
         seq_env = int(os.environ.get("UCCL_TPU_BENCH_SEQ", "0"))
+        rounds = int(os.environ.get("UCCL_TPU_BENCH_ROUNDS", "9"))
+        iters = int(os.environ.get("UCCL_TPU_BENCH_ITERS", "5"))
     except ValueError as e:
-        sys.exit(f"[bench] bad UCCL_TPU_BENCH_BATCH/SEQ: {e}")
+        sys.exit(f"[bench] bad UCCL_TPU_BENCH_{{BATCH,SEQ,ROUNDS,ITERS}}: {e}")
     if batch_env < 0 or seq_env < 0:
         sys.exit("[bench] UCCL_TPU_BENCH_BATCH/SEQ must not be negative")
+    if rounds < 1 or iters < 1:
+        sys.exit("[bench] UCCL_TPU_BENCH_ROUNDS/ITERS must be >= 1")
 
-    healthy, platform, device_kind = _probe_device()
-    if not healthy:
+    if os.environ.get("UCCL_TPU_BENCH_FORCE_CPU", "0").lower() not in (
+        "", "0", "false", "no"
+    ):
+        jax.config.update("jax_platforms", "cpu")
+        healthy, platform, device_kind = False, "cpu", "cpu"
+    else:
+        healthy, platform, device_kind = _probe_device()
+    # A successful probe of a non-TPU backend (e.g. JAX_PLATFORMS=cpu in the
+    # caller's env) still means the full-size config is off the table.
+    on_chip = healthy and platform == "tpu"
+    if not on_chip:
         # CPU can't run the full-size model at benchmark cadence
         batch, seq, cfg_shrink = 2, 128, {
             "dim": 256, "n_layers": 2, "n_heads": 4, "n_kv_heads": 2,
@@ -251,37 +290,68 @@ def main():
     if attn_impl == "auto":
         # resolve before reporting so the JSON names the impl actually run
         attn_impl = "flash" if platform == "tpu" else "xla"
+    ours_kw = {"moe_impl": moe_impl, "remat": remat, **cfg_shrink}
     flash_failed = None
     try:
-        tps, dt, cfg = _measure(
-            {"attn_impl": attn_impl, "moe_impl": moe_impl, "remat": remat,
-             **cfg_shrink},
-            batch, seq, tokens, targets,
-        )
+        ours = _Harness({"attn_impl": attn_impl, **ours_kw}, tokens, targets)
+        ours.warmup()
     except Exception as e:
         if attn_impl != "flash":
             raise  # nothing to fall back to — surface the real failure
         flash_failed = repr(e)
+        ours = None
     if flash_failed is not None:
         # Retry outside the except block: a live exception pins the failed
         # run's params/opt_state via its traceback, and both builds must
         # never coexist in HBM.
         print(f"[bench] flash path failed ({flash_failed}); retrying with "
               "attn=xla", file=sys.stderr)
-        tps, dt, cfg = _measure(
-            {"attn_impl": "xla", "moe_impl": moe_impl, "remat": remat,
-             **cfg_shrink},
-            batch, seq, tokens, targets,
-        )
         attn_impl = "xla"
+        ours = _Harness({"attn_impl": "xla", **ours_kw}, tokens, targets)
+        ours.warmup()
 
     # Vendor baseline: stock XLA lowering of the same model — dense GShard
     # einsum dispatch, plain XLA attention. Same shapes, same optimizer.
-    base_tps, base_dt, _ = _measure(
-        {"attn_impl": "xla", "moe_impl": "dense", "remat": remat,
-         **cfg_shrink},
-        batch, seq, tokens, targets,
-    )
+    # Held resident alongside ours so samples interleave; if the pair does
+    # not fit in HBM, fall back to sequential sampling (medians still
+    # smooth noise, just without drift cancellation).
+    base_kw = {"attn_impl": "xla", "moe_impl": "dense", "remat": remat,
+               **cfg_shrink}
+    sequential, base = False, None
+    try:
+        base = _Harness(base_kw, tokens, targets)
+        base.warmup()
+        # The sampling itself is under the guard too: the first
+        # ours.sample() with base resident is a peak (ours' scratch + both
+        # states) never exercised before this point.
+        ours_dts, base_dts = _interleaved_dts(ours, base, rounds, iters)
+        cfg = ours.cfg
+    except Exception as e:
+        if "RESOURCE_EXHAUSTED" not in repr(e):
+            raise
+        print("[bench] ours+baseline do not fit together; sampling "
+              "sequentially", file=sys.stderr)
+        sequential = True
+
+    if sequential:
+        # Rebuild both from scratch, one at a time (outside the except
+        # block — a live traceback pins the dead buffers): an OOM mid-
+        # sample leaves the donated state consumed.
+        if base is not None:
+            base.free()
+        ours.free()
+        ours = _Harness({"attn_impl": attn_impl, **ours_kw}, tokens, targets)
+        ours.warmup()
+        ours_dts = [ours.sample(iters) for _ in range(rounds)]
+        cfg = ours.cfg
+        ours.free()
+        base = _Harness(base_kw, tokens, targets)
+        base.warmup()
+        base_dts = [base.sample(iters) for _ in range(rounds)]
+
+    dt, base_dt = _median(ours_dts), _median(base_dts)
+    tps, base_tps = batch * seq / dt, batch * seq / base_dt
+    spread = lambda xs: (max(xs) - min(xs)) / _median(xs)  # noqa: E731
 
     result = {
         "metric": "flagship_moe_train_tokens_per_sec",
@@ -290,6 +360,16 @@ def main():
         "vs_baseline": round(tps / base_tps, 3),
         "step_time_ms": round(dt * 1e3, 2),
         "baseline_tokens_per_sec": round(base_tps, 1),
+        # Medians of `rounds` interleaved A-B samples, `iters` steps each;
+        # rel_spread = (max-min)/median of the per-round step times. A
+        # headline whose spread is wide is noise, not evidence — the JSON
+        # now says so itself.
+        "rounds": rounds,
+        "iters_per_round": iters,
+        "rel_spread": round(spread(ours_dts), 3),
+        "baseline_rel_spread": round(spread(base_dts), 3),
+        "samples_ms": [round(d * 1e3, 1) for d in ours_dts],
+        "baseline_samples_ms": [round(d * 1e3, 1) for d in base_dts],
         "device": device_kind,
         "attn_impl": attn_impl,
         "moe_impl": moe_impl,
@@ -302,7 +382,7 @@ def main():
         result["mfu"] = round(
             _model_flops_per_token(cfg, seq) * tps / peak, 4
         )
-    if not healthy:
+    if not on_chip:
         # shrunk-config CPU numbers are not comparable to TPU runs
         result["cpu_fallback"] = True
     print(json.dumps(result))

@@ -317,13 +317,14 @@ def main():
     # smooth noise, just without drift cancellation).
     base_kw = {"attn_impl": "xla", "moe_impl": "dense", "remat": remat,
                **cfg_shrink}
-    sequential, base = False, None
+    sequential, base, ours_dirty = False, None, False
     try:
         base = _Harness(base_kw, tokens, targets)
         base.warmup()
         # The sampling itself is under the guard too: the first
         # ours.sample() with base resident is a peak (ours' scratch + both
         # states) never exercised before this point.
+        ours_dirty = True  # donated state consumed once sampling starts
         ours_dts, base_dts = _interleaved_dts(ours, base, rounds, iters)
         cfg = ours.cfg
     except Exception as e:
@@ -334,14 +335,18 @@ def main():
         sequential = True
 
     if sequential:
-        # Rebuild both from scratch, one at a time (outside the except
-        # block — a live traceback pins the dead buffers): an OOM mid-
-        # sample leaves the donated state consumed.
+        # One harness at a time, outside the except block (a live traceback
+        # pins the dead buffers). The usual OOM site is base's build/warmup
+        # — ours is then still warm and sampleable; only an OOM mid-sample
+        # (ours_dirty) consumed its donated state and forces a rebuild.
         if base is not None:
             base.free()
-        ours.free()
-        ours = _Harness({"attn_impl": attn_impl, **ours_kw}, tokens, targets)
-        ours.warmup()
+        if ours_dirty:
+            ours.free()
+            ours = _Harness(
+                {"attn_impl": attn_impl, **ours_kw}, tokens, targets
+            )
+            ours.warmup()
         ours_dts = [ours.sample(iters) for _ in range(rounds)]
         cfg = ours.cfg
         ours.free()

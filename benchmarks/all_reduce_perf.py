@@ -26,7 +26,7 @@ def main():
                     help="force N virtual CPU devices (0 = use real devices)")
     ap.add_argument(
         "--algo", default="both",
-        choices=["xla", "ring", "hd", "torus", "both", "all"]
+        choices=["xla", "ring", "hd", "torus", "pallas", "both", "all"]
     )
     ap.add_argument(
         "--mesh2d", default="", metavar="AxB",
@@ -56,7 +56,7 @@ def main():
     if args.algo == "both":
         algos = ["xla", "ring"]
     elif args.algo == "all":
-        algos = ["xla", "ring", "hd"] + (["torus"] if args.mesh2d else [])
+        algos = ["xla", "ring", "hd", "pallas"] + (["torus"] if args.mesh2d else [])
     else:
         algos = [args.algo]
 
@@ -73,6 +73,8 @@ def main():
                 # hd falls back to ring off power-of-two worlds; skip rather
                 # than record ring timings under the hd label
                 continue
+            if algo == "pallas" and args.mesh2d:
+                continue  # pallas rings a single mesh axis
             out = comm.all_reduce(x, algo=algo)  # compile + warmup
             np.asarray(out)
             t0 = time.perf_counter()

@@ -36,6 +36,9 @@ export UCCL_TPU_BENCH_PROBE_ATTEMPTS=1 UCCL_TPU_BENCH_PROBE_TIMEOUT=120
 say "1/9 bench.py"
 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
 
+say "1b/9 pallas remote-DMA ring collectives: single-chip Mosaic lowering proof"
+timeout 900 python scripts/pallas_ccl_proof.py 2>&1 | tee -a "$LOG"
+
 say "2/9 attention sweep (flash vs xla crossover)"
 timeout 2400 python benchmarks/attention_bench.py \
   --seqs 1024,2048,4096,8192 --iters 10 2>&1 | tee -a "$LOG"

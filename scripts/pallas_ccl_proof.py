@@ -1,0 +1,65 @@
+"""Single-chip compile proof for the Pallas remote-DMA ring collectives.
+
+An 8-way ring kernel cannot EXECUTE on one chip, but it can be LOWERED for
+the TPU backend through the full Pallas→Mosaic pipeline using an abstract
+8-device mesh — that exercises kernel tracing, VMEM layout/tiling, semaphore
+plumbing and the remote-copy lowering, i.e. everything short of the final
+Mosaic→LLO compile that needs the real topology. (On CPU backends pallas
+refuses non-interpret lowering, so this is a TPU-session artifact; run it
+from scripts/onchip_ladder.sh.)
+
+Prints one line per (collective, dtype) case; exits nonzero on any failure.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import AbstractMesh, PartitionSpec as P
+
+from uccl_tpu.collective import pallas_ccl
+
+
+def main():
+    if jax.default_backend() != "tpu":
+        sys.exit("pallas_ccl_proof: needs a TPU backend (tunnel session)")
+    mesh = AbstractMesh((8,), ("x",))
+    cases = [
+        ("all_reduce_bidi", lambda v: pallas_ccl.ring_all_reduce(
+            v, "x", interpret=False),
+         (8, 65536), P("x", None), P("x", None)),
+        ("all_reduce_uni", lambda v: pallas_ccl.ring_all_reduce(
+            v, "x", bidirectional=False, interpret=False),
+         (8, 65536), P("x", None), P("x", None)),
+        ("all_gather", lambda v: pallas_ccl.ring_all_gather(
+            v, "x", interpret=False),
+         (8, 8192), P("x", None), P("x", None)),
+        ("reduce_scatter", lambda v: pallas_ccl.ring_reduce_scatter(
+            v.reshape(-1), "x", interpret=False),
+         (8, 65536), P("x", None), P("x")),
+    ]
+    failed = 0
+    for dtype in (jnp.float32, jnp.bfloat16):
+        for name, fn, shape, in_spec, out_spec in cases:
+            mapped = shard_map(
+                fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+                check_vma=False,
+            )
+            x = jax.ShapeDtypeStruct(shape, dtype)
+            try:
+                txt = jax.jit(mapped).lower(x).as_text()
+                ok = "tpu_custom_call" in txt or "mosaic" in txt.lower()
+                print(f"pallas_ccl_proof {name} {jnp.dtype(dtype).name}: "
+                      f"{'LOWERED' if ok else 'no-custom-call?'} "
+                      f"({len(txt)} chars of StableHLO)")
+                failed += 0 if ok else 1
+            except Exception as e:  # noqa: BLE001 - report-and-continue proof
+                print(f"pallas_ccl_proof {name} {jnp.dtype(dtype).name}: "
+                      f"FAILED {e!r}")
+                failed += 1
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

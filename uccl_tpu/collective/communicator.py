@@ -108,6 +108,9 @@ class Communicator:
         (sum only; power-of-two worlds, ring fallback otherwise);
         ``algo="torus"`` runs the 2D axis-pair chunk-graph schedule (sum
         only; the communicator must span exactly two mesh axes);
+        ``algo="pallas"`` runs the same ring schedule as device-level
+        remote-DMA kernels (:mod:`uccl_tpu.collective.pallas_ccl`; sum only,
+        single-axis, VMEM-budget fallback to the plan lowering);
         ``algo="auto"`` asks :func:`~uccl_tpu.collective.plan.
         select_all_reduce_algo` (size/world/topology policy, env-overridable
         via UCCL_TPU_AR_ALGO).
@@ -124,12 +127,24 @@ class Communicator:
                 algo = select_all_reduce_algo(
                     per_rank * x.dtype.itemsize, self.world, len(self.axes)
                 )
-        if algo not in ("xla", "ring", "hd", "torus"):
+        if algo not in ("xla", "ring", "hd", "torus", "pallas"):
             raise ValueError(f"unknown all_reduce algo {algo!r}")
         key = ("ar", op, algo, x.shape, x.dtype)
 
         def build():
             def f(v):
+                if algo == "pallas":
+                    if op != ReduceOp.SUM:
+                        raise ValueError("pallas allreduce supports sum only")
+                    if len(self.axes) != 1:
+                        raise ValueError(
+                            "pallas allreduce rings a single mesh axis"
+                        )
+                    from uccl_tpu.collective.pallas_ccl import (
+                        ring_all_reduce as pallas_ar,
+                    )
+
+                    return pallas_ar(v, ax)
                 if algo in ("ring", "hd"):
                     if op != ReduceOp.SUM:
                         raise ValueError(f"{algo} allreduce supports sum only")

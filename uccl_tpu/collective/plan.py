@@ -539,7 +539,7 @@ _AR_FORCE_ALGO = _config.param(
     "",
     str,
     "override the all_reduce auto-selector with a fixed algorithm "
-    "(xla|ring|hd|torus)",
+    "(xla|ring|hd|torus|pallas)",
 )
 
 

@@ -40,12 +40,16 @@ extern "C" {
 
 typedef struct {
   char name[32];       // device name
-  char addr[64];       // the NIC address this device binds (dial target)
   int speed_mbps;      // advertised link speed
   int port;            // listen port of the underlying endpoint
   int max_comms;       // soft cap on simultaneous comms
   int max_recvs;       // irecv batch width (1 in v1)
   int reg_is_global;   // mr handles valid across comms on this device
+  // Fields below were added after the first ucclt_net_v1 export and are
+  // therefore APPENDED: a consumer compiled against the original v1 layout
+  // still reads every field above at its old offset. Any future layout
+  // change that cannot append must bump the exported vtable symbol.
+  char addr[64];       // the NIC address this device binds (dial target)
 } ucclt_net_props_t;
 
 typedef struct {

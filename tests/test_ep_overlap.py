@@ -1,0 +1,173 @@
+"""The overlap/async half of the DeepEP Buffer contract, TPU-dataflow form:
+EventOverlap (previous_event / async_finish), two-phase receive hooks
+(return_recv_hook), and Config tuning hints — checklist vs reference
+ep/bench/buffer.py:285-464 (LL verbs), :801-831 (normal verbs), :741
+(configs). The load-bearing assertion everywhere: overlapped execution is
+bit-identical to synchronous execution."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from uccl_tpu.ep import Buffer, Config, EventOverlap
+from uccl_tpu.ep import ll as ep_ll
+from uccl_tpu.parallel.mesh import AXIS, MeshConfig, make_mesh
+
+W, E, T, H = 4, 8, 16, 32
+
+
+@pytest.fixture(scope="module")
+def ep_mesh(devices):
+    return make_mesh(MeshConfig(dp=4, tp=2), devices)
+
+
+def _buffer(mesh, **kw):
+    kw.setdefault("num_experts", E)
+    kw.setdefault("capacity_factor", float(E))  # no drops
+    return Buffer(mesh, AXIS.EP, **kw)
+
+
+def _routing(rng, k=2):
+    x = rng.standard_normal((W, T, H)).astype(np.float32)
+    idx = rng.integers(0, E, (W, T, k)).astype(np.int32)
+    if k > 1:
+        idx[..., 1] = (idx[..., 0] + 1) % E
+    wts = np.full((W, T, k), 1.0 / k, np.float32)
+    return x, idx, wts
+
+
+class TestEventOverlap:
+    def test_async_finish_returns_event(self, ep_mesh, rng):
+        buf = _buffer(ep_mesh)
+        x, idx, wts = _routing(rng)
+        recv, handle, event = buf.dispatch(
+            buf.device_put(x), buf.device_put(idx), buf.device_put(wts),
+            async_finish=True,
+        )
+        assert isinstance(event, EventOverlap)
+        event.current_stream_wait()  # host barrier on the dispatch outputs
+        out, ev2 = buf.combine(recv, handle, async_finish=True)
+        ev2.wait()
+        np.testing.assert_allclose(np.asarray(out), x, rtol=1e-5, atol=1e-6)
+
+    def test_previous_event_chain_matches_sync(self, ep_mesh, rng):
+        """dispatch → combine(previous_event=ev) must be bit-identical to
+        the plain synchronous chain — the event only orders, never alters."""
+        buf = _buffer(ep_mesh)
+        x, idx, wts = _routing(rng)
+        gx, gidx, gwts = (
+            buf.device_put(x), buf.device_put(idx), buf.device_put(wts)
+        )
+        recv_s, handle_s = buf.dispatch(gx, gidx, gwts)
+        want = np.asarray(buf.combine(recv_s, handle_s))
+
+        recv, handle, event = buf.dispatch(gx, gidx, gwts, async_finish=True)
+        got = np.asarray(buf.combine(recv, handle, previous_event=event))
+        np.testing.assert_array_equal(got, want)
+
+    def test_allocate_on_comm_stream_precondition(self, ep_mesh, rng):
+        buf = _buffer(ep_mesh)
+        x, idx, wts = _routing(rng)
+        with pytest.raises(ValueError, match="allocate_on_comm_stream"):
+            buf.dispatch(
+                buf.device_put(x), buf.device_put(idx), buf.device_put(wts),
+                allocate_on_comm_stream=True,
+            )
+
+
+class TestRecvHook:
+    def test_overlapped_dispatch_gemm_identical(self, ep_mesh, rng):
+        """The verdict's acceptance test: LL dispatch issued with
+        return_recv_hook, an unrelated GEMM overlapped before the hook, then
+        grouped FFN + combine — outputs identical to the fully synchronous
+        path."""
+        buf = _buffer(ep_mesh)
+        x = (rng.standard_normal((W, T, 128)) * 2).astype(np.float32)
+        idx = rng.integers(0, E, (W, T, 1)).astype(np.int32)
+        wts = np.ones((W, T, 1), np.float32)
+        gx, gidx, gwts = (
+            buf.device_put(x), buf.device_put(idx), buf.device_put(wts)
+        )
+        kw = dict(wire="dense", wire_fp8=False)
+
+        # synchronous reference
+        recv_s, counts_s, handle_s = buf.low_latency_dispatch(
+            gx, gidx, None, gwts, **kw
+        )
+        want = np.asarray(buf.low_latency_combine(recv_s, handle_s))
+
+        # overlapped: issue dispatch, run an unrelated GEMM, then hook()
+        recv, counts, handle, event, hook = buf.low_latency_dispatch(
+            gx, gidx, None, gwts, async_finish=True, return_recv_hook=True,
+            **kw,
+        )
+        a = jax.numpy.asarray(rng.standard_normal((64, 64)), jax.numpy.float32)
+        overlap_result = (a @ a).block_until_ready()  # unrelated compute
+        assert hook is not None and event is not None
+        hook()  # arrival barrier
+        out, ev, hk = buf.low_latency_combine(
+            recv, handle, previous_event=event, async_finish=True,
+            return_recv_hook=True,
+        )
+        assert ev is not None and hk is not None
+        hk()
+        got = np.asarray(out)
+        np.testing.assert_array_equal(got, want)
+        assert overlap_result.shape == (64, 64)
+
+    def test_hook_only_returns_none_event(self, ep_mesh, rng):
+        buf = _buffer(ep_mesh)
+        x, idx, wts = _routing(rng, k=1)
+        r = buf.low_latency_dispatch(
+            buf.device_put(x), buf.device_put(idx), None,
+            buf.device_put(wts), wire="dense", return_recv_hook=True,
+        )
+        recv, counts, handle, event, hook = r
+        assert event is None and callable(hook)
+        hook()
+
+
+class TestConfig:
+    def test_tables_cover_reference_worlds(self):
+        for n in (2, 4, 8, 16, 24, 32, 64, 128):
+            d = Buffer.get_dispatch_config(n)
+            c = Buffer.get_combine_config(n)
+            assert isinstance(d, Config) and isinstance(c, Config)
+            assert c.wire_fp8 is False  # combine payloads stay bf16/f32
+
+    def test_config_applies_as_defaults(self, ep_mesh, rng):
+        buf = _buffer(ep_mesh)
+        x, idx, wts = _routing(rng, k=1)
+        gx, gidx, gwts = (
+            buf.device_put(x), buf.device_put(idx), buf.device_put(wts)
+        )
+        cfg = dataclasses.replace(
+            Buffer.get_dispatch_config(W), wire="dense", wire_fp8=False,
+            max_tokens_per_rank=T,
+        )
+        recv_c, counts_c, handle_c = buf.low_latency_dispatch(
+            gx, gidx, None, gwts, config=cfg
+        )
+        recv_e, counts_e, handle_e = buf.low_latency_dispatch(
+            gx, gidx, T, gwts, wire="dense", wire_fp8=False
+        )
+        np.testing.assert_array_equal(np.asarray(recv_c), np.asarray(recv_e))
+        np.testing.assert_array_equal(
+            np.asarray(counts_c), np.asarray(counts_e)
+        )
+
+
+class TestStatsNegativeIds:
+    def test_minus_one_not_counted(self, ep_mesh, rng):
+        """-1 'no expert' assignments claim no slot and must not inflate
+        routed_rows as expert-0 demand (round-4 advisor finding)."""
+        buf = _buffer(ep_mesh)
+        x, idx, wts = _routing(rng)
+        idx[:, :, 1] = -1  # half the assignments route nowhere
+        buf.dispatch(
+            buf.device_put(x), buf.device_put(idx), buf.device_put(wts)
+        )
+        s = buf.stats()
+        assert s["dispatch"]["routed_rows"] == W * T  # only the k=0 column

@@ -19,14 +19,16 @@ OK, ERR = 0, -1
 
 
 class Props(ctypes.Structure):
+    # Mirrors ucclt_net_props_t: post-v1 additions (addr) are APPENDED so a
+    # consumer built against the original v1 prefix still reads its fields.
     _fields_ = [
         ("name", ctypes.c_char * 32),
-        ("addr", ctypes.c_char * 64),
         ("speed_mbps", ctypes.c_int),
         ("port", ctypes.c_int),
         ("max_comms", ctypes.c_int),
         ("max_recvs", ctypes.c_int),
         ("reg_is_global", ctypes.c_int),
+        ("addr", ctypes.c_char * 64),
     ]
 
 

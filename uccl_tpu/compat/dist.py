@@ -131,14 +131,17 @@ def all_gather(out_list: Optional[List[np.ndarray]], x):
             "all_gather with a jax.Array input takes out_list=None and "
             "returns the gathered list"
         )
-    gathered = g.all_gather(host)
-    if is_jax:
-        return [_placed_like(gathered[i], x) for i in range(g.active_world)]
-    if out_list is None or len(out_list) != g.active_world:
+    if not is_jax and (out_list is None or len(out_list) != g.active_world):
+        # validate BEFORE participating: a caller error must fail fast, not
+        # after this rank already joined the collective (which would leave
+        # the group skewed for the other ranks)
         raise ValueError(
             f"out_list has {0 if out_list is None else len(out_list)} "
             f"entries; active world size is {g.active_world}"
         )
+    gathered = g.all_gather(host)
+    if is_jax:
+        return [_placed_like(gathered[i], x) for i in range(g.active_world)]
     for i in range(g.active_world):
         out_list[i][...] = gathered[i]
     return None

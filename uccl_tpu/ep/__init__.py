@@ -14,11 +14,14 @@ Surfaces:
 * :mod:`uccl_tpu.ep.ll`     — packed low-latency path: ragged wire + grouped
   GEMMs over receive counts (the DeepEP LL contract, internode_ll.cu analog).
 * :class:`uccl_tpu.ep.Buffer` — DeepEP-shaped host API (dispatch / combine /
-  low_latency_dispatch / low_latency_combine / get_dispatch_layout).
+  low_latency_dispatch / low_latency_combine / get_dispatch_layout), including
+  the overlap half of the contract: :class:`uccl_tpu.ep.EventOverlap`
+  dataflow events (previous_event / async_finish), two-phase receive hooks
+  (return_recv_hook), and :class:`uccl_tpu.ep.Config` tuning hints.
 """
 
 from uccl_tpu.ep import ll, ops
-from uccl_tpu.ep.buffer import Buffer, LowLatencyHandle
+from uccl_tpu.ep.buffer import Buffer, Config, EventOverlap, LowLatencyHandle
 from uccl_tpu.ep.cross_pod import CrossPodMoE
 from uccl_tpu.ep.elastic import ElasticBuffer, ElasticKVCache
 from uccl_tpu.ep.engram import EngramTable, mesh_fetch
@@ -27,6 +30,8 @@ __all__ = [
     "ops",
     "ll",
     "Buffer",
+    "Config",
+    "EventOverlap",
     "LowLatencyHandle",
     "CrossPodMoE",
     "ElasticBuffer",

@@ -15,7 +15,8 @@ precision (the reference's "normal" internode mode).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+import dataclasses
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +30,63 @@ from uccl_tpu.parallel.mesh import AXIS, get_mesh, mesh_axis_size
 from uccl_tpu.utils.logging import get_logger
 
 _log = get_logger("EP")
+
+
+class EventOverlap:
+    """The overlap half of the DeepEP contract, re-expressed in dataflow.
+
+    On GPU, DeepEP records a CUDA event after the comm kernels and consumers
+    either wait on it from the current stream or pass it as
+    ``previous_event`` to order a later kernel behind it
+    (``EventOverlap`` in the reference's ep/bench/utils.py, used throughout
+    ep/bench/buffer.py:285-464). On TPU there are no user-visible streams —
+    XLA's async dispatch makes every returned array a future, and ordering
+    is dataflow. This class therefore wraps the arrays a verb produced:
+
+    * ``current_stream_wait()`` — host-side barrier on those arrays (the
+      analog of ``event.current_stream_wait()``; jax arrays self-order for
+      device consumers, so this is only needed for host readbacks/timing).
+    * as ``previous_event`` — the next verb ties its computation to this
+      event's token array with ``lax.optimization_barrier``, so the later
+      jit cannot begin before the earlier verb's outputs exist (a REAL
+      cross-jit dependency, not a host sync; an unused jit arg would be
+      pruned, hence the explicit tie).
+    """
+
+    def __init__(self, arrays):
+        self._arrays = arrays
+
+    @property
+    def token(self) -> jax.Array:
+        """A representative array consumers tie ordering to (global form,
+        leading EP-rank dim)."""
+        return jax.tree.leaves(self._arrays)[0]
+
+    def current_stream_wait(self) -> None:
+        jax.block_until_ready(self._arrays)
+
+    wait = current_stream_wait
+
+
+def _tie(x, tok):
+    """Order ``x`` after ``tok`` inside a jit without consuming values."""
+    x, _ = lax.optimization_barrier((x, tok))
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Tuning hints — the TPU mapping of the reference ``Config`` row
+    ``(num_sms, send_tokens, recv_tokens, rdma_send_tokens, chunk)`` from
+    ep/bench/buffer.py:741-796. SM counts and NVL/RDMA chunk depths have no
+    TPU meaning; the knobs that do are the wire form, fp8 packing, and
+    recv-buffer sizing. A Config only fills knobs the caller left unset —
+    an explicit keyword always wins."""
+
+    max_tokens_per_rank: Optional[int] = None  # LL recv-buffer sizing
+    pair_capacity_factor: Optional[float] = None  # dense-wire pair capacity
+    wire: str = "auto"  # ragged | dense | auto
+    wire_fp8: bool = True
 
 
 class DispatchHandle(NamedTuple):
@@ -146,10 +204,11 @@ class Buffer:
             # drop rule applies shard-wise before summing
             routed = kept = 0
             for r in range(idx_np.shape[0]):
-                d = np.bincount(
-                    idx_np[r].reshape(-1).clip(min=0),
-                    minlength=self.num_experts,
-                )
+                flat = idx_np[r].reshape(-1)
+                # -1 = "no expert" (DeepEP-supported): claims no slot, so it
+                # must not be counted as expert-0 demand
+                flat = flat[flat >= 0]
+                d = np.bincount(flat, minlength=self.num_experts)
                 routed += int(d.sum())
                 kept += int(np.minimum(d, cap).sum())
             out["dispatch"] = {
@@ -169,6 +228,29 @@ class Buffer:
                 "wire_payload_bytes": rows * payload,
             }
         return out
+
+    @staticmethod
+    def get_dispatch_config(num_ranks: int) -> Config:
+        """Recommended dispatch config per EP world size (the role of
+        ep/bench/buffer.py:741 ``get_dispatch_config``). Small worlds ride
+        the ragged wire; larger worlds shrink the dense-wire pair capacity
+        so padded slots don't dominate the exchanged volume."""
+        if num_ranks <= 8:
+            return Config(wire="auto", wire_fp8=True)
+        if num_ranks <= 32:
+            return Config(wire="auto", wire_fp8=True,
+                          pair_capacity_factor=1.0)
+        return Config(wire="auto", wire_fp8=True, pair_capacity_factor=0.75)
+
+    @staticmethod
+    def get_combine_config(num_ranks: int) -> Config:
+        """Recommended combine config per EP world size (reference
+        get_combine_config, ep/bench/buffer.py:771), consumable by the
+        normal-mode :meth:`combine` ``config=`` parameter. Combine payloads
+        stay bf16/f32 (gate weights are applied at the destination, so fp8
+        error would be amplified by the reduction), hence wire_fp8=False."""
+        cfg = Buffer.get_dispatch_config(num_ranks)
+        return dataclasses.replace(cfg, wire_fp8=False)
 
     def capacity(self, num_tokens: int) -> int:
         return max(
@@ -223,18 +305,45 @@ class Buffer:
         topk_idx: jax.Array,
         topk_weights: Optional[jax.Array] = None,
         *,
-        wire_fp8: bool = False,
-    ) -> Tuple[jax.Array, DispatchHandle]:
+        wire_fp8: Optional[bool] = None,
+        config: Optional[Config] = None,
+        previous_event: Optional[EventOverlap] = None,
+        async_finish: bool = False,
+        allocate_on_comm_stream: bool = False,
+    ):
         """x: [W, T, H]; topk_idx: [W, T, K]; topk_weights: [W, T, K] (defaults
-        to uniform 1/K). Returns (recv_x [W, E_local, W*C, H], handle)."""
+        to uniform 1/K). Returns (recv_x [W, E_local, W*C, H], handle), plus
+        an :class:`EventOverlap` when ``async_finish`` is set.
+
+        Overlap knobs (reference dispatch, ep/bench/buffer.py:801-824):
+        ``config`` fills wire knobs the caller left unset (explicit keywords
+        win); ``previous_event`` orders this dispatch after another verb's
+        event by dataflow; ``async_finish`` returns an event to wait on /
+        chain from; ``allocate_on_comm_stream`` is stream-allocator
+        bookkeeping with no TPU meaning — accepted (with the reference's own
+        precondition) and otherwise a no-op, since XLA owns allocation."""
+        if wire_fp8 is None:
+            wire_fp8 = config.wire_fp8 if config is not None else False
+        if allocate_on_comm_stream and not (
+            previous_event is not None and async_finish
+        ):
+            raise ValueError(
+                "allocate_on_comm_stream requires previous_event and "
+                "async_finish (reference precondition, buffer.py:826)"
+            )
         w, t, h = x.shape
         k = topk_idx.shape[-1]
         cap = self.capacity(t)
         e = self.num_experts
-        key = ("dispatch", x.shape, topk_idx.shape, wire_fp8, x.dtype)
+        has_ev = previous_event is not None
+        tok = previous_event.token if has_ev else None
+        key = ("dispatch", x.shape, topk_idx.shape, wire_fp8, x.dtype,
+               has_ev and (tok.shape, tok.dtype))
 
-        def f(xv, idx):
+        def f(xv, idx, *tok_arg):
             xv, idx = xv[0], idx[0]
+            if tok_arg:
+                xv = _tie(xv, tok_arg[0])
             # sorted/ragged layout (the fast path): one argsort assigns
             # capacity slots; dispatch is a gather; drops match the dense
             # oracle exactly (ep/ops.py)
@@ -258,32 +367,64 @@ class Buffer:
 
         if topk_weights is None:
             topk_weights = jnp.full(topk_idx.shape, 1.0 / k, jnp.float32)
-        fn = self._jit(key, f, (2, 2), (3, 2, 2))
-        recv, slot, recv_counts = fn(x, topk_idx)
+        extra_in = (2, 2) + ((tok.ndim - 1,) if has_ev else ())
+        fn = self._jit(key, f, extra_in, (3, 2, 2))
+        args = (x, topk_idx) + ((tok,) if has_ev else ())
+        recv, slot, recv_counts = fn(*args)
         self._op_counts["dispatch"] += 1
         self._last_dispatch = (topk_idx, cap)
         # weights go straight into the handle (combine reshards them itself)
-        return recv, DispatchHandle(slot, topk_weights, recv_counts)
+        handle = DispatchHandle(slot, topk_weights, recv_counts)
+        if async_finish:
+            return recv, handle, EventOverlap((recv, slot, recv_counts))
+        return recv, handle
 
     def combine(
         self,
         expert_out: jax.Array,
         handle: DispatchHandle,
         *,
-        wire_fp8: bool = False,
-    ) -> jax.Array:
-        """expert_out: [W, E_local, W*C, H] → [W, T, H]."""
-        key = ("combine", expert_out.shape, handle.slot.shape, wire_fp8)
+        wire_fp8: Optional[bool] = None,
+        config: Optional[Config] = None,
+        previous_event: Optional[EventOverlap] = None,
+        async_finish: bool = False,
+        allocate_on_comm_stream: bool = False,
+    ):
+        """expert_out: [W, E_local, W*C, H] → [W, T, H] (plus an
+        :class:`EventOverlap` when ``async_finish``); overlap knobs as in
+        :meth:`dispatch` (``config``: see :meth:`get_combine_config`)."""
+        if wire_fp8 is None:
+            wire_fp8 = config.wire_fp8 if config is not None else False
+        if allocate_on_comm_stream and not (
+            previous_event is not None and async_finish
+        ):
+            raise ValueError(
+                "allocate_on_comm_stream requires previous_event and "
+                "async_finish (reference precondition, buffer.py:826)"
+            )
+        has_ev = previous_event is not None
+        tok = previous_event.token if has_ev else None
+        key = ("combine", expert_out.shape, handle.slot.shape, wire_fp8,
+               has_ev and (tok.shape, tok.dtype))
 
-        def f(y, slot, wts):
+        def f(y, slot, wts, *tok_arg):
+            if tok_arg:
+                y = _tie(y, tok_arg[0])
             out = ep_ops.combine_sorted(
                 y[0], slot[0], wts[0], self._axis_name(), wire_fp8=wire_fp8
             )
             return out[None]
 
-        fn = self._jit(key, f, (3, 2, 2), 2)
+        extra_in = (3, 2, 2) + ((tok.ndim - 1,) if has_ev else ())
+        fn = self._jit(key, f, extra_in, 2)
         self._op_counts["combine"] += 1
-        return fn(expert_out, handle.slot, handle.weights)
+        args = (expert_out, handle.slot, handle.weights) + (
+            (tok,) if has_ev else ()
+        )
+        out = fn(*args)
+        if async_finish:
+            return out, EventOverlap(out)
+        return out
 
     # -- low-latency mode: packed fp8 payloads + recv counts -------------
     def low_latency_dispatch(
@@ -295,7 +436,11 @@ class Buffer:
         *,
         pair_capacity_factor: Optional[float] = None,
         wire: str = "auto",
-        wire_fp8: bool = True,
+        wire_fp8: Optional[bool] = None,
+        config: Optional[Config] = None,
+        previous_event: Optional[EventOverlap] = None,
+        async_finish: bool = False,
+        return_recv_hook: bool = False,
     ):
         """The DeepEP low-latency contract (ep/bench/buffer.py:285-454):
         packed per-expert buffers sized by ``num_max_dispatch_tokens_per_rank``
@@ -308,20 +453,46 @@ class Buffer:
          recv_count [W, E_local],
          handle) — the consumer feeds (recv_x, recv_count) straight into
         grouped GEMMs (:func:`uccl_tpu.ep.ll.grouped_ffn`) so neither wire
-        nor MXU touches padding."""
+        nor MXU touches padding.
+
+        Overlap knobs (reference LL dispatch, ep/bench/buffer.py:285-346):
+        ``config`` supplies defaults for the wire/sizing knobs
+        (:class:`Config`, see get_dispatch_config); ``previous_event``
+        orders this verb after another's event; ``async_finish`` /
+        ``return_recv_hook`` switch the return to the reference's 5-tuple
+        ``(recv_x, recv_count, handle, event, hook)`` — the hook is the
+        two-phase receive: the dispatch is issued asynchronously and
+        ``hook()`` blocks until the receive buffers have landed (on GPU the
+        unhooked kernel skips the receive entirely; on TPU arrival is the
+        XLA program itself, so the hook is the explicit arrival barrier)."""
+        if config is not None:
+            if num_max_dispatch_tokens_per_rank is None:
+                num_max_dispatch_tokens_per_rank = config.max_tokens_per_rank
+            if pair_capacity_factor is None:
+                pair_capacity_factor = config.pair_capacity_factor
+            if wire == "auto":
+                wire = config.wire
+            if wire_fp8 is None:
+                wire_fp8 = config.wire_fp8  # only fills an unset knob
+        if wire_fp8 is None:
+            wire_fp8 = True  # the LL default (fp8 wire, internode_ll.cu)
         w, t, h = x.shape
         k = topk_idx.shape[-1]
         if wire == "auto":
             wire = "ragged" if ep_ll.wire_supports_ragged() else "dense"
         if topk_weights is None:
             topk_weights = jnp.full(topk_idx.shape, 1.0 / k, jnp.float32)
+        has_ev = previous_event is not None
+        tok = previous_event.token if has_ev else None
         key = (
             "ll_dispatch", x.shape, topk_idx.shape, x.dtype,
             num_max_dispatch_tokens_per_rank, pair_capacity_factor, wire,
-            wire_fp8,
+            wire_fp8, has_ev and (tok.shape, tok.dtype),
         )
 
-        def f(xv, idx, wts):
+        def f(xv, idx, wts, *tok_arg):
+            if tok_arg:
+                xv = _tie(xv, tok_arg[0])
             r = ep_ll.ll_dispatch(
                 xv[0], idx[0], wts[0], self.num_experts, self._axis_name(),
                 num_max_dispatch_tokens_per_rank=(
@@ -337,27 +508,50 @@ class Buffer:
                 s.regroup[None], s.src_in_offsets[None],
             )
 
-        fn = self._jit(key, f, (2, 2, 2), (2, 1, 2, 2, 2, 2, 1, 1))
+        extra_in = (2, 2, 2) + ((tok.ndim - 1,) if has_ev else ())
+        fn = self._jit(key, f, extra_in, (2, 1, 2, 2, 2, 2, 1, 1))
+        args = (x, topk_idx, topk_weights) + ((tok,) if has_ev else ())
         (recv_x, counts, send_slot, weights, send_mat, recv_mat, regroup,
-         src_in_offsets) = fn(x, topk_idx, topk_weights)
+         src_in_offsets) = fn(*args)
         handle = LowLatencyHandle(
             send_slot, weights, send_mat, recv_mat, regroup,
             src_in_offsets, wire, wire_fp8,
         )
         self._op_counts["low_latency_dispatch"] += 1
         self._last_ll = (counts, recv_x.shape[1], x.shape[-1], wire_fp8)
+        if async_finish or return_recv_hook:
+            event = EventOverlap((recv_x, counts)) if async_finish else None
+            hook: Optional[Callable[[], None]] = (
+                (lambda: jax.block_until_ready((recv_x, counts)))
+                if return_recv_hook else None
+            )
+            return recv_x, counts, handle, event, hook
         return recv_x, counts, handle
 
     def low_latency_combine(
-        self, expert_out: jax.Array, handle: LowLatencyHandle
-    ) -> jax.Array:
-        """expert_out: [W, R_max, H] group-major → [W, T, H]."""
+        self,
+        expert_out: jax.Array,
+        handle: LowLatencyHandle,
+        *,
+        previous_event: Optional[EventOverlap] = None,
+        async_finish: bool = False,
+        return_recv_hook: bool = False,
+    ):
+        """expert_out: [W, R_max, H] group-major → [W, T, H]; with
+        ``async_finish``/``return_recv_hook`` set, returns the reference's
+        ``(combined_x, event, hook)`` triple (ep/bench/buffer.py:454-530)."""
+        has_ev = previous_event is not None
+        tok = previous_event.token if has_ev else None
         key = (
             "ll_combine", expert_out.shape, handle.send_slot.shape,
             expert_out.dtype, handle.wire, handle.wire_fp8,
+            has_ev and (tok.shape, tok.dtype),
         )
 
-        def f(y, send_slot, wts, send_mat, recv_mat, regroup, src_off):
+        def f(y, send_slot, wts, send_mat, recv_mat, regroup, src_off,
+              *tok_arg):
+            if tok_arg:
+                y = _tie(y, tok_arg[0])
             state = ep_ll.LLState(
                 send_slot[0], wts[0], send_mat[0], recv_mat[0],
                 regroup[0], src_off[0], handle.wire,
@@ -367,9 +561,19 @@ class Buffer:
             )
             return out[None]
 
-        fn = self._jit(key, f, (2, 2, 2, 2, 2, 1, 1), 2)
+        extra_in = (2, 2, 2, 2, 2, 1, 1) + ((tok.ndim - 1,) if has_ev else ())
+        fn = self._jit(key, f, extra_in, 2)
         self._op_counts["low_latency_combine"] += 1
-        return fn(
+        args = (
             expert_out, handle.send_slot, handle.weights, handle.send_mat,
             handle.recv_mat, handle.regroup, handle.src_in_offsets,
-        )
+        ) + ((tok,) if has_ev else ())
+        out = fn(*args)
+        if async_finish or return_recv_hook:
+            event = EventOverlap(out) if async_finish else None
+            hook: Optional[Callable[[], None]] = (
+                (lambda: jax.block_until_ready(out))
+                if return_recv_hook else None
+            )
+            return out, event, hook
+        return out

@@ -25,6 +25,13 @@ from uccl_tpu.p2p.endpoint import FIFO_ITEM_BYTES, Endpoint
 from uccl_tpu.utils.config import param
 
 _chunk_kb = param("chunk_size_kb", 1024, help="multipath chunk size in KiB")
+_abandoned_cap = param(
+    "chan_abandoned_cap",
+    1024,
+    help="max abandoned (timed-out, non-terminal) transfer ids kept alive; "
+    "past this the oldest is force-reaped — only injected frame loss can "
+    "reach the cap, so the traded keepalive guarantee is test-only",
+)
 _chunk_retries = param(
     "chunk_retries",
     2,
@@ -505,10 +512,21 @@ class Channel:
         into the source buffer (queued or mid-send frame), so the memory
         must stay alive until a terminal state is observed. Every abandoned
         id terminates eventually in production — a late ack completes it, a
-        dead conn fails it — and the next _spray call prunes it. (Only
-        injected frame loss produces never-terminating ids; those keep
-        their keepalive for the endpoint's lifetime — a test-only cost.)"""
+        dead conn fails it — and the next _spray call prunes it. Only
+        injected frame loss (set_drop_rate) produces never-terminating ids;
+        so that long loss-soak tests don't grow memory unboundedly, the
+        list is capped: past the cap the OLDEST id is force-reaped, trading
+        the keepalive guarantee only in that already-test-only case."""
         self._abandoned.append(xid)
+        cap = _abandoned_cap.get()
+        if len(self._abandoned) > cap:
+            # Prune terminal ids first — the cap should only ever evict a
+            # genuinely still-in-flight id (the documented test-only trade),
+            # not force-reap a live one while reapable dead ids sit in the
+            # list.
+            self._prune_abandoned()
+            if len(self._abandoned) > cap:
+                self.ep.reap(self._abandoned.pop(0))
 
     def _prune_abandoned(self) -> None:
         still = []

@@ -245,6 +245,8 @@ def main(argv=None):
     n = len(jax.devices())
     world = args.dp or n
     # fail the cheap flag checks in milliseconds, BEFORE any restore work
+    if world > n:
+        raise SystemExit(f"--dp {world} exceeds the {n} available device(s)")
     if args.batch % world:
         raise SystemExit(f"--batch {args.batch} must divide by world {world}")
     max_seq = args.max_seq or (args.prompt_len + args.new_tokens)

@@ -137,11 +137,64 @@ def sweep_loss(emit, iters, size=4 << 20):
             })
 
 
+def sweep_loss_udp(emit, iters, size=4 << 20):
+    """Fig E: the same loss study over the UDP wire, where recovery is the
+    ENGINE's SACK/selective-repeat (RTO at millisecond scale) instead of the
+    channel's progress-timeout chunk retransmission (400 ms detection). This
+    is the configuration where loss handling is load-bearing: packets are
+    genuinely dropped before the socket and only retransmission delivers
+    the bytes."""
+    import os
+
+    os.environ["UCCL_TPU_WIRE"] = "udp"
+    try:
+        for drop in (0.0, 0.01, 0.05, 0.10, 0.20):
+            server = Endpoint(n_engines=1)
+            client = Endpoint(n_engines=1)
+            with server, client:
+                cid = client.connect("127.0.0.1", server.port)
+                server.accept(timeout_ms=5000)
+                dst = np.zeros(size, np.uint8)
+                fifo = server.advertise(server.reg(dst))
+                src = np.random.default_rng(0).integers(
+                    0, 255, size
+                ).astype(np.uint8)
+                # warmup (no loss)
+                client.wait(
+                    client.write_async(cid, src, fifo), timeout_ms=60000
+                )
+                base = client.conn_stats(cid)["pkts_rtx"]
+                client.set_drop_rate(drop)
+                try:
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        ok = client.wait(
+                            client.write_async(cid, src, fifo),
+                            timeout_ms=120000,
+                        )
+                        if not ok:
+                            raise RuntimeError(f"write lost at drop={drop}")
+                    dt = (time.perf_counter() - t0) / iters
+                finally:
+                    client.set_drop_rate(0.0)
+                retx = client.conn_stats(cid)["pkts_rtx"] - base
+                if not np.array_equal(dst, src):
+                    raise RuntimeError(f"corruption at drop={drop}")
+                emit({
+                    "fig": "E_loss_udp", "drop": drop, "size": size,
+                    "goodput_GB/s": round(size / dt / 1e9, 3),
+                    "lat_ms": round(dt * 1e3, 2),
+                    "retransmitted_pkts": int(retx),
+                })
+    finally:
+        del os.environ["UCCL_TPU_WIRE"]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=5)
-    ap.add_argument("--figs", default="A,B,C,D",
-                    help="comma list from A,B,C,D")
+    ap.add_argument("--figs", default="A,B,C,D,E",
+                    help="comma list from A,B,C,D,E (E = UDP-wire loss study)")
     ap.add_argument("--markdown", action="store_true",
                     help="append results table to docs/ARTIFACT_SWEEP.md")
     args = ap.parse_args()
@@ -161,6 +214,8 @@ def main():
         sweep_engines(emit, args.iters)
     if "D" in figs:
         sweep_loss(emit, args.iters)
+    if "E" in figs:
+        sweep_loss_udp(emit, args.iters)
 
     if args.markdown and rows:
         path = os.path.join(os.path.dirname(os.path.dirname(

@@ -83,6 +83,9 @@ enum class Op : uint16_t {
   kNotif = 6,      // out-of-band notification (NIXL notify pattern: a small
                    // tagged message the target drains non-blocking across
                    // ALL conns — reference p2p/uccl_engine.h:20-26,218-226)
+  kHello = 7,      // UDP wire handshake: h.offset carries the sender's UDP
+                   // data port; always rides TCP (the only frame that does
+                   // in UDP wire mode)
 };
 
 struct FrameHeader {
@@ -215,6 +218,23 @@ class Endpoint {
   void set_rate_limit(uint64_t bytes_per_sec) { rate_bps_ = bytes_per_sec; }
   uint64_t rate_limit() const { return rate_bps_.load(); }
 
+  // --- per-conn CC control plane (UDP wire mode; reference: the CC
+  // algorithms actuate chunk injection rates per flow,
+  // collective/rdma/transport.h:449-533 EventOn* hooks). rate==0 falls back
+  // to the endpoint-global token bucket.
+  struct ConnStats {
+    double rtt_us = 0.0;       // EWMA of ack-sampled RTT
+    uint64_t pkts_tx = 0;      // first transmissions
+    uint64_t pkts_rtx = 0;     // retransmissions (RTO + SACK-triggered)
+    uint64_t pkts_rx = 0;      // data packets received
+    uint64_t acks_rx = 0;      // ack packets processed
+    uint64_t bytes_unacked = 0;
+    uint64_t rate_bps = 0;     // current per-conn pacing rate (0 = global)
+    bool udp_active = false;
+  };
+  bool conn_stats(uint64_t conn_id, ConnStats* out);
+  bool set_conn_rate(uint64_t conn_id, uint64_t bytes_per_sec);
+
   // --- stats
   uint64_t bytes_tx() const { return bytes_tx_.load(); }
   uint64_t bytes_rx() const { return bytes_rx_.load(); }
@@ -243,6 +263,67 @@ class Endpoint {
     size_t total() const { return sizeof(FrameHeader) + wire_len; }
   };
 
+  // Frame-parser state for ONE ordered byte stream (io thread only): a peer
+  // stalling mid-frame just leaves the state parked; the loop never blocks
+  // on one conn. TCP conns have one stream; UDP wire mode has a second
+  // (the reliability layer delivers an in-order byte stream, and this same
+  // parser consumes it — frame semantics are wire-independent).
+  struct RxParse {
+    enum class Stage : uint8_t { kHdr, kBody };
+    Stage stage = Stage::kHdr;
+    size_t got = 0;                // bytes of current stage received
+    FrameHeader hdr{};
+    uint8_t* dst = nullptr;        // zero-copy window target (kWrite)
+    uint64_t t0_ns = 0;            // first header byte: rx latency sample
+    std::shared_ptr<std::atomic<int>> pin;  // held while dst in flight
+    std::vector<uint8_t> buf;      // owned body (non-window ops / sink)
+    bool ok = false;               // window resolved for current kWrite
+  };
+
+  // UDP wire state (one per conn in UDP wire mode): selective-repeat
+  // reliability over an unreliable datagram socket — the layer where the
+  // repo's SACK tracking and CC pacing actually deliver the bytes
+  // (reference: pcb.h snd_una/snd_nxt/rcv_nxt + kSackBitmapSize=128 SACK
+  // bitmaps, collective/rdma/pcb.h:20).
+  struct UdpState {
+    int ufd = -1;
+    std::atomic<bool> active{false};  // hello exchanged, epoll-registered
+
+    // --- sender side (mtx guards everything below it; taken by the tx
+    // thread (serialize/packetize/retransmit) and the io thread (acks))
+    std::mutex mtx;
+    std::vector<uint8_t> ring;     // tx byte ring (power of two)
+    uint64_t stream_end = 0;       // bytes serialized into the ring (abs)
+    uint64_t sent_end = 0;         // bytes packetized at least once (abs)
+    uint64_t una_stream = 0;       // ring tail: bytes cumulatively acked
+    struct Seg {                   // one packet in flight
+      uint64_t seq = 0;            // packet sequence number
+      uint64_t off = 0;            // absolute stream offset
+      uint32_t len = 0;
+      uint64_t t_tx_ns = 0;        // last (re)transmission time
+      uint32_t rtx = 0;            // retransmission count
+      bool sacked = false;         // covered by a SACK bit
+    };
+    std::deque<Seg> inflight;      // seq-ascending
+    uint64_t next_seq = 0;
+    double srtt_us = 0.0;          // RTT EWMA (7/8)
+    // pacing token bucket (per-conn CC actuation point)
+    double tokens = 0.0;
+    uint64_t t_refill_ns = 0;
+
+    // --- receiver side (io thread only)
+    uint64_t rcv_nxt_seq = 0;      // next expected packet seq
+    std::map<uint64_t, std::vector<uint8_t>> ooo;  // out-of-order packets
+
+    // --- stats (atomics: read by conn_stats from app threads)
+    std::atomic<uint64_t> pkts_tx{0}, pkts_rtx{0}, pkts_rx{0}, acks_rx{0};
+    std::atomic<uint64_t> rtt_ewma_us{0};
+
+    ~UdpState() {
+      if (ufd >= 0) ::close(ufd);
+    }
+  };
+
   struct Conn {
     int fd = -1;
     uint64_t id = 0;
@@ -253,17 +334,10 @@ class Endpoint {
     // (getpeername ENOTCONN) can no longer desynchronize the two sides.
     uint32_t wire_slot = 0;
 
-    // --- rx state machine (io thread only): a peer stalling mid-frame just
-    // leaves the state parked; the epoll loop never blocks on one conn.
-    enum class RxStage : uint8_t { kHdr, kBody };
-    RxStage rx_stage = RxStage::kHdr;
-    size_t rx_got = 0;             // bytes of current stage received
-    FrameHeader rx_hdr{};
-    uint8_t* rx_dst = nullptr;     // zero-copy window target (kWrite)
-    uint64_t rx_t0_ns = 0;         // first header byte: rx latency sample
-    std::shared_ptr<std::atomic<int>> rx_pin;  // held while rx_dst in flight
-    std::vector<uint8_t> rx_buf;   // owned body (non-window ops / sink)
-    bool rx_ok = false;            // window resolved for current kWrite
+    RxParse rx_tcp;                // TCP stream parser (io thread only)
+    RxParse rx_udp;                // UDP-delivered stream parser (io thread)
+    std::unique_ptr<UdpState> udp; // present only in UDP wire mode
+    std::atomic<uint64_t> rate_bps{0};  // per-conn pacing (0 = global)
 
     // --- tx queue (tx thread drains; any thread appends)
     std::mutex txq_mtx;
@@ -277,7 +351,8 @@ class Endpoint {
     ~Conn() {
       // Safety net: if the conn dies while a zero-copy receive is parked
       // mid-frame, release the registration pin so dereg() can't hang.
-      if (rx_pin) rx_pin->fetch_sub(1, std::memory_order_acq_rel);
+      if (rx_tcp.pin) rx_tcp.pin->fetch_sub(1, std::memory_order_acq_rel);
+      if (rx_udp.pin) rx_udp.pin->fetch_sub(1, std::memory_order_acq_rel);
       if (fd >= 0) ::close(fd);
     }
   };
@@ -360,7 +435,27 @@ class Endpoint {
   // with bytes possibly still buffered; kDead = conn died.
   enum class RxResult { kDead, kDrained, kBudget };
   RxResult drain_rx(Conn* c);
-  void finish_rx_frame(Conn* c);
+  void finish_rx_frame(Conn* c, RxParse& rx);
+  // Resolve a just-completed frame header on `rx` (window lookup for
+  // kWrite); false = protocol violation, kill the conn. Shared by the TCP
+  // socket parser and the UDP stream parser.
+  bool on_rx_header(Conn* c, RxParse& rx);
+
+  // --- UDP wire mode (selective repeat + SACK over datagrams) -----------
+  // io thread: drain datagrams (data + acks) from the conn's UDP socket.
+  RxResult drain_udp(Conn* c);
+  // io thread: feed in-order stream bytes through the rx_udp frame parser.
+  bool consume_udp_bytes(Conn* c, const uint8_t* p, size_t n);
+  // tx thread: serialize queued frames into the ring, packetize within
+  // cwnd/pacing, retransmit due segments. false = conn must die.
+  bool service_udp_tx(Conn* c);
+  void udp_send_ack(Conn* c, uint64_t echo_ts_us);
+  // send one segment (first tx or retx); mtx must be held by the caller.
+  void udp_send_seg_locked(Conn* c, UdpState& u, UdpState::Seg& s);
+  void udp_activate(Conn* c, uint16_t peer_port);  // io thread (kHello)
+  void send_hello(const std::shared_ptr<Conn>& c);
+  bool wait_udp_active(uint64_t conn_id, int timeout_ms);
+  bool udp_mode_ = false;
   // append a frame to the conn's tx queue (applies drop injection) and wake
   // the serving engine's tx thread.
   void enqueue_frame(const std::shared_ptr<Conn>& c, const FrameHeader& h,

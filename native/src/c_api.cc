@@ -196,4 +196,49 @@ int64_t ucclt_stats_json(void* ep, char* out, size_t cap) {
       static_cast<Endpoint*>(ep)->stats_json(out, cap));
 }
 
+// Per-conn transport stats for the CC control plane (UDP wire mode): the
+// Python Timely/Swift controllers read RTT/loss from here and actuate
+// ucclt_set_conn_rate — the role of the reference's per-flow EventOnRxACK
+// CC updates (collective/rdma/transport.h:449-533). POD mirror of
+// Endpoint::ConnStats; append-only layout.
+typedef struct {
+  double rtt_us;
+  uint64_t pkts_tx;
+  uint64_t pkts_rtx;
+  uint64_t pkts_rx;
+  uint64_t acks_rx;
+  uint64_t bytes_unacked;
+  uint64_t rate_bps;
+  int32_t udp_active;
+  int32_t pad;
+} ucclt_conn_stats_t;
+
+int ucclt_conn_stats(void* ep, uint64_t conn_id, ucclt_conn_stats_t* out) {
+  Endpoint::ConnStats s;
+  if (!static_cast<Endpoint*>(ep)->conn_stats(conn_id, &s)) return -1;
+  out->rtt_us = s.rtt_us;
+  out->pkts_tx = s.pkts_tx;
+  out->pkts_rtx = s.pkts_rtx;
+  out->pkts_rx = s.pkts_rx;
+  out->acks_rx = s.acks_rx;
+  out->bytes_unacked = s.bytes_unacked;
+  out->rate_bps = s.rate_bps;
+  out->udp_active = s.udp_active ? 1 : 0;
+  out->pad = 0;
+  return 0;
+}
+
+// Block until every queued frame on the conn reached the kernel socket —
+// and, on the UDP wire, until every serialized byte was ACKED (see
+// Endpoint::flush_conn). 0 = drained; -1 = timeout or dead conn.
+int ucclt_flush_conn(void* ep, uint64_t conn_id, int timeout_ms) {
+  return static_cast<Endpoint*>(ep)->flush_conn(conn_id, timeout_ms) ? 0 : -1;
+}
+
+int ucclt_set_conn_rate(void* ep, uint64_t conn_id, uint64_t bytes_per_sec) {
+  return static_cast<Endpoint*>(ep)->set_conn_rate(conn_id, bytes_per_sec)
+             ? 0
+             : -1;
+}
+
 }  // extern "C"

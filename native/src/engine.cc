@@ -82,6 +82,63 @@ extern "C" void AnnotateIgnoreReadsEnd(const char* f, int l);
 #endif
 
 constexpr uint32_t kMagic = 0x7C71u;
+
+// --- UDP wire mode ---------------------------------------------------------
+// Packet header for the unreliable-datagram data path. Reliability is
+// packet-seq selective repeat with 128-bit SACK bitmaps (the reference's PCB
+// shape: snd_una/snd_nxt/rcv_nxt + kSackBitmapSize=128,
+// collective/rdma/pcb.h:20). Data packets carry consecutive bytes of the
+// conn's frame stream; the receiver releases them IN SEQ ORDER into the same
+// frame parser the TCP path uses, so frame semantics are wire-independent.
+constexpr uint32_t kUdpMagic = 0x7C72u;
+struct UdpPktHdr {
+  uint32_t magic;
+  uint8_t kind;  // 0 = data, 1 = ack
+  uint8_t pad[3];
+  uint64_t seq;     // data: packet seq | ack: cumulative (next expected seq)
+  uint64_t ts_us;   // data: tx timestamp | ack: echo of the trigger packet
+  uint64_t sack0;   // ack: bit i => packet (cum+1+i) received (i in 0..63)
+  uint64_t sack1;   // ack: bits 64..127
+  uint32_t len;     // data payload bytes
+  uint32_t zero;
+};
+static_assert(sizeof(UdpPktHdr) == 48, "UdpPktHdr layout");
+
+int env_int(const char* name, int dflt) {
+  const char* v = std::getenv(name);
+  return (v != nullptr && v[0] != '\0') ? std::atoi(v) : dflt;
+}
+
+// Tunables (reference transport_config.h idiom: env-overridable knobs).
+size_t udp_pkt_bytes() {
+  static const size_t v = static_cast<size_t>(
+      std::max(512, env_int("UCCL_TPU_UDP_PKT_BYTES", 8192)));
+  return v;
+}
+size_t udp_ring_bytes() {
+  static const size_t v = [] {
+    size_t want = static_cast<size_t>(
+        std::max(1 << 16, env_int("UCCL_TPU_UDP_RING_BYTES", 4 << 20)));
+    size_t p = 1;
+    while (p < want) p <<= 1;
+    return p;
+  }();
+  return v;
+}
+size_t udp_cwnd_pkts() {
+  static const size_t v = static_cast<size_t>(
+      std::max(4, env_int("UCCL_TPU_UDP_CWND", 256)));
+  return v;
+}
+uint64_t udp_rto_min_us() {
+  static const uint64_t v = static_cast<uint64_t>(
+      std::max(200, env_int("UCCL_TPU_UDP_RTO_US", 2000)));
+  return v;
+}
+// consecutive retransmissions of one segment before the conn is declared
+// dead (reference kRTOAbortThreshold=50, transport_config.h:202)
+constexpr uint32_t kUdpRtxAbort = 50;
+constexpr size_t kUdpMaxOoo = 4096;  // out-of-order packets held per conn
 // Upper bound on a single frame payload — rejects absurd lengths from a buggy
 // or malicious peer before any allocation happens.
 constexpr uint64_t kMaxFrameLen = 1ull << 30;
@@ -124,6 +181,15 @@ uint64_t random_token() {
   return static_cast<uint32_t>((h >> 13) & 255);
 }
 
+// Fault-injection coin flip (one definition: frame-level and packet-level
+// injection must never diverge silently).
+bool should_drop(double p) {
+  if (p <= 0.0) return false;
+  static thread_local std::mt19937_64 gen{std::random_device{}()};
+  std::uniform_real_distribution<double> d(0.0, 1.0);
+  return d(gen) < p;
+}
+
 uint64_t now_ns() {
   return static_cast<uint64_t>(
       std::chrono::duration_cast<std::chrono::nanoseconds>(
@@ -133,6 +199,11 @@ uint64_t now_ns() {
 }  // namespace
 
 Endpoint::Endpoint(uint16_t port, int n_engines, const char* listen_ip) {
+  // Wire selection (both ends must agree; see kHello): "udp" runs the
+  // selective-repeat datagram path where the repo's SACK/CC machinery is
+  // load-bearing; default stays framed TCP.
+  const char* wire = std::getenv("UCCL_TPU_WIRE");
+  udp_mode_ = wire != nullptr && std::strcmp(wire, "udp") == 0;
   listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -190,6 +261,10 @@ Endpoint::~Endpoint() {
     std::lock_guard<std::mutex> lk(conns_mtx_);
     for (auto& kv : conns_) {
       total += kv.second->txq_bytes.load(std::memory_order_relaxed);
+      if (kv.second->udp) {  // UDP: serialized-but-unacked counts as queued
+        std::lock_guard<std::mutex> ulk(kv.second->udp->mtx);
+        total += kv.second->udp->stream_end - kv.second->udp->una_stream;
+      }
     }
     return total;
   };
@@ -264,7 +339,49 @@ int64_t Endpoint::connect(const std::string& ip, uint16_t port,
   c->id = next_conn_.fetch_add(1);
   uint64_t id = c->id;
   register_conn(c);
+  if (udp_mode_) {
+    send_hello(c);
+    // The conn is usable only once the datagram path is live on BOTH
+    // ends — every post-handshake frame then rides one ordered UDP
+    // stream, so TCP/UDP frames can never interleave out of order.
+    if (!wait_udp_active(id, env_int("UCCL_TPU_UDP_HELLO_MS", 5000))) {
+      remove_conn(id);
+      return -1;
+    }
+  }
   return static_cast<int64_t>(id);
+}
+
+// Enqueue the UDP handshake frame (always rides TCP): h.offset carries our
+// data port so the peer can aim its datagrams.
+void Endpoint::send_hello(const std::shared_ptr<Conn>& c) {
+  uint16_t uport = 0;
+  if (c->udp && c->udp->ufd >= 0) {
+    sockaddr_in a{};
+    socklen_t al = sizeof(a);
+    if (::getsockname(c->udp->ufd, reinterpret_cast<sockaddr*>(&a), &al) == 0)
+      uport = ntohs(a.sin_port);
+  }
+  FrameHeader h{};
+  h.magic = kMagic;
+  h.op = static_cast<uint16_t>(Op::kHello);
+  h.offset = uport;
+  h.len = 0;
+  enqueue_frame(c, h, nullptr, {}, 0);
+}
+
+bool Endpoint::wait_udp_active(uint64_t conn_id, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    auto c = get_conn(conn_id);
+    if (!c || c->dead.load(std::memory_order_relaxed)) return false;
+    if (c->udp && c->udp->active.load(std::memory_order_acquire)) return true;
+    if (stop_.load() || std::chrono::steady_clock::now() > deadline) {
+      return false;
+    }
+    std::this_thread::sleep_for(std::chrono::microseconds(200));
+  }
 }
 
 void Endpoint::register_conn(const std::shared_ptr<Conn>& c) {
@@ -275,6 +392,26 @@ void Endpoint::register_conn(const std::shared_ptr<Conn>& c) {
   c->wire_slot = wire_slot_for_fd(c->fd);
 #endif
   set_nonblocking(c->fd);  // rx state machine + queued tx never block
+  if (udp_mode_) {
+    auto u = std::make_unique<UdpState>();
+    u->ufd = ::socket(AF_INET, SOCK_DGRAM, 0);
+    if (u->ufd >= 0) {
+      // Bind to the SAME local address family/interface as the TCP conn so
+      // multi-NIC path striping keeps working; ephemeral port.
+      sockaddr_in self{};
+      socklen_t sl = sizeof(self);
+      ::getsockname(c->fd, reinterpret_cast<sockaddr*>(&self), &sl);
+      self.sin_port = 0;
+      ::bind(u->ufd, reinterpret_cast<sockaddr*>(&self), sizeof(self));
+      set_nonblocking(u->ufd);
+      int buf = 4 << 20;
+      ::setsockopt(u->ufd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+      ::setsockopt(u->ufd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+      u->ring.resize(udp_ring_bytes());
+      u->t_refill_ns = now_ns();
+    }
+    c->udp = std::move(u);
+  }
   {
     std::lock_guard<std::mutex> lk(conns_mtx_);
     conns_[c->id] = c;
@@ -298,6 +435,17 @@ int64_t Endpoint::accept(int timeout_ms) {
   while (!accept_queue_.pop(&id)) {
     if (stop_.load() || std::chrono::steady_clock::now() > deadline) return -1;
     std::this_thread::sleep_for(std::chrono::microseconds(100));
+  }
+  if (udp_mode_) {
+    auto remain = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - std::chrono::steady_clock::now());
+    // the caller's budget covers the WHOLE accept, handshake included — a
+    // short-timeout accept() poll loop must not be held 1s past its ask
+    int ms = std::max<int>(1, static_cast<int>(remain.count()));
+    if (!wait_udp_active(id, ms)) {
+      remove_conn(id);
+      return -1;
+    }
   }
   return static_cast<int64_t>(id);
 }
@@ -335,6 +483,10 @@ bool Endpoint::remove_conn(uint64_t conn_id) {
   // pass — the engine's strong conn list keeps the object alive until then.
   c->dead.store(true, std::memory_order_relaxed);
   ::epoll_ctl(engines_[c->engine]->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+  if (c->udp && c->udp->ufd >= 0) {
+    ::epoll_ctl(engines_[c->engine]->epoll_fd, EPOLL_CTL_DEL, c->udp->ufd,
+                nullptr);
+  }
   // Unblock any thread mid-send/recv on this fd; the fd itself closes when
   // the last shared_ptr holder drops (Conn::~Conn), never under a user.
   ::shutdown(c->fd, SHUT_RDWR);
@@ -345,6 +497,24 @@ bool Endpoint::flush_conn(uint64_t conn_id, int timeout_ms) {
   auto c = get_conn(conn_id);
   if (!c) return false;
   if (!wait_txq_below(c.get(), 0, timeout_ms)) return false;
+  if (c->udp && c->udp->active.load(std::memory_order_acquire)) {
+    // UDP "handed to the kernel" is not enough — flush means every
+    // serialized byte was ACKED (the reliability layer's definition of
+    // delivered; until then retransmission may still need the ring).
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (true) {
+      {
+        std::lock_guard<std::mutex> lk(c->udp->mtx);
+        if (c->udp->una_stream == c->udp->stream_end) break;
+      }
+      if (c->dead.load() || stop_.load() ||
+          std::chrono::steady_clock::now() > deadline) {
+        return false;
+      }
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+    }
+  }
   return !c->dead.load();
 }
 
@@ -672,11 +842,12 @@ void Endpoint::enqueue_frame(const std::shared_ptr<Conn>& c,
                              std::vector<uint8_t> owned, uint64_t fail_xfer) {
   // Fault injection: silently drop the frame (reference kTestLoss,
   // transport_config.h:222) — the transfer then times out at the caller.
-  double p = drop_rate_.load();
-  if (p > 0.0) {
-    static thread_local std::mt19937_64 gen{std::random_device{}()};
-    std::uniform_real_distribution<double> d(0.0, 1.0);
-    if (d(gen) < p) return;
+  // In UDP wire mode injection moves down to the PACKET level (real loss on
+  // an unreliable wire, recovered by the reliability layer, not timeouts);
+  // kHello must never be dropped (it carries the handshake over TCP).
+  if (!udp_mode_ && static_cast<Op>(h.op) != Op::kHello &&
+      should_drop(drop_rate_.load())) {
+    return;
   }
   TxItem it;
   it.h = h;
@@ -695,7 +866,312 @@ void Endpoint::enqueue_frame(const std::shared_ptr<Conn>& c,
   engines_[c->engine]->cv.notify_one();
 }
 
+// --- UDP wire mode: selective repeat + SACK over datagrams -----------------
+
+namespace {
+// ring helpers: absolute stream offsets, power-of-two capacity
+inline void ring_copy_in(std::vector<uint8_t>& ring, uint64_t at,
+                         const uint8_t* src, size_t n) {
+  size_t mask = ring.size() - 1;
+  size_t pos = static_cast<size_t>(at) & mask;
+  size_t first = std::min(n, ring.size() - pos);
+  std::memcpy(ring.data() + pos, src, first);
+  if (n > first) std::memcpy(ring.data(), src + first, n - first);
+}
+}  // namespace
+
+// Send one segment (first transmission or retransmission) as a single
+// datagram, scattering straight from the ring (no copy). u.mtx held.
+// Packet-level drop injection lives here: in UDP mode a "dropped" frame is
+// a lost packet the reliability layer must recover, not a caller timeout.
+void Endpoint::udp_send_seg_locked(Conn* c, UdpState& u, UdpState::Seg& s) {
+  (void)c;  // kept for symmetry with the other per-conn send paths
+  if (should_drop(drop_rate_.load())) return;  // lost; RTO/SACK recovers
+  UdpPktHdr h{};
+  h.magic = kUdpMagic;
+  h.kind = 0;
+  h.seq = s.seq;
+  h.ts_us = now_ns() / 1000;
+  h.len = s.len;
+  size_t mask = u.ring.size() - 1;
+  size_t pos = static_cast<size_t>(s.off) & mask;
+  size_t first = std::min<size_t>(s.len, u.ring.size() - pos);
+  iovec iov[3];
+  iov[0] = {&h, sizeof(h)};
+  iov[1] = {u.ring.data() + pos, first};
+  int niov = 2;
+  if (first < s.len) {
+    iov[2] = {u.ring.data(), s.len - first};
+    niov = 3;
+  }
+  msghdr m{};
+  m.msg_iov = iov;
+  m.msg_iovlen = niov;
+  // EAGAIN/any error == packet lost; the reliability layer recovers.
+  ::sendmsg(u.ufd, &m, MSG_DONTWAIT | MSG_NOSIGNAL);
+}
+
+// Cumulative + SACK-bitmap acknowledgement (io thread). Receiver-side state
+// only; robust to ack loss because every later ack supersedes.
+void Endpoint::udp_send_ack(Conn* c, uint64_t echo_ts_us) {
+  UdpState& u = *c->udp;
+  UdpPktHdr a{};
+  a.magic = kUdpMagic;
+  a.kind = 1;
+  a.seq = u.rcv_nxt_seq;
+  a.ts_us = echo_ts_us;
+  for (auto& kv : u.ooo) {
+    uint64_t rel = kv.first - u.rcv_nxt_seq;
+    if (rel >= 1 && rel <= 64) {
+      a.sack0 |= 1ull << (rel - 1);
+    } else if (rel >= 65 && rel <= 128) {
+      a.sack1 |= 1ull << (rel - 65);
+    } else if (rel > 128) {
+      break;  // ordered map: nothing later fits the bitmap
+    }
+  }
+  ::send(u.ufd, &a, sizeof(a), MSG_DONTWAIT | MSG_NOSIGNAL);
+}
+
+// io thread: drain datagrams — data packets feed the in-order stream parser
+// (out-of-order ones wait in a bounded map), ack packets drive the sender's
+// selective repeat (cumulative advance, SACK marks, RTT samples, dup-ack
+// fast retransmit).
+Endpoint::RxResult Endpoint::drain_udp(Conn* c) {
+  UdpState& u = *c->udp;
+  // Sized for the UDP maximum, NOT the local UCCL_TPU_UDP_PKT_BYTES knob:
+  // a peer configured with a bigger packet size must not have its datagrams
+  // truncated (and then silently discarded) by our recv buffer.
+  static thread_local std::vector<uint8_t> buf;
+  buf.resize(64 << 10);
+  for (int budget = 0; budget < 1024; ++budget) {
+    ssize_t n = ::recv(u.ufd, buf.data(), buf.size(), 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      // ECONNREFUSED etc. from ICMP on a connected UDP socket are
+      // transient (peer socket not up yet); liveness is the TCP fd's job.
+      return RxResult::kDrained;
+    }
+    if (static_cast<size_t>(n) < sizeof(UdpPktHdr)) continue;
+    auto* h = reinterpret_cast<UdpPktHdr*>(buf.data());
+    if (h->magic != kUdpMagic) continue;
+    if (h->kind == 1) {  // --- ack
+      u.acks_rx.fetch_add(1, std::memory_order_relaxed);
+      uint64_t now_us_ = now_ns() / 1000;
+      std::lock_guard<std::mutex> lk(u.mtx);
+      if (h->ts_us != 0 && now_us_ >= h->ts_us) {
+        double rtt = static_cast<double>(now_us_ - h->ts_us);
+        u.srtt_us = u.srtt_us == 0.0 ? rtt : 0.875 * u.srtt_us + 0.125 * rtt;
+        u.rtt_ewma_us.store(static_cast<uint64_t>(u.srtt_us),
+                            std::memory_order_relaxed);
+      }
+      uint64_t cum = h->seq;
+      while (!u.inflight.empty() && u.inflight.front().seq < cum) {
+        u.una_stream += u.inflight.front().len;
+        u.inflight.pop_front();
+      }
+      uint64_t max_sacked = 0;
+      for (auto& s : u.inflight) {
+        uint64_t rel = s.seq - cum;
+        if (rel >= 1 && rel <= 128) {
+          bool bit = rel <= 64 ? ((h->sack0 >> (rel - 1)) & 1)
+                               : ((h->sack1 >> (rel - 65)) & 1);
+          if (bit) {
+            s.sacked = true;
+            max_sacked = s.seq;
+          }
+        }
+      }
+      if (max_sacked != 0) {
+        // Dup-ack-equivalent fast retransmit: 3+ later packets arrived, the
+        // gap is very likely loss, not reordering. The one-RTT age guard
+        // keeps a burst of acks from retransmitting the same gap again.
+        uint64_t now = now_ns();
+        uint64_t guard_ns =
+            static_cast<uint64_t>(std::max(u.srtt_us, 100.0)) * 1000;
+        for (auto& s : u.inflight) {
+          if (s.sacked || s.seq + 3 > max_sacked) continue;
+          if (now - s.t_tx_ns < guard_ns) continue;
+          if (++s.rtx > kUdpRtxAbort) return RxResult::kDead;
+          s.t_tx_ns = now;
+          udp_send_seg_locked(c, u, s);
+          u.pkts_rtx.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+      continue;
+    }
+    // --- data
+    if (h->len != static_cast<uint32_t>(n) - sizeof(UdpPktHdr)) continue;
+    u.pkts_rx.fetch_add(1, std::memory_order_relaxed);
+    const uint8_t* payload = buf.data() + sizeof(UdpPktHdr);
+    if (h->seq == u.rcv_nxt_seq) {
+      if (!consume_udp_bytes(c, payload, h->len)) return RxResult::kDead;
+      u.rcv_nxt_seq++;
+      while (!u.ooo.empty() && u.ooo.begin()->first == u.rcv_nxt_seq) {
+        auto& v = u.ooo.begin()->second;
+        if (!consume_udp_bytes(c, v.data(), v.size())) return RxResult::kDead;
+        u.ooo.erase(u.ooo.begin());
+        u.rcv_nxt_seq++;
+      }
+    } else if (h->seq > u.rcv_nxt_seq && u.ooo.size() < kUdpMaxOoo &&
+               h->seq - u.rcv_nxt_seq <= 4 * udp_cwnd_pkts()) {
+      u.ooo.emplace(h->seq,
+                    std::vector<uint8_t>(payload, payload + h->len));
+    }  // else: duplicate (or absurdly far ahead) — the ack below refreshes
+    udp_send_ack(c, h->ts_us);
+  }
+  return RxResult::kBudget;  // level-triggered epoll re-reports the rest
+}
+
+// tx thread: the UDP-mode send service. (1) serialize queued frames into
+// the byte ring (frames "sent" == serialized; delivery is the reliability
+// layer's job, end-to-end completion still comes from peer acks/responses),
+// (2) packetize new bytes within cwnd and the pacing budget, (3) RTO-scan.
+bool Endpoint::service_udp_tx(Conn* c) {
+  UdpState& u = *c->udp;
+  while (true) {
+    TxItem* it = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(c->txq_mtx);
+      if (c->txq.empty()) break;
+      it = &c->txq.front();
+    }
+    if (static_cast<Op>(it->h.op) == Op::kHello) {
+      // pre-activation frame: finish it on TCP (the peer's handshake waits
+      // on these 48 bytes)
+      while (it->off < it->total()) {
+        const uint8_t* base =
+            reinterpret_cast<const uint8_t*>(&it->h) + it->off;
+        ssize_t s = ::send(c->fd, base, it->total() - it->off, MSG_NOSIGNAL);
+        if (s < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return true;  // retry
+          return false;
+        }
+        it->off += static_cast<size_t>(s);
+      }
+    } else {
+      if (!it->credited) {
+        bytes_tx_.fetch_add(it->total());
+        it->credited = true;
+      }
+      size_t total = it->total();
+      bool done = false;
+      {
+        std::lock_guard<std::mutex> lk(u.mtx);
+        uint64_t used = u.stream_end - u.una_stream;
+        uint64_t free_space = u.ring.size() - used;
+        while (it->off < total && free_space > 0) {
+          const uint8_t* base;
+          size_t n;
+          if (it->off < sizeof(FrameHeader)) {
+            base = reinterpret_cast<const uint8_t*>(&it->h) + it->off;
+            n = sizeof(FrameHeader) - it->off;
+          } else {
+            size_t poff = it->off - sizeof(FrameHeader);
+            base = it->payload() + poff;
+            n = it->wire_len - poff;
+          }
+          size_t take = std::min<uint64_t>(n, free_space);
+          ring_copy_in(u.ring, u.stream_end, base, take);
+          u.stream_end += take;
+          it->off += take;
+          free_space -= take;
+        }
+        done = it->off >= total;
+      }
+      if (!done) break;  // ring full until acks free space
+    }
+    size_t total = it->total();
+    uint64_t t_enq = it->t_enq_ns;
+    {
+      std::lock_guard<std::mutex> lk(c->txq_mtx);
+      c->txq.pop_front();
+    }
+    c->txq_bytes.fetch_sub(total, std::memory_order_relaxed);
+    auto& eng = *engines_[c->engine];
+    eng.tx_lat.record(now_ns() - t_enq);
+    eng.tx_frames.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // packetize + retransmit
+  uint64_t now = now_ns();
+  std::lock_guard<std::mutex> lk(u.mtx);
+  uint64_t rate = c->rate_bps.load(std::memory_order_relaxed);
+  if (rate == 0) rate = rate_bps_.load(std::memory_order_relaxed);
+  if (rate != 0) {
+    double add = static_cast<double>(now - u.t_refill_ns) * rate / 1e9;
+    double cap = static_cast<double>(
+        std::max<size_t>(udp_pkt_bytes() * 8, 256 << 10));
+    u.tokens = std::min(u.tokens + add, cap);
+  }
+  u.t_refill_ns = now;
+  while (u.sent_end < u.stream_end && u.inflight.size() < udp_cwnd_pkts()) {
+    uint32_t len = static_cast<uint32_t>(
+        std::min<uint64_t>(udp_pkt_bytes(), u.stream_end - u.sent_end));
+    if (rate != 0) {
+      if (u.tokens < len) break;  // pacing: CC's actuation point
+      u.tokens -= len;
+    }
+    UdpState::Seg s;
+    s.seq = u.next_seq++;
+    s.off = u.sent_end;
+    s.len = len;
+    s.t_tx_ns = now;
+    udp_send_seg_locked(c, u, s);
+    u.sent_end += len;
+    u.inflight.push_back(s);
+    u.pkts_tx.fetch_add(1, std::memory_order_relaxed);
+  }
+  uint64_t srtt_ns =
+      static_cast<uint64_t>(std::max(u.srtt_us, 50.0)) * 1000;
+  for (auto& s : u.inflight) {
+    if (s.sacked) continue;
+    uint64_t rto_ns = std::max<uint64_t>(4 * srtt_ns,
+                                         udp_rto_min_us() * 1000)
+                      << std::min<uint32_t>(s.rtx, 5);
+    if (now - s.t_tx_ns > rto_ns) {
+      if (++s.rtx > kUdpRtxAbort) return false;  // peer unreachable
+      s.t_tx_ns = now;
+      udp_send_seg_locked(c, u, s);
+      u.pkts_rtx.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+  return true;
+}
+
+bool Endpoint::conn_stats(uint64_t conn_id, ConnStats* out) {
+  auto c = get_conn(conn_id);
+  if (!c || out == nullptr) return false;
+  *out = ConnStats{};
+  out->rate_bps = c->rate_bps.load(std::memory_order_relaxed);
+  if (c->udp) {
+    auto& u = *c->udp;
+    out->udp_active = u.active.load(std::memory_order_relaxed);
+    out->rtt_us = static_cast<double>(
+        u.rtt_ewma_us.load(std::memory_order_relaxed));
+    out->pkts_tx = u.pkts_tx.load(std::memory_order_relaxed);
+    out->pkts_rtx = u.pkts_rtx.load(std::memory_order_relaxed);
+    out->pkts_rx = u.pkts_rx.load(std::memory_order_relaxed);
+    out->acks_rx = u.acks_rx.load(std::memory_order_relaxed);
+    std::lock_guard<std::mutex> lk(u.mtx);
+    out->bytes_unacked = u.stream_end - u.una_stream;
+  }
+  return true;
+}
+
+bool Endpoint::set_conn_rate(uint64_t conn_id, uint64_t bytes_per_sec) {
+  auto c = get_conn(conn_id);
+  if (!c) return false;
+  c->rate_bps.store(bytes_per_sec, std::memory_order_relaxed);
+  return true;
+}
+
 bool Endpoint::service_tx(Conn* c, bool* blocked) {
+  if (c->udp && c->udp->active.load(std::memory_order_acquire)) {
+    return service_udp_tx(c);  // *blocked stays false: the 1ms tx cadence
+                               // doubles as the RTO/pacing clock
+  }
   while (true) {
     TxItem* it = nullptr;
     {
@@ -970,42 +1446,104 @@ void Endpoint::handle_frame(Conn* c, const FrameHeader& h,
       notifq_.emplace_back(c->id, std::move(payload));
       break;
     }
+    case Op::kHello:
+      udp_activate(c, static_cast<uint16_t>(h.offset));
+      break;
     default:
       break;
   }
 }
 
+// kHello arrived (io thread): aim our datagram socket at the peer's UDP
+// port and go live. Packets the peer fired before our epoll registration
+// sat in the bound socket's buffer and are drained on the first event.
+void Endpoint::udp_activate(Conn* c, uint16_t peer_port) {
+  if (!c->udp || c->udp->ufd < 0 || peer_port == 0) return;
+  if (c->udp->active.load(std::memory_order_relaxed)) return;
+  sockaddr_in peer{};
+  socklen_t pl = sizeof(peer);
+  if (::getpeername(c->fd, reinterpret_cast<sockaddr*>(&peer), &pl) != 0) {
+    return;
+  }
+  peer.sin_port = htons(peer_port);
+  if (::connect(c->udp->ufd, reinterpret_cast<sockaddr*>(&peer),
+                sizeof(peer)) != 0) {
+    return;
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = (c->id << 2) | 3;  // tag 3 => conn's UDP data socket
+  ::epoll_ctl(engines_[c->engine]->epoll_fd, EPOLL_CTL_ADD, c->udp->ufd, &ev);
+  c->udp->active.store(true, std::memory_order_release);
+  engines_[c->engine]->cv.notify_one();  // tx may switch to the UDP path
+}
+
 // Finish one fully-received frame (io thread only): dispatch by op, release
 // the window pin, reset the state machine for the next header.
-void Endpoint::finish_rx_frame(Conn* c) {
+void Endpoint::finish_rx_frame(Conn* c, RxParse& rx) {
   // Acquire side of the wire-order fence (see g_wire_order): the sender's
   // pre-send writes happen-before everything after this frame's dispatch.
+  // (The UDP path does not need it — its completion chain passes through
+  // in-process mutexes the detector can see — but the acquire is free.)
   UCCLT_WIRE_ACQUIRE(c->wire_slot);
-  const FrameHeader& h = c->rx_hdr;
+  const FrameHeader& h = rx.hdr;
   size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
   bytes_rx_.fetch_add(sizeof(h) + body);
   auto& eng = *engines_[c->engine];
-  eng.rx_lat.record(now_ns() - c->rx_t0_ns);
+  eng.rx_lat.record(now_ns() - rx.t0_ns);
   eng.rx_frames.fetch_add(1, std::memory_order_relaxed);
   if (static_cast<Op>(h.op) == Op::kWrite) {
-    if (c->rx_pin) {
-      c->rx_pin->fetch_sub(1, std::memory_order_acq_rel);
-      c->rx_pin.reset();
+    if (rx.pin) {
+      rx.pin->fetch_sub(1, std::memory_order_acq_rel);
+      rx.pin.reset();
     }
     Task* ack = alloc_task();
     ack->conn_id = c->id;
     ack->op = Op::kWriteAck;
     ack->xfer_id = h.xfer_id;
-    ack->flags = c->rx_ok ? 0 : 1;
+    ack->flags = rx.ok ? 0 : 1;
     enqueue_task(ack);
   } else {
-    handle_frame(c, h, c->rx_buf);
+    handle_frame(c, h, rx.buf);
   }
-  c->rx_stage = Conn::RxStage::kHdr;
-  c->rx_got = 0;
-  c->rx_dst = nullptr;
-  c->rx_ok = false;
-  c->rx_buf.clear();
+  rx.stage = RxParse::Stage::kHdr;
+  rx.got = 0;
+  rx.dst = nullptr;
+  rx.ok = false;
+  rx.buf.clear();
+}
+
+// A frame header just completed on `rx`: validate and resolve the write
+// window (shared by the TCP socket parser and the UDP stream parser).
+// false = protocol violation; the caller kills the conn.
+bool Endpoint::on_rx_header(Conn* c, RxParse& rx) {
+  (void)c;
+  const FrameHeader& h = rx.hdr;
+  if (h.magic != kMagic || h.len > kMaxFrameLen) return false;
+  size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
+  if (static_cast<Op>(h.op) == Op::kWrite) {
+    // Fast path: land write payloads straight into the resolved window —
+    // one copy total (the DCN analog of the reference's zero-copy RDMA
+    // receive into registered memory). Pin so dereg() waits for us
+    // (zero-length writes resolve too — their ack must report success —
+    // but take no pin, since no bytes will land).
+    void* dst = nullptr;
+    std::shared_ptr<std::atomic<int>> pin;
+    {
+      std::lock_guard<std::mutex> lk(regs_mtx_);
+      dst = resolve_window_locked(h.rid, h.token, h.offset, h.len,
+                                  body > 0 ? &pin : nullptr);
+    }
+    if (dst != nullptr) {
+      rx.dst = static_cast<uint8_t*>(dst);
+      rx.pin = std::move(pin);
+      rx.ok = true;
+    } else {
+      rx.dst = nullptr;
+      rx.ok = false;
+    }
+  }
+  return true;
 }
 
 // Drain available bytes through the per-conn state machine without ever
@@ -1013,94 +1551,119 @@ void Endpoint::finish_rx_frame(Conn* c) {
 // arrive, and every other connection on the engine keeps flowing (the fix
 // for the reference-style blocking recv dispatch; ADVICE.md round 1).
 Endpoint::RxResult Endpoint::drain_rx(Conn* c) {
+  RxParse& rx = c->rx_tcp;
   size_t consumed = 0;
   while (consumed < kRxBudgetPerEvent) {
-    if (c->rx_stage == Conn::RxStage::kHdr) {
-      uint8_t* p = reinterpret_cast<uint8_t*>(&c->rx_hdr);
-      while (c->rx_got < sizeof(FrameHeader)) {
-        ssize_t n = ::recv(c->fd, p + c->rx_got,
-                           sizeof(FrameHeader) - c->rx_got, 0);
+    if (rx.stage == RxParse::Stage::kHdr) {
+      uint8_t* p = reinterpret_cast<uint8_t*>(&rx.hdr);
+      while (rx.got < sizeof(FrameHeader)) {
+        ssize_t n = ::recv(c->fd, p + rx.got, sizeof(FrameHeader) - rx.got, 0);
         if (n == 0) return RxResult::kDead;
         if (n < 0) {
           if (errno == EINTR) continue;
           if (errno == EAGAIN || errno == EWOULDBLOCK) return RxResult::kDrained;
           return RxResult::kDead;
         }
-        if (c->rx_got == 0) c->rx_t0_ns = now_ns();  // frame service starts
-        c->rx_got += static_cast<size_t>(n);
+        if (rx.got == 0) rx.t0_ns = now_ns();  // frame service starts
+        rx.got += static_cast<size_t>(n);
         consumed += static_cast<size_t>(n);
       }
-      const FrameHeader& h = c->rx_hdr;
-      if (h.magic != kMagic || h.len > kMaxFrameLen) return RxResult::kDead;
-      size_t body = (static_cast<Op>(h.op) == Op::kRead) ? 0 : h.len;
-      if (static_cast<Op>(h.op) == Op::kWrite) {
-        // Fast path: land write payloads straight into the resolved window —
-        // one copy total (the DCN analog of the reference's zero-copy RDMA
-        // receive into registered memory). Pin so dereg() waits for us
-        // (zero-length writes resolve too — their ack must report success —
-        // but take no pin, since no bytes will land).
-        void* dst = nullptr;
-        std::shared_ptr<std::atomic<int>> pin;
-        {
-          std::lock_guard<std::mutex> lk(regs_mtx_);
-          dst = resolve_window_locked(h.rid, h.token, h.offset, h.len,
-                                      body > 0 ? &pin : nullptr);
-        }
-        if (dst != nullptr) {
-          c->rx_dst = static_cast<uint8_t*>(dst);
-          c->rx_pin = std::move(pin);
-          c->rx_ok = true;
-        } else {
-          c->rx_dst = nullptr;
-          c->rx_ok = false;
-        }
-      }
+      if (!on_rx_header(c, rx)) return RxResult::kDead;
+      size_t body =
+          (static_cast<Op>(rx.hdr.op) == Op::kRead) ? 0 : rx.hdr.len;
       if (body == 0) {
-        finish_rx_frame(c);
+        finish_rx_frame(c, rx);
         continue;
       }
-      if (c->rx_dst == nullptr) {
+      if (rx.dst == nullptr) {
         try {
-          c->rx_buf.resize(body);  // owned body (or sink for bad windows)
+          rx.buf.resize(body);  // owned body (or sink for bad windows)
         } catch (const std::exception&) {
           return RxResult::kDead;
         }
       }
-      c->rx_stage = Conn::RxStage::kBody;
-      c->rx_got = 0;
+      rx.stage = RxParse::Stage::kBody;
+      rx.got = 0;
     }
     // Body stage.
-    size_t body = static_cast<size_t>(c->rx_hdr.len);
-    uint8_t* dst = c->rx_dst != nullptr ? c->rx_dst : c->rx_buf.data();
-    while (c->rx_got < body) {
+    size_t body = static_cast<size_t>(rx.hdr.len);
+    uint8_t* dst = rx.dst != nullptr ? rx.dst : rx.buf.data();
+    while (rx.got < body) {
       // Header bytes above may have nudged consumed past the budget;
       // saturating arithmetic, never wrap.
       size_t remaining = consumed < kRxBudgetPerEvent
                              ? kRxBudgetPerEvent - consumed
                              : 0;
       if (remaining == 0) return RxResult::kBudget;
-      ssize_t n = ::recv(c->fd, dst + c->rx_got,
-                         std::min(body - c->rx_got, remaining), 0);
+      ssize_t n = ::recv(c->fd, dst + rx.got,
+                         std::min(body - rx.got, remaining), 0);
       if (n == 0) return RxResult::kDead;
       if (n < 0) {
         if (errno == EINTR) continue;
         if (errno == EAGAIN || errno == EWOULDBLOCK) return RxResult::kDrained;
         return RxResult::kDead;
       }
-      c->rx_got += static_cast<size_t>(n);
+      rx.got += static_cast<size_t>(n);
       consumed += static_cast<size_t>(n);
     }
-    finish_rx_frame(c);
+    finish_rx_frame(c, rx);
   }
   return RxResult::kBudget;  // epoll re-reports any bytes still waiting
+}
+
+// Feed in-order UDP-delivered stream bytes through the rx_udp frame parser
+// (io thread only). Memory-fed twin of drain_rx's socket loop; false = kill.
+bool Endpoint::consume_udp_bytes(Conn* c, const uint8_t* p, size_t n) {
+  RxParse& rx = c->rx_udp;
+  while (n > 0) {
+    if (rx.stage == RxParse::Stage::kHdr) {
+      if (rx.got == 0) rx.t0_ns = now_ns();
+      size_t want = sizeof(FrameHeader) - rx.got;
+      size_t take = std::min(want, n);
+      std::memcpy(reinterpret_cast<uint8_t*>(&rx.hdr) + rx.got, p, take);
+      rx.got += take;
+      p += take;
+      n -= take;
+      if (rx.got < sizeof(FrameHeader)) return true;
+      if (!on_rx_header(c, rx)) return false;
+      size_t body =
+          (static_cast<Op>(rx.hdr.op) == Op::kRead) ? 0 : rx.hdr.len;
+      if (body == 0) {
+        finish_rx_frame(c, rx);
+        continue;
+      }
+      if (rx.dst == nullptr) {
+        try {
+          rx.buf.resize(body);
+        } catch (const std::exception&) {
+          return false;
+        }
+      }
+      rx.stage = RxParse::Stage::kBody;
+      rx.got = 0;
+      continue;
+    }
+    size_t body = static_cast<size_t>(rx.hdr.len);
+    uint8_t* dst = rx.dst != nullptr ? rx.dst : rx.buf.data();
+    size_t take = std::min(body - rx.got, n);
+    std::memcpy(dst + rx.got, p, take);
+    rx.got += take;
+    p += take;
+    n -= take;
+    if (rx.got == body) finish_rx_frame(c, rx);
+  }
+  return true;
 }
 
 void Endpoint::conn_error(uint64_t conn_id) {
   auto c = get_conn(conn_id);
   if (c) {
-    if (c->rx_pin) {  // io thread owns rx state; we run on the io thread
-      c->rx_pin->fetch_sub(1, std::memory_order_acq_rel);
-      c->rx_pin.reset();
+    // io thread owns rx state; we run on the io thread
+    for (RxParse* rx : {&c->rx_tcp, &c->rx_udp}) {
+      if (rx->pin) {
+        rx->pin->fetch_sub(1, std::memory_order_acq_rel);
+        rx->pin.reset();
+      }
     }
     // The tx thread (sole queue consumer) fails + clears the queue on its
     // next pass; touching it here would race a send in progress.
@@ -1132,6 +1695,7 @@ void Endpoint::io_loop(int engine) {
         c->id = next_conn_.fetch_add(1);
         uint64_t id = c->id;
         register_conn(c);
+        if (udp_mode_) send_hello(c);  // acceptor's half of the handshake
         if (!accept_queue_.push(id)) {
           // accept backlog overflow: reject the connection rather than leak
           // an id the application can never accept()
@@ -1147,6 +1711,10 @@ void Endpoint::io_loop(int engine) {
       uint64_t conn_id = tag >> 2;
       auto conn = get_conn(conn_id);
       if (!conn) continue;
+      if ((tag & 3) == 3) {  // the conn's UDP data socket
+        if (drain_udp(conn.get()) == RxResult::kDead) conn_error(conn_id);
+        continue;
+      }
       RxResult res = drain_rx(conn.get());
       bool dead = res == RxResult::kDead ||
                   (res == RxResult::kDrained &&

@@ -164,7 +164,20 @@ static void test_drop_reap() {
   });
   reaper.join();
   writer.join();
-  for (uint64_t x : lost) CHECK(p.client.poll(x) == XferState::kError);
+  const char* wire = std::getenv("UCCL_TPU_WIRE");
+  bool udp = wire != nullptr && std::strcmp(wire, "udp") == 0;
+  for (uint64_t x : lost) {
+    if (udp) {
+      // UDP wire: drop_rate loses PACKETS, and once it resets the
+      // reliability layer retransmits — the "lost" frames are recovered,
+      // so a reaped id may legitimately resolve kDone (late completion)
+      // or kError (reap consumed it first). Either is terminal; the test
+      // here is that the reap/retransmit race never corrupts tracking.
+      CHECK(p.client.poll(x) != XferState::kPending);
+    } else {
+      CHECK(p.client.poll(x) == XferState::kError);
+    }
+  }
   for (int j = 0; j < kLen; ++j) CHECK(dst[j] == 0x5A);
   std::printf("engine drop_reap ok\n");
 }

@@ -189,3 +189,53 @@ class RateController:
         t0 = time.perf_counter()
         self.ep.write(conn_id, src, fifo)
         return (time.perf_counter() - t0) * 1e6
+
+
+class CcController:
+    """Per-conn CC loop for the UDP wire — the configuration where the CC
+    algorithms are genuinely load-bearing: the engine's datagram path has no
+    kernel congestion control underneath, so the pacing rate this controller
+    sets is the ONLY thing standing between the sender and real packet loss
+    (reference: per-flow CC actuation through the EventOn* hooks,
+    collective/rdma/transport.h:449-533).
+
+    Sensor: the engine's in-protocol RTT EWMA (ack timestamp echoes,
+    ``Endpoint.conn_stats``) — no probe traffic needed. Actuator:
+    ``Endpoint.set_conn_rate``. Call :meth:`tick` periodically (e.g. every
+    few ms from a transfer loop or a background thread).
+    """
+
+    def __init__(self, ep, conn_id: int, algo=None, min_rate: float = 1e6):
+        self.ep = ep
+        self.conn_id = conn_id
+        self.algo = algo if algo is not None else TimelyCC()
+        self.min_rate = min_rate
+        self._last_rtx = 0
+
+    def tick(self) -> Optional[float]:
+        """Read transport stats, update the algorithm, actuate the per-conn
+        rate. Returns the new rate (bytes/s) or None when there is no RTT
+        signal yet. Retransmissions since the last tick count as a loss
+        signal: the RTT fed to the algorithm is inflated toward t_high so
+        multiplicative decrease engages even when the surviving packets'
+        RTTs look healthy (loss-IS-congestion, the EQDS/Swift stance)."""
+        st = self.ep.conn_stats(self.conn_id)
+        rtt = st["rtt_us"]
+        if rtt <= 0.0:
+            return None
+        new_rtx = st["pkts_rtx"] - self._last_rtx
+        self._last_rtx = st["pkts_rtx"]
+        if new_rtx > 0:
+            t_high = getattr(
+                self.algo, "t_high_us",
+                getattr(self.algo, "target_delay_us", 5000.0) * 4,
+            )
+            rtt = max(rtt, t_high)
+        if hasattr(self.algo, "on_rtt"):  # Timely: gradient rate control
+            rate = self.algo.on_rtt(rtt)
+        else:  # Swift: update the delay-target window, convert to a rate
+            self.algo.on_delay(rtt)
+            rate = self.algo.rate_for_rtt(rtt)
+        rate = max(rate, self.min_rate)
+        self.ep.set_conn_rate(self.conn_id, int(rate))
+        return rate

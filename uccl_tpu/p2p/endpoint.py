@@ -205,6 +205,18 @@ def _load():
             ]
         lib.ucclt_set_drop_rate.argtypes = [c, ctypes.c_double]
         lib.ucclt_set_rate_limit.argtypes = [c, ctypes.c_uint64]
+        if hasattr(lib, "ucclt_conn_stats"):
+            lib.ucclt_conn_stats.restype = ctypes.c_int
+            lib.ucclt_conn_stats.argtypes = [
+                c, ctypes.c_uint64, ctypes.POINTER(_ConnStatsC)
+            ]
+            lib.ucclt_set_conn_rate.restype = ctypes.c_int
+            lib.ucclt_set_conn_rate.argtypes = [
+                c, ctypes.c_uint64, ctypes.c_uint64
+            ]
+        if hasattr(lib, "ucclt_flush_conn"):
+            lib.ucclt_flush_conn.restype = ctypes.c_int
+            lib.ucclt_flush_conn.argtypes = [c, ctypes.c_uint64, ctypes.c_int]
         lib.ucclt_bytes_tx.restype = ctypes.c_uint64
         lib.ucclt_bytes_tx.argtypes = [c]
         lib.ucclt_bytes_rx.restype = ctypes.c_uint64
@@ -213,6 +225,22 @@ def _load():
         lib.ucclt_stats_json.argtypes = [c, ctypes.c_char_p, ctypes.c_size_t]
         _lib = lib
         return _lib
+
+
+class _ConnStatsC(ctypes.Structure):
+    """Mirror of ucclt_conn_stats_t (append-only layout)."""
+
+    _fields_ = [
+        ("rtt_us", ctypes.c_double),
+        ("pkts_tx", ctypes.c_uint64),
+        ("pkts_rtx", ctypes.c_uint64),
+        ("pkts_rx", ctypes.c_uint64),
+        ("acks_rx", ctypes.c_uint64),
+        ("bytes_unacked", ctypes.c_uint64),
+        ("rate_bps", ctypes.c_uint64),
+        ("udp_active", ctypes.c_int32),
+        ("pad", ctypes.c_int32),
+    ]
 
 
 def _as_buffer(arr: np.ndarray) -> Tuple[ctypes.c_void_p, int]:
@@ -542,6 +570,42 @@ class Endpoint:
         """Token-bucket pacing on the tx proxies; 0 disables (reference:
         Carousel timing-wheel pacing; actuator for the CC layer in cc.py)."""
         self._lib.ucclt_set_rate_limit(self._handle(), bytes_per_sec)
+
+    def flush(self, conn_id: int, timeout_ms: int = 5000) -> bool:
+        """Wait until every queued frame on the conn was handed to the
+        kernel — and, on the UDP wire, until every serialized byte was
+        ACKED by the peer (delivered, not merely transmitted)."""
+        return self._lib.ucclt_flush_conn(
+            self._handle(), conn_id, timeout_ms
+        ) == 0
+
+    def conn_stats(self, conn_id: int) -> dict:
+        """Per-conn transport stats (UDP wire mode: RTT EWMA, packet/retx
+        counts, unacked bytes) — the observation side of the CC control
+        plane; see :class:`uccl_tpu.p2p.cc.CcController`."""
+        s = _ConnStatsC()
+        if self._lib.ucclt_conn_stats(
+            self._handle(), conn_id, ctypes.byref(s)
+        ) != 0:
+            raise KeyError(f"unknown conn {conn_id}")
+        return {
+            "rtt_us": s.rtt_us,
+            "pkts_tx": s.pkts_tx,
+            "pkts_rtx": s.pkts_rtx,
+            "pkts_rx": s.pkts_rx,
+            "acks_rx": s.acks_rx,
+            "bytes_unacked": s.bytes_unacked,
+            "rate_bps": s.rate_bps,
+            "udp_active": bool(s.udp_active),
+        }
+
+    def set_conn_rate(self, conn_id: int, bytes_per_sec: int) -> None:
+        """Per-conn pacing rate (0 = fall back to the endpoint-global
+        bucket) — the actuation side of the CC control plane."""
+        if self._lib.ucclt_set_conn_rate(
+            self._handle(), conn_id, bytes_per_sec
+        ) != 0:
+            raise KeyError(f"unknown conn {conn_id}")
 
     @property
     def stats(self) -> dict:

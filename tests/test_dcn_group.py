@@ -71,6 +71,27 @@ def test_all_to_all(world, rng):
             np.testing.assert_array_equal(out[j], xs[j][i])
 
 
+def test_all_to_all_regrow_after_calls(rng):
+    """Regrow right after all_to_all calls: the deferred consume-licenses
+    (up to two unconsumed ACs per channel) must be skipped by the MF
+    descriptor exchange, not poison the group (round-5 review finding)."""
+    def body(g, r):
+        small = [np.full((2, 4), float(10 * p + i), np.float32)
+                 for p in range(2) for i in range(2)]
+        for i in range(2):  # leaves deferred ACs queued (consumed at i+2)
+            g.all_to_all(np.full((2, 4), float(10 * r + i), np.float32))
+        big = rng.standard_normal((2, 4096)).astype(np.float32) + r
+        out = g.all_to_all(big)  # regrow -> MF exchange over the same chans
+        # then a broadcast still works on the post-regrow group
+        b = g.broadcast(np.full(8, 3.0 + r, np.float32), root=1)
+        return out, b
+
+    outs = _run_group(2, body)
+    for i, (out, b) in enumerate(outs):
+        assert b[0] == 4.0  # root 1's value
+        assert out.shape == (2, 4096)
+
+
 def test_world_one_degenerate(rng):
     x = rng.standard_normal(10).astype(np.float32)
     outs = _run_group(1, lambda g, r: g.all_reduce(x))

@@ -32,6 +32,8 @@ pods).
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -41,6 +43,60 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from uccl_tpu.collective.hierarchical import DcnGroup
 from uccl_tpu.ep import ops as ep_ops
+
+
+class _StageClock:
+    """Env-gated per-stage wall profiler (UCCL_TPU_XPOD_PROFILE=1): the
+    knob that localizes cross-pod overhead (comm vs host glue vs compute)
+    without guessing — the stats-surface idiom of the reference's proxy
+    timing counters (dispatch_wait_recv_cost_stats, internode_ll.cu:66)."""
+
+    def __init__(self):
+        # read per instance (one per forward): enabling the profiler after
+        # module import must work
+        self.enabled = os.environ.get("UCCL_TPU_XPOD_PROFILE", "") == "1"
+        self.t = {}
+        self._t0 = time.perf_counter()
+
+    def lap(self, name: str):
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        self.t[name] = self.t.get(name, 0.0) + (now - self._t0) * 1e3
+        self._t0 = now
+
+    def dump(self, tag: str):
+        if self.enabled and self.t:
+            total = sum(self.t.values())
+            parts = " ".join(f"{k}={v:.1f}ms" for k, v in self.t.items())
+            print(f"[xpod-profile] {tag}: total={total:.1f}ms {parts}",
+                  flush=True)
+
+
+def _np_token_for_slot(idx: np.ndarray, num_experts: int,
+                       capacity: int) -> np.ndarray:
+    """numpy twin of ep_ops.sorted_from_topk's token_for_slot output —
+    same k-major flattening and STABLE sort, so drop semantics stay
+    byte-identical to the jax path (tests compare against the dense
+    oracle either way). idx: [T, K] bucket ids; returns [E*C] with T as
+    the empty sentinel."""
+    t, k = idx.shape
+    tk = t * k
+    flat_e = idx.T.reshape(tk)
+    flat_t = np.tile(np.arange(t, dtype=np.int32), k)
+    order = np.argsort(flat_e, kind="stable")
+    sorted_t = flat_t[order]
+    counts = np.bincount(flat_e, minlength=num_experts)[:num_experts]
+    seg_start = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    slot_ids = np.arange(num_experts * capacity)
+    e_of = slot_ids // capacity
+    p_of = slot_ids % capacity
+    j = seg_start[e_of] + p_of
+    kept = np.minimum(counts, capacity)
+    valid = p_of < kept[e_of]
+    return np.where(
+        valid, sorted_t[np.clip(j, 0, tk - 1)], t
+    ).astype(np.int32)
 
 
 class CrossPodMoE:
@@ -184,13 +240,11 @@ class CrossPodMoE:
         eq = pod_of[:, :, None] == pod_of[:, None, :]  # [T, K, K]
         dup = np.tril(eq, -1).any(axis=-1)  # [T, K] matches an earlier k
         coarse = np.where(~dup, pod_of, n_pods)  # sentinel: no slot
-        tfs, _slot, _ = (
-            np.asarray(a)
-            for a in ep_ops.sorted_from_topk(
-                jnp.asarray(coarse), n_pods + 1, cap
-            )
-        )
-        tfs = tfs[: n_pods * cap]  # drop the sentinel bucket
+        # pure-numpy twin of ep_ops.sorted_from_topk's token_for_slot: the
+        # bucketing is host-side, and dispatching ~15 eager jax CPU ops per
+        # forward cost 22 ms of the measured 40 ms — more than the entire
+        # wire exchange (UCCL_TPU_XPOD_PROFILE breakdown, round 5)
+        tfs = _np_token_for_slot(coarse, n_pods + 1, cap)[: n_pods * cap]
 
         valid_slot = tfs < t
         safe_tfs = np.where(valid_slot, tfs, 0)
@@ -208,7 +262,8 @@ class CrossPodMoE:
         )
         return tfs, valid_slot, safe_tfs, hits, meta_idx, meta_w, payload
 
-    def _chunked_exchange_compute(self, wire, fn_args_builder, fn):
+    def _chunked_exchange_compute(self, wire, fn_args_builder, fn,
+                                  clk=None):
         """Pipelined: all_to_all chunk c, dispatch compute c asynchronously
         (jax dispatch returns before the device finishes), exchange c+1
         while c computes, then return-exchange each chunk's result as it
@@ -219,16 +274,24 @@ class CrossPodMoE:
         for c in range(self.n_chunks):
             sl = slice(c * cs, (c + 1) * cs)
             recv = self.dcn.all_to_all(np.ascontiguousarray(wire[:, sl]))
+            if clk:
+                clk.lap("a2a_out")
             partials.append(fn(*fn_args_builder(recv)))  # async dispatch
+            if clk:
+                clk.lap("dispatch")
         backs = []
         for c in range(self.n_chunks):
             part = np.asarray(partials[c])  # blocks on chunk c only
+            if clk:
+                clk.lap("compute_wait")
             h = part.shape[-1]
             backs.append(
                 self.dcn.all_to_all(
                     np.ascontiguousarray(part.reshape(n_pods, cs, h))
                 )
             )
+            if clk:
+                clk.lap("a2a_back")
         return np.concatenate(backs, axis=1).reshape(n_pods * cap, -1)
 
     # ------------------------------------------------------------------
@@ -256,37 +319,48 @@ class CrossPodMoE:
             )
         n_pods = self.n_pods
         cap = self._pod_capacity(t)
+        clk = _StageClock()
 
         tfs, valid_slot, safe_tfs, hits, meta_idx, meta_w, payload = (
             self._bucket(x, topk_idx, topk_weights)
         )
+        clk.lap("bucket")
 
         # wire rows: payload + (local idx, weight) metadata per k
         wire = np.concatenate(
             [payload, meta_idx.astype(np.float32), meta_w], axis=1
         ).reshape(n_pods, cap, h + 2 * k)
+        clk.lap("pack")
 
         warrs = {kk: v for kk, v in expert_weights.items() if kk != "fn"}
         cs = cap // self.n_chunks
         shape_key = ((n_pods * cs, h), k)
         fn = self._local_compute(shape_key, expert_weights["fn"])
-        sharding = self._slot_sharding(n_pods * cs)
+        # single-device meshes skip the device_put round trip (measured ~1ms
+        # of glue per chunk on the loopback substrate); the jit commits
+        # host arrays itself
+        multi = len(self.mesh.devices.flat) > 1
+        sharding = self._slot_sharding(n_pods * cs) if multi else None
         recvs = []
 
         def build_args(recv):
             flat = recv.reshape(-1, h + 2 * k)
-            xs = jax.device_put(jnp.asarray(flat[:, :h]), sharding)
-            idx_r = jax.device_put(
-                jnp.asarray(flat[:, h:h + k].astype(np.int32)), sharding
-            )
-            w_r = jax.device_put(jnp.asarray(flat[:, h + k:]), sharding)
+            xs = jnp.asarray(flat[:, :h])
+            idx_r = jnp.asarray(flat[:, h:h + k].astype(np.int32))
+            w_r = jnp.asarray(flat[:, h + k:])
+            if multi:
+                xs = jax.device_put(xs, sharding)
+                idx_r = jax.device_put(idx_r, sharding)
+                w_r = jax.device_put(w_r, sharding)
             recvs.append((xs, idx_r, w_r))
             return xs, idx_r, w_r, warrs
 
-        back = self._chunked_exchange_compute(wire, build_args, fn)
+        back = self._chunked_exchange_compute(wire, build_args, fn, clk=clk)
 
         out = np.zeros((t, h), np.float32)
         np.add.at(out, safe_tfs[valid_slot], back[valid_slot])
+        clk.lap("combine")
+        clk.dump(f"forward pod={self.dcn.pos} chunks={self.n_chunks}")
 
         if save_for_backward:
             self._ctx = dict(

@@ -1,0 +1,136 @@
+"""RL-style weight transfer between actors over the P2P engine.
+
+Mirrors the reference's Ray consumption pattern (p2p/tests/test_ray_api.py:
+actors register tensor lists, swap serialized descriptors + endpoint
+metadata out-of-band, then one-sided WRITE the weights): a "trainer" actor
+pushes updated weights straight into an "inference" actor's registered
+buffers — no copies through the object store, which is the point of the
+API for RL frameworks.
+
+Runs under Ray when it is installed (`pip install ray`); in this
+environment (no ray) the SAME actor class runs in plain multiprocessing —
+the transfer code path is identical, only the actor scheduling differs.
+
+    python examples/ray_weight_transfer.py
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import ray
+except ImportError:  # this image ships no ray; the mp fallback runs
+    ray = None
+
+
+class InferenceActor:
+    """Owns live model weights; exposes registered buffers for the trainer
+    to write into (the reference's receiver side)."""
+
+    def __init__(self):
+        from uccl_tpu.p2p import XferEndpoint
+
+        self.xp = XferEndpoint()
+        self.weights = [
+            np.zeros((256, 256), np.float32),
+            np.zeros((256,), np.float32),
+        ]
+        self.descs = self.xp.register_memory(self.weights)
+
+    def handshake(self) -> tuple:
+        """(endpoint metadata, serialized descriptors) for the trainer."""
+        return (
+            self.xp.get_metadata(),
+            self.xp.get_serialized_descs(self.descs),
+        )
+
+    def wait_update(self) -> float:
+        """Accept the trainer's conn, wait for its WEIGHTS_READY notif,
+        return a checksum of the received weights."""
+        assert self.xp.accept() >= 0
+        import time
+
+        for _ in range(600):
+            notifs = self.xp.get_notifs()
+            if any(p == b"WEIGHTS_READY" for _, p in notifs):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError("no WEIGHTS_READY notification")
+        return float(sum(float(np.abs(w).sum()) for w in self.weights))
+
+    def close(self):
+        self.xp.close()
+
+
+class TrainerActor:
+    """Produces new weights and pushes them (the reference's sender)."""
+
+    def __init__(self, metadata: bytes, desc_blob: bytes):
+        from uccl_tpu.p2p import XferEndpoint
+
+        self.xp = XferEndpoint()
+        ok, self.conn = self.xp.add_remote_endpoint(metadata)
+        assert ok, "connect failed"
+        self.remote_descs = self.xp.deserialize_descs(desc_blob)
+
+    def push_weights(self) -> float:
+        rng = np.random.default_rng(7)
+        new_w = [
+            rng.standard_normal((256, 256)).astype(np.float32),
+            rng.standard_normal((256,)).astype(np.float32),
+        ]
+        xids = self.xp.transfer(self.conn, "WRITE", new_w, self.remote_descs)
+        assert self.xp.wait(xids)
+        self.xp.send_notif(self.conn, b"WEIGHTS_READY")
+        return float(sum(float(np.abs(w).sum()) for w in new_w))
+
+    def close(self):
+        self.xp.close()
+
+
+def _mp_inference(q_out):
+    actor = InferenceActor()
+    q_out.put(actor.handshake())
+    got = actor.wait_update()
+    q_out.put(got)
+    actor.close()
+
+
+def main():
+    if ray is not None:
+        ray.init(num_cpus=2)
+        Inf = ray.remote(InferenceActor)
+        inf = Inf.remote()
+        metadata, blob = ray.get(inf.handshake.remote())
+        pending = inf.wait_update.remote()
+        trainer = TrainerActor(metadata, blob)
+        sent = trainer.push_weights()
+        got = ray.get(pending)
+        trainer.close()
+        ray.shutdown()
+    else:
+        q_out = mp.Queue()
+        proc = mp.Process(target=_mp_inference, args=(q_out,))
+        proc.start()
+        metadata, blob = q_out.get(timeout=30)
+        trainer = TrainerActor(metadata, blob)
+        sent = trainer.push_weights()
+        got = q_out.get(timeout=60)
+        trainer.close()
+        proc.join(timeout=20)
+    ok = abs(sent - got) < 1e-3 * max(1.0, abs(sent))
+    print(f"weight transfer: sent-checksum={sent:.3f} "
+          f"received-checksum={got:.3f} {'OK' if ok else 'MISMATCH'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

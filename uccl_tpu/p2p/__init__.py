@@ -6,7 +6,8 @@ engine lives in ``native/``; :class:`Endpoint` binds it via ctypes.
 """
 
 from uccl_tpu.p2p.endpoint import Endpoint, FIFO_ITEM_BYTES
+from uccl_tpu.p2p.ray_api import XferEndpoint
 from uccl_tpu.p2p.channel import Channel, FifoItem
 from uccl_tpu.p2p.eqds import PullPacer
 
-__all__ = ["Endpoint", "FIFO_ITEM_BYTES", "Channel", "FifoItem", "PullPacer"]
+__all__ = ["Endpoint", "FIFO_ITEM_BYTES", "Channel", "FifoItem", "PullPacer", "XferEndpoint"]

@@ -264,6 +264,7 @@ class Endpoint:
         self._lib = _load()
         if listen_ip is None:
             listen_ip = os.environ.get("UCCL_TPU_LISTEN_IP")
+        self.listen_ip = listen_ip  # the bound interface (None = INADDR_ANY)
         self._h = self._lib.ucclt_create_bound(
             listen_ip.encode() if listen_ip else None, port, n_engines
         )

@@ -34,7 +34,13 @@ class XferEndpoint:
     (p2p/engine_api.cc: register_memory:?, transfer:448, serialize:420)."""
 
     def __init__(self, ep: Optional[Endpoint] = None, *, n_engines: int = 2):
+        from uccl_tpu.p2p.mr_cache import MrCache
+
         self.ep = ep if ep is not None else Endpoint(n_engines=n_engines)
+        # interval-containment registration cache (reference:
+        # test_register_memory_cache.py): repeat/subregion registrations
+        # reuse the base MR behind fresh handles, refcounted
+        self.mr_cache = MrCache(self.ep)
 
     # -- registration + descriptors ------------------------------------
     def register_memory(self, arrays: Sequence[np.ndarray]) -> List[dict]:
@@ -48,7 +54,9 @@ class XferEndpoint:
         peer writes would never reach the caller's array (live model
         weights, in the Ray pattern). The endpoint's registry keeps each
         registered array alive."""
-        descs = []
+        # Validate the WHOLE batch first: a failure after some registrations
+        # already happened would discard the descs list and leak handles the
+        # caller can never release.
         for arr in arrays:
             if not isinstance(arr, np.ndarray):
                 raise TypeError("register_memory takes host numpy arrays "
@@ -59,15 +67,43 @@ class XferEndpoint:
                     "transpose would silently register a copy the peer "
                     "writes into instead of your array)"
                 )
-            mr = self.ep.reg(arr)
-            fifo = self.ep.advertise(mr, 0, arr.nbytes)
-            descs.append({
-                "addr": arr.ctypes.data,
-                "size": int(arr.nbytes),
-                "mr_id": int(mr),
-                "fifo": fifo.hex(),
-            })
+            if arr.nbytes == 0:
+                raise ValueError("register_memory: zero-size array")
+        descs = []
+        try:
+            for arr in arrays:
+                hid, mr, off = self.mr_cache.register(arr)
+                fifo = self.ep.advertise(mr, off, arr.nbytes)
+                descs.append({
+                    "addr": arr.ctypes.data,
+                    "size": int(arr.nbytes),
+                    # the shared key material (reference lkeys/rkeys
+                    # analog): cache hits repeat the base mr_id at an offset
+                    "mr_id": int(mr),
+                    # the per-call API handle deregister_memory() takes
+                    "handle": int(hid),
+                    "fifo": fifo.hex(),
+                })
+        except Exception:
+            for d in descs:  # unwind the partial batch
+                self.mr_cache.deregister(d["handle"])
+            raise
         return descs
+
+    def deregister_memory(self, descs: List[dict]) -> None:
+        """Release registrations by descriptor (reference
+        deregister_memory): the underlying base MR is freed only when its
+        last handle is gone. Drains the WHOLE batch even when one handle is
+        bad, then reports the failures — stopping early would leave the
+        tail pinned forever."""
+        bad = []
+        for d in descs:
+            try:
+                self.mr_cache.deregister(d["handle"])
+            except KeyError:
+                bad.append(d.get("handle"))
+        if bad:
+            raise KeyError(f"unknown registration handle(s): {bad}")
 
     @staticmethod
     def get_serialized_descs(descs: List[dict]) -> bytes:
@@ -92,6 +128,8 @@ class XferEndpoint:
         import socket
 
         host = getattr(self.ep, "listen_ip", None)
+        if host in ("0.0.0.0", "::"):  # wildcard binds are not dialable
+            host = None
         if not host:
             host = os.environ.get("UCCL_TPU_HOST_IP")
         if not host:

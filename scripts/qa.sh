@@ -34,6 +34,10 @@ if [ "${1:-}" != "quick" ]; then
   timeout 900 python examples/ddp_train.py --processes 2 --steps 4 --batch 8; check $?
   note "examples: RL weight sync"
   timeout 900 python examples/rl_weight_sync.py; check $?
+  note "examples: Ray-style actor weight transfer (XferEndpoint)"
+  timeout 900 python examples/ray_weight_transfer.py; check $?
+  note "UDP-wire loss study (fig E: engine SACK recovery under packet loss)"
+  timeout 1200 python benchmarks/artifact_sweep.py --figs E --iters 2; check $?
   note "trainer + serve handoff"
   rm -rf /tmp/qa_ck
   timeout 900 python -m uccl_tpu.train --devices 8 --mesh dp=2,cp=2,tp=2 \

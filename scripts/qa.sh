@@ -36,6 +36,8 @@ if [ "${1:-}" != "quick" ]; then
   timeout 900 python examples/rl_weight_sync.py; check $?
   note "examples: Ray-style actor weight transfer (XferEndpoint)"
   timeout 900 python examples/ray_weight_transfer.py; check $?
+  note "examples: vLLM-style disagg proxy (HTTP routing + READ-pull KV)"
+  UCCL_TPU_EXAMPLE_CPU=1 timeout 900 python examples/disagg_proxy.py; check $?
   note "UDP-wire loss study (fig E: engine SACK recovery under packet loss)"
   timeout 1200 python benchmarks/artifact_sweep.py --figs E --iters 2; check $?
   note "trainer + serve handoff"

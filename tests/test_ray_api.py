@@ -124,6 +124,24 @@ class TestTwoProcessTransfer:
 
 
 class TestExampleRuns:
+    def test_disagg_proxy_example(self):
+        """The vLLM-style prefill/decode router end-to-end: HTTP two-step
+        routing, KV pulled by one-sided READ, exact-match generation."""
+        import subprocess
+        import sys
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, UCCL_TPU_EXAMPLE_CPU="1")
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "examples",
+                                          "disagg_proxy.py"),
+             "--new-tokens", "8"],
+            capture_output=True, text=True, timeout=300, cwd=repo, env=env,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "exact match vs single worker: True" in r.stdout
+
     def test_weight_transfer_example(self):
         """The Ray-actor example end-to-end (multiprocessing fallback in
         this image; identical transfer path under real Ray)."""

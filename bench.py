@@ -243,8 +243,10 @@ def main():
     # numerics across modes — tests/test_flagship.py::TestRematModes):
     # "dots" trades activation memory for zero backward GEMM recompute.
     remat = os.environ.get("UCCL_TPU_BENCH_REMAT", "full")
-    if remat not in ("full", "dots", "none"):
-        sys.exit(f"[bench] UCCL_TPU_BENCH_REMAT={remat!r}: want full|dots|none")
+    if remat not in ("full", "dots", "mlp", "none"):
+        sys.exit(
+            f"[bench] UCCL_TPU_BENCH_REMAT={remat!r}: want full|dots|mlp|none"
+        )
     # Batch/seq overrides validated here too — before the probe.
     try:
         batch_env = int(os.environ.get("UCCL_TPU_BENCH_BATCH", "0"))
@@ -275,7 +277,13 @@ def main():
             "head_dim": 32, "moe_ffn": 512, "vocab": 2048,
         }
     else:
-        batch, seq, cfg_shrink = 8, 1024, {}
+        # B=32 is the paired-harness HBM ceiling on v5e (B=64 OOMs) and the
+        # best measured MFU point (ONCHIP_20260731) — but the ceiling moves
+        # with the remat mode's saved-activation footprint: mlp's saved
+        # expert tensors OOM at B>=24 (B=16 matches B=32/full throughput
+        # anyway), and none saves everything and OOMs even at B=16.
+        batch = {"mlp": 16, "none": 8}.get(remat, 32)
+        seq, cfg_shrink = 1024, {}
     # On-chip MFU levers, sweepable without code edits (ladder step 7):
     # larger batch raises MXU utilization until HBM runs out. Applied to
     # the baseline too, so vs_baseline stays apples-to-apples.
@@ -288,7 +296,11 @@ def main():
 
     attn_impl = os.environ.get("UCCL_TPU_BENCH_ATTN", "auto")
     if attn_impl == "auto":
-        # resolve before reporting so the JSON names the impl actually run
+        # resolve before reporting so the JSON names the impl actually run.
+        # With auto-sized blocks (cap 1024) flash beats XLA's fused
+        # attention 1.7-4x fwd+bwd at every measured flagship shape
+        # (PERF.md round-5 block sweep) — TPU always flash, CPU always xla
+        # (pallas needs interpret mode off-TPU).
         attn_impl = "flash" if platform == "tpu" else "xla"
     ours_kw = {"moe_impl": moe_impl, "remat": remat, **cfg_shrink}
     flash_failed = None
@@ -328,7 +340,11 @@ def main():
         ours_dts, base_dts = _interleaved_dts(ours, base, rounds, iters)
         cfg = ours.cfg
     except Exception as e:
-        if "RESOURCE_EXHAUSTED" not in repr(e):
+        # The axon tunnel surfaces HBM OOM as INTERNAL/HTTP 500 "Ran out
+        # of memory", not RESOURCE_EXHAUSTED — match both spellings.
+        _oom = ("RESOURCE_EXHAUSTED", "ResourceExhausted",
+                "Ran out of memory")
+        if not any(s in repr(e) for s in _oom):
             raise
         print("[bench] ours+baseline do not fit together; sampling "
               "sequentially", file=sys.stderr)

@@ -1,0 +1,126 @@
+"""On-chip microbench: argsort-based vs cumsum-based MoE slot assignment.
+
+Both compute byte-identical (token_for_slot, slot, kept) — the cumsum
+variant exploits that a stable argsort by expert id preserves k-major
+order within each expert, so position-within-expert is a prefix count of
+the one-hot matrix, no sort needed.
+
+Timing discipline (PERF.md round-5 "Harness lesson"): the fori_loop body
+CHAINS — the carry perturbs the first input each iteration (runtime-zero
+for int inputs, so values are unchanged but XLA cannot hoist the body),
+and outputs are consumed by full reductions, not one-element reads.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def sortless_from_topk(idx, num_experts, capacity):
+    t, k = idx.shape
+    tk = t * k
+    flat_e = idx.T.reshape(tk)
+    flat_t = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)
+    onehot = (
+        flat_e[:, None] == jnp.arange(num_experts, dtype=flat_e.dtype)
+    ).astype(jnp.int32)
+    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1) - 1
+    counts = onehot.sum(0)
+    keep = pos < capacity
+    slot_flat = jnp.where(
+        keep, flat_e * capacity + pos, num_experts * capacity
+    ).astype(jnp.int32)
+    slot = slot_flat.reshape(k, t).T
+    token_for_slot = (
+        jnp.full((num_experts * capacity + 1,), t, jnp.int32)
+        .at[slot_flat]
+        .set(flat_t)[:-1]
+    )
+    kept = jnp.minimum(counts, capacity).astype(jnp.int32)
+    return token_for_slot, slot, kept
+
+
+def _perturb(a, c):
+    """Couple array `a` to the carry so the loop body is not hoistable.
+    Float: + c*1e-12 (negligible). Int: + min(int(c), 0) — runtime zero
+    (c accumulates non-negative sums) but data-dependent, so values are
+    bit-unchanged yet XLA cannot prove loop invariance."""
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        return a + (c * 1e-12).astype(a.dtype)
+    return a + jnp.minimum(c, 0.0).astype(a.dtype)
+
+
+def timeit(name, fn, *args, iters=20):
+    def body(i, state):
+        c, arrs = state
+        return fn(_perturb(arrs[0], c), *arrs[1:], c), arrs
+
+    f = jax.jit(lambda n, c0, *a: lax.fori_loop(0, n, body, (c0, a)))
+    c0 = jnp.zeros((), jnp.float32)
+    float(f(2, c0, *args)[0])
+    t0 = time.perf_counter()
+    float(f(iters, c0, *args)[0])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:34s} {dt * 1e3:8.3f} ms", flush=True)
+    return dt
+
+
+def main():
+    from uccl_tpu.ep.ops import sorted_from_topk
+
+    d = jax.devices()[0]
+    print(f"device: {d.platform} {d.device_kind}", flush=True)
+    E, K = 8, 2
+    for B in (16, 32):
+        T = B * 1024
+        cap = int(1.25 * T * K / E)
+        rng = np.random.default_rng(0)
+        idx = jnp.asarray(rng.integers(0, E, (T, K)), jnp.int32)
+        x = jnp.asarray(rng.standard_normal((T, 1024)), jnp.bfloat16)
+
+        # numerical equivalence first
+        a = jax.jit(lambda i: sorted_from_topk(i, E, cap))(idx)
+        b = jax.jit(lambda i: sortless_from_topk(i, E, cap))(idx)
+        for name, av, bv in zip(("token_for_slot", "slot", "kept"), a, b):
+            np.testing.assert_array_equal(np.asarray(av), np.asarray(bv), name)
+        print(f"B={B}: outputs byte-identical", flush=True)
+
+        def run_sort(idx, c):
+            tfs, slot, kept = sorted_from_topk(idx, E, cap)
+            return c + (tfs.astype(jnp.float32).sum()
+                        + slot.astype(jnp.float32).sum()
+                        + kept.astype(jnp.float32).sum()) * 1e-9
+
+        def run_sortless(idx, c):
+            tfs, slot, kept = sortless_from_topk(idx, E, cap)
+            return c + (tfs.astype(jnp.float32).sum()
+                        + slot.astype(jnp.float32).sum()
+                        + kept.astype(jnp.float32).sum()) * 1e-9
+
+        def run_sort_gather(idx, x, c):
+            tfs, slot, kept = sorted_from_topk(idx, E, cap)
+            buf = jnp.take(x, tfs, axis=0, mode="fill", fill_value=0)
+            return c + buf.astype(jnp.float32).sum() * 1e-6 + (
+                slot.astype(jnp.float32).sum() * 1e-9)
+
+        def run_sortless_gather(idx, x, c):
+            tfs, slot, kept = sortless_from_topk(idx, E, cap)
+            buf = jnp.take(x, tfs, axis=0, mode="fill", fill_value=0)
+            return c + buf.astype(jnp.float32).sum() * 1e-6 + (
+                slot.astype(jnp.float32).sum() * 1e-9)
+
+        timeit(f"B={B} argsort slotting", run_sort, idx)
+        timeit(f"B={B} cumsum slotting", run_sortless, idx)
+        timeit(f"B={B} argsort slotting+gather", run_sort_gather, idx, x)
+        timeit(f"B={B} cumsum slotting+gather", run_sortless_gather, idx, x)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""On-chip: why are the batched expert einsums at ~48% of MXU peak?
+
+Compares, at flagship B=32 capacity shapes (E=8, M=10240, K=1024, N=2816):
+  a) batched einsum ech,ehf->ecf (what ep.ops.moe_ffn does)
+  b) unrolled per-expert dots (8 separate GEMMs)
+  c) one dense GEMM [E*M, K]@[K, N] with a shared weight — the roofline
+     (same total FLOPs, no per-expert weight switching)
+Chained fori_loop harness (PERF.md round-5 harness lesson)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def timeit(name, fn, *args, iters=10, flops=None):
+    def body(i, state):
+        c, arrs = state
+        a0 = arrs[0] + c.astype(arrs[0].dtype) * 1e-12
+        return fn(a0, *arrs[1:], c), arrs
+
+    f = jax.jit(lambda n, c0, *a: lax.fori_loop(0, n, body, (c0, a)))
+    c0 = jnp.zeros((), jnp.float32)
+    float(f(2, c0, *args)[0])
+    t0 = time.perf_counter()
+    float(f(iters, c0, *args)[0])
+    dt = (time.perf_counter() - t0) / iters
+    tf = f"  {flops / dt / 1e12:6.1f} TF/s" if flops else ""
+    print(f"{name:38s} {dt * 1e3:8.3f} ms{tf}", flush=True)
+    return dt
+
+
+def main():
+    d = jax.devices()[0]
+    assert d.platform == "tpu", d
+    print(f"device: {d.device_kind}", flush=True)
+    E, M, K, N = 8, 10240, 1024, 2816
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.standard_normal((E, M, K)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((E, K, N)) * 0.02, jnp.bfloat16)
+    flops = 2.0 * E * M * K * N
+
+    def batched(xb, w, c):
+        y = jnp.einsum("ech,ehf->ecf", xb, w)
+        return c + y.astype(jnp.float32).sum() * 1e-6
+
+    def unrolled(xb, w, c):
+        ys = [xb[e] @ w[e] for e in range(E)]
+        return c + sum(y.astype(jnp.float32).sum() for y in ys) * 1e-6
+
+    x2 = jnp.asarray(rng.standard_normal((E * M, K)), jnp.bfloat16)
+    w0 = jnp.asarray(rng.standard_normal((K, N)) * 0.02, jnp.bfloat16)
+
+    def dense(x2, w0, c):
+        return c + (x2 @ w0).astype(jnp.float32).sum() * 1e-6
+
+    timeit("batched einsum ech,ehf->ecf", batched, xb, w, flops=flops)
+    timeit("unrolled 8x per-expert dots", unrolled, xb, w, flops=flops)
+    timeit("single dense GEMM (roofline)", dense, x2, w0, flops=flops)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,10 @@ from scripts/onchip_ladder.sh.)
 Prints one line per (collective, dtype) case; exits nonzero on any failure.
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -234,14 +234,14 @@ class TestTraining:
 
 
 class TestRematModes:
-    """remat="full"|"dots"|"none" change only the backward recompute
+    """remat="full"|"dots"|"mlp"|"none" change only the backward recompute
     schedule (_remat_wrap) — training must be bit-identical across them."""
 
     def test_remat_modes_bit_identical(self, devices, rng):
         mesh = make_mesh(MeshConfig(pp=2, dp=2, cp=1, tp=2), devices)
         tokens = targets = None
         losses = {}
-        for mode in ("full", "dots", "none"):
+        for mode in ("full", "dots", "mlp", "none"):
             cfg = _cfg(remat=mode, aux_loss_weight=0.01, z_loss_weight=1e-3)
             if tokens is None:
                 tokens, targets = _data(rng, cfg)
@@ -256,7 +256,10 @@ class TestRematModes:
                     params, opt_state, tokens, targets
                 )
             losses[mode] = float(metrics["loss"])
-        assert losses["full"] == losses["dots"] == losses["none"], losses
+        assert (
+            losses["full"] == losses["dots"] == losses["mlp"]
+            == losses["none"]
+        ), losses
 
     def test_unknown_remat_mode_raises(self, devices, rng):
         mesh = make_mesh(MeshConfig(), devices[:1])

@@ -45,7 +45,9 @@ from typing import NamedTuple, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
+from uccl_tpu.ep.ops import MOE_CHECKPOINT_NAMES
 from uccl_tpu.ep.ops import counts_exchange as _counts_exchange
 from uccl_tpu.ops.quant import dequantize_fp8, quantize_fp8
 
@@ -379,10 +381,18 @@ def grouped_ffn(
     megablocks-style economy the reference gets from per-expert packed
     messages, internode_ll.cu:62). recv_x: [R, H]; w_gate/w_up: [E_local, H,
     F]; w_down: [E_local, F, H]."""
-    gate = lax.ragged_dot(recv_x, w_gate, group_sizes)
-    up = lax.ragged_dot(recv_x, w_up, group_sizes)
+    # Same checkpoint_name tags as the sort/dense path (ep.ops.moe_ffn):
+    # remat="mlp" (flagship._remat_wrap) saves these, so backward re-runs
+    # no grouped GEMM regardless of which moe impl is selected.
+    xe_tag, hg_tag, hu_tag, ye_tag = MOE_CHECKPOINT_NAMES
+    recv_x = checkpoint_name(recv_x, xe_tag)
+    gate = checkpoint_name(lax.ragged_dot(recv_x, w_gate, group_sizes),
+                           hg_tag)
+    up = checkpoint_name(lax.ragged_dot(recv_x, w_up, group_sizes), hu_tag)
     act = jax.nn.silu(gate) * up
-    return lax.ragged_dot(act, w_down, group_sizes)
+    return checkpoint_name(
+        lax.ragged_dot(act, w_down, group_sizes), ye_tag
+    )
 
 
 def ll_moe_ffn(

@@ -42,8 +42,17 @@ from typing import NamedTuple, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from uccl_tpu.ops.quant import dequantize_fp8, quantize_fp8
+
+# checkpoint_name tags on the expert-GEMM operands/results, shared by the
+# sort/dense path here, the ll path (ep.ll.grouped_ffn), and the
+# remat="mlp" save policy (models.flagship._remat_wrap). A drifted name
+# fails SILENTLY (the policy just stops matching and the memory win
+# evaporates), so every site must import this tuple.
+MOE_CHECKPOINT_NAMES = ("moe_xe", "moe_hg", "moe_hu", "moe_ye")
+_XE, _HG, _HU, _YE = MOE_CHECKPOINT_NAMES
 
 Axis = Union[str, Tuple[str, ...]]
 
@@ -384,10 +393,22 @@ def moe_ffn(
         raise ValueError(
             f"unknown moe impl {impl!r} (want 'sort', 'dense', or 'll')"
         )
-    act = jax.nn.silu(jnp.einsum("ebh,ehf->ebf", xe, w_gate)) * jnp.einsum(
-        "ebh,ehf->ebf", xe, w_up
-    )
-    ye = jnp.einsum("ebf,efh->ebh", act, w_down)
+    # checkpoint_name tags let a remat policy pin exactly the expert-GEMM
+    # operands/results (see flagship._remat_wrap mode "mlp"): with these
+    # saved, the backward pass re-runs NO forward expert GEMM — the policy
+    # lever dots_with_no_batch_dims misses, because these einsums carry the
+    # `e` batch dim and are therefore excluded from it. (Keeping the
+    # BATCHED einsum form is deliberate: unrolling to per-expert 2-D dots
+    # measured 1.65x faster in isolation on v5e — scripts/
+    # expert_gemm_probe.py — but in the fused model context the end-to-end
+    # gain was <1%, and the unrolled dots lose their `e` batch dim, which
+    # silently drags every expert GEMM into the remat="dots" saved set and
+    # OOMs the documented-working B=32 dots config.)
+    xe = checkpoint_name(xe, _XE)
+    h_gate = checkpoint_name(jnp.einsum("ebh,ehf->ebf", xe, w_gate), _HG)
+    h_up = checkpoint_name(jnp.einsum("ebh,ehf->ebf", xe, w_up), _HU)
+    act = jax.nn.silu(h_gate) * h_up
+    ye = checkpoint_name(jnp.einsum("ebf,efh->ebh", act, w_down), _YE)
     if impl == "sort":
         out = combine_sorted(ye, rs.slot, rs.weights, axis, wire_fp8=wire_fp8)
     else:

@@ -62,7 +62,7 @@ class FlagshipConfig:
     moe_impl: str = "sort"  # "sort" (ragged) | "dense" (oracle) | "ll" (packed
     # grouped-GEMM path, no padded FLOPs — ep/ll.py)
     wire_fp8: bool = False
-    remat: str = "full"  # "full" | "dots" | "none" — see _remat_wrap
+    remat: str = "full"  # "full" | "dots" | "mlp" | "none" — see _remat_wrap
     dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
 
 
@@ -213,11 +213,16 @@ def _remat_wrap(f, mode: str):
     """Rematerialization wrapper for one transformer block under the
     per-stage ``lax.scan``. ``"full"`` recomputes the whole block in
     backward (minimum activation liveness — the conservative default);
-    ``"dots"`` saves matmul/einsum outputs and recomputes only the cheap
-    elementwise ops between them (``dots_with_no_batch_dims_saveable`` —
-    the standard MFU lever: backward re-runs no forward GEMM); ``"none"``
-    disables remat (the scan saves every residual — fastest when
-    activations fit). Gradients are bit-identical across modes; only the
+    ``"dots"`` saves no-batch-dim matmul outputs (projections, router,
+    vocab — NOT the expert einsums, which carry the ``e`` batch dim) and
+    recomputes the rest; ``"mlp"`` additionally saves the expert-GEMM
+    operands/results tagged in :mod:`uccl_tpu.ep.ops` / :mod:`~.ep.ll`
+    (``MOE_CHECKPOINT_NAMES``) while still rematerializing the attention
+    interior — the measured v5e sweet spot (backward re-runs NO forward
+    GEMM; attention is HBM-bound on its [S,S] scores, so saving them
+    costs more bandwidth than recomputing them); ``"none"`` disables
+    remat (the scan saves every residual — fastest when activations
+    fit). Gradients are bit-identical across modes; only the
     memory/recompute schedule changes."""
     if mode == "full":
         return jax.checkpoint(f)
@@ -225,9 +230,19 @@ def _remat_wrap(f, mode: str):
         return jax.checkpoint(
             f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
+    if mode == "mlp":
+        pol = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                *ep_ops.MOE_CHECKPOINT_NAMES
+            ),
+        )
+        return jax.checkpoint(f, policy=pol)
     if mode == "none":
         return f
-    raise ValueError(f"unknown remat mode {mode!r} (want full|dots|none)")
+    raise ValueError(
+        f"unknown remat mode {mode!r} (want full|dots|mlp|none)"
+    )
 
 
 def _embed(tokens, embed_local, cfg: FlagshipConfig):

@@ -382,11 +382,15 @@ def _default_blocks():
     """Tile sizes from config (UCCL_TPU_FLASH_BLOCK_Q/K): the on-chip tuning
     knob — the flash-vs-XLA crossover moves with (BQ, BKV) at long sequence,
     and an env sweep (benchmarks/attention_bench.py --block-sweep) must be
-    able to retune without code changes."""
+    able to retune without code changes. 0 (the default) means auto-size
+    from the sequence: largest power-of-two divisor capped at 1024, the
+    measured v5e optimum (see ops.attention._auto_block)."""
     from uccl_tpu.utils.config import param
 
-    bq = param("flash_block_q", 128, help="flash attention q-tile rows")
-    bk = param("flash_block_k", 128, help="flash attention kv-tile rows")
+    bq = param("flash_block_q", 0,
+               help="flash attention q-tile rows (0 = auto-size)")
+    bk = param("flash_block_k", 0,
+               help="flash attention kv-tile rows (0 = auto-size)")
     return int(bq.get()), int(bk.get())
 
 
@@ -427,13 +431,35 @@ def flash_attention_lse(
 
     The lse output is differentiable, so callers may merge blocks (ring/
     blockwise attention) and train straight through the merge. block_q/k
-    default from UCCL_TPU_FLASH_BLOCK_Q/K (128 each).
+    default from UCCL_TPU_FLASH_BLOCK_Q/K; unset (0) auto-sizes to the
+    largest power-of-two divisor of the sequence capped at 1024 — the
+    measured v5e optimum at head_dim 64 (PERF.md round-5 block sweep).
     """
+    from uccl_tpu.ops.attention import _auto_block
+
     dq, dk = _default_blocks()
+    auto_q = auto_k = False
     if block_q is None:
-        block_q = dq
+        block_q = dq or _auto_block(q.shape[1])
+        auto_q = not dq
     if block_k is None:
-        block_k = dk
+        block_k = dk or _auto_block(k.shape[1])
+        auto_k = not dk
+    # Fail fast when AUTO-sizing produced a sub-8 tile (ragged sequence,
+    # e.g. S=1001 -> 1) that is about to be compiled by Mosaic, which
+    # would reject it obscurely. Explicitly passed blocks (args or env)
+    # are the caller's own; interpret mode accepts any tile and keeps
+    # working (short decode-style sequences included).
+    will_compile = interpret is False or (interpret is None and _is_tpu())
+    if will_compile and (
+        (auto_q and block_q < 8) or (auto_k and block_k < 8)
+    ):
+        raise ValueError(
+            f"flash attention: no usable block for seq lengths "
+            f"q={q.shape[1]}, kv={k.shape[1]} (auto-sized blocks "
+            f"({block_q},{block_k}) < 8). Pad the sequence to a multiple "
+            f"of 8 or pass explicit block_q/block_k."
+        )
     return _flash_lse_core(q, k, v, causal, block_q, block_k, interpret)
 
 

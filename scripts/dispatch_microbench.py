@@ -13,7 +13,6 @@ and outputs are consumed by full reductions, not one-element reads.
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -21,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from _timing import chained_timeit as timeit
 
 
 def sortless_from_topk(idx, num_experts, capacity):
@@ -45,31 +46,6 @@ def sortless_from_topk(idx, num_experts, capacity):
     )
     kept = jnp.minimum(counts, capacity).astype(jnp.int32)
     return token_for_slot, slot, kept
-
-
-def _perturb(a, c):
-    """Couple array `a` to the carry so the loop body is not hoistable.
-    Float: + c*1e-12 (negligible). Int: + min(int(c), 0) — runtime zero
-    (c accumulates non-negative sums) but data-dependent, so values are
-    bit-unchanged yet XLA cannot prove loop invariance."""
-    if jnp.issubdtype(a.dtype, jnp.floating):
-        return a + (c * 1e-12).astype(a.dtype)
-    return a + jnp.minimum(c, 0.0).astype(a.dtype)
-
-
-def timeit(name, fn, *args, iters=20):
-    def body(i, state):
-        c, arrs = state
-        return fn(_perturb(arrs[0], c), *arrs[1:], c), arrs
-
-    f = jax.jit(lambda n, c0, *a: lax.fori_loop(0, n, body, (c0, a)))
-    c0 = jnp.zeros((), jnp.float32)
-    float(f(2, c0, *args)[0])
-    t0 = time.perf_counter()
-    float(f(iters, c0, *args)[0])
-    dt = (time.perf_counter() - t0) / iters
-    print(f"{name:34s} {dt * 1e3:8.3f} ms", flush=True)
-    return dt
 
 
 def main():

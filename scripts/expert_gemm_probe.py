@@ -9,31 +9,14 @@ Chained fori_loop harness (PERF.md round-5 harness lesson)."""
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
-
-def timeit(name, fn, *args, iters=10, flops=None):
-    def body(i, state):
-        c, arrs = state
-        a0 = arrs[0] + c.astype(arrs[0].dtype) * 1e-12
-        return fn(a0, *arrs[1:], c), arrs
-
-    f = jax.jit(lambda n, c0, *a: lax.fori_loop(0, n, body, (c0, a)))
-    c0 = jnp.zeros((), jnp.float32)
-    float(f(2, c0, *args)[0])
-    t0 = time.perf_counter()
-    float(f(iters, c0, *args)[0])
-    dt = (time.perf_counter() - t0) / iters
-    tf = f"  {flops / dt / 1e12:6.1f} TF/s" if flops else ""
-    print(f"{name:38s} {dt * 1e3:8.3f} ms{tf}", flush=True)
-    return dt
+from _timing import chained_timeit as timeit
 
 
 def main():

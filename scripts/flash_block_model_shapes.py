@@ -6,7 +6,10 @@ next step's inputs) inside one jitted fori_loop — a loop whose body reads
 only loop-invariant inputs gets hoisted out by XLA (LICM) and times an
 empty loop; measured here as impossible numbers (fwd+bwd < fwd) before
 the chain was added. Sync via a host scalar read (block_until_ready does
-not sync under the axon tunnel)."""
+not sync under the axon tunnel). This script chains FULL tensor state
+(outputs feed next inputs) rather than the carry-perturb scheme of
+scripts/_timing.chained_timeit — both encode the same discipline; use
+the shared helper for scalar-carry probes."""
 
 import os
 import sys
@@ -46,7 +49,6 @@ def main():
     q = jnp.asarray(rng.standard_normal((B, S, NH, HD)), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal((B, S, KVH, HD)), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal((B, S, KVH, HD)), jnp.bfloat16)
-    kv_rep = NH // KVH
 
     def chain_fwd(attn):
         # out [B,S,NH,D] feeds the next q; k/v nudged so nothing is invariant

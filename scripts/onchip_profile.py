@@ -20,7 +20,6 @@ Run from repo root inside a healthy tunnel session:
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -29,25 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-
-def timeit(name, fn, *args, iters=10):
-    """fn(a0, *rest, c) -> new carry scalar; a0 is perturbed by the carry
-    each iteration so the loop body cannot be hoisted."""
-    def body(i, state):
-        c, arrs = state
-        a0 = arrs[0] + c.astype(arrs[0].dtype) * 1e-12
-        return fn(a0, *arrs[1:], c), arrs
-
-    f = jax.jit(lambda n, c0, *a: lax.fori_loop(0, n, body, (c0, a)))
-    c0 = jnp.zeros((), jnp.float32)
-    t0 = time.perf_counter()
-    float(f(2, c0, *args)[0])  # compile + warm
-    tc = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    float(f(iters, c0, *args)[0])
-    dt = (time.perf_counter() - t0) / iters
-    print(f"{name:28s} {dt * 1e3:9.2f} ms  (compile {tc:.0f}s)", flush=True)
-    return dt
+from _timing import chained_timeit as timeit
 
 
 def main():

@@ -156,16 +156,19 @@ class TestBlockConfig:
 
         monkeypatch.setenv("UCCL_TPU_FLASH_BLOCK_Q", "64")
         monkeypatch.setenv("UCCL_TPU_FLASH_BLOCK_K", "32")
-        # params cache their env reads; force a re-read
+        # params cache their env reads; force a re-read. Register with the
+        # PRODUCTION default (0 = auto-size): param() is first-registration-
+        # wins, so a stale default here would silently re-pin the fixed-tile
+        # behavior for every later flash call in this test process.
         for name in ("flash_block_q", "flash_block_k"):
-            p = cfg.param(name, 128)
+            p = cfg.param(name, 0)
             p.reset()
         try:
             assert pa._default_blocks() == (64, 32)
         finally:
             monkeypatch.undo()
             for name in ("flash_block_q", "flash_block_k"):
-                cfg.param(name, 128).reset()
+                cfg.param(name, 0).reset()
 
     def test_grad_with_default_blocks(self):
         """Differentiation with blocks left at their defaults must work —

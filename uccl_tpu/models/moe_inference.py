@@ -265,17 +265,43 @@ class MoEServer:
 
     def generate(self, params, prompt, new_tokens: int, max_seq: int,
                  impl: str = "ll"):
-        """Greedy decode. prompt: [W, B_loc, S] → tokens [W, B_loc, N]."""
+        """Greedy decode. prompt: [W, B_loc, S] → tokens [W, B_loc, N].
+
+        The decode loop is ONE jitted ``lax.scan`` over ``new_tokens``
+        (cached per (impl, N, shapes) like every other program here), not
+        a Python loop of per-token dispatches: under the axon tunnel each
+        dispatch costs ~10 ms, which at decode's ~ms-scale step time was
+        the serving bottleneck (measured 131.9 tok/s on v5e where the
+        compute supports far more — PERF.md round-5 step 9). The scan
+        carries (token, cache) on-device and only the final [W, B_loc, N]
+        token block crosses the host boundary."""
+        if new_tokens < 1:
+            raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
         if prompt.shape[-1] + new_tokens > max_seq:
             raise ValueError(
                 f"prompt {prompt.shape[-1]} + new {new_tokens} tokens "
                 f"exceed max_seq {max_seq}: the cache would overflow"
             )
         logits, cache = self.prefill(params, prompt, max_seq)
-        out = []
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        for _ in range(new_tokens):
-            out.append(tok)
-            logits, cache = self.decode_step(params, tok, cache, impl=impl)
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jnp.stack(out, axis=-1)
+        tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        key = ("gen", impl, new_tokens, tok0.shape, cache.k.shape)
+
+        def build():
+            def gen(p, tok, kc, vc, ln):
+                def body(carry, _):
+                    tok, kc, vc, ln = carry
+                    lg, c2 = self._forward(
+                        p, tok[..., None], MoEKVCache(kc, vc, ln), impl
+                    )
+                    ntok = jnp.argmax(lg[:, :, 0], axis=-1).astype(jnp.int32)
+                    return (ntok, c2.k, c2.v, c2.length), tok
+
+                _, toks = lax.scan(
+                    body, (tok, kc, vc, ln), None, length=new_tokens
+                )
+                return jnp.moveaxis(toks, 0, -1)  # [W, B_loc, N]
+
+            return jax.jit(gen)
+
+        fn = self._fn(key, build)
+        return fn(params, tok0, cache.k, cache.v, cache.length)

@@ -275,9 +275,12 @@ def main(argv=None):
         jnp.int32,
     )
 
-    # Warmup compiles the prefill + decode programs (same shapes as the
-    # timed run) so tokens_per_sec measures decode, not XLA compilation.
-    server.generate(placed, prompt, 1, max_seq, impl=args.impl)
+    # Warmup compiles the prefill + decode-scan programs. It must use the
+    # SAME new_tokens as the timed run: generate's decode loop is one
+    # jitted lax.scan whose length is baked into the program, so a
+    # 1-token warmup would compile a different scan and the timed call
+    # would pay the real compile.
+    server.generate(placed, prompt, args.new_tokens, max_seq, impl=args.impl)
     t0 = time.perf_counter()
     out = server.generate(
         placed, prompt, args.new_tokens, max_seq, impl=args.impl

@@ -20,8 +20,25 @@ from _bootstrap import init_devices
 
 
 def _time_fn(fn, args, iters):
-    """Per-call latency via a timed loop with a final host sync (see
-    memory/tpu-tunnel-discipline: chain + host scalar read)."""
+    """Per-op latency via the shared SLOPE estimator
+    (uccl_tpu.utils.timing.slope_timeit): chained fori_loop, differenced
+    over two run lengths so the fixed tunnel cost (dispatch + host-read
+    RTT, tens of ms) cancels exactly — a per-call loop over µs-scale EP
+    ops measures only its own dispatch floor (the round-4 on-chip table
+    recorded tens of ms for ops this measures in tens of µs). Imported
+    lazily: uccl_tpu pulls in jax, which must not initialize before
+    init_devices has set XLA_FLAGS."""
+    from uccl_tpu.utils.timing import slope_timeit
+
+    return slope_timeit(fn, args, iters)
+
+
+def _time_fn_percall(fn, args, iters):
+    """One dispatch per iteration, host-read sync (jax_block). Carries the
+    full per-call tunnel overhead — use ONLY where the op itself cannot be
+    traced into a fori_loop (the cross-pod forward does host socket I/O),
+    and time BOTH sides of any reported ratio with this same discipline so
+    the fixed cost cancels in the quotient."""
     out = fn(*args)  # compile + warmup
     jax_block(out)
     t0 = time.perf_counter()
@@ -335,8 +352,12 @@ def bench_cross_pod(tokens, hidden, ffn, experts, topk, iters, n_chunks=1):
             for turn in range(P_pods):
                 dcn.barrier()
                 if turn == p:
+                    # per-call on BOTH sides of the fwd/compute ratio:
+                    # fwd does host socket I/O and cannot use the slope
+                    # harness, so the baseline must carry the same fixed
+                    # per-dispatch cost for the ratio to cancel it
                     comp_us = (
-                        _time_fn(fn, (xs, idx, wts, warrs), iters)
+                        _time_fn_percall(fn, (xs, idx, wts, warrs), iters)
                         * 1e6 * moe.n_chunks
                     )
             dcn.barrier()

@@ -1,55 +1,11 @@
-"""Shared chained-fori_loop timing harness for on-chip probe scripts.
+"""Thin shim: probe scripts import the shared timing harness from here
+(scripts/ is sys.path[0] when run as `python scripts/<probe>.py`); the
+implementation — and the round-5 "Harness lesson" it encodes — lives in
+uccl_tpu.utils.timing. The repo root is already on sys.path because every
+probe script inserts it before importing this module."""
 
-Encodes the round-5 "Harness lesson" (PERF.md) in ONE place:
-  * the loop body must be CHAINED to the carry — a body whose inputs are
-    all loop-invariant is hoisted out by XLA's LICM and the loop times
-    nothing (measured: "fwd+bwd" 1.6 ms < fwd 3.4 ms);
-  * consume outputs with a full reduction, never a one-element read that
-    XLA can narrow/DCE through (measured: flattered XLA attention 3x vs
-    the un-trimmable pallas kernel);
-  * pass arrays as jit ARGUMENTS, not closures — baked-in constants can
-    exceed the axon tunnel's remote-compile request limit (HTTP 413);
-  * sync via a host scalar read — block_until_ready does not synchronize
-    under the axon tunnel.
-
-Probe functions have the signature fn(a0, *rest, c) -> new_carry_scalar,
-where a0 is the perturbed first array and c the running f32 carry.
-"""
-
-import time
-
-import jax
-import jax.numpy as jnp
-from jax import lax
-
-
-def perturb(a, c):
-    """Couple array `a` to the carry so the loop body is not hoistable.
-    Float: + c*1e-12 (negligible). Int: + min(c, 0) cast — runtime zero
-    (the carry accumulates non-negative reductions) but data-dependent,
-    so values are bit-unchanged yet XLA cannot prove loop invariance."""
-    if jnp.issubdtype(a.dtype, jnp.floating):
-        return a + (c * 1e-12).astype(a.dtype)
-    return a + jnp.minimum(c, 0.0).astype(a.dtype)
-
-
-def chained_timeit(name, fn, *args, iters=10, flops=None, width=34):
-    """Time fn over `iters` chained iterations in ONE jitted dispatch.
-    Returns seconds per iteration; prints `name`, ms, and TF/s if `flops`
-    (per-iteration FLOPs) is given."""
-    def body(i, state):
-        c, arrs = state
-        return fn(perturb(arrs[0], c), *arrs[1:], c), arrs
-
-    f = jax.jit(lambda n, c0, *a: lax.fori_loop(0, n, body, (c0, a)))
-    c0 = jnp.zeros((), jnp.float32)
-    t0 = time.perf_counter()
-    float(f(2, c0, *args)[0])  # compile + warm
-    tc = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    float(f(iters, c0, *args)[0])
-    dt = (time.perf_counter() - t0) / iters
-    tf = f"  {flops / dt / 1e12:6.1f} TF/s" if flops else ""
-    print(f"{name:{width}s} {dt * 1e3:8.3f} ms{tf}  (compile {tc:.0f}s)",
-          flush=True)
-    return dt
+from uccl_tpu.utils.timing import (  # noqa: F401
+    chained_timeit,
+    perturb,
+    slope_timeit,
+)

@@ -75,18 +75,22 @@ def main():
                     v + g[2].astype(v.dtype) * 1e-6)
         return step
 
+    def try_timeit(name, step, state):
+        try:
+            return timeit(name, step, state)
+        except Exception as e:  # noqa: BLE001 - probe continues past OOM
+            print(f"{name:40s} FAILED {type(e).__name__}: {str(e)[:120]}",
+                  flush=True)
+            return None
+
     xla = lambda q, k, v: attention_reference(q, k, v, causal=True)
-    timeit("xla fwd", chain_fwd(xla), (q, k, v))
-    timeit("xla fwd+bwd", chain_fwdbwd(xla), (q, k, v))
+    try_timeit("xla fwd", chain_fwd(xla), (q, k, v))
+    try_timeit("xla fwd+bwd", chain_fwdbwd(xla), (q, k, v))
 
     for blk in (128, 256, 512, 1024):
         fl = lambda q, k, v, blk=blk: flash_attention(q, k, v, True, blk, blk)
-        try:
-            timeit(f"flash bq=bk={blk} fwd", chain_fwd(fl), (q, k, v))
-            timeit(f"flash bq=bk={blk} fwd+bwd", chain_fwdbwd(fl), (q, k, v))
-        except Exception as e:
-            print(f"flash blk={blk}: FAILED {type(e).__name__}: "
-                  f"{str(e)[:200]}", flush=True)
+        try_timeit(f"flash bq=bk={blk} fwd", chain_fwd(fl), (q, k, v))
+        try_timeit(f"flash bq=bk={blk} fwd+bwd", chain_fwdbwd(fl), (q, k, v))
 
 
 if __name__ == "__main__":

@@ -49,23 +49,39 @@ timeout 2400 python benchmarks/ep_bench.py --table 2>&1 | tee -a "$LOG"
 say "4/9 ep_bench --compare-dense"
 timeout 2400 python benchmarks/ep_bench.py --compare-dense 2>&1 | tee -a "$LOG"
 
-say "5/9 flash block-size sweep at long sequence"
-timeout 2400 python benchmarks/attention_bench.py --block-sweep \
-  --seqs 4096,8192 --iters 10 2>&1 | tee -a "$LOG"
+say "5/9 flash block sweep at FLAGSHIP shapes (chained harness)"
+FB_BATCH=16 timeout 2400 python scripts/flash_block_model_shapes.py \
+  2>&1 | tee -a "$LOG"
+FB_BATCH=4 FB_SEQ=4096 timeout 2400 \
+  python scripts/flash_block_model_shapes.py 2>&1 | tee -a "$LOG"
+# long-context regression guard: the README/PERF claim "flash is the only
+# path at S>=8192" must stay re-measurable (XLA rows FAIL there - that IS
+# the result)
+FB_BATCH=2 FB_SEQ=8192 timeout 2400 \
+  python scripts/flash_block_model_shapes.py 2>&1 | tee -a "$LOG"
+FB_BATCH=1 FB_SEQ=16384 timeout 2400 \
+  python scripts/flash_block_model_shapes.py 2>&1 | tee -a "$LOG"
 
-say "6/9 bench.py MoE-impl sweep (ragged grouped-GEMM path on MXU)"
+say "6/9 bench.py MoE-impl + remat sweeps (defaults pick the per-mode batch)"
 UCCL_TPU_BENCH_MOE=ll timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
+UCCL_TPU_BENCH_REMAT=mlp timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
 
-say "7/9 bench.py batch sweep (MFU vs batch; HBM permitting)"
-UCCL_TPU_BENCH_BATCH=16 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
-UCCL_TPU_BENCH_BATCH=32 timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
+say "7/9 step decomposition (which block eats the step)"
+timeout 2400 python scripts/onchip_profile.py 2>&1 | tee -a "$LOG"
 
-say "8/9 bench.py remat sweep (dots saves fwd GEMMs from bwd recompute)"
-UCCL_TPU_BENCH_REMAT=dots timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
+say "8/9 ep_bench compare-dense scaling (slope harness; T=16384 is the
+published 8.2x endpoint of the crossover curve)"
+timeout 2400 python benchmarks/ep_bench.py --compare-dense --iters 30 \
+  --tokens 4096 2>&1 | tee -a "$LOG"
+timeout 2400 python benchmarks/ep_bench.py --compare-dense --iters 30 \
+  --tokens 16384 2>&1 | tee -a "$LOG"
 
-say "9/9 serve decode throughput (EP LL path, seed params)"
-timeout 2400 python -m uccl_tpu.serve --batch 8 --prompt-len 128 \
+say "9/9 serve decode throughput (jitted-scan loop, ll + sort)"
+timeout 2400 python -m uccl_tpu.serve --batch 64 --prompt-len 128 \
   --new-tokens 64 --vocab 16384 --dim 1024 --layers 4 --heads 16 \
   --kv-heads 4 --ffn 2816 2>&1 | tee -a "$LOG"
+timeout 2400 python -m uccl_tpu.serve --batch 64 --prompt-len 128 \
+  --new-tokens 64 --vocab 16384 --dim 1024 --layers 4 --heads 16 \
+  --kv-heads 4 --ffn 2816 --impl sort 2>&1 | tee -a "$LOG"
 
 say "ladder complete $(date +%H:%M:%S) - transcribe into PERF.md now"

@@ -2,15 +2,17 @@
 # On-chip measurement ladder: run the moment the axon tunnel is healthy.
 #
 # Captures, IN ORDER OF VALUE (the tunnel can wedge mid-session — see
-# memory/tpu-tunnel-discipline), the round's missing TPU evidence:
+# memory/tpu-tunnel-discipline), the round's TPU evidence:
 #   1. bench.py            — the driver metric (device, MFU, vs_baseline)
-#   2. attention sweep     — flash-vs-XLA crossover at S=1k..8k (fori_loop
-#                            harness: one dispatch, host-scalar sync)
-#   3. ep_bench            — sorted-vs-dense + LL dispatch/combine µs,
-#                            ragged wire (TPU-only lowering)
-#   then: flash block-size sweep at long sequence; bench.py MoE impl sweep
-#   (UCCL_TPU_BENCH_MOE=ll — ragged grouped-GEMM path on MXU); batch sweep
-#   (UCCL_TPU_BENCH_BATCH — the MFU lever); remat sweep (UCCL_TPU_BENCH_REMAT)
+#   1b. pallas_ccl_proof   — remote-DMA collective Mosaic lowering proof
+#   2. attention sweep     — flash-vs-XLA crossover (fori_loop harness)
+#   3-4. ep_bench          — latency table + compare-dense (slope harness)
+#   5. flash block sweep at FLAGSHIP shapes incl. S>=8192 long-context
+#      (XLA failing to compile there IS the recorded result)
+#   6. bench.py moe=ll and remat=mlp sweeps (per-mode default batches)
+#   7. step decomposition  — which block eats the step
+#   8. compare-dense scaling incl. the T=16384 crossover endpoint
+#   9. serve decode (jitted-scan loop), ll AND sort impls
 # Everything appends to docs/ONCHIP_$(date +%Y%m%d).log; transcribe wins
 # into PERF.md immediately.
 #

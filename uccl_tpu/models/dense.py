@@ -37,7 +37,9 @@ class DenseConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
     n_microbatches: int = 1
-    remat: str = "full"  # "full" | "dots" | "none" — flagship._remat_wrap
+    remat: str = "full"  # "full" | "dots" | "mlp" | "none" (flagship.
+    # _remat_wrap; "mlp" is accepted but ≡ "dots" here — the dense FFN has
+    # no MOE_CHECKPOINT_NAMES tags for the save-names half to match)
     seq_mode: str = "ring"
     attn_impl: str = "auto"
     dtype: Any = jnp.float32

@@ -141,6 +141,9 @@ def decode_step_elastic(params, token, ekv, cfg: DenseConfig):
     return logits[:, 0]
 
 
+_GEN_CACHE: dict = {}
+
+
 def generate(
     params,
     prompt: jax.Array,
@@ -149,21 +152,36 @@ def generate(
     max_new_tokens: int = 32,
     max_seq: int = 256,
 ) -> jax.Array:
-    """Greedy generation. prompt: [B, S] → [B, max_new_tokens]."""
+    """Greedy generation. prompt: [B, S] → [B, max_new_tokens].
+
+    One jitted program (prefill + a decode ``lax.scan``), cached per
+    (cfg, shapes, N): params enter as jit ARGUMENTS, so repeat calls at
+    the same shapes are pure cache hits. The old form ran the scan
+    eagerly — params were baked into the staged scan as constants, every
+    call re-traced, and the constants could exceed a remote-compile
+    request limit (PERF.md round-5 tunnel lessons)."""
     if prompt.shape[1] + max_new_tokens > max_seq:
         raise ValueError(
             f"prompt {prompt.shape[1]} + new {max_new_tokens} tokens exceed "
             f"max_seq {max_seq}: the cache would overflow"
         )
-    logits, cache = jax.jit(
-        lambda p, t: prefill(p, t, cfg, max_seq)
-    )(params, prompt)
+    key = (repr(cfg), prompt.shape, max_new_tokens, max_seq)
+    fn = _GEN_CACHE.get(key)
+    if fn is None:
 
-    def body(carry, _):
-        logits, cache = carry
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits, cache = decode_step(params, tok, cache, cfg)
-        return (logits, cache), tok
+        def run(p, t):
+            logits, cache = prefill(p, t, cfg, max_seq)
 
-    (_, _), toks = lax.scan(body, (logits, cache), None, length=max_new_tokens)
-    return toks.T  # [B, T]
+            def body(carry, _):
+                logits, cache = carry
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                logits, cache = decode_step(p, tok, cache, cfg)
+                return (logits, cache), tok
+
+            (_, _), toks = lax.scan(
+                body, (logits, cache), None, length=max_new_tokens
+            )
+            return toks.T  # [B, T]
+
+        fn = _GEN_CACHE[key] = jax.jit(run)
+    return fn(params, prompt)

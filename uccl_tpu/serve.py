@@ -190,13 +190,9 @@ def main(argv=None):
                 setattr(args, flag, saved_cfg[key])
     if saved_cfg is not None and saved_cfg.get("model") == "dense":
         # Dense (Llama-family) checkpoints generate through the cached
-        # single-shard KV path (models/inference.py) — no EP mesh. The
-        # prefill/decode programs jit ONCE here and the decode loop reuses
-        # them, so the timed window measures decode, not compilation
-        # (inference.generate re-jits per call and bakes the scan length,
-        # which would make a warmup call useless).
+        # single-shard KV path (models/inference.py) — no EP mesh.
         from uccl_tpu.models.dense import DenseConfig
-        from uccl_tpu.models.inference import decode_step, prefill
+        from uccl_tpu.models.inference import generate
 
         dcfg = DenseConfig(
             vocab=args.vocab, dim=args.dim, n_layers=args.layers,
@@ -214,18 +210,23 @@ def main(argv=None):
             rng.integers(0, dcfg.vocab, (args.batch, args.prompt_len)),
             jnp.int32,
         )
-        prefill_j = jax.jit(lambda p, t: prefill(p, t, dcfg, max_seq))
-        decode_j = jax.jit(lambda p, tok, c: decode_step(p, tok, c, dcfg))
-        logits, cache = prefill_j(params, prompt)
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        decode_j(params, tok, cache)[0].block_until_ready()  # warm decode
+        # One jitted program (prefill + decode scan), cached per shape in
+        # inference.generate — the warmup call at the SAME new_tokens
+        # compiles it; the timed call is a pure cache hit. (The old
+        # per-token decode_j loop paid ~10 ms of dispatch per token over
+        # the tunnel — the same fix as MoEServer.generate, PERF.md.)
+        # host-read the warmup: the call itself is async and compile can
+        # complete with the execution still queued — an unread warmup
+        # leaks its execution (and, observed on the axon tunnel, a
+        # compile-sized stall) into the timed window
+        np.asarray(generate(params, prompt, dcfg,
+                            max_new_tokens=args.new_tokens,
+                            max_seq=max_seq))
         t0 = time.perf_counter()
-        out = []
-        for _ in range(args.new_tokens):
-            out.append(tok)
-            logits, cache = decode_j(params, tok, cache)
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        out = np.stack([np.asarray(t) for t in out], axis=1)
+        out = np.asarray(generate(
+            params, prompt, dcfg, max_new_tokens=args.new_tokens,
+            max_seq=max_seq,
+        ))
         dt = time.perf_counter() - t0
         print(f"first sequence: {out[0].tolist()}", flush=True)
         print(json.dumps({
@@ -279,8 +280,12 @@ def main(argv=None):
     # SAME new_tokens as the timed run: generate's decode loop is one
     # jitted lax.scan whose length is baked into the program, so a
     # 1-token warmup would compile a different scan and the timed call
-    # would pay the real compile.
-    server.generate(placed, prompt, args.new_tokens, max_seq, impl=args.impl)
+    # would pay the real compile. Host-READ the result: the call is
+    # async, and an unread warmup leaks its execution into the timed
+    # window (see the dense branch note).
+    np.asarray(server.generate(
+        placed, prompt, args.new_tokens, max_seq, impl=args.impl
+    ))
     t0 = time.perf_counter()
     out = server.generate(
         placed, prompt, args.new_tokens, max_seq, impl=args.impl

@@ -215,7 +215,12 @@ def main(argv=None):
     ap.add_argument("--ffn", type=int, default=128)
     ap.add_argument("--experts", type=int, default=8)
     ap.add_argument("--microbatches", type=int, default=1)
-    ap.add_argument("--remat", default="full", choices=["full", "dots", "none"])
+    ap.add_argument(
+        "--remat", default="full", choices=["full", "dots", "mlp", "none"],
+        help="backward recompute schedule (mlp: save the expert GEMMs, "
+        "rematerialize attention — the measured v5e sweet spot for "
+        "--model flagship; for --model dense it is equivalent to dots)",
+    )
     ap.add_argument("--data", default="",
                     help="1-D int token .npy (memmapped); batches are "
                          "next-token windows at step-indexed offsets")

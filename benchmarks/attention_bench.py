@@ -76,9 +76,13 @@ def _time(step, q, k, v, iters=10, warmup=2):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=0)
-    ap.add_argument("--batch", type=int, default=2)
-    ap.add_argument("--heads", type=int, default=8)
-    ap.add_argument("--kv-heads", type=int, default=2)
+    # Defaults mirror the FLAGSHIP head geometry (B=8, NH=16, KV=4,
+    # D=64): the old tiny defaults (B=2, H=8) under-utilized the chip and
+    # produced a flash-vs-XLA crossover that did not transfer to the
+    # model (PERF.md round-5 "Harness lesson")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--kv-heads", type=int, default=4)
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--seqs", default="1024,2048,4096,8192")
     ap.add_argument("--iters", type=int, default=10)
@@ -89,8 +93,9 @@ def main():
         "long sequence until flash beats XLA in its claimed regime)",
     )
     ap.add_argument(
-        "--blocks", default="128,256,512",
-        help="candidate tile sizes for --block-sweep",
+        "--blocks", default="128,256,512,1024",
+        help="candidate tile sizes for --block-sweep (1024 is the "
+        "measured v5e optimum at head_dim 64)",
     )
     ap.add_argument(
         "--skip-xla-bwd-at",

@@ -228,11 +228,20 @@ static void test_teardown_under_load() {
 }
 
 int main() {
-  test_concurrent_writes();
-  test_send_recv_notifs();
-  test_drop_reap();
-  test_concurrent_reads();
-  test_teardown_under_load();
+  // UCCLT_TEST_REPS loops the whole list in-process: rare-interleaving
+  // hunts (the ASan soak that caught a use-after-free only under a
+  // loaded box) get far more schedule rolls per second than re-execing.
+  int reps = 1;
+  if (const char* r = std::getenv("UCCLT_TEST_REPS")) reps = std::atoi(r);
+  for (int rep = 0; rep < reps; ++rep) {
+    test_concurrent_writes();
+    test_send_recv_notifs();
+    test_drop_reap();
+    test_concurrent_reads();
+    test_teardown_under_load();
+    if (reps > 1 && (rep + 1) % 25 == 0)
+      std::printf("rep %d/%d\n", rep + 1, reps), std::fflush(stdout);
+  }
   std::printf("ALL ENGINE TESTS PASSED\n");
   return 0;
 }

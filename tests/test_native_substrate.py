@@ -6,6 +6,7 @@ coverage (§5: "the TPU build can do better cheaply") — so our CI runs the
 threaded substrate tests under ThreadSanitizer too.
 """
 
+import fcntl
 import os
 import shutil
 import subprocess
@@ -16,10 +17,20 @@ _NATIVE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 
 
 def _make(target: str, timeout: int = 300):
-    return subprocess.run(
-        ["make", "-C", _NATIVE, target],
-        capture_output=True, text=True, timeout=timeout,
-    )
+    # Serialize across PROCESSES: two test runs (e.g. a suite and qa.sh
+    # racing) invoking make in one build dir can relink a binary while the
+    # other run executes it — observed as a corrupted sanitizer run. The
+    # lock spans build AND run, since make's targets execute the tests.
+    lock_path = os.path.join(_NATIVE, ".build.lock")
+    with open(lock_path, "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        try:
+            return subprocess.run(
+                ["make", "-C", _NATIVE, target],
+                capture_output=True, text=True, timeout=timeout,
+            )
+        finally:
+            fcntl.flock(lk, fcntl.LOCK_UN)
 
 
 def test_substrate_units():

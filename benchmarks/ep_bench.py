@@ -9,6 +9,8 @@ Usage:
   python benchmarks/ep_bench.py [--devices N] [--tokens T] [--hidden H]
   python benchmarks/ep_bench.py --ll            # low-latency packed path
   python benchmarks/ep_bench.py --table         # E ∈ {8, 32} latency table
+  python benchmarks/ep_bench.py --wire pallas   # device-initiated remote-DMA
+                                                # all-to-all (ep/pallas_a2a)
 """
 
 from __future__ import annotations
@@ -57,7 +59,8 @@ def jax_block(tree):
         np.asarray(x).reshape(-1)[:1]
 
 
-def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8):
+def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8,
+                 wire="auto"):
     """Time dispatch and combine separately for one config. Returns a dict."""
     import jax.numpy as jnp
     import numpy as np
@@ -66,10 +69,21 @@ def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8):
     from uccl_tpu.parallel.mesh import AXIS, MeshConfig, make_mesh
 
     n = len(jax.devices())
-    mesh = make_mesh(MeshConfig(dp=n))
+    if wire == "pallas":
+        # the legacy discharge interpreter can only address single-named-axis
+        # meshes; a 1-axis dp mesh keeps the pallas arm runnable everywhere
+        # (Buffer would otherwise downgrade the wire silently)
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        axis = "dp"
+    else:
+        mesh = make_mesh(MeshConfig(dp=n))
+        axis = AXIS.EP
     experts = max(experts, n)
     experts -= experts % n
-    buf = Buffer(mesh, AXIS.EP, num_experts=experts, num_selected=topk)
+    buf = Buffer(mesh, axis, num_experts=experts, num_selected=topk,
+                 wire=wire)
 
     rng = np.random.default_rng(0)
     x = buf.device_put(
@@ -109,6 +123,7 @@ def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8):
     bytes_per_row = hidden * (1 if fp8 else 4)
     return {
         "mode": mode,
+        "wire": wire,
         "experts": experts,
         "tokens": tokens,
         "hidden": hidden,
@@ -132,6 +147,13 @@ def main():
         "--ll", action="store_true",
         help="packed low-latency path (ragged wire on TPU/GPU, grouped "
              "recv buffers + counts; the DeepEP LL contract)",
+    )
+    ap.add_argument(
+        "--wire", default="auto",
+        choices=["auto", "ragged", "dense", "pallas"],
+        help="EP transport: 'pallas' = device-initiated remote-DMA "
+             "all-to-all (uccl_tpu.ep.pallas_a2a, Buffer wire='pallas'); "
+             "'auto' keeps the XLA-collective resolution",
     )
     ap.add_argument(
         "--table", action="store_true",
@@ -198,10 +220,12 @@ def main():
     r = bench_config(
         jax, tokens=args.tokens, hidden=args.hidden, experts=args.experts,
         topk=args.topk, iters=args.iters, mode=mode, fp8=args.fp8,
+        wire=args.wire,
     )
     print(
         f"EP{n} {mode}: tokens={r['tokens']} hidden={r['hidden']} "
-        f"experts={r['experts']} topk={r['topk']} fp8={args.fp8}"
+        f"experts={r['experts']} topk={r['topk']} fp8={args.fp8} "
+        f"wire={r['wire']}"
     )
     print(
         f"  dispatch {r['dispatch_us']:.1f} us | combine "
@@ -245,8 +269,10 @@ def main():
             xe = ep_ops.dispatch(xv, mask, "dp")
             return ep_ops.combine(xe, weights, "dp")[None]
 
+        from uccl_tpu.utils.jaxcompat import shard_map
+
         dense_fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 dense_f, mesh=mesh, in_specs=(P("dp"), P("dp"), P("dp")),
                 out_specs=P("dp"), check_vma=False,
             )

@@ -41,6 +41,13 @@ timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
 say "1b/9 pallas remote-DMA ring collectives: single-chip Mosaic lowering proof"
 timeout 900 python scripts/pallas_ccl_proof.py 2>&1 | tee -a "$LOG"
 
+say "1c/9 pallas EP all-to-all (wire=pallas): dispatch+combine Mosaic lowering proof + bench arm"
+timeout 900 python scripts/pallas_a2a_proof.py 2>&1 | tee -a "$LOG"
+# world-1 runs exercise the full wire=pallas program path (kernel short-
+# circuits at n=1); the multi-member latency table needs a pod session
+timeout 2400 python benchmarks/ep_bench.py --wire pallas 2>&1 | tee -a "$LOG"
+timeout 2400 python benchmarks/ep_bench.py --ll --fp8 --wire pallas 2>&1 | tee -a "$LOG"
+
 say "2/9 attention sweep (flash vs xla crossover)"
 timeout 2400 python benchmarks/attention_bench.py \
   --seqs 1024,2048,4096,8192 --iters 10 2>&1 | tee -a "$LOG"

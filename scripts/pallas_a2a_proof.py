@@ -1,0 +1,114 @@
+"""Single-chip compile proof for the Pallas EP all-to-all (wire="pallas").
+
+An 8-way all-to-all kernel cannot EXECUTE on one chip, but it can be LOWERED
+for the TPU backend through the full Pallas→Mosaic pipeline using an abstract
+8-device mesh — that exercises kernel tracing, VMEM layout/tiling, the
+full-peer barrier, credit semaphore plumbing and the remote-copy lowering,
+i.e. everything short of the final Mosaic→LLO compile that needs the real
+topology. Covered programs: the normal (sorted) EP dispatch AND combine and
+the LL dense-chunk dispatch AND combine, each on the pallas wire, at f32 and
+bf16 payloads plus the fp8+scales wire format.
+
+(On CPU backends pallas refuses non-interpret lowering, so this is a
+TPU-session artifact; run it from scripts/onchip_ladder.sh, step 1c.)
+
+Prints one line per case; exits nonzero on any failure or if any lowered
+module lacks the ``tpu_custom_call`` the device-initiated path must contain.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import AbstractMesh, PartitionSpec as P
+
+from uccl_tpu.ep import ll as ep_ll
+from uccl_tpu.ep import ops as ep_ops
+from uccl_tpu.utils.jaxcompat import shard_map
+
+W, T, H, E, K = 8, 128, 512, 16, 2
+CAP = max(1, int(1.25 * T * K / E))
+
+
+def _dispatch(x, idx):
+    tfs, _slot, _kept = ep_ops.sorted_from_topk(idx, E, CAP)
+    return ep_ops.dispatch_sorted(x, tfs, E, CAP, "x", wire="pallas")
+
+
+def _dispatch_fp8(x, idx):
+    tfs, _slot, _kept = ep_ops.sorted_from_topk(idx, E, CAP)
+    return ep_ops.dispatch_sorted(x, tfs, E, CAP, "x", wire="pallas",
+                                  wire_fp8=True)
+
+
+def _combine(y, slot, wts):
+    return ep_ops.combine_sorted(y, slot, wts, "x", wire="pallas")
+
+
+def _ll_dispatch(x, idx, wts):
+    r = ep_ll.ll_dispatch(x, idx, wts, E, "x", wire="pallas", wire_fp8=True)
+    return r.recv_x, r.group_sizes
+
+
+def _ll_combine(y, slot, wts, send_mat, recv_mat, regroup, src_off):
+    state = ep_ll.LLState(slot, wts, send_mat, recv_mat, regroup, src_off,
+                          "pallas")
+    return ep_ll.ll_combine(y, state, "x", wire_fp8=True)
+
+
+def main():
+    if jax.default_backend() != "tpu":
+        sys.exit("pallas_a2a_proof: needs a TPU backend (tunnel session)")
+    mesh = AbstractMesh((W,), ("x",))
+    per_pair, r_max = ep_ll.ll_bounds(T, K, E // W, W, None, None)
+    i32, f32 = jnp.int32, jnp.float32
+
+    def S(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    cases = []
+    for dtype in (jnp.float32, jnp.bfloat16):
+        name = jnp.dtype(dtype).name
+        cases += [
+            (f"dispatch_{name}", _dispatch,
+             (S((T, H), dtype), S((T, K), i32)),
+             (P(), P()), P()),
+            (f"combine_{name}", _combine,
+             (S((E // W, W * CAP, H), dtype), S((T, K), i32),
+              S((T, K), f32)),
+             (P(), P(), P()), P()),
+        ]
+    cases += [
+        ("dispatch_fp8_wire", _dispatch_fp8,
+         (S((T, H), jnp.bfloat16), S((T, K), i32)), (P(), P()), P()),
+        ("ll_dispatch_fp8", _ll_dispatch,
+         (S((T, H), jnp.bfloat16), S((T, K), i32), S((T, K), f32)),
+         (P(), P(), P()), (P(), P())),
+        ("ll_combine_fp8", _ll_combine,
+         (S((r_max, H), jnp.bfloat16), S((T, K), i32), S((T, K), f32),
+          S((W, E // W), i32), S((W, E // W), i32), S((r_max,), i32),
+          S((W,), i32)),
+         (P(),) * 7, P()),
+    ]
+
+    failed = 0
+    for name, fn, shapes, in_specs, out_spec in cases:
+        mapped = shard_map(fn, mesh, in_specs, out_spec, check_vma=False)
+        try:
+            txt = jax.jit(mapped).lower(*shapes).as_text()
+            ok = "tpu_custom_call" in txt or "mosaic" in txt.lower()
+            print(f"pallas_a2a_proof {name}: "
+                  f"{'LOWERED' if ok else 'no-custom-call?'} "
+                  f"({len(txt)} chars of StableHLO)")
+            failed += 0 if ok else 1
+        except Exception as e:  # noqa: BLE001 - report-and-continue proof
+            print(f"pallas_a2a_proof {name}: FAILED {e!r}")
+            failed += 1
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,10 +18,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import AbstractMesh, PartitionSpec as P
 
 from uccl_tpu.collective import pallas_ccl
+from uccl_tpu.utils.jaxcompat import shard_map
 
 
 def main():

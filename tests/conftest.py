@@ -22,6 +22,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Version-bridge the jax APIs the codebase targets (jax.shard_map,
+# lax.axis_size, ...) BEFORE any test module imports them — on modern jax
+# this is a no-op, on 0.4.x containers it installs the polyfills.
+import uccl_tpu.utils.jaxcompat  # noqa: E402,F401
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

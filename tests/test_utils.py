@@ -133,3 +133,33 @@ class TestTopology:
         assert topo.recursive_halving_peers(0, 8) == [4, 2, 1]
         with pytest.raises(ValueError):
             topo.recursive_halving_peers(0, 6)
+
+
+class TestTimingPerturb:
+    """uccl_tpu.utils.timing.perturb: the carry coupling must be value-
+    preserving for EVERY carry, or the timing harness silently times a
+    different computation than the one it reports."""
+
+    def test_int_leaves_unchanged_for_negative_carry(self):
+        """Regression (round-5 ADVICE): the int branch used min(carry, 0),
+        which is only zero for non-negative carries — a slope carry that
+        drifts negative (reductions of signed outputs do) mutated every int
+        leaf it coupled. min(|carry|, 0) is provably zero for any carry."""
+        import jax.numpy as jnp
+
+        from uccl_tpu.utils.timing import perturb
+
+        a = jnp.arange(6, dtype=jnp.int32)
+        for carry in (-3.7, -1.0, 0.0, 2.5):
+            out = perturb(a, jnp.float32(carry))
+            assert out.dtype == a.dtype
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(a))
+
+    def test_float_coupling_negligible(self):
+        import jax.numpy as jnp
+
+        from uccl_tpu.utils.timing import perturb
+
+        a = jnp.ones((4,), jnp.float32)
+        out = perturb(a, jnp.float32(-2.0))
+        np.testing.assert_allclose(np.asarray(out), np.ones(4), rtol=1e-6)

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from uccl_tpu.utils.jaxcompat import shard_map
 
 from uccl_tpu.parallel.mesh import AXIS, get_mesh, mesh_axis_size
 from uccl_tpu.utils.logging import get_logger

@@ -1,0 +1,173 @@
+"""Shared building blocks for the device-level Pallas remote-DMA kernels.
+
+Factored out of :mod:`uccl_tpu.collective.pallas_ccl` (the ring collectives)
+so the EP all-to-all kernels (:mod:`uccl_tpu.ep.pallas_a2a`) reuse the exact
+machinery the rings proved on the real v5e: chunk padding to VPU tiles,
+MESH-coordinate neighbor addressing, the interpret-mode resolution and its
+single-core-host payload ceiling, the VMEM budget gate, and the entry
+barriers. The synchronization *design* (write-once slots, 2-deep semaphore
+rotation, credit-granted flow control) lives with each kernel — the slot
+arithmetic differs between a ring and an all-to-all — but the primitives and
+constants here are the common substrate.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.pallas import tpu as pltpu
+
+from uccl_tpu.utils import config as _config
+from uccl_tpu.utils import jaxcompat as _jc
+
+LANES = 128
+# Pad each chunk to a multiple of 8x128 elements (one f32 sublane tile;
+# Mosaic masks the partial tile for narrower dtypes). Kept small on purpose:
+# the TPU interpreter backing the CPU tests deadlocks when a single
+# interpret-mode buffer reaches ~128 KiB on a 1-core host (XLA:CPU runs the
+# buffer-init callback on the same starved pool a blocking semaphore-wait
+# callback occupies — measured threshold between 96 and 128 KiB), so small
+# payloads must not be padded into that range.
+CHUNK_QUANTUM = 8 * LANES
+
+MAX_VMEM_BYTES = _config.param(
+    "PALLAS_CCL_MAX_BYTES",
+    8 << 20,
+    int,
+    "per-shard payload ceiling for the VMEM-resident pallas remote-DMA"
+    " kernels (ring collectives and the EP all-to-all); larger buffers fall"
+    " back to the XLA collective lowering",
+)
+MAX_INTERP_BYTES = _config.param(
+    "PALLAS_CCL_INTERP_MAX_BYTES",
+    64 << 10,
+    int,
+    "payload ceiling when running under the TPU interpreter (CPU tests): "
+    "single-core hosts deadlock interpret-mode buffers around 128 KiB, so "
+    "bigger payloads fall back to the XLA lowering there",
+)
+
+MESH = pltpu.DeviceIdType.MESH
+
+
+def pad_chunks(flat: jax.Array, parts: int) -> Tuple[jax.Array, int, int]:
+    """Split ``flat`` into ``parts`` equal chunks of k elements (tail
+    zero-padded), then pad EACH chunk to m (a CHUNK_QUANTUM multiple) — the
+    chunk boundaries are semantic (DMA slots), so padding must be per-chunk,
+    not appended to the buffer tail. Returns ([parts, m//128, 128], k, m)."""
+    k = -(-flat.size // parts)
+    m = -(-k // CHUNK_QUANTUM) * CHUNK_QUANTUM
+    tail = parts * k - flat.size
+    if tail:
+        flat = jnp.concatenate([flat, jnp.zeros((tail,), flat.dtype)])
+    x2 = flat.reshape(parts, k)
+    if m > k:
+        x2 = jnp.pad(x2, ((0, 0), (0, m - k)))
+    return x2.reshape(parts, m // LANES, LANES), k, m
+
+
+def interpret_default() -> bool:
+    """Real Mosaic lowering only exists on TPU backends; anywhere else the
+    kernels run under the TPU interpreter (which simulates remote DMAs and
+    semaphores faithfully on host devices)."""
+    return jax.default_backend() != "tpu"
+
+
+def resolve_interpret(interpret) -> bool:
+    return interpret_default() if interpret is None else bool(interpret)
+
+
+# pallas_call's interpret= value and the compiler params, version-bridged
+# (uccl_tpu.utils.jaxcompat): the faithful InterpretParams interpreter on
+# modern jax, the legacy discharge interpreter (plain True) on jax 0.4.x.
+interp = _jc.tpu_interpret_params
+compiler_params = _jc.tpu_compiler_params
+
+
+def faithful_sync(interpret: bool) -> bool:
+    """True when semaphore/barrier traffic is real: compiled Mosaic, or the
+    faithful InterpretParams interpreter. False under the legacy discharge
+    interpreter (jax 0.4.x), where remote semaphore signals are not
+    implemented — but where every remote DMA discharges into a synchronous
+    cross-device gather, so per-DMA global ordering (and thus correctness of
+    the data movement) is implied and the elided sync is not load-bearing."""
+    return not (interpret and not _jc.FAITHFUL_PALLAS_INTERPRET)
+
+
+def neighbors(axis, n: int, d: int):
+    r = lax.axis_index(axis)
+    right = lax.rem(r + d + n, n)
+    left = lax.rem(r - d + n, n)
+    return r, right, left
+
+
+def mesh_id(axis, idx):
+    """Address a peer by mesh coordinate on the collective axis only — the
+    other mesh axes default to this device's own coordinates, so kernels work
+    on any axis of any mesh (the sub-axis case of a pp×dp×cp×tp mesh). A
+    tuple axis (e.g. the EP world over ("dp", "cp")) decomposes the flat
+    index row-major, matching lax.axis_index's linearization."""
+    if isinstance(axis, (tuple, list)):
+        out = {}
+        rem = idx
+        for a in reversed(axis):
+            s = lax.axis_size(a)
+            out[a] = lax.rem(rem, s)
+            rem = rem // s
+        return out
+    return {axis: idx}
+
+
+def remote_kwargs(axis, idx, faithful: bool) -> dict:
+    """device_id kwargs for make_async_remote_copy / semaphore_signal.
+
+    Faithful mode addresses by MESH coordinates (sub-axis safe). The legacy
+    discharge interpreter supports neither MESH dicts nor multi-axis meshes —
+    there the flat index along the (single) shard axis IS the logical id."""
+    if faithful:
+        return dict(device_id=mesh_id(axis, idx), device_id_type=MESH)
+    return dict(device_id=idx, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def ring_barrier(axis, left, right):
+    """Neighbor barrier: both ring neighbors' kernels are live (skew along
+    the ring is then bounded transitively by the data dependencies)."""
+    sem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(sem, inc=1, device_id=mesh_id(axis, left),
+                           device_id_type=MESH)
+    pltpu.semaphore_signal(sem, inc=1, device_id=mesh_id(axis, right),
+                           device_id_type=MESH)
+    pltpu.semaphore_wait(sem, 2)
+
+
+def all_barrier(axis, n: int):
+    """Full-peer barrier: every member's kernel is live. The all-to-all
+    pattern needs this stronger form — its very first DMA may target ANY
+    peer's buffers, so neighbor liveness (transitive, eventually) is not
+    enough at the moment the DMA issues."""
+    sem = pltpu.get_barrier_semaphore()
+    r = lax.axis_index(axis)
+    for i in range(1, n):
+        pltpu.semaphore_signal(
+            sem, inc=1, device_id=mesh_id(axis, lax.rem(r + i, n)),
+            device_id_type=MESH,
+        )
+    pltpu.semaphore_wait(sem, n - 1)
+
+
+def check_budget(nbytes: int, what: str, interpret: bool) -> bool:
+    limit = MAX_VMEM_BYTES.get()
+    if interpret:
+        limit = min(limit, MAX_INTERP_BYTES.get())
+    if nbytes > limit:
+        from uccl_tpu.utils.logging import log
+
+        log("INFO", "CCL",
+            f"pallas {what}: {nbytes}B exceeds "
+            f"{'interpreter' if interpret else 'VMEM'} budget {limit}B; "
+            "falling back to the XLA collective lowering")
+        return False
+    return True

@@ -40,106 +40,40 @@ Synchronization design (the part that must be right):
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from uccl_tpu.utils import config as _config
+from uccl_tpu.collective import dma as _dma
 
-_LANES = 128
-# Pad each chunk to a multiple of 8x128 elements (one f32 sublane tile;
-# Mosaic masks the partial tile for narrower dtypes). Kept small on purpose:
-# the TPU interpreter backing the CPU tests deadlocks when a single
-# interpret-mode buffer reaches ~128 KiB on a 1-core host (XLA:CPU runs the
-# buffer-init callback on the same starved pool a blocking semaphore-wait
-# callback occupies — measured threshold between 96 and 128 KiB), so small
-# payloads must not be padded into that range.
-_CHUNK_QUANTUM = 8 * _LANES
-
-_MAX_VMEM_BYTES = _config.param(
-    "PALLAS_CCL_MAX_BYTES",
-    8 << 20,
-    int,
-    "per-shard payload ceiling for the VMEM-resident pallas ring collectives;"
-    " larger buffers fall back to the lax.ppermute plan lowering",
-)
-_MAX_INTERP_BYTES = _config.param(
-    "PALLAS_CCL_INTERP_MAX_BYTES",
-    64 << 10,
-    int,
-    "payload ceiling when running under the TPU interpreter (CPU tests): "
-    "single-core hosts deadlock interpret-mode buffers around 128 KiB, so "
-    "bigger payloads fall back to the plan lowering there",
-)
+# Shared substrate (uccl_tpu.collective.dma) — also used by the EP
+# all-to-all kernels (uccl_tpu.ep.pallas_a2a). The underscored aliases keep
+# this module's long-standing surface (tests reset _MAX_VMEM_BYTES, etc.).
+_LANES = _dma.LANES
+_CHUNK_QUANTUM = _dma.CHUNK_QUANTUM
+_MAX_VMEM_BYTES = _dma.MAX_VMEM_BYTES
+_MAX_INTERP_BYTES = _dma.MAX_INTERP_BYTES
+_MESH = _dma.MESH
+_pad_chunks = _dma.pad_chunks
+_interpret_default = _dma.interpret_default
+_resolve_interpret = _dma.resolve_interpret
+_interp = _dma.interp
+_neighbors = _dma.neighbors
+_mesh_id = _dma.mesh_id
+_barrier = _dma.ring_barrier
 
 
-def _pad_chunks(flat: jax.Array, parts: int) -> Tuple[jax.Array, int, int]:
-    """Split ``flat`` into ``parts`` equal chunks of k elements (tail
-    zero-padded), then pad EACH chunk to m (a _CHUNK_QUANTUM multiple) — the
-    chunk boundaries are semantic (ring slots), so padding must be per-chunk,
-    not appended to the buffer tail. Returns ([parts, m//128, 128], k, m)."""
-    k = -(-flat.size // parts)
-    m = -(-k // _CHUNK_QUANTUM) * _CHUNK_QUANTUM
-    tail = parts * k - flat.size
-    if tail:
-        flat = jnp.concatenate([flat, jnp.zeros((tail,), flat.dtype)])
-    x2 = flat.reshape(parts, k)
-    if m > k:
-        x2 = jnp.pad(x2, ((0, 0), (0, m - k)))
-    return x2.reshape(parts, m // _LANES, _LANES), k, m
-
-
-def _interpret_default() -> bool:
-    """Real Mosaic lowering only exists on TPU backends; anywhere else the
-    kernels run under the TPU interpreter (which simulates remote DMAs and
-    semaphores faithfully on host devices)."""
-    return jax.default_backend() != "tpu"
-
-
-def _resolve_interpret(interpret) -> bool:
-    return _interpret_default() if interpret is None else bool(interpret)
-
-
-def _interp(interpret: bool):
-    return pltpu.InterpretParams() if interpret else False
-
-
-def _neighbors(axis, n: int, d: int):
-    r = lax.axis_index(axis)
-    right = lax.rem(r + d + n, n)
-    left = lax.rem(r - d + n, n)
-    return r, right, left
-
-
-def _mesh_id(axis, idx):
-    """Address a neighbor by mesh coordinate on the ring axis only — the
-    other mesh axes default to this device's own coordinates, so rings work
-    on any axis of any mesh (the sub-axis case of a pp×dp×cp×tp mesh)."""
-    return {axis: idx}
-
-
-_MESH = pltpu.DeviceIdType.MESH
-
-
-def _barrier(axis, left, right):
-    sem = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(sem, inc=1, device_id=_mesh_id(axis, left),
-                           device_id_type=_MESH)
-    pltpu.semaphore_signal(sem, inc=1, device_id=_mesh_id(axis, right),
-                           device_id_type=_MESH)
-    pltpu.semaphore_wait(sem, 2)
-
-
-def _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem):
+def _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem,
+              faithful=True):
     """All-gather rings on ``buf_ref[:, h]`` for each stream h (one ring per
     direction in ``dirs``, all DMAs of a step issued before any wait): n-1
     steps of direct buf→buf remote DMA — chunk j lives at slot j on every
     member, so the destination slot equals the source slot and every slot is
-    write-once."""
+    write-once. ``faithful`` is static: the legacy discharge interpreter
+    (jax 0.4.x) implements no remote semaphore signals, so the credit
+    traffic is elided there — subsumed by its per-DMA global ordering."""
     nbrs = [_neighbors(axis, n, d) for d in dirs]
 
     def step(s, _):
@@ -148,9 +82,11 @@ def _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem):
             r, right, _left = nbrs[h]
             send_slot = lax.rem(r - d * s + s * n + n, n)
 
-            @pl.when(s >= 2)
-            def _(h=h):  # credit from downstream: slot s%2 consumed
-                pltpu.semaphore_wait(ack_sem.at[h], 1)
+            if faithful:
+
+                @pl.when(s >= 2)
+                def _(h=h):  # credit from downstream: slot s%2 consumed
+                    pltpu.semaphore_wait(ack_sem.at[h], 1)
 
             sl = lax.rem(s, 2)
             rdma = pltpu.make_async_remote_copy(
@@ -158,8 +94,7 @@ def _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem):
                 dst_ref=buf_ref.at[send_slot, h],
                 send_sem=send_sem.at[h, sl],
                 recv_sem=recv_sem.at[h, sl],
-                device_id=_mesh_id(axis, right),
-                device_id_type=_MESH,
+                **_dma.remote_kwargs(axis, right, faithful),
             )
             rdma.start()
             descs.append(rdma)
@@ -167,12 +102,14 @@ def _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem):
             _r, _right, left = nbrs[h]
             descs[h].wait_recv()  # slot (r - d(s+1)) arrived
 
-            @pl.when(s <= n - 4)
-            def _(h=h, left=left):  # grant upstream its step-(s+2) send
-                pltpu.semaphore_signal(
-                    ack_sem.at[h], inc=1,
-                    device_id=_mesh_id(axis, left), device_id_type=_MESH,
-                )
+            if faithful:
+
+                @pl.when(s <= n - 4)
+                def _(h=h, left=left):  # grant upstream its step-(s+2) send
+                    pltpu.semaphore_signal(
+                        ack_sem.at[h], inc=1,
+                        **_dma.remote_kwargs(axis, left, faithful),
+                    )
 
         for rdma in descs:
             rdma.wait_send()
@@ -182,11 +119,11 @@ def _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem):
 
 
 def _rs_phase(axis, n, dirs, buf_ref, stage_ref, send_sem, recv_sem,
-              ack_sem):
+              ack_sem, faithful=True):
     """Reduce-scatter rings on ``buf_ref[:, h]`` per stream: partial sums
     circulate through 2-slot staging; member r ends holding slot r fully
     reduced. Slot arithmetic matches plan.plan_reduce_scatter
-    (send_off=-(s+1), recv_off=-(s+2))."""
+    (send_off=-(s+1), recv_off=-(s+2)). ``faithful``: see :func:`_ag_phase`."""
     nbrs = [_neighbors(axis, n, d) for d in dirs]
 
     def step(s, _):
@@ -195,9 +132,11 @@ def _rs_phase(axis, n, dirs, buf_ref, stage_ref, send_sem, recv_sem,
             r, right, _left = nbrs[h]
             send_slot = lax.rem(r - d * (s + 1) + (s + 1) * n + n, n)
 
-            @pl.when(s >= 2)
-            def _(h=h):  # credit: downstream consumed its staging slot s%2
-                pltpu.semaphore_wait(ack_sem.at[h], 1)
+            if faithful:
+
+                @pl.when(s >= 2)
+                def _(h=h):  # credit: downstream consumed staging slot s%2
+                    pltpu.semaphore_wait(ack_sem.at[h], 1)
 
             sl = lax.rem(s, 2)
             rdma = pltpu.make_async_remote_copy(
@@ -205,8 +144,7 @@ def _rs_phase(axis, n, dirs, buf_ref, stage_ref, send_sem, recv_sem,
                 dst_ref=stage_ref.at[h, sl],
                 send_sem=send_sem.at[h, sl],
                 recv_sem=recv_sem.at[h, sl],
-                device_id=_mesh_id(axis, right),
-                device_id_type=_MESH,
+                **_dma.remote_kwargs(axis, right, faithful),
             )
             rdma.start()
             descs.append(rdma)
@@ -220,12 +158,14 @@ def _rs_phase(axis, n, dirs, buf_ref, stage_ref, send_sem, recv_sem,
                 buf_ref[recv_slot, h] + stage_ref[h, sl]
             )
 
-            @pl.when(s <= n - 4)
-            def _(h=h, left=left):  # staging consumed — grant step s+2
-                pltpu.semaphore_signal(
-                    ack_sem.at[h], inc=1,
-                    device_id=_mesh_id(axis, left), device_id_type=_MESH,
-                )
+            if faithful:
+
+                @pl.when(s <= n - 4)
+                def _(h=h, left=left):  # staging consumed — grant step s+2
+                    pltpu.semaphore_signal(
+                        ack_sem.at[h], inc=1,
+                        **_dma.remote_kwargs(axis, left, faithful),
+                    )
 
         for rdma in descs:
             rdma.wait_send()
@@ -247,19 +187,7 @@ def _scratch(n_streams, rows, dtype, with_staging):
     return shapes
 
 
-def _check_budget(nbytes: int, what: str, interpret: bool) -> bool:
-    limit = _MAX_VMEM_BYTES.get()
-    if interpret:
-        limit = min(limit, _MAX_INTERP_BYTES.get())
-    if nbytes > limit:
-        from uccl_tpu.utils.logging import log
-
-        log("INFO", "CCL",
-            f"pallas {what}: {nbytes}B exceeds "
-            f"{'interpreter' if interpret else 'VMEM'} budget {limit}B; "
-            "falling back to the ppermute plan lowering")
-        return False
-    return True
+_check_budget = _dma.check_budget
 
 
 def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
@@ -280,13 +208,15 @@ def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
     flat = x.reshape(-1)
     chunk, _, m = _pad_chunks(flat, 1)  # [1, rows, 128]
     rows = m // _LANES
+    faithful = _dma.faithful_sync(interpret)
 
     def kernel(x_ref, buf_ref, send_sem, recv_sem, ack_sem):
         r, right, left = _neighbors(axis, n, direction)
-        _barrier(axis, left, right)
+        if faithful:
+            _barrier(axis, left, right)
         buf_ref[r, 0] = x_ref[0]
         _ag_phase(axis, n, (direction,), buf_ref, send_sem, recv_sem,
-                  ack_sem)
+                  ack_sem, faithful)
 
     buf = pl.pallas_call(
         kernel,
@@ -294,9 +224,7 @@ def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=_scratch(1, rows, x.dtype, with_staging=False),
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=collective_id
-        ),
+        compiler_params=_dma.compiler_params(collective_id),
         interpret=_interp(interpret),
     )(chunk)
     out = buf.reshape(n, m)[:, : flat.size]
@@ -324,14 +252,16 @@ def ring_reduce_scatter(x: jax.Array, axis, *, direction: int = 1,
     chunks, per, m = _pad_chunks(x.reshape(-1), n)  # [n, rows, 128]
     rows = m // _LANES
     chunks = chunks.reshape(n, 1, rows, _LANES)
+    faithful = _dma.faithful_sync(interpret)
 
     def kernel(x_ref, out_ref, buf_ref, stage_ref, send_sem, recv_sem,
                ack_sem):
         r, right, left = _neighbors(axis, n, direction)
-        _barrier(axis, left, right)
+        if faithful:
+            _barrier(axis, left, right)
         buf_ref[...] = x_ref[...]
         _rs_phase(axis, n, (direction,), buf_ref, stage_ref, send_sem,
-                  recv_sem, ack_sem)
+                  recv_sem, ack_sem, faithful)
         out_ref[...] = buf_ref[r, 0]
 
     out = pl.pallas_call(
@@ -341,9 +271,7 @@ def ring_reduce_scatter(x: jax.Array, axis, *, direction: int = 1,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((n, 1, rows, _LANES), x.dtype)]
         + _scratch(1, rows, x.dtype, with_staging=True),
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=collective_id
-        ),
+        compiler_params=_dma.compiler_params(collective_id),
         interpret=_interp(interpret),
     )(chunks)
     return out.reshape(-1)[:per].reshape((k,) + x.shape[1:])
@@ -373,20 +301,24 @@ def ring_all_reduce(x: jax.Array, axis, *, bidirectional: bool = True,
     view, k, m = _pad_chunks(flat, n * n_streams)
     rows = m // _LANES
     view = view.reshape(n, n_streams, rows, _LANES)
+    faithful = _dma.faithful_sync(interpret)
 
     def kernel(x_ref, buf_ref, stage_ref, send_sem, recv_sem, ack_sem):
         r = lax.axis_index(axis)
         right = lax.rem(r + 1, n)
         left = lax.rem(r - 1 + n, n)
-        _barrier(axis, left, right)
+        if faithful:
+            _barrier(axis, left, right)
         buf_ref[...] = x_ref[...]
         _rs_phase(axis, n, dirs, buf_ref, stage_ref, send_sem, recv_sem,
-                  ack_sem)
+                  ack_sem, faithful)
         # Phase barrier: my AG write into a neighbor's buf slot must land
         # after that neighbor's RS sends from it have drained (its RS loop
         # waits every send_sem, so "RS done" implies the reads completed).
-        _barrier(axis, left, right)
-        _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem)
+        if faithful:
+            _barrier(axis, left, right)
+        _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem,
+                  faithful)
 
     buf = pl.pallas_call(
         kernel,
@@ -394,9 +326,7 @@ def ring_all_reduce(x: jax.Array, axis, *, bidirectional: bool = True,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=_scratch(n_streams, rows, x.dtype, with_staging=True),
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=collective_id
-        ),
+        compiler_params=_dma.compiler_params(collective_id),
         interpret=_interp(interpret),
     )(view)
     out = buf.reshape(n * n_streams, m)[:, :k]

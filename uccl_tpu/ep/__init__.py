@@ -13,6 +13,10 @@ Surfaces:
 * :mod:`uccl_tpu.ep.ops`    — per-shard routing/dispatch/combine for shard_map code.
 * :mod:`uccl_tpu.ep.ll`     — packed low-latency path: ragged wire + grouped
   GEMMs over receive counts (the DeepEP LL contract, internode_ll.cu analog).
+* :mod:`uccl_tpu.ep.pallas_a2a` — device-initiated all-to-all: the member-major
+  exchange as ONE Pallas kernel issuing inter-chip remote DMAs (write-once
+  per-source slots, credit-granted flow control) — selected via
+  ``Buffer(..., wire="pallas")`` for both the normal and LL row formats.
 * :class:`uccl_tpu.ep.Buffer` — DeepEP-shaped host API (dispatch / combine /
   low_latency_dispatch / low_latency_combine / get_dispatch_layout), including
   the overlap half of the contract: :class:`uccl_tpu.ep.EventOverlap`
@@ -20,7 +24,7 @@ Surfaces:
   (return_recv_hook), and :class:`uccl_tpu.ep.Config` tuning hints.
 """
 
-from uccl_tpu.ep import ll, ops
+from uccl_tpu.ep import ll, ops, pallas_a2a
 from uccl_tpu.ep.buffer import Buffer, Config, EventOverlap, LowLatencyHandle
 from uccl_tpu.ep.cross_pod import CrossPodMoE
 from uccl_tpu.ep.elastic import ElasticBuffer, ElasticKVCache
@@ -29,6 +33,7 @@ from uccl_tpu.ep.engram import EngramTable, mesh_fetch
 __all__ = [
     "ops",
     "ll",
+    "pallas_a2a",
     "Buffer",
     "Config",
     "EventOverlap",

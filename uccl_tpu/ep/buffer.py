@@ -21,7 +21,9 @@ from typing import Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from uccl_tpu.utils.jaxcompat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from uccl_tpu.ep import ll as ep_ll
@@ -81,11 +83,16 @@ class Config:
     ep/bench/buffer.py:741-796. SM counts and NVL/RDMA chunk depths have no
     TPU meaning; the knobs that do are the wire form, fp8 packing, and
     recv-buffer sizing. A Config only fills knobs the caller left unset —
-    an explicit keyword always wins."""
+    an explicit keyword always wins.
+
+    ``wire`` picks the transport: ``ragged``/``dense`` are the LL layouts on
+    XLA collectives, ``pallas`` is the device-initiated remote-DMA
+    all-to-all (:mod:`uccl_tpu.ep.pallas_a2a`; applies to BOTH the normal
+    and LL verbs), ``auto`` defers to the Buffer/backend resolution."""
 
     max_tokens_per_rank: Optional[int] = None  # LL recv-buffer sizing
     pair_capacity_factor: Optional[float] = None  # dense-wire pair capacity
-    wire: str = "auto"  # ragged | dense | auto
+    wire: str = "auto"  # ragged | dense | pallas | auto
     wire_fp8: bool = True
 
 
@@ -98,11 +105,16 @@ class DispatchHandle(NamedTuple):
     entry [w, s, le] is how many of source s's rows landed for shard w's
     local expert le — i.e. the occupancy of the [s*C, s*C+C) chunk of
     ``recv_x[w, le]``. A consumer can skip empty slots or size grouped GEMMs
-    from it instead of assuming full capacity."""
+    from it instead of assuming full capacity.
+
+    ``wire`` records which transport carried dispatch ("lax" XLA collective
+    or "pallas" device-initiated remote DMA) so combine retraces the same
+    path without re-resolving — the same role LowLatencyHandle.wire plays."""
 
     slot: jax.Array  # [W, T, K] int32 slot per assignment (E*C = dropped)
     weights: jax.Array  # [W, T, K] f32 gate weights
     recv_counts: jax.Array  # [W, W_src, E_local] int32 (always populated)
+    wire: str = "lax"  # lax | pallas (defaulted: pre-wire handles pickle)
 
 
 class LowLatencyHandle(NamedTuple):
@@ -126,7 +138,15 @@ class Buffer:
 
     Args mirror the reference Buffer's construction knobs (group/world implied
     by the mesh; hidden size checked at call time; capacity via factor).
-    """
+
+    ``wire`` selects the transport every verb rides unless a call overrides
+    it: ``"auto"`` keeps today's resolution (XLA collectives; ragged LL wire
+    where the backend lowers it), ``"pallas"`` routes the member-major
+    exchanges of BOTH the normal (sorted) and low-latency row formats
+    through the device-initiated remote-DMA all-to-all kernel
+    (:mod:`uccl_tpu.ep.pallas_a2a`), keeping ``lax`` as the transparent
+    fallback past its VMEM budget or where the kernel cannot address the
+    mesh (legacy interpreters on multi-axis meshes)."""
 
     def __init__(
         self,
@@ -136,6 +156,7 @@ class Buffer:
         num_experts: int,
         num_selected: int = 2,
         capacity_factor: float = 1.25,
+        wire: str = "auto",
     ):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.axes = (axis,) if isinstance(axis, str) else tuple(axis)
@@ -144,10 +165,16 @@ class Buffer:
             raise ValueError(
                 f"num_experts {num_experts} must divide EP world {self.world}"
             )
+        if wire not in ("auto", "ragged", "dense", "pallas"):
+            raise ValueError(
+                f"unknown wire {wire!r} (want 'auto', 'ragged', 'dense', or "
+                "'pallas')"
+            )
         self.num_experts = num_experts
         self.num_local_experts = num_experts // self.world
         self.num_selected = num_selected
         self.capacity_factor = capacity_factor
+        self.wire = wire
         self._cache = {}
         # per-op stats (reference: EP Stats bound at uccl_ep.cc:2411 and the
         # dispatch_wait_recv_cost_stats tensor plumbed through
@@ -164,6 +191,36 @@ class Buffer:
     # ------------------------------------------------------------------
     def _axis_name(self):
         return self.axes if len(self.axes) > 1 else self.axes[0]
+
+    def _pallas_wire_ok(self) -> bool:
+        """Whether the Pallas all-to-all can address this mesh in the mode
+        it would trace under: always, for real Mosaic lowering or the
+        faithful TPU interpreter; on the legacy discharge interpreter (jax
+        0.4.x CPU runs) only single-named-axis meshes are addressable."""
+        from uccl_tpu.collective import dma
+
+        return (
+            dma.faithful_sync(dma.interpret_default())
+            or len(self.mesh.axis_names) == 1
+        )
+
+    def _resolve_wire(self, requested, config) -> str:
+        """Effective wire for a verb: explicit call value, else the Config,
+        else the Buffer's. "pallas" downgrades to "auto" (with a log) where
+        the kernel cannot address the mesh, so the surface stays
+        transparent."""
+        wire = requested if requested is not None else "auto"
+        if wire == "auto" and config is not None:
+            wire = config.wire
+        if wire == "auto":
+            wire = self.wire
+        if wire == "pallas" and not self._pallas_wire_ok():
+            _log.info(
+                "wire='pallas' cannot address a multi-axis mesh under the "
+                "legacy interpret mode; falling back to the XLA wire"
+            )
+            wire = "auto"
+        return wire
 
     def _spec(self, extra_dims: int) -> P:
         return P(self.axes, *([None] * extra_dims))
@@ -331,13 +388,19 @@ class Buffer:
                 "allocate_on_comm_stream requires previous_event and "
                 "async_finish (reference precondition, buffer.py:826)"
             )
+        # "pallas" = device-initiated remote-DMA all-to-all; else the XLA
+        # collective ("ragged"/"dense" are LL-layout knobs, not this path's)
+        wire = (
+            "pallas" if self._resolve_wire(None, config) == "pallas"
+            else "lax"
+        )
         w, t, h = x.shape
         k = topk_idx.shape[-1]
         cap = self.capacity(t)
         e = self.num_experts
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
-        key = ("dispatch", x.shape, topk_idx.shape, wire_fp8, x.dtype,
+        key = ("dispatch", x.shape, topk_idx.shape, wire_fp8, x.dtype, wire,
                has_ev and (tok.shape, tok.dtype))
 
         def f(xv, idx, *tok_arg):
@@ -350,7 +413,7 @@ class Buffer:
             token_for_slot, slot, kept = ep_ops.sorted_from_topk(idx, e, cap)
             recv = ep_ops.dispatch_sorted(
                 xv, token_for_slot, e, cap, self._axis_name(),
-                wire_fp8=wire_fp8,
+                wire_fp8=wire_fp8, wire=wire,
             )
             # per-(source, local-expert) received-row counts: kept[E] is MY
             # contribution per global expert; the all_to_all hands each
@@ -374,7 +437,7 @@ class Buffer:
         self._op_counts["dispatch"] += 1
         self._last_dispatch = (topk_idx, cap)
         # weights go straight into the handle (combine reshards them itself)
-        handle = DispatchHandle(slot, topk_weights, recv_counts)
+        handle = DispatchHandle(slot, topk_weights, recv_counts, wire)
         if async_finish:
             return recv, handle, EventOverlap((recv, slot, recv_counts))
         return recv, handle
@@ -392,7 +455,8 @@ class Buffer:
     ):
         """expert_out: [W, E_local, W*C, H] → [W, T, H] (plus an
         :class:`EventOverlap` when ``async_finish``); overlap knobs as in
-        :meth:`dispatch` (``config``: see :meth:`get_combine_config`)."""
+        :meth:`dispatch` (``config``: see :meth:`get_combine_config`). The
+        reverse exchange rides the wire the handle's dispatch used."""
         if wire_fp8 is None:
             wire_fp8 = config.wire_fp8 if config is not None else False
         if allocate_on_comm_stream and not (
@@ -402,16 +466,18 @@ class Buffer:
                 "allocate_on_comm_stream requires previous_event and "
                 "async_finish (reference precondition, buffer.py:826)"
             )
+        wire = handle.wire
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
         key = ("combine", expert_out.shape, handle.slot.shape, wire_fp8,
-               has_ev and (tok.shape, tok.dtype))
+               wire, has_ev and (tok.shape, tok.dtype))
 
         def f(y, slot, wts, *tok_arg):
             if tok_arg:
                 y = _tie(y, tok_arg[0])
             out = ep_ops.combine_sorted(
-                y[0], slot[0], wts[0], self._axis_name(), wire_fp8=wire_fp8
+                y[0], slot[0], wts[0], self._axis_name(),
+                wire_fp8=wire_fp8, wire=wire,
             )
             return out[None]
 
@@ -478,6 +544,9 @@ class Buffer:
             wire_fp8 = True  # the LL default (fp8 wire, internode_ll.cu)
         w, t, h = x.shape
         k = topk_idx.shape[-1]
+        # Buffer-level default + the pallas addressability gate (config was
+        # already applied by the fill block above)
+        wire = self._resolve_wire(wire, None)
         if wire == "auto":
             wire = "ragged" if ep_ll.wire_supports_ragged() else "dense"
         if topk_weights is None:

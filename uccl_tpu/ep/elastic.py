@@ -37,9 +37,14 @@ def _nbytes(arr) -> int:
 def _memory_shardings(device) -> Tuple[SingleDeviceSharding, SingleDeviceSharding, bool]:
     """(device_sharding, host_sharding, has_host) for one device. Backends
     without a pinned_host memory space degrade to device-only placement —
-    the elastic API keeps working, spills just stay in HBM."""
-    device_s = SingleDeviceSharding(device, memory_kind="device")
+    the elastic API keeps working, spills just stay in HBM. The device-side
+    kind is probed rather than assumed: some CPU backends expose only
+    ``unpinned_host`` and reject the literal ``"device"`` kind."""
     kinds = {m.kind for m in device.addressable_memories()}
+    dev_kind = (
+        "device" if "device" in kinds else device.default_memory().kind
+    )
+    device_s = SingleDeviceSharding(device, memory_kind=dev_kind)
     if "pinned_host" in kinds:
         return device_s, SingleDeviceSharding(device, memory_kind="pinned_host"), True
     return device_s, device_s, False

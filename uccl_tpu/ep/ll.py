@@ -17,7 +17,11 @@ CPU proxy, here:
   backends without ragged collectives (XLA:CPU) a dense-chunked
   ``lax.all_to_all`` carries the same packed layout inside fixed-size per-pair
   chunks (padding on the wire, still none on the MXU) — and that path is
-  fully differentiable, making it the training-grade ragged MoE;
+  fully differentiable, making it the training-grade ragged MoE. A third
+  form, ``wire="pallas"``, keeps the dense-chunk layout but issues the
+  exchange as device-initiated remote DMAs from ONE Pallas kernel
+  (:mod:`uccl_tpu.ep.pallas_a2a` — the TPU analog of internode_ll's
+  proxy-posted RDMA writes, selected via ``Buffer(..., wire="pallas")``);
 * the *grouped GEMM* is ``lax.ragged_dot`` over the receive counts
   (megablocks-style): FLOPs proportional to real tokens, not capacity.
 
@@ -234,20 +238,32 @@ def _dense_exchange(rows, w: int, axis):
     ).reshape(shape)
 
 
+def _pallas_exchange(rows, w: int, axis):
+    """The dense-chunk layout on the device-initiated wire: same [W*per_pair,
+    ...] contract as :func:`_dense_exchange`, but the member-major exchange is
+    the Pallas remote-DMA all-to-all kernel (uccl_tpu.ep.pallas_a2a) instead
+    of an XLA collective."""
+    from uccl_tpu.ep import pallas_a2a
+
+    shape = rows.shape
+    return pallas_a2a.all_to_all(
+        rows.reshape(w, shape[0] // w, *shape[1:]), axis
+    ).reshape(shape)
+
+
 def _send_payload(send_rows, out_rows, w, spec, wire, axis, fp8_group, dtype):
     """Move a row payload across the wire, optionally fp8+scales."""
+    exchange = {
+        "ragged": lambda rows: _ragged_exchange(rows, out_rows, spec, axis),
+        "dense": lambda rows: _dense_exchange(rows, w, axis),
+        "pallas": lambda rows: _pallas_exchange(rows, w, axis),
+    }[wire]
     if fp8_group is not None:
         q, scale = quantize_fp8(send_rows, fp8_group)
-        if wire == "ragged":
-            q = _ragged_exchange(q, out_rows, spec, axis)
-            scale = _ragged_exchange(scale, out_rows, spec, axis)
-        else:
-            q = _dense_exchange(q, w, axis)
-            scale = _dense_exchange(scale, w, axis)
-        return dequantize_fp8(q, scale, fp8_group, dtype=dtype)
-    if wire == "ragged":
-        return _ragged_exchange(send_rows, out_rows, spec, axis)
-    return _dense_exchange(send_rows, w, axis)
+        return dequantize_fp8(
+            exchange(q), exchange(scale), fp8_group, dtype=dtype
+        )
+    return exchange(send_rows)
 
 
 def ll_dispatch(
@@ -276,6 +292,11 @@ def ll_dispatch(
     )
     if wire == "auto":
         wire = "ragged" if wire_supports_ragged() else "dense"
+    if wire not in ("ragged", "dense", "pallas"):
+        raise ValueError(
+            f"unknown LL wire {wire!r} (want 'auto', 'ragged', 'dense', or "
+            "'pallas')"
+        )
     if topk_weights is None:
         topk_weights = jnp.full((t, k), 1.0 / k, jnp.float32)
     fp8_group = _adapt_group(h, quant_group) if wire_fp8 else None

@@ -229,6 +229,7 @@ def dispatch_sorted(
     *,
     wire_fp8: bool = False,
     quant_group: int = 128,
+    wire: str = "lax",
 ) -> jax.Array:
     """Ragged dispatch: one gather packs [E*C, H] slot payloads, then the same
     member-major all-to-all as the dense path. Empty slots (sentinel index T,
@@ -240,7 +241,7 @@ def dispatch_sorted(
     h = x.shape[-1]
     buf = jnp.take(x, token_for_slot, axis=0, mode="fill", fill_value=0)
     buf = buf.reshape(w, e_local, capacity, h)
-    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype)
+    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype, wire)
     return buf.transpose(1, 0, 2, 3).reshape(e_local, w * capacity, h)
 
 
@@ -252,6 +253,7 @@ def combine_sorted(
     *,
     wire_fp8: bool = False,
     quant_group: int = 128,
+    wire: str = "lax",
 ) -> jax.Array:
     """Ragged combine: all-to-all the expert outputs home, then one [T, K]-row
     gather + weighted sum. Dropped assignments (sentinel slot E*C, out of
@@ -260,7 +262,8 @@ def combine_sorted(
     e_local, wc, h = expert_out.shape
     c = wc // w
     buf = expert_out.reshape(e_local, w, c, h).transpose(1, 0, 2, 3)
-    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, expert_out.dtype)
+    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group,
+                           expert_out.dtype, wire)
     y = buf.reshape(w * e_local * c, h)  # [E*C, H], expert-major
     yk = jnp.take(y, slot, axis=0, mode="fill", fill_value=0)  # [T, K, H]
     return jnp.einsum("tk,tkh->th", weights.astype(yk.dtype), yk)
@@ -273,6 +276,7 @@ def dispatch(
     *,
     wire_fp8: bool = False,
     quant_group: int = 128,
+    wire: str = "lax",
 ) -> jax.Array:
     """Scatter local tokens to their experts' owners over the EP axis.
 
@@ -289,12 +293,26 @@ def dispatch(
         "tec,th->ech", dispatch_mask.astype(x.dtype), x
     )  # [E, C, H]
     buf = buf.reshape(w, e_local, c, x.shape[-1])
-    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype)
+    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype, wire)
     # buf: [W, E_local, C, H] with dim0 = source member
     return buf.transpose(1, 0, 2, 3).reshape(e_local, w * c, x.shape[-1])
 
 
-def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype):
+def _member_all_to_all(buf, axis, wire):
+    """One member-major [W, ...] exchange on the selected wire: the XLA
+    collective ("lax") or the device-initiated Pallas remote-DMA kernel
+    ("pallas", uccl_tpu.ep.pallas_a2a — falls back to lax past its VMEM
+    budget). Both implement the identical tiled contract."""
+    if wire == "pallas":
+        from uccl_tpu.ep import pallas_a2a
+
+        return pallas_a2a.all_to_all(buf, axis)
+    if wire != "lax":
+        raise ValueError(f"unknown EP wire {wire!r} (want 'lax' or 'pallas')")
+    return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
+
+
+def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype, wire="lax"):
     """Member-major all-to-all of a [W, ...] buffer, optionally fp8 on the wire
     (the analog of internode_ll.cu's fp8+scales message packing)."""
     if wire_fp8:
@@ -310,12 +328,12 @@ def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype):
             # 1 fp8 byte + 4/g scale bytes per element beats bf16's 2 only
             # for g > 4; awkward hidden sizes (e.g. prime) would INFLATE
             # wire traffic — ship raw instead.
-            return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
+            return _member_all_to_all(buf, axis, wire)
         q, scale = quantize_fp8(buf, quant_group)
-        q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
-        scale = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=True)
+        q = _member_all_to_all(q, axis, wire)
+        scale = _member_all_to_all(scale, axis, wire)
         return dequantize_fp8(q, scale, quant_group, dtype=dtype)
-    return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
+    return _member_all_to_all(buf, axis, wire)
 
 
 def combine(
@@ -325,6 +343,7 @@ def combine(
     *,
     wire_fp8: bool = False,
     quant_group: int = 128,
+    wire: str = "lax",
 ) -> jax.Array:
     """Return expert outputs to their source members and weight-sum per token.
 
@@ -336,7 +355,8 @@ def combine(
     e_local = e // w
     h = expert_out.shape[-1]
     buf = expert_out.reshape(e_local, w, c, h).transpose(1, 0, 2, 3)  # [W,E_l,C,H]
-    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, expert_out.dtype)
+    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group,
+                           expert_out.dtype, wire)
     # buf: [W, E_local, C, H] with dim0 = owner member -> [E, C, H]
     buf = buf.reshape(e, c, h)
     out = jnp.einsum("tec,ech->th", combine_weights.astype(buf.dtype), buf)
@@ -355,6 +375,7 @@ def moe_ffn(
     capacity_factor: float = 1.25,
     wire_fp8: bool = False,
     impl: str = "sort",
+    wire: str = "lax",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full per-shard MoE layer: route → dispatch → SwiGLU experts → combine.
 
@@ -364,6 +385,10 @@ def moe_ffn(
     or "ll" (packed low-latency path: grouped GEMMs over receive counts, no
     padded FLOPs — :mod:`uccl_tpu.ep.ll`; capacity_factor maps to its
     pair_capacity_factor bound).
+    wire: "lax" (XLA collectives) or "pallas" (device-initiated remote-DMA
+    all-to-all, :mod:`uccl_tpu.ep.pallas_a2a`); for impl="ll" the value maps
+    onto that path's wire form ("pallas" selects its dense-chunk layout on
+    the Pallas wire, anything else keeps its own auto resolution).
     Returns (out [T, H], aux_loss, z_loss).
     """
     t, h = x.shape
@@ -377,17 +402,19 @@ def moe_ffn(
             x, router_logits, w_gate, w_up, w_down, axis,
             num_selected=num_selected,
             pair_capacity_factor=capacity_factor,
+            wire="pallas" if wire == "pallas" else "auto",
             wire_fp8=wire_fp8,
         )
     if impl == "sort":
         rs = route_topk_sorted(router_logits, num_selected, capacity)
         xe = dispatch_sorted(
-            x, rs.token_for_slot, e, capacity, axis, wire_fp8=wire_fp8
+            x, rs.token_for_slot, e, capacity, axis, wire_fp8=wire_fp8,
+            wire=wire,
         )
         aux_loss, z_loss = rs.aux_loss, rs.z_loss
     elif impl == "dense":
         r = route_topk(router_logits, num_selected, capacity)
-        xe = dispatch(x, r.dispatch_mask, axis, wire_fp8=wire_fp8)
+        xe = dispatch(x, r.dispatch_mask, axis, wire_fp8=wire_fp8, wire=wire)
         aux_loss, z_loss = r.aux_loss, r.z_loss
     else:
         raise ValueError(
@@ -410,7 +437,9 @@ def moe_ffn(
     act = jax.nn.silu(h_gate) * h_up
     ye = checkpoint_name(jnp.einsum("ebf,efh->ebh", act, w_down), _YE)
     if impl == "sort":
-        out = combine_sorted(ye, rs.slot, rs.weights, axis, wire_fp8=wire_fp8)
+        out = combine_sorted(ye, rs.slot, rs.weights, axis,
+                             wire_fp8=wire_fp8, wire=wire)
     else:
-        out = combine(ye, r.combine_weights, axis, wire_fp8=wire_fp8)
+        out = combine(ye, r.combine_weights, axis, wire_fp8=wire_fp8,
+                      wire=wire)
     return out.astype(x.dtype), aux_loss, z_loss

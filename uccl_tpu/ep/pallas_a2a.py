@@ -1,0 +1,196 @@
+"""Device-initiated EP all-to-all: a Pallas remote-DMA kernel on the ICI.
+
+EP dispatch/combine was the last pillar still riding XLA-scheduled
+``lax`` collectives while the reference's whole EP story is *device-initiated*
+transfer (ep/src/internode_ll.cu packs per-expert token messages and RDMAs
+them via the IBGDA-replacement proxy, ep/src/proxy.cpp:701). This module is
+the EP analog of what :mod:`uccl_tpu.collective.pallas_ccl` did for the ring
+collectives: the all-to-all that moves routed token rows is issued as
+``pltpu.make_async_remote_copy`` inter-chip DMAs from inside ONE kernel — no
+per-step XLA dispatch, payload resident in VMEM, both ICI directions of the
+axis carrying traffic concurrently.
+
+Schedule (the all-to-all generalization of the ring kernels' design):
+
+* Member ``r`` holds a send buffer of ``W`` destination chunks and a recv
+  buffer of ``W`` source slots. Chunk ``r`` short-circuits locally; the
+  remaining ``W-1`` exchanges run in ``S = ceil((W-1)/2)`` steps — at step
+  ``s`` member ``r`` DMAs chunk ``r+s`` forward and chunk ``r-s`` backward
+  (counter-rotating streams, the torus form of the reference's multipath
+  chunk spraying, transport.cc:2186).
+* **Write-once slots**: the sender addresses the destination's slot by its
+  own rank, so every recv slot is written exactly once — data can never be
+  clobbered, and the arrival semaphore for a slot carries exactly that
+  source's payload count.
+* **Full-peer entry barrier**: unlike a ring (where neighbor liveness bounds
+  skew transitively), the first all-to-all DMA may target ANY peer's buffer,
+  so kernel entry synchronizes with every member of the axis.
+* **Credit-granted slot rotation** (generalized from ``pallas_ccl``): each
+  stream rotates 2 semaphore parities. With only data dependencies, a peer
+  could run ahead and alias a parity slot two steps early; so after consuming
+  its step-``s`` arrival, a member grants an explicit credit
+  (``semaphore_signal``) to the peer that targets it at step ``s+2``, and
+  senders wait for a credit from step 3 on (two parities start free).
+  Signals and waits are balanced per stream, so every semaphore drains.
+
+The per-source arrival counts (how many routed rows each source actually
+sent) ride the same counts exchange both lax wire paths already use
+(:func:`uccl_tpu.ep.ops.counts_exchange` — a [W, E_local] int32 side channel
+that is launch-latency-only next to the payload); the payload slots
+themselves are fixed-size per pair, which is exactly the dense-chunk LL wire
+layout (:mod:`uccl_tpu.ep.ll` ``wire="dense"``) and the sorted path's
+capacity layout.
+
+Combine-side note: the *wire* (the reverse all-to-all of expert outputs) is
+device-initiated here; the weighted per-token reduction applies immediately
+on the received buffer in the same jit (a [T, K]-row gather + weighted sum —
+XLA fuses it into the kernel's consumer). The gather itself stays outside
+the kernel by design: Mosaic has no dynamic vector gather, and the reduction
+is arithmetic XLA already fuses well — the pillar gap was who issues the
+DMAs, not who multiplies the weights.
+
+Fallback: payloads over the VMEM budget (or the interpreter's single-core
+ceiling), worlds of 1, and meshes the legacy discharge interpreter cannot
+address fall back to ``lax.all_to_all`` with identical semantics — the
+``wire="pallas"`` surface is transparent either way.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from uccl_tpu.collective import dma as _dma
+
+
+def _lax_fallback(x: jax.Array, axis) -> jax.Array:
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+
+
+def _a2a_kernel(axis, n: int, faithful: bool):
+    """Build the kernel body for an n-member all-to-all over ``axis``.
+
+    ``faithful`` is static: under the legacy discharge interpreter (jax
+    0.4.x) remote semaphore signals are unimplemented, but every DMA
+    discharges into a synchronous cross-device gather — the barrier and
+    credits it elides are subsumed by that global ordering."""
+    s_fwd = (n - 1 + 1) // 2  # fwd stream steps: dsts r+1 .. r+S
+    s_bwd = (n - 1) // 2  # bwd stream steps: dsts r-1 .. r-S'
+
+    def stream_step(x_ref, out_ref, send_sem, recv_sem, ack_sem, r, s, h,
+                    d, last):
+        """One direction's DMA at step s: d=+1 fwd / -1 bwd; ``last`` is the
+        stream's static step count (credit window arithmetic)."""
+        dst = lax.rem(r + d * s + s * n, n)
+        if faithful:
+
+            @pl.when(s >= 3)
+            def _():  # credit: my step-(s-2) parity slot drained downstream
+                pltpu.semaphore_wait(ack_sem.at[h], 1)
+
+        sl = lax.rem(s, 2)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref.at[dst],
+            # write-once: my rows land in the destination's slot ``r`` —
+            # the sender's rank IS the per-source slot index
+            dst_ref=out_ref.at[r],
+            send_sem=send_sem.at[h, sl],
+            recv_sem=recv_sem.at[h, sl],
+            **_dma.remote_kwargs(axis, dst, faithful),
+        )
+        rdma.start()
+        return rdma
+
+    def stream_finish(ack_sem, rdma, r, s, h, d, last):
+        rdma.wait_recv()  # slot (r - d*s) arrived
+        if faithful:
+
+            @pl.when(s <= last - 2)
+            def _():  # grant the peer that targets me at step s+2
+                pltpu.semaphore_signal(
+                    ack_sem.at[h], inc=1,
+                    **_dma.remote_kwargs(
+                        axis, lax.rem(r - d * (s + 2) + (s + 2) * n, n),
+                        faithful,
+                    ),
+                )
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem, ack_sem):
+        r = lax.axis_index(axis)
+        if faithful:
+            _dma.all_barrier(axis, n)
+        out_ref[r] = x_ref[r]  # local chunk short-circuits
+
+        def step(s, _):
+            descs = []
+            for h, (d, last) in enumerate(((1, s_fwd), (-1, s_bwd))):
+                descs.append(
+                    stream_step(x_ref, out_ref, send_sem, recv_sem,
+                                ack_sem, r, s, h, d, last)
+                )
+            for h, (d, last) in enumerate(((1, s_fwd), (-1, s_bwd))):
+                stream_finish(ack_sem, descs[h], r, s, h, d, last)
+            for rdma in descs:
+                rdma.wait_send()
+            return 0
+
+        lax.fori_loop(1, s_bwd + 1, step, 0)
+        if s_fwd > s_bwd:  # even n: the antipodal chunk, fwd stream only
+            # traced like the loop counter, so pl.when sees the same types
+            s = jnp.int32(s_fwd)
+            rdma = stream_step(x_ref, out_ref, send_sem, recv_sem, ack_sem,
+                               r, s, 0, 1, s_fwd)
+            stream_finish(ack_sem, rdma, r, s, 0, 1, s_fwd)
+            rdma.wait_send()
+
+    return kernel
+
+
+def all_to_all(
+    x: jax.Array,
+    axis,
+    *,
+    interpret=None,
+    collective_id: int = 1,
+) -> jax.Array:
+    """Per-shard ``[W, ...] -> [W, ...]`` all-to-all as ONE Pallas kernel.
+
+    Chunk ``d`` of my buffer lands in slot *my-rank* of member ``d``'s
+    output — the exact contract of ``lax.all_to_all(x, axis, 0, 0,
+    tiled=True)``, which is also the fallback lowering when the payload
+    exceeds the VMEM budget. Use inside ``shard_map`` over the EP axis."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    if x.shape[0] != n:
+        raise ValueError(
+            f"all_to_all leading dim {x.shape[0]} != axis size {n}"
+        )
+    interpret = _dma.resolve_interpret(interpret)
+    view, k, m = _dma.pad_chunks(x.reshape(-1), n)  # [n, m//128, 128]
+    # both the send and recv buffers are VMEM-resident for the kernel's
+    # lifetime, so the budget is charged for the padded pair
+    if not _dma.check_budget(2 * n * m * x.dtype.itemsize, "ep_all_to_all",
+                             interpret):
+        return _lax_fallback(x, axis)
+    rows = m // _dma.LANES
+    faithful = _dma.faithful_sync(interpret)
+
+    buf = pl.pallas_call(
+        _a2a_kernel(axis, n, faithful),
+        out_shape=jax.ShapeDtypeStruct((n, rows, _dma.LANES), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2, 2)),  # send, per stream x parity
+            pltpu.SemaphoreType.DMA((2, 2)),  # recv
+            pltpu.SemaphoreType.REGULAR((2,)),  # ack credits, per stream
+        ],
+        compiler_params=_dma.compiler_params(collective_id),
+        interpret=_dma.interp(interpret),
+    )(view)
+    out = buf.reshape(n, m)[:, :k]
+    return out.reshape(x.shape)

@@ -12,6 +12,7 @@ decode step hits the same compiled executable.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, NamedTuple, Tuple
 
 import jax
@@ -141,7 +142,14 @@ def decode_step_elastic(params, token, ekv, cfg: DenseConfig):
     return logits[:, 0]
 
 
-_GEN_CACHE: dict = {}
+# Compiled-generate cache, LRU-bounded: a long-lived server sweeping shapes
+# (batch buckets, growing new_tokens, several max_seq tiers) would otherwise
+# retain a compiled executable per shape forever. 16 entries comfortably
+# covers a serving process's steady-state shape set while bounding the
+# executable memory; evicting the least-recently-used program lets XLA
+# reclaim it.
+_GEN_CACHE: OrderedDict = OrderedDict()
+_GEN_CACHE_CAP = 16
 
 
 def generate(
@@ -167,6 +175,8 @@ def generate(
         )
     key = (repr(cfg), prompt.shape, max_new_tokens, max_seq)
     fn = _GEN_CACHE.get(key)
+    if fn is not None:
+        _GEN_CACHE.move_to_end(key)  # LRU: a hit refreshes recency
     if fn is None:
 
         def run(p, t):
@@ -184,4 +194,6 @@ def generate(
             return toks.T  # [B, T]
 
         fn = _GEN_CACHE[key] = jax.jit(run)
+        while len(_GEN_CACHE) > _GEN_CACHE_CAP:
+            _GEN_CACHE.popitem(last=False)
     return fn(params, prompt)

@@ -30,7 +30,9 @@ from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from uccl_tpu.utils.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from uccl_tpu.ep import ops as ep_ops

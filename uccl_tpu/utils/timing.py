@@ -33,12 +33,15 @@ from jax import lax
 
 def perturb(a, c):
     """Couple array `a` to the carry so the loop body is not hoistable.
-    Float: + c*1e-12 (negligible). Int: + min(c, 0) cast — runtime zero
-    (the carry accumulates non-negative reductions) but data-dependent,
-    so values are bit-unchanged yet XLA cannot prove loop invariance."""
+    Float: + c*1e-12 (negligible). Int: + min(|c|, 0) cast — PROVABLY zero
+    for any carry value, yet data-dependent, so values are bit-unchanged
+    and XLA still cannot prove loop invariance. (The earlier min(c, 0)
+    coupling assumed a non-negative carry; a slope carry that drifts
+    negative — reductions of signed outputs do — silently mutated every
+    int leaf it touched.)"""
     if jnp.issubdtype(a.dtype, jnp.floating):
         return a + (c * 1e-12).astype(a.dtype)
-    return a + jnp.minimum(c, 0.0).astype(a.dtype)
+    return a + jnp.minimum(jnp.abs(c), 0.0).astype(a.dtype)
 
 
 def chained_timeit(name, fn, *args, iters=10, flops=None, width=34):

@@ -11,6 +11,11 @@ Usage:
   python benchmarks/ep_bench.py --table         # E ∈ {8, 32} latency table
   python benchmarks/ep_bench.py --wire pallas   # device-initiated remote-DMA
                                                 # all-to-all (ep/pallas_a2a)
+  python benchmarks/ep_bench.py --wire pallas --chunks 2,4
+      # chunk-pipelined MoE layer sweep: per-chunk double-buffered
+      # dispatch/GEMM/combine vs the strictly phased step, with the
+      # overlap-efficiency metric (fraction of wire time hidden under the
+      # expert GEMMs, from the slope estimator legs — docs/EP_BENCH.md)
 """
 
 from __future__ import annotations
@@ -134,6 +139,150 @@ def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8,
     }
 
 
+def bench_chunk_sweep(jax, *, tokens, hidden, ffn, experts, topk, iters,
+                      chunks, fp8):
+    """Chunk-pipelined MoE layer sweep on the pallas wire.
+
+    Three slope-estimated legs per shape — wire-only (route + dispatch +
+    combine, no GEMM), compute-only (the three expert einsums on a resident
+    recv buffer), and the full layer step at each chunk depth — yield the
+    overlap-efficiency metric:
+
+        overlap_efficiency(N) = (t_wire + t_gemm - t_layer(N)) / t_wire
+
+    i.e. the fraction of the wire leg hidden under compute (1.0 = the wire
+    is free; <= 0 = no overlap, or chunk overhead ate the gain). All legs
+    ride the same estimator so fixed dispatch cost cancels. Also reports
+    whether the pallas kernel actually carried each arm or the budget gate
+    took the fallback chain (chunked → unchunked pallas → lax; PERF.md
+    honesty: on the virtual CPU mesh these are contract/overhead numbers —
+    overlap gains are claimed on-chip only)."""
+    import json
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import jax.numpy as jnp
+
+    from uccl_tpu.collective import dma
+    from uccl_tpu.ep import ops as ep_ops
+    from uccl_tpu.utils.jaxcompat import shard_map
+
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    experts = max(experts, n)
+    experts -= experts % n
+    e_local = experts // n
+    cap = max(1, int(1.25 * tokens * topk / experts))
+    rng = np.random.default_rng(0)
+
+    def put(a, spec=P("dp")):
+        return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+
+    x = put(rng.standard_normal((n, tokens, hidden)).astype(np.float32))
+    logits = put(rng.standard_normal((n, tokens, experts)).astype(np.float32))
+    scale = 1.0 / np.sqrt(hidden)
+    wg = put((rng.standard_normal((experts, hidden, ffn)) * scale).astype(
+        np.float32))
+    wu = put((rng.standard_normal((experts, hidden, ffn)) * scale).astype(
+        np.float32))
+    wd = put((rng.standard_normal((experts, ffn, hidden)) * scale).astype(
+        np.float32))
+
+    def shmap(f, n_in, out_specs=P("dp")):
+        return jax.jit(shard_map(
+            f, mesh, tuple(P("dp") for _ in range(n_in)), out_specs,
+            check_vma=False,
+        ))
+
+    def layer_fn(n_chunks):
+        def f(xv, lv, g, u, d):
+            out, _, _ = ep_ops.moe_ffn(
+                xv[0], lv[0], g, u, d, "dp", num_selected=topk,
+                capacity_factor=1.25, impl="sort", wire="pallas",
+                wire_fp8=fp8, n_chunks=n_chunks,
+            )
+            return out[None]
+
+        return shmap(f, 5)
+
+    def wire_f(xv, lv):
+        rs = ep_ops.route_topk_sorted(lv[0], topk, cap)
+        recv = ep_ops.dispatch_sorted(
+            xv[0], rs.token_for_slot, experts, cap, "dp", wire="pallas",
+            wire_fp8=fp8,
+        )
+        out = ep_ops.combine_sorted(
+            recv, rs.slot, rs.weights, "dp", wire="pallas", wire_fp8=fp8
+        )
+        return out[None]
+
+    def gemm_f(recv, g, u, d):
+        xe = recv[0]
+        act = jax.nn.silu(jnp.einsum("ebh,ehf->ebf", xe, g)) * jnp.einsum(
+            "ebh,ehf->ebf", xe, u
+        )
+        return jnp.einsum("ebf,efh->ebh", act, d)[None]
+
+    wire_fn = shmap(wire_f, 2)
+    gemm_fn = shmap(gemm_f, 4)
+    recv = put(rng.standard_normal(
+        (n, e_local, n * cap, hidden)).astype(np.float32))
+
+    t_wire = _time_fn(wire_fn, (x, logits), iters)
+    t_gemm = _time_fn(gemm_fn, (recv, wg, wu, wd), iters)
+    t1 = _time_fn(layer_fn(1), (x, logits, wg, wu, wd), iters)
+
+    # the fp8 wire quantizes values to int8 before the exchange, so the
+    # budget gates run on 1-byte elements there (the f32 scale side-channel
+    # is h/128 the size and never the binding gate) — shared rule
+    wire_bytes = ep_ops.wire_itemsize(fp8, hidden, np.float32)
+    interp = dma.resolve_interpret(None)
+
+    def fits(elems_per_peer, resident_kernels):
+        # ask the REAL gates (quiet: no fallback log) what they decide, so
+        # the pallas_wire_active labels can never drift from the fallback
+        # chain (chunked -> unchunked pallas -> lax) the arms actually took
+        if resident_kernels == 1:
+            pair = 2 * n * dma.padded_chunk_elems(elems_per_peer) * wire_bytes
+            return dma.check_budget(pair, "bench_label", interp, quiet=True)
+        return dma.chunk_budget(n, elems_per_peer, wire_bytes, "bench_label",
+                                interp, resident_kernels=resident_kernels,
+                                quiet=True)
+
+    arms = []
+    for nc in chunks:
+        t_n = t1 if nc == 1 else _time_fn(
+            layer_fn(nc), (x, logits, wg, wu, wd), iters
+        )
+        cs = dma.pad_capacity(cap, nc) // nc
+        arms.append({
+            "chunks": nc,
+            "layer_us": round(t_n * 1e6, 1),
+            "vs_unchunked": round(t_n / max(t1, 1e-12), 3),
+            "overlap_efficiency": round(
+                (t_wire + t_gemm - t_n) / max(t_wire, 1e-12), 3
+            ),
+            # phased arm: 1 resident pair; chunked layer: 4 (two airborne
+            # kernels in each of the dispatch and combine families — the
+            # same charge ep_ops.resolve_chunks gates with)
+            "pallas_wire_active": fits(e_local * cs * hidden,
+                                       1 if nc == 1 else 4),
+        })
+    line = {
+        "bench": "ep_chunk_sweep",
+        "tokens": tokens, "hidden": hidden, "ffn": ffn,
+        "experts": experts, "topk": topk, "fp8": fp8, "capacity": cap,
+        "wire_us": round(t_wire * 1e6, 1),
+        "gemm_us": round(t_gemm * 1e6, 1),
+        "unchunked_layer_us": round(t1 * 1e6, 1),
+        "arms": arms,
+        "substrate": jax.default_backend(),
+    }
+    print(json.dumps(line))
+    return line
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=0)
@@ -173,10 +322,41 @@ def main():
              "(reference: proxy-served inter-node EP, ep/src/proxy.cpp:701)",
     )
     ap.add_argument("--ffn", type=int, default=256,
-                    help="expert FFN width for --cross-pod")
-    ap.add_argument("--chunks", type=int, default=1,
-                    help="cross-pod slot-space pipelining depth (overlap)")
+                    help="expert FFN width for --cross-pod and the --chunks "
+                         "sweep")
+    ap.add_argument("--chunks", default="1",
+                    help="chunk-pipeline depth(s). A single value sets the "
+                         "cross-pod slot-space pipelining depth; with "
+                         "--wire pallas a comma list (e.g. '2,4') runs the "
+                         "chunk-pipelined MoE layer sweep and reports the "
+                         "overlap-efficiency metric (docs/EP_BENCH.md)")
     args = ap.parse_args()
+    try:
+        chunk_list = [int(c) for c in str(args.chunks).split(",") if c != ""]
+    except ValueError:
+        ap.error(f"--chunks wants an int or comma list of ints, got "
+                 f"{args.chunks!r}")
+    if not chunk_list:
+        chunk_list = [1]
+    if args.cross_pod and len(chunk_list) != 1:
+        ap.error("--cross-pod takes a single --chunks depth (the sweep is "
+                 "the pallas-wire mode)")
+    if chunk_list != [1] and not args.cross_pod:
+        # the chunk sweep is its own mode: validate the combination up
+        # front instead of silently ignoring half the flags
+        if args.wire != "pallas":
+            ap.error("--chunks sweeps the chunk-pipelined pallas wire; add "
+                     "--wire pallas")
+        if any(c < 1 for c in chunk_list):
+            ap.error("--chunks sweep arms are explicit depths >= 1 "
+                     "(0 = auto is a layer knob, not a sweep arm)")
+        if args.ll:
+            ap.error("--chunks sweeps the sorted chunk-pipelined layer; "
+                     "the LL path chunks only its wire (no per-chunk GEMM) "
+                     "and has no sweep mode — drop --ll")
+        if args.table:
+            ap.error("--table and the --chunks sweep are separate modes; "
+                     "pick one")
 
     jax = init_devices(args.devices)
     n = len(jax.devices())
@@ -184,7 +364,7 @@ def main():
     if args.cross_pod:
         out = bench_cross_pod(
             args.tokens, args.hidden, args.ffn, args.experts, args.topk,
-            args.iters, n_chunks=args.chunks,
+            args.iters, n_chunks=chunk_list[0],
         )
         for p, (fwd_us, comp_us) in sorted(out.items()):
             print(
@@ -214,6 +394,16 @@ def main():
                         f"{r['dispatch_us']:>12.1f} {r['combine_us']:>11.1f} "
                         f"{r['gbps']:>8.3f}"
                     )
+        return
+
+    if chunk_list != [1]:
+        if 1 not in chunk_list:
+            chunk_list = [1] + chunk_list  # always anchor on the phased arm
+        bench_chunk_sweep(
+            jax, tokens=args.tokens, hidden=args.hidden, ffn=args.ffn,
+            experts=args.experts, topk=args.topk, iters=args.iters,
+            chunks=sorted(set(chunk_list)), fp8=args.fp8,
+        )
         return
 
     mode = "ll" if args.ll else "normal"

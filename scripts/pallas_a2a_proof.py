@@ -1,67 +1,80 @@
-"""Single-chip compile proof for the Pallas EP all-to-all (wire="pallas").
+"""Compile/smoke proof for the Pallas EP all-to-all (wire="pallas").
 
-An 8-way all-to-all kernel cannot EXECUTE on one chip, but it can be LOWERED
-for the TPU backend through the full Pallas→Mosaic pipeline using an abstract
-8-device mesh — that exercises kernel tracing, VMEM layout/tiling, the
-full-peer barrier, credit semaphore plumbing and the remote-copy lowering,
-i.e. everything short of the final Mosaic→LLO compile that needs the real
-topology. Covered programs: the normal (sorted) EP dispatch AND combine and
-the LL dense-chunk dispatch AND combine, each on the pallas wire, at f32 and
-bf16 payloads plus the fp8+scales wire format.
+Two modes:
 
-(On CPU backends pallas refuses non-interpret lowering, so this is a
-TPU-session artifact; run it from scripts/onchip_ladder.sh, step 1c.)
+* default (TPU session): an 8-way all-to-all kernel cannot EXECUTE on one
+  chip, but it can be LOWERED for the TPU backend through the full
+  Pallas→Mosaic pipeline using an abstract 8-device mesh — that exercises
+  kernel tracing, VMEM layout/tiling, the full-peer barrier, credit
+  semaphore plumbing and the remote-copy lowering, i.e. everything short of
+  the final Mosaic→LLO compile that needs the real topology. Covered
+  programs: the normal (sorted) EP dispatch AND combine and the LL
+  dense-chunk dispatch AND combine, each on the pallas wire, at f32 and
+  bf16 payloads plus the fp8+scales wire format. ``--chunks N`` adds the
+  chunk-pipelined arms (per-chunk kernels on rotated collective ids — the
+  double-buffered dispatch/combine schedule). Run from
+  scripts/onchip_ladder.sh, step 1c.
 
-Prints one line per case; exits nonzero on any failure or if any lowered
-module lacks the ``tpu_custom_call`` the device-initiated path must contain.
+* ``--interpret`` (any host, CI smoke tier): EXECUTES the kernels under the
+  TPU interpreter on a small virtual CPU mesh and checks them against the
+  lax wire — the fast fail-first gate for kernel regressions on CPU
+  runners (scripts/qa.sh and the GitHub workflow run it with --chunks 2
+  under a hard timeout). Small shapes on purpose: the whole smoke must
+  finish in seconds-to-a-minute, not re-prove the full oracle suite
+  (tests/test_pallas_a2a.py does that).
+
+Prints one line per case; exits nonzero on any failure (or, in lowering
+mode, if any lowered module lacks the ``tpu_custom_call`` the
+device-initiated path must contain).
 """
 
+import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
-from jax.sharding import AbstractMesh, PartitionSpec as P
-
-from uccl_tpu.ep import ll as ep_ll
-from uccl_tpu.ep import ops as ep_ops
-from uccl_tpu.utils.jaxcompat import shard_map
-
 W, T, H, E, K = 8, 128, 512, 16, 2
 CAP = max(1, int(1.25 * T * K / E))
 
 
-def _dispatch(x, idx):
-    tfs, _slot, _kept = ep_ops.sorted_from_topk(idx, E, CAP)
-    return ep_ops.dispatch_sorted(x, tfs, E, CAP, "x", wire="pallas")
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--chunks", type=int, default=0,
+        help="also prove the chunk-pipelined arms at this depth (0 = "
+             "unchunked only)",
+    )
+    ap.add_argument(
+        "--interpret", action="store_true",
+        help="execute under the TPU interpreter on a virtual CPU mesh and "
+             "check vs the lax wire (CI smoke tier; no TPU needed)",
+    )
+    return ap.parse_args(argv)
 
 
-def _dispatch_fp8(x, idx):
-    tfs, _slot, _kept = ep_ops.sorted_from_topk(idx, E, CAP)
-    return ep_ops.dispatch_sorted(x, tfs, E, CAP, "x", wire="pallas",
-                                  wire_fp8=True)
+def _setup_interpret_env():
+    """Must run BEFORE jax is imported: the smoke needs virtual devices."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 
-def _combine(y, slot, wts):
-    return ep_ops.combine_sorted(y, slot, wts, "x", wire="pallas")
+def _lowering_proof(chunks: int) -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import AbstractMesh, PartitionSpec as P
 
+    from uccl_tpu.ep import ll as ep_ll
+    from uccl_tpu.ep import ops as ep_ops
+    from uccl_tpu.utils.jaxcompat import shard_map
 
-def _ll_dispatch(x, idx, wts):
-    r = ep_ll.ll_dispatch(x, idx, wts, E, "x", wire="pallas", wire_fp8=True)
-    return r.recv_x, r.group_sizes
-
-
-def _ll_combine(y, slot, wts, send_mat, recv_mat, regroup, src_off):
-    state = ep_ll.LLState(slot, wts, send_mat, recv_mat, regroup, src_off,
-                          "pallas")
-    return ep_ll.ll_combine(y, state, "x", wire_fp8=True)
-
-
-def main():
     if jax.default_backend() != "tpu":
-        sys.exit("pallas_a2a_proof: needs a TPU backend (tunnel session)")
+        sys.exit("pallas_a2a_proof: needs a TPU backend (tunnel session); "
+                 "use --interpret for the CPU smoke tier")
     mesh = AbstractMesh((W,), ("x",))
     per_pair, r_max = ep_ll.ll_bounds(T, K, E // W, W, None, None)
     i32, f32 = jnp.int32, jnp.float32
@@ -69,14 +82,50 @@ def main():
     def S(shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype)
 
+    def _dispatch(nc):
+        def f(x, idx):
+            plan = ep_ops.plan_slots(idx, E, CAP)
+            return ep_ops.dispatch_sorted(x, plan, E, CAP, "x",
+                                          wire="pallas", n_chunks=nc)
+
+        return f
+
+    def _dispatch_fp8(x, idx):
+        plan = ep_ops.plan_slots(idx, E, CAP)
+        return ep_ops.dispatch_sorted(x, plan, E, CAP, "x", wire="pallas",
+                                      wire_fp8=True)
+
+    def _combine(nc):
+        def f(y, slot, wts):
+            return ep_ops.combine_sorted(y, slot, wts, "x", wire="pallas",
+                                         n_chunks=nc)
+
+        return f
+
+    def _ll_dispatch(nc):
+        def f(x, idx, wts):
+            r = ep_ll.ll_dispatch(x, idx, wts, E, "x", wire="pallas",
+                                  wire_fp8=True, n_chunks=nc)
+            return r.recv_x, r.group_sizes
+
+        return f
+
+    def _ll_combine(nc):
+        def f(y, slot, wts, send_mat, recv_mat, regroup, src_off):
+            state = ep_ll.LLState(slot, wts, send_mat, recv_mat, regroup,
+                                  src_off, "pallas", nc)
+            return ep_ll.ll_combine(y, state, "x", wire_fp8=True)
+
+        return f
+
     cases = []
     for dtype in (jnp.float32, jnp.bfloat16):
         name = jnp.dtype(dtype).name
         cases += [
-            (f"dispatch_{name}", _dispatch,
+            (f"dispatch_{name}", _dispatch(1),
              (S((T, H), dtype), S((T, K), i32)),
              (P(), P()), P()),
-            (f"combine_{name}", _combine,
+            (f"combine_{name}", _combine(1),
              (S((E // W, W * CAP, H), dtype), S((T, K), i32),
               S((T, K), f32)),
              (P(), P(), P()), P()),
@@ -84,15 +133,32 @@ def main():
     cases += [
         ("dispatch_fp8_wire", _dispatch_fp8,
          (S((T, H), jnp.bfloat16), S((T, K), i32)), (P(), P()), P()),
-        ("ll_dispatch_fp8", _ll_dispatch,
+        ("ll_dispatch_fp8", _ll_dispatch(1),
          (S((T, H), jnp.bfloat16), S((T, K), i32), S((T, K), f32)),
          (P(), P(), P()), (P(), P())),
-        ("ll_combine_fp8", _ll_combine,
+        ("ll_combine_fp8", _ll_combine(1),
          (S((r_max, H), jnp.bfloat16), S((T, K), i32), S((T, K), f32),
           S((W, E // W), i32), S((W, E // W), i32), S((r_max,), i32),
           S((W,), i32)),
          (P(),) * 7, P()),
     ]
+    if chunks > 1:
+        cases += [
+            (f"dispatch_chunked{chunks}", _dispatch(chunks),
+             (S((T, H), jnp.float32), S((T, K), i32)), (P(), P()), P()),
+            (f"combine_chunked{chunks}", _combine(chunks),
+             (S((E // W, W * CAP, H), jnp.float32), S((T, K), i32),
+              S((T, K), f32)),
+             (P(), P(), P()), P()),
+            (f"ll_dispatch_chunked{chunks}", _ll_dispatch(chunks),
+             (S((T, H), jnp.bfloat16), S((T, K), i32), S((T, K), f32)),
+             (P(), P(), P()), (P(), P())),
+            (f"ll_combine_chunked{chunks}", _ll_combine(chunks),
+             (S((r_max, H), jnp.bfloat16), S((T, K), i32), S((T, K), f32),
+              S((W, E // W), i32), S((W, E // W), i32), S((r_max,), i32),
+              S((W,), i32)),
+             (P(),) * 7, P()),
+        ]
 
     failed = 0
     for name, fn, shapes, in_specs, out_spec in cases:
@@ -107,6 +173,112 @@ def main():
         except Exception as e:  # noqa: BLE001 - report-and-continue proof
             print(f"pallas_a2a_proof {name}: FAILED {e!r}")
             failed += 1
+    return failed
+
+
+def _interpret_smoke(chunks: int) -> int:
+    """Execute small kernel cases under the TPU interpreter and compare to
+    the lax wire — worlds 4 (even, real chunked kernels within the interp
+    budget) and 5 (odd: pad path + antipodal step)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import uccl_tpu.utils.jaxcompat  # noqa: F401 (installs polyfills)
+    from uccl_tpu.ep import ll as ep_ll
+    from uccl_tpu.ep import ops as ep_ops
+    from uccl_tpu.ep import pallas_a2a
+    from uccl_tpu.utils.jaxcompat import shard_map
+
+    devs = jax.devices()
+    rng = np.random.default_rng(0)
+    depths = sorted({1, max(1, chunks)})
+    failed = 0
+
+    def run(mesh, fn, *args, out_specs=None):
+        in_specs = tuple(P("x") for _ in args)
+        out_specs = P("x") if out_specs is None else out_specs
+        return jax.jit(
+            shard_map(fn, mesh, in_specs, out_specs, check_vma=False)
+        )(*args)
+
+    def case(name, ok):
+        nonlocal failed
+        print(f"pallas_a2a_proof[interpret] {name}: "
+              f"{'OK' if ok else 'MISMATCH'}")
+        failed += 0 if ok else 1
+
+    for n in (4, 5):
+        mesh = Mesh(np.array(devs[:n]), ("x",))
+        x = jnp.asarray(rng.normal(size=(n, n, 5, 9)), jnp.float32)
+        want = np.asarray(run(
+            mesh,
+            lambda v: jax.lax.all_to_all(v[0], "x", 0, 0, tiled=True)[None],
+            x,
+        ))
+        for nc in depths:
+            got = np.asarray(run(
+                mesh,
+                lambda v, nc=nc: pallas_a2a.all_to_all(
+                    v[0], "x", n_chunks=nc, chunk_axis=2
+                )[None],
+                x,
+            ))
+            case(f"kernel_w{n}_c{nc}", bool((got == want).all()))
+
+        # one sorted dispatch+combine roundtrip and one LL fp8 roundtrip
+        t, h, e, k = 8, 16, 2 * n, 2
+        cap = max(1, int(1.25 * t * k / e))
+        xs = rng.standard_normal((n, t, h)).astype(np.float32)
+        idx = rng.integers(0, e, (n, t, k)).astype(np.int32)
+        wts = rng.uniform(0.1, 1.0, (n, t, k)).astype(np.float32)
+
+        def sorted_path(wire, nc):
+            def f(xv, iv, wv):
+                plan = ep_ops.plan_slots(iv[0], e, cap)
+                recv = ep_ops.dispatch_sorted(
+                    xv[0], plan, e, cap, "x", wire=wire, n_chunks=nc
+                )
+                return ep_ops.combine_sorted(
+                    recv * 2.0, plan, wv[0], "x", wire=wire, n_chunks=nc
+                )[None]
+
+            return np.asarray(run(
+                mesh, f, *map(jnp.asarray, (xs, idx, wts))
+            ))
+
+        ref = sorted_path("lax", 1)
+        for nc in depths:
+            case(f"sorted_w{n}_c{nc}",
+                 bool((sorted_path("pallas", nc) == ref).all()))
+
+        def ll_path(wire, nc):
+            def f(xv, iv, wv):
+                r = ep_ll.ll_dispatch(
+                    xv[0], iv[0], wv[0], e, "x", wire=wire, wire_fp8=True,
+                    n_chunks=nc,
+                )
+                return r.recv_x[None]
+
+            return np.asarray(run(
+                mesh, f, *map(jnp.asarray, (xs, idx, wts))
+            ))
+
+        ll_ref = ll_path("dense", 1)
+        for nc in depths:
+            case(f"ll_fp8_w{n}_c{nc}",
+                 bool((ll_path("pallas", nc) == ll_ref).all()))
+    return failed
+
+
+def main():
+    args = _parse_args()
+    if args.interpret:
+        _setup_interpret_env()
+        failed = _interpret_smoke(args.chunks)
+    else:
+        failed = _lowering_proof(args.chunks)
     sys.exit(1 if failed else 0)
 
 

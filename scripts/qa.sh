@@ -11,8 +11,13 @@ fail=0
 note() { echo; echo "=== $* ==="; }
 check() { if [ "$1" -ne 0 ]; then echo "^^^ FAILED"; fail=1; fi; }
 
-note "pytest (full suite, virtual 8-device mesh)"
-timeout 2700 python -m pytest tests/ -q; check $?
+note "pallas kernel smoke tier (interpret-mode, fail-fast: a2a proof --chunks 2 + oracle tests)"
+timeout 300 python scripts/pallas_a2a_proof.py --interpret --chunks 2; check $?
+timeout 900 python -m pytest tests/test_pallas_a2a.py tests/test_pallas_ccl.py -q; check $?
+
+note "pytest (full suite, virtual 8-device mesh; pallas kernel files ran in the smoke tier)"
+timeout 2700 python -m pytest tests/ -q \
+  --ignore=tests/test_pallas_a2a.py --ignore=tests/test_pallas_ccl.py; check $?
 
 note "native substrate + engine tests"
 timeout 900 make -C native test; check $?

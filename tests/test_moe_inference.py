@@ -44,6 +44,26 @@ class TestShardingParity:
         sharded = _serve(devices, 4, params, prompt, 6, impl)
         np.testing.assert_array_equal(single, sharded)
 
+    def test_pallas_chunked_wire_generation_identical(self, devices):
+        """The serving config's moe_wire/moe_chunks knobs (device-initiated
+        chunk-pipelined EP wire) are semantics-free: greedy generations
+        match the default lax wire token for token."""
+        import dataclasses
+
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        rng = np.random.default_rng(0)
+        prompt_np = rng.integers(0, CFG.vocab, (4, 8)).astype(np.int32)
+        want = _serve(devices, 4, params, prompt_np, 4, "sort")
+        cfg = dataclasses.replace(CFG, moe_wire="pallas", moe_chunks=2)
+        mesh = Mesh(np.array(devices[:4]), ("dp",))
+        srv = MoEServer(cfg, mesh)
+        p = srv.shard_params(params)
+        prompt = jnp.asarray(prompt_np.reshape(4, 1, 8))
+        got = srv.generate(p, prompt, 4, max_seq=32, impl="sort")
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(4, 4), want
+        )
+
     def test_decode_uses_ll_and_cache_advances(self, devices):
         params = init_params(jax.random.PRNGKey(1), CFG)
         mesh = Mesh(np.array(devices[:4]), ("dp",))

@@ -287,3 +287,347 @@ class TestBuffer:
         mesh = _mesh(devices, 4)
         with pytest.raises(ValueError, match="unknown wire"):
             Buffer(mesh, "ep", num_experts=8, wire="tcp")
+
+
+class TestChunkedKernel:
+    """n_chunks > 1: the chunk axis splits into double-buffered per-chunk
+    kernels on rotated collective ids — numerics pinned to the unchunked
+    lax contract at every world, including the slot-axis pad path (5 is not
+    divisible by 2 or 4)."""
+
+    @pytest.mark.parametrize("n", WORLDS)
+    @pytest.mark.parametrize("chunks", [1, 2, 4])
+    def test_matches_lax(self, devices, rng, n, chunks):
+        mesh = _mesh(devices, n)
+        x = jnp.asarray(rng.normal(size=(n, n, 5, 9)), jnp.float32)
+        got = np.asarray(_run(
+            mesh,
+            lambda v: pallas_a2a.all_to_all(
+                v[0], "ep", n_chunks=chunks, chunk_axis=2
+            )[None],
+            x,
+        ))
+        want = np.asarray(_run(
+            mesh,
+            lambda v: jax.lax.all_to_all(v[0], "ep", 0, 0, tiled=True)[None],
+            x,
+        ))
+        np.testing.assert_array_equal(got, want)
+
+    def test_bf16_chunked(self, devices, rng):
+        mesh = _mesh(devices, 4)
+        x = jnp.asarray(rng.normal(size=(4, 4, 6, 9)), jnp.bfloat16)
+        got = np.asarray(_run(
+            mesh,
+            lambda v: pallas_a2a.all_to_all(
+                v[0], "ep", n_chunks=2, chunk_axis=2
+            )[None],
+            x,
+        ).astype(jnp.float32))
+        want = np.asarray(_run(
+            mesh,
+            lambda v: jax.lax.all_to_all(v[0], "ep", 0, 0, tiled=True)[None],
+            x,
+        ).astype(jnp.float32))
+        np.testing.assert_array_equal(got, want)
+
+    def test_chunk_axis0_rejected(self, devices):
+        mesh = _mesh(devices, 4)
+        x = jnp.zeros((4, 4, 8), jnp.float32)
+        with pytest.raises(ValueError, match="member axis"):
+            _run(
+                mesh,
+                lambda v: pallas_a2a.all_to_all(
+                    v[0], "ep", n_chunks=2, chunk_axis=0
+                )[None],
+                x,
+            )
+
+
+class TestChunkBudget:
+    """The 2x double-buffer footprint gate (dma.chunk_budget) and its clean
+    fallback chain: chunked → unchunked pallas → lax, all bit-identical."""
+
+    def test_double_buffer_charge(self, monkeypatch):
+        """Compiled mode charges TWO resident chunk pairs; the interpreter
+        gates per-buffer (deadlock ceiling), so the same chunk passes."""
+        from uccl_tpu.collective import dma
+
+        world, itemsize = 4, 4
+        pair = 2 * world * dma.CHUNK_QUANTUM * itemsize
+        monkeypatch.setenv("UCCL_TPU_PALLAS_CCL_MAX_BYTES", str(pair + 1))
+        monkeypatch.setenv("UCCL_TPU_PALLAS_CCL_INTERP_MAX_BYTES", str(pair))
+        dma.MAX_VMEM_BYTES.reset()
+        dma.MAX_INTERP_BYTES.reset()
+        try:
+            assert not dma.chunk_budget(world, 1, itemsize, "t",
+                                        interpret=False)
+            assert dma.check_budget(pair, "t", False)  # 1 pair fits
+            assert dma.chunk_budget(world, 1, itemsize, "t", interpret=True)
+        finally:
+            monkeypatch.delenv("UCCL_TPU_PALLAS_CCL_MAX_BYTES")
+            monkeypatch.delenv("UCCL_TPU_PALLAS_CCL_INTERP_MAX_BYTES")
+            dma.MAX_VMEM_BYTES.reset()
+            dma.MAX_INTERP_BYTES.reset()
+
+    def test_over_budget_chunked_falls_back_clean(self, devices, rng,
+                                                  monkeypatch):
+        from uccl_tpu.collective import dma
+
+        rejected = []
+        orig = dma.chunk_budget
+
+        def spy(world, elems, itemsize, what, interpret=None):
+            ok = orig(world, elems, itemsize, what, interpret)
+            if not ok:
+                rejected.append(what)
+            return ok
+
+        monkeypatch.setattr(dma, "chunk_budget", spy)
+        monkeypatch.setenv("UCCL_TPU_PALLAS_CCL_MAX_BYTES", "64")
+        dma.MAX_VMEM_BYTES.reset()
+        try:
+            mesh = _mesh(devices, 4)
+            x = jnp.asarray(rng.normal(size=(4, 4, 8, 16)), jnp.float32)
+            got = np.asarray(_run(
+                mesh,
+                lambda v: pallas_a2a.all_to_all(
+                    v[0], "ep", n_chunks=2, chunk_axis=2
+                )[None],
+                x,
+            ))
+            want = np.asarray(_run(
+                mesh,
+                lambda v: jax.lax.all_to_all(
+                    v[0], "ep", 0, 0, tiled=True
+                )[None],
+                x,
+            ))
+            np.testing.assert_array_equal(got, want)
+            assert "ep_all_to_all_chunked" in rejected
+        finally:
+            monkeypatch.delenv("UCCL_TPU_PALLAS_CCL_MAX_BYTES")
+            dma.MAX_VMEM_BYTES.reset()
+
+
+class TestChunkedSortedPath:
+    """dispatch_sorted/combine_sorted with n_chunks ∈ {1, 2, 4} pinned to
+    the unchunked lax wire — the SlotPlan form, both sides consuming the
+    one permutation."""
+
+    @pytest.mark.parametrize("n", WORLDS)
+    @pytest.mark.parametrize("chunks", [1, 2, 4])
+    def test_roundtrip_matches_lax(self, devices, rng, n, chunks):
+        mesh = _mesh(devices, n)
+        t, h, e, k = 12, 24, 2 * n, 2
+        cap = max(1, int(1.25 * t * k / e))
+        x, idx, wts = _case(rng, n, t, h, e, k)
+
+        def path(wire, nc):
+            def f(xv, iv, wv):
+                plan = ep_ops.plan_slots(iv[0], e, cap)
+                recv = ep_ops.dispatch_sorted(
+                    xv[0], plan, e, cap, "ep", wire=wire, n_chunks=nc
+                )
+                out = ep_ops.combine_sorted(
+                    recv * 2.0, plan, wv[0], "ep", wire=wire, n_chunks=nc
+                )
+                return recv[None], out[None]
+
+            return _run(
+                mesh, f, jnp.asarray(x), jnp.asarray(idx), jnp.asarray(wts),
+                out_specs=(P("ep"), P("ep")),
+            )
+
+        recv_p, out_p = map(np.asarray, path("pallas", chunks))
+        recv_l, out_l = map(np.asarray, path("lax", 1))
+        np.testing.assert_array_equal(recv_p, recv_l)
+        np.testing.assert_array_equal(out_p, out_l)
+
+    @pytest.mark.parametrize("n", [4, 5])
+    def test_fp8_wire_chunked(self, devices, rng, n):
+        """fp8 groups ride the hidden axis; chunking the capacity axis must
+        leave quantization bit-identical to the unchunked lax wire."""
+        mesh = _mesh(devices, n)
+        t, h, e, k = 8, 32, 2 * n, 2
+        cap = max(1, int(1.25 * t * k / e))
+        x, idx, _ = _case(rng, n, t, h, e, k)
+
+        def f(wire, nc):
+            def g(xv, iv):
+                plan = ep_ops.plan_slots(iv[0], e, cap)
+                return ep_ops.dispatch_sorted(
+                    xv[0], plan, e, cap, "ep", wire_fp8=True, wire=wire,
+                    n_chunks=nc,
+                )[None]
+
+            return np.asarray(_run(mesh, g, jnp.asarray(x),
+                                   jnp.asarray(idx)))
+
+        np.testing.assert_array_equal(f("pallas", 2), f("lax", 1))
+
+
+class TestChunkedLLPath:
+    """The LL dense-chunk format with a chunk-pipelined pallas wire vs
+    wire="dense" — the fp8+scales format stays first-class in the
+    pipeline."""
+
+    @pytest.mark.parametrize("n", [4, 5])
+    @pytest.mark.parametrize("fp8", [False, True])
+    def test_ll_roundtrip_chunked(self, devices, rng, n, fp8):
+        mesh = _mesh(devices, n)
+        t, h, e, k = 8, 32, 2 * n, 2
+        x, idx, wts = _case(rng, n, t, h, e, k)
+
+        def path(wire, nc):
+            def f(xv, iv, wv):
+                r = ep_ll.ll_dispatch(
+                    xv[0], iv[0], wv[0], e, "ep", wire=wire, wire_fp8=fp8,
+                    n_chunks=nc,
+                )
+                out = ep_ll.ll_combine(
+                    r.recv_x * 2.0, r.state, "ep", wire_fp8=fp8
+                )
+                return r.recv_x[None], r.group_sizes[None], out[None]
+
+            return _run(
+                mesh, f, jnp.asarray(x), jnp.asarray(idx), jnp.asarray(wts),
+                out_specs=(P("ep"), P("ep"), P("ep")),
+            )
+
+        rp, gp, op = map(np.asarray, path("pallas", 2))
+        rd, gd, od = map(np.asarray, path("dense", 1))
+        np.testing.assert_array_equal(rp, rd)
+        np.testing.assert_array_equal(gp, gd)
+        np.testing.assert_allclose(op, od, rtol=1e-6, atol=1e-6)
+
+
+class TestChunkedMoELayer:
+    """The tentpole: the chunk-pipelined MoE step (dispatch chunk c+1 /
+    expert GEMM c / combine c-1 as independent per-chunk dependency chains)
+    is numerically identical to the strictly phased lax layer — slot rows
+    are independent through the SwiGLU GEMMs and the wire is
+    position-preserving, so chunking changes the schedule, never the
+    math."""
+
+    @pytest.mark.parametrize("n", WORLDS)
+    @pytest.mark.parametrize("chunks", [2, 4])
+    def test_pipelined_layer_matches_lax(self, devices, rng, n, chunks):
+        mesh = _mesh(devices, n)
+        t, h, f_, e, k = 12, 16, 32, 2 * n, 2
+        x = rng.standard_normal((n, t, h)).astype(np.float32)
+        logits = rng.standard_normal((n, t, e)).astype(np.float32)
+        wg = (rng.standard_normal((e, h, f_)) * 0.2).astype(np.float32)
+        wu = (rng.standard_normal((e, h, f_)) * 0.2).astype(np.float32)
+        wd = (rng.standard_normal((e, f_, h)) * 0.2).astype(np.float32)
+
+        def layer(wire, nc):
+            def f(xv, lv, g, u, d):
+                out, aux, z = ep_ops.moe_ffn(
+                    xv[0], lv[0], g, u, d, "ep", num_selected=k,
+                    capacity_factor=1.25, impl="sort", wire=wire,
+                    n_chunks=nc,
+                )
+                return out[None], aux[None], z[None]
+
+            return _run(
+                mesh, f, *map(jnp.asarray, (x, logits, wg, wu, wd)),
+                out_specs=(P("ep"), P("ep"), P("ep")),
+            )
+
+        out_p, aux_p, z_p = map(np.asarray, layer("pallas", chunks))
+        out_l, aux_l, z_l = map(np.asarray, layer("lax", 1))
+        np.testing.assert_allclose(out_p, out_l, rtol=1e-6, atol=1e-6)
+        np.testing.assert_array_equal(aux_p, aux_l)
+        np.testing.assert_array_equal(z_p, z_l)
+
+    def test_auto_chunks(self, devices, rng):
+        """n_chunks=0 resolves itself (2 when the budget allows) and stays
+        numerically identical to the phased layer."""
+        mesh = _mesh(devices, 4)
+        t, h, f_, e, k = 8, 16, 16, 8, 2
+        x = rng.standard_normal((4, t, h)).astype(np.float32)
+        logits = rng.standard_normal((4, t, e)).astype(np.float32)
+        wg = (rng.standard_normal((e, h, f_)) * 0.2).astype(np.float32)
+        wu = (rng.standard_normal((e, h, f_)) * 0.2).astype(np.float32)
+        wd = (rng.standard_normal((e, f_, h)) * 0.2).astype(np.float32)
+
+        def layer(wire, nc):
+            def f(xv, lv, g, u, d):
+                out, _, _ = ep_ops.moe_ffn(
+                    xv[0], lv[0], g, u, d, "ep", num_selected=k,
+                    capacity_factor=1.25, impl="sort", wire=wire,
+                    n_chunks=nc,
+                )
+                return out[None]
+
+            return np.asarray(_run(
+                mesh, f, *map(jnp.asarray, (x, logits, wg, wu, wd))
+            ))
+
+        np.testing.assert_allclose(
+            layer("pallas", 0), layer("lax", 1), rtol=1e-6, atol=1e-6
+        )
+
+
+class TestChunkedBuffer:
+    """Buffer(wire="pallas", n_chunks=N): the DeepEP surface records the
+    depth in its handles and stays bit-identical to the default wire."""
+
+    @pytest.mark.parametrize("chunks", [2, 0])
+    def test_normal_verbs_chunked(self, devices, rng, chunks):
+        mesh = _mesh(devices, 4)
+        e, k, t, h = 8, 2, 12, 24
+        x, idx, wts = _case(rng, 4, t, h, e, k)
+        ref = Buffer(mesh, "ep", num_experts=e, num_selected=k)
+        buf = Buffer(mesh, "ep", num_experts=e, num_selected=k,
+                     wire="pallas", n_chunks=chunks)
+        xx, ii, ww = map(buf.device_put, (x, idx, wts))
+        recv_r, handle_r = ref.dispatch(xx, ii, ww)
+        out_r = ref.combine(recv_r * 2.0, handle_r)
+        recv, handle = buf.dispatch(xx, ii, ww)
+        out = buf.combine(recv * 2.0, handle)
+        assert handle.wire == "pallas" and handle.n_chunks == 2
+        assert handle_r.n_chunks == 1
+        np.testing.assert_array_equal(np.asarray(recv), np.asarray(recv_r))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out_r))
+
+    def test_ll_verbs_chunked(self, devices, rng):
+        mesh = _mesh(devices, 4)
+        e, k, t, h = 8, 2, 8, 32
+        x, idx, wts = _case(rng, 4, t, h, e, k)
+        ref = Buffer(mesh, "ep", num_experts=e, num_selected=k)
+        buf = Buffer(mesh, "ep", num_experts=e, num_selected=k,
+                     wire="pallas", n_chunks=2)
+        xx, ii, ww = map(buf.device_put, (x, idx, wts))
+        recv_r, counts_r, handle_r = ref.low_latency_dispatch(
+            xx, ii, None, ww, wire_fp8=True
+        )
+        out_r = ref.low_latency_combine(recv_r * 2.0, handle_r)
+        recv, counts, handle = buf.low_latency_dispatch(
+            xx, ii, None, ww, wire_fp8=True
+        )
+        out = buf.low_latency_combine(recv * 2.0, handle)
+        assert handle.wire == "pallas" and handle.n_chunks == 2
+        np.testing.assert_array_equal(np.asarray(recv), np.asarray(recv_r))
+        np.testing.assert_array_equal(np.asarray(counts),
+                                      np.asarray(counts_r))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(out_r), rtol=1e-6, atol=1e-6
+        )
+
+    def test_config_chunks_selects(self, devices, rng):
+        """Config(n_chunks=2) fills the knob a default Buffer left unset."""
+        from uccl_tpu.ep import Config
+
+        mesh = _mesh(devices, 4)
+        e, k, t, h = 8, 2, 8, 16
+        x, idx, wts = _case(rng, 4, t, h, e, k)
+        buf = Buffer(mesh, "ep", num_experts=e, num_selected=k)
+        xx, ii, ww = map(buf.device_put, (x, idx, wts))
+        cfg = Config(wire="pallas", wire_fp8=False, n_chunks=2)
+        recv, handle = buf.dispatch(xx, ii, ww, config=cfg)
+        assert handle.wire == "pallas" and handle.n_chunks == 2
+        recv_d, handle_d = buf.dispatch(xx, ii, ww)
+        assert handle_d.wire == "lax" and handle_d.n_chunks == 1
+        np.testing.assert_array_equal(np.asarray(recv), np.asarray(recv_d))

@@ -52,6 +52,85 @@ MAX_INTERP_BYTES = _config.param(
 
 MESH = pltpu.DeviceIdType.MESH
 
+# collective_id allocation for kernels that may be IN FLIGHT concurrently.
+# Mosaic's entry-barrier semaphore is keyed by collective_id, so two kernels
+# sharing one id must never overlap; the chunk pipeline deliberately keeps
+# dispatch chunk c+1 and combine chunk c-1 airborne while chunk c computes,
+# so each family rotates its own 2-parity id pair (the launch-granularity
+# form of the kernels' internal 2-parity slot rotation), and tie_chunk()
+# orders chunk c after chunk c-2 so at most TWO same-family kernels are
+# ever in flight — the invariant that makes a 2-id rotation (and the
+# 2-resident-pair chunk_budget charge) sound at any n_chunks. fp8 wire
+# payloads ride two exchanges (values + scales) with no data dependency
+# between them, so scales ride the value id shifted by CID_SCALE_OFFSET.
+# Allocation: 0 = the ring collectives (pallas_ccl default),
+# {2,3}/{4,5}/{6,7} = dispatch/combine/generic-a2a value lanes,
+# {10,11}/{12,13}/{14,15} = their scale lanes.
+CID_EP_DISPATCH = 2  # dispatch chunks rotate {2, 3}
+CID_EP_COMBINE = 4  # combine chunks rotate {4, 5}
+CID_A2A = 6  # the generic/unchunked EP all-to-all lane, rotating {6, 7}
+CID_SCALE_OFFSET = 8  # fp8 scale exchange = value id + 8
+
+
+def chunk_collective_id(base: int, chunk: int) -> int:
+    """2-deep rotation: chunk kernels alternate ``base``/``base+1`` so chunk
+    c+1 can enter while chunk c-1 drains, without sharing barrier/credit
+    semaphores — the double-buffer discipline at kernel-launch granularity.
+    Sound only together with :func:`tie_chunk`, which keeps chunk c and the
+    id-sharing chunk c-2 from ever being airborne at once."""
+    return base + (chunk & 1)
+
+
+def tie_chunk(x, prev):
+    """The launch-granularity credit of the chunk pipeline: order chunk c's
+    kernel input after chunk c-2's OUTPUT, so the two chunks sharing a
+    collective id parity can never be in flight together (and no more than
+    two chunk kernels — the 2 resident pairs chunk_budget charges — ever
+    are). ``prev`` is chunk c-2's result (or None for c < 2); the tie is a
+    real dataflow edge (``lax.optimization_barrier``), not a host sync, so
+    chunk c+1 still overlaps chunk c freely."""
+    if prev is None:
+        return x
+    x, _ = lax.optimization_barrier((x, prev))
+    return x
+
+
+def pad_capacity(cap: int, n_chunks: int) -> int:
+    """Round a capacity/slot count up to a multiple of ``n_chunks`` — the ONE
+    rounding rule for every chunked EP pipeline (the device-level chunked
+    wire pads its slot axis with empty slots by this rule; the host-level
+    cross-pod pipeline sizes its per-pod capacity with it), so the two
+    pipelines cannot drift on drop semantics."""
+    n_chunks = max(1, int(n_chunks))
+    if cap % n_chunks:
+        cap += n_chunks - cap % n_chunks
+    return cap
+
+
+def chunk_budget(world: int, chunk_elems_per_peer: int, itemsize: int,
+                 what: str, interpret=None, resident_kernels: int = 2,
+                 quiet: bool = False) -> bool:
+    """Budget gate for the double-buffered chunk pipeline:
+    ``resident_kernels`` chunk kernels are resident at once, each holding a
+    send+recv pair of ``[world, m]`` padded slots. A single chunked
+    exchange keeps 2 (the 2-deep rotation); the fully pipelined MoE layer
+    keeps 4 — tie_chunk bounds each FAMILY (dispatch, combine) to two in
+    flight, and both families are airborne while a chunk's GEMM runs.
+    Charged up front so the pipeline falls back to the unchunked wire as a
+    whole instead of degrading mid-flight.
+
+    Under the interpreter the residency multiplier does NOT apply: that
+    ceiling exists to keep any single interpret-mode buffer below the
+    1-core deadlock threshold (see CHUNK_QUANTUM), chunk kernels run
+    sequentially there, and chunking SHRINKS per-kernel buffers — charging
+    residency would perversely gate the chunked wire harder than the
+    unchunked one it falls back to."""
+    m = padded_chunk_elems(chunk_elems_per_peer)
+    interpret = resolve_interpret(interpret)
+    pair = 2 * world * m * itemsize
+    return check_budget(pair if interpret else resident_kernels * pair,
+                        what, interpret, quiet=quiet)
+
 
 def pad_chunks(flat: jax.Array, parts: int) -> Tuple[jax.Array, int, int]:
     """Split ``flat`` into ``parts`` equal chunks of k elements (tail
@@ -158,16 +237,35 @@ def all_barrier(axis, n: int):
     pltpu.semaphore_wait(sem, n - 1)
 
 
-def check_budget(nbytes: int, what: str, interpret: bool) -> bool:
+def budget_limit(interpret: bool) -> int:
+    """The effective payload ceiling (no logging): the VMEM budget, further
+    clamped by the interpreter's per-buffer deadlock ceiling under interpret
+    mode. Exposed so observers (benches labeling which transport actually
+    carried an arm) share the gate's arithmetic instead of mirroring it."""
     limit = MAX_VMEM_BYTES.get()
     if interpret:
         limit = min(limit, MAX_INTERP_BYTES.get())
-    if nbytes > limit:
-        from uccl_tpu.utils.logging import log
+    return limit
 
-        log("INFO", "CCL",
-            f"pallas {what}: {nbytes}B exceeds "
-            f"{'interpreter' if interpret else 'VMEM'} budget {limit}B; "
-            "falling back to the XLA collective lowering")
+
+def padded_chunk_elems(elems_per_peer: int) -> int:
+    """Elements per peer after the CHUNK_QUANTUM padding pad_chunks applies
+    — the m in the kernels' [world, m] slot layout."""
+    return -(-elems_per_peer // CHUNK_QUANTUM) * CHUNK_QUANTUM
+
+
+def check_budget(nbytes: int, what: str, interpret: bool,
+                 quiet: bool = False) -> bool:
+    """``quiet`` suppresses the fallback log — for observers (bench labels)
+    asking what the gate WOULD decide, not taking the fallback."""
+    limit = budget_limit(interpret)
+    if nbytes > limit:
+        if not quiet:
+            from uccl_tpu.utils.logging import log
+
+            log("INFO", "CCL",
+                f"pallas {what}: {nbytes}B exceeds "
+                f"{'interpreter' if interpret else 'VMEM'} budget {limit}B; "
+                "falling back to the XLA collective lowering")
         return False
     return True

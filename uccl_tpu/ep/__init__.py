@@ -16,7 +16,9 @@ Surfaces:
 * :mod:`uccl_tpu.ep.pallas_a2a` — device-initiated all-to-all: the member-major
   exchange as ONE Pallas kernel issuing inter-chip remote DMAs (write-once
   per-source slots, credit-granted flow control) — selected via
-  ``Buffer(..., wire="pallas")`` for both the normal and LL row formats.
+  ``Buffer(..., wire="pallas")`` for both the normal and LL row formats;
+  ``n_chunks=N`` chunk-pipelines it (double-buffered per-chunk kernels, so
+  the MoE layer overlaps expert GEMMs with dispatch/combine DMAs).
 * :class:`uccl_tpu.ep.Buffer` — DeepEP-shaped host API (dispatch / combine /
   low_latency_dispatch / low_latency_combine / get_dispatch_layout), including
   the overlap half of the contract: :class:`uccl_tpu.ep.EventOverlap`

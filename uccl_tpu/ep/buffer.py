@@ -88,12 +88,15 @@ class Config:
     ``wire`` picks the transport: ``ragged``/``dense`` are the LL layouts on
     XLA collectives, ``pallas`` is the device-initiated remote-DMA
     all-to-all (:mod:`uccl_tpu.ep.pallas_a2a`; applies to BOTH the normal
-    and LL verbs), ``auto`` defers to the Buffer/backend resolution."""
+    and LL verbs), ``auto`` defers to the Buffer/backend resolution.
+    ``n_chunks`` is the pallas-wire chunk-pipeline depth (0 = auto, 1 =
+    strictly phased; ignored off the pallas wire)."""
 
     max_tokens_per_rank: Optional[int] = None  # LL recv-buffer sizing
     pair_capacity_factor: Optional[float] = None  # dense-wire pair capacity
     wire: str = "auto"  # ragged | dense | pallas | auto
     wire_fp8: bool = True
+    n_chunks: Optional[int] = None  # pallas chunk-pipeline depth (0 = auto)
 
 
 class DispatchHandle(NamedTuple):
@@ -108,13 +111,15 @@ class DispatchHandle(NamedTuple):
     from it instead of assuming full capacity.
 
     ``wire`` records which transport carried dispatch ("lax" XLA collective
-    or "pallas" device-initiated remote DMA) so combine retraces the same
-    path without re-resolving — the same role LowLatencyHandle.wire plays."""
+    or "pallas" device-initiated remote DMA) and ``n_chunks`` its
+    chunk-pipeline depth, so combine retraces the same path without
+    re-resolving — the same role LowLatencyHandle.wire plays."""
 
     slot: jax.Array  # [W, T, K] int32 slot per assignment (E*C = dropped)
     weights: jax.Array  # [W, T, K] f32 gate weights
     recv_counts: jax.Array  # [W, W_src, E_local] int32 (always populated)
     wire: str = "lax"  # lax | pallas (defaulted: pre-wire handles pickle)
+    n_chunks: int = 1  # pallas chunk depth (defaulted: pre-chunk handles)
 
 
 class LowLatencyHandle(NamedTuple):
@@ -131,6 +136,7 @@ class LowLatencyHandle(NamedTuple):
     src_in_offsets: jax.Array  # [W, W]
     wire: str
     wire_fp8: bool
+    n_chunks: int = 1  # pallas chunk depth (defaulted: pre-chunk handles)
 
 
 class Buffer:
@@ -146,7 +152,15 @@ class Buffer:
     through the device-initiated remote-DMA all-to-all kernel
     (:mod:`uccl_tpu.ep.pallas_a2a`), keeping ``lax`` as the transparent
     fallback past its VMEM budget or where the kernel cannot address the
-    mesh (legacy interpreters on multi-axis meshes)."""
+    mesh (legacy interpreters on multi-axis meshes).
+
+    ``n_chunks`` sets the pallas wire's chunk-pipeline depth: the
+    capacity/slot axis splits into that many double-buffered per-chunk
+    kernels on rotated collective ids, so a consumer's expert compute can
+    hide under the neighboring chunks' DMAs (0 = auto, 1 = strictly
+    phased). Identical numerics either way; over the 2x double-buffer
+    budget the verbs fall back to the unchunked wire automatically, and
+    the knob is ignored off the pallas wire."""
 
     def __init__(
         self,
@@ -157,6 +171,7 @@ class Buffer:
         num_selected: int = 2,
         capacity_factor: float = 1.25,
         wire: str = "auto",
+        n_chunks: int = 1,
     ):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.axes = (axis,) if isinstance(axis, str) else tuple(axis)
@@ -170,11 +185,15 @@ class Buffer:
                 f"unknown wire {wire!r} (want 'auto', 'ragged', 'dense', or "
                 "'pallas')"
             )
+        if n_chunks < 0:
+            raise ValueError(f"n_chunks must be >= 0 (0 = auto), got "
+                             f"{n_chunks}")
         self.num_experts = num_experts
         self.num_local_experts = num_experts // self.world
         self.num_selected = num_selected
         self.capacity_factor = capacity_factor
         self.wire = wire
+        self.n_chunks = n_chunks
         self._cache = {}
         # per-op stats (reference: EP Stats bound at uccl_ep.cc:2411 and the
         # dispatch_wait_recv_cost_stats tensor plumbed through
@@ -221,6 +240,23 @@ class Buffer:
             )
             wire = "auto"
         return wire
+
+    def _resolve_chunks(self, requested, config, wire: str) -> int:
+        """Effective chunk-pipeline depth for a verb: explicit call value,
+        else the Config, else the Buffer's. Collapses to 1 off the pallas
+        wire or at world 1; 0 stays 0 (= auto) for the per-shard resolver,
+        which also owns the double-buffer budget fallback."""
+        n = requested
+        if n is None and config is not None:
+            n = config.n_chunks
+        if n is None:
+            n = self.n_chunks
+        n = int(n)
+        if n < 0:  # same contract as the Buffer constructor
+            raise ValueError(f"n_chunks must be >= 0 (0 = auto), got {n}")
+        if wire != "pallas" or self.world <= 1:
+            return 1
+        return n
 
     def _spec(self, extra_dims: int) -> P:
         return P(self.axes, *([None] * extra_dims))
@@ -398,22 +434,30 @@ class Buffer:
         k = topk_idx.shape[-1]
         cap = self.capacity(t)
         e = self.num_experts
+        n_chunks = self._resolve_chunks(None, config, wire)
+        if n_chunks != 1:
+            n_chunks = ep_ops.resolve_chunks(
+                n_chunks, wire, self.world, cap, self.num_local_experts, h,
+                ep_ops.wire_itemsize(wire_fp8, h, x.dtype),
+            )
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
         key = ("dispatch", x.shape, topk_idx.shape, wire_fp8, x.dtype, wire,
-               has_ev and (tok.shape, tok.dtype))
+               n_chunks, has_ev and (tok.shape, tok.dtype))
 
         def f(xv, idx, *tok_arg):
             xv, idx = xv[0], idx[0]
             if tok_arg:
                 xv = _tie(xv, tok_arg[0])
-            # sorted/ragged layout (the fast path): one argsort assigns
-            # capacity slots; dispatch is a gather; drops match the dense
-            # oracle exactly (ep/ops.py)
-            token_for_slot, slot, kept = ep_ops.sorted_from_topk(idx, e, cap)
+            # sorted/ragged layout (the fast path): ONE argsort per routing
+            # decision builds the SlotPlan both sides of the layer consume;
+            # dispatch is a gather; drops match the dense oracle exactly
+            # (ep/ops.py)
+            plan = ep_ops.plan_slots(idx, e, cap)
+            slot, kept = plan.slot, plan.kept
             recv = ep_ops.dispatch_sorted(
-                xv, token_for_slot, e, cap, self._axis_name(),
-                wire_fp8=wire_fp8, wire=wire,
+                xv, plan, e, cap, self._axis_name(),
+                wire_fp8=wire_fp8, wire=wire, n_chunks=n_chunks,
             )
             # per-(source, local-expert) received-row counts: kept[E] is MY
             # contribution per global expert; the all_to_all hands each
@@ -437,7 +481,8 @@ class Buffer:
         self._op_counts["dispatch"] += 1
         self._last_dispatch = (topk_idx, cap)
         # weights go straight into the handle (combine reshards them itself)
-        handle = DispatchHandle(slot, topk_weights, recv_counts, wire)
+        handle = DispatchHandle(slot, topk_weights, recv_counts, wire,
+                                n_chunks)
         if async_finish:
             return recv, handle, EventOverlap((recv, slot, recv_counts))
         return recv, handle
@@ -467,17 +512,18 @@ class Buffer:
                 "async_finish (reference precondition, buffer.py:826)"
             )
         wire = handle.wire
+        n_chunks = handle.n_chunks  # retrace dispatch's chunking exactly
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
         key = ("combine", expert_out.shape, handle.slot.shape, wire_fp8,
-               wire, has_ev and (tok.shape, tok.dtype))
+               wire, n_chunks, has_ev and (tok.shape, tok.dtype))
 
         def f(y, slot, wts, *tok_arg):
             if tok_arg:
                 y = _tie(y, tok_arg[0])
             out = ep_ops.combine_sorted(
                 y[0], slot[0], wts[0], self._axis_name(),
-                wire_fp8=wire_fp8, wire=wire,
+                wire_fp8=wire_fp8, wire=wire, n_chunks=n_chunks,
             )
             return out[None]
 
@@ -503,6 +549,7 @@ class Buffer:
         pair_capacity_factor: Optional[float] = None,
         wire: str = "auto",
         wire_fp8: Optional[bool] = None,
+        n_chunks: Optional[int] = None,
         config: Optional[Config] = None,
         previous_event: Optional[EventOverlap] = None,
         async_finish: bool = False,
@@ -549,6 +596,16 @@ class Buffer:
         wire = self._resolve_wire(wire, None)
         if wire == "auto":
             wire = "ragged" if ep_ll.wire_supports_ragged() else "dense"
+        # resolve the chunk depth HERE (the shared ll rule) so the handle
+        # records exactly the depth dispatch traced with
+        per_pair, _ = ep_ll.ll_bounds(
+            t, k, self.num_local_experts, self.world,
+            num_max_dispatch_tokens_per_rank, pair_capacity_factor,
+        )
+        n_chunks = ep_ll.resolve_ll_chunks(
+            self._resolve_chunks(n_chunks, config, wire), wire, self.world,
+            per_pair,
+        )
         if topk_weights is None:
             topk_weights = jnp.full(topk_idx.shape, 1.0 / k, jnp.float32)
         has_ev = previous_event is not None
@@ -556,7 +613,7 @@ class Buffer:
         key = (
             "ll_dispatch", x.shape, topk_idx.shape, x.dtype,
             num_max_dispatch_tokens_per_rank, pair_capacity_factor, wire,
-            wire_fp8, has_ev and (tok.shape, tok.dtype),
+            wire_fp8, n_chunks, has_ev and (tok.shape, tok.dtype),
         )
 
         def f(xv, idx, wts, *tok_arg):
@@ -568,7 +625,7 @@ class Buffer:
                     num_max_dispatch_tokens_per_rank
                 ),
                 pair_capacity_factor=pair_capacity_factor,
-                wire=wire, wire_fp8=wire_fp8,
+                wire=wire, wire_fp8=wire_fp8, n_chunks=n_chunks,
             )
             s = r.state
             return (
@@ -584,7 +641,7 @@ class Buffer:
          src_in_offsets) = fn(*args)
         handle = LowLatencyHandle(
             send_slot, weights, send_mat, recv_mat, regroup,
-            src_in_offsets, wire, wire_fp8,
+            src_in_offsets, wire, wire_fp8, n_chunks,
         )
         self._op_counts["low_latency_dispatch"] += 1
         self._last_ll = (counts, recv_x.shape[1], x.shape[-1], wire_fp8)
@@ -614,7 +671,7 @@ class Buffer:
         key = (
             "ll_combine", expert_out.shape, handle.send_slot.shape,
             expert_out.dtype, handle.wire, handle.wire_fp8,
-            has_ev and (tok.shape, tok.dtype),
+            handle.n_chunks, has_ev and (tok.shape, tok.dtype),
         )
 
         def f(y, send_slot, wts, send_mat, recv_mat, regroup, src_off,
@@ -623,7 +680,7 @@ class Buffer:
                 y = _tie(y, tok_arg[0])
             state = ep_ll.LLState(
                 send_slot[0], wts[0], send_mat[0], recv_mat[0],
-                regroup[0], src_off[0], handle.wire,
+                regroup[0], src_off[0], handle.wire, handle.n_chunks,
             )
             out = ep_ll.ll_combine(
                 y[0], state, self._axis_name(), wire_fp8=handle.wire_fp8

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from uccl_tpu.collective import dma as _dma
 from uccl_tpu.collective.hierarchical import DcnGroup
 from uccl_tpu.ep import ops as ep_ops
 
@@ -148,10 +149,10 @@ class CrossPodMoE:
             1,
             int(self.capacity_factor * t * self.num_selected / self.n_pods),
         )
-        # chunked pipelining slices the slot axis evenly
-        if cap % self.n_chunks:
-            cap += self.n_chunks - cap % self.n_chunks
-        return cap
+        # chunked pipelining slices the slot axis evenly — the SAME rounding
+        # rule as the device-level chunked wire (dma.pad_capacity), so the
+        # host and device pipelines cannot drift on drop semantics
+        return _dma.pad_capacity(cap, self.n_chunks)
 
     def _local_fn(self, expert_fn):
         """The pure per-pod compute: (xs [S,H], idx [S,K] local ids with -1

@@ -51,6 +51,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
+from uccl_tpu.collective import dma as _dma
 from uccl_tpu.ep.ops import MOE_CHECKPOINT_NAMES
 from uccl_tpu.ep.ops import counts_exchange as _counts_exchange
 from uccl_tpu.ops.quant import dequantize_fp8, quantize_fp8
@@ -68,13 +69,26 @@ def wire_supports_ragged() -> bool:
 
 
 def _adapt_group(h: int, quant_group: int) -> Optional[int]:
-    """Largest divisor of h ≤ quant_group, or None when fp8 wouldn't pay
-    (1 fp8 byte + 4/g scale bytes beats bf16's 2 only for g > 4)."""
-    if h % quant_group:
-        quant_group = max(
-            d for d in range(min(quant_group, h), 0, -1) if h % d == 0
-        )
-    return quant_group if quant_group >= 8 else None
+    """Largest divisor of h ≤ quant_group (shared rule: ops._adapt_quant_group),
+    or None when fp8 wouldn't pay (1 fp8 byte + 4/g scale bytes beats
+    bf16's 2 only for g > 4)."""
+    from uccl_tpu.ep.ops import _adapt_quant_group
+
+    g = _adapt_quant_group(h, quant_group)
+    return g if g >= 8 else None
+
+
+def resolve_ll_chunks(n_chunks: int, wire: str, world: int,
+                      per_pair: int) -> int:
+    """Effective chunk-pipeline depth for the LL dense-chunk wire (shared
+    with the Buffer verbs so the handle records exactly what dispatch ran):
+    1 off the pallas wire or at world 1; 0 = auto (2 when the per-pair slot
+    axis can split); clamped to per_pair."""
+    if wire != "pallas" or world <= 1:
+        return 1
+    if n_chunks == 0:
+        n_chunks = 2 if per_pair >= 2 else 1
+    return max(1, min(int(n_chunks), per_pair))
 
 
 class LLState(NamedTuple):
@@ -88,7 +102,9 @@ class LLState(NamedTuple):
     regroup: jax.Array  # [R_max] int32 perm: grouped row i ← wire row
     src_in_offsets: jax.Array  # [W] int32 where my chunk sat in each source's
     #   send buffer (ragged-wire reverse path; zeros on dense wire)
-    wire: str  # "ragged" | "dense"
+    wire: str  # "ragged" | "dense" | "pallas"
+    n_chunks: int = 1  # pallas-wire chunk-pipeline depth (static; combine
+    #   retraces dispatch's chunking without re-resolving)
 
 
 class LLDispatchResult(NamedTuple):
@@ -238,30 +254,39 @@ def _dense_exchange(rows, w: int, axis):
     ).reshape(shape)
 
 
-def _pallas_exchange(rows, w: int, axis):
+def _pallas_exchange(rows, w: int, axis, *, n_chunks=1, collective_id=None):
     """The dense-chunk layout on the device-initiated wire: same [W*per_pair,
     ...] contract as :func:`_dense_exchange`, but the member-major exchange is
     the Pallas remote-DMA all-to-all kernel (uccl_tpu.ep.pallas_a2a) instead
-    of an XLA collective."""
+    of an XLA collective. ``n_chunks > 1`` splits the per-pair slot axis into
+    that many double-buffered chunk kernels on rotated collective ids."""
     from uccl_tpu.ep import pallas_a2a
 
     shape = rows.shape
     return pallas_a2a.all_to_all(
-        rows.reshape(w, shape[0] // w, *shape[1:]), axis
+        rows.reshape(w, shape[0] // w, *shape[1:]), axis,
+        n_chunks=n_chunks, chunk_axis=1, collective_id=collective_id,
     ).reshape(shape)
 
 
-def _send_payload(send_rows, out_rows, w, spec, wire, axis, fp8_group, dtype):
+def _send_payload(send_rows, out_rows, w, spec, wire, axis, fp8_group, dtype,
+                  *, n_chunks=1, collective_id=None):
     """Move a row payload across the wire, optionally fp8+scales."""
-    exchange = {
-        "ragged": lambda rows: _ragged_exchange(rows, out_rows, spec, axis),
-        "dense": lambda rows: _dense_exchange(rows, w, axis),
-        "pallas": lambda rows: _pallas_exchange(rows, w, axis),
-    }[wire]
+
+    def exchange(rows, cid_off=0):
+        if wire == "ragged":
+            return _ragged_exchange(rows, out_rows, spec, axis)
+        if wire == "dense":
+            return _dense_exchange(rows, w, axis)
+        cid = None if collective_id is None else collective_id + cid_off
+        return _pallas_exchange(rows, w, axis, n_chunks=n_chunks,
+                                collective_id=cid)
+
     if fp8_group is not None:
         q, scale = quantize_fp8(send_rows, fp8_group)
         return dequantize_fp8(
-            exchange(q), exchange(scale), fp8_group, dtype=dtype
+            exchange(q), exchange(scale, _dma.CID_SCALE_OFFSET),
+            fp8_group, dtype=dtype,
         )
     return exchange(send_rows)
 
@@ -278,8 +303,15 @@ def ll_dispatch(
     wire: str = "auto",
     wire_fp8: bool = True,
     quant_group: int = 128,
+    n_chunks: int = 1,
 ) -> LLDispatchResult:
-    """Packed low-latency dispatch (per-shard). See module docstring."""
+    """Packed low-latency dispatch (per-shard). See module docstring.
+
+    ``n_chunks`` (pallas wire only; 0 = auto) splits the per-pair slot axis
+    of the dense-chunk exchange into double-buffered chunk kernels — the LL
+    grouped GEMM regroups across sources, so here chunking pipelines the
+    WIRE itself (and whatever compute XLA schedules beside it), not a
+    per-chunk GEMM like the sorted layer's pipelined step."""
     w = lax.axis_size(axis)
     t, h = x.shape
     k = topk_idx.shape[-1]
@@ -297,6 +329,7 @@ def ll_dispatch(
             f"unknown LL wire {wire!r} (want 'auto', 'ragged', 'dense', or "
             "'pallas')"
         )
+    n_chunks = resolve_ll_chunks(n_chunks, wire, w, per_pair)
     if topk_weights is None:
         topk_weights = jnp.full((t, k), 1.0 / k, jnp.float32)
     fp8_group = _adapt_group(h, quant_group) if wire_fp8 else None
@@ -328,7 +361,8 @@ def ll_dispatch(
         src_in_offsets = jnp.zeros((w,), jnp.int32)
 
     recv_rows = _send_payload(
-        send_rows, r_max, w, spec, wire, axis, fp8_group, x.dtype
+        send_rows, r_max, w, spec, wire, axis, fp8_group, x.dtype,
+        n_chunks=n_chunks, collective_id=_dma.CID_EP_DISPATCH,
     )
 
     regroup = _regroup_perm(recv_mat, per_pair, wire)
@@ -336,7 +370,7 @@ def ll_dispatch(
     group_sizes = recv_mat.sum(0).astype(jnp.int32)
     state = LLState(
         send_slot, topk_weights, send_mat, recv_mat, regroup,
-        src_in_offsets, wire,
+        src_in_offsets, wire, n_chunks,
     )
     return LLDispatchResult(recv_x, group_sizes, state)
 
@@ -382,6 +416,7 @@ def ll_combine(
     back = _send_payload(
         wire_rows, out_rows, w, spec, state.wire, axis, fp8_group,
         expert_out.dtype,
+        n_chunks=state.n_chunks, collective_id=_dma.CID_EP_COMBINE,
     )
 
     yk = jnp.take(
@@ -430,6 +465,7 @@ def ll_moe_ffn(
     wire: str = "auto",
     wire_fp8: bool = False,
     renormalize: bool = True,
+    n_chunks: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full MoE layer on the low-latency path: route → packed dispatch →
     grouped GEMMs over counts → packed combine. Drop-free by default (the
@@ -447,7 +483,7 @@ def ll_moe_ffn(
         x, topk_idx, topk_vals, e, axis,
         num_max_dispatch_tokens_per_rank=num_max_dispatch_tokens_per_rank,
         pair_capacity_factor=pair_capacity_factor,
-        wire=wire, wire_fp8=wire_fp8,
+        wire=wire, wire_fp8=wire_fp8, n_chunks=n_chunks,
     )
     y = grouped_ffn(
         r.recv_x, r.group_sizes,

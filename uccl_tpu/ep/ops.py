@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
+from uccl_tpu.collective import dma as _dma
 from uccl_tpu.ops.quant import dequantize_fp8, quantize_fp8
 
 # checkpoint_name tags on the expert-GEMM operands/results, shared by the
@@ -203,6 +204,44 @@ def sorted_from_topk(
     return token_for_slot, slot, kept
 
 
+class SlotPlan(NamedTuple):
+    """The slot permutation of ONE routing decision, computed once and
+    consumed by BOTH sides of the layer: dispatch gathers payload rows with
+    ``token_for_slot`` (the forward permutation), combine gathers returned
+    rows with ``slot`` (its inverse). Both views come out of the single
+    stable argsort in :func:`sorted_from_topk`; building the plan once per
+    routing decision (instead of re-deriving index math on each side) is
+    what keeps the two sides structurally unable to disagree on drops —
+    and gives the chunk-pipelined layer one shared index set to slice."""
+
+    token_for_slot: jax.Array  # [E*C] int32 source token per slot (T = empty)
+    slot: jax.Array  # [T, K] int32 slot per assignment (E*C = dropped)
+    kept: jax.Array  # [E] int32 tokens kept per expert
+
+    def chunk_token_for_slot(self, num_experts: int, n_chunks: int,
+                             empty_sentinel: int) -> jax.Array:
+        """Per-chunk gather indices for the pipelined layer: the [E*C] slot
+        axis padded (``dma.pad_capacity`` — the shared rounding rule) with
+        empty slots and resliced to [n_chunks, E * C_pad/n_chunks]. Padding
+        lives only on the wire; it never changes which tokens drop."""
+        cap = self.token_for_slot.shape[0] // num_experts
+        cap_p = _dma.pad_capacity(cap, n_chunks)
+        tfs = self.token_for_slot.reshape(num_experts, cap)
+        if cap_p != cap:
+            tfs = jnp.pad(tfs, ((0, 0), (0, cap_p - cap)),
+                          constant_values=empty_sentinel)
+        cs = cap_p // n_chunks
+        return tfs.reshape(num_experts, n_chunks, cs).transpose(1, 0, 2)
+
+
+def plan_slots(
+    topk_idx: jax.Array, num_experts: int, capacity: int
+) -> SlotPlan:
+    """One argsort → the reusable :class:`SlotPlan` for a routing decision
+    (dispatch- and combine-side gather indices plus kept counts)."""
+    return SlotPlan(*sorted_from_topk(topk_idx, num_experts, capacity))
+
+
 def route_topk_sorted(
     router_logits: jax.Array,
     num_selected: int,
@@ -222,7 +261,7 @@ def route_topk_sorted(
 
 def dispatch_sorted(
     x: jax.Array,
-    token_for_slot: jax.Array,
+    token_for_slot,
     num_experts: int,
     capacity: int,
     axis: Axis,
@@ -230,10 +269,17 @@ def dispatch_sorted(
     wire_fp8: bool = False,
     quant_group: int = 128,
     wire: str = "lax",
+    n_chunks: int = 1,
 ) -> jax.Array:
     """Ragged dispatch: one gather packs [E*C, H] slot payloads, then the same
     member-major all-to-all as the dense path. Empty slots (sentinel index T,
-    out of bounds) gather as zeros. Returns [E_local, W*C, H]."""
+    out of bounds) gather as zeros. ``token_for_slot`` may be the raw [E*C]
+    index array or a :class:`SlotPlan` (the once-per-routing-decision form).
+    ``n_chunks > 1`` splits the capacity axis of the pallas wire into that
+    many double-buffered chunk kernels (identical numerics; lax wire
+    ignores it — XLA owns that schedule). Returns [E_local, W*C, H]."""
+    if isinstance(token_for_slot, SlotPlan):
+        token_for_slot = token_for_slot.token_for_slot
     w = lax.axis_size(axis)
     if num_experts % w:
         raise ValueError(f"experts {num_experts} not divisible by EP world {w}")
@@ -241,29 +287,38 @@ def dispatch_sorted(
     h = x.shape[-1]
     buf = jnp.take(x, token_for_slot, axis=0, mode="fill", fill_value=0)
     buf = buf.reshape(w, e_local, capacity, h)
-    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype, wire)
+    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype, wire,
+                           n_chunks=n_chunks, chunk_axis=2,
+                           collective_id=_dma.CID_EP_DISPATCH)
     return buf.transpose(1, 0, 2, 3).reshape(e_local, w * capacity, h)
 
 
 def combine_sorted(
     expert_out: jax.Array,
-    slot: jax.Array,
+    slot,
     weights: jax.Array,
     axis: Axis,
     *,
     wire_fp8: bool = False,
     quant_group: int = 128,
     wire: str = "lax",
+    n_chunks: int = 1,
 ) -> jax.Array:
     """Ragged combine: all-to-all the expert outputs home, then one [T, K]-row
     gather + weighted sum. Dropped assignments (sentinel slot E*C, out of
-    bounds) gather as zeros. expert_out: [E_local, W*C, H] → [T, H]."""
+    bounds) gather as zeros. ``slot`` may be the raw [T, K] array or the
+    :class:`SlotPlan` dispatch already used — the same permutation, never
+    re-derived. expert_out: [E_local, W*C, H] → [T, H]."""
+    if isinstance(slot, SlotPlan):
+        slot = slot.slot
     w = lax.axis_size(axis)
     e_local, wc, h = expert_out.shape
     c = wc // w
     buf = expert_out.reshape(e_local, w, c, h).transpose(1, 0, 2, 3)
     buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group,
-                           expert_out.dtype, wire)
+                           expert_out.dtype, wire,
+                           n_chunks=n_chunks, chunk_axis=2,
+                           collective_id=_dma.CID_EP_COMBINE)
     y = buf.reshape(w * e_local * c, h)  # [E*C, H], expert-major
     yk = jnp.take(y, slot, axis=0, mode="fill", fill_value=0)  # [T, K, H]
     return jnp.einsum("tk,tkh->th", weights.astype(yk.dtype), yk)
@@ -293,47 +348,77 @@ def dispatch(
         "tec,th->ech", dispatch_mask.astype(x.dtype), x
     )  # [E, C, H]
     buf = buf.reshape(w, e_local, c, x.shape[-1])
-    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype, wire)
+    buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype, wire,
+                           collective_id=_dma.CID_EP_DISPATCH)
     # buf: [W, E_local, C, H] with dim0 = source member
     return buf.transpose(1, 0, 2, 3).reshape(e_local, w * c, x.shape[-1])
 
 
-def _member_all_to_all(buf, axis, wire):
+def _member_all_to_all(buf, axis, wire, *, n_chunks=1, chunk_axis=1,
+                       collective_id=None):
     """One member-major [W, ...] exchange on the selected wire: the XLA
     collective ("lax") or the device-initiated Pallas remote-DMA kernel
     ("pallas", uccl_tpu.ep.pallas_a2a — falls back to lax past its VMEM
-    budget). Both implement the identical tiled contract."""
+    budget). Both implement the identical tiled contract. ``n_chunks``/
+    ``chunk_axis``/``collective_id`` reach only the pallas kernel (slot-axis
+    chunking on 2-parity rotated ids); the lax wire is XLA-scheduled and
+    ignores them."""
     if wire == "pallas":
         from uccl_tpu.ep import pallas_a2a
 
-        return pallas_a2a.all_to_all(buf, axis)
+        return pallas_a2a.all_to_all(buf, axis, n_chunks=n_chunks,
+                                     chunk_axis=chunk_axis,
+                                     collective_id=collective_id)
     if wire != "lax":
         raise ValueError(f"unknown EP wire {wire!r} (want 'lax' or 'pallas')")
     return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
 
 
-def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype, wire="lax"):
+def _adapt_quant_group(h: int, quant_group: int) -> int:
+    """Adapt the fp8 group to the hidden size: the largest divisor of h no
+    bigger than the requested group (trace-time loop; keeps the scale
+    overhead minimal instead of gcd's tiny-group collapse). A result < 8
+    means fp8 would not pay (1 fp8 byte + 4/g scale bytes per element beats
+    bf16's 2 only for g > 4) and the wire ships raw."""
+    if h % quant_group:
+        quant_group = max(
+            d for d in range(min(quant_group, h), 0, -1) if h % d == 0
+        )
+    return quant_group
+
+
+def wire_itemsize(wire_fp8: bool, hidden: int, dtype,
+                  quant_group: int = 128) -> int:
+    """Bytes per element the wire actually moves — the itemsize budget
+    gates must charge: 1 when the fp8 packing applies, else the raw
+    activation width (shared with ep_bench's transport labels so the
+    gate's arithmetic is never mirrored)."""
+    if wire_fp8 and _adapt_quant_group(hidden, quant_group) >= 8:
+        return 1
+    return jnp.dtype(dtype).itemsize
+
+
+def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype, wire="lax", *,
+                     n_chunks=1, chunk_axis=1, collective_id=None):
     """Member-major all-to-all of a [W, ...] buffer, optionally fp8 on the wire
     (the analog of internode_ll.cu's fp8+scales message packing)."""
+
+    def xchg(rows, cid_off=0):
+        cid = None if collective_id is None else collective_id + cid_off
+        return _member_all_to_all(rows, axis, wire, n_chunks=n_chunks,
+                                  chunk_axis=chunk_axis, collective_id=cid)
+
     if wire_fp8:
-        h = buf.shape[-1]
-        if h % quant_group:
-            # adapt to the hidden size: the largest divisor of h no bigger
-            # than the requested group (trace-time loop; keeps the scale
-            # overhead minimal instead of gcd's tiny-group collapse)
-            quant_group = max(
-                d for d in range(min(quant_group, h), 0, -1) if h % d == 0
-            )
+        quant_group = _adapt_quant_group(buf.shape[-1], quant_group)
         if quant_group < 8:
-            # 1 fp8 byte + 4/g scale bytes per element beats bf16's 2 only
-            # for g > 4; awkward hidden sizes (e.g. prime) would INFLATE
-            # wire traffic — ship raw instead.
-            return _member_all_to_all(buf, axis, wire)
+            return xchg(buf)  # fp8 would inflate traffic — ship raw
         q, scale = quantize_fp8(buf, quant_group)
-        q = _member_all_to_all(q, axis, wire)
-        scale = _member_all_to_all(scale, axis, wire)
+        # scales ride their own id lane: the value and scale exchanges have
+        # no data dependency and may be airborne together
+        q = xchg(q)
+        scale = xchg(scale, _dma.CID_SCALE_OFFSET)
         return dequantize_fp8(q, scale, quant_group, dtype=dtype)
-    return _member_all_to_all(buf, axis, wire)
+    return xchg(buf)
 
 
 def combine(
@@ -356,11 +441,130 @@ def combine(
     h = expert_out.shape[-1]
     buf = expert_out.reshape(e_local, w, c, h).transpose(1, 0, 2, 3)  # [W,E_l,C,H]
     buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group,
-                           expert_out.dtype, wire)
+                           expert_out.dtype, wire,
+                           collective_id=_dma.CID_EP_COMBINE)
     # buf: [W, E_local, C, H] with dim0 = owner member -> [E, C, H]
     buf = buf.reshape(e, c, h)
     out = jnp.einsum("tec,ech->th", combine_weights.astype(buf.dtype), buf)
     return out
+
+
+def resolve_chunks(n_chunks: int, wire: str, world: int, capacity: int,
+                   e_local: int, hidden: int, itemsize: int,
+                   axis=None) -> int:
+    """Effective chunk count for the pipelined EP layer. ``0`` = auto:
+    2 chunks (the minimum that buys dispatch/compute/combine overlap) on the
+    pallas wire when the world and capacity can chunk, else 1. Any request
+    collapses to 1 off the pallas wire (XLA owns the lax schedule), at world
+    1 (no wire), on meshes the kernel cannot address (a tuple EP axis under
+    the legacy discharge interpreter — every chunk would silently ride lax
+    and the split would be pure overhead), or when the pipeline's resident
+    footprint — 4 send+recv chunk pairs: two airborne kernels in EACH of
+    the dispatch and combine families — is over budget. All of these are
+    the automatic fallback to the unchunked wire."""
+    if wire != "pallas" or world <= 1 or capacity < 2:
+        return 1
+    if (
+        axis is not None
+        and isinstance(axis, (tuple, list))
+        and len(axis) > 1
+        and not _dma.faithful_sync(_dma.resolve_interpret(None))
+    ):
+        return 1
+    if n_chunks == 0:
+        n_chunks = 2
+    n_chunks = max(1, min(int(n_chunks), capacity))
+    if n_chunks > 1:
+        cs = _dma.pad_capacity(capacity, n_chunks) // n_chunks
+        if not _dma.chunk_budget(world, e_local * cs * hidden, itemsize,
+                                 "ep_moe_chunked", resident_kernels=4):
+            return 1
+    return n_chunks
+
+
+def _expert_gemms(xe, w_gate, w_up, w_down):
+    """The SwiGLU expert GEMMs with their checkpoint_name tags — ONE copy
+    shared by the phased and chunk-pipelined layers so the remat="mlp"
+    policy (which matches these exact tags) can never diverge between them.
+    checkpoint_name tags let a remat policy pin exactly the expert-GEMM
+    operands/results (see flagship._remat_wrap mode "mlp"): with these
+    saved, the backward pass re-runs NO forward expert GEMM — the policy
+    lever dots_with_no_batch_dims misses, because these einsums carry the
+    `e` batch dim and are therefore excluded from it. (Keeping the
+    BATCHED einsum form is deliberate: unrolling to per-expert 2-D dots
+    measured 1.65x faster in isolation on v5e — scripts/
+    expert_gemm_probe.py — but in the fused model context the end-to-end
+    gain was <1%, and the unrolled dots lose their `e` batch dim, which
+    silently drags every expert GEMM into the remat="dots" saved set and
+    OOMs the documented-working B=32 dots config.)"""
+    xe = checkpoint_name(xe, _XE)
+    h_gate = checkpoint_name(jnp.einsum("ebh,ehf->ebf", xe, w_gate), _HG)
+    h_up = checkpoint_name(jnp.einsum("ebh,ehf->ebf", xe, w_up), _HU)
+    act = jax.nn.silu(h_gate) * h_up
+    return checkpoint_name(jnp.einsum("ebf,efh->ebh", act, w_down), _YE)
+
+
+def _moe_ffn_sort_chunked(
+    x, plan: SlotPlan, weights, w_gate, w_up, w_down, axis,
+    num_experts: int, capacity: int, n_chunks: int,
+    wire_fp8: bool, quant_group: int,
+):
+    """The chunk-pipelined sorted MoE step on the device-initiated wire.
+
+    The capacity/slot axis is split into ``n_chunks`` (padded with empty
+    slots by the shared ``dma.pad_capacity`` rule — drop semantics are those
+    of the UNCHUNKED layer, always), and each chunk runs dispatch-a2a →
+    expert GEMM → combine-a2a as its own dependency chain: chunk c's GEMM
+    depends only on chunk c's dispatch, and the per-chunk Pallas kernels
+    rotate 2-parity collective ids (dispatch {2,3}, combine {4,5}), so the
+    remote DMA of dispatch chunk c+1 and the combine return of chunk c-1
+    are free to fly while chunk c sits on the MXU — XLA's latency-hiding
+    scheduler has both the dataflow freedom and the non-aliased semaphores
+    it needs to hide the wire under compute. Slot rows are independent
+    through the SwiGLU GEMMs and the a2a is position-preserving, so the
+    result is numerically identical to the unchunked layer; the final
+    token gather/weighted-sum runs once on the reassembled buffer (it is
+    O(T·K·H) arithmetic XLA fuses into the consumer, not wire time)."""
+    w = lax.axis_size(axis)
+    e_local = num_experts // w
+    t, h = x.shape
+    tfs_chunks = plan.chunk_token_for_slot(num_experts, n_chunks, t)
+    cs = tfs_chunks.shape[-1]
+    recv_chunks, y_chunks = [], []
+    for c in range(n_chunks):
+        buf = jnp.take(x, tfs_chunks[c].reshape(-1), axis=0, mode="fill",
+                       fill_value=0)
+        buf = buf.reshape(w, e_local, cs, h)
+        # launch-granularity credit (dma.tie_chunk): chunk c's wire waits
+        # on chunk c-2's — its collective-id parity twin — so at most two
+        # kernels per family are airborne, matching the 2-id rotation and
+        # the 2-resident-pair budget charge
+        buf = _dma.tie_chunk(
+            buf, recv_chunks[c - 2] if c >= 2 else None
+        )
+        buf = _wire_all_to_all(
+            buf, axis, wire_fp8, quant_group, x.dtype, "pallas",
+            collective_id=_dma.chunk_collective_id(_dma.CID_EP_DISPATCH, c),
+        )
+        xe = buf.transpose(1, 0, 2, 3).reshape(e_local, w * cs, h)
+        recv_chunks.append(xe)
+        ye = _expert_gemms(xe, w_gate, w_up, w_down)
+        back = ye.reshape(e_local, w, cs, h).transpose(1, 0, 2, 3)
+        back = _dma.tie_chunk(
+            back, y_chunks[c - 2] if c >= 2 else None
+        )
+        back = _wire_all_to_all(
+            back, axis, wire_fp8, quant_group, ye.dtype, "pallas",
+            collective_id=_dma.chunk_collective_id(_dma.CID_EP_COMBINE, c),
+        )
+        y_chunks.append(back.reshape(num_experts, cs, h))
+    # reassemble the expert-major [E, C, H] buffer (chunks are contiguous
+    # slices of each expert's padded capacity), drop the wire-only padding,
+    # then ONE token gather + weighted sum — same math as combine_sorted
+    y = jnp.concatenate(y_chunks, axis=1)[:, :capacity]
+    y = y.reshape(num_experts * capacity, h)
+    yk = jnp.take(y, plan.slot, axis=0, mode="fill", fill_value=0)
+    return jnp.einsum("tk,tkh->th", weights.astype(yk.dtype), yk)
 
 
 def moe_ffn(
@@ -376,6 +580,7 @@ def moe_ffn(
     wire_fp8: bool = False,
     impl: str = "sort",
     wire: str = "lax",
+    n_chunks: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full per-shard MoE layer: route → dispatch → SwiGLU experts → combine.
 
@@ -389,6 +594,11 @@ def moe_ffn(
     all-to-all, :mod:`uccl_tpu.ep.pallas_a2a`); for impl="ll" the value maps
     onto that path's wire form ("pallas" selects its dense-chunk layout on
     the Pallas wire, anything else keeps its own auto resolution).
+    n_chunks: chunk-pipeline depth on the pallas wire (0 = auto, 1 = strictly
+    phased). With impl="sort" and n_chunks > 1 the layer runs the
+    chunk-pipelined step (:func:`_moe_ffn_sort_chunked`: dispatch chunk c+1
+    and combine chunk c-1 overlap the expert GEMM of chunk c); impl="ll"
+    chunks its wire exchanges; the dense oracle ignores it.
     Returns (out [T, H], aux_loss, z_loss).
     """
     t, h = x.shape
@@ -404,9 +614,21 @@ def moe_ffn(
             pair_capacity_factor=capacity_factor,
             wire="pallas" if wire == "pallas" else "auto",
             wire_fp8=wire_fp8,
+            n_chunks=n_chunks,
         )
     if impl == "sort":
         rs = route_topk_sorted(router_logits, num_selected, capacity)
+        n_chunks = resolve_chunks(
+            n_chunks, wire, w, capacity, e // w, h,
+            wire_itemsize(wire_fp8, h, x.dtype), axis=axis,
+        )
+        if n_chunks > 1:
+            plan = SlotPlan(rs.token_for_slot, rs.slot, rs.counts)
+            out = _moe_ffn_sort_chunked(
+                x, plan, rs.weights, w_gate, w_up, w_down, axis, e,
+                capacity, n_chunks, wire_fp8, 128,
+            )
+            return out.astype(x.dtype), rs.aux_loss, rs.z_loss
         xe = dispatch_sorted(
             x, rs.token_for_slot, e, capacity, axis, wire_fp8=wire_fp8,
             wire=wire,
@@ -420,22 +642,9 @@ def moe_ffn(
         raise ValueError(
             f"unknown moe impl {impl!r} (want 'sort', 'dense', or 'll')"
         )
-    # checkpoint_name tags let a remat policy pin exactly the expert-GEMM
-    # operands/results (see flagship._remat_wrap mode "mlp"): with these
-    # saved, the backward pass re-runs NO forward expert GEMM — the policy
-    # lever dots_with_no_batch_dims misses, because these einsums carry the
-    # `e` batch dim and are therefore excluded from it. (Keeping the
-    # BATCHED einsum form is deliberate: unrolling to per-expert 2-D dots
-    # measured 1.65x faster in isolation on v5e — scripts/
-    # expert_gemm_probe.py — but in the fused model context the end-to-end
-    # gain was <1%, and the unrolled dots lose their `e` batch dim, which
-    # silently drags every expert GEMM into the remat="dots" saved set and
-    # OOMs the documented-working B=32 dots config.)
-    xe = checkpoint_name(xe, _XE)
-    h_gate = checkpoint_name(jnp.einsum("ebh,ehf->ebf", xe, w_gate), _HG)
-    h_up = checkpoint_name(jnp.einsum("ebh,ehf->ebf", xe, w_up), _HU)
-    act = jax.nn.silu(h_gate) * h_up
-    ye = checkpoint_name(jnp.einsum("ebf,efh->ebh", act, w_down), _YE)
+    # tagged SwiGLU GEMMs shared with the chunked layer (the tags and the
+    # batched einsum form are load-bearing for remat — see _expert_gemms)
+    ye = _expert_gemms(xe, w_gate, w_up, w_down)
     if impl == "sort":
         out = combine_sorted(ye, rs.slot, rs.weights, axis,
                              wire_fp8=wire_fp8, wire=wire)

@@ -49,10 +49,20 @@ the kernel by design: Mosaic has no dynamic vector gather, and the reduction
 is arithmetic XLA already fuses well — the pillar gap was who issues the
 DMAs, not who multiplies the weights.
 
+Chunk pipelining (``n_chunks > 1``): the capacity/slot axis splits into
+independent per-chunk kernels rotating 2-parity ``collective_id`` pairs
+(:func:`uccl_tpu.collective.dma.chunk_collective_id`) so two chunk kernels
+can be in flight at once — the double buffering that lets a consumer (the
+chunk-pipelined MoE layer, :func:`uccl_tpu.ep.ops.moe_ffn`) hide dispatch
+chunk c+1 and combine chunk c-1 under the expert GEMM of chunk c. Identical
+numerics to the unchunked exchange; the 2-deep VMEM residency is charged up
+front (``dma.chunk_budget``).
+
 Fallback: payloads over the VMEM budget (or the interpreter's single-core
-ceiling), worlds of 1, and meshes the legacy discharge interpreter cannot
-address fall back to ``lax.all_to_all`` with identical semantics — the
-``wire="pallas"`` surface is transparent either way.
+ceiling), chunk pipelines over the 2x double-buffer budget, worlds of 1,
+and meshes the legacy discharge interpreter cannot address fall back to the
+unchunked kernel and ultimately ``lax.all_to_all`` with identical
+semantics — the ``wire="pallas"`` surface is transparent either way.
 """
 
 from __future__ import annotations
@@ -149,19 +159,83 @@ def _a2a_kernel(axis, n: int, faithful: bool):
     return kernel
 
 
+def _all_to_all_chunked(x, axis, n: int, interpret: bool,
+                        collective_id: int, n_chunks: int, chunk_axis: int):
+    """Split ``chunk_axis`` into ``n_chunks`` independent per-chunk kernels.
+
+    The slot axis is padded to a multiple of ``n_chunks`` with empty rows
+    (``dma.pad_capacity`` — the shared rounding rule — so routing/drop
+    semantics are untouched by the chunking) and each chunk rides its own
+    Pallas all-to-all with a 2-parity rotated ``collective_id``: chunk c and
+    chunk c+1 never share barrier/credit semaphores, so two chunk kernels
+    can be in flight at once — the double buffering that lets a consumer's
+    compute for chunk c hide under the wire of chunk c+1. The budget gate
+    charges that 2-deep footprint (2 resident send+recv pairs); over budget
+    (or unchunkable shapes) returns None and the caller falls back to the
+    unchunked wire."""
+    if x.ndim <= chunk_axis:
+        return None
+    size = x.shape[chunk_axis]
+    if size == 0:
+        return None
+    n_chunks = min(n_chunks, size)
+    if n_chunks <= 1:
+        return None
+    padded = _dma.pad_capacity(size, n_chunks)
+    cs = padded // n_chunks
+    chunk_elems_per_peer = x.size // size * cs // n
+    if not _dma.chunk_budget(n, chunk_elems_per_peer, x.dtype.itemsize,
+                             "ep_all_to_all_chunked", interpret):
+        return None
+    if padded != size:
+        pad = [(0, 0)] * x.ndim
+        pad[chunk_axis] = (0, padded - size)
+        x = jnp.pad(x, pad)
+    outs = []
+    for c in range(n_chunks):
+        sl = [slice(None)] * x.ndim
+        sl[chunk_axis] = slice(c * cs, (c + 1) * cs)
+        # launch-granularity credit: chunk c waits on chunk c-2 (its id
+        # parity twin), so at most two chunk kernels are ever in flight
+        xc = _dma.tie_chunk(x[tuple(sl)],
+                            outs[c - 2] if c >= 2 else None)
+        outs.append(
+            all_to_all(
+                xc, axis, interpret=interpret,
+                collective_id=_dma.chunk_collective_id(collective_id, c),
+            )
+        )
+    out = jnp.concatenate(outs, axis=chunk_axis)
+    if padded != size:
+        sl = [slice(None)] * x.ndim
+        sl[chunk_axis] = slice(0, size)
+        out = out[tuple(sl)]
+    return out
+
+
 def all_to_all(
     x: jax.Array,
     axis,
     *,
     interpret=None,
-    collective_id: int = 1,
+    collective_id=None,
+    n_chunks: int = 1,
+    chunk_axis: int = 1,
 ) -> jax.Array:
     """Per-shard ``[W, ...] -> [W, ...]`` all-to-all as ONE Pallas kernel.
 
     Chunk ``d`` of my buffer lands in slot *my-rank* of member ``d``'s
     output — the exact contract of ``lax.all_to_all(x, axis, 0, 0,
     tiled=True)``, which is also the fallback lowering when the payload
-    exceeds the VMEM budget. Use inside ``shard_map`` over the EP axis."""
+    exceeds the VMEM budget. Use inside ``shard_map`` over the EP axis.
+
+    ``n_chunks > 1`` splits ``chunk_axis`` (a trailing axis — the
+    capacity/slot axis of the EP layouts; never 0, the member axis) into
+    that many independent per-chunk kernels on 2-parity rotated collective
+    ids, so a consumer can overlap chunk c's compute with chunk c±1's wire
+    (see :func:`_all_to_all_chunked`). Identical numerics to the unchunked
+    exchange; falls back to it when the 2x double-buffer footprint exceeds
+    the budget or the shape cannot chunk."""
     n = lax.axis_size(axis)
     if n == 1:
         return x
@@ -169,7 +243,27 @@ def all_to_all(
         raise ValueError(
             f"all_to_all leading dim {x.shape[0]} != axis size {n}"
         )
+    if collective_id is None:
+        collective_id = _dma.CID_A2A  # the generic lane ({6,7} when chunked)
     interpret = _dma.resolve_interpret(interpret)
+    if (
+        isinstance(axis, (tuple, list))
+        and len(axis) > 1
+        and not _dma.faithful_sync(interpret)
+    ):
+        # the legacy discharge interpreter addresses peers by flat LOGICAL
+        # id along ONE named axis; a tuple EP axis (e.g. flagship's
+        # ("dp", "cp")) is unaddressable there — same transparent downgrade
+        # Buffer._pallas_wire_ok applies at the verb level
+        return _lax_fallback(x, axis)
+    if n_chunks > 1:
+        if chunk_axis == 0:
+            raise ValueError("chunk_axis 0 is the member axis; chunk a "
+                             "trailing (slot) axis instead")
+        out = _all_to_all_chunked(x, axis, n, interpret, collective_id,
+                                  n_chunks, chunk_axis)
+        if out is not None:
+            return out
     view, k, m = _dma.pad_chunks(x.reshape(-1), n)  # [n, m//128, 128]
     # both the send and recv buffers are VMEM-resident for the kernel's
     # lifetime, so the budget is charged for the padded pair

@@ -63,6 +63,13 @@ class FlagshipConfig:
     attn_impl: str = "auto"  # "auto" | "flash" | "xla": kernel when cp == 1
     moe_impl: str = "sort"  # "sort" (ragged) | "dense" (oracle) | "ll" (packed
     # grouped-GEMM path, no padded FLOPs — ep/ll.py)
+    moe_wire: str = "lax"  # "lax" | "pallas" (device-initiated remote-DMA
+    # a2a; forward-only — the Pallas kernel has no vjp, so keep "lax" for
+    # training paths)
+    moe_chunks: int = 0  # pallas-wire chunk-pipeline depth (0 = auto: the
+    # EP layer picks 2 double-buffered chunks when the budget allows,
+    # overlapping expert GEMMs with the dispatch/combine wire; ignored on
+    # the lax wire)
     wire_fp8: bool = False
     remat: str = "full"  # "full" | "dots" | "mlp" | "none" — see _remat_wrap
     dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
@@ -205,6 +212,8 @@ def _layer(x, lp, cfg: FlagshipConfig):
         capacity_factor=cfg.capacity_factor,
         wire_fp8=cfg.wire_fp8,
         impl=cfg.moe_impl,
+        wire=cfg.moe_wire,
+        n_chunks=cfg.moe_chunks,
     )
     x = x + lax.psum(moe_out.reshape(b, s_loc, h), AXIS.TP)
     aux_scalar = cfg.aux_loss_weight * aux + cfg.z_loss_weight * z

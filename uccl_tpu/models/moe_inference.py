@@ -55,6 +55,9 @@ class MoEServeConfig:
     moe_topk: int = 2
     moe_ffn: int = 256
     capacity_factor: float = 8.0  # ample by default: serving wants no drops
+    moe_wire: str = "lax"  # "lax" | "pallas" (device-initiated a2a wire)
+    moe_chunks: int = 0  # pallas chunk-pipeline depth (0 = auto: overlap
+    # prefill's expert GEMMs with the dispatch/combine wire; no-op on lax)
 
 
 class MoEKVCache(NamedTuple):
@@ -122,6 +125,8 @@ def _forward_shard(params, tokens, k_cache, v_cache, length,
             num_selected=cfg.moe_topk,
             capacity_factor=cfg.capacity_factor,
             impl=impl,
+            wire=cfg.moe_wire,
+            n_chunks=cfg.moe_chunks,
         )
         return out.reshape(b, sq, hd)
 

@@ -1,0 +1,116 @@
+"""Serving engine benchmark: arrival rate × slot count sweep.
+
+Each arm runs the continuous-batching engine (uccl_tpu/serving) under a
+synthetic Poisson arrival stream of mixed-length prompts and emits ONE JSON
+line with goodput and TTFT/TPOT percentiles — the load/latency tradeoff
+surface of the slot pool (docs/SERVING.md). Compile warmup happens before
+the clock starts, so the percentiles measure serving, not XLA.
+
+    python benchmarks/serving_bench.py --devices 2 --rates 4,16 --slots 2,4
+    python benchmarks/serving_bench.py --stack moe --devices 4 --slots 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from _bootstrap import init_devices
+
+
+def run_arm(args, jax, stack, rate, n_slots):
+    import numpy as np
+
+    from uccl_tpu.serving import DenseBackend, MoEBackend, ServingEngine
+    from uccl_tpu.serving.loadgen import drive, synth_workload, warm_engine
+
+    max_seq = args.prompt_len + args.new_tokens
+    if stack == "dense":
+        from uccl_tpu.models.dense import DenseConfig, init_params
+
+        cfg = DenseConfig(
+            vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+            n_heads=4, n_kv_heads=2, head_dim=args.dim // 4, ffn=args.ffn,
+        )
+        params = init_params(jax.random.PRNGKey(args.seed), cfg)
+        backend = DenseBackend(params, cfg, n_slots=n_slots, max_seq=max_seq)
+        world, vocab = 1, cfg.vocab
+    else:
+        from uccl_tpu.models.moe_inference import (
+            MoEServeConfig, MoEServer, init_params,
+        )
+        from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        world = len(jax.devices())
+        if n_slots % world:
+            return None  # this arm's pool doesn't tile the mesh
+        cfg = MoEServeConfig(
+            vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+            n_heads=4, n_kv_heads=2, head_dim=args.dim // 4,
+            moe_ffn=args.ffn,
+        )
+        srv = MoEServer(cfg, make_mesh(MeshConfig(dp=world), jax.devices()))
+        params = init_params(jax.random.PRNGKey(args.seed), cfg)
+        backend = MoEBackend(
+            srv, srv.shard_params(params), batch_local=n_slots // world,
+            max_seq=max_seq,
+        )
+        vocab = cfg.vocab
+
+    engine = ServingEngine(backend)
+    rng = np.random.default_rng(args.seed)
+    prompts, lens, arrivals = synth_workload(
+        rng, args.requests, args.prompt_len, vocab, rate
+    )
+    warm_engine(engine, lens, max_seq, args.new_tokens)
+    _, wall = drive(engine, prompts, arrivals, args.new_tokens)
+
+    snap = engine.snapshot()
+    return {
+        "bench": "serving", "stack": stack, "world": world,
+        "arrival_rate": rate, "slots": n_slots,
+        "requests": args.requests, "new_tokens": args.new_tokens,
+        "prompt_len": args.prompt_len, "wall_s": round(wall, 3),
+        "completed": snap["completed"], "rejected": snap["rejected"],
+        "goodput_tok_s": snap.get("goodput_tok_s"),
+        "ttft_ms": snap["ttft_ms"], "tpot_ms": snap["tpot_ms"],
+        "decode_step_ms": snap["decode_step_ms"],
+        "slot_high_water": engine.pool.high_water,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0,
+                    help="virtual CPU device count (0 = ambient)")
+    ap.add_argument("--stack", default="dense", choices=["dense", "moe"])
+    ap.add_argument("--rates", default="4,16",
+                    help="comma-separated Poisson arrival rates (req/s)")
+    ap.add_argument("--slots", default="2,4",
+                    help="comma-separated slot pool sizes")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--prompt-len", type=int, default=8)
+    ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--ffn", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    jax = init_devices(args.devices)
+    for rate in [float(r) for r in args.rates.split(",")]:
+        for n_slots in [int(s) for s in args.slots.split(",")]:
+            arm = run_arm(args, jax, args.stack, rate, n_slots)
+            if arm is None:
+                print(json.dumps({
+                    "bench": "serving", "stack": args.stack,
+                    "arrival_rate": rate, "slots": n_slots,
+                    "skipped": "slots must divide by the MoE world",
+                }), flush=True)
+                continue
+            print(json.dumps(arm), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,10 @@ note "pallas kernel smoke tier (interpret-mode, fail-fast: a2a proof --chunks 2 
 timeout 300 python scripts/pallas_a2a_proof.py --interpret --chunks 2; check $?
 timeout 900 python -m pytest tests/test_pallas_a2a.py tests/test_pallas_ccl.py -q; check $?
 
+note "serving engine smoke tier (fail-fast: 2 slots, 6 mixed-length requests, oracle match + no leaked slots)"
+JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
+  --requests 6 --prompt-len 8 --new-tokens 4 --arrival-rate 50 --check-oracle; check $?
+
 note "pytest (full suite, virtual 8-device mesh; pallas kernel files ran in the smoke tier)"
 timeout 2700 python -m pytest tests/ -q \
   --ignore=tests/test_pallas_a2a.py --ignore=tests/test_pallas_ccl.py; check $?

@@ -12,7 +12,6 @@ decode step hits the same compiled executable.
 
 from __future__ import annotations
 
-from collections import OrderedDict
 from typing import Dict, NamedTuple, Tuple
 
 import jax
@@ -21,6 +20,7 @@ from jax import lax
 
 from uccl_tpu.models.dense import DenseConfig
 from uccl_tpu.models.layers import rms_norm, rope
+from uccl_tpu.utils.lru import LRUFnCache
 
 
 class KVCache(NamedTuple):
@@ -38,7 +38,13 @@ class KVCache(NamedTuple):
 
 def _attend_cached(q, k_cache, v_cache, length, cfg: DenseConfig):
     """q: [B, Sq, H, D] at positions [length, length+Sq); cache: [B, Smax, Hkv, D].
-    Masked attention over the cache prefix + the new causal block."""
+    Masked attention over the cache prefix + the new causal block.
+
+    ``length`` is a scalar (one shared prefix — the one-shot path) or [B]
+    per-sequence prefixes (the slot-pool serving path): the mask math is the
+    same, only its batch rank differs, so both paths produce bit-identical
+    rows for equal per-row (length, prefix) — the serving engine's oracle
+    guarantee rests on this."""
     b, sq, h, d = q.shape
     smax = k_cache.shape[1]
     n_rep = h // cfg.n_kv_heads
@@ -46,10 +52,15 @@ def _attend_cached(q, k_cache, v_cache, length, cfg: DenseConfig):
     vv = jnp.repeat(v_cache, n_rep, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, kk, preferred_element_type=jnp.float32)
     s = s / jnp.sqrt(jnp.float32(d))
-    qpos = length + jnp.arange(sq)[:, None]  # [Sq, 1]
-    kpos = jnp.arange(smax)[None, :]  # [1, Smax]
-    mask = kpos <= qpos  # attend to everything at or before own position
-    s = jnp.where(mask[None, None], s, -1e30)
+    kpos = jnp.arange(smax)
+    if jnp.ndim(length) == 0:
+        qpos = length + jnp.arange(sq)[:, None]  # [Sq, 1]
+        mask = kpos[None, :] <= qpos  # attend at or before own position
+        s = jnp.where(mask[None, None], s, -1e30)
+    else:
+        qpos = length[:, None] + jnp.arange(sq)[None, :]  # [B, Sq]
+        mask = kpos[None, None, :] <= qpos[:, :, None]  # [B, Sq, Smax]
+        s = jnp.where(mask[:, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(vv.dtype), vv)
 
@@ -142,14 +153,134 @@ def decode_step_elastic(params, token, ekv, cfg: DenseConfig):
     return logits[:, 0]
 
 
-# Compiled-generate cache, LRU-bounded: a long-lived server sweeping shapes
-# (batch buckets, growing new_tokens, several max_seq tiers) would otherwise
-# retain a compiled executable per shape forever. 16 entries comfortably
-# covers a serving process's steady-state shape set while bounding the
-# executable memory; evicting the least-recently-used program lets XLA
-# reclaim it.
-_GEN_CACHE: OrderedDict = OrderedDict()
-_GEN_CACHE_CAP = 16
+# -- slot-pool serving primitives ------------------------------------------
+#
+# The continuous-batching engine (uccl_tpu/serving) holds ONE fixed
+# [B_slots, S_max] KV cache and reuses rows ("slots") across requests, so
+# every sequence sits at its own length and joins/leaves the batch at its own
+# time. The primitive that needs is a masked forward: tokens land at per-slot
+# positions, cache writes are gated per slot (an inactive or padded slot's
+# rows never change), and attention masks per slot. Everything else —
+# attention math, rope, the layer stack — is the one-shot code above; rows
+# with equal (prefix, length) are bit-identical between the two paths, which
+# is what makes the engine's exact-oracle guarantee provable by test rather
+# than by tolerance.
+
+
+class SlotKVCache(NamedTuple):
+    k: jax.Array  # [L, B_slots, S_max, Hkv, D]
+    v: jax.Array  # [L, B_slots, S_max, Hkv, D]
+    lengths: jax.Array  # [B_slots] int32 — per-slot valid prefix
+
+    @staticmethod
+    def empty(cfg: DenseConfig, n_slots: int, max_seq: int,
+              dtype=jnp.float32) -> "SlotKVCache":
+        shape = (cfg.n_layers, n_slots, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        return SlotKVCache(
+            jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+            jnp.zeros((n_slots,), jnp.int32),
+        )
+
+
+def _forward_slots(
+    params, tokens, cache: SlotKVCache, start, write_mask, cfg, ffn=None
+) -> Tuple[jax.Array, SlotKVCache]:
+    """Masked batched forward: tokens [B, S] at positions [start_b, start_b+S).
+
+    ``write_mask`` [B] bool gates every cache write — a masked slot's KV rows
+    come back unchanged (its write positions are redirected out of bounds and
+    dropped), so mid-decode neighbors are never corrupted by a prefill or by
+    an idle slot's dummy token. Lengths are NOT advanced here; the callers
+    own the per-slot length bookkeeping. ``ffn`` is the same dense-block
+    override hook as :func:`_forward_cached` (the MoE serving loop uses it).
+    """
+    b, s = tokens.shape
+    smax = cache.k.shape[2]
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cache.k.dtype)
+    positions = start[:, None] + jnp.arange(s)[None, :]  # [B, S]
+    # masked slots write at index smax → dropped by the scatter; rows beyond
+    # the cache end (a bucket overhanging S_max) drop the same way
+    pos_write = jnp.where(write_mask[:, None], positions, smax)
+    bidx = jnp.arange(b)[:, None]
+    new_k, new_v = [], []
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["blocks"])
+        h = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        d = cfg.head_dim
+        q = (h @ lp["wq"].astype(h.dtype)).reshape(b, s, cfg.n_heads, d)
+        kk = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
+        v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
+        q = rope(q, positions, cfg.rope_theta)
+        kk = rope(kk, positions, cfg.rope_theta)
+        k_cache = cache.k[i].at[bidx, pos_write].set(kk, mode="drop")
+        v_cache = cache.v[i].at[bidx, pos_write].set(v, mode="drop")
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+        attn = _attend_cached(q, k_cache, v_cache, start, cfg)
+        x = x + attn.reshape(b, s, -1) @ lp["wo"].astype(attn.dtype)
+        h2 = rms_norm(x, lp["ln2"], cfg.norm_eps)
+        if ffn is None:
+            act = jax.nn.silu(h2 @ lp["w_gate"].astype(h2.dtype)) * (
+                h2 @ lp["w_up"].astype(h2.dtype)
+            )
+            x = x + act @ lp["w_down"].astype(act.dtype)
+        else:
+            x = x + ffn(h2, lp)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["head"]
+    return logits, SlotKVCache(
+        jnp.stack(new_k), jnp.stack(new_v), cache.lengths
+    )
+
+
+def prefill_slots(
+    params, tokens, prompt_lens, new_mask, cache: SlotKVCache,
+    cfg: DenseConfig,
+) -> Tuple[jax.Array, SlotKVCache]:
+    """Masked batched prefill of newly admitted slots.
+
+    tokens: [B_slots, S] prompts right-padded to the bucket length S (rows of
+    slots NOT in ``new_mask`` are ignored); prompt_lens: [B_slots] int32;
+    new_mask: [B_slots] bool. Admitted slots prefill from position 0 —
+    their previous occupant's rows beyond the new prompt are dead (never
+    readable: attention stops at the slot's length, and decode overwrites
+    position L before any read of L). Returns (first greedy token [B_slots],
+    cache with lengths set to prompt_lens on admitted slots).
+    """
+    zeros = jnp.zeros_like(prompt_lens)
+    logits, cache = _forward_slots(
+        params, tokens, cache, zeros, new_mask, cfg
+    )
+    last = jnp.take_along_axis(
+        logits, (prompt_lens - 1)[:, None, None], axis=1
+    )[:, 0]  # [B, V] — each slot's last valid prompt position
+    tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    lengths = jnp.where(new_mask, prompt_lens, cache.lengths)
+    return tok, SlotKVCache(cache.k, cache.v, lengths)
+
+
+def decode_step_slots(
+    params, token, active, cache: SlotKVCache, cfg: DenseConfig
+) -> Tuple[jax.Array, SlotKVCache]:
+    """One masked autoregressive step over the slot pool.
+
+    token: [B_slots] (inactive slots feed a dummy); active: [B_slots] bool.
+    Active slots write their new KV at their own length and advance by one;
+    inactive slots neither write nor advance. Returns (next greedy token
+    [B_slots], cache').
+    """
+    logits, cache = _forward_slots(
+        params, token[:, None], cache, cache.lengths, active, cfg
+    )
+    tok = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+    lengths = cache.lengths + active.astype(jnp.int32)
+    return tok, SlotKVCache(cache.k, cache.v, lengths)
+
+
+# Compiled-generate cache — the shared LRU-bounded ``_fns`` pattern
+# (utils/lru.py): 16 entries comfortably cover a serving process's
+# steady-state shape set while letting XLA reclaim evicted programs.
+_GEN_CACHE = LRUFnCache(16)
 
 
 def generate(
@@ -174,11 +305,8 @@ def generate(
             f"max_seq {max_seq}: the cache would overflow"
         )
     key = (repr(cfg), prompt.shape, max_new_tokens, max_seq)
-    fn = _GEN_CACHE.get(key)
-    if fn is not None:
-        _GEN_CACHE.move_to_end(key)  # LRU: a hit refreshes recency
-    if fn is None:
 
+    def build():
         def run(p, t):
             logits, cache = prefill(p, t, cfg, max_seq)
 
@@ -193,7 +321,6 @@ def generate(
             )
             return toks.T  # [B, T]
 
-        fn = _GEN_CACHE[key] = jax.jit(run)
-        while len(_GEN_CACHE) > _GEN_CACHE_CAP:
-            _GEN_CACHE.popitem(last=False)
-    return fn(params, prompt)
+        return jax.jit(run)
+
+    return _GEN_CACHE.get(key, build)(params, prompt)

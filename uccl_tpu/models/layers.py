@@ -25,14 +25,18 @@ def rope(
     """Rotary position embedding, split-half (Llama) convention.
 
     x: [B, S, H, D]; positions: [S] absolute positions (callers under sequence
-    sharding pass ``cp_index * S_local + arange(S_local)``).
+    sharding pass ``cp_index * S_local + arange(S_local)``), or [B, S]
+    per-sequence positions (the slot-pool serving path, where every slot sits
+    at its own decode offset).
     """
     d = x.shape[-1]
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [S, half]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # [(B,) S, half]
+    cos = jnp.cos(ang)[..., None, :]  # [(B,) S, 1, half]
+    sin = jnp.sin(ang)[..., None, :]
+    if positions.ndim == 1:
+        cos, sin = cos[None], sin[None]
     x1, x2 = x[..., :half], x[..., half:]
     x32_1, x32_2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     out = jnp.concatenate(

@@ -36,7 +36,10 @@ from uccl_tpu.utils.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from uccl_tpu.ep import ops as ep_ops
-from uccl_tpu.models.inference import KVCache, _forward_cached
+from uccl_tpu.models.inference import (
+    KVCache, SlotKVCache, _forward_cached, _forward_slots,
+)
+from uccl_tpu.utils.lru import LRUFnCache
 
 _AXIS = "dp"  # the EP/serving axis of the mesh
 
@@ -76,6 +79,25 @@ class MoEKVCache(NamedTuple):
         )
 
 
+class MoESlotCache(NamedTuple):
+    """Slot-pool KV cache: one length PER SLOT (not per shard) — the
+    continuous-batching engine admits/frees [w, b_loc] rows independently."""
+
+    k: jax.Array  # [W, L, B_loc, S_max, Hkv, D]
+    v: jax.Array
+    lengths: jax.Array  # [W, B_loc] int32
+
+    @staticmethod
+    def empty(cfg: MoEServeConfig, world: int, batch_local: int,
+              max_seq: int, dtype=jnp.float32) -> "MoESlotCache":
+        shape = (world, cfg.n_layers, batch_local, max_seq,
+                 cfg.n_kv_heads, cfg.head_dim)
+        return MoESlotCache(
+            jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+            jnp.zeros((world, batch_local), jnp.int32),
+        )
+
+
 def init_params(key: jax.Array, cfg: MoEServeConfig) -> Dict[str, Any]:
     """Global parameter tree (experts carry the full [E, ...] axis)."""
     k = jax.random.split(key, 12)
@@ -106,13 +128,10 @@ def init_params(key: jax.Array, cfg: MoEServeConfig) -> Dict[str, Any]:
     }
 
 
-def _forward_shard(params, tokens, k_cache, v_cache, length,
-                   cfg: MoEServeConfig, impl: str):
-    """Per-shard cached forward: the dense serving loop
-    (inference._forward_cached — attention/rope/KV updates exist exactly
-    once) with the FFN block swapped for the EP MoE layer. Experts are the
-    LOCAL shard ([E_local, ...]); the MoE FFN exchanges tokens over the EP
-    axis (sorted path for prefill throughput, packed LL for decode)."""
+def _moe_block(cfg: MoEServeConfig, impl: str):
+    """The EP MoE FFN as an :func:`inference._forward_cached`-style ``ffn``
+    hook: route over the EP axis (sorted path for prefill throughput,
+    packed LL for decode), experts being the LOCAL shard."""
 
     def moe_block(h2, lp):
         b, sq, hd = h2.shape
@@ -130,9 +149,57 @@ def _forward_shard(params, tokens, k_cache, v_cache, length,
         )
         return out.reshape(b, sq, hd)
 
+    return moe_block
+
+
+def _forward_shard(params, tokens, k_cache, v_cache, length,
+                   cfg: MoEServeConfig, impl: str):
+    """Per-shard cached forward: the dense serving loop
+    (inference._forward_cached — attention/rope/KV updates exist exactly
+    once) with the FFN block swapped for the EP MoE layer. Experts are the
+    LOCAL shard ([E_local, ...]); the MoE FFN exchanges tokens over the EP
+    axis (sorted path for prefill throughput, packed LL for decode)."""
     cache = KVCache(k_cache, v_cache, length)
-    logits, cache = _forward_cached(params, tokens, cache, cfg, ffn=moe_block)
+    logits, cache = _forward_cached(
+        params, tokens, cache, cfg, ffn=_moe_block(cfg, impl)
+    )
     return logits, cache.k, cache.v, cache.length
+
+
+def _forward_shard_slots(params, tokens, k_cache, v_cache, lengths, start,
+                         write_mask, cfg: MoEServeConfig, impl: str):
+    """Per-shard masked slot forward (the continuous-batching primitive):
+    the dense slot-pool loop (inference._forward_slots — per-slot positions,
+    write-gated KV, per-slot attention masks) with the EP MoE FFN. Idle
+    slots' dummy tokens do route through the experts — harmless: expert
+    GEMM rows are independent and the ample serving capacity_factor keeps
+    the wire drop-free, so active rows are bit-identical to a batch
+    without the dummies."""
+    cache = SlotKVCache(k_cache, v_cache, lengths)
+    logits, cache = _forward_slots(
+        params, tokens, cache, start, write_mask, cfg,
+        ffn=_moe_block(cfg, impl),
+    )
+    return logits, cache.k, cache.v
+
+
+def _strip_shard(p):
+    """Drop the per-shard leading dim shard_map hands each member:
+    replicated leaves carry it LEADING ([1, ...] broadcast slice); expert
+    leaves carry it at axis 1 ([L, 1, E_local, ...] — the sharded W axis
+    of shard_params)."""
+    blocks = {}
+    for name, leaf in p["blocks"].items():
+        if name in ("we_gate", "we_up", "we_down"):
+            blocks[name] = leaf[:, 0]
+        else:
+            blocks[name] = leaf[0]
+    return {
+        "embed": p["embed"][0],
+        "blocks": blocks,
+        "final_norm": p["final_norm"][0],
+        "head": p["head"][0],
+    }
 
 
 class MoEServer:
@@ -150,7 +217,11 @@ class MoEServer:
                 f"the dp world {self.world} must divide moe_experts "
                 f"{cfg.moe_experts}"
             )
-        self._fns = {}
+        # the shared LRU-bounded compiled-fn pattern (utils/lru.py): a
+        # long-lived serving process sweeping shapes (prefill buckets,
+        # several decode batch tiers, varying scan lengths) would
+        # otherwise retain a compiled executable per shape forever
+        self._fns = LRUFnCache(16)
 
     # -- parameter placement ------------------------------------------------
     def shard_params(self, params):
@@ -186,66 +257,50 @@ class MoEServer:
         }
 
     def _fn(self, key, build):
-        cached = self._fns.get(key)
-        if cached is None:
-            cached = self._fns[key] = build()
-        return cached
+        return self._fns.get(key, build)
+
+    @staticmethod
+    def _param_specs():
+        # replicated leaves shard their broadcast leading [W] dim;
+        # expert leaves shard the [W] at axis 1 ([L, W, E_local, ...])
+        def block_spec(name):
+            if name in ("we_gate", "we_up", "we_down"):
+                return P(None, _AXIS)
+            return P(_AXIS)
+
+        return {
+            "embed": P(_AXIS),
+            "blocks": {
+                name: block_spec(name)
+                for name in ("ln1", "ln2", "wq", "wk", "wv", "wo",
+                             "router", "we_gate", "we_up", "we_down")
+            },
+            "final_norm": P(_AXIS),
+            "head": P(_AXIS),
+        }
+
+    def _shard_mapped(self, f, n_in, n_out):
+        """jit(shard_map(f)) with params first, then n_in P(dp) arrays."""
+        return jax.jit(
+            shard_map(
+                f, mesh=self.mesh,
+                in_specs=(self._param_specs(),) + (P(_AXIS),) * n_in,
+                out_specs=(P(_AXIS),) * n_out,
+                check_vma=False,
+            )
+        )
 
     def _forward(self, params, tokens, cache: MoEKVCache, impl: str):
         cfg = self.cfg
 
         def f(p, tok, kc, vc, ln):
-            # strip the shard dim: replicated leaves carry it LEADING
-            # ([1, ...] broadcast slice); expert leaves carry it at axis 1
-            # ([L, 1, E_local, ...] — the sharded W axis of shard_params)
-            blocks = {}
-            for name, leaf in p["blocks"].items():
-                if name in ("we_gate", "we_up", "we_down"):
-                    blocks[name] = leaf[:, 0]
-                else:
-                    blocks[name] = leaf[0]
-            pp = {
-                "embed": p["embed"][0],
-                "blocks": blocks,
-                "final_norm": p["final_norm"][0],
-                "head": p["head"][0],
-            }
             logits, nk, nv, nlen = _forward_shard(
-                pp, tok[0], kc[0], vc[0], ln[0], cfg, impl
+                _strip_shard(p), tok[0], kc[0], vc[0], ln[0], cfg, impl
             )
             return logits[None], nk[None], nv[None], nlen[None]
 
         key = ("fwd", impl, tokens.shape, cache.k.shape)
-
-        def build():
-            # replicated leaves shard their broadcast leading [W] dim;
-            # expert leaves shard the [W] at axis 1 ([L, W, E_local, ...])
-            def block_spec(name):
-                if name in ("we_gate", "we_up", "we_down"):
-                    return P(None, _AXIS)
-                return P(_AXIS)
-
-            p_specs = {
-                "embed": P(_AXIS),
-                "blocks": {
-                    name: block_spec(name)
-                    for name in ("ln1", "ln2", "wq", "wk", "wv", "wo",
-                                 "router", "we_gate", "we_up", "we_down")
-                },
-                "final_norm": P(_AXIS),
-                "head": P(_AXIS),
-            }
-            return jax.jit(
-                shard_map(
-                    f, mesh=self.mesh,
-                    in_specs=(p_specs, P(_AXIS), P(_AXIS), P(_AXIS),
-                              P(_AXIS)),
-                    out_specs=(P(_AXIS), P(_AXIS), P(_AXIS), P(_AXIS)),
-                    check_vma=False,
-                )
-            )
-
-        fn = self._fn(key, build)
+        fn = self._fn(key, lambda: self._shard_mapped(f, 4, 4))
         logits, nk, nv, nlen = fn(params, tokens, cache.k, cache.v,
                                   cache.length)
         return logits, MoEKVCache(nk, nv, nlen)
@@ -269,6 +324,83 @@ class MoEServer:
             params, token[..., None], cache, impl=impl
         )
         return logits[:, :, 0], cache
+
+    # -- slot-pool serving API (continuous batching) ------------------------
+    def _check_drop_free(self):
+        """The slot-serving oracle guarantee (bit-exact vs one-shot
+        generate) requires the EP wire to be DROP-FREE for any routing:
+        per-expert capacity = floor(cf·T·topk/E) must cover the worst case
+        of all T tokens picking the same expert (topk experts are distinct
+        per token, so one expert receives at most T rows) — i.e.
+        cf·topk ≥ E. Otherwise idle-slot dummies and co-scheduled
+        neighbors could crowd a request's tokens past capacity and change
+        its output depending on who shares the batch."""
+        cfg = self.cfg
+        if cfg.capacity_factor * cfg.moe_topk < cfg.moe_experts:
+            raise ValueError(
+                f"slot serving needs a drop-free EP wire: capacity_factor "
+                f"({cfg.capacity_factor}) * moe_topk ({cfg.moe_topk}) must "
+                f"be >= moe_experts ({cfg.moe_experts}), or request "
+                f"outputs would depend on batch composition"
+            )
+
+    def slot_cache(self, batch_local: int, max_seq: int) -> MoESlotCache:
+        """The engine's fixed [W, B_loc, S_max] KV pool (per-slot lengths)."""
+        self._check_drop_free()
+        return MoESlotCache.empty(self.cfg, self.world, batch_local, max_seq)
+
+    def prefill_slots(self, params, tokens, prompt_lens, new_mask,
+                      cache: MoESlotCache):
+        """Masked batched prefill of newly admitted slots (sorted EP path).
+
+        tokens: [W, B_loc, S] right-padded prompts; prompt_lens/new_mask:
+        [W, B_loc]. Slots outside ``new_mask`` keep their KV rows and
+        lengths — mid-decode neighbors are untouched. Returns (first greedy
+        token [W, B_loc], cache')."""
+        self._check_drop_free()
+        cfg = self.cfg
+
+        def f(p, tok, lens, mask, kc, vc, ln):
+            logits, nk, nv = _forward_shard_slots(
+                _strip_shard(p), tok[0], kc[0], vc[0], ln[0],
+                jnp.zeros_like(ln[0]), mask[0], cfg, "sort",
+            )
+            last = jnp.take_along_axis(
+                logits, (lens[0] - 1)[:, None, None], axis=1
+            )[:, 0]
+            t = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            nlen = jnp.where(mask[0], lens[0], ln[0])
+            return t[None], nk[None], nv[None], nlen[None]
+
+        key = ("prefill_slots", tokens.shape, cache.k.shape)
+        fn = self._fn(key, lambda: self._shard_mapped(f, 6, 4))
+        tok, nk, nv, nlen = fn(params, tokens, prompt_lens, new_mask,
+                               cache.k, cache.v, cache.lengths)
+        return tok, MoESlotCache(nk, nv, nlen)
+
+    def decode_step_slots(self, params, token, active, cache: MoESlotCache,
+                          impl: str = "ll"):
+        """One masked autoregressive step over the slot pool (packed LL EP
+        path by default). token/active: [W, B_loc]; inactive slots neither
+        write KV nor advance their length. Returns (next greedy token
+        [W, B_loc], cache')."""
+        self._check_drop_free()
+        cfg = self.cfg
+
+        def f(p, tok, mask, kc, vc, ln):
+            logits, nk, nv = _forward_shard_slots(
+                _strip_shard(p), tok[0][:, None], kc[0], vc[0], ln[0],
+                ln[0], mask[0], cfg, impl,
+            )
+            t = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            nlen = ln[0] + mask[0].astype(jnp.int32)
+            return t[None], nk[None], nv[None], nlen[None]
+
+        key = ("decode_slots", impl, token.shape, cache.k.shape)
+        fn = self._fn(key, lambda: self._shard_mapped(f, 5, 4))
+        tok, nk, nv, nlen = fn(params, token, active,
+                               cache.k, cache.v, cache.lengths)
+        return tok, MoESlotCache(nk, nv, nlen)
 
     def generate(self, params, prompt, new_tokens: int, max_seq: int,
                  impl: str = "ll"):

@@ -15,6 +15,18 @@ consumers reach this shape through vLLM + its transfer/EP plugins
 
 Without --ckpt-dir, params initialize from --seed (smoke/benchmark mode).
 Prompts are deterministic synthetic token ids (no tokenizer in scope).
+
+``--server`` switches from the one-shot fixed batch to the
+continuous-batching engine (uccl_tpu/serving, docs/SERVING.md): a synthetic
+Poisson arrival stream of mixed-length prompts flows through a FIFO
+scheduler into a fixed KV slot pool, requests join and leave mid-decode,
+and the summary reports TTFT/TPOT percentiles, goodput and slot occupancy.
+``--check-oracle`` additionally verifies every completed request against
+the one-shot ``generate`` oracle (bit-exact) and that no slot leaked — the
+CI serving smoke tier:
+
+    python -m uccl_tpu.serve --server --devices 2 --slots 2 --requests 6 \
+        --prompt-len 8 --new-tokens 4 --arrival-rate 50 --check-oracle
 """
 
 from __future__ import annotations
@@ -111,6 +123,214 @@ def _check_sizes(params, cfg):
             )
 
 
+def _timed_windows(run_full, run_one, batch, new_tokens, reps):
+    """Measure the one-shot serving windows ``reps`` times; returns
+    (last full-window output, last full-window seconds, extra summary).
+
+    The 1-token window IS the TTFT window (prompt → first token), and the
+    per-rep delta (full − one)/(N−1) is the decode-step window — prefill
+    and the fixed dispatch cost cancel in the delta (the honest-decode
+    rationale below). Percentile definitions are shared with the
+    continuous-batching engine (uccl_tpu/serving/metrics.py). Callers must
+    have warmed BOTH programs; ``run_one`` is None when N == 1 (the full
+    window then doubles as the TTFT window)."""
+    from uccl_tpu.serving.metrics import percentile, percentiles_ms
+
+    ttft, steps, fulls = [], [], []
+    out = None
+    for _ in range(max(1, reps)):
+        if run_one is not None:
+            t0 = time.perf_counter()
+            run_one()
+            ttft.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        out = run_full()
+        fulls.append(time.perf_counter() - t0)
+        if run_one is not None and fulls[-1] > ttft[-1]:
+            steps.append((fulls[-1] - ttft[-1]) / (new_tokens - 1))
+    if run_one is None:
+        ttft = list(fulls)
+    extra = {"ttft_ms": percentiles_ms(ttft)}
+    if steps:
+        extra["decode_step_ms"] = percentiles_ms(steps)
+        # the delta metric over the MEDIAN windows — only when positive,
+        # never a clamped absurdity (see the window notes below)
+        med_one, med_full = percentile(ttft, 50), percentile(fulls, 50)
+        if med_full > med_one:
+            extra["decode_tokens_per_sec"] = round(
+                batch * (new_tokens - 1) / (med_full - med_one), 1
+            )
+    return out, fulls[-1], extra
+
+
+def _serve_continuous(args, saved_cfg):
+    """--server: the continuous-batching engine under Poisson arrivals.
+
+    Mixed-length synthetic prompts arrive at --arrival-rate req/s, flow
+    through the FIFO scheduler into a --slots KV slot pool, and decode in
+    one masked batch; the summary line is the engine's metrics snapshot
+    (TTFT/TPOT percentiles, goodput, occupancy — docs/SERVING.md).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from uccl_tpu.serving import DenseBackend, MoEBackend, ServingEngine
+    from uccl_tpu.serving.loadgen import drive, synth_workload, warm_engine
+
+    stack = args.stack
+    if stack == "auto":
+        stack = ("dense" if saved_cfg is not None
+                 and saved_cfg.get("model") == "dense" else "moe")
+    if args.slots < 1:
+        raise SystemExit(f"--slots must be >= 1, got {args.slots}")
+    max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
+    if args.prompt_len + args.new_tokens > max_seq:
+        raise SystemExit("--prompt-len + --new-tokens exceed --max-seq")
+
+    step = None
+    world = 1
+    if stack == "dense":
+        from uccl_tpu.models.dense import DenseConfig, init_params
+        from uccl_tpu.models.inference import generate
+
+        dcfg = DenseConfig(
+            vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+            n_heads=args.heads, n_kv_heads=args.kv_heads,
+            head_dim=args.dim // args.heads, ffn=args.ffn,
+        )
+        if args.ckpt_dir:
+            params, step = _load_params(args.ckpt_dir, args.step)
+            params = jax.tree.map(
+                lambda x: jnp.asarray(x, jnp.float32), params
+            )
+            print(f"serving {args.ckpt_dir}/step_{step} (dense)", flush=True)
+        else:
+            params = init_params(jax.random.PRNGKey(args.seed), dcfg)
+        backend = DenseBackend(
+            params, dcfg, n_slots=args.slots, max_seq=max_seq
+        )
+        vocab = dcfg.vocab
+
+        def oracle(req):
+            toks = generate(
+                params, jnp.asarray(req.prompt)[None], dcfg,
+                max_new_tokens=req.max_new_tokens, max_seq=max_seq,
+            )
+            return np.asarray(toks)[0, : req.n_generated]
+    else:
+        from uccl_tpu.models.moe_inference import (
+            MoEServeConfig, MoEServer, init_params,
+        )
+        from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        cfg = MoEServeConfig(
+            vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+            n_heads=args.heads, n_kv_heads=args.kv_heads,
+            head_dim=args.dim // args.heads, moe_experts=args.experts,
+            moe_ffn=args.ffn,
+        )
+        n = len(jax.devices())
+        world = args.dp or n
+        if world > n:
+            raise SystemExit(
+                f"--dp {world} exceeds the {n} available device(s)"
+            )
+        if args.slots % world:
+            raise SystemExit(
+                f"--slots {args.slots} must divide by the serving world "
+                f"{world} (one slot pool row per shard batch row)"
+            )
+        impl = args.impl if args.impl != "auto" else (
+            "sort" if world == 1 else "ll"
+        )
+        mesh = make_mesh(MeshConfig(dp=world), jax.devices()[:world])
+        server = MoEServer(cfg, mesh)
+        if args.ckpt_dir:
+            params, step = _load_params(args.ckpt_dir, args.step)
+            _check_sizes(params, cfg)
+            params = jax.tree.map(
+                lambda x: jnp.asarray(x, jnp.float32), params
+            )
+            print(f"serving {args.ckpt_dir}/step_{step}", flush=True)
+        else:
+            params = init_params(jax.random.PRNGKey(args.seed), cfg)
+        backend = MoEBackend(
+            server, server.shard_params(params),
+            batch_local=args.slots // world, max_seq=max_seq,
+            decode_impl=impl,
+        )
+        vocab = cfg.vocab
+
+        oracle_srv = {}
+
+        def oracle(req):
+            # one-shot generate on a world-1 mesh: sharding is
+            # semantics-free (the tested parity property), so the 1-shard
+            # program is the cheapest exact oracle. Built once — its _fns
+            # cache then makes per-request calls pure cache hits.
+            if not oracle_srv:
+                srv1 = MoEServer(cfg, make_mesh(MeshConfig(dp=1),
+                                                jax.devices()[:1]))
+                oracle_srv["srv"] = (srv1, srv1.shard_params(params))
+            srv1, placed1 = oracle_srv["srv"]
+            toks = srv1.generate(
+                placed1, jnp.asarray(req.prompt)[None, None],
+                req.max_new_tokens, max_seq, impl=impl,
+            )
+            return np.asarray(toks)[0, 0, : req.n_generated]
+
+    engine = ServingEngine(
+        backend, max_queue=args.max_queue or None, register_stats=True
+    )
+
+    # synthetic workload (mixed prompt lengths, Poisson arrivals), compile
+    # warmup, and the wall-clock drive loop — shared with
+    # benchmarks/serving_bench.py (uccl_tpu/serving/loadgen.py)
+    rng = np.random.default_rng(args.seed)
+    prompts, lens, arrivals = synth_workload(
+        rng, args.requests, args.prompt_len, vocab, args.arrival_rate
+    )
+    warm_engine(engine, lens, max_seq, args.new_tokens)
+    reqs, wall = drive(engine, prompts, arrivals, args.new_tokens)
+
+    snap = engine.snapshot()
+    engine.close()
+    summary = {
+        "mode": "serve-continuous", "stack": stack, "ckpt_step": step,
+        "world": world, "slots": args.slots, "requests": args.requests,
+        "arrival_rate": args.arrival_rate, "new_tokens": args.new_tokens,
+        "wall_s": round(wall, 3), **snap,
+    }
+    if reqs:
+        print(f"first request: {reqs[0].out_tokens}", flush=True)
+
+    if args.check_oracle:
+        leaked = engine.pool.leaked()
+        mismatched = []
+        for r in reqs:
+            want = oracle(r)
+            if r.out_tokens != want.tolist():
+                mismatched.append((r.rid, r.out_tokens, want.tolist()))
+        ok = (not leaked and not mismatched and engine.sched.qsize == 0
+              and snap["completed"] == len(reqs))
+        summary["oracle_match"] = bool(ok)
+        summary["leaked_slots"] = leaked
+        print(json.dumps(summary), flush=True)
+        if not ok:
+            for rid, got, want in mismatched:
+                print(f"request {rid}: got {got} want {want}",
+                      file=sys.stderr)
+            raise SystemExit(
+                f"oracle check FAILED: leaked={leaked} "
+                f"mismatched={len(mismatched)}"
+            )
+        print(f"oracle check: {len(reqs)} requests bit-exact, "
+              f"0 leaked slots", flush=True)
+    else:
+        print(json.dumps(summary), flush=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m uccl_tpu.serve")
     ap.add_argument("--devices", type=int, default=0,
@@ -129,6 +349,32 @@ def main(argv=None):
                          "multi-member worlds where its packed rows cut "
                          "actual wire bytes (the DeepEP LL regime)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timing-reps", type=int, default=3,
+                    help="one-shot mode: repetitions of the timing windows "
+                         "feeding the TTFT/decode-step p50/p95 percentiles")
+    # continuous-batching server mode (uccl_tpu/serving, docs/SERVING.md)
+    ap.add_argument("--server", action="store_true",
+                    help="continuous-batching engine under a synthetic "
+                         "Poisson arrival stream (vs the one-shot batch)")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="server: KV slot pool size (MoE: must divide by "
+                         "the serving world; B_loc = slots/world)")
+    ap.add_argument("--requests", type=int, default=16,
+                    help="server: number of synthetic requests")
+    ap.add_argument("--arrival-rate", type=float, default=8.0,
+                    help="server: Poisson arrival rate in req/s "
+                         "(0 = all arrive at t=0)")
+    ap.add_argument("--max-queue", type=int, default=0,
+                    help="server: bounded queue depth; submissions beyond "
+                         "it are rejected (backpressure). 0 = unbounded")
+    ap.add_argument("--stack", default="auto",
+                    choices=["auto", "dense", "moe"],
+                    help="server: model stack ('auto': dense for dense "
+                         "checkpoints, else MoE)")
+    ap.add_argument("--check-oracle", action="store_true",
+                    help="server: verify every completed request is "
+                         "bit-identical to the one-shot generate oracle "
+                         "and that no KV slot leaked (CI smoke tier)")
     # model size — must match the checkpoint when --ckpt-dir is given
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--dim", type=int, default=64)
@@ -192,6 +438,8 @@ def main(argv=None):
                         f"config {saved_cfg[key]} ({cfg_path})"
                     )
                 setattr(args, flag, saved_cfg[key])
+    if args.server:
+        return _serve_continuous(args, saved_cfg)
     if saved_cfg is not None and saved_cfg.get("model") == "dense":
         # Dense (Llama-family) checkpoints generate through the cached
         # single-shard KV path (models/inference.py) — no EP mesh.
@@ -226,25 +474,24 @@ def main(argv=None):
         np.asarray(generate(params, prompt, dcfg,
                             max_new_tokens=args.new_tokens,
                             max_seq=max_seq))
-        # Honest decode throughput: this timed window INCLUDES prefill, so
-        # dividing by batch*new_tokens alone would flatter short windows.
-        # Time a second program at 1 new token (warmed the same way) and
-        # difference the windows — prefill + the fixed dispatch cost cancel
-        # in the delta, leaving decode-only time for new_tokens-1 tokens.
-        t_one = None
+        # Honest decode throughput: the full timed window INCLUDES prefill,
+        # so dividing by batch*new_tokens alone would flatter short windows.
+        # A second program at 1 new token (warmed the same way) gives the
+        # TTFT window, and the window delta is decode-only time for
+        # new_tokens-1 tokens. Repeated reps feed the p50/p95 percentiles
+        # (serving/metrics.py definitions).
+        run_one = None
         if args.new_tokens > 1:
             np.asarray(generate(params, prompt, dcfg, max_new_tokens=1,
                                 max_seq=max_seq))
-            t0 = time.perf_counter()
-            np.asarray(generate(params, prompt, dcfg, max_new_tokens=1,
-                                max_seq=max_seq))
-            t_one = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        out = np.asarray(generate(
+            run_one = lambda: np.asarray(generate(  # noqa: E731
+                params, prompt, dcfg, max_new_tokens=1, max_seq=max_seq))
+        run_full = lambda: np.asarray(generate(  # noqa: E731
             params, prompt, dcfg, max_new_tokens=args.new_tokens,
-            max_seq=max_seq,
-        ))
-        dt = time.perf_counter() - t0
+            max_seq=max_seq))
+        out, dt, extra = _timed_windows(
+            run_full, run_one, args.batch, args.new_tokens, args.timing_reps
+        )
         summary = {
             "mode": "serve", "ckpt_step": step, "impl": "dense",
             "world": 1, "batch": args.batch,
@@ -253,14 +500,8 @@ def main(argv=None):
             # prefill AND decode
             "window": "prefill+decode",
             "tokens_per_sec": round(args.batch * args.new_tokens / dt, 1),
+            **extra,
         }
-        # only report the delta metric when the differenced window is
-        # positive — on prefill-dominated runs jitter can make t_one >= dt,
-        # and clamping would print an absurd throughput as the honest number
-        if t_one is not None and dt > t_one:
-            summary["decode_tokens_per_sec"] = round(
-                args.batch * (args.new_tokens - 1) / (dt - t_one), 1
-            )
         print(f"first sequence: {out[0].tolist()}", flush=True)
         print(json.dumps(summary), flush=True)
         return
@@ -323,19 +564,18 @@ def main(argv=None):
     ))
     # decode-only throughput via the 1-token delta (see the dense branch:
     # the timed window spans prefill+decode, so the delta of two windows
-    # is the honest decode number)
-    t_one = None
+    # is the honest decode number); repeated reps feed the TTFT /
+    # decode-step percentiles
+    run_one = None
     if args.new_tokens > 1:
         np.asarray(server.generate(placed, prompt, 1, max_seq, impl=impl))
-        t0 = time.perf_counter()
-        np.asarray(server.generate(placed, prompt, 1, max_seq, impl=impl))
-        t_one = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = server.generate(
-        placed, prompt, args.new_tokens, max_seq, impl=impl
+        run_one = lambda: np.asarray(server.generate(  # noqa: E731
+            placed, prompt, 1, max_seq, impl=impl))
+    run_full = lambda: np.asarray(server.generate(  # noqa: E731
+        placed, prompt, args.new_tokens, max_seq, impl=impl))
+    out, dt, extra = _timed_windows(
+        run_full, run_one, args.batch, args.new_tokens, args.timing_reps
     )
-    out = np.asarray(out)  # [W, B_loc, N]
-    dt = time.perf_counter() - t0
     total = args.batch * args.new_tokens
     summary = {
         "mode": "serve",
@@ -346,13 +586,8 @@ def main(argv=None):
         "new_tokens": args.new_tokens,
         "window": "prefill+decode",
         "tokens_per_sec": round(total / dt, 1),
+        **extra,
     }
-    # see the dense branch: report the delta metric only when the
-    # differenced window is positive, never a clamped absurdity
-    if t_one is not None and dt > t_one:
-        summary["decode_tokens_per_sec"] = round(
-            args.batch * (args.new_tokens - 1) / (dt - t_one), 1
-        )
     print(f"first sequence: {out[0, 0].tolist()}", flush=True)
     print(json.dumps(summary), flush=True)
 

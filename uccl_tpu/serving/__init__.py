@@ -1,0 +1,24 @@
+"""Continuous-batching serving engine (docs/SERVING.md).
+
+Request scheduler + KV slot manager + serving metrics over the repo's
+dense and MoE serving stacks: requests arrive at any time, share one fixed
+KV slot pool, and each engine step admits, prefills, decodes and retires —
+with every request's tokens bit-identical to the one-shot ``generate``
+oracle.
+"""
+
+from uccl_tpu.serving.engine import (  # noqa: F401
+    DenseBackend, MoEBackend, ServingEngine,
+)
+from uccl_tpu.serving.metrics import (  # noqa: F401
+    ServingMetrics, percentile, percentiles_ms,
+)
+from uccl_tpu.serving.request import Request, RequestState  # noqa: F401
+from uccl_tpu.serving.scheduler import FIFOScheduler  # noqa: F401
+from uccl_tpu.serving.slots import SlotPool  # noqa: F401
+
+__all__ = [
+    "DenseBackend", "MoEBackend", "ServingEngine", "ServingMetrics",
+    "percentile", "percentiles_ms", "Request", "RequestState",
+    "FIFOScheduler", "SlotPool",
+]

@@ -1,0 +1,303 @@
+"""Continuous-batching serving engine over the KV slot pool.
+
+Orca/vLLM-shape iteration-level scheduling on the repo's serving stacks:
+``submit()`` queues a request, each ``step()`` (1) admits queue-head
+requests into free KV slots and batch-prefills exactly those slots (masked —
+mid-decode neighbors untouched), (2) runs ONE masked batched decode step
+over every active slot, (3) retires sequences on EOS or token budget and
+frees their slots for the next admission. ``drain()`` steps until idle.
+
+The engine is exact, not approximate: each request's emitted tokens are
+bit-identical to the one-shot ``generate`` oracle for the same prompt
+(greedy decode over the same per-row math — tests/test_serving.py proves it
+for both stacks). Model programs are jitted once per shape via the same
+LRU-bounded ``_fns`` pattern the one-shot servers use.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from uccl_tpu.serving.metrics import ServingMetrics
+from uccl_tpu.serving.request import Request, RequestState, now
+from uccl_tpu.serving.scheduler import FIFOScheduler
+from uccl_tpu.serving.slots import SlotPool
+from uccl_tpu.utils.lru import LRUFnCache
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Prefill bucket length: next power of two (bounded compile count —
+    at most log2(max_seq) distinct prefill programs), clipped to cap."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class DenseBackend:
+    """Slot-pool serving over the dense KV stack (models/inference.py)."""
+
+    def __init__(self, params, cfg, *, n_slots: int, max_seq: int):
+        import jax
+
+        from uccl_tpu.models.inference import SlotKVCache
+
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_seq = max_seq
+        self.cache = SlotKVCache.empty(cfg, n_slots, max_seq)
+        self._fns = LRUFnCache(16)
+        self._jax = jax
+
+    def _prefill_fn(self, s: int):
+        jax = self._jax
+        cfg = self.cfg
+
+        def build():
+            from uccl_tpu.models.inference import SlotKVCache, prefill_slots
+
+            def run(p, tok, lens, mask, kc, vc, ln):
+                t, cache = prefill_slots(
+                    p, tok, lens, mask, SlotKVCache(kc, vc, ln), cfg
+                )
+                return t, cache.k, cache.v, cache.lengths
+
+            return jax.jit(run)
+
+        return self._fns.get(("prefill", s), build)
+
+    def _decode_fn(self):
+        jax = self._jax
+        cfg = self.cfg
+
+        def build():
+            from uccl_tpu.models.inference import (
+                SlotKVCache, decode_step_slots,
+            )
+
+            def run(p, tok, mask, kc, vc, ln):
+                t, cache = decode_step_slots(
+                    p, tok, mask, SlotKVCache(kc, vc, ln), cfg
+                )
+                return t, cache.k, cache.v, cache.lengths
+
+            return jax.jit(run)
+
+        return self._fns.get(("decode",), build)
+
+    def prefill(self, tokens: np.ndarray, lens: np.ndarray,
+                mask: np.ndarray) -> np.ndarray:
+        from uccl_tpu.models.inference import SlotKVCache
+
+        fn = self._prefill_fn(tokens.shape[1])
+        t, k, v, ln = fn(self.params, tokens, lens, mask,
+                         self.cache.k, self.cache.v, self.cache.lengths)
+        self.cache = SlotKVCache(k, v, ln)
+        return np.asarray(t)
+
+    def decode(self, tokens: np.ndarray, active: np.ndarray) -> np.ndarray:
+        from uccl_tpu.models.inference import SlotKVCache
+
+        fn = self._decode_fn()
+        t, k, v, ln = fn(self.params, tokens, active,
+                         self.cache.k, self.cache.v, self.cache.lengths)
+        self.cache = SlotKVCache(k, v, ln)
+        return np.asarray(t)
+
+
+class MoEBackend:
+    """Slot-pool serving over the EP-sharded MoE stack: slots are the
+    [W, B_loc] rows of the server's cache (slot s ↔ shard s // B_loc, row
+    s % B_loc); prefill routes through the sorted EP path, decode through
+    the packed LL path (the DeepEP decode regime) by default."""
+
+    def __init__(self, server, params, *, batch_local: int, max_seq: int,
+                 decode_impl: str = "ll"):
+        self.server = server
+        self.params = params
+        self.world = server.world
+        self.b_loc = batch_local
+        self.n_slots = self.world * batch_local
+        self.max_seq = max_seq
+        self.decode_impl = decode_impl
+        self.cache = server.slot_cache(batch_local, max_seq)
+
+    def _grid(self, flat: np.ndarray, dtype) -> "np.ndarray":
+        import jax.numpy as jnp
+
+        return jnp.asarray(
+            np.asarray(flat).reshape((self.world, self.b_loc)
+                                     + flat.shape[1:]).astype(dtype)
+        )
+
+    def prefill(self, tokens: np.ndarray, lens: np.ndarray,
+                mask: np.ndarray) -> np.ndarray:
+        t, self.cache = self.server.prefill_slots(
+            self.params, self._grid(tokens, np.int32),
+            self._grid(lens, np.int32), self._grid(mask, bool), self.cache,
+        )
+        return np.asarray(t).reshape(self.n_slots)
+
+    def decode(self, tokens: np.ndarray, active: np.ndarray) -> np.ndarray:
+        t, self.cache = self.server.decode_step_slots(
+            self.params, self._grid(tokens, np.int32),
+            self._grid(active, bool), self.cache, impl=self.decode_impl,
+        )
+        return np.asarray(t).reshape(self.n_slots)
+
+
+class ServingEngine:
+    """submit()/step()/drain() over a backend (Dense or MoE)."""
+
+    _stats_seq = 0  # distinct registry source name per registered engine
+
+    def __init__(self, backend, *, max_queue: Optional[int] = None,
+                 register_stats: bool = False):
+        self.backend = backend
+        self.pool = SlotPool(backend.n_slots)
+        self.sched = FIFOScheduler(max_queue=max_queue)
+        self.metrics = ServingMetrics()
+        self._by_slot = {}  # slot -> Request
+        self._last_tok = np.zeros(backend.n_slots, np.int32)
+        self._next_rid = 0
+        self._stats_name: Optional[str] = None
+        if register_stats:
+            # unique per engine: a second registered engine must not
+            # silently replace the first's export (registry.register
+            # overwrites by name), nor unhook it on close()
+            n = ServingEngine._stats_seq
+            ServingEngine._stats_seq += 1
+            self._stats_name = "serving" if n == 0 else f"serving-{n}"
+            self.metrics.register(self, self._stats_name)
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, prompt, *, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None) -> Optional[Request]:
+        """Queue one request. Returns the Request, or None when rejected by
+        backpressure (bounded queue full)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if prompt.size + max_new_tokens > self.backend.max_seq:
+            raise ValueError(
+                f"prompt {prompt.size} + new {max_new_tokens} tokens exceed "
+                f"max_seq {self.backend.max_seq}: the slot would overflow"
+            )
+        req = Request(
+            rid=self._next_rid, prompt=prompt,
+            max_new_tokens=max_new_tokens, eos_id=eos_id, t_submit=now(),
+        )
+        self._next_rid += 1
+        self.metrics.on_submit(req)
+        if not self.sched.submit(req):
+            self.metrics.on_reject(req)
+            return None
+        return req
+
+    # -- the engine iteration ----------------------------------------------
+    def has_work(self) -> bool:
+        return bool(self.sched.qsize or self._by_slot)
+
+    def step(self) -> List[Request]:
+        """One iteration: admit+prefill, one masked decode, retire.
+        Returns requests finished during this step."""
+        finished: List[Request] = []
+        newly = self.sched.admit(self.pool)
+        if newly:
+            self._prefill(newly, finished)
+        if self._by_slot:
+            self._decode(finished)
+        return finished
+
+    def drain(self, max_steps: int = 100000) -> List[Request]:
+        """Step until queue and slots are empty; returns all finished."""
+        done: List[Request] = []
+        steps = 0
+        while self.has_work():
+            done.extend(self.step())
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"drain exceeded {max_steps} steps with work remaining "
+                    f"(queued={self.sched.qsize}, active={len(self._by_slot)})"
+                )
+        return done
+
+    def snapshot(self) -> dict:
+        return self.metrics.snapshot(
+            queued=self.sched.qsize, active=len(self._by_slot),
+            n_slots=self.pool.n_slots, occupancy=self.pool.occupancy,
+        )
+
+    def reset_metrics(self) -> None:
+        """Zero counters/samples (e.g. after compile warmup) — the slot
+        pool, queue and compiled programs are untouched."""
+        self.metrics = ServingMetrics()
+
+    def close(self) -> None:
+        # only tear down the stats export THIS engine registered — a
+        # second engine with register_stats=False must not unhook the
+        # first one's source
+        if self._stats_name is not None:
+            self.metrics.unregister(self._stats_name)
+            self._stats_name = None
+
+    # -- internals ----------------------------------------------------------
+    def _prefill(self, newly, finished) -> None:
+        n = self.backend.n_slots
+        s_bucket = _bucket(max(r.prompt.size for _, r in newly),
+                           self.backend.max_seq)
+        tokens = np.zeros((n, s_bucket), np.int32)
+        lens = np.ones(n, np.int32)  # 1 (not 0): the -1 logit gather stays
+        mask = np.zeros(n, bool)     # in bounds on non-admitted rows
+        for slot, req in newly:
+            tokens[slot, :req.prompt.size] = req.prompt
+            lens[slot] = req.prompt.size
+            mask[slot] = True
+            self.metrics.on_admit(req)
+        t0 = now()
+        tok = self.backend.prefill(tokens, lens, mask)
+        self.metrics.on_prefill(now() - t0, len(newly))
+        t_done = now()
+        for slot, req in newly:
+            self._by_slot[slot] = req
+            self._last_tok[slot] = tok[slot]
+            req.out_tokens.append(int(tok[slot]))
+            req.t_first_token = t_done
+            self.metrics.on_first_token(req)
+            self._maybe_retire(slot, req, t_done, finished)
+
+    def _decode(self, finished) -> None:
+        active = np.zeros(self.backend.n_slots, bool)
+        for slot in self._by_slot:
+            active[slot] = True
+        t0 = now()
+        tok = self.backend.decode(self._last_tok.copy(), active)
+        self.metrics.on_decode_step(now() - t0, len(self._by_slot))
+        t_done = now()
+        for slot, req in list(self._by_slot.items()):
+            self._last_tok[slot] = tok[slot]
+            req.out_tokens.append(int(tok[slot]))
+            self._maybe_retire(slot, req, t_done, finished)
+
+    def _maybe_retire(self, slot: int, req: Request, t: float,
+                      finished) -> None:
+        if req.eos_id is not None and req.out_tokens[-1] == req.eos_id:
+            req.finish_reason = "eos"
+        elif req.n_generated >= req.max_new_tokens:
+            req.finish_reason = "length"
+        else:
+            return
+        req.state = RequestState.FINISHED
+        req.t_finish = t
+        self.pool.free(slot)
+        self._by_slot.pop(slot, None)
+        self.metrics.on_finish(req)
+        finished.append(req)

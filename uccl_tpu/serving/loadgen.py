@@ -1,0 +1,76 @@
+"""Synthetic load generation + the Poisson arrival drive loop.
+
+One implementation shared by ``python -m uccl_tpu.serve --server`` (the CI
+serving smoke tier) and ``benchmarks/serving_bench.py`` — both must
+measure the SAME loop, or a warmup/arrival-timing fix would land in only
+one of them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from uccl_tpu.serving.engine import ServingEngine, _bucket
+from uccl_tpu.serving.request import Request, now
+
+
+def synth_workload(rng: np.random.Generator, n: int, prompt_len: int,
+                   vocab: int, arrival_rate: float):
+    """Mixed-length prompts (lengths in [max(1, L/2), L]) with Poisson
+    arrival offsets (all at t=0 when rate is 0). Returns
+    (prompts, lens, arrivals)."""
+    lo = max(1, prompt_len // 2)
+    lens = rng.integers(lo, prompt_len + 1, n)
+    prompts = [rng.integers(0, vocab, l).astype(np.int32) for l in lens]
+    if arrival_rate > 0:
+        arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate, n))
+    else:
+        arrivals = np.zeros(n)
+    return prompts, lens, arrivals
+
+
+def warm_engine(engine: ServingEngine, lens, max_seq: int,
+                new_tokens: int) -> None:
+    """Compile every prefill bucket the sampled lengths can hit plus the
+    decode program, then zero the metrics: compiles are a one-time cost a
+    long-lived server never pays again, and folding them into TTFT
+    percentiles would report compile time, not serving time. One
+    representative length per bucket compiles that bucket's program;
+    min 2 tokens — a 1-token warmup retires at prefill and would leave
+    the decode program cold."""
+    by_bucket = {}
+    for l in lens:
+        by_bucket[_bucket(int(l), max_seq)] = int(l)
+    for _, l in sorted(by_bucket.items()):
+        engine.submit(np.zeros(l, np.int32),
+                      max_new_tokens=min(2, new_tokens))
+        engine.drain()
+    engine.reset_metrics()
+
+
+def drive(engine: ServingEngine, prompts, arrivals, max_new_tokens: int,
+          eos_id: Optional[int] = None) -> Tuple[List[Request], float]:
+    """Run the arrival stream to completion: submit requests as their
+    arrival offsets come due (wall clock), stepping the engine whenever it
+    has work. Returns (accepted requests, wall seconds); rejected
+    submissions (bounded queue) are counted in the engine's metrics but
+    not returned."""
+    reqs: List[Request] = []
+    i, n = 0, len(prompts)
+    t0 = now()
+    while i < n or engine.has_work():
+        t = now() - t0
+        while i < n and arrivals[i] <= t:
+            r = engine.submit(prompts[i], max_new_tokens=max_new_tokens,
+                              eos_id=eos_id)
+            if r is not None:
+                reqs.append(r)
+            i += 1
+        if engine.has_work():
+            engine.step()
+        elif i < n:
+            time.sleep(min(0.005, max(arrivals[i] - (now() - t0), 0.0)))
+    return reqs, now() - t0

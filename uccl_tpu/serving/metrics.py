@@ -1,0 +1,159 @@
+"""Serving metrics: queue depth, slot occupancy, goodput, TTFT/TPOT.
+
+Definitions (shared with serve.py's one-shot percentiles and
+benchmarks/serving_bench.py — docs/SERVING.md spells them out):
+
+* **TTFT** — submit → first generated token, queue wait included.
+* **TPOT** — per-request mean seconds per output token AFTER the first
+  (decode steady state): (t_finish - t_first) / (n_out - 1).
+* **decode step latency** — wall time of one masked batched decode call.
+* **goodput** — completed requests' output tokens per second of serving
+  wall time (first submit → last finish). Tokens of in-flight or rejected
+  requests never count: goodput is *useful delivered* throughput.
+
+The snapshot is JSON-ready and also exported through the repo-wide stats
+thread (`uccl_tpu.utils.stats.registry`) under the ``serving`` source, the
+same channel every other subsystem reports on.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from uccl_tpu.serving.request import Request, now
+
+
+def percentile(xs: List[float], q: float) -> Optional[float]:
+    """Linear-interpolation percentile (numpy's default), None when empty."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    if len(s) == 1:
+        return float(s[0])
+    pos = (len(s) - 1) * q / 100.0
+    lo = math.floor(pos)
+    frac = pos - lo
+    if lo + 1 >= len(s):
+        return float(s[-1])
+    return float(s[lo] * (1.0 - frac) + s[lo + 1] * frac)
+
+
+def percentiles_ms(xs: List[float], qs=(50, 95)) -> Dict[str, float]:
+    """{'p50': ..., 'p95': ...} in milliseconds (empty dict when no samples)."""
+    out = {}
+    for q in qs:
+        v = percentile(xs, q)
+        if v is not None:
+            out[f"p{q}"] = round(v * 1e3, 3)
+    return out
+
+
+class ServingMetrics:
+    """Counters + latency samples for one engine; host-only, jax-free."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.rejected = 0
+        self.admitted = 0
+        self.completed = 0
+        self.output_tokens = 0  # completed requests only (goodput numerator)
+        self.prefill_calls = 0
+        self.decode_calls = 0
+        self.ttft_s: List[float] = []
+        self.tpot_s: List[float] = []
+        self.latency_s: List[float] = []
+        self.prefill_s: List[float] = []
+        self.decode_step_s: List[float] = []
+        self.t_first_submit: Optional[float] = None
+        self.t_last_finish: Optional[float] = None
+
+    # -- lifecycle hooks (the engine calls these) ---------------------------
+    def on_submit(self, req: Request) -> None:
+        self.submitted += 1
+        if self.t_first_submit is None:
+            self.t_first_submit = req.t_submit
+
+    def on_reject(self, req: Request) -> None:
+        self.rejected += 1
+
+    def on_admit(self, req: Request) -> None:
+        self.admitted += 1
+
+    def on_first_token(self, req: Request) -> None:
+        if req.ttft is not None:
+            self.ttft_s.append(req.ttft)
+
+    def on_finish(self, req: Request) -> None:
+        self.completed += 1
+        self.output_tokens += req.n_generated
+        self.t_last_finish = req.t_finish
+        if req.tpot is not None:
+            self.tpot_s.append(req.tpot)
+        if req.latency is not None:
+            self.latency_s.append(req.latency)
+
+    def on_prefill(self, dt: float, n_new: int) -> None:
+        self.prefill_calls += 1
+        self.prefill_s.append(dt)
+
+    def on_decode_step(self, dt: float, n_active: int) -> None:
+        self.decode_calls += 1
+        self.decode_step_s.append(dt)
+
+    # -- derived ------------------------------------------------------------
+    def goodput(self) -> Optional[float]:
+        """Completed output tokens / serving wall seconds."""
+        if self.t_last_finish is None or self.t_first_submit is None:
+            return None
+        dt = self.t_last_finish - self.t_first_submit
+        if dt <= 0:
+            return None
+        return self.output_tokens / dt
+
+    def snapshot(self, *, queued: int = 0, active: int = 0,
+                 n_slots: int = 0, occupancy: float = 0.0) -> Dict:
+        """JSON-ready state. Conservation invariant (tested):
+        submitted == completed + active + queued + rejected."""
+        snap = {
+            "submitted": self.submitted,
+            "rejected": self.rejected,
+            "admitted": self.admitted,
+            "completed": self.completed,
+            "queued": queued,
+            "active": active,
+            "n_slots": n_slots,
+            "occupancy": round(occupancy, 4),
+            "output_tokens": self.output_tokens,
+            "prefill_calls": self.prefill_calls,
+            "decode_calls": self.decode_calls,
+            "ttft_ms": percentiles_ms(self.ttft_s),
+            "tpot_ms": percentiles_ms(self.tpot_s),
+            "latency_ms": percentiles_ms(self.latency_s),
+            "prefill_ms": percentiles_ms(self.prefill_s),
+            "decode_step_ms": percentiles_ms(self.decode_step_s),
+        }
+        gp = self.goodput()
+        if gp is not None:
+            snap["goodput_tok_s"] = round(gp, 1)
+        return snap
+
+    # -- repo-wide stats thread export --------------------------------------
+    def register(self, engine, name: str = "serving") -> None:
+        """Export through uccl_tpu.utils.stats — the same periodic snapshot
+        channel the transport engines report on."""
+        from uccl_tpu.utils.stats import registry
+
+        def source() -> Dict[str, float]:
+            s = engine.snapshot()
+            return {
+                k: float(v) for k, v in s.items()
+                if isinstance(v, (int, float))
+            }
+
+        registry.register(name, source)
+
+    def unregister(self, name: str = "serving") -> None:
+        from uccl_tpu.utils.stats import registry
+
+        registry.unregister(name)

@@ -1,0 +1,84 @@
+"""Request lifecycle for the continuous-batching engine.
+
+A request is one sequence: a prompt, a token budget, and an optional EOS id.
+It moves QUEUED → ACTIVE (admitted to a KV slot) → FINISHED (EOS or budget),
+or is REJECTED at submit when the queue is full (backpressure). Timing marks
+are taken at every transition so the serving metrics (TTFT, TPOT, latency —
+docs/SERVING.md) fall out of the lifecycle instead of being instrumented
+around it.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+
+def now() -> float:
+    """The engine's clock (monotonic seconds). One symbol so every timing
+    window — engine, metrics, serve.py's one-shot percentiles — measures
+    with the same clock."""
+    return time.perf_counter()
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    ACTIVE = "active"
+    FINISHED = "finished"
+    REJECTED = "rejected"
+
+
+@dataclass
+class Request:
+    """One serving request and its measured lifecycle.
+
+    ``out_tokens`` is the greedy continuation, element-for-element the
+    prefix of what the one-shot ``generate`` oracle would emit for the same
+    prompt (exactness is the engine's tested contract, not a tolerance).
+    """
+
+    rid: int
+    prompt: np.ndarray  # 1-D int32 token ids
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    state: RequestState = RequestState.QUEUED
+    slot: Optional[int] = None
+    admit_seq: Optional[int] = None  # admission order (FIFO is testable)
+    out_tokens: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None  # "eos" | "length"
+    t_submit: float = 0.0
+    t_admit: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.out_tokens)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to first token: submit (queue wait included) → first token."""
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Time per output token after the first (decode steady state)."""
+        if (self.t_finish is None or self.t_first_token is None
+                or self.n_generated < 2):
+            return None
+        return (self.t_finish - self.t_first_token) / (self.n_generated - 1)
+
+    @property
+    def latency(self) -> Optional[float]:
+        if self.t_finish is None:
+            return None
+        return self.t_finish - self.t_submit
+
+    def is_done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.REJECTED)

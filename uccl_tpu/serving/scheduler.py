@@ -1,0 +1,56 @@
+"""FIFO admission scheduling with backpressure.
+
+Orca/vLLM-shape policy, smallest useful core: arrivals queue in submission
+order; every engine step admits from the queue head while KV slots are free
+(so a long-running sequence never starves the queue — it just occupies one
+slot); a bounded queue rejects at submit when full (backpressure — the
+caller sees it immediately instead of timing out later).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Optional, Tuple
+
+from uccl_tpu.serving.request import Request, RequestState, now
+from uccl_tpu.serving.slots import SlotPool
+
+
+class FIFOScheduler:
+    """Bounded FIFO queue + admission loop over a :class:`SlotPool`."""
+
+    def __init__(self, max_queue: Optional[int] = None):
+        if max_queue is not None and max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        self.max_queue = max_queue
+        self._queue: deque = deque()
+        self._admit_seq = 0
+
+    @property
+    def qsize(self) -> int:
+        return len(self._queue)
+
+    def submit(self, req: Request) -> bool:
+        """Queue a request; False = rejected (queue full, backpressure)."""
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            req.state = RequestState.REJECTED
+            return False
+        self._queue.append(req)
+        return True
+
+    def admit(self, pool: SlotPool) -> List[Tuple[int, Request]]:
+        """Move queue-head requests into free slots, in FIFO order, until
+        either runs out. Returns the newly admitted (slot, request) pairs —
+        the engine prefills exactly these."""
+        admitted: List[Tuple[int, Request]] = []
+        while self._queue and pool.n_free:
+            req = self._queue.popleft()
+            slot = pool.admit(req.rid)
+            assert slot is not None  # n_free was checked
+            req.slot = slot
+            req.state = RequestState.ACTIVE
+            req.t_admit = now()
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            admitted.append((slot, req))
+        return admitted

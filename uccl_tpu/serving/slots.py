@@ -1,0 +1,67 @@
+"""KV slot pool: the fixed [n_slots, S_max] cache managed as reusable rows.
+
+The device cache is allocated ONCE (the backend owns the arrays); this class
+owns the host-side bookkeeping — which rows are free, which request occupies
+which row, occupancy history. Freeing a slot does not touch device memory:
+a stale row is dead by construction (attention stops at the slot's length,
+and a new occupant prefills from position 0, re-writing every position
+before any read of it — see models/inference.py `prefill_slots`).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class SlotPool:
+    """Free-list of KV cache rows with admit/free/occupancy tracking."""
+
+    def __init__(self, n_slots: int):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.n_slots = n_slots
+        self._free = deque(range(n_slots))  # lowest-slot-first reuse
+        self._occupant: Dict[int, int] = {}  # slot -> rid
+        self.total_admits = 0
+        self.total_frees = 0
+        self.high_water = 0  # max concurrent occupancy observed
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_active(self) -> int:
+        return self.n_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.n_active / self.n_slots
+
+    def occupant(self, slot: int) -> Optional[int]:
+        return self._occupant.get(slot)
+
+    def active_slots(self) -> List[int]:
+        return sorted(self._occupant)
+
+    def admit(self, rid: int) -> Optional[int]:
+        """Claim a free slot for ``rid``; None when the pool is full."""
+        if not self._free:
+            return None
+        slot = self._free.popleft()
+        self._occupant[slot] = rid
+        self.total_admits += 1
+        self.high_water = max(self.high_water, self.n_active)
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._occupant:
+            raise ValueError(f"slot {slot} is not occupied")
+        del self._occupant[slot]
+        self._free.append(slot)
+        self.total_frees += 1
+
+    def leaked(self) -> int:
+        """Occupied slots — must be 0 after a full drain (tested)."""
+        return self.n_active

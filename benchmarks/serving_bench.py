@@ -1,13 +1,17 @@
-"""Serving engine benchmark: arrival rate × slot count sweep.
+"""Serving engine benchmark: arrival rate × slot count × prefill-chunk sweep.
 
 Each arm runs the continuous-batching engine (uccl_tpu/serving) under a
 synthetic Poisson arrival stream of mixed-length prompts and emits ONE JSON
-line with goodput and TTFT/TPOT percentiles — the load/latency tradeoff
-surface of the slot pool (docs/SERVING.md). Compile warmup happens before
-the clock starts, so the percentiles measure serving, not XLA.
+line with goodput, TTFT/TPOT/queue-wait percentiles, and the decode-stall
+surface chunked prefill exists to shrink — ``tpot_p95_ms`` and
+``max_step_ms`` per arm, so the stall reduction is a recorded number, not a
+claim (docs/SERVING.md). Compile warmup happens before the clock starts, so
+the percentiles measure serving, not XLA.
 
     python benchmarks/serving_bench.py --devices 2 --rates 4,16 --slots 2,4
     python benchmarks/serving_bench.py --stack moe --devices 4 --slots 4
+    python benchmarks/serving_bench.py --prompt-len 64 --rates 16 \
+        --slots 4 --prefill-chunks off,8,32      # the stall-bound sweep
 """
 
 from __future__ import annotations
@@ -18,7 +22,10 @@ import json
 from _bootstrap import init_devices
 
 
-def run_arm(args, jax, stack, rate, n_slots):
+def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None):
+    step_tokens = (args.step_tokens or None) if prefill_chunk else None
+    if step_tokens is not None and step_tokens < prefill_chunk:
+        return None  # this arm's budget can't admit even one chunk
     import numpy as np
 
     from uccl_tpu.serving import DenseBackend, MoEBackend, ServingEngine
@@ -57,7 +64,9 @@ def run_arm(args, jax, stack, rate, n_slots):
         )
         vocab = cfg.vocab
 
-    engine = ServingEngine(backend)
+    engine = ServingEngine(
+        backend, prefill_chunk=prefill_chunk, step_tokens=step_tokens,
+    )
     rng = np.random.default_rng(args.seed)
     prompts, lens, arrivals = synth_workload(
         rng, args.requests, args.prompt_len, vocab, rate
@@ -69,12 +78,18 @@ def run_arm(args, jax, stack, rate, n_slots):
     return {
         "bench": "serving", "stack": stack, "world": world,
         "arrival_rate": rate, "slots": n_slots,
+        "prefill_chunk": prefill_chunk, "step_tokens": step_tokens,
         "requests": args.requests, "new_tokens": args.new_tokens,
         "prompt_len": args.prompt_len, "wall_s": round(wall, 3),
         "completed": snap["completed"], "rejected": snap["rejected"],
         "goodput_tok_s": snap.get("goodput_tok_s"),
-        "ttft_ms": snap["ttft_ms"], "tpot_ms": snap["tpot_ms"],
+        "ttft_ms": snap["ttft_ms"], "queue_wait_ms": snap["queue_wait_ms"],
+        "tpot_ms": snap["tpot_ms"],
+        "tpot_p95_ms": snap["tpot_ms"].get("p95"),
         "decode_step_ms": snap["decode_step_ms"],
+        "step_ms": snap["step_ms"],
+        "max_step_ms": snap.get("max_step_ms"),
+        "prefill_chunks": snap["prefill_chunks"],
         "slot_high_water": engine.pool.high_water,
     }
 
@@ -88,6 +103,14 @@ def main():
                     help="comma-separated Poisson arrival rates (req/s)")
     ap.add_argument("--slots", default="2,4",
                     help="comma-separated slot pool sizes")
+    ap.add_argument("--prefill-chunks", default="off,8,32",
+                    help="comma-separated chunked-prefill arms: 'off' = "
+                         "whole-prompt (PR 3 path), an integer = chunk "
+                         "size C (one C-token chunk per admitted request "
+                         "per step — bounds decode stalls)")
+    ap.add_argument("--step-tokens", type=int, default=0,
+                    help="per-step token budget for chunked arms "
+                         "(0 = unbudgeted)")
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=8)
@@ -99,17 +122,22 @@ def main():
     args = ap.parse_args()
 
     jax = init_devices(args.devices)
+    chunks = [None if c.strip() in ("off", "0", "none") else int(c)
+              for c in args.prefill_chunks.split(",")]
     for rate in [float(r) for r in args.rates.split(",")]:
         for n_slots in [int(s) for s in args.slots.split(",")]:
-            arm = run_arm(args, jax, args.stack, rate, n_slots)
-            if arm is None:
-                print(json.dumps({
-                    "bench": "serving", "stack": args.stack,
-                    "arrival_rate": rate, "slots": n_slots,
-                    "skipped": "slots must divide by the MoE world",
-                }), flush=True)
-                continue
-            print(json.dumps(arm), flush=True)
+            for chunk in chunks:
+                arm = run_arm(args, jax, args.stack, rate, n_slots, chunk)
+                if arm is None:
+                    print(json.dumps({
+                        "bench": "serving", "stack": args.stack,
+                        "arrival_rate": rate, "slots": n_slots,
+                        "prefill_chunk": chunk,
+                        "skipped": "slots must divide by the MoE world, or "
+                                   "--step-tokens < the arm's chunk",
+                    }), flush=True)
+                    continue
+                print(json.dumps(arm), flush=True)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,10 @@ timeout 900 python -m pytest tests/test_pallas_a2a.py tests/test_pallas_ccl.py -
 note "serving engine smoke tier (fail-fast: 2 slots, 6 mixed-length requests, oracle match + no leaked slots)"
 JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
   --requests 6 --prompt-len 8 --new-tokens 4 --arrival-rate 50 --check-oracle; check $?
+note "serving engine smoke tier, chunked prefill (8-token chunks over 12-token prompts: multi-chunk resume + oracle match)"
+JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
+  --requests 6 --prompt-len 12 --new-tokens 4 --arrival-rate 50 \
+  --prefill-chunk 8 --check-oracle; check $?
 
 note "pytest (full suite, virtual 8-device mesh; pallas kernel files ran in the smoke tier)"
 timeout 2700 python -m pytest tests/ -q \

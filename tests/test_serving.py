@@ -5,12 +5,18 @@ Two layers of test:
 * **Properties** (stub backend, host-only, fast): FIFO admission order, no
   leaked slots after drain, retirement on EOS and on max-tokens,
   backpressure under a bounded queue, metrics conservation
-  (submitted == completed + active + queued + rejected).
+  (submitted == completed + active + queued + rejected), lowest-slot-first
+  pool reuse, and the chunked-prefill scheduling contract — cursor
+  resumption, budget-gated admission, and the decode stall bound (no
+  active slot goes more than one step without a decode while another
+  request prefills).
 * **Oracle exactness** (real models): with ≥2 slots and staggered
   mixed-length arrivals, every request's tokens are bit-identical to the
   one-shot ``generate`` oracle — for the dense stack and for the EP MoE
   stack on a multi-shard mesh (whose oracle is the world-1 server; the
-  repo's parity tests prove world-independence separately).
+  repo's parity tests prove world-independence separately) — in
+  whole-prompt mode AND under chunked prefill (chunk sizes odd /
+  non-dividing, pow2, and ≥ the longest prompt).
 """
 
 import jax
@@ -19,7 +25,7 @@ import numpy as np
 import pytest
 
 from uccl_tpu.serving import (
-    DenseBackend, MoEBackend, RequestState, ServingEngine,
+    DenseBackend, MoEBackend, RequestState, ServingEngine, SlotPool,
 )
 from uccl_tpu.serving.metrics import percentile
 
@@ -38,6 +44,34 @@ class _StubBackend:
 
     def decode(self, tokens, active):
         self.n_decodes += 1
+        return np.full(self.n_slots, self.n_decodes, np.int32)
+
+
+class _ChunkStubBackend:
+    """Chunk-aware stub: records every backend call (kind, masked slots,
+    start offsets) so scheduling order and cursor resumption are directly
+    assertable. Prefill emits 100, the i-th decode step emits i."""
+
+    def __init__(self, n_slots=2, max_seq=64):
+        self.n_slots = n_slots
+        self.max_seq = max_seq
+        self.n_decodes = 0
+        self.calls = []
+
+    def prefill(self, tokens, lens, mask, start=None):
+        if start is None:
+            start = np.zeros(self.n_slots, np.int32)
+        slots = tuple(int(s) for s in np.flatnonzero(mask))
+        self.calls.append(
+            ("prefill", slots, tuple(int(start[s]) for s in slots))
+        )
+        return np.full(self.n_slots, 100, np.int32)
+
+    def decode(self, tokens, active):
+        self.n_decodes += 1
+        self.calls.append(
+            ("decode", tuple(int(s) for s in np.flatnonzero(active)))
+        )
         return np.full(self.n_slots, self.n_decodes, np.int32)
 
 
@@ -144,6 +178,146 @@ class TestSchedulerProperties:
             [np.percentile(xs, 25), np.percentile(xs, 95)],
         )
 
+    def test_queue_wait_reported_separately(self):
+        eng = ServingEngine(_StubBackend(n_slots=1))
+        for _ in range(3):
+            eng.submit([1, 2], max_new_tokens=2)
+        eng.drain()
+        s = eng.snapshot()
+        # one queue-wait sample per admission, its own series next to TTFT
+        assert len(eng.metrics.queue_wait_s) == s["admitted"] == 3
+        assert "p50" in s["queue_wait_ms"] and "p50" in s["ttft_ms"]
+        # queued-behind requests waited at least one engine step; the wait
+        # is the admit mark minus the submit mark, never negative
+        assert all(w >= 0.0 for w in eng.metrics.queue_wait_s)
+
+
+class TestSlotPoolOrder:
+    def test_lowest_slot_first_reuse(self):
+        """Reuse must be lowest-slot-first, not FIFO-of-frees: after
+        interleaved admits/frees the pool hands out the smallest free id."""
+        pool = SlotPool(4)
+        assert [pool.admit(r) for r in range(4)] == [0, 1, 2, 3]
+        pool.free(2)
+        pool.free(0)
+        pool.free(3)  # frees arrive in order 2, 0, 3 — reuse must not
+        assert pool.admit(10) == 0  # ...replay that order
+        assert pool.admit(11) == 2
+        pool.free(1)
+        assert pool.admit(12) == 1  # 1 freed later but lower than 3
+        assert pool.admit(13) == 3
+        assert pool.n_free == 0
+
+    def test_interleaved_admit_free_order(self):
+        pool = SlotPool(3)
+        a = pool.admit(0)
+        b = pool.admit(1)
+        assert (a, b) == (0, 1)
+        pool.free(a)
+        assert pool.admit(2) == 0  # lowest id again, not slot 2
+        pool.free(b)
+        pool.free(0)
+        assert pool.admit(3) == 0 and pool.admit(4) == 1
+
+
+class TestChunkedScheduling:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="prefill_chunk must be"):
+            ServingEngine(_ChunkStubBackend(), prefill_chunk=0)
+        with pytest.raises(ValueError, match="requires prefill_chunk"):
+            ServingEngine(_ChunkStubBackend(), step_tokens=8)
+        with pytest.raises(ValueError, match="must be >= prefill_chunk"):
+            ServingEngine(_ChunkStubBackend(), prefill_chunk=8,
+                          step_tokens=4)
+
+    def test_cursor_resumes_across_steps(self):
+        """A 10-token prompt under chunk 4 prefills at starts 0, 4, 8 and
+        only then emits its first token (PARTIAL_PREFILL → ACTIVE)."""
+        eng = ServingEngine(_ChunkStubBackend(n_slots=1), prefill_chunk=4)
+        r = eng.submit(list(range(10)), max_new_tokens=2)
+        eng.step()
+        assert r.state is RequestState.PARTIAL_PREFILL
+        assert r.prefill_pos == 4 and r.n_generated == 0
+        eng.step()
+        assert r.prefill_pos == 8 and r.n_generated == 0
+        eng.step()  # final (partial) chunk: emit + join decode same step
+        assert r.state is not RequestState.PARTIAL_PREFILL
+        assert r.prefill_pos == 10 and r.n_generated == 2
+        starts = [c[2] for c in eng.backend.calls if c[0] == "prefill"]
+        assert starts == [(0,), (4,), (8,)]
+        eng.drain()
+        assert eng.pool.leaked() == 0
+
+    def test_decode_stall_bound(self):
+        """THE property chunking buys: while one request prefills chunk by
+        chunk, every in-flight decode advances one token per step — no
+        active slot ever goes a step without a decode."""
+        eng = ServingEngine(_ChunkStubBackend(n_slots=2), prefill_chunk=2)
+        a = eng.submit([1], max_new_tokens=12)
+        eng.step()  # A: single-chunk prefill + first decode
+        assert a.n_generated == 2
+        b = eng.submit(list(range(10)), max_new_tokens=2)  # 5 chunks
+        n0 = a.n_generated
+        for i in range(1, 6):
+            eng.step()
+            assert a.n_generated == n0 + i, (
+                "decode stalled behind a prefill chunk"
+            )
+        assert b.n_generated >= 1  # B emitted at its final chunk
+        # call-log shape: a step never runs two prefill calls, and every
+        # prefill while A decoded is followed by A's decode in-step
+        kinds = [c[0] for c in eng.backend.calls]
+        for i in range(len(kinds) - 1):
+            assert not (kinds[i] == kinds[i + 1] == "prefill")
+        eng.drain()
+        assert eng.pool.leaked() == 0
+
+    def test_budget_gates_admission(self):
+        """step_tokens caps the step's committed spend (decode = 1, chunk
+        = C): admissions defer until budget frees up, FIFO order intact."""
+        eng = ServingEngine(_ChunkStubBackend(n_slots=4), prefill_chunk=4,
+                            step_tokens=8)
+        reqs = [eng.submit(list(range(8)), max_new_tokens=3)
+                for _ in range(3)]
+        eng.step()  # budget 8 admits floor(8/4) = 2; third stays queued
+        assert [r.state for r in reqs] == [
+            RequestState.PARTIAL_PREFILL, RequestState.PARTIAL_PREFILL,
+            RequestState.QUEUED,
+        ]
+        s = eng.snapshot()
+        assert s["active"] == 2 and s["queued"] == 1
+        assert (s["submitted"]
+                == s["completed"] + s["active"] + s["queued"]
+                + s["rejected"])
+        eng.step()  # both mid-prefill slots still charge 2C = 8: no admit
+        assert reqs[2].state is RequestState.QUEUED
+        # first two finished prefill this step (first token) AND took the
+        # step's decode pass immediately
+        assert reqs[0].n_generated == 2
+        eng.step()  # spend now 2 decodes = 2 → room for one chunk: admit
+        assert reqs[2].state is RequestState.PARTIAL_PREFILL
+        eng.drain()
+        assert all(r.state is RequestState.FINISHED for r in reqs)
+        assert eng.pool.leaked() == 0
+        seqs = [r.admit_seq for r in reqs]
+        assert seqs == sorted(seqs)
+
+    def test_chunked_eos_and_conservation(self):
+        """EOS at the first token retires straight out of prefill; metrics
+        stay conserved with PARTIAL_PREFILL requests counted as active."""
+        eng = ServingEngine(_ChunkStubBackend(n_slots=1), prefill_chunk=2,
+                            max_queue=4)
+        r = eng.submit([1, 2, 3], max_new_tokens=10, eos_id=100)
+        eng.step()
+        s = eng.snapshot()
+        assert r.state is RequestState.PARTIAL_PREFILL
+        assert (s["submitted"]
+                == s["completed"] + s["active"] + s["queued"]
+                + s["rejected"])
+        eng.drain()
+        assert r.finish_reason == "eos" and r.out_tokens == [100]
+        assert eng.pool.leaked() == 0
+
 
 MAX_SEQ = 32
 
@@ -230,28 +404,111 @@ class TestDenseOracle:
             assert r.out_tokens == self._oracle(params, cfg, r), r.rid
 
 
+class TestDenseChunkedOracle:
+    """Chunked prefill stays bit-exact: the same math split along the
+    sequence axis. (len, N) pairs repeat the whole-prompt tests' so oracle
+    programs are _GEN_CACHE hits; the shared module backend means each
+    chunk size costs exactly ONE new prefill compile ([n_slots, C])."""
+
+    def _drive(self, backend, rng, *, prefill_chunk, step_tokens=None):
+        eng = ServingEngine(backend, prefill_chunk=prefill_chunk,
+                            step_tokens=step_tokens)
+        reqs = [eng.submit(_prompt(rng, 5), max_new_tokens=6),
+                eng.submit(_prompt(rng, 3), max_new_tokens=4)]
+        eng.step()  # both mid-flight (prefilling or decoding)...
+        eng.step()
+        for n, m in ((8, 5), (2, 6), (6, 3), (7, 5)):  # ...arrivals join
+            reqs.append(eng.submit(_prompt(rng, n), max_new_tokens=m))
+        eng.drain()
+        assert eng.pool.leaked() == 0
+        return eng, reqs
+
+    @pytest.mark.parametrize(
+        "chunk,budget",
+        [(3, None),   # odd, divides no prompt length here
+         (4, 8),      # pow2 + a per-step token budget
+         (64, None)], # ≥ every prompt: whole prompt in one chunk
+    )
+    def test_staggered_chunked_exact(self, dense_setup, chunk, budget):
+        cfg, params, backend = dense_setup
+        eng, reqs = self._drive(
+            backend, np.random.default_rng(0),
+            prefill_chunk=chunk, step_tokens=budget,
+        )
+        oracle = TestDenseOracle()
+        for r in reqs:
+            assert r.n_generated == r.max_new_tokens
+            assert r.out_tokens == oracle._oracle(params, cfg, r), (
+                f"chunk={chunk} rid={r.rid}"
+            )
+        if chunk < 8:
+            # multi-chunk prompts really resumed: more chunk calls than
+            # requests, every one through the single [n_slots, C] program
+            assert eng.metrics.prefill_chunks > len(reqs)
+
+    def test_chunk_none_is_whole_prompt_path(self, dense_setup):
+        """prefill_chunk=None ≡ the PR 3 path: identical prompts through a
+        None engine and a chunked engine produce identical tokens (and the
+        None engine still buckets — no chunk calls)."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(7)
+        prompts = [_prompt(rng, n) for n, _ in
+                   ((5, 6), (3, 4), (8, 5), (2, 6), (6, 3), (7, 5))]
+        outs = {}
+        for chunk in (None, 3):
+            eng = ServingEngine(backend, prefill_chunk=chunk)
+            reqs = [eng.submit(p, max_new_tokens=m)
+                    for p, (_, m) in zip(prompts, ((5, 6), (3, 4), (8, 5),
+                                                   (2, 6), (6, 3), (7, 5)))]
+            eng.drain()
+            outs[chunk] = [r.out_tokens for r in reqs]
+            if chunk is None:
+                assert eng.metrics.prefill_chunks == 0
+        assert outs[None] == outs[3]
+
+
+@pytest.fixture(scope="module")
+def moe_setup(devices):
+    """ONE 2-shard server/backend + ONE world-1 oracle server for every MoE
+    serving test: MoE programs are shard_map compiles (the expensive kind),
+    so both the whole-prompt and chunked tests must share them. Oracle
+    (len, N) pairs repeat across tests for the same reason."""
+    from jax.sharding import Mesh
+
+    from uccl_tpu.models.moe_inference import (
+        MoEServeConfig, MoEServer, init_params,
+    )
+
+    cfg = MoEServeConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=8, moe_experts=8, moe_topk=2, moe_ffn=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    srv = MoEServer(cfg, Mesh(np.array(devices[:2]), ("dp",)))
+    backend = MoEBackend(
+        srv, srv.shard_params(params), batch_local=1, max_seq=MAX_SEQ,
+    )
+    srv1 = MoEServer(cfg, Mesh(np.array(devices[:1]), ("dp",)))
+    return backend, srv1, srv1.shard_params(params)
+
+
 class TestMoEOracle:
-    def test_staggered_mixed_lengths_exact(self, devices):
+    def _check(self, reqs, srv1, p1):
+        for r in reqs:
+            want = srv1.generate(
+                p1, jnp.asarray(r.prompt)[None, None], r.max_new_tokens,
+                MAX_SEQ, impl="ll",
+            )
+            assert r.out_tokens == np.asarray(want)[0, 0].tolist(), r.rid
+
+    def test_staggered_mixed_lengths_exact(self, moe_setup):
         """EP MoE stack on a 2-shard mesh (1 slot per shard): masked
         continuous batching bit-equals the world-1 one-shot oracle under
         staggered mixed-length arrivals. Lean on purpose — every distinct
         prompt shape costs a shard_map compile in the oracle, and tier-1
         wall time is budgeted: 3 lengths in one prefill bucket, one N."""
-        from jax.sharding import Mesh
-
-        from uccl_tpu.models.moe_inference import (
-            MoEServeConfig, MoEServer, init_params,
-        )
-
-        cfg = MoEServeConfig(
-            vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
-            head_dim=8, moe_experts=8, moe_topk=2, moe_ffn=64,
-        )
-        params = init_params(jax.random.PRNGKey(0), cfg)
-        srv = MoEServer(cfg, Mesh(np.array(devices[:2]), ("dp",)))
-        eng = ServingEngine(MoEBackend(
-            srv, srv.shard_params(params), batch_local=1, max_seq=MAX_SEQ,
-        ))
+        backend, srv1, p1 = moe_setup
+        eng = ServingEngine(backend)
         rng = np.random.default_rng(0)
         reqs = [eng.submit(_prompt(rng, 5), max_new_tokens=4),
                 eng.submit(_prompt(rng, 6), max_new_tokens=4)]
@@ -259,15 +516,25 @@ class TestMoEOracle:
         reqs.append(eng.submit(_prompt(rng, 8), max_new_tokens=4))
         eng.drain()
         assert eng.pool.leaked() == 0
+        self._check(reqs, srv1, p1)
 
-        srv1 = MoEServer(cfg, Mesh(np.array(devices[:1]), ("dp",)))
-        p1 = srv1.shard_params(params)
-        for r in reqs:
-            want = srv1.generate(
-                p1, jnp.asarray(r.prompt)[None, None], r.max_new_tokens,
-                MAX_SEQ, impl="ll",
-            )
-            assert r.out_tokens == np.asarray(want)[0, 0].tolist(), r.rid
+    def test_staggered_chunked_exact(self, moe_setup):
+        """Chunked prefill on the EP MoE stack: chunk 3 divides none of the
+        prompt lengths (5, 8) fully, so final partial chunks and the
+        write-gate beyond the prompt end are exercised on the sharded
+        cache. Same (len, N) pairs as above — oracle cache hits; the only
+        new compile is the [W, 1, 3] chunk program."""
+        backend, srv1, p1 = moe_setup
+        eng = ServingEngine(backend, prefill_chunk=3, step_tokens=8)
+        rng = np.random.default_rng(0)
+        reqs = [eng.submit(_prompt(rng, 5), max_new_tokens=4),
+                eng.submit(_prompt(rng, 6), max_new_tokens=4)]
+        eng.step()  # both mid-prefill...
+        reqs.append(eng.submit(_prompt(rng, 8), max_new_tokens=4))
+        eng.drain()
+        assert eng.pool.leaked() == 0
+        assert eng.metrics.prefill_chunks > len(reqs)  # really multi-chunk
+        self._check(reqs, srv1, p1)
 
     def test_droppable_capacity_rejected(self, devices):
         """Slot serving's exactness needs a drop-free wire: a config whose

@@ -235,27 +235,45 @@ def _forward_slots(
 
 def prefill_slots(
     params, tokens, prompt_lens, new_mask, cache: SlotKVCache,
-    cfg: DenseConfig,
+    cfg: DenseConfig, start=None,
 ) -> Tuple[jax.Array, SlotKVCache]:
-    """Masked batched prefill of newly admitted slots.
+    """Masked batched prefill of newly admitted slots — resumable.
 
-    tokens: [B_slots, S] prompts right-padded to the bucket length S (rows of
-    slots NOT in ``new_mask`` are ignored); prompt_lens: [B_slots] int32;
-    new_mask: [B_slots] bool. Admitted slots prefill from position 0 —
-    their previous occupant's rows beyond the new prompt are dead (never
-    readable: attention stops at the slot's length, and decode overwrites
-    position L before any read of L). Returns (first greedy token [B_slots],
-    cache with lengths set to prompt_lens on admitted slots).
+    tokens: [B_slots, S] prompt windows right-padded to S (rows of slots NOT
+    in ``new_mask`` are ignored); prompt_lens: [B_slots] int32 FULL prompt
+    lengths; new_mask: [B_slots] bool; start: [B_slots] int32 per-slot
+    offsets (None = all zeros, the whole-prompt path). Row b carries prompt
+    positions [start_b, start_b+S): KV is written only there, attention
+    covers [0, start_b+S) causally — chunked prefill is the same math split
+    along the sequence axis, so resuming in fixed-size chunks is bit-exact
+    with the one-shot prefill. Admitted slots starting at 0 overwrite their
+    previous occupant from position 0 — rows beyond the new prompt are dead
+    (never readable: attention stops at the slot's length, and decode
+    overwrites position L before any read of L). Garbage beyond a
+    non-dividing final chunk's prompt end is dead the same way.
+
+    Returns (greedy token [B_slots] — meaningful only for rows whose window
+    reaches the prompt end, i.e. start + S >= prompt_lens; callers ignore
+    the rest — and cache with lengths set to min(start+S, prompt_lens) on
+    admitted slots).
     """
-    zeros = jnp.zeros_like(prompt_lens)
+    if start is None:
+        start = jnp.zeros_like(prompt_lens)
     logits, cache = _forward_slots(
-        params, tokens, cache, zeros, new_mask, cfg
+        params, tokens, cache, start, new_mask, cfg
     )
+    # each slot's last valid prompt position WITHIN this window; clipped so
+    # mid-prefill rows (prompt end beyond the window) gather in-bounds —
+    # their token is garbage by contract and ignored by the engine
+    s = tokens.shape[1]
+    last_idx = jnp.clip(prompt_lens - 1 - start, 0, s - 1)
     last = jnp.take_along_axis(
-        logits, (prompt_lens - 1)[:, None, None], axis=1
-    )[:, 0]  # [B, V] — each slot's last valid prompt position
+        logits, last_idx[:, None, None], axis=1
+    )[:, 0]  # [B, V]
     tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
-    lengths = jnp.where(new_mask, prompt_lens, cache.lengths)
+    lengths = jnp.where(
+        new_mask, jnp.minimum(start + s, prompt_lens), cache.lengths
+    )
     return tok, SlotKVCache(cache.k, cache.v, lengths)
 
 

@@ -350,32 +350,46 @@ class MoEServer:
         return MoESlotCache.empty(self.cfg, self.world, batch_local, max_seq)
 
     def prefill_slots(self, params, tokens, prompt_lens, new_mask,
-                      cache: MoESlotCache):
-        """Masked batched prefill of newly admitted slots (sorted EP path).
+                      cache: MoESlotCache, start=None):
+        """Masked batched prefill of newly admitted slots (sorted EP path)
+        — resumable, mirroring :func:`inference.prefill_slots`.
 
-        tokens: [W, B_loc, S] right-padded prompts; prompt_lens/new_mask:
-        [W, B_loc]. Slots outside ``new_mask`` keep their KV rows and
-        lengths — mid-decode neighbors are untouched. Returns (first greedy
-        token [W, B_loc], cache')."""
+        tokens: [W, B_loc, S] right-padded prompt windows; prompt_lens (FULL
+        prompt lengths)/new_mask: [W, B_loc]; start: [W, B_loc] int32
+        per-slot offsets (None = zeros, the whole-prompt path). Row (w, b)
+        carries prompt positions [start, start+S): KV is written only there,
+        attention covers [0, start+S) — chunked prefill splits the same math
+        along the sequence axis (the drop-free EP wire keeps expert rows
+        independent), so resuming in chunks stays bit-exact. Slots outside
+        ``new_mask`` keep their KV rows and lengths — mid-decode neighbors
+        are untouched. Returns (greedy token [W, B_loc] — meaningful only
+        for rows whose window reaches the prompt end — and cache with
+        lengths set to min(start+S, prompt_lens) on admitted slots)."""
         self._check_drop_free()
         cfg = self.cfg
+        s = tokens.shape[-1]
+        if start is None:
+            start = jnp.zeros_like(prompt_lens)
 
-        def f(p, tok, lens, mask, kc, vc, ln):
+        def f(p, tok, lens, mask, off, kc, vc, ln):
             logits, nk, nv = _forward_shard_slots(
                 _strip_shard(p), tok[0], kc[0], vc[0], ln[0],
-                jnp.zeros_like(ln[0]), mask[0], cfg, "sort",
+                off[0], mask[0], cfg, "sort",
             )
+            last_idx = jnp.clip(lens[0] - 1 - off[0], 0, s - 1)
             last = jnp.take_along_axis(
-                logits, (lens[0] - 1)[:, None, None], axis=1
+                logits, last_idx[:, None, None], axis=1
             )[:, 0]
             t = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            nlen = jnp.where(mask[0], lens[0], ln[0])
+            nlen = jnp.where(
+                mask[0], jnp.minimum(off[0] + s, lens[0]), ln[0]
+            )
             return t[None], nk[None], nv[None], nlen[None]
 
         key = ("prefill_slots", tokens.shape, cache.k.shape)
-        fn = self._fn(key, lambda: self._shard_mapped(f, 6, 4))
+        fn = self._fn(key, lambda: self._shard_mapped(f, 7, 4))
         tok, nk, nv, nlen = fn(params, tokens, prompt_lens, new_mask,
-                               cache.k, cache.v, cache.lengths)
+                               start, cache.k, cache.v, cache.lengths)
         return tok, MoESlotCache(nk, nv, nlen)
 
     def decode_step_slots(self, params, token, active, cache: MoESlotCache,

@@ -184,6 +184,15 @@ def _serve_continuous(args, saved_cfg):
                  and saved_cfg.get("model") == "dense" else "moe")
     if args.slots < 1:
         raise SystemExit(f"--slots must be >= 1, got {args.slots}")
+    if args.step_tokens and not args.prefill_chunk:
+        raise SystemExit("--step-tokens needs --prefill-chunk (the "
+                         "whole-prompt path has no sub-step unit to budget)")
+    if args.prefill_chunk and args.step_tokens \
+            and args.step_tokens < args.prefill_chunk:
+        raise SystemExit(
+            f"--step-tokens {args.step_tokens} must be >= --prefill-chunk "
+            f"{args.prefill_chunk}, or no request could ever be admitted"
+        )
     max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
     if args.prompt_len + args.new_tokens > max_seq:
         raise SystemExit("--prompt-len + --new-tokens exceed --max-seq")
@@ -281,7 +290,9 @@ def _serve_continuous(args, saved_cfg):
             return np.asarray(toks)[0, 0, : req.n_generated]
 
     engine = ServingEngine(
-        backend, max_queue=args.max_queue or None, register_stats=True
+        backend, max_queue=args.max_queue or None, register_stats=True,
+        prefill_chunk=args.prefill_chunk or None,
+        step_tokens=args.step_tokens or None,
     )
 
     # synthetic workload (mixed prompt lengths, Poisson arrivals), compile
@@ -300,6 +311,8 @@ def _serve_continuous(args, saved_cfg):
         "mode": "serve-continuous", "stack": stack, "ckpt_step": step,
         "world": world, "slots": args.slots, "requests": args.requests,
         "arrival_rate": args.arrival_rate, "new_tokens": args.new_tokens,
+        "prefill_chunk": args.prefill_chunk or None,
+        "step_tokens": args.step_tokens or None,
         "wall_s": round(wall, 3), **snap,
     }
     if reqs:
@@ -371,6 +384,17 @@ def main(argv=None):
                     choices=["auto", "dense", "moe"],
                     help="server: model stack ('auto': dense for dense "
                          "checkpoints, else MoE)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="server: chunked prefill — admitted prompts "
+                         "prefill C tokens per engine step so in-flight "
+                         "decodes never stall behind more than one chunk "
+                         "(one compiled prefill program instead of pow2 "
+                         "buckets). 0 = whole-prompt prefill")
+    ap.add_argument("--step-tokens", type=int, default=0,
+                    help="server: per-step token budget (decode token = 1, "
+                         "prefill chunk = C); admission defers while the "
+                         "step's committed spend would exceed it. Needs "
+                         "--prefill-chunk. 0 = unbudgeted")
     ap.add_argument("--check-oracle", action="store_true",
                     help="server: verify every completed request is "
                          "bit-identical to the one-shot generate oracle "

@@ -7,10 +7,23 @@ mid-decode neighbors untouched), (2) runs ONE masked batched decode step
 over every active slot, (3) retires sequences on EOS or token budget and
 frees their slots for the next admission. ``drain()`` steps until idle.
 
+**Chunked prefill** (``prefill_chunk=C``) bounds decode stalls: instead of
+prefilling a whole bucketed prompt before the step's decode pass — one long
+arriving prompt then stalls every in-flight decode for the full prefill —
+each admitted request advances a prefill cursor by ONE fixed-size chunk of
+C tokens per step, and the step still runs its single decode pass. A decode
+therefore never waits behind more than one chunk (the stall bound, tested),
+and the prefill program compiles ONCE at [n_slots, C] instead of once per
+pow2 bucket. ``step_tokens`` adds a per-step token budget (decode token =
+1, prefill chunk = C): admission is deferred while the step's committed
+spend would exceed it. ``prefill_chunk=None`` (default) is the PR 3
+whole-prompt path, unchanged.
+
 The engine is exact, not approximate: each request's emitted tokens are
 bit-identical to the one-shot ``generate`` oracle for the same prompt
-(greedy decode over the same per-row math — tests/test_serving.py proves it
-for both stacks). Model programs are jitted once per shape via the same
+(greedy decode over the same per-row math — chunked prefill is that math
+split along the sequence axis; tests/test_serving.py proves both modes on
+both stacks). Model programs are jitted once per shape via the same
 LRU-bounded ``_fns`` pattern the one-shot servers use.
 """
 
@@ -59,9 +72,10 @@ class DenseBackend:
         def build():
             from uccl_tpu.models.inference import SlotKVCache, prefill_slots
 
-            def run(p, tok, lens, mask, kc, vc, ln):
+            def run(p, tok, lens, mask, off, kc, vc, ln):
                 t, cache = prefill_slots(
-                    p, tok, lens, mask, SlotKVCache(kc, vc, ln), cfg
+                    p, tok, lens, mask, SlotKVCache(kc, vc, ln), cfg,
+                    start=off,
                 )
                 return t, cache.k, cache.v, cache.lengths
 
@@ -89,11 +103,14 @@ class DenseBackend:
         return self._fns.get(("decode",), build)
 
     def prefill(self, tokens: np.ndarray, lens: np.ndarray,
-                mask: np.ndarray) -> np.ndarray:
+                mask: np.ndarray,
+                start: Optional[np.ndarray] = None) -> np.ndarray:
         from uccl_tpu.models.inference import SlotKVCache
 
+        if start is None:
+            start = np.zeros(tokens.shape[0], np.int32)
         fn = self._prefill_fn(tokens.shape[1])
-        t, k, v, ln = fn(self.params, tokens, lens, mask,
+        t, k, v, ln = fn(self.params, tokens, lens, mask, start,
                          self.cache.k, self.cache.v, self.cache.lengths)
         self.cache = SlotKVCache(k, v, ln)
         return np.asarray(t)
@@ -134,10 +151,14 @@ class MoEBackend:
         )
 
     def prefill(self, tokens: np.ndarray, lens: np.ndarray,
-                mask: np.ndarray) -> np.ndarray:
+                mask: np.ndarray,
+                start: Optional[np.ndarray] = None) -> np.ndarray:
+        if start is None:
+            start = np.zeros(tokens.shape[0], np.int32)
         t, self.cache = self.server.prefill_slots(
             self.params, self._grid(tokens, np.int32),
             self._grid(lens, np.int32), self._grid(mask, bool), self.cache,
+            start=self._grid(start, np.int32),
         )
         return np.asarray(t).reshape(self.n_slots)
 
@@ -150,17 +171,48 @@ class MoEBackend:
 
 
 class ServingEngine:
-    """submit()/step()/drain() over a backend (Dense or MoE)."""
+    """submit()/step()/drain() over a backend (Dense or MoE).
+
+    ``prefill_chunk=C`` enables chunked prefill: admitted requests advance
+    their prefill cursor by one C-token chunk per step (one compiled
+    prefill program at [n_slots, C]) and in-flight decodes run every step —
+    no decode ever waits behind more than one chunk. ``step_tokens`` caps a
+    step's committed token spend (decode token = 1, prefill chunk = C) by
+    deferring admission; it requires ``prefill_chunk`` (the whole-prompt
+    path has no sub-step unit to budget with). Decodes are never
+    budget-gated — they are the latency the budget protects.
+    """
 
     _stats_seq = 0  # distinct registry source name per registered engine
 
     def __init__(self, backend, *, max_queue: Optional[int] = None,
-                 register_stats: bool = False):
+                 register_stats: bool = False,
+                 prefill_chunk: Optional[int] = None,
+                 step_tokens: Optional[int] = None):
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        if step_tokens is not None:
+            if prefill_chunk is None:
+                raise ValueError(
+                    "step_tokens requires prefill_chunk: the whole-prompt "
+                    "path has no sub-step unit to budget with"
+                )
+            if step_tokens < prefill_chunk:
+                raise ValueError(
+                    f"step_tokens ({step_tokens}) must be >= prefill_chunk "
+                    f"({prefill_chunk}), or no request could ever be "
+                    "admitted"
+                )
         self.backend = backend
+        self.prefill_chunk = prefill_chunk
+        self.step_tokens = step_tokens
         self.pool = SlotPool(backend.n_slots)
         self.sched = FIFOScheduler(max_queue=max_queue)
         self.metrics = ServingMetrics()
-        self._by_slot = {}  # slot -> Request
+        self._by_slot = {}  # slot -> Request (every occupied slot)
+        self._prefilling = {}  # slot -> Request mid-prefill (chunked mode)
         self._last_tok = np.zeros(backend.n_slots, np.int32)
         self._next_rid = 0
         self._stats_name: Optional[str] = None
@@ -206,15 +258,46 @@ class ServingEngine:
         return bool(self.sched.qsize or self._by_slot)
 
     def step(self) -> List[Request]:
-        """One iteration: admit+prefill, one masked decode, retire.
-        Returns requests finished during this step."""
+        """One iteration: admit + prefill work, one masked decode, retire.
+        Whole-prompt mode prefills admitted prompts in full; chunked mode
+        advances every mid-prefill request by one chunk (budget-gated
+        admission). Returns requests finished during this step."""
+        t0 = now()
         finished: List[Request] = []
-        newly = self.sched.admit(self.pool)
-        if newly:
-            self._prefill(newly, finished)
-        if self._by_slot:
-            self._decode(finished)
+        if self.prefill_chunk is None:
+            newly = self.sched.admit(self.pool)
+            if newly:
+                self._prefill(newly, finished)
+            if self._by_slot:
+                self._decode(finished)
+        else:
+            self._step_chunked(finished)
+        self.metrics.on_step(now() - t0)
         return finished
+
+    def _step_chunked(self, finished) -> None:
+        """Chunked-mode iteration: budget-gated admission, one batched
+        chunk over every mid-prefill slot, then the step's single decode
+        pass (requests whose cursor just reached the prompt end join it
+        immediately — same step, like the whole-prompt path)."""
+        c = self.prefill_chunk
+        limit = None
+        if self.step_tokens is not None:
+            # committed spend this step: 1 per decoding slot, C per
+            # mid-prefill slot; admit only what fits in the remainder
+            spend = (len(self._by_slot) - len(self._prefilling)
+                     + len(self._prefilling) * c)
+            limit = max(0, (self.step_tokens - spend) // c)
+        for slot, req in self.sched.admit(self.pool, limit=limit):
+            req.state = RequestState.PARTIAL_PREFILL
+            req.prefill_pos = 0
+            self._by_slot[slot] = req
+            self._prefilling[slot] = req
+            self.metrics.on_admit(req)
+        if self._prefilling:
+            self._prefill_chunk_step(finished)
+        if len(self._by_slot) > len(self._prefilling):
+            self._decode(finished)
 
     def drain(self, max_steps: int = 100000) -> List[Request]:
         """Step until queue and slots are empty; returns all finished."""
@@ -268,24 +351,63 @@ class ServingEngine:
         t_done = now()
         for slot, req in newly:
             self._by_slot[slot] = req
-            self._last_tok[slot] = tok[slot]
-            req.out_tokens.append(int(tok[slot]))
-            req.t_first_token = t_done
-            self.metrics.on_first_token(req)
-            self._maybe_retire(slot, req, t_done, finished)
+            self._emit_first_token(slot, req, tok[slot], t_done, finished)
+
+    def _prefill_chunk_step(self, finished) -> None:
+        """Advance every mid-prefill slot by one C-token chunk (ONE batched
+        call, one compiled program at [n_slots, C]). Rows whose cursor
+        reaches the prompt end emit their first token and leave
+        PARTIAL_PREFILL; other rows' returned tokens are garbage by the
+        model contract and ignored here."""
+        c = self.prefill_chunk
+        n = self.backend.n_slots
+        tokens = np.zeros((n, c), np.int32)
+        lens = np.ones(n, np.int32)  # 1 (not 0): the gather index
+        start = np.zeros(n, np.int32)  # clip stays in bounds on idle rows
+        mask = np.zeros(n, bool)
+        for slot, req in self._prefilling.items():
+            chunk = req.prompt[req.prefill_pos:req.prefill_pos + c]
+            tokens[slot, :chunk.size] = chunk
+            lens[slot] = req.prompt.size
+            start[slot] = req.prefill_pos
+            mask[slot] = True
+        t0 = now()
+        tok = self.backend.prefill(tokens, lens, mask, start=start)
+        self.metrics.on_prefill(now() - t0, len(self._prefilling),
+                                chunked=True)
+        t_done = now()
+        for slot, req in list(self._prefilling.items()):
+            req.prefill_pos = min(req.prefill_pos + c, req.prompt.size)
+            if req.prefill_pos < req.prompt.size:
+                continue  # more chunks to go — next step
+            del self._prefilling[slot]
+            req.state = RequestState.ACTIVE
+            self._emit_first_token(slot, req, tok[slot], t_done, finished)
 
     def _decode(self, finished) -> None:
+        decoding = {s: r for s, r in self._by_slot.items()
+                    if s not in self._prefilling}
         active = np.zeros(self.backend.n_slots, bool)
-        for slot in self._by_slot:
+        for slot in decoding:
             active[slot] = True
         t0 = now()
         tok = self.backend.decode(self._last_tok.copy(), active)
-        self.metrics.on_decode_step(now() - t0, len(self._by_slot))
+        self.metrics.on_decode_step(now() - t0, len(decoding))
         t_done = now()
-        for slot, req in list(self._by_slot.items()):
+        for slot, req in list(decoding.items()):
             self._last_tok[slot] = tok[slot]
             req.out_tokens.append(int(tok[slot]))
             self._maybe_retire(slot, req, t_done, finished)
+
+    def _emit_first_token(self, slot: int, req: Request, tok_val, t: float,
+                          finished) -> None:
+        """Record a request's first generated token (prefill completion in
+        either mode): seed the decode input, stamp TTFT, maybe retire."""
+        self._last_tok[slot] = tok_val
+        req.out_tokens.append(int(tok_val))
+        req.t_first_token = t
+        self.metrics.on_first_token(req)
+        self._maybe_retire(slot, req, t, finished)
 
     def _maybe_retire(self, slot: int, req: Request, t: float,
                       finished) -> None:

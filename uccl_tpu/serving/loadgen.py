@@ -34,13 +34,24 @@ def synth_workload(rng: np.random.Generator, n: int, prompt_len: int,
 
 def warm_engine(engine: ServingEngine, lens, max_seq: int,
                 new_tokens: int) -> None:
-    """Compile every prefill bucket the sampled lengths can hit plus the
+    """Compile every prefill program the sampled lengths can hit plus the
     decode program, then zero the metrics: compiles are a one-time cost a
     long-lived server never pays again, and folding them into TTFT
-    percentiles would report compile time, not serving time. One
-    representative length per bucket compiles that bucket's program;
-    min 2 tokens — a 1-token warmup retires at prefill and would leave
-    the decode program cold."""
+    percentiles would report compile time, not serving time.
+
+    Whole-prompt mode compiles one program per pow2 bucket (one
+    representative length each). Chunked mode has exactly ONE prefill
+    program — [n_slots, C] regardless of prompt length — so a single
+    longest-length request covers it (and exercises the multi-chunk
+    resume path while it's at it). Min 2 tokens either way — a 1-token
+    warmup retires at prefill and would leave the decode program cold."""
+    if engine.prefill_chunk is not None:
+        longest = max((int(l) for l in lens), default=1)
+        engine.submit(np.zeros(max(1, longest), np.int32),
+                      max_new_tokens=min(2, new_tokens))
+        engine.drain()
+        engine.reset_metrics()
+        return
     by_bucket = {}
     for l in lens:
         by_bucket[_bucket(int(l), max_seq)] = int(l)

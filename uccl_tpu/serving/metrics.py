@@ -4,9 +4,15 @@ Definitions (shared with serve.py's one-shot percentiles and
 benchmarks/serving_bench.py — docs/SERVING.md spells them out):
 
 * **TTFT** — submit → first generated token, queue wait included.
+* **queue wait** — submit → admission into a KV slot: the scheduling delay
+  alone, reported as its own series so scheduling and compute delays are
+  separable (TTFT − queue wait ≈ prefill/compute time).
 * **TPOT** — per-request mean seconds per output token AFTER the first
   (decode steady state): (t_finish - t_first) / (n_out - 1).
 * **decode step latency** — wall time of one masked batched decode call.
+* **engine step latency** — wall time of one full ``step()`` (admission +
+  prefill work + decode); its MAX is the decode-stall bound chunked
+  prefill exists to shrink (docs/SERVING.md).
 * **goodput** — completed requests' output tokens per second of serving
   wall time (first submit → last finish). Tokens of in-flight or rejected
   requests never count: goodput is *useful delivered* throughput.
@@ -59,12 +65,15 @@ class ServingMetrics:
         self.completed = 0
         self.output_tokens = 0  # completed requests only (goodput numerator)
         self.prefill_calls = 0
+        self.prefill_chunks = 0  # chunked-prefill calls (subset of prefill_calls)
         self.decode_calls = 0
         self.ttft_s: List[float] = []
+        self.queue_wait_s: List[float] = []
         self.tpot_s: List[float] = []
         self.latency_s: List[float] = []
         self.prefill_s: List[float] = []
         self.decode_step_s: List[float] = []
+        self.step_s: List[float] = []
         self.t_first_submit: Optional[float] = None
         self.t_last_finish: Optional[float] = None
 
@@ -79,6 +88,8 @@ class ServingMetrics:
 
     def on_admit(self, req: Request) -> None:
         self.admitted += 1
+        if req.queue_wait is not None:
+            self.queue_wait_s.append(req.queue_wait)
 
     def on_first_token(self, req: Request) -> None:
         if req.ttft is not None:
@@ -93,13 +104,19 @@ class ServingMetrics:
         if req.latency is not None:
             self.latency_s.append(req.latency)
 
-    def on_prefill(self, dt: float, n_new: int) -> None:
+    def on_prefill(self, dt: float, n_new: int, *,
+                   chunked: bool = False) -> None:
         self.prefill_calls += 1
+        if chunked:
+            self.prefill_chunks += 1
         self.prefill_s.append(dt)
 
     def on_decode_step(self, dt: float, n_active: int) -> None:
         self.decode_calls += 1
         self.decode_step_s.append(dt)
+
+    def on_step(self, dt: float) -> None:
+        self.step_s.append(dt)
 
     # -- derived ------------------------------------------------------------
     def goodput(self) -> Optional[float]:
@@ -126,13 +143,18 @@ class ServingMetrics:
             "occupancy": round(occupancy, 4),
             "output_tokens": self.output_tokens,
             "prefill_calls": self.prefill_calls,
+            "prefill_chunks": self.prefill_chunks,
             "decode_calls": self.decode_calls,
             "ttft_ms": percentiles_ms(self.ttft_s),
+            "queue_wait_ms": percentiles_ms(self.queue_wait_s),
             "tpot_ms": percentiles_ms(self.tpot_s),
             "latency_ms": percentiles_ms(self.latency_s),
             "prefill_ms": percentiles_ms(self.prefill_s),
             "decode_step_ms": percentiles_ms(self.decode_step_s),
+            "step_ms": percentiles_ms(self.step_s),
         }
+        if self.step_s:
+            snap["max_step_ms"] = round(max(self.step_s) * 1e3, 3)
         gp = self.goodput()
         if gp is not None:
             snap["goodput_tok_s"] = round(gp, 1)

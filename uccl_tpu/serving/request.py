@@ -2,10 +2,14 @@
 
 A request is one sequence: a prompt, a token budget, and an optional EOS id.
 It moves QUEUED → ACTIVE (admitted to a KV slot) → FINISHED (EOS or budget),
-or is REJECTED at submit when the queue is full (backpressure). Timing marks
-are taken at every transition so the serving metrics (TTFT, TPOT, latency —
-docs/SERVING.md) fall out of the lifecycle instead of being instrumented
-around it.
+or is REJECTED at submit when the queue is full (backpressure). Under
+chunked prefill (``ServingEngine(prefill_chunk=C)``) admission enters
+PARTIAL_PREFILL first: the request occupies its slot while its prefill
+cursor (``prefill_pos``) advances one fixed-size chunk per engine step, and
+it becomes ACTIVE when the cursor reaches the prompt end and the first
+token is emitted. Timing marks are taken at every transition so the serving
+metrics (TTFT, TPOT, queue wait, latency — docs/SERVING.md) fall out of the
+lifecycle instead of being instrumented around it.
 """
 
 from __future__ import annotations
@@ -27,6 +31,7 @@ def now() -> float:
 
 class RequestState(enum.Enum):
     QUEUED = "queued"
+    PARTIAL_PREFILL = "partial_prefill"  # in a slot, prefill cursor mid-prompt
     ACTIVE = "active"
     FINISHED = "finished"
     REJECTED = "rejected"
@@ -48,6 +53,7 @@ class Request:
     state: RequestState = RequestState.QUEUED
     slot: Optional[int] = None
     admit_seq: Optional[int] = None  # admission order (FIFO is testable)
+    prefill_pos: int = 0  # chunked-prefill cursor: prompt[:prefill_pos] is in KV
     out_tokens: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None  # "eos" | "length"
     t_submit: float = 0.0
@@ -58,6 +64,14 @@ class Request:
     @property
     def n_generated(self) -> int:
         return len(self.out_tokens)
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        """Submit → admission into a KV slot: the scheduling delay alone
+        (TTFT minus this is pure compute/prefill time)."""
+        if self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
 
     @property
     def ttft(self) -> Optional[float]:

@@ -38,12 +38,18 @@ class FIFOScheduler:
         self._queue.append(req)
         return True
 
-    def admit(self, pool: SlotPool) -> List[Tuple[int, Request]]:
+    def admit(self, pool: SlotPool,
+              limit: Optional[int] = None) -> List[Tuple[int, Request]]:
         """Move queue-head requests into free slots, in FIFO order, until
-        either runs out. Returns the newly admitted (slot, request) pairs —
-        the engine prefills exactly these."""
+        either runs out. ``limit`` caps this call's admissions (the engine's
+        per-step token budget: each admission under chunked prefill commits
+        one chunk of prefill work per step until its prompt is in KV, so
+        admission is where the budget is enforced — None = unbounded).
+        Returns the newly admitted (slot, request) pairs — the engine
+        prefills exactly these."""
         admitted: List[Tuple[int, Request]] = []
-        while self._queue and pool.n_free:
+        while (self._queue and pool.n_free
+               and (limit is None or len(admitted) < limit)):
             req = self._queue.popleft()
             slot = pool.admit(req.rid)
             assert slot is not None  # n_free was checked

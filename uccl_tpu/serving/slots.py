@@ -10,7 +10,7 @@ before any read of it — see models/inference.py `prefill_slots`).
 
 from __future__ import annotations
 
-from collections import deque
+import heapq
 from typing import Dict, List, Optional
 
 
@@ -21,7 +21,11 @@ class SlotPool:
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         self.n_slots = n_slots
-        self._free = deque(range(n_slots))  # lowest-slot-first reuse
+        # min-heap: admissions always take the LOWEST free slot id, so the
+        # pool packs low rows under partial load (a deque here would hand
+        # out slots in FIFO-of-frees order, not lowest-first — tested)
+        self._free = list(range(n_slots))
+        heapq.heapify(self._free)
         self._occupant: Dict[int, int] = {}  # slot -> rid
         self.total_admits = 0
         self.total_frees = 0
@@ -49,7 +53,7 @@ class SlotPool:
         """Claim a free slot for ``rid``; None when the pool is full."""
         if not self._free:
             return None
-        slot = self._free.popleft()
+        slot = heapq.heappop(self._free)
         self._occupant[slot] = rid
         self.total_admits += 1
         self.high_water = max(self.high_water, self.n_active)
@@ -59,7 +63,7 @@ class SlotPool:
         if slot not in self._occupant:
             raise ValueError(f"slot {slot} is not occupied")
         del self._occupant[slot]
-        self._free.append(slot)
+        heapq.heappush(self._free, slot)
         self.total_frees += 1
 
     def leaked(self) -> int:

@@ -229,33 +229,41 @@ def bench_chunk_sweep(jax, *, tokens, hidden, ffn, experts, topk, iters,
     recv = put(rng.standard_normal(
         (n, e_local, n * cap, hidden)).astype(np.float32))
 
+    # per-arm wire labels come off the REAL fallback counter
+    # (obs ep_wire_fallback_total, incremented at trace time by the gates
+    # themselves — uccl_tpu/collective/dma.py record_fallback) instead of
+    # the old hand-mirrored budget arithmetic: snapshot before each arm's
+    # compile, diff after. An "ep_all_to_all:*" event is the terminal
+    # lax fallback (the unchunked kernel did not carry the exchange);
+    # "ep_moe_chunked:*"/"ep_all_to_all_chunked:*" events mean only the
+    # chunk pipeline degraded to the unchunked pallas wire.
+    def _fb_snapshot():
+        return {tuple(sorted(lb.items())): v
+                for lb, v in dma.WIRE_FALLBACK.samples()}
+
+    def _fb_delta(before):
+        out = {}
+        for k, v in _fb_snapshot().items():
+            d = int(v - before.get(k, 0))
+            if d > 0:
+                lb = dict(k)
+                out[f"{lb['what']}:{lb['reason']}"] = d
+        return out
+
     t_wire = _time_fn(wire_fn, (x, logits), iters)
     t_gemm = _time_fn(gemm_fn, (recv, wg, wu, wd), iters)
+    fb0 = _fb_snapshot()
     t1 = _time_fn(layer_fn(1), (x, logits, wg, wu, wd), iters)
-
-    # the fp8 wire quantizes values to int8 before the exchange, so the
-    # budget gates run on 1-byte elements there (the f32 scale side-channel
-    # is h/128 the size and never the binding gate) — shared rule
-    wire_bytes = ep_ops.wire_itemsize(fp8, hidden, np.float32)
-    interp = dma.resolve_interpret(None)
-
-    def fits(elems_per_peer, resident_kernels):
-        # ask the REAL gates (quiet: no fallback log) what they decide, so
-        # the pallas_wire_active labels can never drift from the fallback
-        # chain (chunked -> unchunked pallas -> lax) the arms actually took
-        if resident_kernels == 1:
-            pair = 2 * n * dma.padded_chunk_elems(elems_per_peer) * wire_bytes
-            return dma.check_budget(pair, "bench_label", interp, quiet=True)
-        return dma.chunk_budget(n, elems_per_peer, wire_bytes, "bench_label",
-                                interp, resident_kernels=resident_kernels,
-                                quiet=True)
+    fb1 = _fb_delta(fb0)
 
     arms = []
     for nc in chunks:
-        t_n = t1 if nc == 1 else _time_fn(
-            layer_fn(nc), (x, logits, wg, wu, wd), iters
-        )
-        cs = dma.pad_capacity(cap, nc) // nc
+        if nc == 1:
+            t_n, fb = t1, fb1
+        else:
+            before = _fb_snapshot()
+            t_n = _time_fn(layer_fn(nc), (x, logits, wg, wu, wd), iters)
+            fb = _fb_delta(before)
         arms.append({
             "chunks": nc,
             "layer_us": round(t_n * 1e6, 1),
@@ -263,14 +271,15 @@ def bench_chunk_sweep(jax, *, tokens, hidden, ffn, experts, topk, iters,
             "overlap_efficiency": round(
                 (t_wire + t_gemm - t_n) / max(t_wire, 1e-12), 3
             ),
-            # phased arm: 1 resident pair; chunked layer: 4 (two airborne
-            # kernels in each of the dispatch and combine families — the
-            # same charge ep_ops.resolve_chunks gates with)
-            "pallas_wire_active": fits(e_local * cs * hidden,
-                                       1 if nc == 1 else 4),
+            "pallas_wire_active": not any(
+                k.startswith("ep_all_to_all:") for k in fb
+            ),
+            "wire_fallbacks": fb,
         })
+    from uccl_tpu import obs
+
     line = {
-        "bench": "ep_chunk_sweep",
+        "bench": "ep_chunk_sweep", "schema_version": obs.SCHEMA_VERSION,
         "tokens": tokens, "hidden": hidden, "ffn": ffn,
         "experts": experts, "topk": topk, "fp8": fp8, "capacity": cap,
         "wire_us": round(t_wire * 1e6, 1),
@@ -330,7 +339,15 @@ def main():
                          "--wire pallas a comma list (e.g. '2,4') runs the "
                          "chunk-pipelined MoE layer sweep and reports the "
                          "overlap-efficiency metric (docs/EP_BENCH.md)")
+    from uccl_tpu import obs
+
+    obs.add_cli_args(ap)
     args = ap.parse_args()
+    # every CLI dumps the obs surfaces the same way (--trace-out /
+    # --metrics-out, docs/OBSERVABILITY.md); the exit-time net covers
+    # every return path of the mode dispatch below, crashes included
+    obs.setup_from_args(args)
+    obs.dump_at_exit(args)
     try:
         chunk_list = [int(c) for c in str(args.chunks).split(",") if c != ""]
     except ValueError:

@@ -153,8 +153,11 @@ def run(grad_mb=128, chunks=8, gemm_d=1024, gemm_chain=8, gemm_reps=4):
         r0["comm_ms"] + r0["compute_ms"]
     )
     bytes_frac = r0.get("moved_during_compute", 0) / (grad_mb * (1 << 20))
+    from uccl_tpu import obs
+
     line = {
         "grad_mb": grad_mb,
+        "schema_version": obs.SCHEMA_VERSION,
         "chunks": chunks,
         "serial_ms": round(r0["serial"] * 1e3, 1),
         "overlap_ms": round(r0["overlap"] * 1e3, 1),
@@ -184,5 +187,16 @@ def run(grad_mb=128, chunks=8, gemm_d=1024, gemm_chain=8, gemm_reps=4):
 
 
 if __name__ == "__main__":
+    import argparse
+
+    from uccl_tpu import obs
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grad-mb", type=int, default=128)
+    ap.add_argument("--chunks", type=int, default=8)
+    obs.add_cli_args(ap)
+    _args = ap.parse_args()
+    obs.setup_from_args(_args)
+    obs.dump_at_exit(_args)  # covers crashes too
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    run()
+    run(grad_mb=_args.grad_mb, chunks=_args.chunks)

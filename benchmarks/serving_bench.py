@@ -74,9 +74,12 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None):
     warm_engine(engine, lens, max_seq, args.new_tokens)
     _, wall = drive(engine, prompts, arrivals, args.new_tokens)
 
+    from uccl_tpu import obs
+
     snap = engine.snapshot()
     return {
-        "bench": "serving", "stack": stack, "world": world,
+        "bench": "serving", "schema_version": obs.SCHEMA_VERSION,
+        "stack": stack, "world": world,
         "arrival_rate": rate, "slots": n_slots,
         "prefill_chunk": prefill_chunk, "step_tokens": step_tokens,
         "requests": args.requests, "new_tokens": args.new_tokens,
@@ -91,6 +94,11 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None):
         "max_step_ms": snap.get("max_step_ms"),
         "prefill_chunks": snap["prefill_chunks"],
         "slot_high_water": engine.pool.high_water,
+        # the obs registry's counter/gauge state rides along (fallback
+        # events, rejections, slot gauges — docs/OBSERVABILITY.md) so a
+        # bench line is self-contained for later analysis; counters are
+        # cumulative across the process's arms
+        "obs": obs.REGISTRY.snapshot()["metrics"],
     }
 
 
@@ -119,7 +127,12 @@ def main():
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--ffn", type=int, default=128)
     ap.add_argument("--seed", type=int, default=0)
+    from uccl_tpu import obs
+
+    obs.add_cli_args(ap)
     args = ap.parse_args()
+    obs.setup_from_args(args)
+    obs.dump_at_exit(args)  # every return path + crashes dump the surfaces
 
     jax = init_devices(args.devices)
     chunks = [None if c.strip() in ("off", "0", "none") else int(c)

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Validate the obs smoke arm's artifacts (qa.sh / ci.yml).
+
+Usage: python scripts/check_obs.py TRACE_JSON METRICS_PROM
+
+Asserts, with a named failure for each:
+
+* the trace parses (``json.loads``) and its ``traceEvents`` are a valid
+  Chrome trace: every ``B`` has a matching ``E`` on its tid, every ``X``
+  duration is non-negative;
+* at least one request track carries the complete lifecycle
+  (submit → admit → prefill[(-chunk)] → first_token → finish, in timeline
+  order), and engine-step + wire spans exist;
+* the metrics file is Prometheus text containing the wire-fallback and
+  serving goodput series.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import Counter, defaultdict
+
+
+def fail(msg: str) -> None:
+    print(f"check_obs: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check_trace(path: str) -> None:
+    with open(path) as f:
+        trace = json.loads(f.read())
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        fail(f"{path}: no traceEvents")
+    tracks = {e["tid"]: e["args"]["name"] for e in evs
+              if e.get("name") == "thread_name"}
+    b, e_ = Counter(), Counter()
+    by_track = defaultdict(list)
+    for ev in evs:
+        if ev["ph"] == "B":
+            b[ev["tid"]] += 1
+        elif ev["ph"] == "E":
+            e_[ev["tid"]] += 1
+        elif ev["ph"] == "X" and ev.get("dur", 0) < 0:
+            fail(f"{path}: X event {ev['name']!r} with negative dur")
+        if ev["ph"] in "XBEi":
+            track = tracks.get(ev["tid"])
+            if track is None:
+                fail(f"{path}: event on unnamed tid {ev['tid']}")
+            by_track[track].append(ev)
+    if b != e_:
+        fail(f"{path}: unbalanced B/E events ({dict(b)} vs {dict(e_)})")
+
+    complete = 0
+    for track, track_evs in by_track.items():
+        if not track.startswith("req-"):
+            continue
+        names = [ev["name"]
+                 for ev in sorted(track_evs, key=lambda ev: ev["ts"])]
+        if ("submit" in names and "admit" in names
+                and ("prefill" in names or "prefill_chunk" in names)
+                and "first_token" in names and "finish" in names):
+            order = [names.index("submit"), names.index("admit"),
+                     min(i for i, n in enumerate(names)
+                         if n in ("prefill", "prefill_chunk")),
+                     names.index("first_token"), names.index("finish")]
+            if order == sorted(order):
+                complete += 1
+    if complete < 1:
+        fail(f"{path}: no request track with a complete "
+             f"submit->admit->prefill->first_token->finish timeline "
+             f"(tracks: {sorted(by_track)})")
+    if not any(ev["name"] == "engine.step"
+               for ev in by_track.get("engine", [])):
+        fail(f"{path}: no engine.step spans")
+    if not any(ev["name"].startswith("wire.")
+               for ev in by_track.get("wire", [])):
+        fail(f"{path}: no wire spans")
+    print(f"check_obs: trace OK — {len(evs)} events, "
+          f"{complete} complete request timeline(s)")
+
+
+def check_metrics(path: str) -> None:
+    with open(path) as f:
+        text = f.read()
+    for series in ("ep_wire_fallback_total", "uccl_serving_goodput_tok_s"):
+        if series not in text:
+            fail(f"{path}: missing series {series!r}")
+    print(f"check_obs: metrics OK — {len(text.splitlines())} lines")
+
+
+def main(argv) -> None:
+    if len(argv) != 3:
+        fail("usage: check_obs.py TRACE_JSON METRICS_PROM")
+    check_trace(argv[1])
+    check_metrics(argv[2])
+    print("check_obs: ALL OK")
+
+
+if __name__ == "__main__":
+    main(sys.argv)

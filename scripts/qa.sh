@@ -23,6 +23,12 @@ JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --sl
   --requests 6 --prompt-len 12 --new-tokens 4 --arrival-rate 50 \
   --prefill-chunk 8 --check-oracle; check $?
 
+note "observability smoke tier (2-slot serving run traced end to end: Chrome-trace lifecycle timelines + Prometheus metrics validate)"
+JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
+  --requests 6 --prompt-len 8 --new-tokens 4 --arrival-rate 50 --check-oracle \
+  --trace-out /tmp/qa_obs_trace.json --metrics-out /tmp/qa_obs_metrics.prom; check $?
+python scripts/check_obs.py /tmp/qa_obs_trace.json /tmp/qa_obs_metrics.prom; check $?
+
 note "pytest (full suite, virtual 8-device mesh; pallas kernel files ran in the smoke tier)"
 timeout 2700 python -m pytest tests/ -q \
   --ignore=tests/test_pallas_a2a.py --ignore=tests/test_pallas_ccl.py; check $?

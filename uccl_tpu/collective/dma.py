@@ -22,6 +22,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from uccl_tpu.utils import config as _config
 from uccl_tpu.utils import jaxcompat as _jc
+from uccl_tpu.obs import counters as _obsc
 
 LANES = 128
 # Pad each chunk to a multiple of 8x128 elements (one f32 sublane tile;
@@ -51,6 +52,37 @@ MAX_INTERP_BYTES = _config.param(
 )
 
 MESH = pltpu.DeviceIdType.MESH
+
+# Every transparent pallas-wire downgrade (chunked → unchunked → lax)
+# increments this counter with its site (`what`) and `reason` — benches and
+# the /metrics surface read it instead of re-deriving the gate arithmetic
+# (the old `pallas_wire_active` heuristic). Declared at import so the
+# series exists (as 0) before the first fallback. Increments happen at
+# TRACE time — once per compiled program, the granularity at which the
+# wire decision is actually made; a jit cache hit re-runs the traced
+# choice without re-counting.
+WIRE_FALLBACK = _obsc.counter(
+    "ep_wire_fallback_total",
+    "transparent pallas-wire downgrades (chunked->unchunked->lax) by "
+    "site (what) and reason",
+)
+_fallback_logged = set()  # (what, reason, detail): log once per shape
+
+
+def record_fallback(what: str, reason: str, detail=None, msg=None) -> None:
+    """Count a transparent wire downgrade and log it ONCE per
+    (what, reason, detail) — ``detail`` carries the shape/bytes that made
+    this occurrence distinct, so a new shape logs again but a hot loop
+    doesn't spam."""
+    WIRE_FALLBACK.inc(what=what, reason=reason)
+    key = (what, reason, detail)
+    if key in _fallback_logged:
+        return
+    _fallback_logged.add(key)
+    from uccl_tpu.utils.logging import log
+
+    log("INFO", "CCL",
+        msg or f"pallas {what}: falling back ({reason}, {detail})")
 
 # collective_id allocation for kernels that may be IN FLIGHT concurrently.
 # Mosaic's entry-barrier semaphore is keyed by collective_id, so two kernels
@@ -256,16 +288,20 @@ def padded_chunk_elems(elems_per_peer: int) -> int:
 
 def check_budget(nbytes: int, what: str, interpret: bool,
                  quiet: bool = False) -> bool:
-    """``quiet`` suppresses the fallback log — for observers (bench labels)
-    asking what the gate WOULD decide, not taking the fallback."""
+    """``quiet`` suppresses the fallback counter AND log — for observers
+    asking what the gate WOULD decide, not taking the fallback (a quiet
+    probe must not inflate the fallback series the benches now read)."""
     limit = budget_limit(interpret)
     if nbytes > limit:
         if not quiet:
-            from uccl_tpu.utils.logging import log
-
-            log("INFO", "CCL",
-                f"pallas {what}: {nbytes}B exceeds "
-                f"{'interpreter' if interpret else 'VMEM'} budget {limit}B; "
-                "falling back to the XLA collective lowering")
+            record_fallback(
+                what,
+                "interpret_budget" if interpret else "vmem_budget",
+                detail=nbytes,
+                msg=(f"pallas {what}: {nbytes}B exceeds "
+                     f"{'interpreter' if interpret else 'VMEM'} budget "
+                     f"{limit}B; falling back to the XLA collective "
+                     "lowering"),
+            )
         return False
     return True

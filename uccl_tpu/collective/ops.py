@@ -4,6 +4,14 @@ These are the device-side contract of the collectives pillar: thin, uniformly-na
 wrappers over ``jax.lax`` collectives so model/parallel code never spells raw lax
 names (and so the chunk-graph scheduler can later swap implementations without
 touching call sites). All take ``axis`` as a mesh axis name or tuple of names.
+
+Telemetry: every wrapper tallies itself on the obs registry
+(``collective_traced_calls_total`` / ``collective_traced_bytes_total``,
+labeled by op). These functions run at TRACE time — inside jit — so the
+counts are per *compiled program*, not per execution: the honest host-side
+signal for "which collectives does this program issue, over how many
+per-shard bytes" (docs/OBSERVABILITY.md). Runtime device timing belongs to
+``jax.profiler``.
 """
 
 from __future__ import annotations
@@ -14,12 +22,32 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from uccl_tpu.obs import counters as _obsc
 from uccl_tpu.utils.topology import ppermute_pairs
 
 Axis = Union[str, Tuple[str, ...]]
 
+_CALLS = _obsc.counter(
+    "collective_traced_calls_total",
+    "collective ops traced into compiled programs, by op",
+)
+_BYTES = _obsc.counter(
+    "collective_traced_bytes_total",
+    "per-shard payload bytes of traced collective ops, by op",
+)
+
+
+def _tally(op: str, x) -> None:
+    try:
+        nbytes = int(x.size) * jnp.dtype(x.dtype).itemsize
+    except Exception:
+        return  # never let telemetry break a trace
+    _CALLS.inc(op=op)
+    _BYTES.inc(nbytes, op=op)
+
 
 def all_reduce(x: jax.Array, axis: Axis, op: str = "sum") -> jax.Array:
+    _tally("all_reduce", x)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "max":
@@ -32,25 +60,30 @@ def all_reduce(x: jax.Array, axis: Axis, op: str = "sum") -> jax.Array:
 
 
 def all_gather(x: jax.Array, axis: Axis, *, dim: int = 0, tiled: bool = True) -> jax.Array:
+    _tally("all_gather", x)
     return lax.all_gather(x, axis, axis=dim, tiled=tiled)
 
 
 def reduce_scatter(x: jax.Array, axis: Axis, *, dim: int = 0) -> jax.Array:
+    _tally("reduce_scatter", x)
     return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
 
 
 def all_to_all(
     x: jax.Array, axis: Axis, *, split_dim: int, concat_dim: int, tiled: bool = True
 ) -> jax.Array:
+    _tally("all_to_all", x)
     return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=tiled)
 
 
 def ppermute(x: jax.Array, axis: Axis, perm: Sequence[Tuple[int, int]]) -> jax.Array:
+    _tally("ppermute", x)
     return lax.ppermute(x, axis, perm=list(perm))
 
 
 def ring_shift(x: jax.Array, axis: str, shift: int = 1) -> jax.Array:
     """Rotate shards around the ring: member i's value goes to member i+shift."""
+    _tally("ring_shift", x)
     return lax.ppermute(x, axis, perm=ppermute_pairs(lax.axis_size(axis), shift))
 
 
@@ -64,5 +97,6 @@ def axis_size(axis: Axis) -> int:
 
 def broadcast(x: jax.Array, axis: Axis, root: int = 0) -> jax.Array:
     """Every member ends with the root member's value."""
+    _tally("broadcast", x)
     g = lax.all_gather(x, axis, axis=0, tiled=False)
     return g[root]

@@ -28,10 +28,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from uccl_tpu.ep import ll as ep_ll
 from uccl_tpu.ep import ops as ep_ops
+from uccl_tpu.obs import counters as _obsc
+from uccl_tpu.obs import tracer as _obst
 from uccl_tpu.parallel.mesh import AXIS, get_mesh, mesh_axis_size
 from uccl_tpu.utils.logging import get_logger
 
 _log = get_logger("EP")
+
+# host-level wire telemetry: payload bytes handed to each EP verb (the
+# global [W, ...] array — what the exchange moves end to end), labeled by
+# verb and the wire that carried it. The companion span on the "wire"
+# track measures the verb's HOST call window (dispatch + any compile on
+# first call) — device time proper belongs to jax.profiler.
+EP_BYTES = _obsc.counter(
+    "ep_bytes_total",
+    "payload bytes handed to EP verbs (global arrays), by verb and wire",
+)
+
+
+def _observed_call(verb: str, fn, args, *, wire: str, n_chunks: int,
+                   payload) -> tuple:
+    """Run one verb's jitted fn under the bytes counter + wire span."""
+    nbytes = int(payload.size) * payload.dtype.itemsize
+    EP_BYTES.inc(nbytes, verb=verb, wire=wire)
+    with _obst.span(f"ep.{verb}", track="wire", wire=wire,
+                    n_chunks=n_chunks, bytes=nbytes):
+        return fn(*args)
 
 
 class EventOverlap:
@@ -195,6 +217,13 @@ class Buffer:
         self.wire = wire
         self.n_chunks = n_chunks
         self._cache = {}
+        # host-path wire/chunk resolutions memoize per distinct config:
+        # the fallback counter's contract is one event per compiled
+        # program (collective/dma.py WIRE_FALLBACK), and these decisions
+        # are static per (buffer, shape/knob tuple) — re-resolving them on
+        # every verb call of a hot serving loop would re-count a single
+        # decision thousands of times
+        self._resolve_memo = {}
         # per-op stats (reference: EP Stats bound at uccl_ep.cc:2411 and the
         # dispatch_wait_recv_cost_stats tensor plumbed through
         # internode_ll.cu:66): op counters update eagerly; row/byte
@@ -234,10 +263,19 @@ class Buffer:
         if wire == "auto":
             wire = self.wire
         if wire == "pallas" and not self._pallas_wire_ok():
-            _log.info(
-                "wire='pallas' cannot address a multi-axis mesh under the "
-                "legacy interpret mode; falling back to the XLA wire"
-            )
+            # static per Buffer (mesh + interpreter): count/log the
+            # downgrade once, not per verb call
+            if "wire_downgrade" not in self._resolve_memo:
+                self._resolve_memo["wire_downgrade"] = True
+                from uccl_tpu.collective import dma
+
+                dma.record_fallback(
+                    "buffer_verb", "legacy_interpret_mesh",
+                    detail=tuple(self.mesh.axis_names),
+                    msg="wire='pallas' cannot address a multi-axis mesh "
+                        "under the legacy interpret mode; falling back to "
+                        "the XLA wire",
+                )
             wire = "auto"
         return wire
 
@@ -255,6 +293,17 @@ class Buffer:
         if n < 0:  # same contract as the Buffer constructor
             raise ValueError(f"n_chunks must be >= 0 (0 = auto), got {n}")
         if wire != "pallas" or self.world <= 1:
+            # an EXPLICIT depth > 1 on the pallas wire collapsing at world
+            # 1 is the same downgrade the per-shard resolvers record —
+            # count it here too (once: the world is static per Buffer), or
+            # counter coverage would depend on which call path resolved it
+            if n > 1 and wire == "pallas" and self.world <= 1 \
+                    and "chunks_world" not in self._resolve_memo:
+                self._resolve_memo["chunks_world"] = True
+                from uccl_tpu.collective import dma
+
+                dma.record_fallback("buffer_verb", "world_size",
+                                    detail=self.world)
             return 1
         return n
 
@@ -436,10 +485,18 @@ class Buffer:
         e = self.num_experts
         n_chunks = self._resolve_chunks(None, config, wire)
         if n_chunks != 1:
-            n_chunks = ep_ops.resolve_chunks(
-                n_chunks, wire, self.world, cap, self.num_local_experts, h,
-                ep_ops.wire_itemsize(wire_fp8, h, x.dtype),
-            )
+            # memoized: resolve_chunks records budget/capacity fallbacks,
+            # and this host call repeats per dispatch() of one static
+            # config — count once, like the traced (per-compile) gates
+            rkey = ("chunks", n_chunks, wire, cap, h, wire_fp8,
+                    jnp.dtype(x.dtype).name)
+            if rkey not in self._resolve_memo:
+                self._resolve_memo[rkey] = ep_ops.resolve_chunks(
+                    n_chunks, wire, self.world, cap,
+                    self.num_local_experts, h,
+                    ep_ops.wire_itemsize(wire_fp8, h, x.dtype),
+                )
+            n_chunks = self._resolve_memo[rkey]
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
         key = ("dispatch", x.shape, topk_idx.shape, wire_fp8, x.dtype, wire,
@@ -477,7 +534,9 @@ class Buffer:
         extra_in = (2, 2) + ((tok.ndim - 1,) if has_ev else ())
         fn = self._jit(key, f, extra_in, (3, 2, 2))
         args = (x, topk_idx) + ((tok,) if has_ev else ())
-        recv, slot, recv_counts = fn(*args)
+        recv, slot, recv_counts = _observed_call(
+            "dispatch", fn, args, wire=wire, n_chunks=n_chunks, payload=x,
+        )
         self._op_counts["dispatch"] += 1
         self._last_dispatch = (topk_idx, cap)
         # weights go straight into the handle (combine reshards them itself)
@@ -533,7 +592,10 @@ class Buffer:
         args = (expert_out, handle.slot, handle.weights) + (
             (tok,) if has_ev else ()
         )
-        out = fn(*args)
+        out = _observed_call(
+            "combine", fn, args, wire=wire, n_chunks=n_chunks,
+            payload=expert_out,
+        )
         if async_finish:
             return out, EventOverlap(out)
         return out
@@ -638,7 +700,10 @@ class Buffer:
         fn = self._jit(key, f, extra_in, (2, 1, 2, 2, 2, 2, 1, 1))
         args = (x, topk_idx, topk_weights) + ((tok,) if has_ev else ())
         (recv_x, counts, send_slot, weights, send_mat, recv_mat, regroup,
-         src_in_offsets) = fn(*args)
+         src_in_offsets) = _observed_call(
+            "low_latency_dispatch", fn, args, wire=wire, n_chunks=n_chunks,
+            payload=x,
+        )
         handle = LowLatencyHandle(
             send_slot, weights, send_mat, recv_mat, regroup,
             src_in_offsets, wire, wire_fp8, n_chunks,
@@ -694,7 +759,10 @@ class Buffer:
             expert_out, handle.send_slot, handle.weights, handle.send_mat,
             handle.recv_mat, handle.regroup, handle.src_in_offsets,
         ) + ((tok,) if has_ev else ())
-        out = fn(*args)
+        out = _observed_call(
+            "low_latency_combine", fn, args, wire=handle.wire,
+            n_chunks=handle.n_chunks, payload=expert_out,
+        )
         if async_finish or return_recv_hook:
             event = EventOverlap(out) if async_finish else None
             hook: Optional[Callable[[], None]] = (

@@ -83,8 +83,14 @@ def resolve_ll_chunks(n_chunks: int, wire: str, world: int,
     """Effective chunk-pipeline depth for the LL dense-chunk wire (shared
     with the Buffer verbs so the handle records exactly what dispatch ran):
     1 off the pallas wire or at world 1; 0 = auto (2 when the per-pair slot
-    axis can split); clamped to per_pair."""
+    axis can split); clamped to per_pair. An explicitly-requested depth
+    (> 1) that gets downgraded is recorded on the shared fallback counter
+    (docs/OBSERVABILITY.md); auto (0) resolving to 1 stays silent."""
     if wire != "pallas" or world <= 1:
+        if n_chunks > 1 and wire == "pallas":
+            from uccl_tpu.collective import dma as _dma
+
+            _dma.record_fallback("ep_ll_chunked", "world_size", detail=world)
         return 1
     if n_chunks == 0:
         n_chunks = 2 if per_pair >= 2 else 1

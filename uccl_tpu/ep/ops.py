@@ -461,8 +461,35 @@ def resolve_chunks(n_chunks: int, wire: str, world: int, capacity: int,
     and the split would be pure overhead), or when the pipeline's resident
     footprint — 4 send+recv chunk pairs: two airborne kernels in EACH of
     the dispatch and combine families — is over budget. All of these are
-    the automatic fallback to the unchunked wire."""
+    the automatic fallback to the unchunked wire. Every downgrade of an
+    EXPLICITLY requested chunk pipeline (n_chunks > 1 on the pallas wire)
+    is recorded on the ``ep_wire_fallback_total`` counter with its reason
+    (docs/OBSERVABILITY.md) — ``0`` (auto) resolving to 1 on an
+    unchunkable config is the correct auto answer, not a downgrade, and
+    stays silent (the budget gate still counts either way: there a
+    RESOLVED pipeline was pushed back). The resolved depth — including a
+    downgraded 1 — lands on the ``ep_chunk_depth`` gauge."""
+    n = _resolve_chunks_value(n_chunks, wire, world, capacity, e_local,
+                              hidden, itemsize, axis)
+    from uccl_tpu.obs import counters as _obsc
+
+    _obsc.gauge(
+        "ep_chunk_depth",
+        "resolved chunk-pipeline depth of the last traced EP layer",
+    ).set(n, what="moe_layer")
+    return n
+
+
+def _resolve_chunks_value(n_chunks, wire, world, capacity, e_local, hidden,
+                          itemsize, axis) -> int:
+    requested = n_chunks > 1 and wire == "pallas"
     if wire != "pallas" or world <= 1 or capacity < 2:
+        if requested:
+            _dma.record_fallback(
+                "ep_moe_chunked",
+                "world_size" if world <= 1 else "capacity",
+                detail=(world, capacity),
+            )
         return 1
     if (
         axis is not None
@@ -470,6 +497,9 @@ def resolve_chunks(n_chunks: int, wire: str, world: int, capacity: int,
         and len(axis) > 1
         and not _dma.faithful_sync(_dma.resolve_interpret(None))
     ):
+        if requested:
+            _dma.record_fallback("ep_moe_chunked", "tuple_axis_mesh",
+                                 detail=tuple(axis))
         return 1
     if n_chunks == 0:
         n_chunks = 2
@@ -478,7 +508,7 @@ def resolve_chunks(n_chunks: int, wire: str, world: int, capacity: int,
         cs = _dma.pad_capacity(capacity, n_chunks) // n_chunks
         if not _dma.chunk_budget(world, e_local * cs * hidden, itemsize,
                                  "ep_moe_chunked", resident_kernels=4):
-            return 1
+            n_chunks = 1  # chunk_budget already counted + logged the reason
     return n_chunks
 
 

@@ -255,6 +255,8 @@ def all_to_all(
         # id along ONE named axis; a tuple EP axis (e.g. flagship's
         # ("dp", "cp")) is unaddressable there — same transparent downgrade
         # Buffer._pallas_wire_ok applies at the verb level
+        _dma.record_fallback("ep_all_to_all", "tuple_axis_mesh",
+                             detail=tuple(axis))
         return _lax_fallback(x, axis)
     if n_chunks > 1:
         if chunk_axis == 0:

@@ -1,0 +1,55 @@
+"""uccl_tpu.obs — unified observability: event tracing + telemetry registry.
+
+The framework-wide telemetry spine (docs/OBSERVABILITY.md). Three layers,
+all host-only and jax-free:
+
+* :mod:`uccl_tpu.obs.tracer` — thread-safe ring-buffered event tracer
+  (spans + instants, monotonic timestamps, per-thread tracks, bounded
+  memory, zero-cost when disabled);
+* :mod:`uccl_tpu.obs.counters` — labeled counter/gauge registry + pull
+  sources (absorbs and supersedes ``utils.stats``'s registration surface);
+* :mod:`uccl_tpu.obs.chrome_trace` / :mod:`uccl_tpu.obs.export` — the
+  Chrome-trace/Perfetto JSON exporter and the Prometheus-text ``/metrics``
+  + JSON ``/snapshot`` surfaces (file dump via ``--trace-out`` /
+  ``--metrics-out`` on every CLI; live HTTP in ``serve --server``).
+
+Instrumentation idiom::
+
+    from uccl_tpu import obs
+
+    obs.counter("ep_wire_fallback_total").inc(reason="vmem_budget")
+    with obs.span("engine.step", track="engine", queued=3):
+        ...
+    obs.instant("first_token", track=req.track)
+
+Everything is a no-op (one bool check) until ``obs.enable_tracing()`` /
+``--trace-out`` turns the tracer on; counters are always live (they are
+just dict adds).
+"""
+
+from uccl_tpu.obs.counters import (  # noqa: F401
+    REGISTRY, CounterFamily, GaugeFamily, Registry, counter,
+    escape_label_value, gauge, sanitize_name,
+)
+from uccl_tpu.obs.tracer import (  # noqa: F401
+    Event, Tracer, begin, complete, end, get_tracer, instant, span,
+)
+from uccl_tpu.obs.tracer import enable as enable_tracing  # noqa: F401
+from uccl_tpu.obs.tracer import disable as disable_tracing  # noqa: F401
+from uccl_tpu.obs.tracer import enabled as tracing_enabled  # noqa: F401
+from uccl_tpu.obs.export import (  # noqa: F401
+    SCHEMA_VERSION, MetricsServer, add_cli_args, dump_at_exit,
+    dump_from_args, json_snapshot, prometheus_text, setup_from_args,
+    write_metrics, write_trace,
+)
+from uccl_tpu.obs.chrome_trace import to_chrome_trace  # noqa: F401
+
+__all__ = [
+    "REGISTRY", "CounterFamily", "GaugeFamily", "Registry", "counter",
+    "gauge", "sanitize_name", "escape_label_value", "Event", "Tracer",
+    "begin", "complete", "end", "get_tracer", "instant", "span",
+    "enable_tracing", "disable_tracing", "tracing_enabled",
+    "SCHEMA_VERSION", "MetricsServer", "add_cli_args", "dump_at_exit",
+    "dump_from_args", "json_snapshot", "prometheus_text", "setup_from_args",
+    "write_metrics", "write_trace", "to_chrome_trace",
+]

@@ -1,0 +1,204 @@
+"""Counter/gauge registry — the unified telemetry surface of the framework.
+
+The reference's engines each keep a STATS block that a 2 s reporter thread
+prints (transport.cc:1797); our :mod:`uccl_tpu.utils.stats` reproduced the
+reporter but left every subsystem to invent its own numbers. This registry
+is the one place those numbers now live:
+
+* **counters** — monotonic, labeled (``wire_fallback.inc(reason="budget")``):
+  bytes moved per collective, pallas→lax fallback events with recorded
+  reasons, admission rejections, traced-collective tallies.
+* **gauges** — last-write-wins, labeled: slot-pool high-water, occupancy,
+  resolved chunk-pipeline depth.
+* **sources** — pull callbacks (the old ``utils.stats`` registration
+  surface, absorbed here: :class:`uccl_tpu.utils.stats.StatsRegistry` now
+  delegates to this registry, so everything the stats thread printed is
+  also exported through /metrics and /snapshot).
+
+Everything is host-only, jax-free and thread-safe; reading never blocks
+writers for longer than a dict copy. Export lives in
+:mod:`uccl_tpu.obs.export` (Prometheus text + JSON snapshot).
+
+Label keys/values are kept verbatim here; sanitization to the Prometheus
+grammar happens once at export (:func:`sanitize_name` /
+:func:`escape_label_value` — shared with serving/metrics.py so the two
+exporters cannot drift).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "CounterFamily", "GaugeFamily", "Registry", "REGISTRY",
+    "counter", "gauge", "sanitize_name", "escape_label_value",
+]
+
+LabelKey = Tuple[Tuple[str, str], ...]  # sorted (k, v) pairs
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NAME_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Coerce to the Prometheus metric-name grammar
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (invalid chars → ``_``, digit-led names
+    get a ``_`` prefix). The ONE sanitizer every exporter shares."""
+    if _NAME_OK.match(name):
+        return name
+    name = _NAME_BAD_CHARS.sub("_", name)
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def escape_label_value(v: str) -> str:
+    """Escape a label value for the Prometheus text format."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Family:
+    """Shared labeled-sample storage for counters and gauges."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._samples: Dict[LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def get(self, **labels) -> float:
+        with self._lock:
+            return self._samples.get(_label_key(labels), 0.0)
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            items = list(self._samples.items())
+        return [(dict(k), v) for k, v in items]
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._samples.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._samples.clear()
+
+
+class CounterFamily(_Family):
+    """Monotonic counter, optionally labeled."""
+
+    kind = "counter"
+
+    def inc(self, by: float = 1.0, **labels) -> None:
+        if by < 0:
+            raise ValueError(f"counter {self.name} cannot decrease ({by})")
+        k = _label_key(labels)
+        with self._lock:
+            self._samples[k] = self._samples.get(k, 0.0) + by
+
+
+class GaugeFamily(_Family):
+    """Last-write-wins gauge, optionally labeled."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._samples[_label_key(labels)] = float(value)
+
+    def max(self, value: float, **labels) -> None:
+        """Raise-only set (high-water marks)."""
+        k = _label_key(labels)
+        with self._lock:
+            self._samples[k] = max(self._samples.get(k, value), float(value))
+
+
+class Registry:
+    """Named counter/gauge families + pull sources."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        self._sources: Dict[str, Callable[[], Dict]] = {}
+
+    def counter(self, name: str, help: str = "") -> CounterFamily:
+        return self._family(name, help, CounterFamily)
+
+    def gauge(self, name: str, help: str = "") -> GaugeFamily:
+        return self._family(name, help, GaugeFamily)
+
+    def _family(self, name, help, cls):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = cls(name, help)
+            elif not isinstance(fam, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {fam.kind}"
+                )
+            if help and not fam.help:
+                fam.help = help
+            return fam
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    # -- pull sources (the absorbed utils.stats surface) ---------------------
+    def register_source(self, name: str,
+                        fn: Callable[[], Dict]) -> None:
+        with self._lock:
+            self._sources[name] = fn
+
+    def unregister_source(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def sources_snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            sources = dict(self._sources)
+        out = {}
+        for name, fn in sources.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # a broken source must not kill readers
+                out[name] = {"error": repr(e)}
+        return out
+
+    def snapshot(self) -> Dict:
+        """JSON-ready dump: counters/gauges as {name: {"label=val,...":
+        value}} (empty-label samples keyed ""), plus every source's pull."""
+        metrics: Dict[str, Dict[str, float]] = {}
+        for fam in self.families():
+            metrics[fam.name] = {
+                ",".join(f"{k}={v}" for k, v in sorted(labels.items())): val
+                for labels, val in fam.samples()
+            }
+        return {"metrics": metrics, "sources": self.sources_snapshot()}
+
+    def reset(self) -> None:
+        """Zero every family (sources are untouched) — tests and benches
+        isolating per-arm deltas."""
+        for fam in self.families():
+            fam.clear()
+
+
+REGISTRY = Registry()
+
+
+def counter(name: str, help: str = "") -> CounterFamily:
+    """Get-or-create a counter on the global registry."""
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> GaugeFamily:
+    """Get-or-create a gauge on the global registry."""
+    return REGISTRY.gauge(name, help)

@@ -1,0 +1,213 @@
+"""Thread-safe, ring-buffered event tracer (the NPKit analog, host-side).
+
+The reference ships NPKit GPU event tracing (SURVEY.md §5): fixed-size
+per-channel event buffers filled by the kernels and dumped to a
+Chrome-trace post-hoc. The TPU reproduction's device timeline already
+belongs to ``jax.profiler`` (utils/tracing.py); what was missing is the
+HOST event spine — request lifecycles, engine steps, wire windows — with
+the same properties the NPKit design proves out:
+
+* **bounded memory**: events land in a ring buffer (``deque(maxlen=...)``);
+  a long-lived server can trace forever, old events fall off the back and
+  are counted in ``dropped``.
+* **thread-safe**: any runtime thread may record; one lock per record,
+  nothing else shared.
+* **zero-cost when disabled**: the module-level helpers check one bool and
+  return a cached no-op context manager — no allocation, no lock, no
+  timestamp read.
+* **monotonic timestamps**: ``time.perf_counter`` relative to the tracer's
+  epoch, in microseconds (the Chrome-trace unit), so spans from different
+  threads land on one consistent timeline.
+
+Tracks: every event carries a ``track`` label — the Chrome-trace exporter
+maps each distinct label to a tid row. ``track=None`` means "this thread's
+auto track" (``thread-<n>`` in first-seen order), so concurrent writers
+never interleave on one row; instrumentation that owns a logical timeline
+(a request, the engine loop, the wire) passes an explicit label instead.
+
+Event phases follow the Chrome-trace vocabulary: ``X`` (complete span with
+a duration — what :func:`span`/:meth:`Tracer.complete` emit), ``B``/``E``
+(open/close pairs for spans that cross call boundaries), ``i`` (instant).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional
+
+__all__ = [
+    "Event", "Tracer", "enable", "disable", "enabled", "get_tracer",
+    "span", "instant", "begin", "end", "complete",
+]
+
+
+class Event(NamedTuple):
+    """One trace event. ``ts_us`` is microseconds since the tracer's epoch;
+    ``dur_us`` is only meaningful for ``ph == "X"``; ``args`` is a small
+    JSON-ready dict (or None)."""
+
+    name: str
+    ph: str  # "X" | "B" | "E" | "i"
+    ts_us: float
+    dur_us: float
+    track: str
+    args: Optional[dict]
+
+
+class Tracer:
+    """Ring-buffered event recorder. All methods are thread-safe."""
+
+    def __init__(self, capacity: int = 65536):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._buf: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._threads: Dict[int, str] = {}  # ident -> auto track label
+        self.dropped = 0
+
+    # -- clock ---------------------------------------------------------------
+    def now_us(self) -> float:
+        """Microseconds since this tracer's epoch (monotonic)."""
+        return (time.perf_counter() - self._t0) * 1e6
+
+    # -- recording -----------------------------------------------------------
+    def _track(self, track: Optional[str]) -> str:
+        if track is not None:
+            return track
+        ident = threading.get_ident()
+        t = self._threads.get(ident)
+        if t is None:
+            # racy get-then-set is fine: both racers write the same mapping
+            # only if they share an ident, which they cannot
+            with self._lock:
+                t = self._threads.setdefault(
+                    ident, f"thread-{len(self._threads)}"
+                )
+        return t
+
+    def _record(self, ev: Event) -> None:
+        with self._lock:
+            if len(self._buf) == self.capacity:
+                self.dropped += 1
+            self._buf.append(ev)
+
+    def instant(self, name: str, track: Optional[str] = None,
+                **args) -> None:
+        self._record(Event(name, "i", self.now_us(), 0.0,
+                           self._track(track), args or None))
+
+    def begin(self, name: str, track: Optional[str] = None, **args) -> None:
+        self._record(Event(name, "B", self.now_us(), 0.0,
+                           self._track(track), args or None))
+
+    def end(self, name: str, track: Optional[str] = None) -> None:
+        self._record(Event(name, "E", self.now_us(), 0.0,
+                           self._track(track), None))
+
+    def complete(self, name: str, ts_us: float, dur_us: float,
+                 track: Optional[str] = None, **args) -> None:
+        """Record a finished span ("X") from explicit timestamps — the form
+        instrumentation uses when ONE measured window yields spans on
+        several tracks (e.g. a batched prefill covering many requests)."""
+        self._record(Event(name, "X", ts_us, max(0.0, dur_us),
+                           self._track(track), args or None))
+
+    @contextlib.contextmanager
+    def span(self, name: str, track: Optional[str] = None, **args):
+        """Context manager: one "X" event spanning the with-block."""
+        t0 = self.now_us()
+        try:
+            yield
+        finally:
+            self.complete(name, t0, self.now_us() - t0, track, **args)
+
+    # -- readout -------------------------------------------------------------
+    def events(self) -> List[Event]:
+        """Snapshot of the ring (oldest first)."""
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+
+# -- module-level singleton (what instrumentation calls) ---------------------
+_tracer: Optional[Tracer] = None  # None = disabled: the zero-cost check
+
+
+class _NullSpan:
+    """Reusable no-op context manager — the disabled-tracer fast path
+    allocates nothing."""
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def enable(capacity: int = 65536) -> Tracer:
+    """Install (or replace) the global tracer and return it."""
+    global _tracer
+    _tracer = Tracer(capacity)
+    return _tracer
+
+
+def disable() -> None:
+    global _tracer
+    _tracer = None
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def span(name: str, track: Optional[str] = None, **args):
+    """Span on the global tracer; a cached no-op when tracing is off."""
+    t = _tracer
+    if t is None:
+        return _NULL_SPAN
+    return t.span(name, track, **args)
+
+
+def instant(name: str, track: Optional[str] = None, **args) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, track, **args)
+
+
+def begin(name: str, track: Optional[str] = None, **args) -> None:
+    t = _tracer
+    if t is not None:
+        t.begin(name, track, **args)
+
+
+def end(name: str, track: Optional[str] = None) -> None:
+    t = _tracer
+    if t is not None:
+        t.end(name, track)
+
+
+def complete(name: str, ts_us: float, dur_us: float,
+             track: Optional[str] = None, **args) -> None:
+    t = _tracer
+    if t is not None:
+        t.complete(name, ts_us, dur_us, track, **args)

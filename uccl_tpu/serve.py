@@ -134,6 +134,7 @@ def _timed_windows(run_full, run_one, batch, new_tokens, reps):
     continuous-batching engine (uccl_tpu/serving/metrics.py). Callers must
     have warmed BOTH programs; ``run_one`` is None when N == 1 (the full
     window then doubles as the TTFT window)."""
+    from uccl_tpu import obs
     from uccl_tpu.serving.metrics import percentile, percentiles_ms
 
     ttft, steps, fulls = [], [], []
@@ -141,10 +142,13 @@ def _timed_windows(run_full, run_one, batch, new_tokens, reps):
     for _ in range(max(1, reps)):
         if run_one is not None:
             t0 = time.perf_counter()
-            run_one()
+            with obs.span("serve.ttft_window", track="serve"):
+                run_one()
             ttft.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        out = run_full()
+        with obs.span("serve.full_window", track="serve",
+                      new_tokens=new_tokens):
+            out = run_full()
         fulls.append(time.perf_counter() - t0)
         if run_one is not None and fulls[-1] > ttft[-1]:
             steps.append((fulls[-1] - ttft[-1]) / (new_tokens - 1))
@@ -175,7 +179,10 @@ def _serve_continuous(args, saved_cfg):
     import jax.numpy as jnp
     import numpy as np
 
-    from uccl_tpu.serving import DenseBackend, MoEBackend, ServingEngine
+    from uccl_tpu import obs
+    from uccl_tpu.serving import (
+        DenseBackend, MoEBackend, ServingEngine, ServingMetrics,
+    )
     from uccl_tpu.serving.loadgen import drive, synth_workload, warm_engine
 
     stack = args.stack
@@ -303,12 +310,35 @@ def _serve_continuous(args, saved_cfg):
         rng, args.requests, args.prompt_len, vocab, args.arrival_rate
     )
     warm_engine(engine, lens, max_seq, args.new_tokens)
-    reqs, wall = drive(engine, prompts, arrivals, args.new_tokens)
+    metrics_srv = None
+    if args.metrics_port:
+        # live /metrics (Prometheus text) + /snapshot (JSON) for the run's
+        # duration — each scrape appends the engine's current percentile
+        # lines to the registry dump
+        metrics_srv = obs.MetricsServer(
+            args.metrics_port,
+            extra_lines_fn=lambda: ServingMetrics.prometheus_lines(
+                engine.snapshot()
+            ),
+        )
+        print(f"metrics: http://127.0.0.1:{metrics_srv.port}/metrics "
+              f"(+ /snapshot)", flush=True)
+    try:
+        reqs, wall = drive(engine, prompts, arrivals, args.new_tokens)
+    finally:
+        if metrics_srv is not None:
+            metrics_srv.close()
 
     snap = engine.snapshot()
     engine.close()
+    written = obs.dump_from_args(
+        args, extra_lines=ServingMetrics.prometheus_lines(snap)
+    )
+    for path in written:
+        print(f"wrote {path}", flush=True)
     summary = {
-        "mode": "serve-continuous", "stack": stack, "ckpt_step": step,
+        "mode": "serve-continuous", "schema_version": obs.SCHEMA_VERSION,
+        "stack": stack, "ckpt_step": step,
         "world": world, "slots": args.slots, "requests": args.requests,
         "arrival_rate": args.arrival_rate, "new_tokens": args.new_tokens,
         "prefill_chunk": args.prefill_chunk or None,
@@ -410,7 +440,18 @@ def main(argv=None):
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--step", type=int, default=None,
                     help="checkpoint step (default: latest)")
+    # observability surfaces (docs/OBSERVABILITY.md): --trace-out enables
+    # the event tracer and writes a Chrome-trace/Perfetto JSON at exit;
+    # --metrics-out dumps the Prometheus-text registry; --metrics-port
+    # serves live /metrics + /snapshot during --server runs
+    from uccl_tpu import obs
+
+    obs.add_cli_args(ap)
     args = ap.parse_args(argv)
+    obs.setup_from_args(args)
+    # crash-safety net: a run that dies mid-flight still dumps its partial
+    # trace/metrics (the explicit dumps below win when they run)
+    obs.dump_at_exit(args)
 
     if args.devices:
         os.environ["XLA_FLAGS"] = (
@@ -517,7 +558,8 @@ def main(argv=None):
             run_full, run_one, args.batch, args.new_tokens, args.timing_reps
         )
         summary = {
-            "mode": "serve", "ckpt_step": step, "impl": "dense",
+            "mode": "serve", "schema_version": obs.SCHEMA_VERSION,
+            "ckpt_step": step, "impl": "dense",
             "world": 1, "batch": args.batch,
             "new_tokens": args.new_tokens,
             # the raw window metric, kept under an honest name: it spans
@@ -528,6 +570,7 @@ def main(argv=None):
         }
         print(f"first sequence: {out[0].tolist()}", flush=True)
         print(json.dumps(summary), flush=True)
+        obs.dump_from_args(args)
         return
 
     cfg = MoEServeConfig(
@@ -603,6 +646,7 @@ def main(argv=None):
     total = args.batch * args.new_tokens
     summary = {
         "mode": "serve",
+        "schema_version": obs.SCHEMA_VERSION,
         "ckpt_step": step,
         "impl": impl,
         "world": world,
@@ -614,6 +658,7 @@ def main(argv=None):
     }
     print(f"first sequence: {out[0, 0].tolist()}", flush=True)
     print(json.dumps(summary), flush=True)
+    obs.dump_from_args(args)
 
 
 if __name__ == "__main__":

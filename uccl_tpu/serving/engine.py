@@ -33,11 +33,27 @@ from typing import List, Optional
 
 import numpy as np
 
+from uccl_tpu import obs
 from uccl_tpu.serving.metrics import ServingMetrics
 from uccl_tpu.serving.request import Request, RequestState, now
 from uccl_tpu.serving.scheduler import FIFOScheduler
 from uccl_tpu.serving.slots import SlotPool
 from uccl_tpu.utils.lru import LRUFnCache
+
+# serving telemetry on the obs registry (docs/OBSERVABILITY.md): the
+# admission-rejection counter and slot-pool gauges are always live (dict
+# adds); trace events additionally light up under --trace-out /
+# obs.enable_tracing() and cost one bool check otherwise.
+_REJECTS = obs.counter(
+    "serving_admission_rejected_total",
+    "requests rejected at submit by queue backpressure",
+)
+_OCCUPANCY = obs.gauge(
+    "serving_slot_occupancy", "KV slot-pool occupancy after the last step"
+)
+_HIGH_WATER = obs.gauge(
+    "serving_slot_high_water", "max concurrent KV slot occupancy observed"
+)
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -248,8 +264,13 @@ class ServingEngine:
         )
         self._next_rid += 1
         self.metrics.on_submit(req)
+        obs.instant("submit", track=req.track, rid=req.rid,
+                    prompt_len=int(prompt.size),
+                    max_new_tokens=max_new_tokens)
         if not self.sched.submit(req):
             self.metrics.on_reject(req)
+            _REJECTS.inc()
+            obs.instant("reject", track=req.track, rid=req.rid)
             return None
         return req
 
@@ -263,6 +284,8 @@ class ServingEngine:
         advances every mid-prefill request by one chunk (budget-gated
         admission). Returns requests finished during this step."""
         t0 = now()
+        tr = obs.get_tracer()
+        ts0 = tr.now_us() if tr is not None else 0.0
         finished: List[Request] = []
         if self.prefill_chunk is None:
             newly = self.sched.admit(self.pool)
@@ -273,6 +296,12 @@ class ServingEngine:
         else:
             self._step_chunked(finished)
         self.metrics.on_step(now() - t0)
+        if tr is not None:
+            tr.complete("engine.step", ts0, tr.now_us() - ts0, "engine",
+                        active=len(self._by_slot), queued=self.sched.qsize,
+                        finished=len(finished))
+        _OCCUPANCY.set(self.pool.occupancy)
+        _HIGH_WATER.set(self.pool.high_water)
         return finished
 
     def _step_chunked(self, finished) -> None:
@@ -294,6 +323,7 @@ class ServingEngine:
             self._by_slot[slot] = req
             self._prefilling[slot] = req
             self.metrics.on_admit(req)
+            obs.instant("admit", track=req.track, slot=slot)
         if self._prefilling:
             self._prefill_chunk_step(finished)
         if len(self._by_slot) > len(self._prefilling):
@@ -345,10 +375,21 @@ class ServingEngine:
             lens[slot] = req.prompt.size
             mask[slot] = True
             self.metrics.on_admit(req)
+            obs.instant("admit", track=req.track, slot=slot)
+        tr = obs.get_tracer()
+        ts0 = tr.now_us() if tr is not None else 0.0
         t0 = now()
         tok = self.backend.prefill(tokens, lens, mask)
         self.metrics.on_prefill(now() - t0, len(newly))
         t_done = now()
+        if tr is not None:
+            # one measured window, spans on every covered track: the wire
+            # row shows the batched device call, each request row its share
+            dur = tr.now_us() - ts0
+            tr.complete("wire.prefill", ts0, dur, "wire",
+                        n=len(newly), bucket=s_bucket)
+            for slot, req in newly:
+                tr.complete("prefill", ts0, dur, req.track, slot=slot)
         for slot, req in newly:
             self._by_slot[slot] = req
             self._emit_first_token(slot, req, tok[slot], t_done, finished)
@@ -371,11 +412,20 @@ class ServingEngine:
             lens[slot] = req.prompt.size
             start[slot] = req.prefill_pos
             mask[slot] = True
+        tr = obs.get_tracer()
+        ts0 = tr.now_us() if tr is not None else 0.0
         t0 = now()
         tok = self.backend.prefill(tokens, lens, mask, start=start)
         self.metrics.on_prefill(now() - t0, len(self._prefilling),
                                 chunked=True)
         t_done = now()
+        if tr is not None:
+            dur = tr.now_us() - ts0
+            tr.complete("wire.prefill", ts0, dur, "wire",
+                        n=len(self._prefilling), chunk=c)
+            for slot, req in self._prefilling.items():
+                tr.complete("prefill_chunk", ts0, dur, req.track,
+                            slot=slot, offset=req.prefill_pos)
         for slot, req in list(self._prefilling.items()):
             req.prefill_pos = min(req.prefill_pos + c, req.prompt.size)
             if req.prefill_pos < req.prompt.size:
@@ -390,10 +440,15 @@ class ServingEngine:
         active = np.zeros(self.backend.n_slots, bool)
         for slot in decoding:
             active[slot] = True
+        tr = obs.get_tracer()
+        ts0 = tr.now_us() if tr is not None else 0.0
         t0 = now()
         tok = self.backend.decode(self._last_tok.copy(), active)
         self.metrics.on_decode_step(now() - t0, len(decoding))
         t_done = now()
+        if tr is not None:
+            tr.complete("wire.decode", ts0, tr.now_us() - ts0, "wire",
+                        n=len(decoding))
         for slot, req in list(decoding.items()):
             self._last_tok[slot] = tok[slot]
             req.out_tokens.append(int(tok[slot]))
@@ -407,6 +462,8 @@ class ServingEngine:
         req.out_tokens.append(int(tok_val))
         req.t_first_token = t
         self.metrics.on_first_token(req)
+        obs.instant("first_token", track=req.track,
+                    ttft_ms=round(req.ttft * 1e3, 3))
         self._maybe_retire(slot, req, t, finished)
 
     def _maybe_retire(self, slot: int, req: Request, t: float,
@@ -422,4 +479,6 @@ class ServingEngine:
         self.pool.free(slot)
         self._by_slot.pop(slot, None)
         self.metrics.on_finish(req)
+        obs.instant("finish", track=req.track, reason=req.finish_reason,
+                    tokens=req.n_generated)
         finished.append(req)

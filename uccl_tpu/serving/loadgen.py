@@ -51,6 +51,7 @@ def warm_engine(engine: ServingEngine, lens, max_seq: int,
                       max_new_tokens=min(2, new_tokens))
         engine.drain()
         engine.reset_metrics()
+        _clear_warmup_trace()
         return
     by_bucket = {}
     for l in lens:
@@ -60,6 +61,18 @@ def warm_engine(engine: ServingEngine, lens, max_seq: int,
                       max_new_tokens=min(2, new_tokens))
         engine.drain()
     engine.reset_metrics()
+    _clear_warmup_trace()
+
+
+def _clear_warmup_trace() -> None:
+    """Warmup requests are synthetic compile fodder — their lifecycle
+    events would sit at the front of every exported trace, so the tracer
+    resets with the metrics."""
+    from uccl_tpu import obs
+
+    t = obs.get_tracer()
+    if t is not None:
+        t.clear()
 
 
 def drive(engine: ServingEngine, prompts, arrivals, max_new_tokens: int,

@@ -161,6 +161,31 @@ class ServingMetrics:
         return snap
 
     # -- repo-wide stats thread export --------------------------------------
+    @staticmethod
+    def prometheus_lines(snapshot: Dict,
+                         prefix: str = "uccl_serving") -> List[str]:
+        """The snapshot as Prometheus text lines (the ``/metrics`` face of
+        the same numbers — names through the shared obs sanitizer so this
+        exporter and :func:`uccl_tpu.obs.prometheus_text` cannot drift).
+        Percentile sub-dicts become one series per quantile, labeled
+        ``{q="p50"}``; booleans and strings are skipped."""
+        from uccl_tpu.obs import escape_label_value, sanitize_name
+
+        lines: List[str] = []
+        for k, v in snapshot.items():
+            name = sanitize_name(f"{prefix}_{k}")
+            if isinstance(v, dict):
+                for q, qv in v.items():
+                    if isinstance(qv, (int, float)) \
+                            and not isinstance(qv, bool):
+                        lines.append(
+                            f'{name}{{q="{escape_label_value(str(q))}"}} '
+                            f"{qv}"
+                        )
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(f"{name} {v}")
+        return lines
+
     def register(self, engine, name: str = "serving") -> None:
         """Export through uccl_tpu.utils.stats — the same periodic snapshot
         channel the transport engines report on."""

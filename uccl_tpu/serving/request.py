@@ -62,6 +62,13 @@ class Request:
     t_finish: Optional[float] = None
 
     @property
+    def track(self) -> str:
+        """The request's trace track (one Chrome-trace row per request —
+        the engine emits its submit→admit→prefill→first-token→finish
+        lifecycle marks here, docs/OBSERVABILITY.md)."""
+        return f"req-{self.rid}"
+
+    @property
     def n_generated(self) -> int:
         return len(self.out_tokens)
 

@@ -4,6 +4,16 @@ The analog of the reference's engine stats thread (collective/rdma
 transport.cc:1797 ``stats_thread_fn`` — 2 s interval, silenced by
 ``UCCL_ENGINE_QUIET``): components register counter callbacks; a daemon thread
 logs a snapshot every interval. Silence with ``UCCL_TPU_STATS_QUIET=1``.
+
+.. deprecated:: the registration surface is absorbed by
+   :data:`uccl_tpu.obs.REGISTRY` (docs/OBSERVABILITY.md). The module-level
+   ``registry`` here now mirrors every register/unregister into the obs
+   registry's pull sources, so anything registered through the old surface
+   is also exported via ``/metrics`` + ``/snapshot`` and the obs JSON
+   snapshot. Existing callers keep working unchanged; new code should
+   register on ``uccl_tpu.obs.REGISTRY`` (``register_source``) directly.
+   The reporter thread itself stays — it is the log-file face of the same
+   sources.
 """
 
 from __future__ import annotations
@@ -12,6 +22,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from uccl_tpu.obs import counters as _obsc
 from uccl_tpu.utils.config import param
 from uccl_tpu.utils.logging import get_logger
 
@@ -22,19 +33,29 @@ _interval = param("stats_interval_s", 2.0, help="stats reporting interval")
 
 
 class StatsRegistry:
-    """Named counter sources; snapshot() pulls every registered callback."""
+    """Named counter sources; snapshot() pulls every registered callback.
 
-    def __init__(self):
+    When constructed with ``obs_registry``, every source is mirrored into
+    that registry's pull sources (the deprecation shim: the module-level
+    ``registry`` below mirrors into :data:`uccl_tpu.obs.REGISTRY`).
+    Standalone instances (tests) stay self-contained."""
+
+    def __init__(self, obs_registry: Optional[_obsc.Registry] = None):
         self._sources: Dict[str, Callable[[], Dict[str, float]]] = {}
         self._lock = threading.Lock()
+        self._obs = obs_registry
 
     def register(self, name: str, fn: Callable[[], Dict[str, float]]) -> None:
         with self._lock:
             self._sources[name] = fn
+        if self._obs is not None:
+            self._obs.register_source(name, fn)
 
     def unregister(self, name: str) -> None:
         with self._lock:
             self._sources.pop(name, None)
+        if self._obs is not None:
+            self._obs.unregister_source(name)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -48,7 +69,7 @@ class StatsRegistry:
         return out
 
 
-registry = StatsRegistry()
+registry = StatsRegistry(obs_registry=_obsc.REGISTRY)
 
 
 class StatsThread:

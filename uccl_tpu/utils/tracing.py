@@ -4,22 +4,48 @@ The TPU answer to the reference's observability stack (SURVEY.md §5: STATS
 engine counters, latency histograms, NPKit GPU event tracing, nsys wrappers):
 ``jax.profiler`` XPlane traces plus lightweight named annotations that show up
 on the TPU timeline, and a wall-clock scope timer feeding LatencyHistograms.
+
+.. deprecated:: the host-side event layer lives in :mod:`uccl_tpu.obs`
+   (docs/OBSERVABILITY.md). ``timed_scope`` keeps its histogram contract
+   (``scope_stats``/``reset_scopes`` work unchanged) and is re-pointed at
+   the obs spine: every scope sample also lands as a span on the obs
+   tracer (when enabled), and the per-scope summaries are registered as
+   the ``scopes`` pull source on :data:`uccl_tpu.obs.REGISTRY`, so they
+   ride the ``/metrics`` + ``/snapshot`` exports. New code should use
+   ``obs.span`` directly.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, Iterator, Optional
 
 import jax
 
+from uccl_tpu.obs import counters as _obsc
+from uccl_tpu.obs import tracer as _obst
 from uccl_tpu.utils.latency import LatencyHistogram
 from uccl_tpu.utils.logging import get_logger
 
 _log = get_logger("UTIL")
 
+# scope histograms: mutated from arbitrary runtime threads, so every access
+# goes through the lock (the old get-then-setdefault pair raced two threads
+# into distinct histograms, silently dropping one side's samples)
 _scope_hists: Dict[str, LatencyHistogram] = {}
+_scope_lock = threading.Lock()
+
+
+def _scopes_source() -> Dict[str, Dict[str, float]]:
+    """Per-scope summaries for the obs registry (the ``scopes`` source)."""
+    with _scope_lock:
+        hists = dict(_scope_hists)
+    return {name: h.summary() for name, h in hists.items()}
+
+
+_obsc.REGISTRY.register_source("scopes", _scopes_source)
 
 
 def start_trace(log_dir: str) -> None:
@@ -41,24 +67,32 @@ def annotate(name: str) -> Iterator[None]:
 @contextlib.contextmanager
 def timed_scope(name: str, log: bool = False) -> Iterator[None]:
     """Wall-clock scope timer; samples land in a per-name LatencyHistogram
-    (uccl_tpu.utils.latency) retrievable via :func:`scope_stats`."""
+    (uccl_tpu.utils.latency) retrievable via :func:`scope_stats`, and as a
+    span on the obs tracer when tracing is enabled."""
+    tr = _obst.get_tracer()
+    ts0 = tr.now_us() if tr is not None else 0.0
     t0 = time.perf_counter()
     try:
         yield
     finally:
         us = (time.perf_counter() - t0) * 1e6
-        hist = _scope_hists.get(name)
-        if hist is None:
-            hist = _scope_hists.setdefault(name, LatencyHistogram())
+        with _scope_lock:
+            hist = _scope_hists.get(name)
+            if hist is None:
+                hist = _scope_hists[name] = LatencyHistogram()
         hist.record(us)
+        if tr is not None:
+            tr.complete(name, ts0, tr.now_us() - ts0)
         if log:
             _log.info("%s: %.1f us", name, us)
 
 
 def scope_stats(name: str) -> Optional[Dict[str, float]]:
-    h = _scope_hists.get(name)
+    with _scope_lock:
+        h = _scope_hists.get(name)
     return h.summary() if h else None
 
 
 def reset_scopes() -> None:
-    _scope_hists.clear()
+    with _scope_lock:
+        _scope_hists.clear()

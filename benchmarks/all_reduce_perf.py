@@ -10,14 +10,105 @@ Bus bandwidth uses the standard ring factor 2*(n-1)/n over the data size.
 Usage: python benchmarks/all_reduce_perf.py [--devices N] [--algo xla|ring|both]
 On a machine without multiple accelerators, pass --devices N to use N virtual
 CPU devices.
+
+``--wire-dtype fp8,int8`` adds the quantized-wire arms (pallas ring,
+``wire_dtype=`` — docs/QUANT_WIRE.md): per size it prints one JSON line per
+arm with the per-shard wire bytes read off the REAL
+``ep_bytes_total{verb="ring_all_reduce",...,wire_dtype}`` counter delta
+(quantized payload + scale sidecar, counted at trace time by the rings
+themselves — never mirrored arithmetic), the effective per-member wire
+bandwidth those bytes imply, the wire-byte reduction vs the full-precision
+arm, and the max-abs/rel error vs the full-precision result.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import time
 
 from _bootstrap import init_devices
+
+
+def _ring_bytes_snapshot():
+    from uccl_tpu.obs import counters as obsc
+
+    fam = obsc.counter("ep_bytes_total")
+    return {tuple(sorted(lb.items())): v for lb, v in fam.samples()
+            if lb.get("verb") == "ring_all_reduce"}
+
+
+def _ring_bytes_delta(before):
+    out = {}
+    for kk, v in _ring_bytes_snapshot().items():
+        d = v - before.get(kk, 0)
+        if d > 0:
+            out[dict(kk)["wire_dtype"]] = out.get(
+                dict(kk)["wire_dtype"], 0) + int(d)
+    return out
+
+
+def quant_sweep(jax, n, wire_dtypes, args):
+    """Quantized-wire arms: per (size, wire_dtype) one JSON line — wire
+    bytes off the counter delta around the compiling call, effective
+    per-member wire bandwidth, wire-byte reduction and error vs the
+    full-precision pallas arm."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from uccl_tpu import obs
+    from uccl_tpu.collective import Communicator
+
+    # 1-axis mesh: the legacy discharge interpreter addresses peers by flat
+    # logical id along ONE named axis — same choice as ep_bench's pallas arm
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    comm = Communicator(mesh, "dp")
+
+    size = args.min_bytes
+    while size <= args.max_bytes:
+        elems = size // 4
+        x = comm.device_put(
+            np.random.default_rng(0)
+            .standard_normal((n, elems))
+            .astype(np.float32)
+        )
+        arms = []
+        ref = None
+        ref_bytes = None
+        for wd in [None] + list(wire_dtypes):
+            before = _ring_bytes_snapshot()
+            out = comm.all_reduce(x, algo="pallas", wire_dtype=wd)
+            got = np.asarray(out)  # compile + host sync
+            wire_bytes = _ring_bytes_delta(before).get(wd or "none", 0)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = comm.all_reduce(x, algo="pallas", wire_dtype=wd)
+            np.asarray(out)
+            dt = (time.perf_counter() - t0) / args.iters
+            if wd is None:
+                ref, ref_bytes = got, wire_bytes
+                err_abs = err_rel = 0.0
+            else:
+                err_abs = float(np.abs(got - ref).max())
+                err_rel = float(err_abs / (np.abs(ref).max() + 1e-12))
+            arms.append({
+                "wire_dtype": wd or "none",
+                "time_us": round(dt * 1e6, 1),
+                "wire_bytes_per_shard": wire_bytes,
+                "wire_gbps_per_member": round(wire_bytes / dt / 1e9, 3),
+                "wire_byte_reduction": round(
+                    ref_bytes / wire_bytes, 2) if wire_bytes else None,
+                "max_abs_err": err_abs,
+                "max_rel_err": err_rel,
+            })
+        print(json.dumps({
+            "bench": "all_reduce_quant",
+            "schema_version": obs.SCHEMA_VERSION,
+            "bytes": size, "world": n,
+            "substrate": jax.default_backend(),
+            "arms": arms,
+        }))
+        size *= 4
 
 
 def main():
@@ -35,6 +126,12 @@ def main():
     ap.add_argument("--min-bytes", type=int, default=1 << 12)
     ap.add_argument("--max-bytes", type=int, default=1 << 26)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument(
+        "--wire-dtype", default="",
+        help="comma list of quantized pallas-ring arms to sweep "
+             "(e.g. 'fp8,int8'): JSON line per size with counter-derived "
+             "wire bytes, effective bandwidth, and error vs full precision",
+    )
     args = ap.parse_args()
 
     jax = init_devices(args.devices)
@@ -45,6 +142,19 @@ def main():
     from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
 
     n = len(jax.devices())
+    if args.wire_dtype:
+        # quant_sweep builds its own raw single-axis mesh (the legacy
+        # discharge interpreter can't address peers on the canonical
+        # 4-axis make_mesh mesh) — dispatch before constructing one here
+        if args.mesh2d:
+            ap.error("--wire-dtype rides the single-axis pallas ring; "
+                     "drop --mesh2d")
+        wire_dtypes = [w for w in args.wire_dtype.split(",") if w]
+        for w in wire_dtypes:
+            if w not in ("fp8", "int8"):
+                ap.error(f"unknown --wire-dtype arm {w!r} (want fp8/int8)")
+        quant_sweep(jax, n, wire_dtypes, args)
+        return
     if args.mesh2d:
         a, b = (int(v) for v in args.mesh2d.lower().split("x"))
         assert a * b == n, f"mesh {a}x{b} != {n} devices"
@@ -53,6 +163,7 @@ def main():
     else:
         mesh = make_mesh(MeshConfig(dp=n))
         comm = Communicator(mesh, "dp")
+
     if args.algo == "both":
         algos = ["xla", "ring"]
     elif args.algo == "all":

@@ -64,9 +64,30 @@ def jax_block(tree):
         np.asarray(x).reshape(-1)[:1]
 
 
+def _ep_bytes_snapshot():
+    from uccl_tpu.obs import counters as obsc
+
+    fam = obsc.counter("ep_bytes_total")
+    return {tuple(sorted(lb.items())): v for lb, v in fam.samples()}
+
+
+def _ep_bytes_delta(before):
+    return sum(
+        int(v - before.get(k, 0))
+        for k, v in _ep_bytes_snapshot().items()
+        if v > before.get(k, 0)
+    )
+
+
 def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8,
-                 wire="auto"):
-    """Time dispatch and combine separately for one config. Returns a dict."""
+                 wire="auto", wire_dtype=None, return_recv=False):
+    """Time dispatch and combine separately for one config. Returns a dict.
+
+    Per-verb wire bytes come off the REAL ``ep_bytes_total`` counter delta
+    around one call (quantized payload + scale sidecar when ``wire_dtype``
+    applies — the counter's arithmetic, never re-derived here), and
+    ``wire_gbps`` is the effective per-member wire bandwidth those bytes
+    imply at the measured latencies."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -88,7 +109,7 @@ def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8,
     experts = max(experts, n)
     experts -= experts % n
     buf = Buffer(mesh, axis, num_experts=experts, num_selected=topk,
-                 wire=wire)
+                 wire=wire, wire_dtype=wire_dtype)
 
     rng = np.random.default_rng(0)
     x = buf.device_put(
@@ -101,13 +122,23 @@ def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8,
         np.full((n, tokens, topk), 1.0 / topk, np.float32)
     )
 
+    # wire_dtype rides the Buffer default; without it the legacy --fp8 flag
+    # maps onto an explicit per-call wire_fp8 (preserving the old bench's
+    # explicit-off for the LL path, whose Buffer default is fp8-on)
+    fp8_kw = {} if wire_dtype is not None else {"wire_fp8": fp8}
     if mode == "ll":
         recv, counts, handle = buf.low_latency_dispatch(
-            x, idx, None, wts, wire_fp8=fp8
+            x, idx, None, wts, **fp8_kw
         )
+        before = _ep_bytes_snapshot()
+        buf.low_latency_dispatch(x, idx, None, wts, **fp8_kw)
+        bytes_dispatch = _ep_bytes_delta(before)
+        before = _ep_bytes_snapshot()
+        buf.low_latency_combine(recv, handle)
+        bytes_combine = _ep_bytes_delta(before)
         dt_dispatch = _time_fn(
             lambda a, b, c: buf.low_latency_dispatch(a, b, None, c,
-                                                     wire_fp8=fp8),
+                                                     **fp8_kw),
             (x, idx, wts), iters,
         )
         dt_combine = _time_fn(
@@ -115,20 +146,27 @@ def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8,
         )
         wire_rows = tokens * topk  # actual rows moved (ragged wire)
     else:
-        recv, handle = buf.dispatch(x, idx, wts, wire_fp8=fp8)
+        recv, handle = buf.dispatch(x, idx, wts, **fp8_kw)
+        before = _ep_bytes_snapshot()
+        buf.dispatch(x, idx, wts, **fp8_kw)
+        bytes_dispatch = _ep_bytes_delta(before)
+        before = _ep_bytes_snapshot()
+        buf.combine(recv, handle, **fp8_kw)
+        bytes_combine = _ep_bytes_delta(before)
         dt_dispatch = _time_fn(
-            lambda a, b, c: buf.dispatch(a, b, c, wire_fp8=fp8)[0],
+            lambda a, b, c: buf.dispatch(a, b, c, **fp8_kw)[0],
             (x, idx, wts), iters,
         )
         dt_combine = _time_fn(
-            lambda y: buf.combine(y, handle, wire_fp8=fp8), (recv,), iters
+            lambda y: buf.combine(y, handle, **fp8_kw), (recv,), iters
         )
         wire_rows = experts // n * buf.capacity(tokens) * n  # padded slots
 
-    bytes_per_row = hidden * (1 if fp8 else 4)
-    return {
+    bytes_per_row = hidden * (1 if (fp8 or wire_dtype) else 4)
+    out = {
         "mode": mode,
         "wire": wire,
+        "wire_dtype": wire_dtype or ("fp8" if fp8 else "none"),
         "experts": experts,
         "tokens": tokens,
         "hidden": hidden,
@@ -136,7 +174,69 @@ def bench_config(jax, *, tokens, hidden, experts, topk, iters, mode, fp8,
         "dispatch_us": dt_dispatch * 1e6,
         "combine_us": dt_combine * 1e6,
         "gbps": wire_rows * bytes_per_row / (dt_dispatch + dt_combine) / 1e9,
+        "wire_bytes_dispatch": bytes_dispatch,
+        "wire_bytes_combine": bytes_combine,
+        "wire_gbps": (bytes_dispatch + bytes_combine)
+        / (dt_dispatch + dt_combine) / 1e9,
     }
+    if return_recv:
+        out["_recv"] = np.asarray(recv)
+    return out
+
+
+def bench_quant_sweep(jax, *, tokens, hidden, experts, topk, iters, mode,
+                      wire, wire_dtypes):
+    """Quantized-wire EP arms: one JSON line with a full-precision anchor
+    arm plus one arm per ``wire_dtype``. Per-arm wire bytes and effective
+    bandwidth come off the REAL ``ep_bytes_total{...,wire_dtype}`` counter
+    deltas (bench_config — quantized payload + scale sidecar, never
+    mirrored arithmetic); error is max-abs/rel of the dispatch recv buffer
+    vs the full-precision arm (same routing seed, so the wire is the only
+    difference — docs/QUANT_WIRE.md)."""
+    import json
+
+    import numpy as np
+
+    from uccl_tpu import obs
+
+    arms = []
+    ref = None
+    ref_bytes = None
+    for wd in [None] + list(wire_dtypes):
+        r = bench_config(
+            jax, tokens=tokens, hidden=hidden, experts=experts, topk=topk,
+            iters=iters, mode=mode, fp8=False, wire=wire, wire_dtype=wd,
+            return_recv=True,
+        )
+        recv = r.pop("_recv")
+        wire_bytes = r["wire_bytes_dispatch"] + r["wire_bytes_combine"]
+        if wd is None:
+            ref, ref_bytes = recv, wire_bytes
+            err_abs = err_rel = 0.0
+        else:
+            err_abs = float(np.abs(recv - ref).max())
+            err_rel = float(err_abs / (np.abs(ref).max() + 1e-12))
+        arms.append({
+            "wire_dtype": wd or "none",
+            "dispatch_us": round(r["dispatch_us"], 1),
+            "combine_us": round(r["combine_us"], 1),
+            "wire_bytes_dispatch": r["wire_bytes_dispatch"],
+            "wire_bytes_combine": r["wire_bytes_combine"],
+            "wire_gbps": round(r["wire_gbps"], 3),
+            "wire_byte_reduction": round(ref_bytes / wire_bytes, 2)
+            if wire_bytes else None,
+            "max_abs_err": err_abs,
+            "max_rel_err": err_rel,
+        })
+    line = {
+        "bench": "ep_quant_sweep", "schema_version": obs.SCHEMA_VERSION,
+        "mode": mode, "wire": wire, "tokens": tokens, "hidden": hidden,
+        "experts": r["experts"], "topk": topk,
+        "substrate": jax.default_backend(),
+        "arms": arms,
+    }
+    print(json.dumps(line))
+    return line
 
 
 def bench_chunk_sweep(jax, *, tokens, hidden, ffn, experts, topk, iters,
@@ -330,6 +430,13 @@ def main():
              "dispatch+compute+combine µs and a compute-only baseline "
              "(reference: proxy-served inter-node EP, ep/src/proxy.cpp:701)",
     )
+    ap.add_argument(
+        "--wire-dtype", default="",
+        help="comma list of block-quantized wire arms to sweep beside a "
+             "full-precision anchor (e.g. 'fp8,int8'): one JSON line with "
+             "counter-derived wire bytes, effective bandwidth, wire-byte "
+             "reduction, and max-abs/rel error per arm (docs/QUANT_WIRE.md)",
+    )
     ap.add_argument("--ffn", type=int, default=256,
                     help="expert FFN width for --cross-pod and the --chunks "
                          "sweep")
@@ -375,8 +482,26 @@ def main():
             ap.error("--table and the --chunks sweep are separate modes; "
                      "pick one")
 
+    wire_dtypes = [w for w in args.wire_dtype.split(",") if w]
+    for w in wire_dtypes:
+        if w not in ("fp8", "int8"):
+            ap.error(f"unknown --wire-dtype arm {w!r} (want fp8/int8)")
+    if wire_dtypes and (args.cross_pod or args.table
+                        or chunk_list != [1]):
+        ap.error("--wire-dtype is its own sweep mode; drop "
+                 "--cross-pod/--table/--chunks")
+
     jax = init_devices(args.devices)
     n = len(jax.devices())
+
+    if wire_dtypes:
+        bench_quant_sweep(
+            jax, tokens=args.tokens, hidden=args.hidden,
+            experts=args.experts, topk=args.topk, iters=args.iters,
+            mode="ll" if args.ll else "normal", wire=args.wire,
+            wire_dtypes=wire_dtypes,
+        )
+        return
 
     if args.cross_pod:
         out = bench_cross_pod(

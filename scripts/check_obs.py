@@ -2,6 +2,7 @@
 """Validate the obs smoke arm's artifacts (qa.sh / ci.yml).
 
 Usage: python scripts/check_obs.py TRACE_JSON METRICS_PROM
+       python scripts/check_obs.py --quant METRICS_PROM WIRE_DTYPE
 
 Asserts, with a named failure for each:
 
@@ -13,6 +14,12 @@ Asserts, with a named failure for each:
   order), and engine-step + wire spans exist;
 * the metrics file is Prometheus text containing the wire-fallback and
   serving goodput series.
+
+``--quant`` mode (the quantized-wire smoke arm): the metrics file must
+export a nonzero ``ep_bytes_total{...,wire_dtype="<WIRE_DTYPE>"}`` sample
+— i.e. a quantized run's wire bytes landed on the labeled byte series the
+benches read bandwidth off (docs/QUANT_WIRE.md), not on an unlabeled or
+full-precision bucket.
 """
 
 from __future__ import annotations
@@ -90,9 +97,30 @@ def check_metrics(path: str) -> None:
     print(f"check_obs: metrics OK — {len(text.splitlines())} lines")
 
 
+def check_quant_metrics(path: str, wire_dtype: str) -> None:
+    with open(path) as f:
+        lines = f.read().splitlines()
+    label = f'wire_dtype="{wire_dtype}"'
+    hits = [ln for ln in lines
+            if ln.startswith("ep_bytes_total{") and label in ln]
+    if not hits:
+        fail(f"{path}: no ep_bytes_total sample labeled {label} — the "
+             f"quantized run's wire bytes never reached the labeled series")
+    nonzero = [ln for ln in hits if float(ln.rsplit(" ", 1)[1]) > 0]
+    if not nonzero:
+        fail(f"{path}: ep_bytes_total{{...,{label}}} present but zero")
+    print(f"check_obs: quant metrics OK — {len(nonzero)} nonzero "
+          f"{label} byte series")
+
+
 def main(argv) -> None:
+    if len(argv) == 4 and argv[1] == "--quant":
+        check_quant_metrics(argv[2], argv[3])
+        print("check_obs: ALL OK")
+        return
     if len(argv) != 3:
-        fail("usage: check_obs.py TRACE_JSON METRICS_PROM")
+        fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
+             "check_obs.py --quant METRICS_PROM WIRE_DTYPE")
     check_trace(argv[1])
     check_metrics(argv[2])
     print("check_obs: ALL OK")

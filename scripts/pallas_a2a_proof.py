@@ -50,6 +50,20 @@ def _parse_args(argv=None):
         help="execute under the TPU interpreter on a virtual CPU mesh and "
              "check vs the lax wire (CI smoke tier; no TPU needed)",
     )
+    ap.add_argument(
+        "--wire-dtype", default=None, choices=["fp8", "int8"],
+        help="also prove the block-quantized wire (docs/QUANT_WIRE.md): "
+             "quantized ring allreduce + EP roundtrip arms — interpret "
+             "mode checks pallas == lax bit-identity on the quantized "
+             "path, the documented error bound vs full precision, and "
+             "exact zeros on zero input",
+    )
+    ap.add_argument(
+        "--metrics-out", default=None,
+        help="dump the Prometheus counter registry here on exit (the "
+             "quantized smoke's ep_bytes_total{...,wire_dtype} series — "
+             "validated by scripts/check_obs.py --quant)",
+    )
     return ap.parse_args(argv)
 
 
@@ -63,11 +77,12 @@ def _setup_interpret_env():
         ).strip()
 
 
-def _lowering_proof(chunks: int) -> int:
+def _lowering_proof(chunks: int, wire_dtype=None) -> int:
     import jax
     import jax.numpy as jnp
     from jax.sharding import AbstractMesh, PartitionSpec as P
 
+    from uccl_tpu.collective import pallas_ccl
     from uccl_tpu.ep import ll as ep_ll
     from uccl_tpu.ep import ops as ep_ops
     from uccl_tpu.utils.jaxcompat import shard_map
@@ -142,6 +157,24 @@ def _lowering_proof(chunks: int) -> int:
           S((W,), i32)),
          (P(),) * 7, P()),
     ]
+    if wire_dtype:
+        # quantized-wire lowerings: the EP dispatch with the generic
+        # wire_dtype knob and the quantized ring allreduce kernel (RS-q +
+        # quantize-once AG in one pallas_call)
+        def _dispatch_q(x, idx):
+            plan = ep_ops.plan_slots(idx, E, CAP)
+            return ep_ops.dispatch_sorted(x, plan, E, CAP, "x",
+                                          wire="pallas",
+                                          wire_dtype=wire_dtype)
+
+        cases += [
+            (f"dispatch_{wire_dtype}_wire", _dispatch_q,
+             (S((T, H), jnp.bfloat16), S((T, K), i32)), (P(), P()), P()),
+            (f"ring_ar_{wire_dtype}",
+             lambda x: pallas_ccl.ring_all_reduce(x, "x",
+                                                  wire_dtype=wire_dtype),
+             (S((T, H), jnp.bfloat16),), (P(),), P()),
+        ]
     if chunks > 1:
         cases += [
             (f"dispatch_chunked{chunks}", _dispatch(chunks),
@@ -272,13 +305,109 @@ def _interpret_smoke(chunks: int) -> int:
     return failed
 
 
+def _interpret_quant_smoke(chunks: int, wire_dtype: str) -> int:
+    """Quantized-wire smoke (--wire-dtype): the pallas ring allreduce and
+    the sorted EP roundtrip at worlds 4 and 5, asserting (1) the quantized
+    pallas path is bit-identical to the quantized lax path (same shared
+    codec either wire), (2) error vs full precision sits inside the
+    documented per-hop bound (docs/QUANT_WIRE.md), (3) an all-zero payload
+    round-trips to EXACT zeros (the codec's scale-guard contract)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import uccl_tpu.utils.jaxcompat  # noqa: F401 (installs polyfills)
+    from uccl_tpu.collective import pallas_ccl
+    from uccl_tpu.ep import ops as ep_ops
+    from uccl_tpu.utils.jaxcompat import shard_map
+
+    devs = jax.devices()
+    rng = np.random.default_rng(0)
+    depths = sorted({1, max(1, chunks)})
+    failed = 0
+    # two quantize round trips (dispatch + combine, or RS hops + AG) of
+    # rel error: half-ulp/QMAX per trip, with headroom for summation
+    rel_bound = {"fp8": 0.12, "int8": 0.02}[wire_dtype]
+
+    def case(name, ok):
+        nonlocal failed
+        print(f"pallas_a2a_proof[interpret,{wire_dtype}] {name}: "
+              f"{'OK' if ok else 'MISMATCH'}")
+        failed += 0 if ok else 1
+
+    for n in (4, 5):
+        mesh = Mesh(np.array(devs[:n]), ("x",))
+
+        def run(fn, *args, n_in=None):
+            n_in = len(args) if n_in is None else n_in
+            return np.asarray(jax.jit(shard_map(
+                fn, mesh, tuple(P("x") for _ in range(n_in)), P("x"),
+                check_vma=False,
+            ))(*args))
+
+        # -- quantized ring allreduce ---------------------------------
+        x = jnp.asarray(rng.normal(size=(n, 3, 200)), jnp.float32)
+
+        def ar(v, wd=None):
+            return pallas_ccl.ring_all_reduce(
+                v[0], "x", wire_dtype=wd)[None]
+
+        want = run(lambda v: ar(v), x)
+        got = run(lambda v: ar(v, wire_dtype), x)
+        err = np.abs(got - want).max() / (np.abs(want).max() + 1e-12)
+        case(f"ring_ar_w{n}_err({err:.2e})", bool(err < rel_bound))
+        zero = run(lambda v: ar(v, wire_dtype),
+                   jnp.zeros((n, 3, 200), jnp.float32))
+        case(f"ring_ar_w{n}_zero_exact", bool((zero == 0.0).all()))
+
+        # -- quantized sorted EP roundtrip ----------------------------
+        t, h, e, k = 8, 64, 2 * n, 2
+        cap = max(1, int(1.25 * t * k / e))
+        xs = jnp.asarray(rng.standard_normal((n, t, h)), jnp.float32)
+        idx = jnp.asarray(rng.integers(0, e, (n, t, k)), jnp.int32)
+        wts = jnp.asarray(rng.uniform(0.1, 1.0, (n, t, k)), jnp.float32)
+
+        def sorted_path(wire, nc, wd):
+            def f(xv, iv, wv):
+                plan = ep_ops.plan_slots(iv[0], e, cap)
+                recv = ep_ops.dispatch_sorted(
+                    xv[0], plan, e, cap, "x", wire=wire, n_chunks=nc,
+                    wire_dtype=wd,
+                )
+                return ep_ops.combine_sorted(
+                    recv, plan, wv[0], "x", wire=wire, n_chunks=nc,
+                    wire_dtype=wd,
+                )[None]
+
+            return run(f, xs, idx, wts)
+
+        ref = sorted_path("lax", 1, None)
+        lax_q = sorted_path("lax", 1, wire_dtype)
+        err = np.abs(lax_q - ref).max() / (np.abs(ref).max() + 1e-12)
+        case(f"sorted_w{n}_err({err:.2e})", bool(err < rel_bound))
+        for nc in depths:
+            case(f"sorted_w{n}_c{nc}_pallas_eq_lax",
+                 bool((sorted_path("pallas", nc, wire_dtype)
+                       == lax_q).all()))
+    return failed
+
+
 def main():
     args = _parse_args()
     if args.interpret:
         _setup_interpret_env()
-        failed = _interpret_smoke(args.chunks)
+        if args.wire_dtype:
+            failed = _interpret_quant_smoke(args.chunks, args.wire_dtype)
+        else:
+            failed = _interpret_smoke(args.chunks)
     else:
-        failed = _lowering_proof(args.chunks)
+        failed = _lowering_proof(args.chunks, args.wire_dtype)
+    if args.metrics_out:
+        from uccl_tpu import obs
+
+        obs.write_metrics(args.metrics_out)
+        print(f"pallas_a2a_proof: metrics -> {args.metrics_out}")
     sys.exit(1 if failed else 0)
 
 

@@ -15,6 +15,11 @@ note "pallas kernel smoke tier (interpret-mode, fail-fast: a2a proof --chunks 2 
 timeout 300 python scripts/pallas_a2a_proof.py --interpret --chunks 2; check $?
 timeout 900 python -m pytest tests/test_pallas_a2a.py tests/test_pallas_ccl.py -q; check $?
 
+note "quantized-wire smoke tier (interpret-mode fp8 arms: ring allreduce + EP roundtrip error-bounded, pallas == lax bit-identity, wire_dtype-labeled byte series exported)"
+timeout 300 python scripts/pallas_a2a_proof.py --interpret --wire-dtype fp8 \
+  --metrics-out /tmp/qa_quant_metrics.prom; check $?
+python scripts/check_obs.py --quant /tmp/qa_quant_metrics.prom fp8; check $?
+
 note "serving engine smoke tier (fail-fast: 2 slots, 6 mixed-length requests, oracle match + no leaked slots)"
 JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
   --requests 6 --prompt-len 8 --new-tokens 4 --arrival-rate 50 --check-oracle; check $?

@@ -1,10 +1,22 @@
-"""FP8 wire-quantization roundtrip accuracy."""
+"""Block-scale wire codec: fp8/int8 round trips, scale guards, and the
+legacy-fp8 bit-equality regression (docs/QUANT_WIRE.md)."""
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from uccl_tpu.ops.quant import dequantize_fp8, quantize_fp8
+from uccl_tpu.ops.quant import (
+    FP8_DTYPE,
+    FP8_MAX,
+    INT8_MAX,
+    adapt_block,
+    dequantize_block,
+    dequantize_fp8,
+    paying_block,
+    quantize_block,
+    quantize_fp8,
+    resolve_wire_dtype,
+)
 
 
 def test_roundtrip_accuracy(rng):
@@ -37,6 +49,170 @@ def test_zero_input():
 def test_bad_group():
     with pytest.raises(ValueError):
         quantize_fp8(jnp.zeros((2, 100)), group_size=128)
+
+
+class TestBlockCodec:
+    """The generic fp8/int8 block-scale codec every wire shares."""
+
+    # fp8: half-ulp at 448 (16) + half an f16 ulp of cast double-rounding
+    # (0.125 — XLA:CPU lowers the e4m3 cast through f16); int8: half a
+    # step of amax/127. The module-docstring error model, verbatim.
+    @pytest.mark.parametrize("wd,qerr", [("fp8", 448 / 16.125),
+                                         ("int8", 254.0)])
+    @pytest.mark.parametrize("shape,block", [
+        ((4, 256), 128),   # dividing
+        ((3, 300), 128),   # non-dividing trailing block (pad path)
+        ((2, 3, 7), 4),    # small odd dims
+        ((1, 5), 128),     # block > dim
+    ])
+    def test_roundtrip_within_documented_bound(self, rng, wd, qerr, shape,
+                                               block):
+        """One quantize→dequantize round trip obeys |err| <= amax/QERR per
+        block — the documented per-hop unit the wire designs budget in."""
+        x = (rng.standard_normal(shape) * 3).astype(np.float32)
+        q, scale = quantize_block(jnp.asarray(x), wd, block)
+        assert q.shape == x.shape
+        assert scale.shape == x.shape[:-1] + (-(-x.shape[-1] // block),)
+        back = np.asarray(
+            dequantize_block(q, scale, block, dtype=jnp.float32)
+        )
+        d = x.shape[-1]
+        nb = -(-d // block)
+        pad = nb * block - d
+        xp = np.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+        g = xp.reshape(x.shape[:-1] + (nb, block))
+        amax = np.abs(g).max(-1)
+        err = np.abs(back - x)
+        ep = np.pad(err, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+        per_block = ep.reshape(x.shape[:-1] + (nb, block)).max(-1)
+        assert (per_block <= amax / qerr + 1e-7).all()
+
+    def test_int8_payload_contract(self, rng):
+        x = rng.standard_normal((4, 128)).astype(np.float32)
+        q, scale = quantize_block(jnp.asarray(x), "int8", 64)
+        assert q.dtype == jnp.int8
+        qn = np.asarray(q)
+        assert qn.min() >= -127 and qn.max() <= 127  # symmetric: -128 unused
+        # the per-block amax element must land on +/-QMAX exactly
+        g = np.asarray(x).reshape(4, 2, 64)
+        hit = np.abs(qn.reshape(4, 2, 64))[
+            np.abs(g) == np.abs(g).max(-1, keepdims=True)
+        ]
+        assert (hit == 127).all()
+
+    def test_padding_never_raises_real_scale(self, rng):
+        """The zero-padded tail of a non-dividing trailing block cannot
+        change the scale of the real data in that block."""
+        x = rng.standard_normal((2, 130)).astype(np.float32)
+        _, s_padded = quantize_block(jnp.asarray(x), "fp8", 128)
+        # trailing block holds 2 real elements; its scale must equal the
+        # amax of exactly those two
+        want = np.abs(x[:, 128:]).max(-1) / FP8_MAX
+        np.testing.assert_allclose(np.asarray(s_padded)[:, 1], want,
+                                   rtol=1e-6)
+
+    def test_zero_block_roundtrips_exact(self):
+        """Exact-zero blocks take scale 1.0 and round-trip to EXACT zeros
+        (the guard satellite: no inf/nan from a zero amax)."""
+        x = jnp.zeros((3, 256), jnp.float32)
+        for wd in ("fp8", "int8"):
+            q, scale = quantize_block(x, wd, 128)
+            np.testing.assert_array_equal(np.asarray(scale), 1.0)
+            back = np.asarray(dequantize_block(q, scale, 128,
+                                               dtype=jnp.float32))
+            assert (back == 0.0).all()
+
+    def test_denormal_amax_no_inf(self):
+        """A block whose amax is denormal floors the scale at the smallest
+        normal f32 — the divide stays finite, nothing becomes inf/nan."""
+        tiny = np.float32(1e-42)  # denormal
+        x = jnp.full((1, 128), tiny, jnp.float32)
+        for wd in ("fp8", "int8"):
+            q, scale = quantize_block(x, wd, 128)
+            back = np.asarray(dequantize_block(q, scale, 128,
+                                               dtype=jnp.float32))
+            assert np.isfinite(back).all()
+
+    def test_dequantize_guards_garbage_scales(self):
+        """Zero / negative / nan / denormal wire scales dequantize their
+        block to exact zeros instead of propagating garbage (regression for
+        the zero/denormal-scale guard). A +inf scale is NOT garbage — it is
+        the quantizer's poison marker for a non-finite input block and must
+        stay loud (non-finite out, never silent zeros)."""
+        q = jnp.ones((4, 128), FP8_DTYPE)
+        for bad in (0.0, -1.0, np.nan, 1e-42):
+            scale = jnp.full((4, 1), bad, jnp.float32)
+            back = np.asarray(dequantize_block(q, scale, 128,
+                                               dtype=jnp.float32))
+            assert (back == 0.0).all(), f"scale {bad} leaked garbage"
+        scale = jnp.full((4, 1), np.inf, jnp.float32)
+        back = np.asarray(dequantize_block(q, scale, 128, dtype=jnp.float32))
+        assert not np.isfinite(back).any(), "+inf poison scale went silent"
+
+    @pytest.mark.parametrize("wd", ["fp8", "int8"])
+    @pytest.mark.parametrize("val", [np.inf, -np.inf, np.nan])
+    def test_nonfinite_block_stays_loud(self, wd, val):
+        """A block holding any inf/nan element round-trips the WHOLE block
+        non-finite (poisoned +inf scale) — one shared scale cannot carry
+        inf and its finite neighbors, and a silent zero would mask the
+        divergence a full-precision wire delivers (int8's nan→0 cast used
+        to do exactly that). Neighboring finite blocks are untouched."""
+        x = np.ones((2, 256), np.float32)
+        x[0, 3] = val  # poisons block 0 of row 0 only
+        q, scale = quantize_block(jnp.asarray(x), wd, 128)
+        back = np.asarray(dequantize_block(q, scale, 128,
+                                           dtype=jnp.float32))
+        assert not np.isfinite(back[0, :128]).any(), "divergence masked"
+        np.testing.assert_allclose(back[0, 128:], x[0, 128:], rtol=0.05)
+        np.testing.assert_allclose(back[1], x[1], rtol=0.05)
+
+    def test_resolve_and_knob_helpers(self):
+        assert resolve_wire_dtype(None) is None
+        assert resolve_wire_dtype("none") is None
+        assert resolve_wire_dtype("fp8") == "fp8"
+        assert resolve_wire_dtype("int8") == "int8"
+        with pytest.raises(ValueError, match="unknown wire_dtype"):
+            resolve_wire_dtype("fp4")
+        assert adapt_block(256, 128) == 128
+        assert adapt_block(300, 128) == 100  # largest divisor <= 128
+        assert paying_block(256, 128) == 128
+        assert paying_block(7, 128) is None  # only blocks < 8 divide
+
+    def test_legacy_fp8_bit_equal_to_old_rule(self, rng):
+        """The shared codec behind quantize_fp8/dequantize_fp8 must stay
+        bit-equal to PR 1's private rule on its original contract —
+        dividing group, per-block amax >= 1e-12 (the old rule's scale
+        floor; below it the old rule collapsed blocks to q ≈ 0 while the
+        codec keeps them representable, so wire bits legitimately differ)
+        — the LL wire format cannot drift (dedupe satellite)."""
+        x = (rng.standard_normal((4, 16, 256)) * 5).astype(np.float32)
+        x[0, 0, :128] = 0.0  # a zero block: outputs must still agree
+        x[0, 1, :128] = 1e-12  # the old floor boundary itself
+        x[0, 2, :128] = 3.4e38  # near-f32-max amax
+
+        def old_quantize(xv, group):
+            *lead, d = xv.shape
+            g = xv.reshape(*lead, d // group, group).astype(jnp.float32)
+            amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+            scale = jnp.maximum(amax, 1e-12) / FP8_MAX
+            return (g / scale).astype(FP8_DTYPE).reshape(*lead, d), \
+                scale[..., 0]
+
+        def old_dequantize(qv, scale, group, dtype):
+            *lead, d = qv.shape
+            g = qv.reshape(*lead, d // group, group).astype(jnp.float32)
+            return (g * scale[..., None]).reshape(*lead, d).astype(dtype)
+
+        xj = jnp.asarray(x)
+        q_new, s_new = quantize_fp8(xj, 128)
+        q_old, s_old = old_quantize(xj, 128)
+        np.testing.assert_array_equal(
+            np.asarray(q_new).view(np.uint8), np.asarray(q_old).view(np.uint8)
+        )
+        back_new = np.asarray(dequantize_fp8(q_new, s_new, 128,
+                                             dtype=jnp.float32))
+        back_old = np.asarray(old_dequantize(q_old, s_old, 128, jnp.float32))
+        np.testing.assert_array_equal(back_new, back_old)
 
 
 class TestWireCompress:

@@ -97,7 +97,8 @@ class Communicator:
     # -- collectives -------------------------------------------------------
 
     def all_reduce(
-        self, x: jax.Array, op: str = ReduceOp.SUM, algo: str = "xla"
+        self, x: jax.Array, op: str = ReduceOp.SUM, algo: str = "xla",
+        wire_dtype=None,
     ) -> jax.Array:
         """out[i] = reduce_j x[j] for every rank i.
 
@@ -114,8 +115,17 @@ class Communicator:
         ``algo="auto"`` asks :func:`~uccl_tpu.collective.plan.
         select_all_reduce_algo` (size/world/topology policy, env-overridable
         via UCCL_TPU_AR_ALGO).
+
+        ``wire_dtype="fp8"|"int8"`` (pallas algo only) block-quantizes the
+        wire payloads — per-hop quantized reduce-scatter with
+        input-precision accumulation plus a quantize-once all-gather
+        (docs/QUANT_WIRE.md error model).
         """
         self._check(x)
+        if wire_dtype is not None and algo != "pallas":
+            raise ValueError(
+                "wire_dtype quantization rides the pallas allreduce only"
+            )
         ax = self._axis_name()
         if algo == "auto":
             if op != ReduceOp.SUM:
@@ -129,7 +139,7 @@ class Communicator:
                 )
         if algo not in ("xla", "ring", "hd", "torus", "pallas"):
             raise ValueError(f"unknown all_reduce algo {algo!r}")
-        key = ("ar", op, algo, x.shape, x.dtype)
+        key = ("ar", op, algo, x.shape, x.dtype, wire_dtype)
 
         def build():
             def f(v):
@@ -144,7 +154,7 @@ class Communicator:
                         ring_all_reduce as pallas_ar,
                     )
 
-                    return pallas_ar(v, ax)
+                    return pallas_ar(v, ax, wire_dtype=wire_dtype)
                 if algo in ("ring", "hd"):
                     if op != ReduceOp.SUM:
                         raise ValueError(f"{algo} allreduce supports sum only")

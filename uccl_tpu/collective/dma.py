@@ -164,6 +164,32 @@ def chunk_budget(world: int, chunk_elems_per_peer: int, itemsize: int,
                         what, interpret, quiet=quiet)
 
 
+def scale_rows(rows: int) -> int:
+    """Rows of the packed per-row scale buffer a quantized-wire kernel
+    DMAs beside its payload: one f32 scale per 128-lane payload row
+    (the rings' block rule), packed LANES scales per buffer row —
+    ``ceil(rows / LANES)``."""
+    return -(-rows // LANES)
+
+
+def pack_row_scales(s: jax.Array, srows: int) -> jax.Array:
+    """[..., rows] per-row f32 scales → the [..., srows, LANES] wire buffer
+    (zero-padded tail; a zero scale dequantizes padding to exact zeros —
+    ops.quant's guard). Pure layout: values are untouched, so kernel and
+    lax-mirror stay bit-identical through a pack/unpack round trip."""
+    *lead, rows = s.shape
+    pad = srows * LANES - rows
+    if pad:
+        s = jnp.pad(s, [(0, 0)] * len(lead) + [(0, pad)])
+    return s.reshape(*lead, srows, LANES)
+
+
+def unpack_row_scales(sp: jax.Array, rows: int) -> jax.Array:
+    """Inverse of :func:`pack_row_scales`: [..., srows, LANES] → [..., rows]."""
+    *lead, srows, lanes = sp.shape
+    return sp.reshape(*lead, srows * lanes)[..., :rows]
+
+
 def pad_chunks(flat: jax.Array, parts: int) -> Tuple[jax.Array, int, int]:
     """Split ``flat`` into ``parts`` equal chunks of k elements (tail
     zero-padded), then pad EACH chunk to m (a CHUNK_QUANTUM multiple) — the

@@ -36,6 +36,33 @@ Synchronization design (the part that must be right):
   (``semaphore_signal`` of the sender's ack semaphore) after consuming a
   slot; senders wait for a credit from step 2 on (two slots start free).
   Signals and waits are balanced so every semaphore drains to zero.
+
+Quantized wire (``wire_dtype="fp8"|"int8"`` — the EQuARX move, PAPERS.md:
+quantize AllReduce payloads on the wire for ~2-4x fewer bytes with bounded
+loss impact):
+
+* every hop moves a block-scaled payload (one f32 scale per 128-lane row,
+  the shared :mod:`uccl_tpu.ops.quant` codec) plus its scale sidecar on the
+  ``collective_id + CID_SCALE_OFFSET`` lane;
+* **reduce-scatter quantizes in the send path and dequantizes in the recv
+  path BEFORE accumulating in the input precision** — partial sums are
+  never stored in wire precision, so the error is one quantize round trip
+  per hop (additive over the n-1 hops), never compounding;
+* all-gather payloads are quantized ONCE and forwarded verbatim (write-once
+  slots make forwarding exact), so every member pays exactly one round trip;
+* the budget/addressability fallbacks ride a **bit-identical pure-lax
+  mirror** of the same per-hop math (same codec calls, same slot
+  arithmetic), counted on ``ep_wire_fallback_total`` like every transparent
+  downgrade — a quantized collective is never silently full-precision and
+  never silently off the kernel path. Non-float payloads downgrade to the
+  full-precision wire with reason ``quant_dtype``.
+
+Wire bytes are tallied at TRACE time (once per compiled program, the same
+per-compile semantics as ``dma.record_fallback``) on the shared
+``ep_bytes_total{verb,wire,wire_dtype}`` counter: per-shard bytes actually
+sent over the wire for one call — quantized payload + scale sidecar, not
+logical element bytes — so benches read effective bus bandwidth straight
+off counter deltas (docs/QUANT_WIRE.md).
 """
 
 from __future__ import annotations
@@ -47,6 +74,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from uccl_tpu.collective import dma as _dma
+from uccl_tpu.obs import counters as _obsc
+from uccl_tpu.ops import quant as _quant
+from uccl_tpu.utils.topology import ppermute_pairs
 
 # Shared substrate (uccl_tpu.collective.dma) — also used by the EP
 # all-to-all kernels (uccl_tpu.ep.pallas_a2a). The underscored aliases keep
@@ -63,6 +93,61 @@ _interp = _dma.interp
 _neighbors = _dma.neighbors
 _mesh_id = _dma.mesh_id
 _barrier = _dma.ring_barrier
+
+# the same family ep.buffer's verbs count on — get-or-create by name
+# returns the one shared registry family
+_WIRE_BYTES = _obsc.counter(
+    "ep_bytes_total",
+    "actual wire bytes moved by EP verbs and ring collectives (quantized "
+    "payload + f32 scale sidecar when a wire_dtype applies, raw element "
+    "bytes otherwise), by verb, wire, and wire_dtype",
+)
+
+
+def _count_wire_bytes(verb: str, wire: str, wire_dtype, nbytes: int) -> None:
+    """Tally one call's per-shard wire bytes at trace time (per-compile
+    semantics — a jit cache hit re-runs the traced exchange without
+    re-counting; benches diff around a compiling call)."""
+    _WIRE_BYTES.inc(nbytes, verb=verb, wire=wire,
+                    wire_dtype=wire_dtype or "none")
+
+
+def _ring_wire_dtype(x: jax.Array, wire_dtype, what: str):
+    """Validate a ring's wire_dtype and downgrade non-float payloads to the
+    full-precision wire — counted, never silent."""
+    wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
+    if wire_dtype is not None and not jnp.issubdtype(
+        jnp.dtype(x.dtype), jnp.floating
+    ):
+        _dma.record_fallback(
+            what, "quant_dtype", detail=jnp.dtype(x.dtype).name,
+            msg=f"pallas {what}: wire_dtype={wire_dtype!r} needs a float "
+                f"payload, got {jnp.dtype(x.dtype).name}; shipping full "
+                "precision",
+        )
+        return None
+    return wire_dtype
+
+
+def _hop_wire_bytes(m: int, itemsize: int, wire_dtype) -> int:
+    """Bytes ONE ring hop of an m-element chunk moves: raw payload, or the
+    1-byte quantized payload + packed f32 row-scale sidecar."""
+    if wire_dtype is None:
+        return m * itemsize
+    srows = _dma.scale_rows(m // _LANES)
+    return m + srows * _LANES * 4
+
+
+def _quantize_rows(chunk, wire_dtype):
+    """Per-row block quantization of a [..., rows, LANES] chunk — the rings'
+    block rule (block = one 128-lane row). Returns (q same shape, scales
+    [..., rows, 1] f32) via the shared codec."""
+    return _quant.quantize_block(chunk, wire_dtype, _LANES)
+
+
+def _dequantize_rows(q, scales, dtype):
+    """Inverse of :func:`_quantize_rows` (scales [..., rows, 1])."""
+    return _quant.dequantize_block(q, scales, _LANES, dtype)
 
 
 def _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem,
@@ -174,6 +259,84 @@ def _rs_phase(axis, n, dirs, buf_ref, stage_ref, send_sem, recv_sem,
     lax.fori_loop(0, n - 1, step, 0)
 
 
+def _rs_phase_q(axis, n, dirs, buf_ref, qsend_ref, ssend_ref, qstage_ref,
+                sstage_ref, send_sem, recv_sem, ssend_sem, srecv_sem,
+                ack_sem, faithful, wire_dtype, rows, srows, dtype):
+    """The quantized-wire reduce-scatter phase: identical slot/credit
+    schedule to :func:`_rs_phase`, but each hop's send path quantizes the
+    partial sum into a wire-dtype scratch + packed row scales (TWO remote
+    DMAs per hop per stream — payload and scale sidecar, no data dependency
+    between them) and the recv path dequantizes BEFORE accumulating into
+    ``buf_ref`` in the input precision. Partial sums never live in wire
+    precision (the EQuARX error-bounding rule): the error is one quantize
+    round trip per hop. The payload and scale staging slots of a step are
+    consumed together, so ONE ack credit per stream gates both — the
+    credit-window arithmetic is untouched."""
+    nbrs = [_neighbors(axis, n, d) for d in dirs]
+
+    def step(s, _):
+        descs = []
+        for h, d in enumerate(dirs):
+            r, right, _left = nbrs[h]
+            send_slot = lax.rem(r - d * (s + 1) + (s + 1) * n + n, n)
+
+            if faithful:
+
+                @pl.when(s >= 2)
+                def _(h=h):  # credit: downstream consumed staging slot s%2
+                    pltpu.semaphore_wait(ack_sem.at[h], 1)
+
+            # quantize the send path: wire payload + packed row scales
+            q, sc = _quantize_rows(buf_ref[send_slot, h], wire_dtype)
+            qsend_ref[h] = q
+            ssend_ref[h] = _dma.pack_row_scales(sc[..., 0], srows)
+            sl = lax.rem(s, 2)
+            rq = pltpu.make_async_remote_copy(
+                src_ref=qsend_ref.at[h],
+                dst_ref=qstage_ref.at[h, sl],
+                send_sem=send_sem.at[h, sl],
+                recv_sem=recv_sem.at[h, sl],
+                **_dma.remote_kwargs(axis, right, faithful),
+            )
+            rs_ = pltpu.make_async_remote_copy(
+                src_ref=ssend_ref.at[h],
+                dst_ref=sstage_ref.at[h, sl],
+                send_sem=ssend_sem.at[h, sl],
+                recv_sem=srecv_sem.at[h, sl],
+                **_dma.remote_kwargs(axis, right, faithful),
+            )
+            rq.start()
+            rs_.start()
+            descs.append((rq, rs_))
+        sl = lax.rem(s, 2)
+        for h, d in enumerate(dirs):
+            r, _right, left = nbrs[h]
+            recv_slot = lax.rem(r - d * (s + 2) + (s + 2) * n + n, n)
+            rq, rs_ = descs[h]
+            rq.wait_recv()
+            rs_.wait_recv()
+            # dequantize, THEN accumulate in the input precision
+            sc = _dma.unpack_row_scales(sstage_ref[h, sl], rows)
+            deq = _dequantize_rows(qstage_ref[h, sl], sc[..., None], dtype)
+            buf_ref[recv_slot, h] = buf_ref[recv_slot, h] + deq
+
+            if faithful:
+
+                @pl.when(s <= n - 4)
+                def _(h=h, left=left):  # staging consumed — grant step s+2
+                    pltpu.semaphore_signal(
+                        ack_sem.at[h], inc=1,
+                        **_dma.remote_kwargs(axis, left, faithful),
+                    )
+
+        for rq, rs_ in descs:
+            rq.wait_send()
+            rs_.wait_send()
+        return 0
+
+    lax.fori_loop(0, n - 1, step, 0)
+
+
 def _scratch(n_streams, rows, dtype, with_staging):
     shapes = [
         pltpu.SemaphoreType.DMA((n_streams, 2)),  # send
@@ -187,28 +350,94 @@ def _scratch(n_streams, rows, dtype, with_staging):
     return shapes
 
 
+def _quant_scratch(n_streams, rows, srows, wire_dtype):
+    """Wire scratch + semaphores of the quantized RS phase: send/stage pairs
+    for the payload (wire dtype) and the packed row scales (f32), payload
+    DMA sems, scale DMA sems, and the shared ack credits."""
+    wdt = _quant.wire_payload_dtype(wire_dtype)
+    return [
+        pltpu.VMEM((n_streams, rows, _LANES), wdt),  # qsend
+        pltpu.VMEM((n_streams, srows, _LANES), jnp.float32),  # ssend
+        pltpu.VMEM((n_streams, 2, rows, _LANES), wdt),  # qstage
+        pltpu.VMEM((n_streams, 2, srows, _LANES), jnp.float32),  # sstage
+        pltpu.SemaphoreType.DMA((n_streams, 2)),  # payload send
+        pltpu.SemaphoreType.DMA((n_streams, 2)),  # payload recv
+        pltpu.SemaphoreType.DMA((n_streams, 2)),  # scale send
+        pltpu.SemaphoreType.DMA((n_streams, 2)),  # scale recv
+        pltpu.SemaphoreType.REGULAR((n_streams,)),  # ack credits (shared)
+    ]
+
+
 _check_budget = _dma.check_budget
 
 
-def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
-                    interpret=None, collective_id: int = 0) -> jax.Array:
-    """Per-shard ``[k, ...] -> [n*k, ...]`` ring all-gather as one Pallas
-    kernel (n-1 neighbor DMA hops). Falls back to the plan lowering when the
-    gathered buffer exceeds the VMEM budget."""
-    n = lax.axis_size(axis)
-    if n == 1:
-        return x
-    interpret = _resolve_interpret(interpret)
-    if not _check_budget(n * x.size * x.dtype.itemsize, "all_gather",
-                         interpret):
-        from uccl_tpu.collective import plan
+# ---------------------------------------------------------------------------
+# Pure-lax mirrors of the quantized schedules. These are the budget /
+# addressability fallbacks of the quantized entries and MUST stay
+# bit-identical to the kernels: same codec calls (uccl_tpu.ops.quant), same
+# slot arithmetic (plan.py offsets), same accumulate-in-input-precision
+# order. tests/test_quant_wire.py pins kernel == mirror exactly.
 
-        return plan.ring_all_gather(x, axis)
-    k = x.shape[0]
-    flat = x.reshape(-1)
-    chunk, _, m = _pad_chunks(flat, 1)  # [1, rows, 128]
-    rows = m // _LANES
-    faithful = _dma.faithful_sync(interpret)
+
+def _mirror_rs_hops(buf, axis, n, d, wire_dtype, dtype):
+    """n-1 quantized reduce-scatter hops on ``buf`` [n, rows, LANES]:
+    send_off −(s+1), recv_off −(s+2) (plan.plan_reduce_scatter), each hop
+    quantize→ppermute(payload, scales)→dequantize→accumulate."""
+    pairs = ppermute_pairs(n, d)
+    r = lax.axis_index(axis)
+    for s in range(n - 1):
+        send_slot = jnp.mod(r - d * (s + 1), n)
+        recv_slot = jnp.mod(r - d * (s + 2), n)
+        chunk = lax.dynamic_index_in_dim(buf, send_slot, 0, keepdims=False)
+        q, sc = _quantize_rows(chunk, wire_dtype)
+        qg = lax.ppermute(q, axis, pairs)
+        sg = lax.ppermute(sc, axis, pairs)
+        deq = _dequantize_rows(qg, sg, dtype)
+        cur = lax.dynamic_index_in_dim(buf, recv_slot, 0, keepdims=False)
+        buf = lax.dynamic_update_index_in_dim(buf, cur + deq, recv_slot, 0)
+    return buf
+
+
+def _mirror_ag_hops(buf, axis, n, d):
+    """n-1 verbatim all-gather hops on ``buf`` [n, ...] (send_off −s,
+    recv_off −(s+1), plan.plan_all_gather) — payload dtype untouched, so a
+    quantized buffer is forwarded exactly like the kernel's write-once
+    slots."""
+    pairs = ppermute_pairs(n, d)
+    r = lax.axis_index(axis)
+    for s in range(n - 1):
+        send_slot = jnp.mod(r - d * s, n)
+        recv_slot = jnp.mod(r - d * (s + 1), n)
+        chunk = lax.dynamic_index_in_dim(buf, send_slot, 0, keepdims=False)
+        got = lax.ppermute(chunk, axis, pairs)
+        buf = lax.dynamic_update_index_in_dim(buf, got, recv_slot, 0)
+    return buf
+
+
+def _mirror_quant_ar_stream(buf, axis, n, d, wire_dtype, dtype):
+    """One stream of the quantized allreduce in pure lax: quantized RS hops
+    (input-precision accumulator), quantize the reduced slot ONCE, verbatim
+    AG of payload + scales, dequantize every slot. buf: [n, rows, LANES]."""
+    buf = _mirror_rs_hops(buf, axis, n, d, wire_dtype, dtype)
+    r = lax.axis_index(axis)
+    mine = lax.dynamic_index_in_dim(buf, r, 0, keepdims=False)
+    q, sc = _quantize_rows(mine, wire_dtype)
+    qbuf = jnp.zeros((n,) + q.shape, q.dtype)
+    qbuf = lax.dynamic_update_index_in_dim(qbuf, q, r, 0)
+    sbuf = jnp.zeros((n,) + sc.shape, sc.dtype)
+    sbuf = lax.dynamic_update_index_in_dim(sbuf, sc, r, 0)
+    qbuf = _mirror_ag_hops(qbuf, axis, n, d)
+    sbuf = _mirror_ag_hops(sbuf, axis, n, d)
+    return _dequantize_rows(qbuf, sbuf, dtype)
+
+
+def _ag_ring(chunk, axis, n, *, direction, interpret, faithful,
+             collective_id):
+    """One write-once all-gather ring kernel on a [1, rows, LANES] chunk of
+    any dtype → [n, 1, rows, LANES]. The payload core of ring_all_gather,
+    reused verbatim for the quantized wire's payload and scale exchanges
+    (forwarding is dtype-agnostic)."""
+    rows = chunk.shape[1]
 
     def kernel(x_ref, buf_ref, send_sem, recv_sem, ack_sem):
         r, right, left = _neighbors(axis, n, direction)
@@ -218,23 +447,93 @@ def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
         _ag_phase(axis, n, (direction,), buf_ref, send_sem, recv_sem,
                   ack_sem, faithful)
 
-    buf = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n, 1, rows, _LANES), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, 1, rows, _LANES), chunk.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=_scratch(1, rows, x.dtype, with_staging=False),
+        scratch_shapes=_scratch(1, rows, chunk.dtype, with_staging=False),
         compiler_params=_dma.compiler_params(collective_id),
         interpret=_interp(interpret),
     )(chunk)
-    out = buf.reshape(n, m)[:, : flat.size]
+
+
+def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
+                    interpret=None, collective_id: int = 0,
+                    wire_dtype=None) -> jax.Array:
+    """Per-shard ``[k, ...] -> [n*k, ...]`` ring all-gather as one Pallas
+    kernel (n-1 neighbor DMA hops). Falls back to the plan lowering when the
+    gathered buffer exceeds the VMEM budget.
+
+    ``wire_dtype``: quantize the payload once (shared block codec, one f32
+    scale per 128-lane row) and circulate payload + scale sidecar — every
+    member dequantizes the same wire bytes, so the result is identical on
+    all members and one quantize round trip from the input."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    interpret = _resolve_interpret(interpret)
+    wire_dtype = _ring_wire_dtype(x, wire_dtype, "all_gather")
+    k = x.shape[0]
+    flat = x.reshape(-1)
+    chunk, _, m = _pad_chunks(flat, 1)  # [1, rows, 128]
+    rows = m // _LANES
+    faithful = _dma.faithful_sync(interpret)
+    itemsize = x.dtype.itemsize
+    hop_bytes = _hop_wire_bytes(m, itemsize, wire_dtype)
+
+    if wire_dtype is None:
+        if not _check_budget(n * x.size * itemsize, "all_gather",
+                             interpret):
+            from uccl_tpu.collective import plan
+
+            _count_wire_bytes("ring_all_gather", "lax", None,
+                              (n - 1) * hop_bytes)
+            return plan.ring_all_gather(x, axis)
+        _count_wire_bytes("ring_all_gather", "pallas", None,
+                          (n - 1) * hop_bytes)
+        buf = _ag_ring(chunk, axis, n, direction=direction,
+                       interpret=interpret, faithful=faithful,
+                       collective_id=collective_id)
+        out = buf.reshape(n, m)[:, : flat.size]
+        return out.reshape((n * k,) + x.shape[1:])
+
+    # quantized wire: quantize ONCE, gather payload + packed scales
+    srows = _dma.scale_rows(rows)
+    q, sc = _quantize_rows(chunk, wire_dtype)  # [1,rows,128], [1,rows,1]
+    if not _check_budget(n * hop_bytes, "all_gather", interpret):
+        from uccl_tpu.collective import plan
+
+        _count_wire_bytes("ring_all_gather", "lax", wire_dtype,
+                          (n - 1) * hop_bytes)
+        qg = plan.ring_all_gather(q, axis)  # [n, rows, 128]
+        sg = plan.ring_all_gather(sc, axis)  # [n, rows, 1]
+        out = _dequantize_rows(qg, sg, x.dtype)
+    else:
+        _count_wire_bytes("ring_all_gather", "pallas", wire_dtype,
+                          (n - 1) * hop_bytes)
+        sp = _dma.pack_row_scales(sc[..., 0], srows)  # [1, srows, 128]
+        qbuf = _ag_ring(q, axis, n, direction=direction,
+                        interpret=interpret, faithful=faithful,
+                        collective_id=collective_id)
+        sbuf = _ag_ring(sp, axis, n, direction=direction,
+                        interpret=interpret, faithful=faithful,
+                        collective_id=collective_id + _dma.CID_SCALE_OFFSET)
+        scg = _dma.unpack_row_scales(sbuf, rows)  # [n, 1, rows]
+        out = _dequantize_rows(qbuf, scg[..., None], x.dtype)
+    out = out.reshape(n, m)[:, : flat.size]
     return out.reshape((n * k,) + x.shape[1:])
 
 
 def ring_reduce_scatter(x: jax.Array, axis, *, direction: int = 1,
-                        interpret=None, collective_id: int = 0) -> jax.Array:
+                        interpret=None, collective_id: int = 0,
+                        wire_dtype=None) -> jax.Array:
     """Per-shard ``[n*k, ...] -> [k, ...]``: member r keeps reduced slot r
-    (sum), matching plan.ring_reduce_scatter."""
+    (sum), matching plan.ring_reduce_scatter.
+
+    ``wire_dtype``: every hop's partial sum crosses the wire block-quantized
+    (payload + row-scale sidecar) and is dequantized before accumulating in
+    the input precision — one quantize round trip of error per hop."""
     n = lax.axis_size(axis)
     if n == 1:
         return x
@@ -243,25 +542,73 @@ def ring_reduce_scatter(x: jax.Array, axis, *, direction: int = 1,
     if x.shape[0] % n:
         raise ValueError(f"leading dim {x.shape[0]} not divisible by {n}")
     interpret = _resolve_interpret(interpret)
-    if not _check_budget(x.size * x.dtype.itemsize, "reduce_scatter",
-                         interpret):
-        from uccl_tpu.collective import plan
-
-        return plan.ring_reduce_scatter(x, axis)
+    wire_dtype = _ring_wire_dtype(x, wire_dtype, "reduce_scatter")
     k = x.shape[0] // n
     chunks, per, m = _pad_chunks(x.reshape(-1), n)  # [n, rows, 128]
     rows = m // _LANES
-    chunks = chunks.reshape(n, 1, rows, _LANES)
+    itemsize = x.dtype.itemsize
+    hop_bytes = _hop_wire_bytes(m, itemsize, wire_dtype)
     faithful = _dma.faithful_sync(interpret)
 
-    def kernel(x_ref, out_ref, buf_ref, stage_ref, send_sem, recv_sem,
-               ack_sem):
+    if wire_dtype is None:
+        if not _check_budget(x.size * itemsize, "reduce_scatter",
+                             interpret):
+            from uccl_tpu.collective import plan
+
+            _count_wire_bytes("ring_reduce_scatter", "lax", None,
+                              (n - 1) * hop_bytes)
+            return plan.ring_reduce_scatter(x, axis)
+        _count_wire_bytes("ring_reduce_scatter", "pallas", None,
+                          (n - 1) * hop_bytes)
+        chunks = chunks.reshape(n, 1, rows, _LANES)
+
+        def kernel(x_ref, out_ref, buf_ref, stage_ref, send_sem, recv_sem,
+                   ack_sem):
+            r, right, left = _neighbors(axis, n, direction)
+            if faithful:
+                _barrier(axis, left, right)
+            buf_ref[...] = x_ref[...]
+            _rs_phase(axis, n, (direction,), buf_ref, stage_ref, send_sem,
+                      recv_sem, ack_sem, faithful)
+            out_ref[...] = buf_ref[r, 0]
+
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, _LANES), x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.VMEM((n, 1, rows, _LANES), x.dtype)]
+            + _scratch(1, rows, x.dtype, with_staging=True),
+            compiler_params=_dma.compiler_params(collective_id),
+            interpret=_interp(interpret),
+        )(chunks)
+        return out.reshape(-1)[:per].reshape((k,) + x.shape[1:])
+
+    # quantized wire: accumulator stays input precision; the wire scratches
+    # (send + 2-slot staging for payload and scales) ride on top
+    srows = _dma.scale_rows(rows)
+    charge = x.size * itemsize + 3 * hop_bytes
+    if not _check_budget(charge, "reduce_scatter", interpret):
+        _count_wire_bytes("ring_reduce_scatter", "lax", wire_dtype,
+                          (n - 1) * hop_bytes)
+        buf = _mirror_rs_hops(chunks, axis, n, direction, wire_dtype,
+                              x.dtype)
+        r = lax.axis_index(axis)
+        out = lax.dynamic_index_in_dim(buf, r, 0, keepdims=False)
+        return out.reshape(-1)[:per].reshape((k,) + x.shape[1:])
+    _count_wire_bytes("ring_reduce_scatter", "pallas", wire_dtype,
+                      (n - 1) * hop_bytes)
+    chunks = chunks.reshape(n, 1, rows, _LANES)
+
+    def kernel(x_ref, out_ref, buf_ref, qsend, ssend, qstage, sstage,
+               send_sem, recv_sem, ssend_sem, srecv_sem, ack_sem):
         r, right, left = _neighbors(axis, n, direction)
         if faithful:
             _barrier(axis, left, right)
         buf_ref[...] = x_ref[...]
-        _rs_phase(axis, n, (direction,), buf_ref, stage_ref, send_sem,
-                  recv_sem, ack_sem, faithful)
+        _rs_phase_q(axis, n, (direction,), buf_ref, qsend, ssend, qstage,
+                    sstage, send_sem, recv_sem, ssend_sem, srecv_sem,
+                    ack_sem, faithful, wire_dtype, rows, srows, x.dtype)
         out_ref[...] = buf_ref[r, 0]
 
     out = pl.pallas_call(
@@ -270,7 +617,7 @@ def ring_reduce_scatter(x: jax.Array, axis, *, direction: int = 1,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((n, 1, rows, _LANES), x.dtype)]
-        + _scratch(1, rows, x.dtype, with_staging=True),
+        + _quant_scratch(1, rows, srows, wire_dtype),
         compiler_params=_dma.compiler_params(collective_id),
         interpret=_interp(interpret),
     )(chunks)
@@ -278,21 +625,26 @@ def ring_reduce_scatter(x: jax.Array, axis, *, direction: int = 1,
 
 
 def ring_all_reduce(x: jax.Array, axis, *, bidirectional: bool = True,
-                    interpret=None, collective_id: int = 0) -> jax.Array:
+                    interpret=None, collective_id: int = 0,
+                    wire_dtype=None) -> jax.Array:
     """Per-shard allreduce (sum) as ONE kernel: reduce-scatter phase, phase
     barrier, all-gather phase. With ``bidirectional=True`` the payload is
     split over two counter-rotating rings whose DMAs are issued back to back
     each step — both ICI directions of the axis carry traffic concurrently
     (the torus form of UCCL's multipath spraying, transport.cc:2186), from
-    inside a single kernel rather than two serialized collectives."""
+    inside a single kernel rather than two serialized collectives.
+
+    ``wire_dtype="fp8"|"int8"`` quantizes the wire (module docstring): the
+    RS phase quantizes each hop's send and dequantizes before accumulating
+    in input precision; the reduced slot is then quantized ONCE and the AG
+    phase forwards wire bytes verbatim (payload on the RS semaphores after
+    the phase barrier, scales on their own semaphore set). Total error:
+    n-1 per-hop round trips into the sum, plus one on the gathered copy."""
     n = lax.axis_size(axis)
     if n == 1:
         return x
     interpret = _resolve_interpret(interpret)
-    if not _check_budget(x.size * x.dtype.itemsize, "all_reduce", interpret):
-        from uccl_tpu.collective import plan
-
-        return plan.ring_all_reduce(x, axis, bidirectional=bidirectional)
+    wire_dtype = _ring_wire_dtype(x, wire_dtype, "all_reduce")
     n_streams = 2 if bidirectional else 1
     dirs = (1, -1)[:n_streams]
     shape = x.shape
@@ -301,31 +653,113 @@ def ring_all_reduce(x: jax.Array, axis, *, bidirectional: bool = True,
     view, k, m = _pad_chunks(flat, n * n_streams)
     rows = m // _LANES
     view = view.reshape(n, n_streams, rows, _LANES)
+    itemsize = x.dtype.itemsize
+    hop_bytes = _hop_wire_bytes(m, itemsize, wire_dtype)
+    wire_total = 2 * (n - 1) * n_streams * hop_bytes
     faithful = _dma.faithful_sync(interpret)
 
-    def kernel(x_ref, buf_ref, stage_ref, send_sem, recv_sem, ack_sem):
+    if wire_dtype is None:
+        if not _check_budget(x.size * itemsize, "all_reduce", interpret):
+            from uccl_tpu.collective import plan
+
+            _count_wire_bytes("ring_all_reduce", "lax", None, wire_total)
+            return plan.ring_all_reduce(x, axis,
+                                        bidirectional=bidirectional)
+        _count_wire_bytes("ring_all_reduce", "pallas", None, wire_total)
+
+        def kernel(x_ref, buf_ref, stage_ref, send_sem, recv_sem, ack_sem):
+            r = lax.axis_index(axis)
+            right = lax.rem(r + 1, n)
+            left = lax.rem(r - 1 + n, n)
+            if faithful:
+                _barrier(axis, left, right)
+            buf_ref[...] = x_ref[...]
+            _rs_phase(axis, n, dirs, buf_ref, stage_ref, send_sem,
+                      recv_sem, ack_sem, faithful)
+            # Phase barrier: my AG write into a neighbor's buf slot must
+            # land after that neighbor's RS sends from it have drained (its
+            # RS loop waits every send_sem, so "RS done" implies the reads
+            # completed).
+            if faithful:
+                _barrier(axis, left, right)
+            _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem,
+                      faithful)
+
+        buf = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n, n_streams, rows, _LANES),
+                                           x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=_scratch(n_streams, rows, x.dtype,
+                                    with_staging=True),
+            compiler_params=_dma.compiler_params(collective_id),
+            interpret=_interp(interpret),
+        )(view)
+        out = buf.reshape(n * n_streams, m)[:, :k]
+        return out.reshape(-1)[: flat.size].reshape(shape)
+
+    # quantized wire: input-precision accumulator + wire-dtype AG buffers
+    # + PER-STREAM send/2-slot-staging wire scratch (_quant_scratch)
+    srows = _dma.scale_rows(rows)
+    charge = (x.size * itemsize + n * n_streams * hop_bytes
+              + n_streams * 3 * hop_bytes)
+    if not _check_budget(charge, "all_reduce", interpret):
+        _count_wire_bytes("ring_all_reduce", "lax", wire_dtype, wire_total)
+        streams = [
+            _mirror_quant_ar_stream(view[:, h], axis, n, d, wire_dtype,
+                                    x.dtype)
+            for h, d in enumerate(dirs)
+        ]
+        buf = jnp.stack(streams, axis=1)  # [n, S, rows, LANES]
+        out = buf.reshape(n * n_streams, m)[:, :k]
+        return out.reshape(-1)[: flat.size].reshape(shape)
+    _count_wire_bytes("ring_all_reduce", "pallas", wire_dtype, wire_total)
+    wdt = _quant.wire_payload_dtype(wire_dtype)
+
+    def kernel(x_ref, buf_ref, qsend, ssend, qstage, sstage, send_sem,
+               recv_sem, ssend_sem, srecv_sem, ack_sem, qbuf, sbuf,
+               sack_sem):
         r = lax.axis_index(axis)
         right = lax.rem(r + 1, n)
         left = lax.rem(r - 1 + n, n)
         if faithful:
             _barrier(axis, left, right)
         buf_ref[...] = x_ref[...]
-        _rs_phase(axis, n, dirs, buf_ref, stage_ref, send_sem, recv_sem,
-                  ack_sem, faithful)
-        # Phase barrier: my AG write into a neighbor's buf slot must land
-        # after that neighbor's RS sends from it have drained (its RS loop
-        # waits every send_sem, so "RS done" implies the reads completed).
+        _rs_phase_q(axis, n, dirs, buf_ref, qsend, ssend, qstage, sstage,
+                    send_sem, recv_sem, ssend_sem, srecv_sem, ack_sem,
+                    faithful, wire_dtype, rows, srows, x.dtype)
+        # Phase barrier: the payload AG reuses the RS payload semaphores —
+        # an early AG signal must not race a neighbor still in its RS loop.
         if faithful:
             _barrier(axis, left, right)
-        _ag_phase(axis, n, dirs, buf_ref, send_sem, recv_sem, ack_sem,
+        # quantize the reduced slot ONCE; AG forwards wire bytes verbatim
+        # (write-once slots), every member dequantizing the same bytes
+        for h in range(n_streams):
+            q, sc = _quantize_rows(buf_ref[r, h], wire_dtype)
+            qbuf[r, h] = q
+            sbuf[r, h] = _dma.pack_row_scales(sc[..., 0], srows)
+        _ag_phase(axis, n, dirs, qbuf, send_sem, recv_sem, ack_sem,
                   faithful)
+        # the scale AG rides the scale semaphores + its own credits —
+        # disjoint from the payload AG's set, so no barrier between them
+        _ag_phase(axis, n, dirs, sbuf, ssend_sem, srecv_sem, sack_sem,
+                  faithful)
+        scg = _dma.unpack_row_scales(sbuf[...], rows)  # [n, S, rows]
+        buf_ref[...] = _dequantize_rows(qbuf[...], scg[..., None], x.dtype)
 
     buf = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n, n_streams, rows, _LANES), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, n_streams, rows, _LANES),
+                                       x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=_scratch(n_streams, rows, x.dtype, with_staging=True),
+        scratch_shapes=_quant_scratch(n_streams, rows, srows, wire_dtype)
+        + [
+            pltpu.VMEM((n, n_streams, rows, _LANES), wdt),  # qbuf (AG)
+            pltpu.VMEM((n, n_streams, srows, _LANES), jnp.float32),  # sbuf
+            pltpu.SemaphoreType.REGULAR((n_streams,)),  # scale-AG credits
+        ],
         compiler_params=_dma.compiler_params(collective_id),
         interpret=_interp(interpret),
     )(view)

@@ -35,24 +35,31 @@ from uccl_tpu.utils.logging import get_logger
 
 _log = get_logger("EP")
 
-# host-level wire telemetry: payload bytes handed to each EP verb (the
-# global [W, ...] array — what the exchange moves end to end), labeled by
-# verb and the wire that carried it. The companion span on the "wire"
+# host-level wire telemetry: WIRE bytes of the payload handed to each EP
+# verb (the global [W, ...] array — what the exchange moves end to end):
+# quantized payload + f32 scale sidecar when a wire_dtype applies
+# (ep_ops.wire_bytes_of — the one arithmetic benches share), raw element
+# bytes otherwise; labeled by verb, the wire that carried it, and the
+# wire_dtype ("none" = full precision). The companion span on the "wire"
 # track measures the verb's HOST call window (dispatch + any compile on
 # first call) — device time proper belongs to jax.profiler.
 EP_BYTES = _obsc.counter(
     "ep_bytes_total",
-    "payload bytes handed to EP verbs (global arrays), by verb and wire",
+    "actual wire bytes moved by EP verbs and ring collectives (quantized "
+    "payload + f32 scale sidecar when a wire_dtype applies, raw element "
+    "bytes otherwise), by verb, wire, and wire_dtype",
 )
 
 
 def _observed_call(verb: str, fn, args, *, wire: str, n_chunks: int,
-                   payload) -> tuple:
+                   payload, wire_dtype=None) -> tuple:
     """Run one verb's jitted fn under the bytes counter + wire span."""
-    nbytes = int(payload.size) * payload.dtype.itemsize
-    EP_BYTES.inc(nbytes, verb=verb, wire=wire)
+    nbytes = ep_ops.wire_bytes_of(payload.shape, payload.dtype, wire_dtype)
+    EP_BYTES.inc(nbytes, verb=verb, wire=wire,
+                 wire_dtype=wire_dtype or "none")
     with _obst.span(f"ep.{verb}", track="wire", wire=wire,
-                    n_chunks=n_chunks, bytes=nbytes):
+                    n_chunks=n_chunks, bytes=nbytes,
+                    wire_dtype=wire_dtype or "none"):
         return fn(*args)
 
 
@@ -112,13 +119,16 @@ class Config:
     all-to-all (:mod:`uccl_tpu.ep.pallas_a2a`; applies to BOTH the normal
     and LL verbs), ``auto`` defers to the Buffer/backend resolution.
     ``n_chunks`` is the pallas-wire chunk-pipeline depth (0 = auto, 1 =
-    strictly phased; ignored off the pallas wire)."""
+    strictly phased; ignored off the pallas wire). ``wire_dtype`` picks the
+    block-quantized wire payload ("fp8" | "int8", the shared ops.quant
+    codec; None defers to ``wire_fp8``/the Buffer default)."""
 
     max_tokens_per_rank: Optional[int] = None  # LL recv-buffer sizing
     pair_capacity_factor: Optional[float] = None  # dense-wire pair capacity
     wire: str = "auto"  # ragged | dense | pallas | auto
     wire_fp8: bool = True
     n_chunks: Optional[int] = None  # pallas chunk-pipeline depth (0 = auto)
+    wire_dtype: Optional[str] = None  # fp8 | int8 | None (full precision)
 
 
 class DispatchHandle(NamedTuple):
@@ -135,13 +145,18 @@ class DispatchHandle(NamedTuple):
     ``wire`` records which transport carried dispatch ("lax" XLA collective
     or "pallas" device-initiated remote DMA) and ``n_chunks`` its
     chunk-pipeline depth, so combine retraces the same path without
-    re-resolving — the same role LowLatencyHandle.wire plays."""
+    re-resolving — the same role LowLatencyHandle.wire plays.
+    ``wire_dtype`` records dispatch's quantized wire payload (audit +
+    stats; combine resolves its OWN quantization — get_combine_config
+    deliberately keeps the return path full-precision by default, since
+    gate weights amplify combine error)."""
 
     slot: jax.Array  # [W, T, K] int32 slot per assignment (E*C = dropped)
     weights: jax.Array  # [W, T, K] f32 gate weights
     recv_counts: jax.Array  # [W, W_src, E_local] int32 (always populated)
     wire: str = "lax"  # lax | pallas (defaulted: pre-wire handles pickle)
     n_chunks: int = 1  # pallas chunk depth (defaulted: pre-chunk handles)
+    wire_dtype: Optional[str] = None  # fp8 | int8 | None (pre-quant: None)
 
 
 class LowLatencyHandle(NamedTuple):
@@ -159,6 +174,8 @@ class LowLatencyHandle(NamedTuple):
     wire: str
     wire_fp8: bool
     n_chunks: int = 1  # pallas chunk depth (defaulted: pre-chunk handles)
+    wire_dtype: Optional[str] = None  # resolved quantized payload (None =
+    #   wire_fp8 decides — pre-quant handles unpickle to that legacy rule)
 
 
 class Buffer:
@@ -182,7 +199,13 @@ class Buffer:
     hide under the neighboring chunks' DMAs (0 = auto, 1 = strictly
     phased). Identical numerics either way; over the 2x double-buffer
     budget the verbs fall back to the unchunked wire automatically, and
-    the knob is ignored off the pallas wire."""
+    the knob is ignored off the pallas wire.
+
+    ``wire_dtype`` quantizes every verb's wire payload with the shared
+    block-scale codec ("fp8" | "int8", :mod:`uccl_tpu.ops.quant`; values +
+    per-block f32 scales move, one quantize round trip of error per
+    exchange — docs/QUANT_WIRE.md). Per-call ``wire_dtype=``/``wire_fp8=``
+    keywords and a Config override it; None keeps full precision."""
 
     def __init__(
         self,
@@ -194,6 +217,7 @@ class Buffer:
         capacity_factor: float = 1.25,
         wire: str = "auto",
         n_chunks: int = 1,
+        wire_dtype: Optional[str] = None,
     ):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.axes = (axis,) if isinstance(axis, str) else tuple(axis)
@@ -210,12 +234,15 @@ class Buffer:
         if n_chunks < 0:
             raise ValueError(f"n_chunks must be >= 0 (0 = auto), got "
                              f"{n_chunks}")
+        from uccl_tpu.ops import quant as _quant
+
         self.num_experts = num_experts
         self.num_local_experts = num_experts // self.world
         self.num_selected = num_selected
         self.capacity_factor = capacity_factor
         self.wire = wire
         self.n_chunks = n_chunks
+        self.wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
         self._cache = {}
         # host-path wire/chunk resolutions memoize per distinct config:
         # the fallback counter's contract is one event per compiled
@@ -306,6 +333,28 @@ class Buffer:
                                     detail=self.world)
             return 1
         return n
+
+    def _resolve_wire_dtype(self, wire_dtype, wire_fp8, config,
+                            default_fp8: bool = False):
+        """Effective quantized wire payload for a verb: explicit
+        ``wire_dtype`` keyword, else the explicit ``wire_fp8`` bool (True =
+        "fp8", False = full precision), else the Config (its wire_dtype,
+        then its wire_fp8), else the Buffer default, else ``default_fp8``
+        (the LL verbs' legacy fp8-on default)."""
+        from uccl_tpu.ops import quant as _quant
+
+        if wire_dtype is not None:
+            return _quant.resolve_wire_dtype(wire_dtype)
+        if wire_fp8 is not None:
+            return "fp8" if wire_fp8 else None
+        if config is not None:
+            if config.wire_dtype is not None:
+                return _quant.resolve_wire_dtype(config.wire_dtype)
+            if config.wire_fp8 is not None:
+                return "fp8" if config.wire_fp8 else None
+        if self.wire_dtype is not None:
+            return self.wire_dtype
+        return "fp8" if default_fp8 else None
 
     def _spec(self, extra_dims: int) -> P:
         return P(self.axes, *([None] * extra_dims))
@@ -448,6 +497,7 @@ class Buffer:
         topk_weights: Optional[jax.Array] = None,
         *,
         wire_fp8: Optional[bool] = None,
+        wire_dtype: Optional[str] = None,
         config: Optional[Config] = None,
         previous_event: Optional[EventOverlap] = None,
         async_finish: bool = False,
@@ -457,6 +507,10 @@ class Buffer:
         to uniform 1/K). Returns (recv_x [W, E_local, W*C, H], handle), plus
         an :class:`EventOverlap` when ``async_finish`` is set.
 
+        ``wire_dtype`` ("fp8" | "int8") block-quantizes the wire payload
+        (``wire_fp8=True`` is the legacy spelling of "fp8"; resolution:
+        explicit keyword > Config > Buffer default).
+
         Overlap knobs (reference dispatch, ep/bench/buffer.py:801-824):
         ``config`` fills wire knobs the caller left unset (explicit keywords
         win); ``previous_event`` orders this dispatch after another verb's
@@ -464,8 +518,7 @@ class Buffer:
         chain from; ``allocate_on_comm_stream`` is stream-allocator
         bookkeeping with no TPU meaning — accepted (with the reference's own
         precondition) and otherwise a no-op, since XLA owns allocation."""
-        if wire_fp8 is None:
-            wire_fp8 = config.wire_fp8 if config is not None else False
+        wire_dtype = self._resolve_wire_dtype(wire_dtype, wire_fp8, config)
         if allocate_on_comm_stream and not (
             previous_event is not None and async_finish
         ):
@@ -488,19 +541,20 @@ class Buffer:
             # memoized: resolve_chunks records budget/capacity fallbacks,
             # and this host call repeats per dispatch() of one static
             # config — count once, like the traced (per-compile) gates
-            rkey = ("chunks", n_chunks, wire, cap, h, wire_fp8,
+            rkey = ("chunks", n_chunks, wire, cap, h, wire_dtype,
                     jnp.dtype(x.dtype).name)
             if rkey not in self._resolve_memo:
                 self._resolve_memo[rkey] = ep_ops.resolve_chunks(
                     n_chunks, wire, self.world, cap,
                     self.num_local_experts, h,
-                    ep_ops.wire_itemsize(wire_fp8, h, x.dtype),
+                    ep_ops.wire_itemsize(False, h, x.dtype,
+                                         wire_dtype=wire_dtype),
                 )
             n_chunks = self._resolve_memo[rkey]
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
-        key = ("dispatch", x.shape, topk_idx.shape, wire_fp8, x.dtype, wire,
-               n_chunks, has_ev and (tok.shape, tok.dtype))
+        key = ("dispatch", x.shape, topk_idx.shape, wire_dtype, x.dtype,
+               wire, n_chunks, has_ev and (tok.shape, tok.dtype))
 
         def f(xv, idx, *tok_arg):
             xv, idx = xv[0], idx[0]
@@ -514,7 +568,7 @@ class Buffer:
             slot, kept = plan.slot, plan.kept
             recv = ep_ops.dispatch_sorted(
                 xv, plan, e, cap, self._axis_name(),
-                wire_fp8=wire_fp8, wire=wire, n_chunks=n_chunks,
+                wire_dtype=wire_dtype, wire=wire, n_chunks=n_chunks,
             )
             # per-(source, local-expert) received-row counts: kept[E] is MY
             # contribution per global expert; the all_to_all hands each
@@ -536,12 +590,13 @@ class Buffer:
         args = (x, topk_idx) + ((tok,) if has_ev else ())
         recv, slot, recv_counts = _observed_call(
             "dispatch", fn, args, wire=wire, n_chunks=n_chunks, payload=x,
+            wire_dtype=wire_dtype,
         )
         self._op_counts["dispatch"] += 1
         self._last_dispatch = (topk_idx, cap)
         # weights go straight into the handle (combine reshards them itself)
         handle = DispatchHandle(slot, topk_weights, recv_counts, wire,
-                                n_chunks)
+                                n_chunks, wire_dtype)
         if async_finish:
             return recv, handle, EventOverlap((recv, slot, recv_counts))
         return recv, handle
@@ -552,6 +607,7 @@ class Buffer:
         handle: DispatchHandle,
         *,
         wire_fp8: Optional[bool] = None,
+        wire_dtype: Optional[str] = None,
         config: Optional[Config] = None,
         previous_event: Optional[EventOverlap] = None,
         async_finish: bool = False,
@@ -560,9 +616,12 @@ class Buffer:
         """expert_out: [W, E_local, W*C, H] → [W, T, H] (plus an
         :class:`EventOverlap` when ``async_finish``); overlap knobs as in
         :meth:`dispatch` (``config``: see :meth:`get_combine_config`). The
-        reverse exchange rides the wire the handle's dispatch used."""
-        if wire_fp8 is None:
-            wire_fp8 = config.wire_fp8 if config is not None else False
+        reverse exchange rides the wire (and chunk depth) the handle's
+        dispatch used; ``wire_dtype`` resolves independently of dispatch's
+        (explicit keyword > Config > Buffer default — combine error is
+        amplified by the gate weights, so get_combine_config keeps the
+        return path full-precision even under an fp8 dispatch Config)."""
+        wire_dtype = self._resolve_wire_dtype(wire_dtype, wire_fp8, config)
         if allocate_on_comm_stream and not (
             previous_event is not None and async_finish
         ):
@@ -574,7 +633,7 @@ class Buffer:
         n_chunks = handle.n_chunks  # retrace dispatch's chunking exactly
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
-        key = ("combine", expert_out.shape, handle.slot.shape, wire_fp8,
+        key = ("combine", expert_out.shape, handle.slot.shape, wire_dtype,
                wire, n_chunks, has_ev and (tok.shape, tok.dtype))
 
         def f(y, slot, wts, *tok_arg):
@@ -582,7 +641,7 @@ class Buffer:
                 y = _tie(y, tok_arg[0])
             out = ep_ops.combine_sorted(
                 y[0], slot[0], wts[0], self._axis_name(),
-                wire_fp8=wire_fp8, wire=wire, n_chunks=n_chunks,
+                wire_dtype=wire_dtype, wire=wire, n_chunks=n_chunks,
             )
             return out[None]
 
@@ -594,7 +653,7 @@ class Buffer:
         )
         out = _observed_call(
             "combine", fn, args, wire=wire, n_chunks=n_chunks,
-            payload=expert_out,
+            payload=expert_out, wire_dtype=wire_dtype,
         )
         if async_finish:
             return out, EventOverlap(out)
@@ -611,6 +670,7 @@ class Buffer:
         pair_capacity_factor: Optional[float] = None,
         wire: str = "auto",
         wire_fp8: Optional[bool] = None,
+        wire_dtype: Optional[str] = None,
         n_chunks: Optional[int] = None,
         config: Optional[Config] = None,
         previous_event: Optional[EventOverlap] = None,
@@ -640,6 +700,11 @@ class Buffer:
         ``hook()`` blocks until the receive buffers have landed (on GPU the
         unhooked kernel skips the receive entirely; on TPU arrival is the
         XLA program itself, so the hook is the explicit arrival barrier)."""
+        # the quantized-payload knob resolves through the one Buffer rule
+        # (explicit wire_dtype/wire_fp8 > Config > Buffer default), with
+        # the LL legacy default of fp8-on (internode_ll.cu's fp8 wire)
+        wire_dtype = self._resolve_wire_dtype(wire_dtype, wire_fp8, config,
+                                              default_fp8=True)
         if config is not None:
             if num_max_dispatch_tokens_per_rank is None:
                 num_max_dispatch_tokens_per_rank = config.max_tokens_per_rank
@@ -647,10 +712,6 @@ class Buffer:
                 pair_capacity_factor = config.pair_capacity_factor
             if wire == "auto":
                 wire = config.wire
-            if wire_fp8 is None:
-                wire_fp8 = config.wire_fp8  # only fills an unset knob
-        if wire_fp8 is None:
-            wire_fp8 = True  # the LL default (fp8 wire, internode_ll.cu)
         w, t, h = x.shape
         k = topk_idx.shape[-1]
         # Buffer-level default + the pallas addressability gate (config was
@@ -675,7 +736,7 @@ class Buffer:
         key = (
             "ll_dispatch", x.shape, topk_idx.shape, x.dtype,
             num_max_dispatch_tokens_per_rank, pair_capacity_factor, wire,
-            wire_fp8, n_chunks, has_ev and (tok.shape, tok.dtype),
+            wire_dtype, n_chunks, has_ev and (tok.shape, tok.dtype),
         )
 
         def f(xv, idx, wts, *tok_arg):
@@ -687,7 +748,8 @@ class Buffer:
                     num_max_dispatch_tokens_per_rank
                 ),
                 pair_capacity_factor=pair_capacity_factor,
-                wire=wire, wire_fp8=wire_fp8, n_chunks=n_chunks,
+                wire=wire, wire_fp8=False, wire_dtype=wire_dtype,
+                n_chunks=n_chunks,
             )
             s = r.state
             return (
@@ -702,14 +764,15 @@ class Buffer:
         (recv_x, counts, send_slot, weights, send_mat, recv_mat, regroup,
          src_in_offsets) = _observed_call(
             "low_latency_dispatch", fn, args, wire=wire, n_chunks=n_chunks,
-            payload=x,
+            payload=x, wire_dtype=wire_dtype,
         )
         handle = LowLatencyHandle(
             send_slot, weights, send_mat, recv_mat, regroup,
-            src_in_offsets, wire, wire_fp8, n_chunks,
+            src_in_offsets, wire, wire_dtype == "fp8", n_chunks, wire_dtype,
         )
         self._op_counts["low_latency_dispatch"] += 1
-        self._last_ll = (counts, recv_x.shape[1], x.shape[-1], wire_fp8)
+        self._last_ll = (counts, recv_x.shape[1], x.shape[-1],
+                         wire_dtype is not None)
         if async_finish or return_recv_hook:
             event = EventOverlap((recv_x, counts)) if async_finish else None
             hook: Optional[Callable[[], None]] = (
@@ -731,11 +794,16 @@ class Buffer:
         """expert_out: [W, R_max, H] group-major → [W, T, H]; with
         ``async_finish``/``return_recv_hook`` set, returns the reference's
         ``(combined_x, event, hook)`` triple (ep/bench/buffer.py:454-530)."""
+        # pre-quant pickled handles carry wire_dtype=None + the legacy
+        # wire_fp8 bool — the resolution every reader must apply
+        wire_dtype = handle.wire_dtype or (
+            "fp8" if handle.wire_fp8 else None
+        )
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
         key = (
             "ll_combine", expert_out.shape, handle.send_slot.shape,
-            expert_out.dtype, handle.wire, handle.wire_fp8,
+            expert_out.dtype, handle.wire, wire_dtype,
             handle.n_chunks, has_ev and (tok.shape, tok.dtype),
         )
 
@@ -748,7 +816,8 @@ class Buffer:
                 regroup[0], src_off[0], handle.wire, handle.n_chunks,
             )
             out = ep_ll.ll_combine(
-                y[0], state, self._axis_name(), wire_fp8=handle.wire_fp8
+                y[0], state, self._axis_name(), wire_fp8=False,
+                wire_dtype=wire_dtype,
             )
             return out[None]
 
@@ -762,6 +831,7 @@ class Buffer:
         out = _observed_call(
             "low_latency_combine", fn, args, wire=handle.wire,
             n_chunks=handle.n_chunks, payload=expert_out,
+            wire_dtype=wire_dtype,
         )
         if async_finish or return_recv_hook:
             event = EventOverlap(out) if async_finish else None

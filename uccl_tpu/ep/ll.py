@@ -54,7 +54,11 @@ from jax.ad_checkpoint import checkpoint_name
 from uccl_tpu.collective import dma as _dma
 from uccl_tpu.ep.ops import MOE_CHECKPOINT_NAMES
 from uccl_tpu.ep.ops import counts_exchange as _counts_exchange
-from uccl_tpu.ops.quant import dequantize_fp8, quantize_fp8
+from uccl_tpu.ops.quant import (
+    dequantize_block,
+    paying_block,
+    quantize_block,
+)
 
 Axis = Union[str, Tuple[str, ...]]
 
@@ -68,14 +72,33 @@ def wire_supports_ragged() -> bool:
     return jax.default_backend() in ("tpu", "gpu")
 
 
-def _adapt_group(h: int, quant_group: int) -> Optional[int]:
-    """Largest divisor of h ≤ quant_group (shared rule: ops._adapt_quant_group),
-    or None when fp8 wouldn't pay (1 fp8 byte + 4/g scale bytes beats
-    bf16's 2 only for g > 4)."""
-    from uccl_tpu.ep.ops import _adapt_quant_group
+# ONE scale/payoff rule everywhere: the LL wire's block adaption is the
+# shared codec's (uccl_tpu.ops.quant.paying_block — formerly a private
+# duplicate of ops._adapt_quant_group + the >= 8 payoff margin here).
+_adapt_group = paying_block
 
-    g = _adapt_quant_group(h, quant_group)
-    return g if g >= 8 else None
+
+def _resolve_quant(h: int, wire_fp8: bool, wire_dtype,
+                   quant_group: int):
+    """The LL wire's quantization decision: (wire_dtype, adapted group) or
+    None. A requested wire dtype that would not pay (only blocks < 8
+    divide h) ships raw — counted on the shared fallback counter like
+    every quantized→full-precision downgrade, never silent."""
+    from uccl_tpu.ep.ops import resolve_wire_dtype
+
+    wire_dtype = resolve_wire_dtype(wire_fp8, wire_dtype)
+    if wire_dtype is None:
+        return None
+    g = _adapt_group(h, quant_group)
+    if g is None:
+        _dma.record_fallback(
+            "ep_wire_quant", "block_too_small", detail=(h, quant_group),
+            msg=f"ll wire_dtype={wire_dtype!r}: hidden {h} only admits "
+                f"blocks < 8 (requested {quant_group}); shipping full "
+                "precision",
+        )
+        return None
+    return (wire_dtype, g)
 
 
 def resolve_ll_chunks(n_chunks: int, wire: str, world: int,
@@ -275,9 +298,11 @@ def _pallas_exchange(rows, w: int, axis, *, n_chunks=1, collective_id=None):
     ).reshape(shape)
 
 
-def _send_payload(send_rows, out_rows, w, spec, wire, axis, fp8_group, dtype,
-                  *, n_chunks=1, collective_id=None):
-    """Move a row payload across the wire, optionally fp8+scales."""
+def _send_payload(send_rows, out_rows, w, spec, wire, axis, quant_spec,
+                  dtype, *, n_chunks=1, collective_id=None):
+    """Move a row payload across the wire, optionally block-quantized
+    (``quant_spec`` = (wire_dtype, group) or None — values + scale sidecar,
+    the shared ops.quant codec)."""
 
     def exchange(rows, cid_off=0):
         if wire == "ragged":
@@ -288,11 +313,12 @@ def _send_payload(send_rows, out_rows, w, spec, wire, axis, fp8_group, dtype,
         return _pallas_exchange(rows, w, axis, n_chunks=n_chunks,
                                 collective_id=cid)
 
-    if fp8_group is not None:
-        q, scale = quantize_fp8(send_rows, fp8_group)
-        return dequantize_fp8(
+    if quant_spec is not None:
+        wire_dtype, group = quant_spec
+        q, scale = quantize_block(send_rows, wire_dtype, group)
+        return dequantize_block(
             exchange(q), exchange(scale, _dma.CID_SCALE_OFFSET),
-            fp8_group, dtype=dtype,
+            group, dtype=dtype,
         )
     return exchange(send_rows)
 
@@ -310,6 +336,7 @@ def ll_dispatch(
     wire_fp8: bool = True,
     quant_group: int = 128,
     n_chunks: int = 1,
+    wire_dtype: Optional[str] = None,
 ) -> LLDispatchResult:
     """Packed low-latency dispatch (per-shard). See module docstring.
 
@@ -317,7 +344,10 @@ def ll_dispatch(
     of the dense-chunk exchange into double-buffered chunk kernels — the LL
     grouped GEMM regroups across sources, so here chunking pipelines the
     WIRE itself (and whatever compute XLA schedules beside it), not a
-    per-chunk GEMM like the sorted layer's pipelined step."""
+    per-chunk GEMM like the sorted layer's pipelined step.
+
+    ``wire_dtype`` picks the quantized wire payload ("fp8" | "int8");
+    ``wire_fp8=True`` is the legacy spelling of "fp8"."""
     w = lax.axis_size(axis)
     t, h = x.shape
     k = topk_idx.shape[-1]
@@ -338,7 +368,7 @@ def ll_dispatch(
     n_chunks = resolve_ll_chunks(n_chunks, wire, w, per_pair)
     if topk_weights is None:
         topk_weights = jnp.full((t, k), 1.0 / k, jnp.float32)
-    fp8_group = _adapt_group(h, quant_group) if wire_fp8 else None
+    quant_spec = _resolve_quant(h, wire_fp8, wire_dtype, quant_group)
 
     sorted_t, slot_sorted, send_slot, send_mat = _layout(
         topk_idx, num_experts, e_local, per_pair, wire
@@ -367,7 +397,7 @@ def ll_dispatch(
         src_in_offsets = jnp.zeros((w,), jnp.int32)
 
     recv_rows = _send_payload(
-        send_rows, r_max, w, spec, wire, axis, fp8_group, x.dtype,
+        send_rows, r_max, w, spec, wire, axis, quant_spec, x.dtype,
         n_chunks=n_chunks, collective_id=_dma.CID_EP_DISPATCH,
     )
 
@@ -388,6 +418,7 @@ def ll_combine(
     *,
     wire_fp8: bool = True,
     quant_group: int = 128,
+    wire_dtype: Optional[str] = None,
 ) -> jax.Array:
     """Packed low-latency combine (per-shard): ungroup → reverse wire →
     weighted per-token sum. expert_out: [R_max, H] group-major."""
@@ -395,7 +426,7 @@ def ll_combine(
     r_max, h = expert_out.shape
     per_pair = r_max // w
     t, k = state.send_slot.shape
-    fp8_group = _adapt_group(h, quant_group) if wire_fp8 else None
+    quant_spec = _resolve_quant(h, wire_fp8, wire_dtype, quant_group)
 
     # grouped → wire layout (inverse of the regroup gather)
     wire_rows = (
@@ -420,7 +451,7 @@ def ll_combine(
         spec, out_rows = None, r_max
 
     back = _send_payload(
-        wire_rows, out_rows, w, spec, state.wire, axis, fp8_group,
+        wire_rows, out_rows, w, spec, state.wire, axis, quant_spec,
         expert_out.dtype,
         n_chunks=state.n_chunks, collective_id=_dma.CID_EP_COMBINE,
     )
@@ -472,6 +503,7 @@ def ll_moe_ffn(
     wire_fp8: bool = False,
     renormalize: bool = True,
     n_chunks: int = 1,
+    wire_dtype: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full MoE layer on the low-latency path: route → packed dispatch →
     grouped GEMMs over counts → packed combine. Drop-free by default (the
@@ -490,6 +522,7 @@ def ll_moe_ffn(
         num_max_dispatch_tokens_per_rank=num_max_dispatch_tokens_per_rank,
         pair_capacity_factor=pair_capacity_factor,
         wire=wire, wire_fp8=wire_fp8, n_chunks=n_chunks,
+        wire_dtype=wire_dtype,
     )
     y = grouped_ffn(
         r.recv_x, r.group_sizes,
@@ -497,5 +530,6 @@ def ll_moe_ffn(
         w_up.astype(r.recv_x.dtype),
         w_down.astype(r.recv_x.dtype),
     )
-    out = ll_combine(y, r.state, axis, wire_fp8=wire_fp8)
+    out = ll_combine(y, r.state, axis, wire_fp8=wire_fp8,
+                     wire_dtype=wire_dtype)
     return out.astype(x.dtype), aux_loss, z_loss

@@ -45,7 +45,8 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from uccl_tpu.collective import dma as _dma
-from uccl_tpu.ops.quant import dequantize_fp8, quantize_fp8
+from uccl_tpu.ops import quant as _quant
+from uccl_tpu.ops.quant import dequantize_block, quantize_block
 
 # checkpoint_name tags on the expert-GEMM operands/results, shared by the
 # sort/dense path here, the ll path (ep.ll.grouped_ffn), and the
@@ -270,6 +271,7 @@ def dispatch_sorted(
     quant_group: int = 128,
     wire: str = "lax",
     n_chunks: int = 1,
+    wire_dtype=None,
 ) -> jax.Array:
     """Ragged dispatch: one gather packs [E*C, H] slot payloads, then the same
     member-major all-to-all as the dense path. Empty slots (sentinel index T,
@@ -277,7 +279,9 @@ def dispatch_sorted(
     index array or a :class:`SlotPlan` (the once-per-routing-decision form).
     ``n_chunks > 1`` splits the capacity axis of the pallas wire into that
     many double-buffered chunk kernels (identical numerics; lax wire
-    ignores it — XLA owns that schedule). Returns [E_local, W*C, H]."""
+    ignores it — XLA owns that schedule). ``wire_dtype="fp8"|"int8"``
+    block-quantizes the wire payload (wire_fp8=True = legacy "fp8").
+    Returns [E_local, W*C, H]."""
     if isinstance(token_for_slot, SlotPlan):
         token_for_slot = token_for_slot.token_for_slot
     w = lax.axis_size(axis)
@@ -289,7 +293,8 @@ def dispatch_sorted(
     buf = buf.reshape(w, e_local, capacity, h)
     buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype, wire,
                            n_chunks=n_chunks, chunk_axis=2,
-                           collective_id=_dma.CID_EP_DISPATCH)
+                           collective_id=_dma.CID_EP_DISPATCH,
+                           wire_dtype=wire_dtype)
     return buf.transpose(1, 0, 2, 3).reshape(e_local, w * capacity, h)
 
 
@@ -303,6 +308,7 @@ def combine_sorted(
     quant_group: int = 128,
     wire: str = "lax",
     n_chunks: int = 1,
+    wire_dtype=None,
 ) -> jax.Array:
     """Ragged combine: all-to-all the expert outputs home, then one [T, K]-row
     gather + weighted sum. Dropped assignments (sentinel slot E*C, out of
@@ -318,7 +324,8 @@ def combine_sorted(
     buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group,
                            expert_out.dtype, wire,
                            n_chunks=n_chunks, chunk_axis=2,
-                           collective_id=_dma.CID_EP_COMBINE)
+                           collective_id=_dma.CID_EP_COMBINE,
+                           wire_dtype=wire_dtype)
     y = buf.reshape(w * e_local * c, h)  # [E*C, H], expert-major
     yk = jnp.take(y, slot, axis=0, mode="fill", fill_value=0)  # [T, K, H]
     return jnp.einsum("tk,tkh->th", weights.astype(yk.dtype), yk)
@@ -332,6 +339,7 @@ def dispatch(
     wire_fp8: bool = False,
     quant_group: int = 128,
     wire: str = "lax",
+    wire_dtype=None,
 ) -> jax.Array:
     """Scatter local tokens to their experts' owners over the EP axis.
 
@@ -349,7 +357,8 @@ def dispatch(
     )  # [E, C, H]
     buf = buf.reshape(w, e_local, c, x.shape[-1])
     buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype, wire,
-                           collective_id=_dma.CID_EP_DISPATCH)
+                           collective_id=_dma.CID_EP_DISPATCH,
+                           wire_dtype=wire_dtype)
     # buf: [W, E_local, C, H] with dim0 = source member
     return buf.transpose(1, 0, 2, 3).reshape(e_local, w * c, x.shape[-1])
 
@@ -374,50 +383,104 @@ def _member_all_to_all(buf, axis, wire, *, n_chunks=1, chunk_axis=1,
     return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
 
 
-def _adapt_quant_group(h: int, quant_group: int) -> int:
-    """Adapt the fp8 group to the hidden size: the largest divisor of h no
-    bigger than the requested group (trace-time loop; keeps the scale
-    overhead minimal instead of gcd's tiny-group collapse). A result < 8
-    means fp8 would not pay (1 fp8 byte + 4/g scale bytes per element beats
-    bf16's 2 only for g > 4) and the wire ships raw."""
-    if h % quant_group:
-        quant_group = max(
-            d for d in range(min(quant_group, h), 0, -1) if h % d == 0
-        )
-    return quant_group
+# the ONE divisor rule every wire shares — re-exported under the
+# long-standing name (uccl_tpu.ops.quant owns the codec now)
+_adapt_quant_group = _quant.adapt_block
+
+
+def resolve_wire_dtype(wire_fp8: bool, wire_dtype=None):
+    """The EP knob-resolution rule: an explicit ``wire_dtype`` wins; the
+    legacy ``wire_fp8`` bool maps to "fp8"; otherwise full precision."""
+    wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
+    if wire_dtype is None and wire_fp8:
+        wire_dtype = "fp8"
+    return wire_dtype
 
 
 def wire_itemsize(wire_fp8: bool, hidden: int, dtype,
-                  quant_group: int = 128) -> int:
+                  quant_group: int = 128, wire_dtype=None) -> int:
     """Bytes per element the wire actually moves — the itemsize budget
-    gates must charge: 1 when the fp8 packing applies, else the raw
-    activation width (shared with ep_bench's transport labels so the
-    gate's arithmetic is never mirrored)."""
-    if wire_fp8 and _adapt_quant_group(hidden, quant_group) >= 8:
+    gates must charge: 1 when the block-scaled packing applies (fp8 or
+    int8, identical 1-byte payloads), else the raw activation width
+    (shared with ep_bench's transport labels so the gate's arithmetic is
+    never mirrored)."""
+    wire_dtype = resolve_wire_dtype(wire_fp8, wire_dtype)
+    if (wire_dtype is not None
+            and jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+            and _quant.paying_block(hidden, quant_group)):
         return 1
     return jnp.dtype(dtype).itemsize
 
 
+def wire_bytes_of(shape, dtype, wire_dtype=None,
+                  quant_group: int = 128) -> int:
+    """Actual wire bytes of a payload array one EP exchange moves:
+    quantized payload (1 byte/elem) PLUS the f32 scale sidecar when the
+    wire dtype applies, raw element bytes otherwise — the arithmetic the
+    ``ep_bytes_total`` counter and the bench bandwidth math share
+    (docs/QUANT_WIRE.md)."""
+    elems = 1
+    for s in shape:
+        elems *= int(s)
+    itemsize = jnp.dtype(dtype).itemsize
+    if wire_dtype is None or not jnp.issubdtype(
+        jnp.dtype(dtype), jnp.floating
+    ):
+        return elems * itemsize  # full precision / non-float raw wire
+    g = _quant.paying_block(int(shape[-1]), quant_group)
+    if g is None:
+        return elems * itemsize  # quantization would not pay — raw wire
+    return elems + (elems // g) * 4
+
+
 def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype, wire="lax", *,
-                     n_chunks=1, chunk_axis=1, collective_id=None):
-    """Member-major all-to-all of a [W, ...] buffer, optionally fp8 on the wire
-    (the analog of internode_ll.cu's fp8+scales message packing)."""
+                     n_chunks=1, chunk_axis=1, collective_id=None,
+                     wire_dtype=None):
+    """Member-major all-to-all of a [W, ...] buffer, optionally block-scale
+    quantized on the wire (``wire_dtype="fp8"|"int8"``; ``wire_fp8=True``
+    is the legacy spelling of "fp8" — the analog of internode_ll.cu's
+    fp8+scales message packing)."""
 
     def xchg(rows, cid_off=0):
         cid = None if collective_id is None else collective_id + cid_off
         return _member_all_to_all(rows, axis, wire, n_chunks=n_chunks,
                                   chunk_axis=chunk_axis, collective_id=cid)
 
-    if wire_fp8:
-        quant_group = _adapt_quant_group(buf.shape[-1], quant_group)
-        if quant_group < 8:
-            return xchg(buf)  # fp8 would inflate traffic — ship raw
-        q, scale = quantize_fp8(buf, quant_group)
+    wire_dtype = resolve_wire_dtype(wire_fp8, wire_dtype)
+    if wire_dtype is not None and not jnp.issubdtype(
+        jnp.dtype(buf.dtype), jnp.floating
+    ):
+        # same rule as the rings' _ring_wire_dtype: a non-float payload
+        # rides the full-precision wire — counted, never silently cast
+        # through the float codec
+        _dma.record_fallback(
+            "ep_wire_quant", "quant_dtype",
+            detail=jnp.dtype(buf.dtype).name,
+            msg=f"ep wire_dtype={wire_dtype!r} needs a float payload, got "
+                f"{jnp.dtype(buf.dtype).name}; shipping full precision",
+        )
+        wire_dtype = None
+    if wire_dtype is not None:
+        group = _quant.paying_block(buf.shape[-1], quant_group)
+        if group is None:
+            # quantization would inflate traffic — ship raw, but never
+            # silently: the quantized→full-precision downgrade is counted
+            # like every other transparent wire decision
+            _dma.record_fallback(
+                "ep_wire_quant", "block_too_small",
+                detail=(buf.shape[-1], quant_group),
+                msg=f"ep wire_dtype={wire_dtype!r}: hidden {buf.shape[-1]} "
+                    f"only admits blocks < 8 (requested {quant_group}); "
+                    "scale overhead would exceed the payload saving — "
+                    "shipping full precision",
+            )
+            return xchg(buf)
+        q, scale = quantize_block(buf, wire_dtype, group)
         # scales ride their own id lane: the value and scale exchanges have
         # no data dependency and may be airborne together
         q = xchg(q)
         scale = xchg(scale, _dma.CID_SCALE_OFFSET)
-        return dequantize_fp8(q, scale, quant_group, dtype=dtype)
+        return dequantize_block(q, scale, group, dtype=dtype)
     return xchg(buf)
 
 
@@ -429,6 +492,7 @@ def combine(
     wire_fp8: bool = False,
     quant_group: int = 128,
     wire: str = "lax",
+    wire_dtype=None,
 ) -> jax.Array:
     """Return expert outputs to their source members and weight-sum per token.
 
@@ -442,7 +506,8 @@ def combine(
     buf = expert_out.reshape(e_local, w, c, h).transpose(1, 0, 2, 3)  # [W,E_l,C,H]
     buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group,
                            expert_out.dtype, wire,
-                           collective_id=_dma.CID_EP_COMBINE)
+                           collective_id=_dma.CID_EP_COMBINE,
+                           wire_dtype=wire_dtype)
     # buf: [W, E_local, C, H] with dim0 = owner member -> [E, C, H]
     buf = buf.reshape(e, c, h)
     out = jnp.einsum("tec,ech->th", combine_weights.astype(buf.dtype), buf)
@@ -537,7 +602,7 @@ def _expert_gemms(xe, w_gate, w_up, w_down):
 def _moe_ffn_sort_chunked(
     x, plan: SlotPlan, weights, w_gate, w_up, w_down, axis,
     num_experts: int, capacity: int, n_chunks: int,
-    wire_fp8: bool, quant_group: int,
+    wire_fp8: bool, quant_group: int, wire_dtype=None,
 ):
     """The chunk-pipelined sorted MoE step on the device-initiated wire.
 
@@ -575,6 +640,7 @@ def _moe_ffn_sort_chunked(
         buf = _wire_all_to_all(
             buf, axis, wire_fp8, quant_group, x.dtype, "pallas",
             collective_id=_dma.chunk_collective_id(_dma.CID_EP_DISPATCH, c),
+            wire_dtype=wire_dtype,
         )
         xe = buf.transpose(1, 0, 2, 3).reshape(e_local, w * cs, h)
         recv_chunks.append(xe)
@@ -586,6 +652,7 @@ def _moe_ffn_sort_chunked(
         back = _wire_all_to_all(
             back, axis, wire_fp8, quant_group, ye.dtype, "pallas",
             collective_id=_dma.chunk_collective_id(_dma.CID_EP_COMBINE, c),
+            wire_dtype=wire_dtype,
         )
         y_chunks.append(back.reshape(num_experts, cs, h))
     # reassemble the expert-major [E, C, H] buffer (chunks are contiguous
@@ -611,6 +678,7 @@ def moe_ffn(
     impl: str = "sort",
     wire: str = "lax",
     n_chunks: int = 1,
+    wire_dtype=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full per-shard MoE layer: route → dispatch → SwiGLU experts → combine.
 
@@ -629,12 +697,18 @@ def moe_ffn(
     chunk-pipelined step (:func:`_moe_ffn_sort_chunked`: dispatch chunk c+1
     and combine chunk c-1 overlap the expert GEMM of chunk c); impl="ll"
     chunks its wire exchanges; the dense oracle ignores it.
+    wire_dtype: block-scale quantize the dispatch/combine wire payloads
+    ("fp8" | "int8"; the shared :mod:`uccl_tpu.ops.quant` codec —
+    ``wire_fp8=True`` is the legacy spelling of "fp8"). Chunking composes
+    bit-identically (blocks run along the hidden dim, untouched by the
+    capacity split).
     Returns (out [T, H], aux_loss, z_loss).
     """
     t, h = x.shape
     e = router_logits.shape[-1]
     w = lax.axis_size(axis)
     capacity = max(1, int(capacity_factor * t * num_selected / e))
+    wire_dtype = resolve_wire_dtype(wire_fp8, wire_dtype)
     if impl == "ll":
         from uccl_tpu.ep.ll import ll_moe_ffn
 
@@ -643,30 +717,32 @@ def moe_ffn(
             num_selected=num_selected,
             pair_capacity_factor=capacity_factor,
             wire="pallas" if wire == "pallas" else "auto",
-            wire_fp8=wire_fp8,
+            wire_dtype=wire_dtype,
             n_chunks=n_chunks,
         )
     if impl == "sort":
         rs = route_topk_sorted(router_logits, num_selected, capacity)
         n_chunks = resolve_chunks(
             n_chunks, wire, w, capacity, e // w, h,
-            wire_itemsize(wire_fp8, h, x.dtype), axis=axis,
+            wire_itemsize(wire_fp8, h, x.dtype, wire_dtype=wire_dtype),
+            axis=axis,
         )
         if n_chunks > 1:
             plan = SlotPlan(rs.token_for_slot, rs.slot, rs.counts)
             out = _moe_ffn_sort_chunked(
                 x, plan, rs.weights, w_gate, w_up, w_down, axis, e,
-                capacity, n_chunks, wire_fp8, 128,
+                capacity, n_chunks, False, 128, wire_dtype=wire_dtype,
             )
             return out.astype(x.dtype), rs.aux_loss, rs.z_loss
         xe = dispatch_sorted(
-            x, rs.token_for_slot, e, capacity, axis, wire_fp8=wire_fp8,
-            wire=wire,
+            x, rs.token_for_slot, e, capacity, axis, wire=wire,
+            wire_dtype=wire_dtype,
         )
         aux_loss, z_loss = rs.aux_loss, rs.z_loss
     elif impl == "dense":
         r = route_topk(router_logits, num_selected, capacity)
-        xe = dispatch(x, r.dispatch_mask, axis, wire_fp8=wire_fp8, wire=wire)
+        xe = dispatch(x, r.dispatch_mask, axis, wire=wire,
+                      wire_dtype=wire_dtype)
         aux_loss, z_loss = r.aux_loss, r.z_loss
     else:
         raise ValueError(
@@ -676,9 +752,9 @@ def moe_ffn(
     # batched einsum form are load-bearing for remat — see _expert_gemms)
     ye = _expert_gemms(xe, w_gate, w_up, w_down)
     if impl == "sort":
-        out = combine_sorted(ye, rs.slot, rs.weights, axis,
-                             wire_fp8=wire_fp8, wire=wire)
+        out = combine_sorted(ye, rs.slot, rs.weights, axis, wire=wire,
+                             wire_dtype=wire_dtype)
     else:
-        out = combine(ye, r.combine_weights, axis, wire_fp8=wire_fp8,
-                      wire=wire)
+        out = combine(ye, r.combine_weights, axis, wire=wire,
+                      wire_dtype=wire_dtype)
     return out.astype(x.dtype), aux_loss, z_loss

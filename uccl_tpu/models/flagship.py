@@ -71,6 +71,9 @@ class FlagshipConfig:
     # overlapping expert GEMMs with the dispatch/combine wire; ignored on
     # the lax wire)
     wire_fp8: bool = False
+    wire_dtype: Any = None  # None | "fp8" | "int8": block-quantized EP wire
+    # payloads (shared ops.quant codec; wire_fp8=True is the legacy
+    # spelling of "fp8" — an explicit wire_dtype wins)
     remat: str = "full"  # "full" | "dots" | "mlp" | "none" — see _remat_wrap
     dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
 
@@ -211,6 +214,7 @@ def _layer(x, lp, cfg: FlagshipConfig):
         num_selected=cfg.moe_topk,
         capacity_factor=cfg.capacity_factor,
         wire_fp8=cfg.wire_fp8,
+        wire_dtype=cfg.wire_dtype,
         impl=cfg.moe_impl,
         wire=cfg.moe_wire,
         n_chunks=cfg.moe_chunks,

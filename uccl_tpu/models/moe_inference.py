@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +61,9 @@ class MoEServeConfig:
     moe_wire: str = "lax"  # "lax" | "pallas" (device-initiated a2a wire)
     moe_chunks: int = 0  # pallas chunk-pipeline depth (0 = auto: overlap
     # prefill's expert GEMMs with the dispatch/combine wire; no-op on lax)
+    wire_dtype: Optional[str] = None  # None | "fp8" | "int8": block-scale
+    # quantized EP wire payloads (shared ops.quant codec; one quantize
+    # round trip of error per exchange — docs/QUANT_WIRE.md)
 
 
 class MoEKVCache(NamedTuple):
@@ -146,6 +149,7 @@ def _moe_block(cfg: MoEServeConfig, impl: str):
             impl=impl,
             wire=cfg.moe_wire,
             n_chunks=cfg.moe_chunks,
+            wire_dtype=cfg.wire_dtype,
         )
         return out.reshape(b, sq, hd)
 

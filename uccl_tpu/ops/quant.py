@@ -1,21 +1,196 @@
-"""FP8 payload quantization for wire transfer.
+"""Block-scaled wire codec: fp8 / int8 payloads + per-block f32 scales.
 
 The analog of the reference's fp8-packed EP payloads (ep/src/internode_ll.cu:62
 casts tokens to fp8 + per-group scales before RDMA) and the DietGPU float
 compression on the P2P wire (p2p/rdma/compression.{h,cc}): shrink what moves
-across the fabric, restore on arrival. On TPU we use native ``float8_e4m3fn``
-with per-group scales — MXU-friendly and XLA-fusable into the surrounding ops.
+across the fabric, restore on arrival. On TPU the payload dtypes are native
+``float8_e4m3fn`` and ``int8`` with per-block f32 scales — MXU-friendly and
+XLA-fusable into the surrounding ops.
+
+This module is the ONE scale rule every wire shares (EQuARX-style: quantize
+on the wire only, never store partial sums in wire precision):
+
+* the EP all-to-all paths (:mod:`uccl_tpu.ep.ops` sorted/dense,
+  :mod:`uccl_tpu.ep.ll` packed LL) quantize along the hidden dim in
+  ``quant_group``-sized blocks;
+* the Pallas ring collectives (:mod:`uccl_tpu.collective.pallas_ccl`
+  ``wire_dtype=``) quantize per 128-lane row of their padded chunk layout;
+* the host-side P2P codec (:mod:`uccl_tpu.p2p.compress`) still carries the
+  legacy numpy variant of the rule (amax floored at 1e-12, no zero-exact /
+  non-finite guards) — it pre-dates this codec and its self-describing blob
+  header pins that format; converging it here is tracked with the
+  quantized-p2p roadmap item.
+
+Codec contract (``quantize_block`` / ``dequantize_block``):
+
+* symmetric block scaling along the LAST dim: ``scale = amax / QMAX`` per
+  block (``QMAX`` = 448 for fp8 e4m3fn, 127 for int8), values divided by the
+  scale and cast (int8 additionally rounds-to-nearest);
+* **padding-safe**: a trailing block that does not divide the last dim is
+  zero-padded internally and sliced back — padding never changes the scale
+  of real data (zeros cannot raise an amax);
+* **zero/denormal-safe**: an exact-zero block takes ``scale = 1.0`` (so it
+  round-trips to EXACT zeros), a denormal-amax block's scale is floored at
+  the smallest normal f32 (no inf from the divide), quantized values are
+  clipped to ±QMAX before the cast (e4m3fn has no inf — an unclipped
+  overflow would become nan), and ``dequantize_block`` maps zero/denormal/
+  nan scales to 0 instead of propagating garbage;
+* **non-finite-loud**: a block containing any inf/nan input element gets
+  its scale poisoned to +inf so the WHOLE block dequantizes non-finite —
+  a full-precision wire would deliver the divergence, so the quantized
+  wire must never mask it as zeros (int8's nan→0 cast otherwise would).
+
+Per-block error bound of one quantize→dequantize round trip (the unit the
+wire designs budget in — docs/QUANT_WIRE.md): ``|err| <= amax / 27.7`` for
+fp8 (half-ulp at 448 is 16 ⇒ 16/448 = amax/28 for a correctly-rounded
+cast, plus up to half an f16 ulp where the substrate double-rounds the
+f32→e4m3 cast through f16 — XLA:CPU does ⇒ 16.125/448) and
+``|err| <= amax / 254`` for int8 (half a step of amax/127;
+``jnp.round`` is correctly rounded, no double-rounding slack).
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 FP8_DTYPE = jnp.float8_e4m3fn
 FP8_MAX = 448.0  # max normal of e4m3fn
+INT8_MAX = 127.0  # symmetric int8 (−127..127; −128 unused)
+
+# wire_dtype name -> (payload jnp dtype, QMAX, needs integer rounding)
+WIRE_DTYPES = {
+    "fp8": (FP8_DTYPE, FP8_MAX, False),
+    "int8": (jnp.int8, INT8_MAX, True),
+}
+
+# scale floor: the smallest NORMAL f32. A denormal scale risks flushing to
+# zero (then x / scale = inf) and denormal arithmetic on some substrates;
+# flooring here keeps |x / scale| finite (clipped to QMAX right after).
+_SCALE_TINY = float(jnp.finfo(jnp.float32).tiny)
+
+
+def resolve_wire_dtype(wire_dtype: Optional[str]) -> Optional[str]:
+    """Validate a ``wire_dtype`` knob value (None | "fp8" | "int8")."""
+    if wire_dtype is None or wire_dtype in ("", "none"):
+        return None
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"unknown wire_dtype {wire_dtype!r} (want None, 'fp8', or "
+            "'int8')"
+        )
+    return wire_dtype
+
+
+def wire_payload_dtype(wire_dtype: str):
+    """The jnp payload dtype of a wire_dtype."""
+    return WIRE_DTYPES[wire_dtype][0]
+
+
+def wire_qmax(wire_dtype: str) -> float:
+    return WIRE_DTYPES[wire_dtype][1]
+
+
+def adapt_block(d: int, block: int) -> int:
+    """Adapt a block size to a dim: the largest divisor of ``d`` no bigger
+    than the requested block (trace-time loop; keeps the scale overhead
+    minimal instead of gcd's tiny-block collapse). The ONE divisor rule the
+    EP paths share (formerly ep.ops._adapt_quant_group)."""
+    if d % block:
+        block = max(b for b in range(min(block, d), 0, -1) if d % b == 0)
+    return block
+
+
+def paying_block(d: int, block: int) -> Optional[int]:
+    """The adapted block when block-scaled quantization PAYS on the wire,
+    else None: 1 payload byte + 4/g scale bytes beats bf16's 2 only for
+    g > 4; the codebase's established margin is g >= 8 (formerly
+    ep.ll._adapt_group — the one payoff rule every wire shares; identical
+    for fp8 and int8, both 1-byte payloads)."""
+    g = adapt_block(d, block)
+    return g if g >= 8 else None
+
+
+def quantize_block(
+    x: jax.Array, wire_dtype: str = "fp8", block: int = 128
+) -> Tuple[jax.Array, jax.Array]:
+    """Block-scaled symmetric quantization along the last dim.
+
+    x: [..., D] → (values [..., D] in the wire payload dtype,
+    scales [..., ceil(D/block)] f32) such that ``values * scale ≈ x``.
+    Padding-safe on a non-dividing trailing block; exact-zero blocks take
+    scale 1.0 and round-trip to exact zeros (see module docstring).
+    """
+    wire_dtype = resolve_wire_dtype(wire_dtype)
+    if wire_dtype is None:
+        raise ValueError("quantize_block needs a wire_dtype ('fp8'/'int8')")
+    dtype, qmax, integer = WIRE_DTYPES[wire_dtype]
+    *lead, d = x.shape
+    nb = -(-d // block)
+    pad = nb * block - d
+    g = x.astype(jnp.float32)
+    if pad:
+        g = jnp.pad(g, [(0, 0)] * len(lead) + [(0, pad)])
+    g = g.reshape(*lead, nb, block)
+    amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    scale = jnp.where(
+        amax > 0.0, jnp.maximum(amax / qmax, _SCALE_TINY), 1.0
+    )
+    # A block holding any non-finite element cannot be block-scaled (one
+    # shared scale cannot carry inf AND its finite neighbors). Poison its
+    # scale to +inf so the whole block dequantizes non-finite — divergence
+    # stays loud; int8's nan→0 cast would otherwise mask it as exact zeros.
+    scale = jnp.where(jnp.isfinite(amax), scale, jnp.inf)
+    q = jnp.clip(g / scale, -qmax, qmax)
+    if integer:
+        q = jnp.round(q)
+    q = q.astype(dtype).reshape(*lead, nb * block)
+    if pad:
+        q = q[..., :d]
+    return q, scale[..., 0]
+
+
+def dequantize_block(
+    q: jax.Array,
+    scale: jax.Array,
+    block: int = 128,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Inverse of :func:`quantize_block`.
+
+    Scale guard: a zero/denormal/nan scale dequantizes its block to exact
+    zeros — those only arise from garbage sidecar lanes or a legitimately
+    zero block (which carries q == 0 either way). A **+inf** scale is the
+    quantizer's poison marker for a non-finite input block and is let
+    through, so the whole block arrives non-finite (nan) instead of
+    silently zeroed — divergence on a quantized wire must stay loud."""
+    *lead, d = q.shape
+    nb = scale.shape[-1]
+    pad = nb * block - d
+    g = q.astype(jnp.float32)
+    if pad:
+        g = jnp.pad(g, [(0, 0)] * len(lead) + [(0, pad)])
+    g = g.reshape(*lead, nb, block)
+    scale = scale.astype(jnp.float32)
+    safe = jnp.where(
+        jnp.isnan(scale) | (scale < _SCALE_TINY), 0.0, scale
+    )
+    out = (g * safe[..., None]).reshape(*lead, nb * block)
+    if pad:
+        out = out[..., :d]
+    return out.astype(dtype)
+
+
+# -- legacy fp8 surface (PR 1's EP wire) -------------------------------------
+# Thin wrappers over the generic codec; bit-equal to the pre-codec
+# quantize_fp8/dequantize_fp8 on their original contract — last dim divisible
+# by the group and per-block amax >= 1e-12, the old rule's scale floor
+# (below it the old rule collapsed blocks to q ≈ 0 while the codec's
+# TINY-floored scale keeps them representable: different wire bits, strictly
+# tighter round-trip error) — regression-tested in tests/test_quant.py so
+# the LL wire format cannot drift.
 
 
 def quantize_fp8(
@@ -26,21 +201,15 @@ def quantize_fp8(
     x: [..., D] with D % group_size == 0 → values [..., D] fp8,
     scales [..., D // group_size] f32 such that values * scale ≈ x.
     """
-    *lead, d = x.shape
-    if d % group_size:
-        raise ValueError(f"last dim {d} not divisible by group size {group_size}")
-    g = x.reshape(*lead, d // group_size, group_size).astype(jnp.float32)
-    amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
-    scale = jnp.maximum(amax, 1e-12) / FP8_MAX
-    q = (g / scale).astype(FP8_DTYPE)
-    return q.reshape(*lead, d), scale[..., 0]
+    if x.shape[-1] % group_size:
+        raise ValueError(
+            f"last dim {x.shape[-1]} not divisible by group size {group_size}"
+        )
+    return quantize_block(x, "fp8", group_size)
 
 
 def dequantize_fp8(
     q: jax.Array, scale: jax.Array, group_size: int = 128, dtype=jnp.bfloat16
 ) -> jax.Array:
     """Inverse of :func:`quantize_fp8`."""
-    *lead, d = q.shape
-    g = q.reshape(*lead, d // group_size, group_size).astype(jnp.float32)
-    out = g * scale[..., None]
-    return out.reshape(*lead, d).astype(dtype)
+    return dequantize_block(q, scale, group_size, dtype=dtype)

@@ -19,6 +19,19 @@ arm with the per-shard wire bytes read off the REAL
 themselves — never mirrored arithmetic), the effective per-member wire
 bandwidth those bytes imply, the wire-byte reduction vs the full-precision
 arm, and the max-abs/rel error vs the full-precision result.
+
+``--json`` switches the algo sweep to one ``all_reduce_plan`` JSON line
+per size: every arm labeled off the REAL ``collective_plan_total`` counter
+delta around its compile (the planner's decision, never the CLI arg
+mirrored back) with the cost model's ``modeled_us`` (read off the
+``collective_plan_predicted_us`` gauge the planner set) beside the
+measured time — the record ``scripts/plan_calibrate.py`` refits the
+alpha/beta/gamma constants from. ``--check`` makes every arm's result an
+oracle assertion against an independent numpy sum (exit nonzero on mismatch) — the CI
+planner smoke rides this. ``--metrics-out`` dumps the Prometheus
+snapshot (``scripts/check_obs.py --plan`` validates the plan series
+against the emitted JSON); ``--trace-out`` records the ``collective_plan``
+decision instants.
 """
 
 from __future__ import annotations
@@ -36,6 +49,45 @@ def _ring_bytes_snapshot():
     fam = obsc.counter("ep_bytes_total")
     return {tuple(sorted(lb.items())): v for lb, v in fam.samples()
             if lb.get("verb") == "ring_all_reduce"}
+
+
+def _plan_snapshot():
+    from uccl_tpu.obs import counters as obsc
+
+    fam = obsc.counter("collective_plan_total")
+    return {tuple(sorted(lb.items())): v for lb, v in fam.samples()}
+
+
+def _planned_label(before):
+    """The plan decision an arm ACTUALLY emitted (counter delta around its
+    compile) — the real label, never the CLI arg mirrored back. A
+    ``fallback`` delta (the planned kernel degraded to its lax mirror at
+    trace time) wins over the decision delta: the arm's timings are the
+    mirror's, and plan_calibrate must be able to exclude them. Otherwise
+    the largest allreduce-plan delta; None if nothing moved."""
+    deltas = []
+    for k, v in _plan_snapshot().items():
+        d = v - before.get(k, 0)
+        lb = dict(k)
+        if d > 0 and lb.get("algo") != "ep_a2a":
+            deltas.append((d, lb))
+    if not deltas:
+        return None
+    for _, lb in deltas:
+        if lb.get("outcome") == "fallback":
+            return lb
+    return max(deltas, key=lambda t: t[0])[1]
+
+
+def _modeled_us(label):
+    """The cost model's prediction for a plan label, read off the gauge the
+    planner set at decision time (shared arithmetic, not mirrored)."""
+    from uccl_tpu.obs import counters as obsc
+
+    return obsc.gauge("collective_plan_predicted_us").get(
+        algo=label["algo"], chunks=label["chunks"],
+        wire_dtype=label["wire_dtype"],
+    )
 
 
 def _ring_bytes_delta(before):
@@ -117,7 +169,8 @@ def main():
                     help="force N virtual CPU devices (0 = use real devices)")
     ap.add_argument(
         "--algo", default="both",
-        choices=["xla", "ring", "hd", "torus", "pallas", "both", "all"]
+        choices=["xla", "ring", "hd", "torus", "pallas", "bidir", "auto",
+                 "both", "all"]
     )
     ap.add_argument(
         "--mesh2d", default="", metavar="AxB",
@@ -132,6 +185,17 @@ def main():
              "(e.g. 'fp8,int8'): JSON line per size with counter-derived "
              "wire bytes, effective bandwidth, and error vs full precision",
     )
+    ap.add_argument("--json", action="store_true",
+                    help="emit one all_reduce_plan JSON line per size: arms "
+                         "labeled off the real collective_plan_total delta "
+                         "with modeled_us beside measured (the record "
+                         "plan_calibrate.py refits from)")
+    ap.add_argument("--check", action="store_true",
+                    help="oracle mode: every arm must match the numpy sum oracle "
+                         "(exit nonzero on mismatch) — the planner smoke")
+    from uccl_tpu import obs  # safe pre-device-forcing: jax-free surfaces
+
+    obs.add_cli_args(ap)
     args = ap.parse_args()
 
     jax = init_devices(args.devices)
@@ -140,6 +204,8 @@ def main():
 
     from uccl_tpu.collective import Communicator
     from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    obs.setup_from_args(args)
 
     n = len(jax.devices())
     if args.wire_dtype:
@@ -154,6 +220,7 @@ def main():
             if w not in ("fp8", "int8"):
                 ap.error(f"unknown --wire-dtype arm {w!r} (want fp8/int8)")
         quant_sweep(jax, n, wire_dtypes, args)
+        obs.dump_from_args(args)
         return
     if args.mesh2d:
         a, b = (int(v) for v in args.mesh2d.lower().split("x"))
@@ -161,44 +228,99 @@ def main():
         mesh = make_mesh(MeshConfig(dp=a, tp=b))
         comm = Communicator(mesh, ("dp", "tp"))
     else:
-        mesh = make_mesh(MeshConfig(dp=n))
+        # raw single-axis mesh: the same choice as quant_sweep, so the
+        # pallas/bidir arms are kernel-addressable under the legacy
+        # discharge interpreter and auto may plan them
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
         comm = Communicator(mesh, "dp")
 
     if args.algo == "both":
         algos = ["xla", "ring"]
     elif args.algo == "all":
-        algos = ["xla", "ring", "hd", "pallas"] + (["torus"] if args.mesh2d else [])
+        algos = ["xla", "ring", "hd", "pallas", "bidir", "auto"] + (
+            ["torus"] if args.mesh2d else [])
     else:
         algos = [args.algo]
 
-    print(f"# all_reduce_perf  world={n}  devices={jax.devices()[0].platform}")
-    print(f"# {'bytes':>12} {'algo':>6} {'time_us':>10} {'algbw_GB/s':>10} {'busbw_GB/s':>10}")
+    failed = 0
+    if not args.json:
+        print(f"# all_reduce_perf  world={n}  "
+              f"devices={jax.devices()[0].platform}")
+        print(f"# {'bytes':>12} {'algo':>8} {'planned':>8} {'time_us':>10}"
+              f" {'model_us':>10} {'algbw_GB/s':>10} {'busbw_GB/s':>10}")
     size = args.min_bytes
     while size <= args.max_bytes:
         elems = size // 4
         x = comm.device_put(
             np.random.default_rng(0).standard_normal((n, elems)).astype(np.float32)
         )
+        # the --check oracle: an independent numpy sum, NOT comm.all_reduce
+        # — the comm memoizes plan resolutions per request, so going through
+        # it here would consume the xla arm's counter delta before the arm
+        # could label itself off it
+        ref = np.tile(np.asarray(x).sum(0), (n, 1))
+        arms = []
         for algo in algos:
             if algo == "hd" and n & (n - 1):
                 # hd falls back to ring off power-of-two worlds; skip rather
                 # than record ring timings under the hd label
                 continue
-            if algo == "pallas" and args.mesh2d:
-                continue  # pallas rings a single mesh axis
-            out = comm.all_reduce(x, algo=algo)  # compile + warmup
-            np.asarray(out)
+            if algo in ("pallas", "bidir") and args.mesh2d:
+                continue  # the ring kernels drive a single mesh axis
+            before = _plan_snapshot()
+            out = comm.all_reduce(x, algo=algo)  # compile + warmup (+ plan)
+            got = np.asarray(out)
+            label = _planned_label(before) or {
+                "algo": algo, "chunks": "1", "wire_dtype": "none"}
             t0 = time.perf_counter()
             for _ in range(args.iters):
                 out = comm.all_reduce(x, algo=algo)
             np.asarray(out)  # host read = hard sync (axon-safe)
             dt = (time.perf_counter() - t0) / args.iters
+            err = float(np.abs(got - ref).max())
+            ok = err <= 1e-4 * max(1.0, float(np.abs(ref).max()))
+            if args.check and not ok:
+                print(f"all_reduce_perf: CHECK FAILED {algo} @ {size}B "
+                      f"(planned {label['algo']}): max abs err {err}",
+                      flush=True)
+                failed = 1
             algbw = size / dt / 1e9
             busbw = algbw * 2 * (n - 1) / n
-            print(
-                f"  {size:>12} {algo:>6} {dt * 1e6:>10.1f} {algbw:>10.3f} {busbw:>10.3f}"
-            )
+            modeled = _modeled_us(label)
+            arms.append({
+                "requested": algo,
+                "algo": label["algo"],  # the REAL plan label (counter)
+                "chunks": int(label["chunks"]),
+                # "fallback" = the planned kernel ran as its lax mirror —
+                # plan_calibrate excludes those rows from the fit
+                "outcome": label.get("outcome", "explicit"),
+                "time_us": round(dt * 1e6, 1),
+                "modeled_us": round(modeled, 2),
+                "algbw_gbps": round(algbw, 3),
+                "busbw_gbps": round(busbw, 3),
+                "max_abs_err": err,
+                "oracle_ok": ok,
+            })
+            if not args.json:
+                print(f"  {size:>12} {algo:>8} {label['algo']:>8} "
+                      f"{dt * 1e6:>10.1f} {modeled:>10.1f} {algbw:>10.3f} "
+                      f"{busbw:>10.3f}")
+        if args.json:
+            print(json.dumps({
+                "bench": "all_reduce_plan",
+                "schema_version": obs.SCHEMA_VERSION,
+                "bytes": size, "world": n,
+                "n_axes": 2 if args.mesh2d else 1,
+                "mesh2d": args.mesh2d or None,
+                "substrate": jax.default_backend(),
+                "arms": arms,
+            }), flush=True)
         size *= 4
+    obs.dump_from_args(args)
+    if failed:
+        raise SystemExit(failed)
 
 
 if __name__ == "__main__":

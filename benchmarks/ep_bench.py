@@ -337,6 +337,22 @@ def bench_chunk_sweep(jax, *, tokens, hidden, ffn, experts, topk, iters,
     # lax fallback (the unchunked kernel did not carry the exchange);
     # "ep_moe_chunked:*"/"ep_all_to_all_chunked:*" events mean only the
     # chunk pipeline degraded to the unchunked pallas wire.
+    # ... and the RESOLVED chunk depth comes off the planner's decision
+    # series (collective_plan_total{algo="ep_a2a", chunks}) the resolver
+    # emits — never the requested CLI knob mirrored back.
+    def _plan_snapshot():
+        from uccl_tpu.obs import counters as obsc
+
+        return {tuple(sorted(lb.items())): v
+                for lb, v in obsc.counter("collective_plan_total").samples()
+                if lb.get("algo") == "ep_a2a"}
+
+    def _plan_chunks_delta(before):
+        for k, v in _plan_snapshot().items():
+            if v - before.get(k, 0) > 0:
+                return int(dict(k)["chunks"])
+        return None
+
     def _fb_snapshot():
         return {tuple(sorted(lb.items())): v
                 for lb, v in dma.WIRE_FALLBACK.samples()}
@@ -353,19 +369,24 @@ def bench_chunk_sweep(jax, *, tokens, hidden, ffn, experts, topk, iters,
     t_wire = _time_fn(wire_fn, (x, logits), iters)
     t_gemm = _time_fn(gemm_fn, (recv, wg, wu, wd), iters)
     fb0 = _fb_snapshot()
+    pl0 = _plan_snapshot()
     t1 = _time_fn(layer_fn(1), (x, logits, wg, wu, wd), iters)
     fb1 = _fb_delta(fb0)
+    rc1 = _plan_chunks_delta(pl0)
 
     arms = []
     for nc in chunks:
         if nc == 1:
-            t_n, fb = t1, fb1
+            t_n, fb, rc = t1, fb1, rc1
         else:
             before = _fb_snapshot()
+            plb = _plan_snapshot()
             t_n = _time_fn(layer_fn(nc), (x, logits, wg, wu, wd), iters)
             fb = _fb_delta(before)
+            rc = _plan_chunks_delta(plb)
         arms.append({
             "chunks": nc,
+            "resolved_chunks": rc,
             "layer_us": round(t_n * 1e6, 1),
             "vs_unchunked": round(t_n / max(t1, 1e-12), 3),
             "overlap_efficiency": round(

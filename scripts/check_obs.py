@@ -3,6 +3,7 @@
 
 Usage: python scripts/check_obs.py TRACE_JSON METRICS_PROM
        python scripts/check_obs.py --quant METRICS_PROM WIRE_DTYPE
+       python scripts/check_obs.py --plan METRICS_PROM BENCH_JSON
 
 Asserts, with a named failure for each:
 
@@ -20,6 +21,13 @@ export a nonzero ``ep_bytes_total{...,wire_dtype="<WIRE_DTYPE>"}`` sample
 — i.e. a quantized run's wire bytes landed on the labeled byte series the
 benches read bandwidth off (docs/QUANT_WIRE.md), not on an unlabeled or
 full-precision bucket.
+
+``--plan`` mode (the planner smoke arm): the metrics file must export a
+nonzero ``collective_plan_total`` sample (every planner decision lands
+there) plus the ``collective_plan_predicted_us`` gauge, and every arm of
+the bench's ``all_reduce_plan`` JSON lines must carry an ``algo`` label
+present on that counter — i.e. bench arms were labeled off the REAL plan
+series, not mirrored selector math (docs/PLAN_BENCH.md round-8).
 """
 
 from __future__ import annotations
@@ -113,14 +121,66 @@ def check_quant_metrics(path: str, wire_dtype: str) -> None:
           f"{label} byte series")
 
 
+def check_plan_metrics(path: str, bench_json: str) -> None:
+    with open(path) as f:
+        lines = f.read().splitlines()
+    hits = [ln for ln in lines if ln.startswith("collective_plan_total{")]
+    nonzero = [ln for ln in hits if float(ln.rsplit(" ", 1)[1]) > 0]
+    if not nonzero:
+        fail(f"{path}: no nonzero collective_plan_total sample — the "
+             f"planner's decisions never reached the plan series")
+    if not any(ln.startswith("collective_plan_predicted_us")
+               for ln in lines):
+        fail(f"{path}: missing collective_plan_predicted_us gauge — no "
+             f"modeled cost beside the decisions")
+    algos = set()
+    for ln in nonzero:
+        for part in ln[ln.index("{") + 1:ln.index("}")].split(","):
+            k, _, v = part.partition("=")
+            if k == "algo":
+                algos.add(v.strip('"'))
+    arms = 0
+    with open(bench_json) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("bench") != "all_reduce_plan":
+                continue
+            for arm in rec.get("arms", []):
+                arms += 1
+                if arm.get("algo") not in algos:
+                    fail(f"{bench_json}: arm labeled {arm.get('algo')!r} "
+                         f"has no collective_plan_total series in {path} "
+                         f"(counter algos: {sorted(algos)}) — the label "
+                         f"did not come off the plan counter")
+                if "modeled_us" not in arm:
+                    fail(f"{bench_json}: arm {arm.get('algo')!r} carries "
+                         f"no modeled_us")
+    if arms < 1:
+        fail(f"{bench_json}: no all_reduce_plan arms to cross-check")
+    print(f"check_obs: plan metrics OK — {len(nonzero)} nonzero plan "
+          f"series, {arms} bench arm(s) label-matched "
+          f"(algos: {sorted(algos)})")
+
+
 def main(argv) -> None:
     if len(argv) == 4 and argv[1] == "--quant":
         check_quant_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
         return
+    if len(argv) == 4 and argv[1] == "--plan":
+        check_plan_metrics(argv[2], argv[3])
+        print("check_obs: ALL OK")
+        return
     if len(argv) != 3:
         fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
-             "check_obs.py --quant METRICS_PROM WIRE_DTYPE")
+             "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
+             "check_obs.py --plan METRICS_PROM BENCH_JSON")
     check_trace(argv[1])
     check_metrics(argv[2])
     print("check_obs: ALL OK")

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Refit the CollectivePlanner's alpha/beta/gamma constants from recorded
+bench JSON — on-TPU recalibration in one command.
+
+Usage:
+    python benchmarks/all_reduce_perf.py --algo all --json > sweep.json
+    python scripts/plan_calibrate.py sweep.json [more.json ...]
+    python scripts/plan_calibrate.py < sweep.json
+
+Reads ``all_reduce_plan`` lines (benchmarks/all_reduce_perf.py --json; any
+other JSON lines are skipped), builds the design matrix from the SAME
+feature arithmetic the planner charges (uccl_tpu.collective.plan.
+cost_features — shared import, never mirrored), and least-squares fits:
+
+* plan-family arms (ring | hd | bidir | torus | pallas):
+  ``time_us ~= alpha * hops + beta * serial_wire_bytes + gamma * launches``
+* xla arms: ``time_us ~= xla_alpha + xla_beta * snake * bytes`` (snake
+  estimated from 2-axis lines when present, else left at its default).
+
+Prints the fitted constants, per-arm residuals under them, and the
+``export UCCL_TPU_PLAN_*`` lines that pin the planner to this substrate
+(docs/PLAN_BENCH.md round-8 addendum). Exits nonzero when the input holds
+no usable arms.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+# jax-free import path: plan.py pulls jax, which is fine on any substrate
+# this script runs on (the same container the bench ran in)
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+PLAN_ALGOS = ("ring", "hd", "bidir", "torus", "pallas")
+
+
+def _rows(lines):
+    """(algo, world, worlds, n_axes, bytes, time_us) per arm of every
+    all_reduce_plan line. Arms whose plan label carries
+    ``outcome="fallback"`` are dropped: their timings are the lax mirror's,
+    not the kernel's — fitting them as the kernel would teach the planner
+    to pick it exactly where it degrades."""
+    out = []
+    for ln in lines:
+        ln = ln.strip()
+        if not ln or not ln.startswith("{"):
+            continue
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("bench") != "all_reduce_plan":
+            continue
+        worlds = None
+        if rec.get("mesh2d"):
+            a, b = (int(v) for v in rec["mesh2d"].lower().split("x"))
+            worlds = (a, b)
+        for arm in rec.get("arms", []):
+            if arm.get("outcome") == "fallback":
+                continue
+            out.append((arm["algo"], int(rec["world"]), worlds,
+                        int(rec.get("n_axes", 1)), float(rec["bytes"]),
+                        float(arm["time_us"])))
+    return out
+
+
+def fit(rows):
+    from uccl_tpu.collective import plan as _plan
+
+    plan_rows = [r for r in rows if r[0] in PLAN_ALGOS]
+    xla_rows = [r for r in rows if r[0] == "xla"]
+    fitted = {}
+
+    if plan_rows:
+        feats, times = [], []
+        for algo, world, worlds, _n_axes, nbytes, t in plan_rows:
+            feats.append(_plan.cost_features(algo, world, nbytes,
+                                             worlds=worlds))
+            times.append(t)
+        a = np.asarray(feats, np.float64)
+        y = np.asarray(times, np.float64)
+        coef, *_ = np.linalg.lstsq(a, y, rcond=None)
+        alpha, beta, gamma = (max(float(c), 0.0) for c in coef)
+        fitted.update(PLAN_ALPHA_US=alpha, PLAN_BETA_US_PER_BYTE=beta,
+                      PLAN_GAMMA_US=gamma)
+
+    if xla_rows:
+        one = [(b, t) for _a, _w, _ws, nx, b, t in xla_rows if nx == 1]
+        two = [(b, t) for _a, _w, _ws, nx, b, t in xla_rows if nx > 1]
+        base = one or two  # fit the line on whichever topology we have
+        a = np.stack([np.ones(len(base)),
+                      np.asarray([b for b, _ in base], np.float64)], axis=1)
+        y = np.asarray([t for _, t in base], np.float64)
+        (xa, xb), *_ = np.linalg.lstsq(a, y, rcond=None)
+        xa, xb = max(float(xa), 0.0), max(float(xb), 0.0)
+        fitted.update(PLAN_XLA_ALPHA_US=xa, PLAN_XLA_BETA_US_PER_BYTE=xb)
+        if one and two and xb > 0:
+            snakes = [max((t - xa) / (xb * b), 1.0) for b, t in two if b > 0]
+            if snakes:
+                fitted["PLAN_XLA_SNAKE"] = float(np.mean(snakes))
+    return fitted
+
+
+def residuals(rows, fitted):
+    """Per-arm (algo, bytes, measured, modeled) under the fitted model."""
+    from uccl_tpu.collective import plan as _plan
+
+    model = _plan.CostModel(
+        alpha_us=fitted.get("PLAN_ALPHA_US", _plan._PLAN_ALPHA.get()),
+        beta_us_per_byte=fitted.get("PLAN_BETA_US_PER_BYTE",
+                                    _plan._PLAN_BETA.get()),
+        gamma_us=fitted.get("PLAN_GAMMA_US", _plan._PLAN_GAMMA.get()),
+        xla_alpha_us=fitted.get("PLAN_XLA_ALPHA_US",
+                                _plan._PLAN_XLA_ALPHA.get()),
+        xla_beta_us_per_byte=fitted.get("PLAN_XLA_BETA_US_PER_BYTE",
+                                        _plan._PLAN_XLA_BETA.get()),
+        xla_snake=fitted.get("PLAN_XLA_SNAKE", _plan._PLAN_XLA_SNAKE.get()),
+    )
+    out = []
+    for algo, world, worlds, n_axes, nbytes, t in rows:
+        if algo not in PLAN_ALGOS + ("xla",):
+            continue
+        pred = model.predict(algo, world, int(nbytes), n_axes, worlds)
+        out.append((algo, int(nbytes), t, pred))
+    return out
+
+
+def main(argv) -> int:
+    if len(argv) > 1:
+        lines = []
+        for path in argv[1:]:
+            with open(path) as f:
+                lines.extend(f.read().splitlines())
+    else:
+        lines = sys.stdin.read().splitlines()
+    rows = _rows(lines)
+    if not rows:
+        print("plan_calibrate: no all_reduce_plan arms in input",
+              file=sys.stderr)
+        return 1
+    fitted = fit(rows)
+    print(f"# plan_calibrate: {len(rows)} arms "
+          f"({sum(1 for r in rows if r[0] in PLAN_ALGOS)} plan-family, "
+          f"{sum(1 for r in rows if r[0] == 'xla')} xla)")
+    print(f"# {'algo':>8} {'bytes':>12} {'measured_us':>12} {'modeled_us':>12}")
+    for algo, nbytes, t, pred in residuals(rows, fitted):
+        print(f"  {algo:>8} {nbytes:>12} {t:>12.1f} {pred:>12.1f}")
+    print("# pin the planner to this substrate:")
+    for k, v in sorted(fitted.items()):
+        print(f"export UCCL_TPU_{k}={v:.6g}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -20,6 +20,12 @@ timeout 300 python scripts/pallas_a2a_proof.py --interpret --wire-dtype fp8 \
   --metrics-out /tmp/qa_quant_metrics.prom; check $?
 python scripts/check_obs.py --quant /tmp/qa_quant_metrics.prom fp8; check $?
 
+note "planner smoke tier (interpret-mode bidir allreduce: decision on collective_plan_total, bench arm labeled off the counter, oracle-exact vs the numpy sum oracle)"
+timeout 300 python benchmarks/all_reduce_perf.py --devices 4 --algo bidir \
+  --json --check --min-bytes 4096 --max-bytes 4096 --iters 2 \
+  --metrics-out /tmp/qa_plan_metrics.prom > /tmp/qa_plan_bench.json; check $?
+python scripts/check_obs.py --plan /tmp/qa_plan_metrics.prom /tmp/qa_plan_bench.json; check $?
+
 note "serving engine smoke tier (fail-fast: 2 slots, 6 mixed-length requests, oracle match + no leaked slots)"
 JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
   --requests 6 --prompt-len 8 --new-tokens 4 --arrival-rate 50 --check-oracle; check $?

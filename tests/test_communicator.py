@@ -123,6 +123,35 @@ class TestCache:
         assert len(comm._cache) == n
 
 
+class TestPlanCacheKeys:
+    """PR 7 satellite: the _compiled cache key carries the RESOLVED plan
+    (algo + chunks + wire_dtype), never the "auto" spelling — two calls
+    that resolve to different plans must not share a compiled fn."""
+
+    def test_auto_resolutions_do_not_share_compiled_fn(
+            self, mesh_dp8, rng, monkeypatch):
+        from uccl_tpu.utils import config as cfg
+
+        comm = Communicator(mesh_dp8, "dp")
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        gx = comm.device_put(x)
+        a = np.asarray(comm.all_reduce(gx, algo="auto"))  # small pow2: hd
+        monkeypatch.setenv("UCCL_TPU_AR_ALGO", "ring")
+        cfg.reset_all()
+        try:
+            b = np.asarray(comm.all_reduce(gx, algo="auto"))  # forced ring
+        finally:
+            monkeypatch.delenv("UCCL_TPU_AR_ALGO")
+            cfg.reset_all()
+        keys = [k for k in comm._cache if k[0] == "ar"]
+        assert len(keys) == 2, keys
+        assert {k[2] for k in keys} == {"hd", "ring"}
+        # key layout: ("ar", op, algo, chunks, shape, dtype, wire_dtype)
+        for k in keys:
+            assert isinstance(k[3], int) and k[6] is None
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
 class TestTorusAlgo:
     def test_torus_matches_xla(self, devices, rng):
         from uccl_tpu.parallel.mesh import MeshConfig, make_mesh

@@ -203,6 +203,140 @@ class TestAllReduce:
             pallas_ccl._MAX_VMEM_BYTES.reset()
 
 
+class TestBidir:
+    """The paired counter-rotating ring kernels (round 8, the FlexLink
+    pair): two unidirectional kernels on paired collective ids, each
+    carrying half the payload. 1-axis meshes so every arm runs under the
+    legacy discharge interpreter too; worlds 4/8/5 — the odd world is what
+    catches the credit fenceposts, exactly like TestOddWorlds."""
+
+    @staticmethod
+    def _mesh(devices, n):
+        return Mesh(np.array(devices[:n]), ("dp",))
+
+    @staticmethod
+    def _mirror_fn(n, wire_dtype=None):
+        """The directed lax mirror pair — the exact code the pair-level
+        budget fallback runs, so kernel == this pins kernel == fallback."""
+
+        def f(v):
+            flat = v.reshape(-1)
+            half = flat.size // 2
+            fwd = pallas_ccl._directed_ar_mirror(flat[:half], "dp", n, 1,
+                                                 wire_dtype)
+            bwd = pallas_ccl._directed_ar_mirror(flat[half:], "dp", n, -1,
+                                                 wire_dtype)
+            return jnp.concatenate([fwd, bwd]).reshape(v.shape)
+
+        return f
+
+    def test_matches_sum_and_mirror(self, devices, rng):
+        """World 4, f32 (the tier-1 arm): oracle-exact vs the sum AND
+        bit-identical to the directed lax mirror pair."""
+        n = 4
+        mesh = self._mesh(devices, n)
+        x = jnp.asarray(rng.normal(size=(n, 41)), jnp.float32)
+        got = _run(
+            mesh,
+            lambda v: pallas_ccl.bidir_all_reduce(v, "dp", interpret=True),
+            x, P("dp"), P("dp", None),
+        )
+        want = np.tile(np.asarray(x).sum(0), (n, 1))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        mirror = _run(mesh, self._mirror_fn(n), x, P("dp"), P("dp", None))
+        np.testing.assert_array_equal(got, mirror)
+
+    def test_budget_fallback_counted(self, devices, rng, monkeypatch):
+        """The pair-level budget gate degrades BOTH rings to the mirror as
+        a unit — counted on ep_wire_fallback_total{what="all_reduce_bidir"}
+        AND collective_plan_total{algo="bidir", outcome="fallback"}, and
+        still numerically correct."""
+        from uccl_tpu.collective import dma, plan as plan_mod
+
+        monkeypatch.setenv("UCCL_TPU_PALLAS_CCL_MAX_BYTES", "64")
+        pallas_ccl._MAX_VMEM_BYTES.reset()
+        try:
+            n = 4
+            mesh = self._mesh(devices, n)
+            x = jnp.asarray(rng.normal(size=(n, 64)), jnp.float32)
+            fb = {tuple(sorted(lb.items())): v
+                  for lb, v in dma.WIRE_FALLBACK.samples()}
+            pk = (("algo", "bidir"), ("chunks", "2"),
+                  ("outcome", "fallback"), ("wire_dtype", "none"))
+            pl = {tuple(sorted(lb.items())): v
+                  for lb, v in plan_mod.PLAN_TOTAL.samples()}
+            got = _run(
+                mesh,
+                lambda v: pallas_ccl.bidir_all_reduce(v, "dp",
+                                                      interpret=True),
+                x, P("dp"), P("dp", None),
+            )
+            want = np.tile(np.asarray(x).sum(0), (n, 1))
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+            fb2 = {tuple(sorted(lb.items())): v
+                   for lb, v in dma.WIRE_FALLBACK.samples()}
+            hit = [k for k, v in fb2.items()
+                   if v > fb.get(k, 0)
+                   and dict(k)["what"] == "all_reduce_bidir"]
+            assert hit, f"no counted all_reduce_bidir downgrade in {fb2}"
+            pl2 = {tuple(sorted(lb.items())): v
+                   for lb, v in plan_mod.PLAN_TOTAL.samples()}
+            assert pl2.get(pk, 0) == pl.get(pk, 0) + 1
+        finally:
+            monkeypatch.delenv("UCCL_TPU_PALLAS_CCL_MAX_BYTES")
+            pallas_ccl._MAX_VMEM_BYTES.reset()
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("n", [8, 5])
+    def test_oracle_worlds(self, devices, rng, n):
+        x = jnp.asarray(rng.normal(size=(n, 72)), jnp.float32)
+        got = _run(
+            self._mesh(devices, n),
+            lambda v: pallas_ccl.bidir_all_reduce(v, "dp", interpret=True),
+            x, P("dp"), P("dp", None),
+        )
+        want = np.tile(np.asarray(x).sum(0), (n, 1))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        mirror = _run(self._mesh(devices, n), self._mirror_fn(n), x,
+                      P("dp"), P("dp", None))
+        np.testing.assert_array_equal(got, mirror)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("n", [4, 8, 5])
+    def test_bf16(self, devices, rng, n):
+        x = jnp.asarray(rng.normal(size=(n, 64)), jnp.bfloat16)
+        got = _run(
+            self._mesh(devices, n),
+            lambda v: pallas_ccl.bidir_all_reduce(v, "dp", interpret=True),
+            x, P("dp"), P("dp", None),
+        ).astype(np.float32)
+        want = np.tile(
+            np.asarray(x, np.float32).sum(0, keepdims=True), (n, 1)
+        )
+        np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("n", [4, 8, 5])
+    def test_fp8_wire(self, devices, rng, n):
+        """fp8 wire: tolerance-exact vs the f32 oracle AND bit-identical to
+        the quantized directed mirror pair (the counted fallback path)."""
+        mesh = self._mesh(devices, n)
+        x = jnp.asarray(rng.normal(size=(n, 40)), jnp.float32)
+        got = _run(
+            mesh,
+            lambda v: pallas_ccl.bidir_all_reduce(v, "dp", interpret=True,
+                                                  wire_dtype="fp8"),
+            x, P("dp"), P("dp", None),
+        )
+        want = np.tile(np.asarray(x).sum(0), (n, 1))
+        # one quantize round trip per RS hop + one on the gathered copy
+        # (docs/QUANT_WIRE.md error model)
+        np.testing.assert_allclose(got, want, rtol=0.2, atol=0.6)
+        mirror = _run(mesh, self._mirror_fn(n, "fp8"), x, P("dp"),
+                      P("dp", None))
+        np.testing.assert_array_equal(got, mirror)
+
+
 class TestOddWorlds:
     """Rings at n ∈ {3, 5} on 1-axis meshes: odd n is exactly what catches
     the ``s <= n - 4`` credit-window arithmetic (n=5 has ONE credited step

@@ -253,6 +253,143 @@ class TestHalvingDoubling:
         )
 
 
+class TestPlanner:
+    """The cost-model CollectivePlanner (round 8): wire-byte-aware
+    decisions, obs emission, forced calibration, EP chunk depth."""
+
+    def test_wire_dtype_shifts_hd_threshold(self):
+        """Regression (PR 7 satellite): the byte threshold is charged at
+        ACTUAL wire bytes, so fp8 pulls a just-over-threshold payload from
+        xla/torus back to hd."""
+        import jax.numpy as jnp
+
+        pl = plan.get_planner()
+        shape = (70000,)  # 280000 B f32 — just over the 262144 B hd cap
+        assert pl.plan_all_reduce(shape, jnp.float32, 8).algo == "xla"
+        assert pl.plan_all_reduce(
+            shape, jnp.float32, 8, wire_dtype="fp8"
+        ).algo == "hd"
+        assert pl.plan_all_reduce(
+            shape, jnp.float32, 8, n_axes=2, worlds=(2, 4)
+        ).algo == "torus"
+        assert pl.plan_all_reduce(
+            shape, jnp.float32, 8, n_axes=2, worlds=(2, 4),
+            wire_dtype="fp8"
+        ).algo == "hd"
+
+    def test_bidir_wins_large_single_axis_in_budget(self):
+        """Eligible + in budget, the counter-rotating pair's halved serial
+        byte volume beats hd/xla in the bandwidth range."""
+        import jax.numpy as jnp
+
+        pl = plan.get_planner()
+        p = pl.plan_all_reduce((16384,), jnp.float32, 8, pallas_ok=True)
+        assert p.algo == "bidir" and p.chunks == 2
+        # over the interpret budget the quiet probe drops the candidate —
+        # auto must not plan a kernel whose first act is a counted downgrade
+        p2 = pl.plan_all_reduce((1 << 20,), jnp.float32, 8, pallas_ok=True)
+        assert p2.algo == "xla"
+
+    def test_decisions_land_on_obs(self):
+        import jax.numpy as jnp
+        from uccl_tpu.obs import counters as obsc
+
+        fam = obsc.counter("collective_plan_total")
+        before = {tuple(sorted(lb.items())): v for lb, v in fam.samples()}
+        p = plan.get_planner().plan_all_reduce((256,), jnp.float32, 8)
+        key = (("algo", p.algo), ("chunks", str(p.chunks)),
+               ("outcome", "model"), ("wire_dtype", "none"))
+        after = {tuple(sorted(lb.items())): v for lb, v in fam.samples()}
+        assert after.get(key, 0) == before.get(key, 0) + 1
+        g = obsc.gauge("collective_plan_predicted_us")
+        assert g.get(algo=p.algo, chunks=str(p.chunks),
+                     wire_dtype="none") == pytest.approx(p.predicted_us)
+
+    def test_forced_outcome(self, monkeypatch):
+        from uccl_tpu.utils import config as cfg
+
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("UCCL_TPU_AR_ALGO", "ring")
+        cfg.reset_all()
+        try:
+            p = plan.get_planner().plan_all_reduce((1 << 20,), jnp.float32,
+                                                   8)
+            assert p.algo == "ring" and p.outcome == "forced"
+        finally:
+            monkeypatch.delenv("UCCL_TPU_AR_ALGO")
+            cfg.reset_all()
+
+    def test_ep_auto_depth_scales_with_wire_time(self):
+        pl = plan.get_planner()
+        m = pl.model
+        small = int(8 * m.gamma_us / m.beta_us_per_byte)
+        big = int(100 * m.gamma_us / m.beta_us_per_byte)
+        assert pl.ep_auto_depth(small, capacity=64) == 2
+        assert pl.ep_auto_depth(big, capacity=64) == 4
+        assert pl.ep_auto_depth(big, capacity=3) == 3  # capacity-capped
+
+    def test_cost_features_shapes(self):
+        hops, vol, launches = plan.cost_features("bidir", 8, 1000)
+        assert hops == 14 and launches == 2
+        assert vol == pytest.approx(7 / 8 * 1000)
+        rh, rvol, rl = plan.cost_features("ring", 8, 1000)
+        assert rvol == pytest.approx(2 * vol) and rl == 1
+        th, tvol, _ = plan.cost_features("torus", 8, 1000, worlds=(2, 4))
+        assert th == 2 * 1 + 2 * 3
+        assert tvol == pytest.approx((1.0 + 6 / 8) * 1000)
+
+
+class TestCalibrate:
+    """scripts/plan_calibrate.py recovers model constants from bench JSON
+    generated with known constants (pure numpy — no devices)."""
+
+    @staticmethod
+    def _calibrate_mod():
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "plan_calibrate.py")
+        spec = importlib.util.spec_from_file_location("plan_calibrate", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_fit_recovers_constants(self):
+        import json
+
+        pc = self._calibrate_mod()
+        model = plan.CostModel(
+            alpha_us=3.0, beta_us_per_byte=2e-3, gamma_us=7.0,
+            xla_alpha_us=55.0, xla_beta_us_per_byte=1.1e-3, xla_snake=2.0,
+        )
+        lines = []
+        for nbytes in (4096, 65536, 1 << 20):
+            arms = [
+                {"algo": a, "time_us": model.predict(a, 8, nbytes),
+                 "modeled_us": 0.0}
+                for a in ("xla", "ring", "hd", "bidir")
+            ]
+            lines.append(json.dumps({
+                "bench": "all_reduce_plan", "bytes": nbytes, "world": 8,
+                "n_axes": 1, "mesh2d": None, "arms": arms,
+            }))
+        rows = pc._rows(lines)
+        fitted = pc.fit(rows)
+        assert fitted["PLAN_ALPHA_US"] == pytest.approx(3.0, rel=1e-3)
+        assert fitted["PLAN_BETA_US_PER_BYTE"] == pytest.approx(2e-3,
+                                                               rel=1e-3)
+        assert fitted["PLAN_GAMMA_US"] == pytest.approx(7.0, rel=1e-3)
+        assert fitted["PLAN_XLA_ALPHA_US"] == pytest.approx(55.0, rel=1e-3)
+        assert fitted["PLAN_XLA_BETA_US_PER_BYTE"] == pytest.approx(
+            1.1e-3, rel=1e-3)
+
+    def test_no_arms_fails(self):
+        pc = self._calibrate_mod()
+        assert pc._rows(["not json", '{"bench": "other"}']) == []
+
+
 class TestSelector:
     def test_small_power_of_two_prefers_hd(self):
         assert plan.select_all_reduce_algo(1024, 8) == "hd"

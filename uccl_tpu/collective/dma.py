@@ -97,11 +97,15 @@ def record_fallback(what: str, reason: str, detail=None, msg=None) -> None:
 # between them, so scales ride the value id shifted by CID_SCALE_OFFSET.
 # Allocation: 0 = the ring collectives (pallas_ccl default),
 # {2,3}/{4,5}/{6,7} = dispatch/combine/generic-a2a value lanes,
-# {10,11}/{12,13}/{14,15} = their scale lanes.
+# {10,11}/{12,13}/{14,15} = their scale lanes, {16,17} = the bidir
+# allreduce's paired fwd/bwd ring kernels (airborne CONCURRENTLY by
+# design — the FlexLink counter-rotating pair — so they must never share
+# an id), {24,25} = their scale lanes.
 CID_EP_DISPATCH = 2  # dispatch chunks rotate {2, 3}
 CID_EP_COMBINE = 4  # combine chunks rotate {4, 5}
 CID_A2A = 6  # the generic/unchunked EP all-to-all lane, rotating {6, 7}
 CID_SCALE_OFFSET = 8  # fp8 scale exchange = value id + 8
+CID_RING_BIDIR = 16  # bidir allreduce: fwd ring 16, bwd ring 17
 
 
 def chunk_collective_id(base: int, chunk: int) -> int:

@@ -598,8 +598,20 @@ def hierarchical_all_reduce(comm, dcn: DcnGroup, x):
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
 
+    from uccl_tpu.collective import plan as _plan
+
     local = comm.world
     n = x.shape[1]
+    # the cross-pod decision rides the same plan surface as the on-mesh
+    # algos: ICI ring legs at (alpha, beta) + the DCN ring middle at the
+    # dcn beta — benches and check_obs see "hier" beside "bidir"/"hd"
+    model = _plan.get_planner().model
+    wire_bytes = n * jnp.dtype(x.dtype).itemsize
+    pred = model.predict("hier", local, wire_bytes,
+                         dcn_world=max(dcn.active_world, 1))
+    _plan.PLAN_TOTAL.inc(algo="hier", chunks=1, wire_dtype="none",
+                         outcome="explicit")
+    _plan.PLAN_PREDICTED.set(pred, algo="hier", chunks=1, wire_dtype="none")
     shard = comm.reduce_scatter(x)  # [local_world, N/local]: row i = chunk i
     reduced = dcn.all_reduce(np.asarray(shard))  # host staging + DCN exchange
     # back onto the mesh shard-wise (N/local per device over the host link),

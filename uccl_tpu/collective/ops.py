@@ -46,8 +46,48 @@ def _tally(op: str, x) -> None:
     _BYTES.inc(nbytes, op=op)
 
 
-def all_reduce(x: jax.Array, axis: Axis, op: str = "sum") -> jax.Array:
+def all_reduce(x: jax.Array, axis: Axis, op: str = "sum",
+               algo: str = "xla") -> jax.Array:
+    """``algo="auto"`` routes a sum through the
+    :class:`~uccl_tpu.collective.plan.CollectivePlanner` at trace time
+    (per-shard form: the plan-library candidates are the lax lowerings —
+    xla | hd — since a per-shard call site cannot vouch for kernel
+    addressability); any other op, or ``algo="xla"``, stays on the XLA
+    collective. The decision lands on ``collective_plan_total`` like every
+    planner decision."""
     _tally("all_reduce", x)
+    if op == "sum" and algo == "auto":
+        from uccl_tpu.collective import plan as _plan
+
+        n_axes = len(axis) if isinstance(axis, tuple) else 1
+        world = lax.axis_size(axis)
+        planner = _plan.get_planner()
+        shape = tuple(x.shape) or (1,)
+        plan_ = planner.plan_all_reduce(shape, x.dtype, world,
+                                        n_axes=n_axes, emit=False)
+        lowerable = {"xla", "hd", "ring"} | (
+            {"torus"} if n_axes == 2 else set())
+        exec_algo = plan_.algo if plan_.algo in lowerable else "xla"
+        if exec_algo != plan_.algo:
+            # a forced kernel algo (bidir/pallas via UCCL_TPU_AR_ALGO) this
+            # per-shard site cannot lower — counted, never silent, and the
+            # plan counter records what actually runs
+            from uccl_tpu.collective import dma as _dma
+
+            _dma.record_fallback(
+                "ops_all_reduce", "no_lowering", detail=plan_.algo,
+                msg=f"per-shard all_reduce cannot lower planned "
+                    f"{plan_.algo!r}; running the xla collective",
+            )
+        planner.plan_explicit(exec_algo, shape, x.dtype, world,
+                              n_axes=n_axes, outcome=plan_.outcome)
+        if exec_algo == "hd":
+            return _plan.hd_all_reduce(x, axis)
+        if exec_algo == "ring":
+            return _plan.ring_all_reduce(x, axis)
+        if exec_algo == "torus":
+            return _plan.torus_all_reduce(x, tuple(axis))
+        return lax.psum(x, axis)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "max":

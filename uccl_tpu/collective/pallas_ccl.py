@@ -625,14 +625,17 @@ def ring_reduce_scatter(x: jax.Array, axis, *, direction: int = 1,
 
 
 def ring_all_reduce(x: jax.Array, axis, *, bidirectional: bool = True,
-                    interpret=None, collective_id: int = 0,
-                    wire_dtype=None) -> jax.Array:
+                    direction: int = 1, interpret=None,
+                    collective_id: int = 0, wire_dtype=None) -> jax.Array:
     """Per-shard allreduce (sum) as ONE kernel: reduce-scatter phase, phase
     barrier, all-gather phase. With ``bidirectional=True`` the payload is
     split over two counter-rotating rings whose DMAs are issued back to back
     each step — both ICI directions of the axis carry traffic concurrently
     (the torus form of UCCL's multipath spraying, transport.cc:2186), from
     inside a single kernel rather than two serialized collectives.
+    ``direction`` rotates the single ring when ``bidirectional=False`` —
+    the stream primitive :func:`bidir_all_reduce` pairs a +1 and a -1 ring
+    as separate concurrently-airborne kernels.
 
     ``wire_dtype="fp8"|"int8"`` quantizes the wire (module docstring): the
     RS phase quantizes each hop's send and dequantizes before accumulating
@@ -646,7 +649,7 @@ def ring_all_reduce(x: jax.Array, axis, *, bidirectional: bool = True,
     interpret = _resolve_interpret(interpret)
     wire_dtype = _ring_wire_dtype(x, wire_dtype, "all_reduce")
     n_streams = 2 if bidirectional else 1
-    dirs = (1, -1)[:n_streams]
+    dirs = (1, -1) if bidirectional else (direction,)
     shape = x.shape
     flat = x.reshape(-1)
     # [n*S, rows, 128], slot-major then stream
@@ -664,7 +667,8 @@ def ring_all_reduce(x: jax.Array, axis, *, bidirectional: bool = True,
 
             _count_wire_bytes("ring_all_reduce", "lax", None, wire_total)
             return plan.ring_all_reduce(x, axis,
-                                        bidirectional=bidirectional)
+                                        bidirectional=bidirectional,
+                                        direction=direction)
         _count_wire_bytes("ring_all_reduce", "pallas", None, wire_total)
 
         def kernel(x_ref, buf_ref, stage_ref, send_sem, recv_sem, ack_sem):
@@ -765,3 +769,124 @@ def ring_all_reduce(x: jax.Array, axis, *, bidirectional: bool = True,
     )(view)
     out = buf.reshape(n * n_streams, m)[:, :k]
     return out.reshape(-1)[: flat.size].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# The bidir allreduce: paired counter-rotating ring KERNELS (FlexLink move)
+#
+# ring_all_reduce(bidirectional=True) drives both ICI directions from inside
+# ONE kernel — its two streams share the kernel's entry barrier, phase
+# barrier and fori_loop, so the slower direction gates the faster every
+# step. bidir_all_reduce generalizes pallas_a2a's fwd/bwd stream pairing to
+# rings at LAUNCH granularity instead: two unidirectional ring kernels on
+# paired collective ids (dma.CID_RING_BIDIR / +1 — Mosaic's entry-barrier
+# semaphore is keyed by id, so distinct ids are what lets both kernels be
+# airborne at once), each carrying half the payload, with no data
+# dependency between them — XLA issues both and each ring runs at its own
+# pace over its own ICI direction (FlexLink's ~2x link utilization,
+# PAPERS.md). It composes with wire_dtype like any ring, and its budget
+# fallback is the bit-identical lax mirror of the same directed schedules —
+# counted on ep_wire_fallback_total AND collective_plan_total
+# (outcome="fallback"), never silent.
+
+
+def _directed_ar_mirror(hx, axis, n, d, wire_dtype):
+    """The pure-lax mirror of ONE directed allreduce ring on a flat payload
+    ``hx``: the plan lowering (full precision) or the quantized stream
+    mirror — exactly what the directed kernel computes, bit for bit."""
+    if wire_dtype is None:
+        from uccl_tpu.collective import plan
+
+        return plan.ring_all_reduce(hx, axis, bidirectional=False,
+                                    direction=d)
+    chunks, k, m = _pad_chunks(hx.reshape(-1), n)  # [n, rows, 128]
+    buf = _mirror_quant_ar_stream(chunks, axis, n, d, wire_dtype, hx.dtype)
+    return buf.reshape(n, m)[:, :k].reshape(-1)[: hx.size]
+
+
+def bidir_pair_charge(nelems: int, itemsize: int, n: int, wire_dtype,
+                      interpret) -> int:
+    """VMEM charge of the bidir kernel pair on a flat ``nelems`` payload
+    over a world of ``n`` — THE arithmetic :func:`bidir_all_reduce`'s
+    budget gate charges AND the planner's quiet eligibility probe
+    (``CollectivePlanner._bidir_budget_ok``) checks, shared so auto can
+    never plan a pair the gate would immediately downgrade."""
+    half = nelems // 2
+    halves = (half, nelems - half)
+
+    def _charge(ne: int) -> int:
+        m = _dma.padded_chunk_elems(-(-ne // n))
+        if wire_dtype is None:
+            return ne * itemsize
+        hb = _hop_wire_bytes(m, itemsize, wire_dtype)
+        # accumulator + wire-dtype AG buffers + send/2-slot staging scratch
+        return ne * itemsize + n * hb + 3 * hb
+
+    charges = [_charge(h) for h in halves]
+    # Both kernels are airborne CONCURRENTLY by design, so the VMEM charge
+    # is their sum; under the interpreter kernels run sequentially and the
+    # ceiling is per-buffer deadlock avoidance — charge the larger half.
+    return max(charges) if interpret else sum(charges)
+
+
+def bidir_all_reduce(x: jax.Array, axis, *, interpret=None,
+                     collective_id=None, wire_dtype=None) -> jax.Array:
+    """Per-shard allreduce (sum) over TWO counter-rotating ring kernels on
+    paired collective ids: the payload is split in half, the first half
+    rings forward (+1), the second backward (-1), both kernels airborne
+    concurrently (docstring above). ``wire_dtype`` quantizes each ring's
+    wire exactly like :func:`ring_all_reduce`'s (scale sidecars ride
+    ``collective_id + CID_SCALE_OFFSET`` per ring)."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    interpret = _resolve_interpret(interpret)
+    wire_dtype = _ring_wire_dtype(x, wire_dtype, "all_reduce_bidir")
+    if collective_id is None:
+        collective_id = _dma.CID_RING_BIDIR
+    shape = x.shape
+    flat = x.reshape(-1)
+    half = flat.size // 2
+    if half == 0:  # nothing to split: one directed ring carries it
+        return ring_all_reduce(x, axis, bidirectional=False,
+                               interpret=interpret,
+                               collective_id=collective_id,
+                               wire_dtype=wire_dtype)
+    halves = (flat[:half], flat[half:])
+    itemsize = x.dtype.itemsize
+    pair_charge = bidir_pair_charge(flat.size, itemsize, n, wire_dtype,
+                                    interpret)
+    if not _check_budget(pair_charge, "all_reduce_bidir", interpret):
+        # Counted pair-level downgrade: BOTH rings ride their bit-identical
+        # lax mirrors as a unit (a half-kernel half-mirror split would tie
+        # the surviving kernel to the mirror's XLA schedule — the concurrency
+        # the pairing exists for would be gone, silently).
+        from uccl_tpu.collective import plan
+
+        plan.PLAN_TOTAL.inc(algo="bidir", chunks=2,
+                            wire_dtype=wire_dtype or "none",
+                            outcome="fallback")
+        wire_total = sum(
+            2 * (n - 1) * _hop_wire_bytes(
+                _dma.padded_chunk_elems(-(-h.size // n)), itemsize,
+                wire_dtype)
+            for h in halves
+        )
+        _count_wire_bytes("ring_all_reduce_bidir", "lax", wire_dtype,
+                          wire_total)
+        outs = [
+            _directed_ar_mirror(h, axis, n, d, wire_dtype)
+            for h, d in zip(halves, (1, -1))
+        ]
+        return jnp.concatenate(outs).reshape(shape)
+    # The pair gate passing implies each half passes its own kernel gate
+    # (half charge <= pair charge <= limit), so neither inner call can
+    # secretly downgrade — the pair flies as a pair or falls as a pair.
+    fwd = ring_all_reduce(halves[0], axis, bidirectional=False, direction=1,
+                          interpret=interpret, collective_id=collective_id,
+                          wire_dtype=wire_dtype)
+    bwd = ring_all_reduce(halves[1], axis, bidirectional=False,
+                          direction=-1, interpret=interpret,
+                          collective_id=collective_id + 1,
+                          wire_dtype=wire_dtype)
+    return jnp.concatenate([fwd, bwd]).reshape(shape)

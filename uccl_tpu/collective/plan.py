@@ -23,12 +23,14 @@ Layers:
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Literal, Tuple, Union
+from typing import List, Literal, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from uccl_tpu.obs import counters as _obsc
+from uccl_tpu.obs import tracer as _obstr
 from uccl_tpu.utils import config as _config
 from uccl_tpu.utils.topology import ppermute_pairs
 
@@ -157,19 +159,23 @@ def execute(plan: RingPlan, x: jax.Array, axis: Axis) -> jax.Array:
 
 
 def ring_all_reduce(
-    x: jax.Array, axis: Axis, *, bidirectional: bool = True
+    x: jax.Array, axis: Axis, *, bidirectional: bool = True,
+    direction: int = 1,
 ) -> jax.Array:
     """Bandwidth-optimal ring allreduce as an explicit chunk schedule.
 
     With ``bidirectional=True`` the buffer is split in half and two
     counter-rotating rings run concurrently — both ICI directions of the axis
     carry traffic every step (the torus analog of UCCL's multipath spraying).
+    ``direction`` picks the single ring's rotation when
+    ``bidirectional=False`` — the lax mirror of a directed pallas ring must
+    hop (and therefore accumulate) in the SAME order to stay bit-identical.
     """
     n = lax.axis_size(axis)
     if n == 1:
         return x
     if not bidirectional:
-        return execute(plan_all_reduce(n), x, axis)
+        return execute(plan_all_reduce(n, direction), x, axis)
     flat = x.reshape(-1)
     half = flat.size // 2
     fwd = execute(plan_all_reduce(n), flat[:half], axis)
@@ -465,7 +471,7 @@ def ring_all_gather(x: jax.Array, axis: Axis) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
-# Recursive halving-doubling (latency-optimal) + the algorithm selector
+# Recursive halving-doubling (latency-optimal) + the cost-model planner
 #
 # The reference's lite-collective ships an *algorithm selector over many
 # execution plans* (experimental/lite/lite-collective/collective/: selector +
@@ -531,43 +537,410 @@ _AR_SMALL_BYTES = _config.param(
     "AR_HD_MAX_BYTES",
     1 << 18,
     int,
-    "all_reduce auto-selector: payloads at or under this many bytes prefer "
-    "the log-step halving-doubling plan over a ring (alpha-dominated range)",
+    "all_reduce planner: wire payloads at or under this many bytes are "
+    "eligible for the log-step halving-doubling plan (the calibrated "
+    "alpha-dominated range; the cost model arbitrates inside it)",
 )
 _AR_FORCE_ALGO = _config.param(
     "AR_ALGO",
     "",
     str,
-    "override the all_reduce auto-selector with a fixed algorithm "
-    "(xla|ring|hd|torus|pallas)",
+    "override the all_reduce planner with a fixed algorithm "
+    "(xla|ring|hd|torus|pallas|bidir) — forced calibration: the planner "
+    "still runs and emits its decision, with outcome 'forced'",
 )
+
+# ---------------------------------------------------------------------------
+# The cost-model planner (tentpole of the topology-aware collective work).
+#
+# UCCL's transport sprays chunks over many paths with a pluggable selection
+# policy (PAPER.md §0.1); FAST schedules all-to-all traffic off a cost model
+# and FlexLink pairs counter-rotating streams to recover idle reverse-link
+# bandwidth (PAPERS.md). The TPU expression: an alpha-beta-gamma model over
+# the plan library — per-hop latency (alpha), per-WIRE-byte time (beta, fed
+# by ops.quant.wire_bytes_of so fp8/int8 payloads shift the crossover
+# points), and per-kernel-launch overhead (gamma) — picking both the
+# algorithm (xla | hd | ring | bidir | torus | hier) and the chunk depth,
+# and emitting every decision through the obs layer
+# (``collective_plan_total`` + a ``collective_plan`` trace instant) so
+# benches label arms off REAL decisions, never mirrored selector math.
+#
+# Default constants are STRUCTURAL-ICI derived (a ring hop between torus
+# neighbors is cheap, an XLA collective dispatch is not, a flat XLA
+# schedule over a 2D slice snakes across non-neighbor links, a
+# counter-rotating pair fills both ICI directions) — recalibratable in one
+# command from recorded bench JSON via scripts/plan_calibrate.py, which
+# fits these exact env params (docs/PLAN_BENCH.md round-8 addendum).
+
+_PLAN_ALPHA = _config.param(
+    "PLAN_ALPHA_US", 1.0, float,
+    "planner cost model: per-ring-hop latency in us (neighbor DMA issue + "
+    "sync) — the alpha of the alpha-beta-gamma model",
+)
+_PLAN_BETA = _config.param(
+    "PLAN_BETA_US_PER_BYTE", 1.0e-3, float,
+    "planner cost model: serial wire time per byte per member in us (beta; "
+    "1e-3 = 1 GB/s per ICI direction)",
+)
+_PLAN_GAMMA = _config.param(
+    "PLAN_GAMMA_US", 5.0, float,
+    "planner cost model: per-kernel-launch overhead in us (gamma) — what "
+    "an extra chunk/stream launch costs",
+)
+_PLAN_XLA_ALPHA = _config.param(
+    "PLAN_XLA_ALPHA_US", 40.0, float,
+    "planner cost model: fixed dispatch cost of one XLA-scheduled "
+    "collective in us",
+)
+_PLAN_XLA_BETA = _config.param(
+    "PLAN_XLA_BETA_US_PER_BYTE", 1.7e-3, float,
+    "planner cost model: per-byte time of the XLA collective schedule on a "
+    "single ring axis in us",
+)
+_PLAN_XLA_SNAKE = _config.param(
+    "PLAN_XLA_SNAKE", 2.0, float,
+    "planner cost model: byte-time penalty of a flat XLA schedule over a "
+    "2D torus slice (non-neighbor snake links) relative to one axis",
+)
+_PLAN_DCN_BETA = _config.param(
+    "PLAN_DCN_BETA_US_PER_BYTE", 1.0e-2, float,
+    "planner cost model: per-byte time of the cross-pod DCN leg in us "
+    "(hierarchical allreduce middle phase)",
+)
+
+# get-or-create: the one family every plan decision lands on. Labels:
+# algo, chunks, wire_dtype, outcome (model|forced|explicit|fallback).
+PLAN_TOTAL = _obsc.counter(
+    "collective_plan_total",
+    "collective planner decisions by algorithm, chunk/stream depth, wire "
+    "dtype and outcome (model = cost model chose, forced = UCCL_TPU_AR_ALGO"
+    " calibration override, explicit = caller named the algo, fallback = a "
+    "planned kernel degraded to its counted lax mirror)",
+)
+PLAN_PREDICTED = _obsc.gauge(
+    "collective_plan_predicted_us",
+    "the cost model's predicted time (us) of the last plan decision per "
+    "{algo, chunks, wire_dtype} — benches read modeled cost off this "
+    "instead of mirroring the model arithmetic",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CostModel:
+    """Alpha-beta-gamma constants of the planner (all in us / us-per-byte).
+
+    ``predict`` and ``features`` are the ONE arithmetic shared by the
+    planner, the benches' modeled-cost column and scripts/plan_calibrate.py
+    (which least-squares these exact features against measured times)."""
+
+    alpha_us: float
+    beta_us_per_byte: float
+    gamma_us: float
+    xla_alpha_us: float
+    xla_beta_us_per_byte: float
+    xla_snake: float
+    dcn_beta_us_per_byte: float = 1.0e-2
+
+    @classmethod
+    def from_env(cls) -> "CostModel":
+        return cls(
+            alpha_us=_PLAN_ALPHA.get(),
+            beta_us_per_byte=_PLAN_BETA.get(),
+            gamma_us=_PLAN_GAMMA.get(),
+            xla_alpha_us=_PLAN_XLA_ALPHA.get(),
+            xla_beta_us_per_byte=_PLAN_XLA_BETA.get(),
+            xla_snake=_PLAN_XLA_SNAKE.get(),
+            dcn_beta_us_per_byte=_PLAN_DCN_BETA.get(),
+        )
+
+    def predict(self, algo: str, world: int, wire_bytes: int,
+                n_axes: int = 1, worlds=None, dcn_world: int = 1) -> float:
+        """Predicted us of one allreduce of ``wire_bytes`` per member.
+        ``dcn_world`` (algo "hier" only) adds the cross-pod DCN ring
+        middle at the dcn beta — the ONE hier arithmetic
+        hierarchical_all_reduce's emission and any plan_explicit("hier")
+        share."""
+        if world <= 1 and dcn_world <= 1:
+            return 0.0
+        if algo == "xla":
+            snake = self.xla_snake if n_axes > 1 else 1.0
+            return (self.xla_alpha_us
+                    + self.xla_beta_us_per_byte * snake * wire_bytes)
+        hops, serial_bytes, launches = cost_features(
+            algo, world, wire_bytes, worlds=worlds
+        )
+        t = (self.alpha_us * hops
+             + self.beta_us_per_byte * serial_bytes
+             + self.gamma_us * launches)
+        if algo == "hier" and dcn_world > 1:
+            t += (self.dcn_beta_us_per_byte
+                  * 2.0 * (dcn_world - 1) / dcn_world * wire_bytes)
+        return t
+
+
+def torus_split(world: int) -> Tuple[int, int]:
+    """The (a, b) factor pair of ``world`` closest to square — the planner's
+    stand-in torus shape when only the flat world size is known (a caller
+    with real axis sizes passes them via ``worlds``)."""
+    a = int(world ** 0.5)
+    while a > 1 and world % a:
+        a -= 1
+    return (max(a, 1), world // max(a, 1))
+
+
+def cost_features(algo: str, world: int, wire_bytes: int,
+                  worlds=None) -> Tuple[float, float, int]:
+    """(hops, serial wire bytes per member, kernel launches) of one
+    allreduce under ``algo`` — the design matrix row plan_calibrate.py fits
+    alpha/beta/gamma against, and the terms CostModel.predict charges.
+
+    ``serial wire bytes`` is the byte volume on the critical path: the
+    bidir pair carries half the payload per direction CONCURRENTLY (the
+    FlexLink ~2x move), so its serial volume is half the ring's.
+    """
+    w = world
+    b = float(wire_bytes)
+    if algo in ("ring", "pallas"):
+        return 2.0 * (w - 1), 2.0 * (w - 1) / w * b, 1
+    if algo == "bidir":
+        return 2.0 * (w - 1), (w - 1) / w * b, 2
+    if algo == "hd":
+        if w & (w - 1):  # ring fallback worlds
+            return 2.0 * (w - 1), 2.0 * (w - 1) / w * b, 1
+        import math
+
+        return 2.0 * math.log2(w), 2.0 * (w - 1) / w * b, 1
+    if algo == "torus":
+        a, bb = worlds if worlds and len(worlds) == 2 else torus_split(w)
+        if a == 1 or bb == 1:  # degenerate: routes through the flat ring
+            return 2.0 * (w - 1), 2.0 * (w - 1) / w * b, 1
+        hops = 2.0 * (a - 1) + 2.0 * (bb - 1)
+        vol = (2.0 * (a - 1) / a + 2.0 * (bb - 1) / (a * bb)) * b
+        return hops, vol, 1
+    if algo == "hier":
+        # ICI reduce-scatter + all-gather legs around the DCN ring middle:
+        # the local legs are ring-shaped, the DCN leg is charged by the
+        # caller at dcn beta (hierarchical_all_reduce).
+        return 2.0 * (w - 1), 2.0 * (w - 1) / w * b, 1
+    if algo == "xla":
+        return 1.0, b, 1
+    raise ValueError(f"unknown plan algo {algo!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One planner decision: what will carry the collective and why."""
+
+    algo: str
+    chunks: int  # concurrent streams/kernels (bidir = 2) or chunk depth
+    wire_dtype: Optional[str]
+    world: int
+    wire_bytes: int
+    predicted_us: float
+    outcome: str  # "model" | "forced" | "explicit"
+
+
+class CollectivePlanner:
+    """Cost-model-driven algorithm + chunk-depth selection for collectives.
+
+    The decision point every auto allreduce and EP chunk-depth resolution
+    flows through (Communicator.all_reduce(algo="auto"),
+    ep.ops.resolve_chunks). Every decision — modeled, forced via
+    UCCL_TPU_AR_ALGO, or explicitly named by the caller — is emitted on
+    ``collective_plan_total{algo,chunks,wire_dtype,outcome}`` plus a
+    ``collective_plan`` trace instant carrying the model's predicted cost,
+    so benches and check_obs read REAL decisions off the obs layer.
+    """
+
+    def __init__(self, model: Optional[CostModel] = None):
+        self._model = model
+
+    @property
+    def model(self) -> CostModel:
+        return self._model if self._model is not None else CostModel.from_env()
+
+    # -- wire-byte accounting ------------------------------------------------
+
+    @staticmethod
+    def wire_bytes(payload_shape, dtype, wire_dtype) -> int:
+        from uccl_tpu.ops import quant as _quant
+
+        return _quant.wire_bytes_of(tuple(payload_shape), dtype,
+                                    _quant.resolve_wire_dtype(wire_dtype))
+
+    # -- the allreduce decision ----------------------------------------------
+
+    def plan_all_reduce(self, payload_shape, dtype, world: int, *,
+                        n_axes: int = 1, worlds=None, wire_dtype=None,
+                        pallas_ok: bool = False, emit: bool = True) -> Plan:
+        """Pick the allreduce algorithm for a per-member payload.
+
+        ``payload_shape``/``dtype`` describe ONE member's buffer;
+        ``wire_dtype`` shifts every byte term to actual wire bytes (the
+        fp8/int8 payload + scale sidecar), so a quantized payload crosses
+        the hd/torus/ring thresholds at its WIRE size, not its logical
+        size — but a winner that cannot CARRY a quantized wire (anything
+        but the pallas/bidir kernels) is re-labeled and re-priced at the
+        full-precision bytes it will actually ship, so the emitted
+        decision never claims a quantized hd/xla/torus that cannot exist
+        (the caller counts the quant downgrade on the fallback counter).
+        ``pallas_ok`` gates the device-kernel candidates (bidir):
+        the caller asserts its mesh is kernel-addressable; the planner
+        additionally quiet-probes the VMEM/interpret budget so auto never
+        picks a kernel that would immediately downgrade (a FORCED bidir
+        still exercises the counted fallback).
+        """
+        from uccl_tpu.ops import quant as _quant
+
+        wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
+        m = self.model
+        wire_bytes = self.wire_bytes(payload_shape, dtype, wire_dtype)
+
+        def _final(algo: str, cost, outcome: str) -> Plan:
+            wd, wb, c = wire_dtype, wire_bytes, cost
+            if wd is not None and algo not in ("pallas", "bidir"):
+                # selection was priced at wire bytes (the ISSUE-pinned
+                # threshold rule), but this winner ships full precision
+                wd = None
+                wb = self.wire_bytes(payload_shape, dtype, None)
+                c = None
+            if c is None:
+                c = m.predict(algo, world, wb, n_axes, worlds)
+            plan_ = Plan(algo, 2 if algo == "bidir" else 1, wd, world, wb,
+                         c, outcome)
+            return self._emit(plan_) if emit else plan_
+
+        forced = _AR_FORCE_ALGO.get()
+        if forced:
+            return _final(forced, None, "forced")
+        if world <= 1:
+            return _final("xla", 0.0, "model")
+
+        candidates = ["xla"]
+        if world & (world - 1) == 0 and wire_bytes <= _AR_SMALL_BYTES.get():
+            # the calibrated alpha-dominated range (UCCL_TPU_AR_HD_MAX_BYTES
+            # — honored as a hard eligibility cap, the model arbitrates
+            # inside it)
+            candidates.append("hd")
+        if n_axes == 2:
+            candidates.append("torus")
+        if pallas_ok and n_axes == 1 and self._bidir_budget_ok(
+                payload_shape, dtype, wire_dtype, world):
+            candidates.append("bidir")
+
+        best, best_cost = "xla", None
+        for algo in candidates:
+            cost = m.predict(algo, world, wire_bytes, n_axes, worlds)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = algo, cost
+        return _final(best, best_cost, "model")
+
+    def plan_explicit(self, algo: str, payload_shape, dtype, world: int, *,
+                      n_axes: int = 1, worlds=None, wire_dtype=None,
+                      emit: bool = True, outcome: str = "explicit") -> Plan:
+        """Record a caller-named algorithm as a plan (outcome "explicit",
+        overridable when relaying a decision made elsewhere — e.g. the
+        per-shard wrapper recording the algo it actually lowered under the
+        original plan's outcome) with the model's predicted cost beside it
+        — how bench arms get a modeled time without mirroring the model."""
+        from uccl_tpu.ops import quant as _quant
+
+        wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
+        wire_bytes = self.wire_bytes(payload_shape, dtype, wire_dtype)
+        pred = self.model.predict(algo, world, wire_bytes, n_axes, worlds) \
+            if algo in ("xla", "ring", "hd", "torus", "pallas", "bidir",
+                        "hier") else 0.0
+        plan_ = Plan(algo, 2 if algo == "bidir" else 1, wire_dtype, world,
+                     wire_bytes, pred, outcome)
+        return self._emit(plan_) if emit else plan_
+
+    def _bidir_budget_ok(self, payload_shape, dtype, wire_dtype,
+                         world: int) -> bool:
+        """Quiet budget probe: would the paired bidir kernels fit? Charges
+        EXACTLY what the pair gate charges (pallas_ccl.bidir_pair_charge —
+        one shared arithmetic) against the gate's own limit
+        (dma.budget_limit), counts nothing — auto must not plan a kernel
+        whose first act is a counted downgrade."""
+        from uccl_tpu.collective import dma as _dma
+        from uccl_tpu.collective import pallas_ccl as _pccl
+
+        elems = 1
+        for s in payload_shape:
+            elems *= int(s)
+        itemsize = jnp.dtype(dtype).itemsize
+        interpret = _dma.resolve_interpret(None)
+        charge = _pccl.bidir_pair_charge(elems, itemsize, world, wire_dtype,
+                                         interpret)
+        return charge <= _dma.budget_limit(interpret)
+
+    # -- EP chunk depth -------------------------------------------------------
+
+    def ep_auto_depth(self, exchange_bytes: int, capacity: int) -> int:
+        """Auto chunk depth for the pipelined EP layer: 2 is the minimum
+        that buys dispatch/compute/combine overlap; deeper pipelines pay
+        gamma per extra launch, so depth grows only once the modeled wire
+        time dwarfs it (64x / 256x gamma — conservative: the budget gate
+        still arbitrates the final depth)."""
+        m = self.model
+        wire_us = m.beta_us_per_byte * exchange_bytes
+        depth = 2
+        if wire_us >= 256 * m.gamma_us:
+            depth = 8
+        elif wire_us >= 64 * m.gamma_us:
+            depth = 4
+        return max(1, min(depth, capacity))
+
+    def record_ep_chunks(self, resolved: int, *, wire: str,
+                         wire_dtype=None, auto: bool = False) -> int:
+        """Emit an EP chunk-depth resolution on the plan counter (algo
+        "ep_a2a") — ep_bench labels its arms off this series. ``auto``
+        marks an n_chunks=0 request, where the cost model (ep_auto_depth)
+        chose the depth: outcome "model"; a caller-pinned depth records
+        "explicit" (same outcome semantics as the allreduce decisions —
+        OBSERVABILITY.md catalog)."""
+        del wire  # the resolution, not the wire kind, decides the outcome
+        PLAN_TOTAL.inc(algo="ep_a2a", chunks=resolved,
+                       wire_dtype=wire_dtype or "none",
+                       outcome="model" if auto else "explicit")
+        return resolved
+
+    # -- emission -------------------------------------------------------------
+
+    def _emit(self, plan_: Plan) -> Plan:
+        PLAN_TOTAL.inc(algo=plan_.algo, chunks=plan_.chunks,
+                       wire_dtype=plan_.wire_dtype or "none",
+                       outcome=plan_.outcome)
+        PLAN_PREDICTED.set(plan_.predicted_us, algo=plan_.algo,
+                           chunks=plan_.chunks,
+                           wire_dtype=plan_.wire_dtype or "none")
+        _obstr.instant(
+            "collective_plan", track="wire", algo=plan_.algo,
+            chunks=plan_.chunks, wire_dtype=plan_.wire_dtype or "none",
+            outcome=plan_.outcome, world=plan_.world,
+            wire_bytes=plan_.wire_bytes,
+            predicted_us=round(plan_.predicted_us, 2),
+        )
+        return plan_
+
+
+_PLANNER = CollectivePlanner()
+
+
+def get_planner() -> CollectivePlanner:
+    """The process-wide planner (model constants re-read from env params on
+    every decision, so tests/calibration overrides take effect live)."""
+    return _PLANNER
 
 
 def select_all_reduce_algo(
     nbytes: int, world: int, n_axes: int = 1
 ) -> str:
-    """Pick an allreduce algorithm from the plan library (the lite-collective
-    selector role). Policy is the standard alpha-beta model, recalibratable
-    via UCCL_TPU_AR_HD_MAX_BYTES / overridable via UCCL_TPU_AR_ALGO:
-
-    * world 1 → "xla" (no comm; let the compiler elide it).
-    * explicit override set → that.
-    * small payloads (≤ AR_HD_MAX_BYTES), power-of-two world → "hd"
-      (2 log W hops beat 2(W-1) when per-hop latency dominates).
-    * large payloads over a 2D axis pair → "torus" (both ICI axis rings
-      carry traffic, shard-restricted middle phase).
-    * everything else → "xla": measured on this repo's substrates XLA's own
-      schedule wins the bandwidth range on-mesh (docs/PLAN_BENCH.md — honest
-      default; the explicit plans exist for the cross-pod/overlap cases and
-      for recalibration on real multi-chip ICI).
+    """Back-compat selector surface: one planner decision on a flat
+    ``nbytes`` payload (full-precision wire, no device-kernel candidates —
+    the host-side callers that only know a byte count). Emits through the
+    planner like every decision; quantization-aware callers use
+    :meth:`CollectivePlanner.plan_all_reduce` with shape/dtype/wire_dtype.
     """
-    forced = _AR_FORCE_ALGO.get()
-    if forced:
-        return forced
-    if world <= 1:
-        return "xla"
-    if nbytes <= _AR_SMALL_BYTES.get() and world & (world - 1) == 0:
-        return "hd"
-    if n_axes == 2:
-        return "torus"
-    return "xla"
+    return get_planner().plan_all_reduce(
+        (max(1, nbytes // 4),), jnp.float32, world, n_axes=n_axes
+    ).algo

@@ -549,6 +549,7 @@ class Buffer:
                     self.num_local_experts, h,
                     ep_ops.wire_itemsize(False, h, x.dtype,
                                          wire_dtype=wire_dtype),
+                    wire_dtype=wire_dtype,
                 )
             n_chunks = self._resolve_memo[rkey]
         has_ev = previous_event is not None

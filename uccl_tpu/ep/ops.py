@@ -412,25 +412,10 @@ def wire_itemsize(wire_fp8: bool, hidden: int, dtype,
     return jnp.dtype(dtype).itemsize
 
 
-def wire_bytes_of(shape, dtype, wire_dtype=None,
-                  quant_group: int = 128) -> int:
-    """Actual wire bytes of a payload array one EP exchange moves:
-    quantized payload (1 byte/elem) PLUS the f32 scale sidecar when the
-    wire dtype applies, raw element bytes otherwise — the arithmetic the
-    ``ep_bytes_total`` counter and the bench bandwidth math share
-    (docs/QUANT_WIRE.md)."""
-    elems = 1
-    for s in shape:
-        elems *= int(s)
-    itemsize = jnp.dtype(dtype).itemsize
-    if wire_dtype is None or not jnp.issubdtype(
-        jnp.dtype(dtype), jnp.floating
-    ):
-        return elems * itemsize  # full precision / non-float raw wire
-    g = _quant.paying_block(int(shape[-1]), quant_group)
-    if g is None:
-        return elems * itemsize  # quantization would not pay — raw wire
-    return elems + (elems // g) * 4
+# the ONE wire-byte arithmetic (codec-owned now: the planner cost model,
+# the ep_bytes_total counter and the benches all import the same rule) —
+# re-exported under the long-standing EP name
+wire_bytes_of = _quant.wire_bytes_of
 
 
 def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype, wire="lax", *,
@@ -516,10 +501,13 @@ def combine(
 
 def resolve_chunks(n_chunks: int, wire: str, world: int, capacity: int,
                    e_local: int, hidden: int, itemsize: int,
-                   axis=None) -> int:
-    """Effective chunk count for the pipelined EP layer. ``0`` = auto:
-    2 chunks (the minimum that buys dispatch/compute/combine overlap) on the
-    pallas wire when the world and capacity can chunk, else 1. Any request
+                   axis=None, wire_dtype=None) -> int:
+    """Effective chunk count for the pipelined EP layer. ``0`` = auto: the
+    :class:`~uccl_tpu.collective.plan.CollectivePlanner` picks the depth
+    off its cost model (2 — the minimum that buys dispatch/compute/combine
+    overlap — growing to 4/8 once the modeled wire time of one exchange
+    dwarfs the per-launch gamma) on the pallas wire when the world and
+    capacity can chunk, else 1. Any request
     collapses to 1 off the pallas wire (XLA owns the lax schedule), at world
     1 (no wire), on meshes the kernel cannot address (a tuple EP axis under
     the legacy discharge interpreter — every chunk would silently ride lax
@@ -533,15 +521,22 @@ def resolve_chunks(n_chunks: int, wire: str, world: int, capacity: int,
     unchunkable config is the correct auto answer, not a downgrade, and
     stays silent (the budget gate still counts either way: there a
     RESOLVED pipeline was pushed back). The resolved depth — including a
-    downgraded 1 — lands on the ``ep_chunk_depth`` gauge."""
+    downgraded 1 — lands on the ``ep_chunk_depth`` gauge AND on the plan
+    counter (``collective_plan_total{algo="ep_a2a", chunks, wire_dtype}``)
+    so benches label their chunk arms off the real resolution, not the
+    requested knob."""
     n = _resolve_chunks_value(n_chunks, wire, world, capacity, e_local,
                               hidden, itemsize, axis)
+    from uccl_tpu.collective import plan as _plan
     from uccl_tpu.obs import counters as _obsc
 
     _obsc.gauge(
         "ep_chunk_depth",
         "resolved chunk-pipeline depth of the last traced EP layer",
     ).set(n, what="moe_layer")
+    _plan.get_planner().record_ep_chunks(n, wire=wire,
+                                         wire_dtype=wire_dtype,
+                                         auto=(n_chunks == 0))
     return n
 
 
@@ -567,7 +562,13 @@ def _resolve_chunks_value(n_chunks, wire, world, capacity, e_local, hidden,
                                  detail=tuple(axis))
         return 1
     if n_chunks == 0:
-        n_chunks = 2
+        # auto: the planner's cost model picks the depth from the modeled
+        # wire time of ONE exchange vs the per-launch gamma
+        from uccl_tpu.collective import plan as _plan
+
+        n_chunks = _plan.get_planner().ep_auto_depth(
+            world * e_local * capacity * hidden * itemsize, capacity
+        )
     n_chunks = max(1, min(int(n_chunks), capacity))
     if n_chunks > 1:
         cs = _dma.pad_capacity(capacity, n_chunks) // n_chunks
@@ -725,7 +726,7 @@ def moe_ffn(
         n_chunks = resolve_chunks(
             n_chunks, wire, w, capacity, e // w, h,
             wire_itemsize(wire_fp8, h, x.dtype, wire_dtype=wire_dtype),
-            axis=axis,
+            axis=axis, wire_dtype=wire_dtype,
         )
         if n_chunks > 1:
             plan = SlotPlan(rs.token_for_slot, rs.slot, rs.counts)

@@ -113,6 +113,29 @@ def paying_block(d: int, block: int) -> Optional[int]:
     return g if g >= 8 else None
 
 
+def wire_bytes_of(shape, dtype, wire_dtype: Optional[str] = None,
+                  quant_group: int = 128) -> int:
+    """Actual wire bytes one exchange of a payload array moves under the
+    block codec: quantized payload (1 byte/elem) PLUS the f32 scale sidecar
+    when the wire dtype applies, raw element bytes otherwise — the ONE
+    arithmetic the ``ep_bytes_total`` counter, the bench bandwidth math and
+    the :class:`uccl_tpu.collective.plan.CollectivePlanner` cost model
+    share (docs/QUANT_WIRE.md). Formerly ``ep.ops.wire_bytes_of``, which
+    still re-exports it."""
+    elems = 1
+    for s in shape:
+        elems *= int(s)
+    itemsize = jnp.dtype(dtype).itemsize
+    if wire_dtype is None or not jnp.issubdtype(
+        jnp.dtype(dtype), jnp.floating
+    ):
+        return elems * itemsize  # full precision / non-float raw wire
+    g = paying_block(int(shape[-1]), quant_group) if shape else None
+    if g is None:
+        return elems * itemsize  # quantization would not pay — raw wire
+    return elems + (elems // g) * 4
+
+
 def quantize_block(
     x: jax.Array, wire_dtype: str = "fp8", block: int = 128
 ) -> Tuple[jax.Array, jax.Array]:

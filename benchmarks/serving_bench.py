@@ -1,4 +1,5 @@
-"""Serving engine benchmark: arrival rate × slot count × prefill-chunk sweep.
+"""Serving engine benchmark: arrival rate × slot count × prefill-chunk sweep,
+plus the prefix-cache hit-rate sweep and the disaggregated-pair arm.
 
 Each arm runs the continuous-batching engine (uccl_tpu/serving) under a
 synthetic Poisson arrival stream of mixed-length prompts and emits ONE JSON
@@ -8,10 +9,25 @@ surface chunked prefill exists to shrink — ``tpot_p95_ms`` and
 claim (docs/SERVING.md). Compile warmup happens before the clock starts, so
 the percentiles measure serving, not XLA.
 
+``--prefix-hit-rates`` enables the prefix-reuse cache on chunked arms and
+drives a shared-system-prompt workload: with probability p a prompt starts
+with a fixed ``--shared-prefix-len`` token prefix. Per-arm cache
+hits/misses/evictions/tokens-reused and prefill-tokens-computed are
+COUNTER DELTAS around the measured window (warmup excluded), so the
+"prefix hits cut prefill compute" claim is counter-derived, not inferred.
+``--disagg`` additionally runs each arm through the in-process
+disaggregated pair (prefill engine → chunk-streamed KV over loopback p2p →
+decode engine), reporting the decode side's TTFT split into
+queue/prefill/transfer (docs/SERVING.md).
+
     python benchmarks/serving_bench.py --devices 2 --rates 4,16 --slots 2,4
     python benchmarks/serving_bench.py --stack moe --devices 4 --slots 4
     python benchmarks/serving_bench.py --prompt-len 64 --rates 16 \
         --slots 4 --prefill-chunks off,8,32      # the stall-bound sweep
+    python benchmarks/serving_bench.py --prompt-len 64 --rates 16 --slots 4 \
+        --prefill-chunks 8 --prefix-hit-rates 0,0.75 --shared-prefix-len 48
+    python benchmarks/serving_bench.py --disagg --prompt-len 64 --rates 16 \
+        --slots 4 --prefill-chunks 8 --prefix-hit-rates 0,0.75
 """
 
 from __future__ import annotations
@@ -21,19 +37,41 @@ import json
 
 from _bootstrap import init_devices
 
+# the counter families whose per-arm deltas label the output lines
+_ARM_COUNTERS = (
+    ("prefix_cache_hits_total", {}),
+    ("prefix_cache_misses_total", {}),
+    ("prefix_cache_evictions_total", {}),
+    ("prefix_cache_tokens_reused_total", {}),
+    ("serving_prefill_tokens_total", {"kind": "computed"}),
+    ("kv_stream_chunks_total", {"role": "tx"}),
+    ("p2p_bytes_total", {"verb": "write"}),
+)
 
-def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None):
-    step_tokens = (args.step_tokens or None) if prefill_chunk else None
-    if step_tokens is not None and step_tokens < prefill_chunk:
-        return None  # this arm's budget can't admit even one chunk
-    import numpy as np
 
-    from uccl_tpu.serving import DenseBackend, MoEBackend, ServingEngine
-    from uccl_tpu.serving.loadgen import drive, synth_workload, warm_engine
+def _counter_state():
+    from uccl_tpu import obs
 
-    max_seq = args.prompt_len + args.new_tokens
+    return [obs.counter(name).get(**labels) for name, labels in _ARM_COUNTERS]
+
+
+def _counter_deltas(before):
+    out = {}
+    for (name, labels), b, a in zip(_ARM_COUNTERS, before, _counter_state()):
+        key = name.replace("_total", "")
+        if labels:
+            key += "_" + "_".join(labels.values())
+        out[key] = a - b
+    return out
+
+
+def _make_backend(args, jax, stack, n_slots, max_seq):
+    """One serving backend, or None when the arm's pool doesn't tile the
+    MoE mesh — shared by the single-engine and disagg arms. Returns
+    (backend, world, vocab)."""
     if stack == "dense":
         from uccl_tpu.models.dense import DenseConfig, init_params
+        from uccl_tpu.serving import DenseBackend
 
         cfg = DenseConfig(
             vocab=args.vocab, dim=args.dim, n_layers=args.layers,
@@ -41,49 +79,123 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None):
         )
         params = init_params(jax.random.PRNGKey(args.seed), cfg)
         backend = DenseBackend(params, cfg, n_slots=n_slots, max_seq=max_seq)
-        world, vocab = 1, cfg.vocab
-    else:
-        from uccl_tpu.models.moe_inference import (
-            MoEServeConfig, MoEServer, init_params,
-        )
-        from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
-
-        world = len(jax.devices())
-        if n_slots % world:
-            return None  # this arm's pool doesn't tile the mesh
-        cfg = MoEServeConfig(
-            vocab=args.vocab, dim=args.dim, n_layers=args.layers,
-            n_heads=4, n_kv_heads=2, head_dim=args.dim // 4,
-            moe_ffn=args.ffn,
-        )
-        srv = MoEServer(cfg, make_mesh(MeshConfig(dp=world), jax.devices()))
-        params = init_params(jax.random.PRNGKey(args.seed), cfg)
-        backend = MoEBackend(
-            srv, srv.shard_params(params), batch_local=n_slots // world,
-            max_seq=max_seq,
-        )
-        vocab = cfg.vocab
-
-    engine = ServingEngine(
-        backend, prefill_chunk=prefill_chunk, step_tokens=step_tokens,
+        return backend, 1, cfg.vocab
+    from uccl_tpu.models.moe_inference import (
+        MoEServeConfig, MoEServer, init_params,
     )
+    from uccl_tpu.parallel.mesh import MeshConfig, make_mesh
+    from uccl_tpu.serving import MoEBackend
+
+    world = len(jax.devices())
+    if n_slots % world:
+        return None, world, 0  # this arm's pool doesn't tile the mesh
+    cfg = MoEServeConfig(
+        vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+        n_heads=4, n_kv_heads=2, head_dim=args.dim // 4,
+        moe_ffn=args.ffn,
+    )
+    srv = MoEServer(cfg, make_mesh(MeshConfig(dp=world), jax.devices()))
+    params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    backend = MoEBackend(
+        srv, srv.shard_params(params), batch_local=n_slots // world,
+        max_seq=max_seq,
+    )
+    return backend, world, cfg.vocab
+
+
+def _workload(args, vocab, rate, hit_rate):
+    import numpy as np
+
+    from uccl_tpu.serving.loadgen import synth_shared_workload, synth_workload
+
     rng = np.random.default_rng(args.seed)
-    prompts, lens, arrivals = synth_workload(
-        rng, args.requests, args.prompt_len, vocab, rate
-    )
-    warm_engine(engine, lens, max_seq, args.new_tokens)
-    _, wall = drive(engine, prompts, arrivals, args.new_tokens)
+    if hit_rate is None:
+        return synth_workload(rng, args.requests, args.prompt_len, vocab,
+                              rate)
+    shared = args.shared_prefix_len or max(1, args.prompt_len // 2)
+    return synth_shared_workload(rng, args.requests, args.prompt_len, vocab,
+                                 rate, hit_rate, shared)
 
+
+def _arm_header(args, stack, world, rate, n_slots, prefill_chunk,
+                step_tokens, hit_rate):
     from uccl_tpu import obs
 
-    snap = engine.snapshot()
-    return {
+    head = {
         "bench": "serving", "schema_version": obs.SCHEMA_VERSION,
         "stack": stack, "world": world,
         "arrival_rate": rate, "slots": n_slots,
         "prefill_chunk": prefill_chunk, "step_tokens": step_tokens,
         "requests": args.requests, "new_tokens": args.new_tokens,
-        "prompt_len": args.prompt_len, "wall_s": round(wall, 3),
+        "prompt_len": args.prompt_len,
+    }
+    if hit_rate is not None:
+        head["prefix_hit_rate"] = hit_rate
+        head["shared_prefix_len"] = (args.shared_prefix_len
+                                     or max(1, args.prompt_len // 2))
+    return head
+
+
+def _cache_fields(deltas):
+    """Counter-derived per-arm cache/stream numbers (docs/SERVING.md)."""
+    hits, misses = deltas["prefix_cache_hits"], deltas["prefix_cache_misses"]
+    out = {
+        "cache_hits": hits, "cache_misses": misses,
+        "cache_evictions": deltas["prefix_cache_evictions"],
+        "tokens_reused": deltas["prefix_cache_tokens_reused"],
+        "prefill_tokens_computed": deltas["serving_prefill_tokens_computed"],
+    }
+    if hits + misses > 0:
+        out["observed_hit_rate"] = round(hits / (hits + misses), 4)
+    return out
+
+
+def _hit_arm_viable(args, prefill_chunk, hit_rate) -> bool:
+    """A hit-rate arm needs chunk-granular matches to be POSSIBLE: a
+    shared prefix shorter than one chunk can never match (random tails),
+    so the arm would report its requested hit rate with zero hits."""
+    if hit_rate is None:
+        return True
+    if not prefill_chunk:
+        return False  # the prefix cache is chunk-granular by construction
+    shared = args.shared_prefix_len or max(1, args.prompt_len // 2)
+    # upper bound: synth_shared_workload needs room for a >=1-token tail
+    return prefill_chunk <= shared < args.prompt_len
+
+
+def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
+            hit_rate=None):
+    step_tokens = (args.step_tokens or None) if prefill_chunk else None
+    if step_tokens is not None and step_tokens < prefill_chunk:
+        return None  # this arm's budget can't admit even one chunk
+    if not _hit_arm_viable(args, prefill_chunk, hit_rate):
+        return None
+
+    from uccl_tpu.serving import PrefixCache, ServingEngine
+    from uccl_tpu.serving.loadgen import drive, warm_engine
+
+    max_seq = args.prompt_len + args.new_tokens
+    backend, world, vocab = _make_backend(args, jax, stack, n_slots, max_seq)
+    if backend is None:
+        return None
+    engine = ServingEngine(
+        backend, prefill_chunk=prefill_chunk, step_tokens=step_tokens,
+        prefix_cache=(PrefixCache(prefill_chunk)
+                      if hit_rate is not None else None),
+    )
+    prompts, lens, arrivals = _workload(args, vocab, rate, hit_rate)
+    warm_engine(engine, lens, max_seq, args.new_tokens)
+    before = _counter_state()
+    _, wall = drive(engine, prompts, arrivals, args.new_tokens)
+    deltas = _counter_deltas(before)
+
+    from uccl_tpu import obs
+
+    snap = engine.snapshot()
+    arm = _arm_header(args, stack, world, rate, n_slots, prefill_chunk,
+                      step_tokens, hit_rate)
+    arm.update({
+        "wall_s": round(wall, 3),
         "completed": snap["completed"], "rejected": snap["rejected"],
         "goodput_tok_s": snap.get("goodput_tok_s"),
         "ttft_ms": snap["ttft_ms"], "queue_wait_ms": snap["queue_wait_ms"],
@@ -94,12 +206,91 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None):
         "max_step_ms": snap.get("max_step_ms"),
         "prefill_chunks": snap["prefill_chunks"],
         "slot_high_water": engine.pool.high_water,
-        # the obs registry's counter/gauge state rides along (fallback
-        # events, rejections, slot gauges — docs/OBSERVABILITY.md) so a
-        # bench line is self-contained for later analysis; counters are
-        # cumulative across the process's arms
-        "obs": obs.REGISTRY.snapshot()["metrics"],
-    }
+    })
+    if hit_rate is not None:
+        arm.update(_cache_fields(deltas))
+    # the obs registry's counter/gauge state rides along (fallback
+    # events, rejections, slot gauges — docs/OBSERVABILITY.md) so a
+    # bench line is self-contained for later analysis; counters are
+    # cumulative across the process's arms
+    arm["obs"] = obs.REGISTRY.snapshot()["metrics"]
+    return arm
+
+
+def run_disagg_arm(args, jax, stack, rate, n_slots, prefill_chunk,
+                   hit_rate=None):
+    """One disaggregated arm: prefill engine → chunk-streamed KV over
+    loopback p2p → decode engine, measured at the decode side (where the
+    user-visible TTFT and its queue/prefill/transfer split live)."""
+    if not prefill_chunk:
+        return None  # streaming granularity IS the prefill chunk
+    step_tokens = args.step_tokens or None
+    if step_tokens is not None and step_tokens < prefill_chunk:
+        return None  # this arm's budget can't admit even one chunk
+    if not _hit_arm_viable(args, prefill_chunk, hit_rate):
+        return None
+    from uccl_tpu.serving import PrefixCache, ServingEngine
+    from uccl_tpu.serving.disagg import (
+        drive_pair, make_local_pair, warm_pair,
+    )
+
+    max_seq = args.prompt_len + args.new_tokens
+    pb, world, vocab = _make_backend(args, jax, stack, n_slots, max_seq)
+    db, _, _ = _make_backend(args, jax, stack, n_slots, max_seq)
+    if pb is None or db is None:
+        return None
+    pe = ServingEngine(
+        pb, prefill_chunk=prefill_chunk, step_tokens=step_tokens,
+        prefix_cache=(PrefixCache(prefill_chunk)
+                      if hit_rate is not None else None),
+    )
+    de = ServingEngine(db)
+    pw, dw = make_local_pair(pe, de)
+    try:
+        warm_pair(pw, dw, args.prompt_len, args.new_tokens)
+        prompts, _, arrivals = _workload(args, vocab, rate, hit_rate)
+        before = _counter_state()
+        finished, wall = drive_pair(pw, dw, prompts, arrivals,
+                                    args.new_tokens)
+        deltas = _counter_deltas(before)
+        pw.close()
+        psnap, dsnap = pe.snapshot(), de.snapshot()
+    finally:
+        # each arm owns two endpoints + two registered full-pool mirrors;
+        # a sweep must not accumulate them until process exit
+        pw.ep.close()
+        dw.ep.close()
+
+    from uccl_tpu import obs
+
+    arm = _arm_header(args, stack, world, rate, n_slots, prefill_chunk,
+                      step_tokens, hit_rate)
+    arm.update({
+        "bench": "serving_disagg",
+        "wall_s": round(wall, 3),
+        "completed": dsnap["completed"],
+        "adopted": dsnap.get("adopted", 0),
+        "goodput_tok_s": dsnap.get("goodput_tok_s"),
+        # the end-to-end TTFT and its split, from the stream's wall-clock
+        # marks (docs/SERVING.md): queue+prefill on the prefill fleet,
+        # transfer = prefill-done -> adopt on the decode fleet
+        "ttft_ms": dsnap.get("disagg_ttft_ms", {}),
+        "ttft_p95_ms": dsnap.get("disagg_ttft_ms", {}).get("p95"),
+        "ttft_queue_ms": dsnap.get("disagg_queue_ms", {}),
+        "ttft_prefill_ms": dsnap.get("disagg_prefill_ms", {}),
+        "ttft_transfer_ms": dsnap.get("disagg_transfer_ms", {}),
+        "tpot_ms": dsnap["tpot_ms"],
+        "tpot_p95_ms": dsnap["tpot_ms"].get("p95"),
+        "decode_step_ms": dsnap["decode_step_ms"],
+        "prefill_ms": psnap["prefill_ms"],
+        "prefill_chunks": psnap["prefill_chunks"],
+        "kv_slabs_streamed": deltas["kv_stream_chunks_tx"],
+        "kv_bytes_streamed": deltas["p2p_bytes_write"],
+    })
+    if hit_rate is not None:  # cache absent ≠ cache enabled-but-cold
+        arm.update(_cache_fields(deltas))
+    arm["obs"] = obs.REGISTRY.snapshot()["metrics"]
+    return arm
 
 
 def main():
@@ -119,6 +310,20 @@ def main():
     ap.add_argument("--step-tokens", type=int, default=0,
                     help="per-step token budget for chunked arms "
                          "(0 = unbudgeted)")
+    ap.add_argument("--prefix-hit-rates", default="",
+                    help="comma-separated shared-system-prompt rates (e.g. "
+                         "'0,0.75'): enables the prefix-reuse cache on "
+                         "chunked arms and labels each arm with its "
+                         "counter-derived hits/tokens-reused/prefill-"
+                         "tokens-computed")
+    ap.add_argument("--shared-prefix-len", type=int, default=0,
+                    help="shared system-prompt length for the hit-rate "
+                         "sweep (0 = prompt_len/2)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run each arm through the disaggregated "
+                         "prefill->decode pair (chunk-streamed KV over "
+                         "loopback p2p) instead of one engine, reporting "
+                         "the TTFT queue/prefill/transfer split")
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=8)
@@ -137,20 +342,34 @@ def main():
     jax = init_devices(args.devices)
     chunks = [None if c.strip() in ("off", "0", "none") else int(c)
               for c in args.prefill_chunks.split(",")]
+    hit_rates = ([float(h) for h in args.prefix_hit_rates.split(",")]
+                 if args.prefix_hit_rates else [None])
     for rate in [float(r) for r in args.rates.split(",")]:
         for n_slots in [int(s) for s in args.slots.split(",")]:
             for chunk in chunks:
-                arm = run_arm(args, jax, args.stack, rate, n_slots, chunk)
-                if arm is None:
-                    print(json.dumps({
-                        "bench": "serving", "stack": args.stack,
-                        "arrival_rate": rate, "slots": n_slots,
-                        "prefill_chunk": chunk,
-                        "skipped": "slots must divide by the MoE world, or "
-                                   "--step-tokens < the arm's chunk",
-                    }), flush=True)
-                    continue
-                print(json.dumps(arm), flush=True)
+                for hit_rate in hit_rates:
+                    if args.disagg:
+                        arm = run_disagg_arm(args, jax, args.stack, rate,
+                                             n_slots, chunk, hit_rate)
+                    else:
+                        arm = run_arm(args, jax, args.stack, rate, n_slots,
+                                      chunk, hit_rate)
+                    if arm is None:
+                        print(json.dumps({
+                            "bench": ("serving_disagg" if args.disagg
+                                      else "serving"),
+                            "stack": args.stack,
+                            "arrival_rate": rate, "slots": n_slots,
+                            "prefill_chunk": chunk,
+                            "prefix_hit_rate": hit_rate,
+                            "skipped": "slots must divide by the MoE "
+                                       "world, --step-tokens < the arm's "
+                                       "chunk, a chunkless prefix/disagg "
+                                       "arm, or a shared prefix shorter "
+                                       "than the chunk (no hit possible)",
+                        }), flush=True)
+                        continue
+                    print(json.dumps(arm), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,14 +1,25 @@
 """Disaggregated prefill → decode serving with KV-cache transfer over P2P.
 
 The analog of the reference's prefill/decode disaggregation workload
-(ep/bench/vllm/disagg_proxy.py; "KV cache transfer" README.md:18): a prefill
-worker runs the prompt and ships the KV cache through the transfer engine's
-one-sided write path (advertise → write, out-of-band FifoItems over the
-engine's own send/recv); the decode worker continues generation from the
-received cache. The script asserts the disaggregated output matches
-single-worker generation exactly.
+(ep/bench/vllm/disagg_proxy.py; "KV cache transfer" README.md:18), in two
+tiers:
 
-Usage: python examples/disagg_kv.py [--new-tokens 16]
+* **Default — chunk-streamed serving** (`uccl_tpu/serving/disagg.py`): a
+  PrefillWorker engine (chunked prefill + prefix-reuse cache) streams each
+  request's KV slabs chunk-by-chunk into a DecodeWorker process over the
+  one-sided write path as they are computed; the decode engine adopts each
+  request and continues generation. Three requests share a system-prompt
+  prefix, so the run demonstrates ≥1 prefix-cache hit (tokens reused, not
+  recomputed — the counters prove it) AND bit-exact output.
+* **Legacy one-shot handoff** (`--compress` / `--elastic` / `--one-shot`):
+  the original whole-cache advertise → write → notif flow, kept for the
+  compressed-wire (DietGPU-style) and elastic-KV demos.
+
+Either way the script asserts the disaggregated output matches
+single-worker generation exactly (fp8 is lossy: agreement-checked) and
+exits non-zero on mismatch — tests/test_disagg_kv.py pins that contract.
+
+Usage: python examples/disagg_kv.py [--new-tokens 12] [--metrics-out M]
 """
 
 from __future__ import annotations
@@ -34,6 +45,9 @@ CFG_KW = dict(
 MAX_SEQ = 64
 PROMPT_LEN = 8
 BATCH = 2
+STREAM_CHUNK = 4  # prefill chunk = KV stream granularity = prefix-trie key
+STREAM_PROMPT_LEN = 12  # 3 chunks; requests share the first 8 tokens
+STREAM_REQUESTS = 3
 
 
 def _make(seed=0):
@@ -54,16 +68,120 @@ def _prompt():
     )
 
 
+# -- default: chunk-streamed disaggregated serving --------------------------
+def stream_decode_worker(port_q, result_q, n_requests):
+    """Decode-fleet process: advertises its slot-pool KV mirror, grants
+    incoming streams, adopts + decodes each request, reports the outputs
+    and its engine snapshot (with the disagg TTFT split)."""
+    _maybe_force_cpu()
+    import numpy as np
+
+    from uccl_tpu.p2p import Endpoint
+    from uccl_tpu.serving import DenseBackend, ServingEngine
+    from uccl_tpu.serving.disagg import DecodeWorker
+
+    cfg, params = _make()
+    backend = DenseBackend(params, cfg, n_slots=2, max_seq=MAX_SEQ)
+    engine = ServingEngine(backend)
+    ep = Endpoint()
+    port_q.put(ep.port)
+    dw = DecodeWorker(engine, ep)
+    dw.attach()
+    done = dw.serve(n_requests, timeout_s=180.0)
+    result_q.put((
+        [(np.asarray(r.prompt), list(r.out_tokens), int(r.cache_hit_len))
+         for r in done],
+        engine.snapshot(),
+    ))
+    ep.close()
+
+
+def _stream_main(args) -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from uccl_tpu import obs
+    from uccl_tpu.models.inference import generate
+    from uccl_tpu.p2p import Endpoint
+    from uccl_tpu.serving import DenseBackend, PrefixCache, ServingEngine
+    from uccl_tpu.serving.disagg import PrefillWorker
+
+    ctx = mp.get_context("spawn")
+    port_q, result_q = ctx.Queue(), ctx.Queue()
+    worker = ctx.Process(
+        target=stream_decode_worker,
+        args=(port_q, result_q, STREAM_REQUESTS),
+    )
+    worker.start()
+
+    cfg, params = _make()
+    backend = DenseBackend(params, cfg, n_slots=2, max_seq=MAX_SEQ)
+    engine = ServingEngine(backend, prefill_chunk=STREAM_CHUNK,
+                           prefix_cache=PrefixCache(STREAM_CHUNK))
+    ep = Endpoint()
+    pw = PrefillWorker(engine, ep, "127.0.0.1", port_q.get(timeout=60))
+
+    # one cold prompt, then two sharing its first 8 tokens (a 2-chunk
+    # "system prompt"): the second and third resume from the cache
+    rng = np.random.default_rng(7)
+    p0 = rng.integers(0, cfg.vocab, STREAM_PROMPT_LEN).astype(np.int32)
+    prompts = [
+        p0,
+        np.concatenate([p0[:8], rng.integers(0, cfg.vocab, 4).astype(np.int32)]),
+        p0.copy(),
+    ]
+    pw.submit(prompts[0], max_new_tokens=args.new_tokens)
+    pw.drain()  # cold request fully streamed -> its slot parks as a donor
+    for p in prompts[1:]:
+        pw.submit(p, max_new_tokens=args.new_tokens)
+    pw.drain()
+    pw.close()
+
+    results, snap = result_q.get(timeout=180)
+    worker.join(timeout=60)
+
+    hits = int(obs.counter("prefix_cache_hits_total").get())
+    reused = int(obs.counter("prefix_cache_tokens_reused_total").get())
+    computed = int(obs.counter("serving_prefill_tokens_total")
+                   .get(kind="computed"))
+    chunks = int(obs.counter("kv_stream_chunks_total").get(role="tx"))
+    wire = obs.counter("p2p_bytes_total").get(verb="write")
+    print(
+        f"prefill fleet: {len(prompts)} requests, {hits} prefix-cache "
+        f"hit(s), {reused} prompt tokens reused / {computed} computed, "
+        f"{chunks} KV slabs ({wire / 1e3:.1f} KB) streamed chunk-wise"
+    )
+    split = {k: snap.get(k, {}).get("p50") for k in
+             ("disagg_queue_ms", "disagg_prefill_ms", "disagg_transfer_ms")}
+    print(
+        f"decode fleet: adopted {snap.get('adopted', 0)} requests; TTFT "
+        f"split p50 queue/prefill/transfer = {split['disagg_queue_ms']}/"
+        f"{split['disagg_prefill_ms']}/{split['disagg_transfer_ms']} ms"
+    )
+
+    ok = len(results) == STREAM_REQUESTS and hits >= 1
+    for prompt, toks, hit in results:
+        want = np.asarray(generate(
+            params, jnp.asarray(prompt)[None], cfg,
+            max_new_tokens=args.new_tokens, max_seq=MAX_SEQ,
+        ))[0].tolist()
+        if toks != want:
+            print(f"MISMATCH (hit={hit}): got {toks} want {want}")
+            ok = False
+    print(f"disaggregated tokens match single-worker generation: {ok}")
+    return 0 if ok else 1
+
+
+# -- legacy: one-shot whole-cache handoff ------------------------------------
 def decode_worker(port_q, result_q, new_tokens):
     """Decode side: advertises cache buffers, receives them, continues."""
     _maybe_force_cpu()
     import jax.numpy as jnp
     import numpy as np
 
-    from uccl_tpu.models.inference import (
-        KVCache, decode_step, decode_step_elastic,
-    )
+    from uccl_tpu.models.inference import KVCache, decode_step_elastic
     from uccl_tpu.p2p import Endpoint
+    from uccl_tpu.serving.disagg import decode_continue
 
     compress = os.environ.get("UCCL_TPU_EXAMPLE_COMPRESS", "off")
     elastic = os.environ.get("UCCL_TPU_EXAMPLE_ELASTIC") == "1"
@@ -109,8 +227,6 @@ def decode_worker(port_q, result_q, new_tokens):
     else:
         k_arr, v_arr = k_host, v_host
     cache = KVCache(jnp.asarray(k_arr), jnp.asarray(v_arr), jnp.int32(length))
-    toks = [first_tok]
-    tok = jnp.asarray(first_tok)
     if elastic:
         # Re-home the received cache elastically: hot ring of 1 block in
         # device memory, the rest of the prefix offloaded to pinned host
@@ -119,6 +235,8 @@ def decode_worker(port_q, result_q, new_tokens):
         from uccl_tpu.ep import ElasticKVCache
 
         ekv = ElasticKVCache.from_cache(cache, block_tokens=8, hot_blocks=1)
+        toks = [first_tok]
+        tok = jnp.asarray(first_tok)
         for _ in range(new_tokens - 1):
             logits = decode_step_elastic(params, tok, ekv, cfg)
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -128,38 +246,14 @@ def decode_worker(port_q, result_q, new_tokens):
             f"host memory, {ekv.device_committed_bytes() / 1e3:.1f} KB "
             f"committed HBM, context {ekv.length}"
         )
+        result_q.put(np.stack(toks, axis=1))
     else:
-        for _ in range(new_tokens - 1):
-            logits, cache = decode_step(params, tok, cache, cfg)
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            toks.append(np.asarray(tok))
-    result_q.put(np.stack(toks, axis=1))
+        result_q.put(decode_continue(params, cfg, cache, first_tok,
+                                     new_tokens))
     ep.close()
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--new-tokens", type=int, default=12)
-    ap.add_argument("--cpu", action="store_true", help="force CPU jax")
-    ap.add_argument(
-        "--compress", nargs="?", const="fp8", default="off",
-        choices=["off", "fp8", "lossless"],
-        help="ship the KV cache compressed: fp8 (lossy ~3.8x) or lossless "
-             "(exact, byte-plane + native rANS; prints the wire ratio)",
-    )
-    ap.add_argument(
-        "--elastic", action="store_true",
-        help="decode over an elastic KV cache (cold blocks in host memory)",
-    )
-    args = ap.parse_args()
-    if args.cpu:
-        os.environ["UCCL_TPU_EXAMPLE_CPU"] = "1"  # inherited by the worker
-    if args.compress != "off":
-        os.environ["UCCL_TPU_EXAMPLE_COMPRESS"] = args.compress
-    if args.elastic:
-        os.environ["UCCL_TPU_EXAMPLE_ELASTIC"] = "1"
-    _maybe_force_cpu()
-
+def _legacy_main(args) -> int:
     ctx = mp.get_context("spawn")
     port_q, result_q = ctx.Queue(), ctx.Queue()
     worker = ctx.Process(
@@ -225,7 +319,7 @@ def main():
         agree = float(np.mean(disagg == want))
         print(f"disaggregated (fp8 wire) token agreement: {agree:.0%}")
         if disagg.shape != want.shape or agree < 0.5:
-            sys.exit(1)
+            return 1
     else:
         # raw and lossless wires are exact: tokens must match bit-for-bit
         ok = np.array_equal(disagg, want)
@@ -233,7 +327,47 @@ def main():
         if not ok:
             print("disagg:", disagg)
             print("want:  ", want)
-            sys.exit(1)
+            return 1
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--new-tokens", type=int, default=12)
+    ap.add_argument("--cpu", action="store_true", help="force CPU jax")
+    ap.add_argument(
+        "--compress", nargs="?", const="fp8", default="off",
+        choices=["off", "fp8", "lossless"],
+        help="LEGACY one-shot handoff with a compressed wire: fp8 (lossy "
+             "~3.8x) or lossless (exact, byte-plane + native rANS)",
+    )
+    ap.add_argument(
+        "--elastic", action="store_true",
+        help="LEGACY one-shot handoff decoding over an elastic KV cache "
+             "(cold blocks in host memory)",
+    )
+    ap.add_argument(
+        "--one-shot", action="store_true",
+        help="run the legacy whole-cache handoff instead of the "
+             "chunk-streamed serving pair",
+    )
+    from uccl_tpu import obs
+
+    obs.add_cli_args(ap)
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ["UCCL_TPU_EXAMPLE_CPU"] = "1"  # inherited by the worker
+    if args.compress != "off":
+        os.environ["UCCL_TPU_EXAMPLE_COMPRESS"] = args.compress
+    if args.elastic:
+        os.environ["UCCL_TPU_EXAMPLE_ELASTIC"] = "1"
+    _maybe_force_cpu()
+    obs.setup_from_args(args)
+    obs.dump_at_exit(args)
+
+    if args.compress != "off" or args.elastic or args.one_shot:
+        sys.exit(_legacy_main(args))
+    sys.exit(_stream_main(args))
 
 
 if __name__ == "__main__":

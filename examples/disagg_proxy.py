@@ -146,8 +146,9 @@ def decode_worker(port_q):
     _maybe_force_cpu()
     import jax.numpy as jnp
 
-    from uccl_tpu.models.inference import KVCache, decode_step
+    from uccl_tpu.models.inference import KVCache
     from uccl_tpu.p2p import XferEndpoint
+    from uccl_tpu.serving.disagg import decode_continue
 
     cfg, params = _model()
     xp = XferEndpoint(n_engines=1)
@@ -173,12 +174,10 @@ def decode_worker(port_q):
             jnp.int32(ktp["length"]),
         )
         tok = jnp.asarray(np.asarray(payload["first_token"], np.int32))
-        toks = [np.asarray(tok)]
-        for _ in range(int(payload["max_tokens"]) - 1):
-            logits, cache = decode_step(params, tok, cache, cfg)
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            toks.append(np.asarray(tok))
-        return {"tokens": np.stack(toks, axis=1).tolist()}
+        toks = decode_continue(
+            params, cfg, cache, tok, int(payload["max_tokens"])
+        )
+        return {"tokens": toks.tolist()}
 
     _serve(app, port_q)
 
@@ -211,19 +210,10 @@ def proxy_worker(port_q, prefill_port, decode_port):
 
 def _single_worker_reference(prompt, new_tokens):
     _maybe_force_cpu()
-    import jax.numpy as jnp
-
-    from uccl_tpu.models.inference import decode_step, prefill
+    from uccl_tpu.serving.disagg import oneshot_reference
 
     cfg, params = _model()
-    logits, cache = prefill(params, jnp.asarray(prompt), cfg, MAX_SEQ)
-    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    toks = [np.asarray(tok)]
-    for _ in range(new_tokens - 1):
-        logits, cache = decode_step(params, tok, cache, cfg)
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        toks.append(np.asarray(tok))
-    return np.stack(toks, axis=1)
+    return oneshot_reference(params, cfg, prompt, new_tokens, MAX_SEQ)
 
 
 def main():

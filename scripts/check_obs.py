@@ -4,6 +4,7 @@
 Usage: python scripts/check_obs.py TRACE_JSON METRICS_PROM
        python scripts/check_obs.py --quant METRICS_PROM WIRE_DTYPE
        python scripts/check_obs.py --plan METRICS_PROM BENCH_JSON
+       python scripts/check_obs.py --disagg METRICS_PROM
 
 Asserts, with a named failure for each:
 
@@ -28,6 +29,15 @@ there) plus the ``collective_plan_predicted_us`` gauge, and every arm of
 the bench's ``all_reduce_plan`` JSON lines must carry an ``algo`` label
 present on that counter — i.e. bench arms were labeled off the REAL plan
 series, not mirrored selector math (docs/PLAN_BENCH.md round-8).
+
+``--disagg`` mode (the disaggregated-serving smoke arm,
+examples/disagg_kv.py --metrics-out): the metrics file must carry nonzero
+KV-handoff telemetry — one-sided write bytes on
+``p2p_bytes_total{verb="write"}``, streamed slabs on
+``kv_stream_chunks_total{role="tx"}``, and ≥1 ``prefix_cache_hits_total``
+(the run's shared-prefix requests really reused cached KV) with the
+``serving_prefill_tokens_total`` computed/skipped split present — i.e.
+the chunk-streamed handoff AND the prefix cache both demonstrably fired.
 """
 
 from __future__ import annotations
@@ -168,7 +178,40 @@ def check_plan_metrics(path: str, bench_json: str) -> None:
           f"(algos: {sorted(algos)})")
 
 
+def check_disagg_metrics(path: str) -> None:
+    with open(path) as f:
+        lines = f.read().splitlines()
+
+    def total(prefix: str) -> float:
+        vals = [float(ln.rsplit(" ", 1)[1]) for ln in lines
+                if ln.startswith(prefix)]
+        if not vals:
+            fail(f"{path}: no sample for {prefix!r}")
+        return sum(vals)
+
+    if total('p2p_bytes_total{verb="write"}') <= 0:
+        fail(f"{path}: zero one-sided write bytes — no KV crossed the "
+             f"p2p wire")
+    if total('kv_stream_chunks_total{role="tx"}') <= 0:
+        fail(f"{path}: zero streamed KV slabs — the chunk stream never "
+             f"fired")
+    hits = total("prefix_cache_hits_total")
+    if hits < 1:
+        fail(f"{path}: no prefix_cache_hits_total — the shared-prefix "
+             f"requests never reused cached KV")
+    if total('serving_prefill_tokens_total{kind="skipped"}') <= 0:
+        fail(f"{path}: prefix hits counted but no skipped prefill tokens "
+             f"— the hit did not shorten prefill")
+    total('serving_prefill_tokens_total{kind="computed"}')  # must exist
+    print(f"check_obs: disagg metrics OK — {int(hits)} prefix-cache "
+          f"hit(s), stream + skip series all nonzero")
+
+
 def main(argv) -> None:
+    if len(argv) == 3 and argv[1] == "--disagg":
+        check_disagg_metrics(argv[2])
+        print("check_obs: ALL OK")
+        return
     if len(argv) == 4 and argv[1] == "--quant":
         check_quant_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
@@ -180,7 +223,8 @@ def main(argv) -> None:
     if len(argv) != 3:
         fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
-             "check_obs.py --plan METRICS_PROM BENCH_JSON")
+             "check_obs.py --plan METRICS_PROM BENCH_JSON | "
+             "check_obs.py --disagg METRICS_PROM")
     check_trace(argv[1])
     check_metrics(argv[2])
     print("check_obs: ALL OK")

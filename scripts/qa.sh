@@ -34,6 +34,11 @@ JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --sl
   --requests 6 --prompt-len 12 --new-tokens 4 --arrival-rate 50 \
   --prefill-chunk 8 --check-oracle; check $?
 
+note "disagg serving smoke tier (prefill+decode worker pair over p2p: chunk-streamed KV, >=1 prefix-cache hit, oracle-exact, telemetry validated)"
+UCCL_TPU_EXAMPLE_CPU=1 JAX_PLATFORMS=cpu timeout 600 python examples/disagg_kv.py --cpu \
+  --metrics-out /tmp/qa_disagg_metrics.prom; check $?
+python scripts/check_obs.py --disagg /tmp/qa_disagg_metrics.prom; check $?
+
 note "observability smoke tier (2-slot serving run traced end to end: Chrome-trace lifecycle timelines + Prometheus metrics validate)"
 JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
   --requests 6 --prompt-len 8 --new-tokens 4 --arrival-rate 50 --check-oracle \
@@ -54,8 +59,8 @@ note "net-plugin allreduce acceptance (dlopen vtable, 4 ranks)"
 timeout 900 make -C native perf; check $?
 
 if [ "${1:-}" != "quick" ]; then
-  note "examples: disagg KV (exact, fp8, lossless, elastic)"
-  UCCL_TPU_EXAMPLE_CPU=1 timeout 900 python examples/disagg_kv.py --cpu; check $?
+  note "examples: disagg KV (legacy one-shot handoff: exact + lossless wires; the streaming pair ran in the smoke tier)"
+  UCCL_TPU_EXAMPLE_CPU=1 timeout 900 python examples/disagg_kv.py --cpu --one-shot; check $?
   UCCL_TPU_EXAMPLE_CPU=1 timeout 900 python examples/disagg_kv.py --cpu --compress lossless; check $?
   note "examples: 2-pod hierarchical allreduce"
   UCCL_TPU_EXAMPLE_CPU=1 timeout 900 python examples/multipod_allreduce.py; check $?

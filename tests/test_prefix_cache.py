@@ -1,0 +1,448 @@
+"""Prefix-reuse cache + disaggregated serving: trie/eviction properties and
+bit-exactness of the resumed-prefill hit path.
+
+Three layers, cheapest first:
+
+* **Trie / wire-format units** (host-only, no jax): chunk-granular
+  insert/match/evict, the ≥1-token-must-remain match clamp, LRU order,
+  covered-prefix dedup, and the disagg KV wire format's byte-span math
+  against a numpy flat-index oracle.
+* **Engine properties** (stub backend): a hit resumes the prefill cursor at
+  ``matched_len`` after one donor copy; retire parks; admission pressure
+  evicts LRU parked donors and NEVER a live request's slot; no leaked
+  slots; metric conservation with parked slots outside the live count.
+* **Oracle exactness** (real models): cold vs hit outputs bit-equal the
+  one-shot ``generate`` oracle on the dense stack (tier-1), and — marked
+  slow, like every multi-compile arm — on the EP MoE stack and through the
+  full in-process disaggregated pair (chunk-streamed KV over real loopback
+  p2p endpoints, prefill fleet + decode fleet, cold and prefix-hit).
+"""
+
+import numpy as np
+import pytest
+
+from uccl_tpu.serving import (
+    PrefixCache, RequestState, ServingEngine, SlotPool,
+)
+from uccl_tpu.serving.disagg import KVWireFormat
+
+
+class TestTrie:
+    def _prompt(self, *chunks):
+        return np.concatenate([np.asarray(c, np.int32) for c in chunks])
+
+    def test_miss_then_hit_at_chunk_granularity(self):
+        pool = SlotPool(4)
+        pc = PrefixCache(4)
+        p = np.arange(12, dtype=np.int32)
+        assert pc.match(p) == (0, None)
+        slot = pool.admit(0)
+        assert pc.park(pool, slot, p)
+        # identical first 8 tokens -> depth-2 match; chunk 3 differs
+        q = self._prompt(p[:8], [99, 98, 97, 96])
+        m, donor = pc.match(q)
+        assert (m, donor) == (8, slot)
+        # sub-chunk prefix sharing (< 4 tokens) is below granularity
+        r = self._prompt(p[:3], [50, 51, 52, 53, 54])
+        assert pc.match(r) == (0, None)
+
+    def test_match_leaves_at_least_one_token(self):
+        """A fully cached prompt still recomputes its final chunk: the
+        first generated token needs the last position's logits."""
+        pool = SlotPool(2)
+        pc = PrefixCache(4)
+        p = np.arange(8, dtype=np.int32)  # exactly 2 chunks
+        pc.park(pool, p1 := pool.admit(0), p)
+        assert p1 is not None
+        m, _ = pc.match(p)  # usable = (8-1)//4 = 1 chunk
+        assert m == 4
+        longer = self._prompt(p, [7, 7, 7])  # 11 tokens: both chunks usable
+        m, _ = pc.match(longer)
+        assert m == 8
+
+    def test_short_prompt_never_cached(self):
+        pool = SlotPool(2)
+        pc = PrefixCache(8)
+        slot = pool.admit(0)
+        assert not pc.park(pool, slot, np.arange(5, dtype=np.int32))
+        assert pc.n_resident == 0 and pool.n_parked == 0
+
+    def test_covered_prefix_not_parked_twice(self):
+        pool = SlotPool(4)
+        pc = PrefixCache(4)
+        p = np.arange(12, dtype=np.int32)
+        assert pc.park(pool, pool.admit(0), p)
+        # same full-chunk prefix again: parking is refused (slot freed by
+        # the caller), residency stays 1
+        s2 = pool.admit(1)
+        assert not pc.park(pool, s2, p.copy())
+        assert pc.n_resident == 1
+        # a LONGER prompt extends the path -> parks
+        s3 = pool.admit(2)
+        assert pc.park(pool, s3, self._prompt(p, [1, 2, 3, 4]))
+        assert pc.n_resident == 2
+
+    def test_lru_eviction_order(self):
+        pool = SlotPool(4)
+        pc = PrefixCache(2)
+        pa = np.asarray([1, 1, 2, 2], np.int32)
+        pb = np.asarray([3, 3, 4, 4], np.int32)
+        sa, sb = pool.admit(0), pool.admit(1)
+        pc.park(pool, sa, pa)
+        pc.park(pool, sb, pb)
+        pc.match(pa)  # refresh A: B becomes LRU
+        assert pc.evict_lru(pool) == sb
+        assert pool.n_free == 3 and pc.n_resident == 1
+        # the evicted prefix is gone from the trie
+        assert pc.match(np.asarray([3, 3, 4, 4, 9], np.int32)) == (0, None)
+        assert pc.evict_lru(pool) == sa
+        assert pc.evict_lru(pool) is None
+
+    def test_deepest_match_wins(self):
+        pool = SlotPool(4)
+        pc = PrefixCache(2)
+        short = np.asarray([5, 6, 7, 8], np.int32)
+        long = np.asarray([5, 6, 7, 8, 9, 10], np.int32)
+        pc.park(pool, pool.admit(0), short)
+        s_long = pool.admit(1)
+        pc.park(pool, s_long, long)
+        m, donor = pc.match(np.asarray([5, 6, 7, 8, 9, 10, 11], np.int32))
+        assert (m, donor) == (6, s_long)
+
+    def test_clear_reclaims_everything(self):
+        pool = SlotPool(3)
+        pc = PrefixCache(2)
+        for i in range(3):
+            pc.park(pool, pool.admit(i),
+                    np.asarray([i, i, i + 1, i + 1], np.int32))
+        assert pool.n_free == 0
+        pc.clear(pool)
+        assert pool.n_free == 3 and pc.n_resident == 0
+
+
+class TestWireFormat:
+    def test_spans_match_numpy_flat_offsets(self):
+        fmt = KVWireFormat(n_layers=3, n_slots=4, max_seq=16,
+                           n_kv_heads=2, head_dim=8)
+        pool = np.arange(np.prod(fmt.pool_shape()), dtype=np.float32
+                         ).reshape(fmt.pool_shape())
+        flat = pool.reshape(-1).view(np.uint8)
+        for slot, lo, hi in ((0, 0, 4), (2, 4, 9), (3, 15, 16)):
+            spans = fmt.spans(slot, lo, hi)
+            assert len(spans) == fmt.n_layers
+            for layer, (off, ln) in enumerate(spans):
+                want = pool[layer, slot, lo:hi].tobytes()
+                assert flat[off:off + ln].tobytes() == want, (slot, lo, hi)
+
+    def test_pool_nbytes_and_meta_roundtrip(self):
+        fmt = KVWireFormat(n_layers=2, n_slots=2, max_seq=32,
+                           n_kv_heads=2, head_dim=16)
+        assert fmt.pool_nbytes() == 2 * 2 * 32 * 2 * 16 * 4
+        assert KVWireFormat.from_meta(fmt.to_meta()) == fmt
+
+    def test_bounds_rejected(self):
+        fmt = KVWireFormat(n_layers=1, n_slots=2, max_seq=8,
+                           n_kv_heads=1, head_dim=4)
+        with pytest.raises(ValueError, match="rows"):
+            fmt.spans(0, 4, 9)
+        with pytest.raises(ValueError, match="slot"):
+            fmt.spans(2, 0, 4)
+
+
+class _CacheStubBackend:
+    """Chunk-aware stub recording prefill starts and prefix copies."""
+
+    def __init__(self, n_slots=2, max_seq=64):
+        self.n_slots = n_slots
+        self.max_seq = max_seq
+        self.n_decodes = 0
+        self.calls = []
+
+    def prefill(self, tokens, lens, mask, start=None):
+        slots = tuple(int(s) for s in np.flatnonzero(mask))
+        self.calls.append(
+            ("prefill", slots, tuple(int(start[s]) for s in slots))
+        )
+        return np.full(self.n_slots, 100, np.int32)
+
+    def decode(self, tokens, active):
+        self.n_decodes += 1
+        self.calls.append(
+            ("decode", tuple(int(s) for s in np.flatnonzero(active)))
+        )
+        return np.full(self.n_slots, self.n_decodes, np.int32)
+
+    def copy_slot_prefix(self, dst, src, n):
+        self.calls.append(("copy", dst, src, n))
+
+
+class TestEngineProperties:
+    def test_requires_chunked_prefill(self):
+        with pytest.raises(ValueError, match="requires prefill_chunk"):
+            ServingEngine(_CacheStubBackend(), prefix_cache=PrefixCache(4))
+        with pytest.raises(ValueError, match="must equal prefill_chunk"):
+            ServingEngine(_CacheStubBackend(), prefill_chunk=8,
+                          prefix_cache=PrefixCache(4))
+
+    def test_hit_resumes_cursor_after_one_copy(self):
+        eng = ServingEngine(_CacheStubBackend(n_slots=2), prefill_chunk=4,
+                            prefix_cache=PrefixCache(4))
+        p0 = np.arange(10, dtype=np.int32)
+        eng.submit(p0, max_new_tokens=2)
+        eng.drain()
+        assert eng.pool.n_parked == 1  # retire parked, not freed
+        r1 = eng.submit(np.concatenate([p0[:8], [9, 9, 9]]).astype(np.int32),
+                        max_new_tokens=2)
+        eng.drain()
+        assert r1.cache_hit_len == 8
+        copies = [c for c in eng.backend.calls if c[0] == "copy"]
+        assert copies == [("copy", 1, 0, 8)]
+        # r1's only prefill window starts at the matched boundary
+        starts = [c[2] for c in eng.backend.calls if c[0] == "prefill"]
+        assert starts[-1] == (8,)
+        assert eng.pool.leaked() == 0
+
+    def test_pressure_evicts_lru_donor_never_live(self):
+        """2 slots: one parked donor + one live request; a second live
+        arrival must evict the PARKED slot, never the live one."""
+        eng = ServingEngine(_CacheStubBackend(n_slots=2), prefill_chunk=4,
+                            prefix_cache=PrefixCache(4))
+        eng.submit(np.arange(8, dtype=np.int32), max_new_tokens=2)
+        eng.drain()
+        donor = eng.pool.parked_slots()[0]
+        live = eng.submit(np.full(20, 7, np.int32), max_new_tokens=4)
+        eng.step()  # live mid-prefill (5 chunks), occupies the free slot
+        assert live.state is RequestState.PARTIAL_PREFILL
+        live_slot = live.slot
+        third = eng.submit(np.full(9, 3, np.int32), max_new_tokens=2)
+        eng.drain()
+        # the donor slot was reclaimed for `third`; the live slot survived
+        assert third.slot == donor
+        assert live.slot == live_slot
+        assert live.n_generated == live.max_new_tokens
+        assert eng.pool.leaked() == 0
+        assert eng.prefix_cache.n_resident >= 1  # retirees re-parked
+
+    def test_sole_protected_donor_yields_when_nothing_live(self):
+        """n_slots=1: the parked donor is the queue-head's match AND the
+        only eviction candidate. With no live request to ever free a
+        slot, admission must evict it (trading the hit for progress)
+        instead of deadlocking drain()."""
+        eng = ServingEngine(_CacheStubBackend(n_slots=1), prefill_chunk=4,
+                            prefix_cache=PrefixCache(4))
+        p = np.arange(8, dtype=np.int32)
+        eng.submit(p, max_new_tokens=2)
+        eng.drain()
+        assert eng.pool.n_parked == 1
+        r = eng.submit(p.copy(), max_new_tokens=2)  # would match the donor
+        eng.drain()  # must terminate: donor evicted, cold prefill
+        assert r.state is RequestState.FINISHED
+        assert r.cache_hit_len == 0
+        assert eng.pool.leaked() == 0
+
+    def test_conservation_with_parked_slots(self):
+        eng = ServingEngine(_CacheStubBackend(n_slots=2), prefill_chunk=4,
+                            max_queue=4, prefix_cache=PrefixCache(4))
+        for i in range(5):
+            eng.submit(np.full(8, i, np.int32), max_new_tokens=2)
+        while eng.has_work():
+            eng.step()
+            s = eng.snapshot()
+            assert (s["submitted"]
+                    == s["completed"] + s["active"] + s["queued"]
+                    + s["rejected"]), s
+        assert eng.pool.leaked() == 0
+
+    def test_adopt_decodes_from_imported_state(self):
+        """adopt() is the decode-side entry: ACTIVE at once, first token
+        pre-seeded, decodes to the budget, conserved in the metrics."""
+        eng = ServingEngine(_CacheStubBackend(n_slots=2))
+        r = eng.adopt([1, 2, 3], 100, max_new_tokens=3,
+                      queue_s=0.001, prefill_s=0.002, transfer_s=0.003)
+        assert r.adopted and r.state is RequestState.ACTIVE
+        assert r.out_tokens == [100]
+        eng.drain()
+        assert r.n_generated == 3 and r.finish_reason == "length"
+        s = eng.snapshot()
+        assert s["adopted"] == 1 and s["completed"] == 1
+        assert s["submitted"] == s["completed"]
+        assert "p50" in s["disagg_ttft_ms"]
+        assert eng.pool.leaked() == 0
+
+    def test_adopt_eos_and_budget_edge(self):
+        eng = ServingEngine(_CacheStubBackend(n_slots=1))
+        r = eng.adopt([1], 7, max_new_tokens=5, eos_id=7)
+        assert r.is_done() and r.finish_reason == "eos"
+        r2 = eng.adopt([1], 3, max_new_tokens=1)
+        assert r2.is_done() and r2.finish_reason == "length"
+        assert eng.pool.leaked() == 0
+
+
+MAX_SEQ = 32
+
+
+@pytest.fixture(scope="module")
+def dense_setup():
+    """Same config family as test_serving so the one-shot oracle programs
+    are _GEN_CACHE hits across files; ONE backend per engine role keeps
+    compile count at one [n_slots, C] prefill + one decode program."""
+    import jax
+
+    from uccl_tpu.models import dense
+    from uccl_tpu.serving import DenseBackend
+
+    cfg = dense.DenseConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, head_dim=8,
+        ffn=64,
+    )
+    params = dense.init_params(jax.random.PRNGKey(0), cfg)
+    backend = DenseBackend(params, cfg, n_slots=2, max_seq=MAX_SEQ)
+    return cfg, params, backend
+
+
+def _oracle(params, cfg, req):
+    import jax.numpy as jnp
+
+    from uccl_tpu.models.inference import generate
+
+    toks = generate(params, jnp.asarray(req.prompt)[None], cfg,
+                    max_new_tokens=req.max_new_tokens, max_seq=MAX_SEQ)
+    return np.asarray(toks)[0, : req.n_generated].tolist()
+
+
+class TestDenseHitExact:
+    def test_hit_path_bit_exact_vs_cold(self, dense_setup):
+        """THE acceptance property: a prefix-cache hit (copy + resumed
+        prefill) emits exactly the cold path's tokens, which in turn equal
+        the one-shot oracle. Sequential requests guarantee the donor is
+        parked before the sharer arrives."""
+        cfg, params, backend = dense_setup
+        eng = ServingEngine(backend, prefill_chunk=4,
+                            prefix_cache=PrefixCache(4))
+        rng = np.random.default_rng(3)
+        p0 = rng.integers(0, 64, 12).astype(np.int32)
+        sharers = [
+            np.concatenate([p0[:8], rng.integers(0, 64, 4).astype(np.int32)]),
+            p0.copy(),  # identical prompt: full-chunk re-match
+        ]
+        cold = eng.submit(p0, max_new_tokens=4)
+        eng.drain()
+        assert cold.cache_hit_len == 0
+        assert cold.out_tokens == _oracle(params, cfg, cold)
+        for p in sharers:
+            r = eng.submit(p, max_new_tokens=4)
+            eng.drain()
+            assert r.cache_hit_len == 8, "expected a depth-2 (8-token) hit"
+            assert r.out_tokens == _oracle(params, cfg, r), r.rid
+        # identical prompt produced the identical continuation
+        assert eng.pool.leaked() == 0
+
+    def test_eviction_churn_stays_exact(self, dense_setup):
+        """More distinct prompts than slots: donors park and are evicted
+        under pressure; every output stays oracle-exact through the
+        churn (stale donor KV can never corrupt a hit)."""
+        from uccl_tpu import obs
+
+        cfg, params, backend = dense_setup
+        eng = ServingEngine(backend, prefill_chunk=4,
+                            prefix_cache=PrefixCache(4))
+        ev0 = obs.counter("prefix_cache_evictions_total").get()
+        rng = np.random.default_rng(4)
+        reqs = []
+        for _ in range(5):
+            reqs.append(eng.submit(rng.integers(0, 64, 12).astype(np.int32),
+                                   max_new_tokens=4))
+            eng.drain()
+        for r in reqs:
+            assert r.out_tokens == _oracle(params, cfg, r), r.rid
+        # 5 distinct donors through 2 slots: pressure really evicted
+        assert obs.counter("prefix_cache_evictions_total").get() - ev0 >= 3
+        assert eng.pool.leaked() == 0
+
+
+@pytest.mark.slow
+class TestDisaggPairDense:
+    """The full disaggregated pair over real loopback p2p endpoints —
+    multi-compile (two engines) + native transfer engine, so slow-marked;
+    qa.sh/CI run it unfiltered, and the example covers the two-process
+    arrangement."""
+
+    def test_cold_and_hit_streams_exact(self, dense_setup):
+        from uccl_tpu.serving import DenseBackend
+        from uccl_tpu.serving.disagg import (
+            drive_pair, make_local_pair, warm_pair,
+        )
+        from uccl_tpu import obs
+
+        cfg, params, _ = dense_setup
+        pb = DenseBackend(params, cfg, n_slots=2, max_seq=MAX_SEQ)
+        db = DenseBackend(params, cfg, n_slots=2, max_seq=MAX_SEQ)
+        pe = ServingEngine(pb, prefill_chunk=4, prefix_cache=PrefixCache(4))
+        de = ServingEngine(db)
+        pw, dw = make_local_pair(pe, de)
+        warm_pair(pw, dw, prompt_len=8)
+
+        rng = np.random.default_rng(0)
+        p0 = rng.integers(0, 64, 12).astype(np.int32)
+        p1 = np.concatenate([p0[:8], rng.integers(0, 64, 4).astype(np.int32)])
+        chunks0 = obs.counter("kv_stream_chunks_total").get(role="tx")
+        cold, _ = drive_pair(pw, dw, [p0], [0.0], max_new_tokens=4)
+        hit, _ = drive_pair(pw, dw, [p1], [0.0], max_new_tokens=4)
+        for r in cold + hit:
+            assert r.adopted
+            assert r.out_tokens == _oracle(params, cfg, r), r.rid
+        assert hit[0].cache_hit_len == 8  # reused rows still streamed
+        # every KV row crossed the wire both times: the cold prompt as 3
+        # C-token slabs, the hit as its copied [0, 8) prefix in ONE slab
+        # plus the recomputed final chunk
+        tx = obs.counter("kv_stream_chunks_total").get(role="tx") - chunks0
+        assert tx == 5, tx
+        snap = de.snapshot()
+        assert snap["adopted"] == 2
+        for key in ("disagg_queue_ms", "disagg_prefill_ms",
+                    "disagg_transfer_ms", "disagg_ttft_ms"):
+            assert "p50" in snap[key], key
+        assert pe.pool.leaked() == 0 and de.pool.leaked() == 0
+        pw.close()
+
+
+@pytest.mark.slow
+class TestMoEHitExact:
+    def test_moe_prefix_hit_bit_exact(self, devices):
+        """Prefix-cache hits on the EP-sharded MoE stack: the grid-mapped
+        copy/import views keep the resumed prefill bit-exact vs the
+        world-1 oracle (cold and hit)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from uccl_tpu.models.moe_inference import (
+            MoEServeConfig, MoEServer, init_params,
+        )
+        from uccl_tpu.serving import MoEBackend
+
+        cfg = MoEServeConfig(
+            vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            head_dim=8, moe_experts=8, moe_topk=2, moe_ffn=64,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        srv = MoEServer(cfg, Mesh(np.array(devices[:2]), ("dp",)))
+        backend = MoEBackend(srv, srv.shard_params(params), batch_local=1,
+                             max_seq=MAX_SEQ)
+        eng = ServingEngine(backend, prefill_chunk=3,
+                            prefix_cache=PrefixCache(3))
+        srv1 = MoEServer(cfg, Mesh(np.array(devices[:1]), ("dp",)))
+        p1p = srv1.shard_params(params)
+        rng = np.random.default_rng(0)
+        p0 = rng.integers(0, 64, 8).astype(np.int32)
+        share = np.concatenate([p0[:6], rng.integers(0, 64, 2).astype(np.int32)])
+        reqs = []
+        for p in (p0, share):
+            reqs.append(eng.submit(p, max_new_tokens=4))
+            eng.drain()
+        assert reqs[1].cache_hit_len == 6
+        for r in reqs:
+            want = srv1.generate(p1p, jnp.asarray(r.prompt)[None, None],
+                                 r.max_new_tokens, MAX_SEQ, impl="ll")
+            assert r.out_tokens == np.asarray(want)[0, 0].tolist(), r.rid
+        assert eng.pool.leaked() == 0

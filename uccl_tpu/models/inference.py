@@ -181,6 +181,86 @@ class SlotKVCache(NamedTuple):
             jnp.zeros((n_slots,), jnp.int32),
         )
 
+    # -- slot KV export/import views (the disaggregation surface) ----------
+    #
+    # One slot's rows as host arrays, and the inverse: these are what
+    # crosses the p2p wire between a prefill worker and a decode worker
+    # (uccl_tpu/serving/disagg.py), and what the prefix-reuse cache copies
+    # between slots. Raw float32 rows — bit-exact by construction, so the
+    # disaggregated continuation is the oracle's continuation.
+    #
+    # All three go through module-level jitted helpers whose slot indices
+    # and lengths are TRACED scalars, and whole slot rows move at the
+    # fixed [L, S_max, Hkv, D] shape: one compiled program per pool shape,
+    # instead of one per (slot, offset, length) combination that baked
+    # constants would cost. Rows beyond the stamped length carry donor/
+    # stale data and are dead by the masked-attention invariant (attention
+    # stops at the slot's length; resumed prefill writes [start, start+C)
+    # before attending to it).
+
+    def export_rows(self, slot: int, lo: int, hi: int):
+        """Host copies of rows [lo, hi): (k, v) each [L, hi-lo, Hkv, D]."""
+        import numpy as np
+
+        k_row, v_row = _slot_row_export(self.k, self.v, jnp.int32(slot))
+        return (np.asarray(k_row[:, lo:hi]), np.asarray(v_row[:, lo:hi]))
+
+    def import_rows(self, slot: int, k_rows, v_rows, *,
+                    length: int) -> "SlotKVCache":
+        """Rows [0, n) of ``slot`` replaced by ``k_rows``/``v_rows``
+        ([L, n, Hkv, D]); the slot's length becomes ``length``. Callers on
+        a hot path should pass full S_max rows (the decode worker's mirror
+        does) so every import shares one compiled program."""
+        import numpy as np
+
+        smax = self.k.shape[2]
+        n = k_rows.shape[1]
+        if n < smax:  # pad to the row shape with dead rows
+            pad = [(0, 0), (0, smax - n), (0, 0), (0, 0)]
+            k_rows = np.pad(np.asarray(k_rows), pad)
+            v_rows = np.pad(np.asarray(v_rows), pad)
+        k, v, lengths = _slot_row_import(
+            self.k, self.v, self.lengths, jnp.int32(slot),
+            jnp.asarray(k_rows, self.k.dtype),
+            jnp.asarray(v_rows, self.v.dtype), jnp.int32(length),
+        )
+        return SlotKVCache(k, v, lengths)
+
+    def copy_prefix(self, dst: int, src: int, n: int) -> "SlotKVCache":
+        """Copy slot ``src``'s row into slot ``dst`` and stamp dst's
+        length to n (the prefix-cache hit path: dst resumes prefill at
+        position n; src rows past n are dead weight in dst, never
+        readable)."""
+        k, v, lengths = _slot_row_copy(
+            self.k, self.v, self.lengths, jnp.int32(dst), jnp.int32(src),
+            jnp.int32(n),
+        )
+        return SlotKVCache(k, v, lengths)
+
+
+@jax.jit
+def _slot_row_export(k, v, slot):
+    """One slot's full KV row [L, S_max, Hkv, D] (slot is traced: one
+    compiled gather per pool shape)."""
+    return (lax.dynamic_index_in_dim(k, slot, axis=1, keepdims=False),
+            lax.dynamic_index_in_dim(v, slot, axis=1, keepdims=False))
+
+
+@jax.jit
+def _slot_row_import(k, v, lengths, slot, k_row, v_row, length):
+    k = lax.dynamic_update_slice(k, k_row[:, None], (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(v, v_row[:, None], (0, slot, 0, 0, 0))
+    return k, v, lengths.at[slot].set(length)
+
+
+@jax.jit
+def _slot_row_copy(k, v, lengths, dst, src, n):
+    k_row = lax.dynamic_index_in_dim(k, src, axis=1, keepdims=True)
+    v_row = lax.dynamic_index_in_dim(v, src, axis=1, keepdims=True)
+    k = lax.dynamic_update_slice(k, k_row, (0, dst, 0, 0, 0))
+    v = lax.dynamic_update_slice(v, v_row, (0, dst, 0, 0, 0))
+    return k, v, lengths.at[dst].set(n)
+
 
 def _forward_slots(
     params, tokens, cache: SlotKVCache, start, write_mask, cfg, ffn=None

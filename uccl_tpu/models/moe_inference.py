@@ -100,6 +100,59 @@ class MoESlotCache(NamedTuple):
             jnp.zeros((world, batch_local), jnp.int32),
         )
 
+    # -- slot KV export/import views (the disaggregation surface) ----------
+    #
+    # Mirrors inference.SlotKVCache: a flat slot id s maps to grid row
+    # (w, b) = (s // B_loc, s % B_loc). Exports/imports go through host
+    # numpy round-trips — np.asarray gathers a sharded pool, and the next
+    # shard_mapped call re-shards the rebuilt arrays — which keeps the
+    # surface correct on any mesh at the cost of a pool copy per call
+    # (admission-rate work, not step-rate).
+
+    def _loc(self, slot: int):
+        b_loc = self.k.shape[2]
+        return slot // b_loc, slot % b_loc
+
+    def export_rows(self, slot: int, lo: int, hi: int):
+        """Host copies of rows [lo, hi): (k, v) each [L, hi-lo, Hkv, D] —
+        the same per-slot layout the dense cache exports, so the disagg
+        wire format is stack-independent."""
+        import numpy as np
+
+        w, b = self._loc(slot)
+        return (np.asarray(self.k[w, :, b, lo:hi]),
+                np.asarray(self.v[w, :, b, lo:hi]))
+
+    def import_rows(self, slot: int, k_rows, v_rows, *,
+                    length: int) -> "MoESlotCache":
+        import numpy as np
+
+        w, b = self._loc(slot)
+        n = k_rows.shape[1]
+        # np.array (not asarray): device gathers come back read-only
+        k = np.array(self.k)
+        v = np.array(self.v)
+        lengths = np.array(self.lengths)
+        k[w, :, b, :n] = np.asarray(k_rows, k.dtype)
+        v[w, :, b, :n] = np.asarray(v_rows, v.dtype)
+        lengths[w, b] = length
+        return MoESlotCache(jnp.asarray(k), jnp.asarray(v),
+                            jnp.asarray(lengths))
+
+    def copy_prefix(self, dst: int, src: int, n: int) -> "MoESlotCache":
+        import numpy as np
+
+        dw, db = self._loc(dst)
+        sw, sb = self._loc(src)
+        k = np.array(self.k)
+        v = np.array(self.v)
+        lengths = np.array(self.lengths)
+        k[dw, :, db, :n] = k[sw, :, sb, :n]
+        v[dw, :, db, :n] = v[sw, :, sb, :n]
+        lengths[dw, db] = n
+        return MoESlotCache(jnp.asarray(k), jnp.asarray(v),
+                            jnp.asarray(lengths))
+
 
 def init_params(key: jax.Array, cfg: MoEServeConfig) -> Dict[str, Any]:
     """Global parameter tree (experts carry the full [E, ...] axis)."""

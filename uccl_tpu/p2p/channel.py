@@ -21,8 +21,23 @@ from typing import List, Optional
 
 import numpy as np
 
+from uccl_tpu import obs
 from uccl_tpu.p2p.endpoint import FIFO_ITEM_BYTES, Endpoint
 from uccl_tpu.utils.config import param
+
+# Channel-level spray accounting (payload bytes are already counted per
+# verb on p2p_bytes_total by the Endpoint the chunks issue through): how
+# many chunk transfers the multipath fan-out created, and how many were
+# re-issued after a completion timeout — the wire-health face of the
+# credit-paced spray (docs/OBSERVABILITY.md).
+_CHAN_CHUNKS = obs.counter(
+    "p2p_channel_chunks_total",
+    "chunk transfers issued by the multipath channel spray",
+)
+_CHAN_RETX = obs.counter(
+    "p2p_channel_retx_total",
+    "channel chunks re-issued after a completion timeout (loss/failover)",
+)
 
 _chunk_kb = param("chunk_size_kb", 1024, help="multipath chunk size in KiB")
 _abandoned_cap = param(
@@ -426,6 +441,7 @@ class Channel:
             # async + wait so the caller's timeout_ms governs each attempt
             # (the native sync op carries its own fixed internal timeout)
             for attempt in range(self.retries + 1):
+                _CHAN_CHUNKS.inc()
                 xid = async_op(
                     self.conns[attempt % self.n_paths], arr, fifo
                 )
@@ -434,6 +450,7 @@ class Channel:
                 self._abandon(xid)
                 if attempt < self.retries:
                     self.retransmitted_chunks += 1
+                    _CHAN_RETX.inc()
             raise IOError(
                 f"transfer failed: undelivered after {self.retries + 1} "
                 "attempts"
@@ -448,6 +465,7 @@ class Channel:
                 if self._pull_mode and attempt == 0:
                     self._await_credit(self._pull_sent + ln, timeout_ms)
                     self._pull_sent += ln
+                _CHAN_CHUNKS.inc()
                 xids.append(
                     async_op(
                         self.conns[(ci + attempt) % self.n_paths],
@@ -500,6 +518,7 @@ class Channel:
             failed = dead + [p for _, p in pend]
             if attempt < self.retries:
                 self.retransmitted_chunks += len(failed)
+                _CHAN_RETX.inc(len(failed))
             pending = failed
         raise IOError(
             f"chunked transfer failed: {len(pending)} chunks undelivered "

@@ -16,10 +16,22 @@ from typing import Optional, Tuple, Union
 
 import numpy as np
 
+from uccl_tpu import obs
 from uccl_tpu.utils.config import param
 from uccl_tpu.utils.logging import get_logger
 
 _log = get_logger("P2P")
+
+# Transfer-engine byte accounting on the obs registry (docs/OBSERVABILITY.md):
+# one labeled series for every verb class, incremented at the Python call
+# site with the payload size — the auditable "every transferred byte" face
+# of the KV-handoff path (native bytes_tx/rx remain the wire-level truth,
+# including retransmits; this series is application intent).
+_P2P_BYTES = obs.counter(
+    "p2p_bytes_total",
+    "payload bytes entering the p2p engine per verb "
+    "(write/read/send/recv/notif; vectorized calls count per element)",
+)
 
 _stage_chunk_bytes = param(
     "stage_chunk_bytes", 8 << 20,
@@ -374,16 +386,19 @@ class Endpoint:
     # -- one-sided -------------------------------------------------------
     def write(self, conn_id: int, src: np.ndarray, fifo: bytes) -> None:
         ptr, nbytes = _as_buffer(src)
+        _P2P_BYTES.inc(nbytes, verb="write")
         if self._lib.ucclt_write(self._handle(), conn_id, ptr, nbytes, fifo) != 0:
             raise IOError("write failed")
 
     def read(self, conn_id: int, dst: np.ndarray, fifo: bytes) -> None:
         ptr, nbytes = _as_buffer(dst)
+        _P2P_BYTES.inc(nbytes, verb="read")
         if self._lib.ucclt_read(self._handle(), conn_id, ptr, nbytes, fifo) != 0:
             raise IOError("read failed")
 
     def write_async(self, conn_id: int, src: np.ndarray, fifo: bytes) -> int:
         ptr, nbytes = _as_buffer(src)
+        _P2P_BYTES.inc(nbytes, verb="write")
         xid = self._lib.ucclt_write_async(self._handle(), conn_id, ptr, nbytes, fifo)
         # Keep the buffer alive until completion: the tx proxy thread reads
         # from the raw pointer after this call returns.
@@ -392,11 +407,12 @@ class Endpoint:
 
     def read_async(self, conn_id: int, dst: np.ndarray, fifo: bytes) -> int:
         ptr, nbytes = _as_buffer(dst)
+        _P2P_BYTES.inc(nbytes, verb="read")
         xid = self._lib.ucclt_read_async(self._handle(), conn_id, ptr, nbytes, fifo)
         self._inflight[xid] = dst
         return xid
 
-    def _vec_async(self, c_fn, conn_id: int, arrays, fifos):
+    def _vec_async(self, c_fn, conn_id: int, arrays, fifos, verb: str):
         """Shared descriptor-array fan-out: one C call, one engine wake."""
         n = len(arrays)
         bufs = [_as_buffer(a) for a in arrays]
@@ -405,6 +421,7 @@ class Endpoint:
         packed = b"".join(bytes(f) for f in fifos)
         if len(packed) != n * FIFO_ITEM_BYTES:
             raise ValueError("fifos must be n packed 64-byte descriptors")
+        _P2P_BYTES.inc(sum(ln for _, ln in bufs), verb=verb)
         xids = (ctypes.c_uint64 * n)()
         c_fn(self._handle(), conn_id, ptrs, lens, packed, n, xids)
         out = list(xids)
@@ -417,11 +434,13 @@ class Endpoint:
         writev_async + XferDescList, engine.h:317, engine_api.cc:448):
         one C call enqueues the whole batch with a single proxy wake.
         Returns per-element xfer ids."""
-        return self._vec_async(self._lib.ucclt_writev_async, conn_id, srcs, fifos)
+        return self._vec_async(self._lib.ucclt_writev_async, conn_id, srcs,
+                               fifos, "write")
 
     def readv_async(self, conn_id: int, dsts, fifos):
         """Vectorized async read (reference: readv, engine.h:324)."""
-        return self._vec_async(self._lib.ucclt_readv_async, conn_id, dsts, fifos)
+        return self._vec_async(self._lib.ucclt_readv_async, conn_id, dsts,
+                               fifos, "read")
 
     def _wait_all(self, xids, what: str) -> None:
         # Drain EVERY element before raising: abandoning the rest of the
@@ -496,6 +515,7 @@ class Endpoint:
             ptr, nbytes = _as_buffer(data)
         else:
             ptr, nbytes = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p), len(data)
+        _P2P_BYTES.inc(nbytes, verb="send")
         if self._lib.ucclt_send(self._handle(), conn_id, ptr, nbytes) != 0:
             raise IOError("send failed")
 
@@ -508,6 +528,7 @@ class Endpoint:
         if fn is None:
             raise RuntimeError("loaded libuccl_tpu.so predates notif ABI")
         ptr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
+        _P2P_BYTES.inc(len(data), verb="notif")
         if fn(self._handle(), conn_id, ptr, len(data)) != 0:
             raise IOError("send_notif failed")
 
@@ -543,6 +564,7 @@ class Endpoint:
             n = self._lib.ucclt_recv(self._handle(), conn_id, buf, needed, timeout_ms)
         if n < 0:
             raise TimeoutError("recv timed out")
+        _P2P_BYTES.inc(int(n), verb="recv")
         return buf.raw[:n]
 
     def recv_into(self, conn_id: int, out: np.ndarray, timeout_ms: int = 10000) -> int:
@@ -561,6 +583,7 @@ class Endpoint:
             )
         if n < 0:
             raise TimeoutError("recv timed out")
+        _P2P_BYTES.inc(int(n), verb="recv")
         return n
 
     # -- observability / fault injection ---------------------------------

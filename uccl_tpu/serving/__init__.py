@@ -8,17 +8,21 @@ oracle.
 """
 
 from uccl_tpu.serving.engine import (  # noqa: F401
-    DenseBackend, MoEBackend, ServingEngine,
+    ChunkEvent, DenseBackend, MoEBackend, ServingEngine,
 )
 from uccl_tpu.serving.metrics import (  # noqa: F401
     ServingMetrics, percentile, percentiles_ms,
 )
+from uccl_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from uccl_tpu.serving.request import Request, RequestState  # noqa: F401
 from uccl_tpu.serving.scheduler import FIFOScheduler  # noqa: F401
 from uccl_tpu.serving.slots import SlotPool  # noqa: F401
 
+# uccl_tpu.serving.disagg (the prefill/decode worker pair over p2p) is
+# imported explicitly by its consumers — it pulls in the p2p runtime.
+
 __all__ = [
-    "DenseBackend", "MoEBackend", "ServingEngine", "ServingMetrics",
-    "percentile", "percentiles_ms", "Request", "RequestState",
-    "FIFOScheduler", "SlotPool",
+    "ChunkEvent", "DenseBackend", "MoEBackend", "ServingEngine",
+    "ServingMetrics", "percentile", "percentiles_ms", "PrefixCache",
+    "Request", "RequestState", "FIFOScheduler", "SlotPool",
 ]

@@ -1,0 +1,591 @@
+"""Disaggregated prefill/decode serving: chunk-streamed KV handoff over p2p.
+
+The P2P pillar's reason to exist (PAPER.md §0.2: a NIXL-style
+initiator-target KV-cache transfer engine), promoted from the one-shot
+``examples/disagg_kv.py`` proof into a serving architecture: a
+**PrefillWorker** runs a chunked-prefill ``ServingEngine`` and, as each
+C-token chunk of a prompt lands in its KV slot, one-sided-writes that
+``[off, off+C)`` KV slab into the decode worker's advertised slot pool via
+``Endpoint.writev_async`` — transfer of chunk *i* overlaps prefill compute
+of chunk *i+1*, so when the last chunk's logits produce the first token,
+only ONE chunk (plus the control notif) remains in flight. The
+**DecodeWorker** reserved its slot when the stream opened (BEGIN→GRANT),
+imports the streamed rows, and ``adopt()``s the request into its own
+engine: TTFT is bounded by prefill + one chunk's transfer, not prefill +
+whole-cache transfer. Add the prefill side's prefix-reuse cache
+(``serving/prefix_cache.py``) and shared system prompts are computed once:
+a hit resumes at ``prefill_pos = matched_len`` — still shipping every
+chunk (the decode side needs all rows), but skipping their compute.
+
+Exactness: KV slabs cross the wire as raw float32 rows, the first token is
+computed by the (oracle-exact, tested) prefill engine, and the decode
+engine continues through the same masked decode primitive — so the
+disaggregated output is bit-identical to one-shot ``generate``, cold or
+cache-hit, on both stacks (tests/test_prefix_cache.py,
+tests/test_disagg_kv.py).
+
+Wire format (docs/SERVING.md): the decode side advertises its ENTIRE host
+KV mirror (one FifoItem for K, one for V, exchanged in HELLO); the prefill
+side derives per-(layer, chunk) windows by descriptor slicing
+(``FifoItem.slice``), so the steady-state control plane is three small
+JSON notifs per request — BEGIN (prompt + timing), GRANT (slot), FINAL
+(length + first token + timing) — and ALL KV bytes move one-sided.
+
+Control-plane timestamps are wall-clock (``time.time()``): the TTFT split
+(queue / prefill / transfer) spans two processes, where the engines'
+monotonic clocks share no epoch.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from uccl_tpu import obs
+from uccl_tpu.serving.engine import ChunkEvent, ServingEngine
+from uccl_tpu.serving.request import Request, now
+
+KV_DTYPE = np.float32
+
+_STREAM_CHUNKS = obs.counter(
+    "kv_stream_chunks_total",
+    "KV slabs streamed between prefill and decode workers (role=tx|rx)",
+)
+_STREAM_REQS = obs.counter(
+    "kv_stream_requests_total",
+    "requests whose KV crossed the disagg stream (role=tx|rx)",
+)
+
+
+# -- wire format ------------------------------------------------------------
+@dataclass(frozen=True)
+class KVWireFormat:
+    """Byte layout of a decode worker's host KV mirror — the contract both
+    ends slice against. The mirror is the CANONICAL dense slot layout
+    ``[L, n_slots, S_max, Hkv, D]`` float32 regardless of model stack (the
+    MoE cache maps its [W, B_loc] grid to flat slot ids at import), so
+    prefill and decode stacks only need matching model dims, not matching
+    cache layouts. Pure host math — numpy-only, unit-tested without jax."""
+
+    n_layers: int
+    n_slots: int
+    max_seq: int
+    n_kv_heads: int
+    head_dim: int
+    itemsize: int = 4
+
+    @property
+    def row_bytes(self) -> int:
+        return self.n_kv_heads * self.head_dim * self.itemsize
+
+    def pool_shape(self) -> Tuple[int, ...]:
+        return (self.n_layers, self.n_slots, self.max_seq,
+                self.n_kv_heads, self.head_dim)
+
+    def pool_nbytes(self) -> int:
+        n = 1
+        for d in self.pool_shape():
+            n *= d
+        return n * self.itemsize
+
+    def spans(self, slot: int, lo: int, hi: int) -> List[Tuple[int, int]]:
+        """Per-layer ``(offset_bytes, length_bytes)`` of rows [lo, hi) of
+        ``slot`` inside one pool array (K or V — same layout)."""
+        if not (0 <= lo < hi <= self.max_seq):
+            raise ValueError(f"rows [{lo}, {hi}) outside [0, {self.max_seq})")
+        if not (0 <= slot < self.n_slots):
+            raise ValueError(f"slot {slot} outside pool of {self.n_slots}")
+        out = []
+        for layer in range(self.n_layers):
+            base = ((layer * self.n_slots + slot) * self.max_seq + lo)
+            out.append((base * self.row_bytes, (hi - lo) * self.row_bytes))
+        return out
+
+    def to_meta(self) -> Dict:
+        return {
+            "n_layers": self.n_layers, "n_slots": self.n_slots,
+            "max_seq": self.max_seq, "n_kv_heads": self.n_kv_heads,
+            "head_dim": self.head_dim, "itemsize": self.itemsize,
+        }
+
+    @staticmethod
+    def from_meta(meta: Dict) -> "KVWireFormat":
+        return KVWireFormat(**{k: int(v) for k, v in meta.items()})
+
+
+def _model_dims(backend) -> Dict[str, int]:
+    """(n_layers, n_kv_heads, head_dim) of a serving backend — DenseBackend
+    carries its config, MoEBackend's lives on its server."""
+    cfg = getattr(backend, "cfg", None)
+    if cfg is None:
+        cfg = backend.server.cfg
+    return {"n_layers": cfg.n_layers, "n_kv_heads": cfg.n_kv_heads,
+            "head_dim": cfg.head_dim}
+
+
+def wire_format_for(backend) -> KVWireFormat:
+    """The wire format describing ``backend``'s slot pool as a mirror."""
+    return KVWireFormat(n_slots=backend.n_slots, max_seq=backend.max_seq,
+                        itemsize=np.dtype(KV_DTYPE).itemsize,
+                        **_model_dims(backend))
+
+
+# -- control plane ----------------------------------------------------------
+def _send_msg(ep, conn: int, msg: Dict) -> None:
+    ep.send_notif(conn, json.dumps(msg).encode())
+
+
+def _drain_msgs(ep) -> List[Tuple[int, Dict]]:
+    return [(conn, json.loads(raw.decode()))
+            for conn, raw in ep.get_notifs()]
+
+
+def _b64(raw: bytes) -> str:
+    return base64.b64encode(raw).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s.encode())
+
+
+# -- prefill side -----------------------------------------------------------
+@dataclass
+class _TxStream:
+    """Prefill-side per-request stream state."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_id: Optional[int]
+    t_submit_wall: float
+    t_admit_wall: Optional[float] = None
+    t_done_wall: Optional[float] = None
+    slabs: List[Tuple[int, int, np.ndarray, np.ndarray]] = field(
+        default_factory=list)  # (lo, hi, k, v) exported, awaiting ship
+    remote_slot: Optional[int] = None  # GRANTed decode-side slot
+    xids: List[int] = field(default_factory=list)
+    n_shipped: int = 0
+    first_token: Optional[int] = None
+    done: bool = False  # prefill finished (first token known)
+    cache_hit_len: int = 0  # rows reused from the prefix cache
+
+
+class PrefillWorker:
+    """The prefill-fleet role: a chunked-prefill ``ServingEngine`` whose
+    per-chunk KV output streams to one decode worker as it is computed.
+
+    The engine must run ``prefill_chunk=C`` (the streaming granularity) and
+    may carry a ``PrefixCache`` — cache-hit slabs ship without having been
+    recomputed. Submissions go through :meth:`submit` (which opens the
+    stream); drive the loop with :meth:`step` until :meth:`idle`.
+    """
+
+    def __init__(self, engine: ServingEngine, ep, ip: str, port: int,
+                 *, timeout_ms: int = 30000):
+        _init_prefill_worker(self, engine, ep, ep.connect(ip, port),
+                             timeout_ms=timeout_ms)
+
+    # -- submission ----------------------------------------------------
+    def submit(self, prompt, *, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None) -> Optional[Request]:
+        """Open a KV stream and queue the prompt on the prefill engine
+        (``max_new_tokens=1`` locally — this fleet never decodes; the
+        requested budget rides the BEGIN message to the decode side).
+        Returns the local Request, or None on queue backpressure."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        req = self.engine.submit(prompt, max_new_tokens=1)
+        if req is None:
+            return None
+        st = _TxStream(req.rid, prompt, max_new_tokens, eos_id,
+                       t_submit_wall=time.time())
+        self._streams[req.rid] = st
+        _send_msg(self.ep, self.conn, {
+            "t": "begin", "rid": req.rid, "prompt": prompt.tolist(),
+            "max_new_tokens": max_new_tokens, "eos_id": eos_id,
+            "t_submit": st.t_submit_wall,
+        })
+        return req
+
+    # -- engine hook ---------------------------------------------------
+    def _on_chunks(self, events: List[ChunkEvent]) -> None:
+        """Export every newly valid KV slab to host NOW (the slot may be
+        freed/parked at this step's retirement) and queue it for the wire;
+        cache-hit copies (``reused=True``) ship exactly like computed
+        chunks — the decode side needs all rows either way."""
+        for ev in events:
+            st = self._streams.get(ev.req.rid)
+            if st is None:
+                continue  # warmup / non-streamed submission
+            if st.t_admit_wall is None and ev.req.t_admit is not None:
+                # back-date to the engine's admission mark (the first
+                # event arrives AFTER the first chunk's compute — stamping
+                # now() would misfile that compute under queue time)
+                st.t_admit_wall = time.time() - max(
+                    0.0, now() - ev.req.t_admit
+                )
+            with obs.span("kv_stream.export", track="wire", slot=ev.slot,
+                          lo=ev.lo, hi=ev.hi, reused=ev.reused):
+                k, v = self.engine.backend.export_slot_kv(
+                    ev.slot, ev.lo, ev.hi
+                )
+            st.slabs.append((ev.lo, ev.hi, k, v))
+            if ev.reused:
+                st.cache_hit_len = max(st.cache_hit_len, ev.hi)
+            if ev.done:
+                st.done = True
+                st.first_token = ev.first_token
+                st.t_done_wall = time.time()
+
+    # -- the pump ------------------------------------------------------
+    def _ship(self, st: _TxStream) -> None:
+        fifos_k, fifos_v = self._fifo_k, self._fifo_v
+        for lo, hi, k, v in st.slabs:
+            spans = self.fmt.spans(st.remote_slot, lo, hi)
+            srcs = ([np.ascontiguousarray(k[layer])
+                     for layer in range(self.fmt.n_layers)]
+                    + [np.ascontiguousarray(v[layer])
+                       for layer in range(self.fmt.n_layers)])
+            fifos = ([fifos_k.slice(off, ln).pack() for off, ln in spans]
+                     + [fifos_v.slice(off, ln).pack() for off, ln in spans])
+            with obs.span("kv_stream.tx", track="wire", rid=st.rid,
+                          slot=st.remote_slot, lo=lo, hi=hi,
+                          bytes=sum(s.nbytes for s in srcs)):
+                st.xids.extend(
+                    self.ep.writev_async(self.conn, srcs, fifos)
+                )
+            st.n_shipped += 1
+            _STREAM_CHUNKS.inc(role="tx")
+        st.slabs.clear()
+
+    def pump(self) -> None:
+        """Drain GRANTs, ship queued slabs, close finished streams (wait
+        for every slab's completion, then send FINAL — writes and notifs
+        share the conn, so the decode side sees all rows before FINAL)."""
+        for _, msg in _drain_msgs(self.ep):
+            if msg.get("t") == "grant":
+                st = self._streams.get(msg["rid"])
+                if st is not None:
+                    st.remote_slot = int(msg["slot"])
+        for st in self._streams.values():
+            if st.remote_slot is not None and st.slabs:
+                self._ship(st)
+        for rid, st in list(self._streams.items()):
+            if not (st.done and st.remote_slot is not None
+                    and not st.slabs):
+                continue
+            for xid in st.xids:
+                if not self.ep.wait(xid, self._timeout_ms):
+                    raise IOError(
+                        f"kv stream rid={rid}: slab write undelivered"
+                    )
+            _send_msg(self.ep, self.conn, {
+                "t": "final", "rid": rid,
+                "length": int(st.prompt.size),
+                "first_token": int(st.first_token),
+                "chunks": st.n_shipped,
+                "cache_hit_len": st.cache_hit_len,
+                "t_submit": st.t_submit_wall,
+                "t_admit": st.t_admit_wall,
+                "t_done": st.t_done_wall,
+            })
+            _STREAM_REQS.inc(role="tx")
+            del self._streams[rid]
+
+    def step(self) -> None:
+        """One loop iteration: advance the engine (chunks export through
+        the sink) then pump the wire."""
+        if self.engine.has_work():
+            self.engine.step()
+        self.pump()
+
+    def idle(self) -> bool:
+        return not self.engine.has_work() and not self._streams
+
+    def drain(self, timeout_s: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while not self.idle():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"prefill drain stalled: {len(self._streams)} streams "
+                    f"open (ungranted decode slots?)"
+                )
+            self.step()
+            if not self.engine.has_work():
+                time.sleep(0.001)  # waiting on grants/completions only
+
+    def close(self) -> None:
+        _send_msg(self.ep, self.conn, {"t": "bye"})
+
+
+# -- decode side ------------------------------------------------------------
+class DecodeWorker:
+    """The decode-fleet role: a ``ServingEngine`` whose requests arrive as
+    KV streams. BEGIN reserves a slot (deferred under a full pool — the
+    GRANT is the admission backpressure), streamed slabs land one-sided in
+    the registered host mirror, FINAL imports rows [0, plen) into the
+    engine's device cache and ``adopt()``s the request.
+    """
+
+    def __init__(self, engine: ServingEngine, ep):
+        self.engine = engine
+        self.ep = ep
+        self.fmt = wire_format_for(engine.backend)
+        self.mirror_k = np.zeros(self.fmt.pool_shape(), KV_DTYPE)
+        self.mirror_v = np.zeros(self.fmt.pool_shape(), KV_DTYPE)
+        self._mr_k = ep.reg(self.mirror_k)
+        self._mr_v = ep.reg(self.mirror_v)
+        self._pending: Deque[Tuple[int, Dict]] = deque()
+        self._granted: Dict[Tuple[int, int], Dict] = {}  # (conn, rid) -> st
+        self._finished: List[Request] = []
+        self.origin: Dict[int, Tuple[int, int]] = {}  # local rid -> (conn, remote rid)
+        self.closed = False
+
+    @property
+    def port(self) -> int:
+        return self.ep.port
+
+    def attach(self, timeout_ms: int = 30000) -> int:
+        """Accept one prefill worker and hand it the pool descriptors."""
+        conn = self.ep.accept(timeout_ms=timeout_ms)
+        self.ep.send(conn, json.dumps({
+            "t": "hello", "fmt": self.fmt.to_meta(),
+            "k_fifo": _b64(self.ep.advertise(self._mr_k)),
+            "v_fifo": _b64(self.ep.advertise(self._mr_v)),
+        }).encode())
+        return conn
+
+    # -- control-plane handling ----------------------------------------
+    def poll(self) -> None:
+        for conn, msg in _drain_msgs(self.ep):
+            kind = msg.get("t")
+            if kind == "begin":
+                self._pending.append((conn, msg))
+            elif kind == "final":
+                self._on_final(conn, msg)
+            elif kind == "bye":
+                self.closed = True
+        self._try_grant()
+
+    def _try_grant(self) -> None:
+        while self._pending:
+            conn, msg = self._pending[0]
+            slot = self.engine.pool.admit(int(msg["rid"]))
+            if slot is None:
+                break  # pool full: BEGINs wait (admission backpressure)
+            self._pending.popleft()
+            self._granted[(conn, int(msg["rid"]))] = {
+                "slot": slot, "msg": msg, "t_grant": time.time(),
+            }
+            _send_msg(self.ep, conn, {
+                "t": "grant", "rid": int(msg["rid"]), "slot": slot,
+            })
+
+    def _on_final(self, conn: int, final: Dict) -> None:
+        st = self._granted.pop((conn, int(final["rid"])), None)
+        if st is None:
+            raise KeyError(
+                f"FINAL for unknown stream rid={final['rid']} (no BEGIN "
+                "grant recorded)"
+            )
+        slot, begin = st["slot"], st["msg"]
+        plen = int(final["length"])
+        # full S_max rows: rows past plen are dead (masked attention), and
+        # the fixed shape keeps every import on one compiled program
+        k_rows = self.mirror_k[:, slot, :]
+        v_rows = self.mirror_v[:, slot, :]
+        with obs.span("kv_stream.import", track="wire", slot=slot,
+                      rows=plen, chunks=int(final["chunks"])):
+            self.engine.backend.import_slot_kv(
+                slot, k_rows, v_rows, length=plen
+            )
+        _STREAM_CHUNKS.inc(int(final["chunks"]), role="rx")
+        _STREAM_REQS.inc(role="rx")
+        t_adopt = time.time()
+        t_submit, t_admit, t_done = (final["t_submit"], final["t_admit"],
+                                     final["t_done"])
+        req = self.engine.adopt(
+            np.asarray(begin["prompt"], np.int32),
+            int(final["first_token"]),
+            max_new_tokens=int(begin["max_new_tokens"]),
+            eos_id=begin["eos_id"], slot=slot,
+            queue_s=t_admit - t_submit, prefill_s=t_done - t_admit,
+            transfer_s=t_adopt - t_done,
+        )
+        req.cache_hit_len = int(final.get("cache_hit_len", 0))
+        self.origin[req.rid] = (conn, int(final["rid"]))
+        if req.is_done():  # max_new_tokens == 1 or EOS at the first token
+            self._finished.append(req)
+
+    def step(self) -> List[Request]:
+        """One loop iteration: drain control messages, run one engine
+        step when there is decode work. Returns requests finished now."""
+        self.poll()
+        out, self._finished = self._finished, []
+        if self.engine.has_work():
+            out.extend(self.engine.step())
+        return out
+
+    def serve(self, n_requests: Optional[int] = None,
+              timeout_s: float = 300.0) -> List[Request]:
+        """Loop until ``n_requests`` finished (or the peer said BYE and
+        everything drained). The example/bench decode processes run this."""
+        done: List[Request] = []
+        deadline = time.monotonic() + timeout_s
+        while True:
+            done.extend(self.step())
+            if n_requests is not None and len(done) >= n_requests:
+                return done
+            if (self.closed and not self.engine.has_work()
+                    and not self._pending and not self._granted):
+                return done
+            if not self.engine.has_work():
+                time.sleep(0.001)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"decode serve stalled at {len(done)} finished "
+                    f"({len(self._granted)} streams open)"
+                )
+
+
+# -- shared one-shot reference + in-process pair helpers --------------------
+def oneshot_reference(params, cfg, prompt, new_tokens: int, max_seq: int):
+    """The single-worker greedy continuation both disagg examples check
+    against (prefill + decode_step loop — one implementation, two
+    consumers: examples/disagg_kv.py and examples/disagg_proxy.py)."""
+    import jax.numpy as jnp
+
+    from uccl_tpu.models.inference import prefill
+
+    logits, cache = prefill(params, jnp.asarray(prompt), cfg, max_seq)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return decode_continue(params, cfg, cache, tok, new_tokens)
+
+
+def decode_continue(params, cfg, cache, first_tok, new_tokens: int):
+    """Continue ``new_tokens`` greedy steps from a warm cache + first
+    token (the decode leg shared by the legacy one-shot examples)."""
+    import jax.numpy as jnp
+
+    from uccl_tpu.models.inference import decode_step
+
+    tok = jnp.asarray(first_tok)
+    toks = [np.asarray(tok)]
+    for _ in range(new_tokens - 1):
+        logits, cache = decode_step(params, tok, cache, cfg)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks.append(np.asarray(tok))
+    return np.stack(toks, axis=1)
+
+
+def make_local_pair(prefill_engine: ServingEngine,
+                    decode_engine: ServingEngine,
+                    ) -> Tuple[PrefillWorker, DecodeWorker]:
+    """Both roles in ONE process over loopback endpoints — the in-process
+    harness tests and benches drive (the example runs the same classes in
+    two real processes)."""
+    from uccl_tpu.p2p import Endpoint
+
+    ep_d, ep_p = Endpoint(), Endpoint()
+    dw = DecodeWorker(decode_engine, ep_d)
+    # loopback: connect() completes against the listening endpoint before
+    # accept() is called (the test_p2p pair idiom)
+    pw = PrefillWorker.__new__(PrefillWorker)
+    conn_p = ep_p.connect("127.0.0.1", ep_d.port)
+    dw.attach()
+    _init_prefill_worker(pw, prefill_engine, ep_p, conn_p)
+    return pw, dw
+
+
+def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,
+                         conn: int, timeout_ms: int = 30000) -> None:
+    """PrefillWorker init against an already-open conn (the local-pair
+    path, where connect must precede the peer's accept)."""
+    if engine.prefill_chunk is None:
+        raise ValueError("PrefillWorker needs a chunked engine")
+    if engine.chunk_sink is not None:
+        raise ValueError("engine already has a chunk_sink")
+    hello = json.loads(ep.recv(conn, timeout_ms=timeout_ms))
+    assert hello.get("t") == "hello", hello
+    from uccl_tpu.p2p.channel import FifoItem
+
+    pw.engine = engine
+    pw.ep = ep
+    pw.conn = conn
+    pw.fmt = KVWireFormat.from_meta(hello["fmt"])
+    dims = _model_dims(engine.backend)
+    dims["max_seq"] = engine.backend.max_seq
+    for k, v in dims.items():
+        if getattr(pw.fmt, k) != v:
+            raise ValueError(
+                f"decode pool {k}={getattr(pw.fmt, k)} != prefill "
+                f"backend {k}={v}: the KV slabs would not line up"
+            )
+    pw._fifo_k = FifoItem.unpack(_unb64(hello["k_fifo"]))
+    pw._fifo_v = FifoItem.unpack(_unb64(hello["v_fifo"]))
+    pw._streams = {}
+    pw._timeout_ms = timeout_ms
+    engine.chunk_sink = pw._on_chunks
+
+
+def drive_pair(pw: PrefillWorker, dw: DecodeWorker, prompts, arrivals,
+               max_new_tokens: int, eos_id: Optional[int] = None,
+               timeout_s: float = 300.0) -> Tuple[List[Request], float]:
+    """Submit ``prompts`` at their Poisson ``arrivals`` offsets and step
+    both workers until every accepted request finishes on the decode side.
+    Returns (decode-side finished Requests, wall seconds) — the disagg
+    analog of ``loadgen.drive``."""
+    finished: List[Request] = []
+    i, n = 0, len(prompts)
+    accepted = 0
+    t0 = now()
+    deadline = time.monotonic() + timeout_s
+    while i < n or not pw.idle() or len(finished) < accepted:
+        t = now() - t0
+        while i < n and arrivals[i] <= t:
+            if pw.submit(prompts[i], max_new_tokens=max_new_tokens,
+                         eos_id=eos_id) is not None:
+                accepted += 1
+            i += 1
+        pw.step()
+        finished.extend(dw.step())
+        if not pw.engine.has_work() and not dw.engine.has_work():
+            time.sleep(0.0005)
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"disagg drive stalled: {len(finished)}/{accepted} finished"
+            )
+    return finished, now() - t0
+
+
+def warm_pair(pw: PrefillWorker, dw: DecodeWorker, prompt_len: int,
+              new_tokens: int = 2) -> None:
+    """One dummy request through the whole stream: compiles the prefill
+    chunk program, the decode program, and touches every wire path — then
+    zeroes both engines' metrics and clears the prefix cache (warmup
+    prompts must not act as donors). Counters stay cumulative; benches
+    snapshot deltas around each arm."""
+    reps = 2 if pw.engine.prefix_cache is not None else 1
+    for _ in range(reps):  # rep 2 hits the parked rep-1 donor: compiles
+        pw.submit(np.zeros(max(1, prompt_len), np.int32),  # the copy path
+                  max_new_tokens=max(2, new_tokens))
+        got: List[Request] = []
+        deadline = time.monotonic() + 120.0
+        while len(got) < 1:
+            pw.step()
+            got.extend(dw.step())
+            if time.monotonic() > deadline:
+                raise TimeoutError("disagg warmup stalled")
+    pw.drain()
+    if pw.engine.prefix_cache is not None:
+        pw.engine.prefix_cache.clear(pw.engine.pool)
+    pw.engine.reset_metrics()
+    dw.engine.reset_metrics()
+    from uccl_tpu.serving.loadgen import _clear_warmup_trace
+
+    _clear_warmup_trace()

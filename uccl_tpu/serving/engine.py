@@ -29,7 +29,8 @@ LRU-bounded ``_fns`` pattern the one-shot servers use.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from dataclasses import dataclass
+from typing import Callable, List, Optional
 
 import numpy as np
 
@@ -54,6 +55,29 @@ _OCCUPANCY = obs.gauge(
 _HIGH_WATER = obs.gauge(
     "serving_slot_high_water", "max concurrent KV slot occupancy observed"
 )
+_PREFILL_TOKENS = obs.counter(
+    "serving_prefill_tokens_total",
+    "prompt tokens per prefill path: kind=computed ran the model, "
+    "kind=skipped were reused from the prefix cache (the auditable cut)",
+)
+
+
+@dataclass
+class ChunkEvent:
+    """One slot's KV rows [lo, hi) became valid during this engine step —
+    either computed by a prefill chunk (``reused=False``) or copied from a
+    prefix-cache donor at admission (``reused=True``). The engine hands
+    these to its ``chunk_sink`` (the disagg prefill worker's streaming
+    hook) BEFORE any retirement in the same step, so a sink can export the
+    rows while the slot still holds them."""
+
+    req: Request
+    slot: int
+    lo: int
+    hi: int
+    done: bool  # this event completes the request's prefill
+    first_token: Optional[int]  # set iff done
+    reused: bool
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -140,6 +164,19 @@ class DenseBackend:
         self.cache = SlotKVCache(k, v, ln)
         return np.asarray(t)
 
+    # slot KV movement (prefix-cache hits + the disagg p2p stream) — thin
+    # shims over the cache's export/import views (models/inference.py)
+    def export_slot_kv(self, slot: int, lo: int, hi: int):
+        return self.cache.export_rows(slot, lo, hi)
+
+    def import_slot_kv(self, slot: int, k_rows, v_rows, *,
+                       length: int) -> None:
+        self.cache = self.cache.import_rows(slot, k_rows, v_rows,
+                                            length=length)
+
+    def copy_slot_prefix(self, dst: int, src: int, n: int) -> None:
+        self.cache = self.cache.copy_prefix(dst, src, n)
+
 
 class MoEBackend:
     """Slot-pool serving over the EP-sharded MoE stack: slots are the
@@ -185,6 +222,19 @@ class MoEBackend:
         )
         return np.asarray(t).reshape(self.n_slots)
 
+    # slot KV movement — MoESlotCache maps flat slot ids to its [W, B_loc]
+    # grid internally, so the engine-facing surface matches DenseBackend's
+    def export_slot_kv(self, slot: int, lo: int, hi: int):
+        return self.cache.export_rows(slot, lo, hi)
+
+    def import_slot_kv(self, slot: int, k_rows, v_rows, *,
+                       length: int) -> None:
+        self.cache = self.cache.import_rows(slot, k_rows, v_rows,
+                                            length=length)
+
+    def copy_slot_prefix(self, dst: int, src: int, n: int) -> None:
+        self.cache = self.cache.copy_prefix(dst, src, n)
+
 
 class ServingEngine:
     """submit()/step()/drain() over a backend (Dense or MoE).
@@ -204,7 +254,10 @@ class ServingEngine:
     def __init__(self, backend, *, max_queue: Optional[int] = None,
                  register_stats: bool = False,
                  prefill_chunk: Optional[int] = None,
-                 step_tokens: Optional[int] = None):
+                 step_tokens: Optional[int] = None,
+                 prefix_cache=None,
+                 chunk_sink: Optional[Callable[[List[ChunkEvent]], None]]
+                 = None):
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}"
@@ -221,9 +274,28 @@ class ServingEngine:
                     f"({prefill_chunk}), or no request could ever be "
                     "admitted"
                 )
+        if prefix_cache is not None:
+            if prefill_chunk is None:
+                raise ValueError(
+                    "prefix_cache requires prefill_chunk: matches are "
+                    "chunk-granular and resume via the chunked program"
+                )
+            if prefix_cache.chunk != prefill_chunk:
+                raise ValueError(
+                    f"prefix_cache.chunk ({prefix_cache.chunk}) must equal "
+                    f"prefill_chunk ({prefill_chunk}): a match boundary "
+                    "must be a resumable prefill position"
+                )
+        if chunk_sink is not None and prefill_chunk is None:
+            raise ValueError(
+                "chunk_sink requires prefill_chunk: the whole-prompt path "
+                "emits no per-chunk availability events"
+            )
         self.backend = backend
         self.prefill_chunk = prefill_chunk
         self.step_tokens = step_tokens
+        self.prefix_cache = prefix_cache
+        self.chunk_sink = chunk_sink
         self.pool = SlotPool(backend.n_slots)
         self.sched = FIFOScheduler(max_queue=max_queue)
         self.metrics = ServingMetrics()
@@ -274,6 +346,64 @@ class ServingEngine:
             return None
         return req
 
+    def adopt(self, prompt, first_token, *, max_new_tokens: int = 16,
+              eos_id: Optional[int] = None, slot: Optional[int] = None,
+              queue_s: Optional[float] = None,
+              prefill_s: Optional[float] = None,
+              transfer_s: Optional[float] = None) -> Request:
+        """Admit a request whose prefill happened ELSEWHERE — the disagg
+        decode side. The caller must already have imported the prompt's KV
+        into ``slot`` (``backend.import_slot_kv`` with length =
+        ``len(prompt)``) and supplies the first generated token the prefill
+        fleet computed; the request enters ACTIVE directly and decodes from
+        the next ``step()`` on. ``slot=None`` claims a free slot here;
+        passing a slot means the caller reserved it (``pool.admit``) when
+        the KV stream opened. The ``*_s`` wall-clock splits (queue on the
+        prefill fleet, prefill compute, transfer tail) land on the metrics'
+        disaggregated-TTFT series. Returns the Request (already FINISHED
+        when ``max_new_tokens == 1`` or the first token is EOS)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if prompt.size + max_new_tokens > self.backend.max_seq:
+            raise ValueError(
+                f"prompt {prompt.size} + new {max_new_tokens} tokens exceed "
+                f"max_seq {self.backend.max_seq}: the slot would overflow"
+            )
+        t = now()
+        req = Request(
+            rid=self._next_rid, prompt=prompt,
+            max_new_tokens=max_new_tokens, eos_id=eos_id, t_submit=t,
+        )
+        self._next_rid += 1
+        if slot is None:
+            slot = self.pool.admit(req.rid)
+            if slot is None:
+                raise RuntimeError(
+                    "adopt: no free slot (reserve one at stream-open time "
+                    "or size the decode pool for the stream fan-in)"
+                )
+        req.slot = slot
+        req.adopted = True
+        req.state = RequestState.ACTIVE
+        req.prefill_pos = prompt.size
+        req.t_admit = t
+        self.metrics.on_submit(req)
+        self.metrics.on_admit(req)
+        self.metrics.on_adopt(req, queue_s=queue_s, prefill_s=prefill_s,
+                              transfer_s=transfer_s)
+        self._by_slot[slot] = req
+        obs.instant("adopt", track=req.track, rid=req.rid, slot=slot,
+                    prompt_len=int(prompt.size))
+        finished: List[Request] = []
+        self._emit_first_token(slot, req, np.int32(first_token), now(),
+                               finished)
+        return req
+
     # -- the engine iteration ----------------------------------------------
     def has_work(self) -> bool:
         return bool(self.sched.qsize or self._by_slot)
@@ -305,9 +435,10 @@ class ServingEngine:
         return finished
 
     def _step_chunked(self, finished) -> None:
-        """Chunked-mode iteration: budget-gated admission, one batched
-        chunk over every mid-prefill slot, then the step's single decode
-        pass (requests whose cursor just reached the prompt end join it
+        """Chunked-mode iteration: budget-gated admission (evicting LRU
+        prefix-cache donors when the pool is full), one batched chunk over
+        every mid-prefill slot, then the step's single decode pass
+        (requests whose cursor just reached the prompt end join it
         immediately — same step, like the whole-prompt path)."""
         c = self.prefill_chunk
         limit = None
@@ -317,17 +448,69 @@ class ServingEngine:
             spend = (len(self._by_slot) - len(self._prefilling)
                      + len(self._prefilling) * c)
             limit = max(0, (self.step_tokens - spend) // c)
-        for slot, req in self.sched.admit(self.pool, limit=limit):
+        events: List[ChunkEvent] = []
+        # admit ONE at a time: each admission's prefix-cache match (and
+        # donor copy) must land before the NEXT admission's make_room can
+        # evict that donor — a batch admit would let admission k+1 reclaim
+        # the very slot admission k is about to copy from
+        while limit is None or limit > 0:
+            batch = self.sched.admit(self.pool, limit=1,
+                                     make_room=self._make_room)
+            if not batch:
+                break
+            if limit is not None:
+                limit -= 1
+            slot, req = batch[0]
             req.state = RequestState.PARTIAL_PREFILL
             req.prefill_pos = 0
+            if self.prefix_cache is not None:
+                matched, donor = self.prefix_cache.match(req.prompt)
+                if matched > 0:
+                    # resume at the cached boundary: copy the donor's KV
+                    # rows [0, matched) into the fresh slot, then the
+                    # chunked program continues from start=matched —
+                    # bit-exact by the PR 4 resumability contract
+                    self.backend.copy_slot_prefix(slot, donor, matched)
+                    req.prefill_pos = matched
+                    req.cache_hit_len = matched
+                    _PREFILL_TOKENS.inc(matched, kind="skipped")
+                    obs.instant("prefix_hit", track=req.track, slot=slot,
+                                donor=donor, matched=matched)
+                    events.append(ChunkEvent(req, slot, 0, matched,
+                                             False, None, True))
             self._by_slot[slot] = req
             self._prefilling[slot] = req
             self.metrics.on_admit(req)
             obs.instant("admit", track=req.track, slot=slot)
         if self._prefilling:
-            self._prefill_chunk_step(finished)
+            self._prefill_chunk_step(finished, events)
         if len(self._by_slot) > len(self._prefilling):
             self._decode(finished)
+
+    def _make_room(self) -> bool:
+        """Admission's last resort when no slot is free: evict the LRU
+        prefix-cache donor. Live requests' slots are never candidates —
+        only parked (retired, cache-resident) slots are in the cache. The
+        donor the queue-head request would match is protected: evicting it
+        would trade that admission's cache hit for its slot (when it is the
+        ONLY parked slot, admission waits instead — a live retire parks or
+        frees a slot within a bounded number of steps)."""
+        if self.prefix_cache is None:
+            return False
+        protect = None
+        head = self.sched.peek()
+        if head is not None:
+            protect = self.prefix_cache.peek_donor(head.prompt)
+        if self.prefix_cache.evict_lru(self.pool,
+                                       protect=protect) is not None:
+            return True
+        # the protected donor was the ONLY candidate: with live requests
+        # in flight a retire will park/free a slot within bounded steps, so
+        # defer; with none, nothing can ever free a slot — evict the donor
+        # (trading the head's cache hit for forward progress)
+        if protect is not None and not self._by_slot:
+            return self.prefix_cache.evict_lru(self.pool) is not None
+        return False
 
     def drain(self, max_steps: int = 100000) -> List[Request]:
         """Step until queue and slots are empty; returns all finished."""
@@ -376,6 +559,8 @@ class ServingEngine:
             mask[slot] = True
             self.metrics.on_admit(req)
             obs.instant("admit", track=req.track, slot=slot)
+        _PREFILL_TOKENS.inc(sum(int(r.prompt.size) for _, r in newly),
+                            kind="computed")
         tr = obs.get_tracer()
         ts0 = tr.now_us() if tr is not None else 0.0
         t0 = now()
@@ -394,12 +579,17 @@ class ServingEngine:
             self._by_slot[slot] = req
             self._emit_first_token(slot, req, tok[slot], t_done, finished)
 
-    def _prefill_chunk_step(self, finished) -> None:
+    def _prefill_chunk_step(self, finished,
+                            events: Optional[List[ChunkEvent]] = None,
+                            ) -> None:
         """Advance every mid-prefill slot by one C-token chunk (ONE batched
         call, one compiled program at [n_slots, C]). Rows whose cursor
         reaches the prompt end emit their first token and leave
         PARTIAL_PREFILL; other rows' returned tokens are garbage by the
-        model contract and ignored here."""
+        model contract and ignored here. ``events`` carries this step's
+        admission-time prefix-cache copies; the chunk advances are appended
+        and the whole batch goes to ``chunk_sink`` BEFORE any retirement,
+        so a sink can export rows while slots still hold them."""
         c = self.prefill_chunk
         n = self.backend.n_slots
         tokens = np.zeros((n, c), np.int32)
@@ -426,9 +616,25 @@ class ServingEngine:
             for slot, req in self._prefilling.items():
                 tr.complete("prefill_chunk", ts0, dur, req.track,
                             slot=slot, offset=req.prefill_pos)
-        for slot, req in list(self._prefilling.items()):
-            req.prefill_pos = min(req.prefill_pos + c, req.prompt.size)
-            if req.prefill_pos < req.prompt.size:
+        if events is None:
+            events = []
+        computed = 0
+        advanced = []
+        for slot, req in self._prefilling.items():
+            old = req.prefill_pos
+            req.prefill_pos = min(old + c, req.prompt.size)
+            done = req.prefill_pos >= req.prompt.size
+            computed += req.prefill_pos - old
+            events.append(ChunkEvent(
+                req, slot, old, req.prefill_pos, done,
+                int(tok[slot]) if done else None, False,
+            ))
+            advanced.append((slot, req, done))
+        _PREFILL_TOKENS.inc(computed, kind="computed")
+        if self.chunk_sink is not None:
+            self.chunk_sink(events)
+        for slot, req, done in advanced:
+            if not done:
                 continue  # more chunks to go — next step
             del self._prefilling[slot]
             req.state = RequestState.ACTIVE
@@ -476,9 +682,15 @@ class ServingEngine:
             return
         req.state = RequestState.FINISHED
         req.t_finish = t
-        self.pool.free(slot)
+        # park-on-retire: with a prefix cache, the retiring slot's prompt
+        # KV stays resident as a reuse donor (LRU-evicted under admission
+        # pressure) instead of being freed
+        parked = (self.prefix_cache is not None
+                  and self.prefix_cache.park(self.pool, slot, req.prompt))
+        if not parked:
+            self.pool.free(slot)
         self._by_slot.pop(slot, None)
         self.metrics.on_finish(req)
         obs.instant("finish", track=req.track, reason=req.finish_reason,
-                    tokens=req.n_generated)
+                    tokens=req.n_generated, parked=parked)
         finished.append(req)

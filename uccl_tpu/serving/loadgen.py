@@ -32,6 +32,43 @@ def synth_workload(rng: np.random.Generator, n: int, prompt_len: int,
     return prompts, lens, arrivals
 
 
+def synth_shared_workload(rng: np.random.Generator, n: int, prompt_len: int,
+                          vocab: int, arrival_rate: float, hit_rate: float,
+                          shared_len: int):
+    """Mixed workload with a shared "system prompt": with probability
+    ``hit_rate`` a request's prompt is the fixed ``shared_len``-token
+    prefix plus a random tail (prefix-cache fodder); otherwise a plain
+    mixed-length random prompt as in :func:`synth_workload`. Returns
+    (prompts, lens, arrivals)."""
+    if not (0 < shared_len < prompt_len):
+        raise ValueError(
+            f"shared_len must be in (0, prompt_len), got {shared_len} of "
+            f"{prompt_len}"
+        )
+    # arrivals FIRST: every hit-rate arm at the same seed then faces the
+    # identical arrival stream, so TTFT/goodput deltas are cache effects,
+    # not Poisson-sample luck
+    if arrival_rate > 0:
+        arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate, n))
+    else:
+        arrivals = np.zeros(n)
+    shared = rng.integers(0, vocab, shared_len).astype(np.int32)
+    prompts = []
+    for _ in range(n):
+        if rng.random() < hit_rate:
+            tail = rng.integers(1, prompt_len - shared_len + 1)
+            prompts.append(np.concatenate(
+                [shared, rng.integers(0, vocab, tail).astype(np.int32)]
+            ))
+        else:
+            lo = max(1, prompt_len // 2)
+            prompts.append(rng.integers(
+                0, vocab, rng.integers(lo, prompt_len + 1)
+            ).astype(np.int32))
+    lens = np.asarray([p.size for p in prompts])
+    return prompts, lens, arrivals
+
+
 def warm_engine(engine: ServingEngine, lens, max_seq: int,
                 new_tokens: int) -> None:
     """Compile every prefill program the sampled lengths can hit plus the
@@ -50,6 +87,15 @@ def warm_engine(engine: ServingEngine, lens, max_seq: int,
         engine.submit(np.zeros(max(1, longest), np.int32),
                       max_new_tokens=min(2, new_tokens))
         engine.drain()
+        if engine.prefix_cache is not None:
+            # a second identical prompt HITS the parked warmup donor,
+            # compiling the slot-copy program the hit path runs through —
+            # then the cache is emptied (warmup prompts must not stay
+            # resident as reuse donors)
+            engine.submit(np.zeros(max(1, longest), np.int32),
+                          max_new_tokens=min(2, new_tokens))
+            engine.drain()
+            engine.prefix_cache.clear(engine.pool)
         engine.reset_metrics()
         _clear_warmup_trace()
         return

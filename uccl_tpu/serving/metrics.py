@@ -62,6 +62,7 @@ class ServingMetrics:
         self.submitted = 0
         self.rejected = 0
         self.admitted = 0
+        self.adopted = 0  # requests entering via adopt() (disagg decode)
         self.completed = 0
         self.output_tokens = 0  # completed requests only (goodput numerator)
         self.prefill_calls = 0
@@ -74,6 +75,14 @@ class ServingMetrics:
         self.prefill_s: List[float] = []
         self.decode_step_s: List[float] = []
         self.step_s: List[float] = []
+        # disaggregated TTFT split (decode side, wall-clock seconds carried
+        # in the stream's control messages — docs/SERVING.md): submit→admit
+        # on the prefill fleet, admit→prefill-done, prefill-done→adopt
+        # (the transfer tail), and the end-to-end sum per adopted request.
+        self.disagg_queue_s: List[float] = []
+        self.disagg_prefill_s: List[float] = []
+        self.disagg_transfer_s: List[float] = []
+        self.disagg_ttft_s: List[float] = []
         self.t_first_submit: Optional[float] = None
         self.t_last_finish: Optional[float] = None
 
@@ -94,6 +103,25 @@ class ServingMetrics:
     def on_first_token(self, req: Request) -> None:
         if req.ttft is not None:
             self.ttft_s.append(req.ttft)
+
+    def on_adopt(self, req: Request, *, queue_s: Optional[float] = None,
+                 prefill_s: Optional[float] = None,
+                 transfer_s: Optional[float] = None) -> None:
+        """A request adopted mid-stream (disagg decode side): its KV and
+        first token arrived over the wire, so TTFT decomposes into the
+        prefill fleet's queue + prefill time plus the transfer tail."""
+        self.adopted += 1
+        if queue_s is not None:
+            self.disagg_queue_s.append(max(0.0, queue_s))
+        if prefill_s is not None:
+            self.disagg_prefill_s.append(max(0.0, prefill_s))
+        if transfer_s is not None:
+            self.disagg_transfer_s.append(max(0.0, transfer_s))
+        if None not in (queue_s, prefill_s, transfer_s):
+            self.disagg_ttft_s.append(
+                max(0.0, queue_s) + max(0.0, prefill_s)
+                + max(0.0, transfer_s)
+            )
 
     def on_finish(self, req: Request) -> None:
         self.completed += 1
@@ -155,6 +183,14 @@ class ServingMetrics:
         }
         if self.step_s:
             snap["max_step_ms"] = round(max(self.step_s) * 1e3, 3)
+        if self.adopted:
+            snap["adopted"] = self.adopted
+            snap["disagg_queue_ms"] = percentiles_ms(self.disagg_queue_s)
+            snap["disagg_prefill_ms"] = percentiles_ms(self.disagg_prefill_s)
+            snap["disagg_transfer_ms"] = percentiles_ms(
+                self.disagg_transfer_s
+            )
+            snap["disagg_ttft_ms"] = percentiles_ms(self.disagg_ttft_s)
         gp = self.goodput()
         if gp is not None:
             snap["goodput_tok_s"] = round(gp, 1)

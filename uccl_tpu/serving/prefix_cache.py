@@ -1,0 +1,246 @@
+"""Prefix-reuse KV cache: a token trie at chunk granularity over parked slots.
+
+The radix/prefix-cache idea (vLLM automatic prefix caching, SGLang RadixAttention)
+on this repo's slot pool: a retired request's KV rows stay **resident** — its
+slot is *parked*, not freed — and its prompt is inserted into a token trie
+keyed by fixed-size chunks of ``prefill_chunk`` tokens. A later request whose
+prompt shares a cached chunk-aligned prefix resumes prefill at
+``prefill_pos = matched_len`` after a slot-to-slot KV copy: the PR 4 resumable
+prefill primitive (``prefill_slots(..., start=off)``) makes the continuation
+bit-exact, so shared system prompts are computed ONCE and every skipped token
+is still oracle-identical.
+
+Chunk granularity is deliberate: it matches the engine's prefill chunk, so a
+match boundary is always a position the chunked prefill program can resume
+from, and trie keys are the raw bytes of one chunk's tokens (no hashing
+collisions to reason about).
+
+Residency is charged against the slot pool (``SlotPool.park``): parked donors
+occupy real KV rows, and admission pressure evicts them LRU-first via the
+scheduler's ``make_room`` hook — a live request's slot is never evicted
+because live slots are, by construction, never *in* the cache (only retire
+parks). Everything is host-only and jax-free; KV bytes move in the backend.
+
+Counters (obs registry, docs/OBSERVABILITY.md): ``prefix_cache_hits_total``,
+``prefix_cache_misses_total``, ``prefix_cache_evictions_total``,
+``prefix_cache_tokens_reused_total``, gauge ``prefix_cache_resident_slots``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from uccl_tpu import obs
+
+_HITS = obs.counter(
+    "prefix_cache_hits_total",
+    "admissions that resumed prefill from a cached chunk-aligned prefix",
+)
+_MISSES = obs.counter(
+    "prefix_cache_misses_total",
+    "admissions with no usable cached prefix (cold prefill from 0)",
+)
+_EVICTIONS = obs.counter(
+    "prefix_cache_evictions_total",
+    "parked donor slots reclaimed LRU-first under admission pressure",
+)
+_TOKENS_REUSED = obs.counter(
+    "prefix_cache_tokens_reused_total",
+    "prompt tokens whose prefill compute was skipped via a cached prefix",
+)
+_RESIDENT = obs.gauge(
+    "prefix_cache_resident_slots",
+    "slots currently parked as prefix-cache donors",
+)
+
+
+class _Node:
+    """One trie node: children keyed by the raw bytes of a C-token chunk;
+    ``slots`` is every parked slot whose cached prompt passes through this
+    node (i.e. whose KV holds at least this node's depth in chunks)."""
+
+    __slots__ = ("children", "slots")
+
+    def __init__(self):
+        self.children: Dict[bytes, _Node] = {}
+        self.slots: Set[int] = set()
+
+
+class PrefixCache:
+    """Chunk-granular prefix trie over parked KV slots, LRU-evicted.
+
+    The engine owns the pool and the KV copies; this class owns WHICH slot
+    holds WHICH prefix and for how long. Invariant: every slot referenced
+    anywhere in the trie is parked in the engine's pool (never a live
+    request's slot), so eviction can only ever reclaim cache residency.
+    """
+
+    def __init__(self, chunk: int):
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.chunk = chunk
+        self._root = _Node()
+        # slot -> (depth in chunks, last-use sequence number). Depth is how
+        # many full chunks of the slot's prompt are keyed in the trie.
+        self._resident: Dict[int, Tuple[int, int]] = {}
+        self._seq = 0
+
+    # -- inspection -------------------------------------------------------
+    @property
+    def n_resident(self) -> int:
+        return len(self._resident)
+
+    def resident_slots(self) -> List[int]:
+        return sorted(self._resident)
+
+    def _touch(self, slot: int) -> None:
+        depth, _ = self._resident[slot]
+        self._seq += 1
+        self._resident[slot] = (depth, self._seq)
+
+    def _chunks(self, prompt: np.ndarray, n: int):
+        c = self.chunk
+        p = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        for i in range(n):
+            yield p[i * c:(i + 1) * c].tobytes()
+
+    # -- lookup -----------------------------------------------------------
+    def _lookup(self, prompt) -> Tuple[int, Optional[int]]:
+        """Side-effect-free deepest-usable-prefix walk (no counters, no
+        LRU refresh) — shared by :meth:`match` and :meth:`peek_donor`."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        usable = (prompt.size - 1) // self.chunk  # ≥1 token must remain
+        node, best = self._root, None
+        depth = 0
+        for key in self._chunks(prompt, usable):
+            node = node.children.get(key)
+            if node is None:
+                break
+            depth += 1
+            if node.slots:
+                best = (depth, node)
+        if best is None:
+            return 0, None
+        depth, node = best
+        # prefer the most recently used donor among equals (keeps hot
+        # shared prompts hot)
+        donor = max(node.slots, key=lambda s: self._resident[s][1])
+        return depth * self.chunk, donor
+
+    def match(self, prompt) -> Tuple[int, Optional[int]]:
+        """Deepest cached chunk-aligned prefix of ``prompt`` that is usable
+        for resumption. Returns ``(matched_len, donor_slot)`` with
+        ``matched_len`` a multiple of ``chunk``; ``(0, None)`` on a miss.
+
+        A match is capped at the largest chunk multiple ≤ ``len(prompt)-1``:
+        at least one prompt position must remain to prefill, because the
+        first generated token comes from the final position's logits — a
+        fully cached prompt still recomputes its last partial/full chunk.
+        Counts one hit (+ reused tokens) or one miss, and refreshes the
+        donor's LRU stamp.
+        """
+        matched, donor = self._lookup(prompt)
+        if donor is None:
+            _MISSES.inc()
+            return 0, None
+        self._touch(donor)
+        _HITS.inc()
+        _TOKENS_REUSED.inc(matched)
+        return matched, donor
+
+    def peek_donor(self, prompt) -> Optional[int]:
+        """The slot :meth:`match` would reuse for ``prompt``, with no
+        counter or LRU side effects — the engine protects it from being
+        its own admission's eviction victim."""
+        return self._lookup(prompt)[1]
+
+    def covered(self, prompt) -> Optional[int]:
+        """If the trie already caches ``prompt``'s full-chunk prefix at
+        maximal depth, return a slot holding it (parking another copy would
+        waste a slot); else None."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        k = prompt.size // self.chunk
+        if k < 1:
+            return None
+        node = self._root
+        for key in self._chunks(prompt, k):
+            node = node.children.get(key)
+            if node is None:
+                return None
+        if not node.slots:
+            return None
+        return max(node.slots, key=lambda s: self._resident[s][1])
+
+    # -- residency --------------------------------------------------------
+    def park(self, pool, slot: int, prompt) -> bool:
+        """Try to keep a retiring request's slot resident as a donor.
+
+        Returns True when the slot was parked (caller must NOT free it);
+        False when caching is useless — prompt shorter than one chunk, or
+        its full-chunk prefix is already cached (the existing donor's LRU
+        stamp is refreshed instead) — and the caller should free the slot.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        k = prompt.size // self.chunk
+        if k < 1:
+            return False
+        existing = self.covered(prompt)
+        if existing is not None:
+            self._touch(existing)
+            return False
+        node = self._root
+        for key in self._chunks(prompt, k):
+            node = node.children.setdefault(key, _Node())
+            node.slots.add(slot)
+        self._seq += 1
+        self._resident[slot] = (k, self._seq)
+        pool.park(slot)
+        _RESIDENT.set(len(self._resident))
+        return True
+
+    def _remove(self, slot: int) -> None:
+        """Drop every trie reference to ``slot`` (prune empty branches)."""
+        del self._resident[slot]
+
+        def prune(node: _Node) -> None:
+            dead = []
+            for key, child in node.children.items():
+                child.slots.discard(slot)
+                prune(child)
+                if not child.slots and not child.children:
+                    dead.append(key)
+            for key in dead:
+                del node.children[key]
+
+        prune(self._root)
+        _RESIDENT.set(len(self._resident))
+
+    def evict_lru(self, pool, protect: Optional[int] = None) -> Optional[int]:
+        """Reclaim the least-recently-used parked slot for admission: the
+        slot returns to the pool's free list and every trie entry for it is
+        dropped. Only parked slots are candidates (live requests are never
+        resident), so a pinned/live slot can never be freed here.
+        ``protect`` exempts one slot — the donor the admission triggering
+        this eviction is about to match (evicting it would trade the hit
+        for the slot). Returns the evicted slot id, or None when no
+        candidate remains."""
+        candidates = [s for s in self._resident if s != protect]
+        if not candidates:
+            return None
+        slot = min(candidates, key=lambda s: self._resident[s][1])
+        self._remove(slot)
+        pool.reclaim(slot)
+        _EVICTIONS.inc()
+        return slot
+
+    def clear(self, pool) -> None:
+        """Reclaim every parked slot and empty the trie (e.g. after compile
+        warmup, whose synthetic prompts must not act as donors). Counters
+        are untouched — benches isolate arms by delta."""
+        for slot in list(self._resident):
+            self._remove(slot)
+            pool.reclaim(slot)
+        self._root = _Node()
+        _RESIDENT.set(0)

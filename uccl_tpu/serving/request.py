@@ -54,6 +54,8 @@ class Request:
     slot: Optional[int] = None
     admit_seq: Optional[int] = None  # admission order (FIFO is testable)
     prefill_pos: int = 0  # chunked-prefill cursor: prompt[:prefill_pos] is in KV
+    cache_hit_len: int = 0  # prompt tokens reused from the prefix cache
+    adopted: bool = False  # entered via adopt() (disagg decode side), not submit()
     out_tokens: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None  # "eos" | "length"
     t_submit: float = 0.0

@@ -10,7 +10,7 @@ caller sees it immediately instead of timing out later).
 from __future__ import annotations
 
 from collections import deque
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from uccl_tpu.serving.request import Request, RequestState, now
 from uccl_tpu.serving.slots import SlotPool
@@ -30,6 +30,12 @@ class FIFOScheduler:
     def qsize(self) -> int:
         return len(self._queue)
 
+    def peek(self) -> Optional[Request]:
+        """The request the next admission would take (None when empty) —
+        lets the engine's make_room hook protect the prefix-cache donor
+        this request is about to match from being the eviction victim."""
+        return self._queue[0] if self._queue else None
+
     def submit(self, req: Request) -> bool:
         """Queue a request; False = rejected (queue full, backpressure)."""
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
@@ -38,18 +44,24 @@ class FIFOScheduler:
         self._queue.append(req)
         return True
 
-    def admit(self, pool: SlotPool,
-              limit: Optional[int] = None) -> List[Tuple[int, Request]]:
+    def admit(self, pool: SlotPool, limit: Optional[int] = None,
+              make_room: Optional[Callable[[], bool]] = None,
+              ) -> List[Tuple[int, Request]]:
         """Move queue-head requests into free slots, in FIFO order, until
         either runs out. ``limit`` caps this call's admissions (the engine's
         per-step token budget: each admission under chunked prefill commits
         one chunk of prefill work per step until its prompt is in KV, so
         admission is where the budget is enforced — None = unbounded).
-        Returns the newly admitted (slot, request) pairs — the engine
-        prefills exactly these."""
+        ``make_room()`` is consulted only when the pool has no free slot
+        and the queue still has work: return True after freeing one (the
+        prefix cache's LRU eviction — parked donor slots yield to live
+        admissions), False to stop admitting. Returns the newly admitted
+        (slot, request) pairs — the engine prefills exactly these."""
         admitted: List[Tuple[int, Request]] = []
-        while (self._queue and pool.n_free
-               and (limit is None or len(admitted) < limit)):
+        while self._queue and (limit is None or len(admitted) < limit):
+            if not pool.n_free and not (make_room is not None
+                                        and make_room()):
+                break
             req = self._queue.popleft()
             slot = pool.admit(req.rid)
             assert slot is not None  # n_free was checked

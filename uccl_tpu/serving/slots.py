@@ -15,7 +15,14 @@ from typing import Dict, List, Optional
 
 
 class SlotPool:
-    """Free-list of KV cache rows with admit/free/occupancy tracking."""
+    """Free-list of KV cache rows with admit/free/occupancy tracking.
+
+    Slots exist in three states: **free** (on the heap), **occupied** (a
+    live request's KV), or **parked** (a retired request's KV kept resident
+    for the prefix-reuse cache — still charged against the pool, but not a
+    live occupant: ``leaked()`` excludes parked slots, and only
+    ``reclaim()`` — the cache's eviction — returns them to the free list).
+    """
 
     def __init__(self, n_slots: int):
         if n_slots < 1:
@@ -27,6 +34,7 @@ class SlotPool:
         self._free = list(range(n_slots))
         heapq.heapify(self._free)
         self._occupant: Dict[int, int] = {}  # slot -> rid
+        self._parked: Dict[int, int] = {}  # slot -> rid of the retiree
         self.total_admits = 0
         self.total_frees = 0
         self.high_water = 0  # max concurrent occupancy observed
@@ -38,6 +46,10 @@ class SlotPool:
     @property
     def n_active(self) -> int:
         return self.n_slots - len(self._free)
+
+    @property
+    def n_parked(self) -> int:
+        return len(self._parked)
 
     @property
     def occupancy(self) -> float:
@@ -66,6 +78,27 @@ class SlotPool:
         heapq.heappush(self._free, slot)
         self.total_frees += 1
 
+    def park(self, slot: int) -> None:
+        """Retire an occupied slot into the parked (cache-resident) state:
+        its KV stays readable as a prefix-reuse donor, but no request owns
+        it and admissions cannot claim it until :meth:`reclaim`."""
+        if slot not in self._occupant:
+            raise ValueError(f"slot {slot} is not occupied")
+        self._parked[slot] = self._occupant.pop(slot)
+
+    def reclaim(self, slot: int) -> None:
+        """Return a parked slot to the free list (prefix-cache eviction)."""
+        if slot not in self._parked:
+            raise ValueError(f"slot {slot} is not parked")
+        del self._parked[slot]
+        heapq.heappush(self._free, slot)
+        self.total_frees += 1
+
+    def parked_slots(self) -> List[int]:
+        return sorted(self._parked)
+
     def leaked(self) -> int:
-        """Occupied slots — must be 0 after a full drain (tested)."""
-        return self.n_active
+        """Live-occupied slots — must be 0 after a full drain (tested).
+        Parked slots are cache residency, not leaks: the prefix cache owns
+        their lifecycle (LRU eviction under admission pressure)."""
+        return len(self._occupant)

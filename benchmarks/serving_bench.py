@@ -19,6 +19,12 @@ COUNTER DELTAS around the measured window (warmup excluded), so the
 disaggregated pair (prefill engine → chunk-streamed KV over loopback p2p →
 decode engine), reporting the decode side's TTFT split into
 queue/prefill/transfer (docs/SERVING.md).
+``--spec-k 0,2,4`` sweeps speculative decoding (0 = vanilla): each arm
+reports ``acceptance_rate`` off ``spec_tokens_total`` counter deltas and
+``decode_tok_s`` off the committed-token count (never an assumed 1
+token/step); pair it with ``--workload repeat`` for the template-heavy
+prompt family whose looping continuations the prompt-lookup drafter
+predicts (``--workload random`` bounds the novel-text end).
 
     python benchmarks/serving_bench.py --devices 2 --rates 4,16 --slots 2,4
     python benchmarks/serving_bench.py --stack moe --devices 4 --slots 4
@@ -28,6 +34,9 @@ queue/prefill/transfer (docs/SERVING.md).
         --prefill-chunks 8 --prefix-hit-rates 0,0.75 --shared-prefix-len 48
     python benchmarks/serving_bench.py --disagg --prompt-len 64 --rates 16 \
         --slots 4 --prefill-chunks 8 --prefix-hit-rates 0,0.75
+    python benchmarks/serving_bench.py --stack dense --workload repeat \
+        --rates 24 --slots 4 --prefill-chunks off --spec-k 0,2,4 \
+        --prompt-len 24 --new-tokens 32     # the speculative-decode sweep
 """
 
 from __future__ import annotations
@@ -46,6 +55,9 @@ _ARM_COUNTERS = (
     ("serving_prefill_tokens_total", {"kind": "computed"}),
     ("kv_stream_chunks_total", {"role": "tx"}),
     ("p2p_bytes_total", {"verb": "write"}),
+    ("spec_tokens_total", {"outcome": "accepted"}),
+    ("spec_tokens_total", {"outcome": "rejected"}),
+    ("spec_tokens_total", {"outcome": "bonus"}),
 )
 
 
@@ -106,19 +118,23 @@ def _make_backend(args, jax, stack, n_slots, max_seq):
 def _workload(args, vocab, rate, hit_rate):
     import numpy as np
 
-    from uccl_tpu.serving.loadgen import synth_shared_workload, synth_workload
+    from uccl_tpu.serving.loadgen import (
+        synth_repeat_workload, synth_shared_workload, synth_workload,
+    )
 
     rng = np.random.default_rng(args.seed)
-    if hit_rate is None:
-        return synth_workload(rng, args.requests, args.prompt_len, vocab,
-                              rate)
-    shared = args.shared_prefix_len or max(1, args.prompt_len // 2)
-    return synth_shared_workload(rng, args.requests, args.prompt_len, vocab,
-                                 rate, hit_rate, shared)
+    if hit_rate is not None:
+        shared = args.shared_prefix_len or max(1, args.prompt_len // 2)
+        return synth_shared_workload(rng, args.requests, args.prompt_len,
+                                     vocab, rate, hit_rate, shared)
+    if args.workload == "repeat":
+        return synth_repeat_workload(rng, args.requests, args.prompt_len,
+                                     vocab, rate, args.motif_max)
+    return synth_workload(rng, args.requests, args.prompt_len, vocab, rate)
 
 
 def _arm_header(args, stack, world, rate, n_slots, prefill_chunk,
-                step_tokens, hit_rate):
+                step_tokens, hit_rate, spec_k=None):
     from uccl_tpu import obs
 
     head = {
@@ -129,11 +145,34 @@ def _arm_header(args, stack, world, rate, n_slots, prefill_chunk,
         "requests": args.requests, "new_tokens": args.new_tokens,
         "prompt_len": args.prompt_len,
     }
+    head["workload"] = "shared" if hit_rate is not None else args.workload
+    if args.spec_k:
+        head["spec_k"] = spec_k or 0
     if hit_rate is not None:
         head["prefix_hit_rate"] = hit_rate
         head["shared_prefix_len"] = (args.shared_prefix_len
                                      or max(1, args.prompt_len // 2))
     return head
+
+
+def _spec_fields(snap, deltas):
+    """Counter-derived speculative-decoding numbers: acceptance off the
+    spec_tokens_total deltas (the auditable claim), decode throughput off
+    the COMMITTED token count over decode-call time (metrics.py) — never
+    an assumed 1 token per call."""
+    acc = deltas["spec_tokens_accepted"]
+    rej = deltas["spec_tokens_rejected"]
+    out = {
+        "decode_tokens": snap["decode_tokens"],
+        "decode_tok_s": snap.get("decode_tok_s"),
+        "spec_accepted": acc, "spec_rejected": rej,
+        "spec_bonus": deltas["spec_tokens_bonus"],
+    }
+    if acc + rej > 0:
+        out["acceptance_rate"] = round(acc / (acc + rej), 4)
+    if "accepted_len" in snap:
+        out["accepted_len"] = snap["accepted_len"]
+    return out
 
 
 def _cache_fields(deltas):
@@ -164,7 +203,7 @@ def _hit_arm_viable(args, prefill_chunk, hit_rate) -> bool:
 
 
 def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
-            hit_rate=None):
+            hit_rate=None, spec_k=None):
     step_tokens = (args.step_tokens or None) if prefill_chunk else None
     if step_tokens is not None and step_tokens < prefill_chunk:
         return None  # this arm's budget can't admit even one chunk
@@ -174,7 +213,7 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
     from uccl_tpu.serving import PrefixCache, ServingEngine
     from uccl_tpu.serving.loadgen import drive, warm_engine
 
-    max_seq = args.prompt_len + args.new_tokens
+    max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
     backend, world, vocab = _make_backend(args, jax, stack, n_slots, max_seq)
     if backend is None:
         return None
@@ -182,6 +221,7 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
         backend, prefill_chunk=prefill_chunk, step_tokens=step_tokens,
         prefix_cache=(PrefixCache(prefill_chunk)
                       if hit_rate is not None else None),
+        spec_k=spec_k,
     )
     prompts, lens, arrivals = _workload(args, vocab, rate, hit_rate)
     warm_engine(engine, lens, max_seq, args.new_tokens)
@@ -193,7 +233,7 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
 
     snap = engine.snapshot()
     arm = _arm_header(args, stack, world, rate, n_slots, prefill_chunk,
-                      step_tokens, hit_rate)
+                      step_tokens, hit_rate, spec_k)
     arm.update({
         "wall_s": round(wall, 3),
         "completed": snap["completed"], "rejected": snap["rejected"],
@@ -207,6 +247,8 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
         "prefill_chunks": snap["prefill_chunks"],
         "slot_high_water": engine.pool.high_water,
     })
+    if args.spec_k:
+        arm.update(_spec_fields(snap, deltas))
     if hit_rate is not None:
         arm.update(_cache_fields(deltas))
     # the obs registry's counter/gauge state rides along (fallback
@@ -218,10 +260,12 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
 
 
 def run_disagg_arm(args, jax, stack, rate, n_slots, prefill_chunk,
-                   hit_rate=None):
+                   hit_rate=None, spec_k=None):
     """One disaggregated arm: prefill engine → chunk-streamed KV over
-    loopback p2p → decode engine, measured at the decode side (where the
-    user-visible TTFT and its queue/prefill/transfer split live)."""
+    loopback p2p → decode engine (speculating when ``spec_k`` — adopted
+    requests decode through the same verify window), measured at the
+    decode side (where the user-visible TTFT and its
+    queue/prefill/transfer split live)."""
     if not prefill_chunk:
         return None  # streaming granularity IS the prefill chunk
     step_tokens = args.step_tokens or None
@@ -234,7 +278,7 @@ def run_disagg_arm(args, jax, stack, rate, n_slots, prefill_chunk,
         drive_pair, make_local_pair, warm_pair,
     )
 
-    max_seq = args.prompt_len + args.new_tokens
+    max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
     pb, world, vocab = _make_backend(args, jax, stack, n_slots, max_seq)
     db, _, _ = _make_backend(args, jax, stack, n_slots, max_seq)
     if pb is None or db is None:
@@ -244,7 +288,7 @@ def run_disagg_arm(args, jax, stack, rate, n_slots, prefill_chunk,
         prefix_cache=(PrefixCache(prefill_chunk)
                       if hit_rate is not None else None),
     )
-    de = ServingEngine(db)
+    de = ServingEngine(db, spec_k=spec_k)
     pw, dw = make_local_pair(pe, de)
     try:
         warm_pair(pw, dw, args.prompt_len, args.new_tokens)
@@ -264,7 +308,7 @@ def run_disagg_arm(args, jax, stack, rate, n_slots, prefill_chunk,
     from uccl_tpu import obs
 
     arm = _arm_header(args, stack, world, rate, n_slots, prefill_chunk,
-                      step_tokens, hit_rate)
+                      step_tokens, hit_rate, spec_k)
     arm.update({
         "bench": "serving_disagg",
         "wall_s": round(wall, 3),
@@ -287,6 +331,8 @@ def run_disagg_arm(args, jax, stack, rate, n_slots, prefill_chunk,
         "kv_slabs_streamed": deltas["kv_stream_chunks_tx"],
         "kv_bytes_streamed": deltas["p2p_bytes_write"],
     })
+    if args.spec_k:
+        arm.update(_spec_fields(dsnap, deltas))
     if hit_rate is not None:  # cache absent ≠ cache enabled-but-cold
         arm.update(_cache_fields(deltas))
     arm["obs"] = obs.REGISTRY.snapshot()["metrics"]
@@ -319,6 +365,25 @@ def main():
     ap.add_argument("--shared-prefix-len", type=int, default=0,
                     help="shared system-prompt length for the hit-rate "
                          "sweep (0 = prompt_len/2)")
+    ap.add_argument("--spec-k", default="",
+                    help="comma-separated speculative-decoding arms (e.g. "
+                         "'0,2,4'; 0 = vanilla): each decoding slot "
+                         "drafts K tokens via the prompt-lookup NGram "
+                         "drafter and one batched [slots, K+1] verify "
+                         "commits the accepted prefix + 1 target token. "
+                         "Arms report acceptance_rate + decode_tok_s off "
+                         "spec_tokens_total counter deltas")
+    ap.add_argument("--workload", default="random",
+                    choices=["random", "repeat"],
+                    help="prompt family for non-prefix arms: 'random' = "
+                         "mixed-length uniform tokens (novel text — the "
+                         "near-zero-acceptance bound for spec arms), "
+                         "'repeat' = tiled 1..motif-max-token motifs "
+                         "(template-heavy traffic whose continuations "
+                         "loop — the regime prompt-lookup drafting "
+                         "targets)")
+    ap.add_argument("--motif-max", type=int, default=2,
+                    help="repeat workload: max motif length being tiled")
     ap.add_argument("--disagg", action="store_true",
                     help="run each arm through the disaggregated "
                          "prefill->decode pair (chunk-streamed KV over "
@@ -327,6 +392,11 @@ def main():
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=0,
+                    help="KV slot capacity (0 = prompt+new): size the pool "
+                         "for the longest SUPPORTED sequence, not this "
+                         "workload's — per-step cost scales with pool "
+                         "size, so capacity belongs to the arm label")
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--dim", type=int, default=64)
     ap.add_argument("--layers", type=int, default=2)
@@ -339,37 +409,51 @@ def main():
     obs.setup_from_args(args)
     obs.dump_at_exit(args)  # every return path + crashes dump the surfaces
 
+    if args.max_seq and args.max_seq < args.prompt_len + args.new_tokens:
+        raise SystemExit(
+            f"--max-seq {args.max_seq} < --prompt-len {args.prompt_len} + "
+            f"--new-tokens {args.new_tokens}: every arm's slots would "
+            "overflow"
+        )
     jax = init_devices(args.devices)
     chunks = [None if c.strip() in ("off", "0", "none") else int(c)
               for c in args.prefill_chunks.split(",")]
     hit_rates = ([float(h) for h in args.prefix_hit_rates.split(",")]
                  if args.prefix_hit_rates else [None])
+    spec_ks = ([None if int(k) == 0 else int(k)
+                for k in args.spec_k.split(",")]
+               if args.spec_k else [None])
     for rate in [float(r) for r in args.rates.split(",")]:
         for n_slots in [int(s) for s in args.slots.split(",")]:
             for chunk in chunks:
                 for hit_rate in hit_rates:
-                    if args.disagg:
-                        arm = run_disagg_arm(args, jax, args.stack, rate,
-                                             n_slots, chunk, hit_rate)
-                    else:
-                        arm = run_arm(args, jax, args.stack, rate, n_slots,
-                                      chunk, hit_rate)
-                    if arm is None:
-                        print(json.dumps({
-                            "bench": ("serving_disagg" if args.disagg
-                                      else "serving"),
-                            "stack": args.stack,
-                            "arrival_rate": rate, "slots": n_slots,
-                            "prefill_chunk": chunk,
-                            "prefix_hit_rate": hit_rate,
-                            "skipped": "slots must divide by the MoE "
-                                       "world, --step-tokens < the arm's "
-                                       "chunk, a chunkless prefix/disagg "
-                                       "arm, or a shared prefix shorter "
-                                       "than the chunk (no hit possible)",
-                        }), flush=True)
-                        continue
-                    print(json.dumps(arm), flush=True)
+                    for spec_k in spec_ks:
+                        if args.disagg:
+                            arm = run_disagg_arm(args, jax, args.stack,
+                                                 rate, n_slots, chunk,
+                                                 hit_rate, spec_k)
+                        else:
+                            arm = run_arm(args, jax, args.stack, rate,
+                                          n_slots, chunk, hit_rate,
+                                          spec_k)
+                        if arm is None:
+                            print(json.dumps({
+                                "bench": ("serving_disagg" if args.disagg
+                                          else "serving"),
+                                "stack": args.stack,
+                                "arrival_rate": rate, "slots": n_slots,
+                                "prefill_chunk": chunk,
+                                "prefix_hit_rate": hit_rate,
+                                "spec_k": spec_k,
+                                "skipped": "slots must divide by the MoE "
+                                           "world, --step-tokens < the "
+                                           "arm's chunk, a chunkless "
+                                           "prefix/disagg arm, or a "
+                                           "shared prefix shorter than "
+                                           "the chunk (no hit possible)",
+                            }), flush=True)
+                            continue
+                        print(json.dumps(arm), flush=True)
 
 
 if __name__ == "__main__":

@@ -38,6 +38,15 @@ KV-handoff telemetry — one-sided write bytes on
 (the run's shared-prefix requests really reused cached KV) with the
 ``serving_prefill_tokens_total`` computed/skipped split present — i.e.
 the chunk-streamed handoff AND the prefix cache both demonstrably fired.
+
+``--spec`` mode (the speculative-decoding smoke arm, serve --server
+--spec-k ... --metrics-out): the metrics file must show ≥1 ACCEPTED
+speculation on ``spec_tokens_total{outcome="accepted"}`` plus nonzero
+bonus tokens and the ``spec_accepted_len_total`` histogram, and the
+engine's committed-token accounting (``uccl_serving_decode_tokens``)
+must be present and nonzero — i.e. speculation really ran, really
+accepted drafts, and throughput derives from committed tokens rather
+than an assumed one token per step.
 """
 
 from __future__ import annotations
@@ -178,16 +187,23 @@ def check_plan_metrics(path: str, bench_json: str) -> None:
           f"(algos: {sorted(algos)})")
 
 
+def _prom_total(lines, prefix: str, path: str) -> float:
+    """Sum every sample whose series line starts with ``prefix`` (name or
+    name{label-prefix}); a missing series is a named failure — the shared
+    parse of the disagg and spec validators."""
+    vals = [float(ln.rsplit(" ", 1)[1]) for ln in lines
+            if ln.startswith(prefix)]
+    if not vals:
+        fail(f"{path}: no sample for {prefix!r}")
+    return sum(vals)
+
+
 def check_disagg_metrics(path: str) -> None:
     with open(path) as f:
         lines = f.read().splitlines()
 
     def total(prefix: str) -> float:
-        vals = [float(ln.rsplit(" ", 1)[1]) for ln in lines
-                if ln.startswith(prefix)]
-        if not vals:
-            fail(f"{path}: no sample for {prefix!r}")
-        return sum(vals)
+        return _prom_total(lines, prefix, path)
 
     if total('p2p_bytes_total{verb="write"}') <= 0:
         fail(f"{path}: zero one-sided write bytes — no KV crossed the "
@@ -207,7 +223,37 @@ def check_disagg_metrics(path: str) -> None:
           f"hit(s), stream + skip series all nonzero")
 
 
+def check_spec_metrics(path: str) -> None:
+    with open(path) as f:
+        lines = f.read().splitlines()
+
+    def total(prefix: str) -> float:
+        return _prom_total(lines, prefix, path)
+
+    acc = total('spec_tokens_total{outcome="accepted"}')
+    if acc < 1:
+        fail(f"{path}: zero accepted speculations — the drafter never "
+             f"predicted the target's greedy output (counted on "
+             f'spec_tokens_total{{outcome="accepted"}})')
+    if total('spec_tokens_total{outcome="bonus"}') <= 0:
+        fail(f"{path}: zero bonus tokens — no verify window ever ran")
+    total('spec_tokens_total{outcome="rejected"}')  # series must exist
+    if not any(ln.startswith("spec_accepted_len_total{") for ln in lines):
+        fail(f"{path}: missing spec_accepted_len_total histogram")
+    if total("uccl_serving_decode_tokens") <= 0:
+        fail(f"{path}: uccl_serving_decode_tokens missing or zero — "
+             f"decode throughput is not being derived from committed "
+             f"tokens")
+    print(f"check_obs: spec metrics OK — {int(acc)} accepted "
+          f"speculation(s), bonus + histogram + committed-token series "
+          f"all present")
+
+
 def main(argv) -> None:
+    if len(argv) == 3 and argv[1] == "--spec":
+        check_spec_metrics(argv[2])
+        print("check_obs: ALL OK")
+        return
     if len(argv) == 3 and argv[1] == "--disagg":
         check_disagg_metrics(argv[2])
         print("check_obs: ALL OK")
@@ -224,7 +270,8 @@ def main(argv) -> None:
         fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
              "check_obs.py --plan METRICS_PROM BENCH_JSON | "
-             "check_obs.py --disagg METRICS_PROM")
+             "check_obs.py --disagg METRICS_PROM | "
+             "check_obs.py --spec METRICS_PROM")
     check_trace(argv[1])
     check_metrics(argv[2])
     print("check_obs: ALL OK")

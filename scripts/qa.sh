@@ -34,6 +34,12 @@ JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --sl
   --requests 6 --prompt-len 12 --new-tokens 4 --arrival-rate 50 \
   --prefill-chunk 8 --check-oracle; check $?
 
+note "speculative decoding smoke tier (4 slots, spec_k=2, NGram drafter: oracle-exact + >=1 accepted speculation counted)"
+JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 4 \
+  --requests 8 --prompt-len 8 --new-tokens 16 --arrival-rate 50 --spec-k 2 \
+  --check-oracle --metrics-out /tmp/qa_spec_metrics.prom; check $?
+python scripts/check_obs.py --spec /tmp/qa_spec_metrics.prom; check $?
+
 note "disagg serving smoke tier (prefill+decode worker pair over p2p: chunk-streamed KV, >=1 prefix-cache hit, oracle-exact, telemetry validated)"
 UCCL_TPU_EXAMPLE_CPU=1 JAX_PLATFORMS=cpu timeout 600 python examples/disagg_kv.py --cpu \
   --metrics-out /tmp/qa_disagg_metrics.prom; check $?

@@ -27,6 +27,19 @@ from uccl_tpu.utils.jaxcompat import shard_map
 
 WORLDS = (4, 8, 5)  # the acceptance grid: powers of two plus one odd world
 
+# The heavy end-to-end path suites (sorted/LL roundtrips, chunked layers,
+# Buffer verb parity) keep world 4 in tier-1 and push the wide (8) and odd
+# (5) arms to `slow` — the 870s tier-1 cap is the ONLY consumer of that
+# filter: qa.sh and ci.yml run this file UNFILTERED in their dedicated
+# pallas smoke tier (and exclude it from their full-suite pytest), so the
+# 8/5 coverage is unchanged there. Kernel-level suites stay on the full
+# grid — their arms are cheap. (The heavy-worlds-slow convention from the
+# PR 6 quant-wire suites.)
+WORLDS_T1 = (4,
+             pytest.param(8, marks=pytest.mark.slow),
+             pytest.param(5, marks=pytest.mark.slow))
+ODD_T1 = (4, pytest.param(5, marks=pytest.mark.slow))
+
 
 def _mesh(devices, n):
     return Mesh(np.array(devices[:n]), ("ep",))
@@ -111,7 +124,7 @@ class TestSortedPath:
     """dispatch_sorted/combine_sorted on the pallas wire vs the lax wire
     (which test_ep.py pins to the dense-mask oracle)."""
 
-    @pytest.mark.parametrize("n", WORLDS)
+    @pytest.mark.parametrize("n", WORLDS_T1)
     @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
     def test_dispatch_combine_roundtrip(self, devices, rng, n, dtype):
         mesh = _mesh(devices, n)
@@ -141,7 +154,7 @@ class TestSortedPath:
         np.testing.assert_array_equal(recv_p, recv_l)
         np.testing.assert_array_equal(out_p, out_l)
 
-    @pytest.mark.parametrize("n", [4, 5])
+    @pytest.mark.parametrize("n", ODD_T1)
     def test_fp8_wire_format(self, devices, rng, n):
         """fp8+scales payloads: quantized values and scales both ride the
         pallas wire; dequantized results must equal the lax-wire path
@@ -169,7 +182,7 @@ class TestLLPath:
     (same layout, XLA transport) — recv buffers, counts, and the combine
     round trip."""
 
-    @pytest.mark.parametrize("n", WORLDS)
+    @pytest.mark.parametrize("n", WORLDS_T1)
     @pytest.mark.parametrize("fp8", [False, True])
     def test_ll_roundtrip(self, devices, rng, n, fp8):
         mesh = _mesh(devices, n)
@@ -239,7 +252,7 @@ class TestBuffer:
         np.testing.assert_array_equal(outs["auto"][0], outs["pallas"][0])
         np.testing.assert_array_equal(outs["auto"][1], outs["pallas"][1])
 
-    @pytest.mark.parametrize("n", [4, 5])
+    @pytest.mark.parametrize("n", ODD_T1)
     @pytest.mark.parametrize("fp8", [False, True])
     def test_ll_verbs_match_default_wire(self, devices, rng, n, fp8):
         mesh = _mesh(devices, n)
@@ -415,7 +428,7 @@ class TestChunkedSortedPath:
     the unchunked lax wire — the SlotPlan form, both sides consuming the
     one permutation."""
 
-    @pytest.mark.parametrize("n", WORLDS)
+    @pytest.mark.parametrize("n", WORLDS_T1)
     @pytest.mark.parametrize("chunks", [1, 2, 4])
     def test_roundtrip_matches_lax(self, devices, rng, n, chunks):
         mesh = _mesh(devices, n)
@@ -444,7 +457,7 @@ class TestChunkedSortedPath:
         np.testing.assert_array_equal(recv_p, recv_l)
         np.testing.assert_array_equal(out_p, out_l)
 
-    @pytest.mark.parametrize("n", [4, 5])
+    @pytest.mark.parametrize("n", ODD_T1)
     def test_fp8_wire_chunked(self, devices, rng, n):
         """fp8 groups ride the hidden axis; chunking the capacity axis must
         leave quantization bit-identical to the unchunked lax wire."""
@@ -472,7 +485,7 @@ class TestChunkedLLPath:
     wire="dense" — the fp8+scales format stays first-class in the
     pipeline."""
 
-    @pytest.mark.parametrize("n", [4, 5])
+    @pytest.mark.parametrize("n", ODD_T1)
     @pytest.mark.parametrize("fp8", [False, True])
     def test_ll_roundtrip_chunked(self, devices, rng, n, fp8):
         mesh = _mesh(devices, n)
@@ -510,7 +523,7 @@ class TestChunkedMoELayer:
     position-preserving, so chunking changes the schedule, never the
     math."""
 
-    @pytest.mark.parametrize("n", WORLDS)
+    @pytest.mark.parametrize("n", WORLDS_T1)
     @pytest.mark.parametrize("chunks", [2, 4])
     def test_pipelined_layer_matches_lax(self, devices, rng, n, chunks):
         mesh = _mesh(devices, n)

@@ -407,6 +407,115 @@ class TestDisaggPairDense:
 
 
 @pytest.mark.slow
+class TestDisaggFanIn:
+    """N x 1 fan-in (ROADMAP: wired-but-untested): TWO PrefillWorkers
+    stream interleaved requests into ONE DecodeWorker over separate
+    loopback conns. Every adopted request must stay oracle-exact and land
+    back on the conn that opened its stream — a cross-conn slot mixup
+    would either corrupt outputs (wrong KV under a prompt) or break the
+    per-(conn, rid) origin map. The decode engine runs spec_k=2, so the
+    adopted continuations also cover the adopt() x speculative-decoding
+    composition."""
+
+    def test_two_prefill_workers_one_decoder(self, dense_setup):
+        import time as _time
+
+        from uccl_tpu.serving import DenseBackend, NGramDrafter
+        from uccl_tpu.serving.disagg import (
+            DecodeWorker, add_local_prefill,
+        )
+        from uccl_tpu.p2p import Endpoint
+
+        cfg, params, _ = dense_setup
+        pes = [ServingEngine(DenseBackend(params, cfg, n_slots=2,
+                                          max_seq=MAX_SEQ),
+                             prefill_chunk=4) for _ in range(2)]
+        de = ServingEngine(DenseBackend(params, cfg, n_slots=4,
+                                        max_seq=MAX_SEQ),
+                           spec_k=2, drafter=NGramDrafter())
+        dw = DecodeWorker(de, Endpoint())
+        pws = [add_local_prefill(dw, pe) for pe in pes]
+
+        def pump(n_done, done, deadline_s=120.0):
+            deadline = _time.monotonic() + deadline_s
+            while len(done) < n_done:
+                for pw in pws:
+                    pw.step()
+                done.extend(dw.step())
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(f"fan-in stalled at {len(done)}")
+            return done
+
+        # warm both streams (compiles chunk + verify programs), then zero
+        for pw in pws:
+            pw.submit(np.zeros(8, np.int32), max_new_tokens=2)
+        pump(2, [])
+        for eng in pes + [de]:
+            eng.reset_metrics()
+
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, 64, 6 + i).astype(np.int32)
+                   for i in range(6)]
+        by_worker = {}  # worker index -> {remote rid -> prompt key}
+        done = []
+        for i, p in enumerate(prompts):
+            w = i % 2  # interleaved across the two prefill fleets
+            r = pws[w].submit(p, max_new_tokens=4)
+            assert r is not None
+            by_worker.setdefault(w, {})[r.rid] = tuple(p.tolist())
+            for pw in pws:  # let streams interleave between arrivals
+                pw.step()
+            done.extend(dw.step())
+        pump(6, done)
+
+        # oracle-exact, all adopted, nothing leaked anywhere
+        assert len(done) == 6
+        for r in done:
+            assert r.adopted
+            assert r.out_tokens == _oracle(params, cfg, r), r.rid
+        for eng in pes + [de]:
+            assert eng.pool.leaked() == 0
+        assert de.metrics.adopted == 6
+        # no cross-conn leaks: each adopted request's origin (conn, rid)
+        # must name the worker that actually submitted its prompt, and
+        # the two workers' streams must sit on distinct conns
+        conn_of_worker = {}
+        for r in done:
+            conn, remote_rid = dw.origin[r.rid]
+            key = tuple(r.prompt.tolist())
+            owners = [w for w, rids in by_worker.items()
+                      if rids.get(remote_rid) == key]
+            assert owners, f"request {r.rid} origin matches no stream"
+            w = owners[0]
+            assert conn_of_worker.setdefault(w, conn) == conn, (
+                "one worker's streams landed on two conns"
+            )
+        assert len(conn_of_worker) == 2
+        assert (conn_of_worker[0] != conn_of_worker[1]), (
+            "both workers share a conn — fan-in never exercised"
+        )
+        # the spec x adopt composition really speculated on adopted work
+        assert de.metrics.spec_windows > 0
+        # shutdown is per-conn: ONE worker's BYE must not close the
+        # decoder while the other conn is still attached
+        def poll_until_byes(n, deadline_s=10.0):
+            deadline = _time.monotonic() + deadline_s
+            while dw._n_byes < n:
+                dw.poll()
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(f"bye {n} never arrived")
+        pws[0].close()
+        poll_until_byes(1)
+        assert not dw.closed, "one BYE closed a 2-conn decoder"
+        pws[1].close()
+        poll_until_byes(2)
+        assert dw.closed
+        for pw in pws:
+            pw.ep.close()
+        dw.ep.close()
+
+
+@pytest.mark.slow
 class TestMoEHitExact:
     def test_moe_prefix_hit_bit_exact(self, devices):
         """Prefix-cache hits on the EP-sharded MoE stack: the grid-mapped

@@ -467,6 +467,166 @@ class TestDenseChunkedOracle:
         assert outs[None] == outs[3]
 
 
+class _ReplayDrafter:
+    """Drafts from known full sequences (prompt + oracle continuation) —
+    the deterministic full-acceptance driver for spec-decode tests: every
+    proposal is exactly what the target will emit, so the accept path
+    (multi-token commits, bonus tokens, cursor jumps) is exercised on
+    every step while the output must STILL be bit-exact."""
+
+    def __init__(self, seqs):
+        self.seqs = [np.asarray(s, np.int32) for s in seqs]
+
+    def draft(self, context, k):
+        c = np.asarray(context)
+        for s in self.seqs:
+            if s.size >= c.size and np.array_equal(s[:c.size], c):
+                return s[c.size:c.size + k]
+        return np.zeros(0, np.int32)
+
+
+class _GarbageDrafter:
+    """Near-certain rejection: proposes off-by-17 tokens (still in-vocab),
+    driving the correction path — one committed token per window."""
+
+    def draft(self, context, k):
+        return (np.asarray(context)[-1] + 17
+                + np.arange(k, dtype=np.int32)) % 64
+
+
+class TestDenseSpecOracle:
+    """Speculative decoding stays bit-exact on the dense stack: greedy
+    acceptance only ever commits the target's own argmaxes, so any
+    drafter — always right, always wrong, or the real prompt-lookup
+    NGramDrafter — yields the vanilla greedy output. (len, N) pairs repeat
+    the whole-prompt tests' so oracle programs are _GEN_CACHE hits; the
+    only new compiles are the [n_slots, k+1] verify programs."""
+
+    _PAIRS = ((5, 6), (3, 4), (8, 5), (2, 6), (6, 3), (7, 5))
+
+    def _oracle_seqs(self, params, cfg, prompts):
+        from uccl_tpu.models.inference import generate
+
+        seqs = []
+        for p, (_, m) in zip(prompts, self._PAIRS):
+            toks = np.asarray(generate(
+                params, jnp.asarray(p)[None], cfg, max_new_tokens=m,
+                max_seq=MAX_SEQ,
+            ))[0]
+            seqs.append(np.concatenate([p, toks]))
+        return seqs
+
+    def _drive(self, backend, prompts, drafter, spec_k, **engine_kw):
+        from uccl_tpu.serving import ServingEngine
+
+        eng = ServingEngine(backend, spec_k=spec_k, drafter=drafter,
+                            **engine_kw)
+        reqs = [eng.submit(p, max_new_tokens=m)
+                for p, (_, m) in zip(prompts[:2], self._PAIRS[:2])]
+        eng.step()  # staggered arrivals mid-flight, like the vanilla test
+        eng.step()
+        for p, (_, m) in zip(prompts[2:], self._PAIRS[2:]):
+            reqs.append(eng.submit(p, max_new_tokens=m))
+        eng.drain()
+        assert eng.pool.leaked() == 0
+        return eng, reqs
+
+    def test_spec_staggered_exact_across_drafters(self, dense_setup):
+        """The acceptance anchor: staggered mixed-length arrivals with
+        slot reuse under spec_k=2, across the acceptance spectrum —
+        full-accept (replay), near-full-reject (garbage) and the real
+        NGramDrafter — every request bit-equals the one-shot oracle."""
+        from uccl_tpu.serving import NGramDrafter
+
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(0)
+        prompts = [_prompt(rng, n) for n, _ in self._PAIRS]
+        seqs = self._oracle_seqs(params, cfg, prompts)
+        oracle = TestDenseOracle()
+        accepted = {}
+        for name, drafter in (("replay", _ReplayDrafter(seqs)),
+                              ("garbage", _GarbageDrafter()),
+                              ("ngram", NGramDrafter())):
+            eng, reqs = self._drive(backend, prompts, drafter, spec_k=2)
+            for r in reqs:
+                assert r.n_generated == r.max_new_tokens
+                assert r.out_tokens == oracle._oracle(params, cfg, r), (
+                    f"drafter={name} rid={r.rid}"
+                )
+            accepted[name] = eng.metrics.spec_accepted
+            if name == "replay":
+                # full acceptance really multiplied tokens per model
+                # call — strictly more commits than verify calls
+                assert eng.metrics.decode_tokens > eng.metrics.decode_calls
+        assert accepted["replay"] > accepted["garbage"]
+
+    def test_spec_k1_equivalent_to_vanilla(self, dense_setup):
+        """spec_k=1 emits the same stream as the vanilla engine — same
+        tokens, same per-request counts — just 1-2 tokens per window."""
+        from uccl_tpu.serving import NGramDrafter, ServingEngine
+
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(0)
+        prompts = [_prompt(rng, n) for n, _ in self._PAIRS]
+        outs = {}
+        for mode in ("vanilla", "spec"):
+            eng = ServingEngine(
+                backend,
+                spec_k=1 if mode == "spec" else None,
+                drafter=NGramDrafter() if mode == "spec" else None,
+            )
+            reqs = [eng.submit(p, max_new_tokens=m)
+                    for p, (_, m) in zip(prompts, self._PAIRS)]
+            eng.drain()
+            outs[mode] = [r.out_tokens for r in reqs]
+            assert eng.pool.leaked() == 0
+        assert outs["spec"] == outs["vanilla"]
+
+    def test_spec_composes_with_chunked_prefill(self, dense_setup):
+        """spec_k x prefill_chunk: chunk-resumed prompts join the same
+        step's verify when their cursor lands — outputs stay exact and
+        chunks really resumed. Chunk 3 + verify [2, 3] are compile cache
+        hits from the chunked and spec suites above."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(0)
+        prompts = [_prompt(rng, n) for n, _ in self._PAIRS]
+        seqs = self._oracle_seqs(params, cfg, prompts)
+        eng, reqs = self._drive(backend, prompts, _ReplayDrafter(seqs),
+                                spec_k=2, prefill_chunk=3)
+        oracle = TestDenseOracle()
+        for r in reqs:
+            assert r.out_tokens == oracle._oracle(params, cfg, r), r.rid
+        assert eng.metrics.prefill_chunks > len(reqs)
+        assert eng.metrics.spec_accepted > 0
+
+    def test_spec_composes_with_prefix_cache_hit(self, dense_setup):
+        """spec_k x prefix cache: a hit resumes prefill at the matched
+        boundary AND the continuation decodes speculatively — both
+        requests bit-equal the oracle."""
+        from uccl_tpu.serving import (
+            NGramDrafter, PrefixCache, ServingEngine,
+        )
+
+        cfg, params, backend = dense_setup
+        eng = ServingEngine(backend, prefill_chunk=4,
+                            prefix_cache=PrefixCache(4), spec_k=2,
+                            drafter=NGramDrafter())
+        rng = np.random.default_rng(3)
+        p0 = rng.integers(0, 64, 12).astype(np.int32)
+        sharer = np.concatenate(
+            [p0[:8], rng.integers(0, 64, 4).astype(np.int32)]
+        )
+        oracle = TestDenseOracle()
+        cold = eng.submit(p0, max_new_tokens=4)
+        eng.drain()
+        hit = eng.submit(sharer, max_new_tokens=4)
+        eng.drain()
+        assert cold.cache_hit_len == 0 and hit.cache_hit_len == 8
+        for r in (cold, hit):
+            assert r.out_tokens == oracle._oracle(params, cfg, r), r.rid
+        assert eng.pool.leaked() == 0
+
+
 @pytest.fixture(scope="module")
 def moe_setup(devices):
     """ONE 2-shard server/backend + ONE world-1 oracle server for every MoE
@@ -534,6 +694,33 @@ class TestMoEOracle:
         eng.drain()
         assert eng.pool.leaked() == 0
         assert eng.metrics.prefill_chunks > len(reqs)  # really multi-chunk
+        self._check(reqs, srv1, p1)
+
+    def test_spec_staggered_exact(self, moe_setup):
+        """Speculative decoding on the EP-sharded MoE stack: the
+        [W, B_loc, k+1] verify window routes every slot's draft through
+        the drop-free sorted EP path, and full-acceptance drafting (the
+        replay drafter) still bit-equals the world-1 oracle under
+        staggered arrivals. Same (len, N) pairs as above — the only new
+        compile is the verify program."""
+        backend, srv1, p1 = moe_setup
+        rng = np.random.default_rng(0)
+        prompts = [_prompt(rng, n) for n in (5, 6, 8)]
+        seqs = []
+        for p in prompts:
+            toks = srv1.generate(p1, jnp.asarray(p)[None, None], 4,
+                                 MAX_SEQ, impl="ll")
+            seqs.append(np.concatenate([p, np.asarray(toks)[0, 0]]))
+        eng = ServingEngine(backend, spec_k=2,
+                            drafter=_ReplayDrafter(seqs))
+        reqs = [eng.submit(prompts[0], max_new_tokens=4),
+                eng.submit(prompts[1], max_new_tokens=4)]
+        eng.step()  # both mid-decode...
+        reqs.append(eng.submit(prompts[2], max_new_tokens=4))
+        eng.drain()
+        assert eng.pool.leaked() == 0
+        assert eng.metrics.spec_accepted > 0
+        assert eng.metrics.decode_tokens > eng.metrics.decode_calls
         self._check(reqs, srv1, p1)
 
     def test_droppable_capacity_rejected(self, devices):

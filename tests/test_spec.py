@@ -1,0 +1,256 @@
+"""Speculative decoding: drafter units + spec scheduling/accounting
+properties (host-only, stub backend — the real-model oracle-exactness
+suites live in tests/test_serving.py beside the engine's other oracle
+tests, sharing its module fixtures).
+
+The stub target model's greedy continuation for EVERY slot is the fixed
+arithmetic sequence last+1, last+2, ... seeded at 100 by prefill — so
+acceptance, multi-token commits, EOS truncation inside an accepted prefix,
+and the token-budget cap are all fully predictable with no jax."""
+
+import numpy as np
+import pytest
+
+from uccl_tpu.serving import (
+    NGramDrafter, RequestState, ServingEngine,
+)
+from uccl_tpu.serving.spec import Drafter
+
+
+class _SpecStubBackend:
+    """Target 'model' whose greedy continuation is always last_token + 1:
+    prefill emits 100, verify scores a window against the arithmetic
+    continuation of its column-0 token (stateless — the committed history
+    is encoded in the last token itself). Records every call."""
+
+    def __init__(self, n_slots=2, max_seq=64):
+        self.n_slots = n_slots
+        self.max_seq = max_seq
+        self.calls = []
+
+    def prefill(self, tokens, lens, mask, start=None):
+        slots = tuple(int(s) for s in np.flatnonzero(mask))
+        self.calls.append(("prefill", slots))
+        return np.full(self.n_slots, 100, np.int32)
+
+    def decode(self, tokens, active):
+        self.calls.append(
+            ("decode", tuple(int(s) for s in np.flatnonzero(active)))
+        )
+        return (tokens + 1).astype(np.int32)
+
+    def verify(self, tokens, active):
+        s = tokens.shape[1]
+        out = np.zeros((self.n_slots, s), np.int32)
+        n_acc = np.zeros(self.n_slots, np.int32)
+        for b in np.flatnonzero(active):
+            out[b] = int(tokens[b, 0]) + 1 + np.arange(s)
+            m = 0
+            for j in range(1, s):
+                if tokens[b, j] != out[b, j - 1]:
+                    break
+                m += 1
+            n_acc[b] = m
+        self.calls.append(
+            ("verify", tuple(int(b) for b in np.flatnonzero(active)))
+        )
+        return out, n_acc
+
+
+class _ArithmeticDrafter(Drafter):
+    """Always right for the stub target: proposes last+1, last+2, ..."""
+
+    def draft(self, context, k):
+        return np.asarray(context)[-1] + 1 + np.arange(k, dtype=np.int32)
+
+
+class _ZeroDrafter(Drafter):
+    """Always wrong for the stub target (its continuations are >= 101)."""
+
+    def draft(self, context, k):
+        return np.zeros(k, np.int32)
+
+
+def _stub_oracle(n):
+    """What the stub target emits for any prompt: 100, 101, ..."""
+    return list(range(100, 100 + n))
+
+
+class TestNGramDrafter:
+    def test_periodic_suffix_proposes_cycle_continuation(self):
+        d = NGramDrafter(max_ngram=3)
+        got = d.draft(np.array([1, 2, 3, 1, 2, 3, 1, 2]), 3)
+        assert got.tolist() == [3, 1, 2]
+
+    def test_most_recent_match_wins(self):
+        d = NGramDrafter(max_ngram=2)
+        # suffix [1, 2] occurs at i=1 and i=4; the later one's
+        # continuation (9) must win over the earlier one's (7)
+        got = d.draft(np.array([5, 1, 2, 7, 1, 2, 9, 1, 2]), 2)
+        assert got.tolist() == [9, 1]
+
+    def test_longest_ngram_preferred(self):
+        d = NGramDrafter(max_ngram=3, min_ngram=1)
+        # trigram [2, 3, 4] matches at i=0 (→ 8); the unigram [4] also
+        # matches at i=5 (→ 9) but the longer match must be taken
+        got = d.draft(np.array([2, 3, 4, 8, 7, 4, 9, 2, 3, 4]), 1)
+        assert got.tolist() == [8]
+
+    def test_no_repetition_abstains(self):
+        d = NGramDrafter()
+        assert d.draft(np.arange(10), 4).size == 0
+
+    def test_short_context_and_k_zero(self):
+        d = NGramDrafter()
+        assert d.draft(np.array([7]), 4).size == 0
+        assert d.draft(np.array([1, 2, 1]), 0).size == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="min_ngram"):
+            NGramDrafter(max_ngram=2, min_ngram=3)
+        with pytest.raises(ValueError, match="min_ngram"):
+            NGramDrafter(max_ngram=2, min_ngram=0)
+
+    def test_proposal_capped_at_k(self):
+        d = NGramDrafter(max_ngram=1)
+        got = d.draft(np.array([4, 5, 6, 7, 4]), 2)
+        assert got.tolist() == [5, 6]
+
+
+class TestSpecScheduling:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="spec_k must be"):
+            ServingEngine(_SpecStubBackend(), spec_k=0)
+        with pytest.raises(ValueError, match="drafter requires spec_k"):
+            ServingEngine(_SpecStubBackend(), drafter=_ZeroDrafter())
+
+    def test_full_accept_commits_k_plus_one_per_step(self):
+        eng = ServingEngine(_SpecStubBackend(n_slots=1), spec_k=3,
+                            drafter=_ArithmeticDrafter())
+        r = eng.submit([1, 2], max_new_tokens=9)
+        eng.step()  # prefill emits token 1, verify commits 4 more in-step
+        assert r.n_generated == 5
+        eng.drain()
+        assert r.out_tokens == _stub_oracle(9)
+        assert r.finish_reason == "length"
+        # 9 tokens: 1 at prefill + two full windows (4 + 4)
+        assert eng.metrics.decode_calls == 2
+        assert eng.metrics.decode_tokens == 8
+        assert eng.metrics.spec_accepted == 6 and eng.metrics.spec_proposed == 6
+        assert eng.pool.leaked() == 0
+
+    def test_full_reject_is_vanilla_pace_same_output(self):
+        eng = ServingEngine(_SpecStubBackend(n_slots=1), spec_k=3,
+                            drafter=_ZeroDrafter())
+        r = eng.submit([1, 2], max_new_tokens=5)
+        eng.drain()
+        assert r.out_tokens == _stub_oracle(5)
+        # every window commits exactly the bonus token: vanilla pace
+        assert eng.metrics.decode_calls == 4
+        assert eng.metrics.decode_tokens == 4
+        assert eng.metrics.spec_accepted == 0
+        assert eng.metrics.accepted_len == [0, 0, 0, 0]
+
+    def test_eos_inside_accepted_prefix_truncates_commit(self):
+        # stub emits 100, 101, 102, ...; EOS 102 arrives mid-window with a
+        # fully accepted draft — commits must stop AT the EOS token
+        eng = ServingEngine(_SpecStubBackend(n_slots=1), spec_k=4,
+                            drafter=_ArithmeticDrafter())
+        r = eng.submit([1], max_new_tokens=10, eos_id=102)
+        eng.drain()
+        assert r.finish_reason == "eos"
+        assert r.out_tokens == [100, 101, 102]
+        assert eng.pool.leaked() == 0
+
+    def test_budget_truncates_commit_at_max_tokens(self):
+        eng = ServingEngine(_SpecStubBackend(n_slots=1), spec_k=4,
+                            drafter=_ArithmeticDrafter())
+        r = eng.submit([1], max_new_tokens=3)
+        eng.drain()
+        assert r.finish_reason == "length"
+        assert r.out_tokens == _stub_oracle(3)
+        # one window was enough: 1 prefill token + 2 committed of the 5
+        assert eng.metrics.decode_calls == 1
+        assert eng.metrics.decode_tokens == 2
+
+    def test_chunk_finishing_joins_same_step_verify(self):
+        """A prompt finishing its last prefill chunk emits its first token
+        AND takes the same step's verify pass (the chunked-prefill rule,
+        unchanged under speculation)."""
+        eng = ServingEngine(_SpecStubBackend(n_slots=2), prefill_chunk=2,
+                            spec_k=2, drafter=_ArithmeticDrafter())
+        r = eng.submit([1, 2, 3, 4], max_new_tokens=6)
+        eng.step()  # chunk [0, 2): still mid-prefill, no decode work
+        assert r.state is RequestState.PARTIAL_PREFILL
+        assert eng.backend.calls == [("prefill", (0,))]
+        eng.step()  # final chunk + SAME-step verify
+        assert eng.backend.calls[1:] == [("prefill", (0,)), ("verify", (0,))]
+        assert r.n_generated == 4  # first token + k+1 window commits
+        eng.drain()
+        assert r.out_tokens == _stub_oracle(6)
+        assert eng.pool.leaked() == 0
+
+    def test_spec_budget_charges_verify_width(self):
+        """step_tokens accounts 1+k tokens per decoding slot: with k=3 a
+        decoding slot charges 4, so a budget of 8 cannot admit a chunk of
+        8 while one decode is in flight (8 - 4 < 8)."""
+        eng = ServingEngine(_SpecStubBackend(n_slots=2), prefill_chunk=8,
+                            step_tokens=8, spec_k=3,
+                            drafter=_ZeroDrafter())
+        a = eng.submit([1, 2], max_new_tokens=8)
+        eng.step()  # admit + prefill A (spends the whole budget)
+        b = eng.submit([3, 4], max_new_tokens=2)
+        eng.step()  # A decodes (charges 4): B's chunk of 8 must defer
+        assert b.state is RequestState.QUEUED
+        eng.drain()
+        assert a.out_tokens == _stub_oracle(8)
+        assert b.out_tokens == _stub_oracle(2)
+        assert eng.pool.leaked() == 0
+
+    def test_mixed_slots_conservation_and_metrics(self):
+        eng = ServingEngine(_SpecStubBackend(n_slots=2), spec_k=2,
+                            drafter=_ArithmeticDrafter(), max_queue=4)
+        reqs = [eng.submit([1], max_new_tokens=5) for _ in range(4)]
+        for _ in range(2):
+            eng.step()
+            s = eng.snapshot()
+            assert (s["submitted"]
+                    == s["completed"] + s["active"] + s["queued"]
+                    + s["rejected"]), s
+        eng.drain()
+        for r in reqs:
+            assert r.out_tokens == _stub_oracle(5)
+        s = eng.snapshot()
+        assert s["decode_tokens"] == eng.metrics.decode_tokens == 4 * 4
+        assert "decode_tok_s" in s
+        assert s["spec_acceptance_rate"] == 1.0
+        assert "p50" in s["accepted_len"] and "mean" in s["accepted_len"]
+        assert eng.pool.leaked() == 0
+
+
+class TestVanillaAccountingRegression:
+    """Satellite: multi-token-step accounting must leave vanilla numbers
+    unchanged — a vanilla decode call still counts exactly one token per
+    active slot, and the pre-existing snapshot keys keep their values."""
+
+    def test_vanilla_decode_tokens_one_per_slot_step(self):
+        eng = ServingEngine(_SpecStubBackend(n_slots=2))
+        reqs = [eng.submit([1, 2], max_new_tokens=4) for _ in range(3)]
+        eng.drain()
+        # 3 requests x 4 tokens, first token of each from prefill
+        assert eng.metrics.decode_tokens == sum(
+            r.n_generated - 1 for r in reqs
+        )
+        s = eng.snapshot()
+        assert s["output_tokens"] == 12
+        assert s["decode_tokens"] == 9
+        assert "spec_acceptance_rate" not in s  # no spec series w/o spec
+        assert s["decode_calls"] == eng.metrics.decode_calls
+
+    def test_eos_at_prefill_counts_zero_decode_tokens(self):
+        eng = ServingEngine(_SpecStubBackend(n_slots=1))
+        r = eng.submit([5], max_new_tokens=10, eos_id=100)
+        eng.drain()
+        assert r.out_tokens == [100]
+        assert eng.metrics.decode_tokens == 0
+        assert "decode_tok_s" not in eng.snapshot()

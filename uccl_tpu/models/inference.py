@@ -357,22 +357,72 @@ def prefill_slots(
     return tok, SlotKVCache(cache.k, cache.v, lengths)
 
 
+def verify_slots(
+    params, tokens, active, cache: SlotKVCache, cfg: DenseConfig
+) -> Tuple[jax.Array, jax.Array, SlotKVCache]:
+    """Batched draft verification — the speculative-decoding primitive,
+    generalizing :func:`decode_step_slots` from one token to a window.
+
+    tokens: [B_slots, S] where column 0 is each slot's last committed token
+    and columns 1..S-1 are its k = S-1 drafted continuation tokens; active:
+    [B_slots] bool. The window runs at positions [length, length+S) — the
+    same masked forward a prefill chunk uses, so per-row results are
+    bit-identical to S sequential decode steps over the same tokens. Row j's
+    greedy argmax is the target model's next token GIVEN the window prefix
+    tokens[:j+1]; greedy acceptance is the longest draft prefix that matches
+    those outputs: ``n_accepted[b] = max m such that tokens[b, 1..m] ==
+    argmax[b, 0..m-1]``. Active slots advance their length by
+    ``n_accepted + 1`` — the accepted draft tokens plus the one
+    target-computed token (correction or bonus) every verify yields.
+
+    KV written for rejected positions [length + n_accepted + 1, length + S)
+    is dead by the chunked-prefill stale-KV argument: the next window starts
+    at the new length and re-writes every stale position before attending to
+    it, and attention never reads past its own query position. Rollback is
+    the cursor, never a cache scrub.
+
+    Returns (greedy tokens [B_slots, S], n_accepted [B_slots], cache').
+    """
+    logits, out = _forward_slots(
+        params, tokens, cache, cache.lengths, active, cfg
+    )
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+    n_acc = greedy_acceptance(tokens, tok)
+    lengths = spec_advance(cache.lengths, active, n_acc)
+    return tok, n_acc, SlotKVCache(out.k, out.v, lengths)
+
+
+def greedy_acceptance(tokens, tok):
+    """THE acceptance rule, shared by both stacks' verify primitives:
+    per-row count of the longest draft prefix (``tokens[:, 1:]``) matching
+    the window's own greedy argmaxes (``tok[:, :-1]``). Exactness hangs on
+    this one definition — a divergence between the dense and MoE stacks
+    would break their common oracle guarantee."""
+    if tokens.shape[1] <= 1:
+        return jnp.zeros((tokens.shape[0],), jnp.int32)
+    match = (tokens[:, 1:] == tok[:, :-1]).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(match, axis=1), axis=1).astype(jnp.int32)
+
+
+def spec_advance(lengths, active, n_acc):
+    """Post-verify cursor advance: active slots move by the accepted
+    prefix plus the one target-computed token; inactive slots hold."""
+    return lengths + jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+
+
 def decode_step_slots(
     params, token, active, cache: SlotKVCache, cfg: DenseConfig
 ) -> Tuple[jax.Array, SlotKVCache]:
-    """One masked autoregressive step over the slot pool.
+    """One masked autoregressive step over the slot pool — the S=1 case of
+    :func:`verify_slots` (no draft: nothing to accept, advance by one).
 
     token: [B_slots] (inactive slots feed a dummy); active: [B_slots] bool.
     Active slots write their new KV at their own length and advance by one;
     inactive slots neither write nor advance. Returns (next greedy token
     [B_slots], cache').
     """
-    logits, cache = _forward_slots(
-        params, token[:, None], cache, cache.lengths, active, cfg
-    )
-    tok = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-    lengths = cache.lengths + active.astype(jnp.int32)
-    return tok, SlotKVCache(cache.k, cache.v, lengths)
+    tok, _, cache = verify_slots(params, token[:, None], active, cache, cfg)
+    return tok[:, 0], cache
 
 
 # Compiled-generate cache — the shared LRU-bounded ``_fns`` pattern

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from uccl_tpu.ep import ops as ep_ops
 from uccl_tpu.models.inference import (
     KVCache, SlotKVCache, _forward_cached, _forward_slots,
+    greedy_acceptance, spec_advance,
 )
 from uccl_tpu.utils.lru import LRUFnCache
 
@@ -449,29 +450,51 @@ class MoEServer:
                                start, cache.k, cache.v, cache.lengths)
         return tok, MoESlotCache(nk, nv, nlen)
 
-    def decode_step_slots(self, params, token, active, cache: MoESlotCache,
-                          impl: str = "ll"):
-        """One masked autoregressive step over the slot pool (packed LL EP
-        path by default). token/active: [W, B_loc]; inactive slots neither
-        write KV nor advance their length. Returns (next greedy token
-        [W, B_loc], cache')."""
+    def verify_slots(self, params, tokens, active, cache: MoESlotCache,
+                     impl: str = "sort"):
+        """Batched draft verification over the slot pool — the speculative-
+        decoding primitive, generalizing :meth:`decode_step_slots` from one
+        token to a window (mirrors :func:`inference.verify_slots`).
+
+        tokens: [W, B_loc, S] where column 0 is each slot's last committed
+        token and columns 1..S-1 its drafted continuation; active:
+        [W, B_loc] bool. Greedy acceptance = longest draft prefix matching
+        the window's own greedy argmaxes; active slots advance their length
+        by ``n_accepted + 1``; rejected-position KV is dead by the
+        chunked-prefill stale-KV argument (the next window re-writes it
+        before attending). Routes through the sorted EP path by default —
+        the multi-token regime, like prefill; the drop-free capacity check
+        keeps every routing exact regardless of window width. Returns
+        (greedy tokens [W, B_loc, S], n_accepted [W, B_loc], cache')."""
         self._check_drop_free()
         cfg = self.cfg
 
         def f(p, tok, mask, kc, vc, ln):
             logits, nk, nv = _forward_shard_slots(
-                _strip_shard(p), tok[0][:, None], kc[0], vc[0], ln[0],
+                _strip_shard(p), tok[0], kc[0], vc[0], ln[0],
                 ln[0], mask[0], cfg, impl,
             )
-            t = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-            nlen = ln[0] + mask[0].astype(jnp.int32)
-            return t[None], nk[None], nv[None], nlen[None]
+            t = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B_loc, S]
+            n_acc = greedy_acceptance(tok[0], t)
+            nlen = spec_advance(ln[0], mask[0], n_acc)
+            return t[None], n_acc[None], nk[None], nv[None], nlen[None]
 
-        key = ("decode_slots", impl, token.shape, cache.k.shape)
-        fn = self._fn(key, lambda: self._shard_mapped(f, 5, 4))
-        tok, nk, nv, nlen = fn(params, token, active,
-                               cache.k, cache.v, cache.lengths)
-        return tok, MoESlotCache(nk, nv, nlen)
+        key = ("verify_slots", impl, tokens.shape, cache.k.shape)
+        fn = self._fn(key, lambda: self._shard_mapped(f, 5, 5))
+        tok, n_acc, nk, nv, nlen = fn(params, tokens, active,
+                                      cache.k, cache.v, cache.lengths)
+        return tok, n_acc, MoESlotCache(nk, nv, nlen)
+
+    def decode_step_slots(self, params, token, active, cache: MoESlotCache,
+                          impl: str = "ll"):
+        """One masked autoregressive step over the slot pool (packed LL EP
+        path by default) — the S=1 case of :meth:`verify_slots`.
+        token/active: [W, B_loc]; inactive slots neither write KV nor
+        advance their length. Returns (next greedy token [W, B_loc],
+        cache')."""
+        tok, _, cache = self.verify_slots(params, token[..., None], active,
+                                          cache, impl=impl)
+        return tok[..., 0], cache
 
     def generate(self, params, prompt, new_tokens: int, max_seq: int,
                  impl: str = "ll"):

@@ -191,6 +191,8 @@ def _serve_continuous(args, saved_cfg):
                  and saved_cfg.get("model") == "dense" else "moe")
     if args.slots < 1:
         raise SystemExit(f"--slots must be >= 1, got {args.slots}")
+    if args.spec_k < 0:
+        raise SystemExit(f"--spec-k must be >= 0, got {args.spec_k}")
     if args.step_tokens and not args.prefill_chunk:
         raise SystemExit("--step-tokens needs --prefill-chunk (the "
                          "whole-prompt path has no sub-step unit to budget)")
@@ -300,6 +302,7 @@ def _serve_continuous(args, saved_cfg):
         backend, max_queue=args.max_queue or None, register_stats=True,
         prefill_chunk=args.prefill_chunk or None,
         step_tokens=args.step_tokens or None,
+        spec_k=args.spec_k or None,
     )
 
     # synthetic workload (mixed prompt lengths, Poisson arrivals), compile
@@ -343,6 +346,7 @@ def _serve_continuous(args, saved_cfg):
         "arrival_rate": args.arrival_rate, "new_tokens": args.new_tokens,
         "prefill_chunk": args.prefill_chunk or None,
         "step_tokens": args.step_tokens or None,
+        "spec_k": args.spec_k or None,
         "wall_s": round(wall, 3), **snap,
     }
     if reqs:
@@ -421,10 +425,19 @@ def main(argv=None):
                          "(one compiled prefill program instead of pow2 "
                          "buckets). 0 = whole-prompt prefill")
     ap.add_argument("--step-tokens", type=int, default=0,
-                    help="server: per-step token budget (decode token = 1, "
-                         "prefill chunk = C); admission defers while the "
-                         "step's committed spend would exceed it. Needs "
-                         "--prefill-chunk. 0 = unbudgeted")
+                    help="server: per-step token budget (decoding slot = 1 "
+                         "token, or 1+K under --spec-k — the verify window "
+                         "really runs K+1 rows; prefill chunk = C); "
+                         "admission defers while the step's committed "
+                         "spend would exceed it. Needs --prefill-chunk. "
+                         "0 = unbudgeted")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="server: speculative decoding — the prompt-lookup "
+                         "NGram drafter proposes K tokens per decoding "
+                         "slot each step, one batched [slots, K+1] verify "
+                         "commits each slot's accepted prefix + 1 "
+                         "target token (bit-identical to vanilla greedy "
+                         "decode, docs/SERVING.md). 0 = off")
     ap.add_argument("--check-oracle", action="store_true",
                     help="server: verify every completed request is "
                          "bit-identical to the one-shot generate oracle "

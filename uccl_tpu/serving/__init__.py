@@ -17,6 +17,7 @@ from uccl_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from uccl_tpu.serving.request import Request, RequestState  # noqa: F401
 from uccl_tpu.serving.scheduler import FIFOScheduler  # noqa: F401
 from uccl_tpu.serving.slots import SlotPool  # noqa: F401
+from uccl_tpu.serving.spec import Drafter, NGramDrafter  # noqa: F401
 
 # uccl_tpu.serving.disagg (the prefill/decode worker pair over p2p) is
 # imported explicitly by its consumers — it pulls in the p2p runtime.
@@ -25,4 +26,5 @@ __all__ = [
     "ChunkEvent", "DenseBackend", "MoEBackend", "ServingEngine",
     "ServingMetrics", "percentile", "percentiles_ms", "PrefixCache",
     "Request", "RequestState", "FIFOScheduler", "SlotPool",
+    "Drafter", "NGramDrafter",
 ]

@@ -344,7 +344,11 @@ class DecodeWorker:
         self._granted: Dict[Tuple[int, int], Dict] = {}  # (conn, rid) -> st
         self._finished: List[Request] = []
         self.origin: Dict[int, Tuple[int, int]] = {}  # local rid -> (conn, remote rid)
+        # closed = EVERY attached prefill conn said BYE (per-conn counting:
+        # under N-to-1 fan-in one worker closing must not strand the rest)
         self.closed = False
+        self._n_conns = 0
+        self._n_byes = 0
 
     @property
     def port(self) -> int:
@@ -353,6 +357,10 @@ class DecodeWorker:
     def attach(self, timeout_ms: int = 30000) -> int:
         """Accept one prefill worker and hand it the pool descriptors."""
         conn = self.ep.accept(timeout_ms=timeout_ms)
+        self._n_conns += 1
+        # a conn attaching AFTER earlier conns all said BYE re-opens the
+        # decoder (sequential fan-in must not inherit a stale closed flag)
+        self.closed = self._n_byes >= self._n_conns
         self.ep.send(conn, json.dumps({
             "t": "hello", "fmt": self.fmt.to_meta(),
             "k_fifo": _b64(self.ep.advertise(self._mr_k)),
@@ -369,7 +377,8 @@ class DecodeWorker:
             elif kind == "final":
                 self._on_final(conn, msg)
             elif kind == "bye":
-                self.closed = True
+                self._n_byes += 1
+                self.closed = self._n_byes >= self._n_conns
         self._try_grant()
 
     def _try_grant(self) -> None:
@@ -491,15 +500,26 @@ def make_local_pair(prefill_engine: ServingEngine,
     two real processes)."""
     from uccl_tpu.p2p import Endpoint
 
-    ep_d, ep_p = Endpoint(), Endpoint()
-    dw = DecodeWorker(decode_engine, ep_d)
+    dw = DecodeWorker(decode_engine, Endpoint())
+    return add_local_prefill(dw, prefill_engine), dw
+
+
+def add_local_prefill(dw: DecodeWorker,
+                      prefill_engine: ServingEngine) -> PrefillWorker:
+    """Attach one more in-process prefill worker to ``dw`` — the loopback
+    fan-in arrangement (N prefill engines streaming into one decode pool;
+    each stream is its own conn, so GRANT/FINAL bookkeeping stays
+    per-(conn, rid) and workers never see each other's slots)."""
+    from uccl_tpu.p2p import Endpoint
+
+    ep_p = Endpoint()
     # loopback: connect() completes against the listening endpoint before
     # accept() is called (the test_p2p pair idiom)
     pw = PrefillWorker.__new__(PrefillWorker)
-    conn_p = ep_p.connect("127.0.0.1", ep_d.port)
+    conn_p = ep_p.connect("127.0.0.1", dw.ep.port)
     dw.attach()
     _init_prefill_worker(pw, prefill_engine, ep_p, conn_p)
-    return pw, dw
+    return pw
 
 
 def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,

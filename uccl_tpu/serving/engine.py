@@ -39,6 +39,10 @@ from uccl_tpu.serving.metrics import ServingMetrics
 from uccl_tpu.serving.request import Request, RequestState, now
 from uccl_tpu.serving.scheduler import FIFOScheduler
 from uccl_tpu.serving.slots import SlotPool
+from uccl_tpu.serving.spec import (
+    SPEC_ACCEPTED_LEN as _SPEC_ACCEPTED_LEN,
+    SPEC_TOKENS as _SPEC_TOKENS,
+)
 from uccl_tpu.utils.lru import LRUFnCache
 
 # serving telemetry on the obs registry (docs/OBSERVABILITY.md): the
@@ -142,6 +146,23 @@ class DenseBackend:
 
         return self._fns.get(("decode",), build)
 
+    def _verify_fn(self, s: int):
+        jax = self._jax
+        cfg = self.cfg
+
+        def build():
+            from uccl_tpu.models.inference import SlotKVCache, verify_slots
+
+            def run(p, tok, mask, kc, vc, ln):
+                t, n_acc, cache = verify_slots(
+                    p, tok, mask, SlotKVCache(kc, vc, ln), cfg
+                )
+                return t, n_acc, cache.k, cache.v, cache.lengths
+
+            return jax.jit(run)
+
+        return self._fns.get(("verify", s), build)
+
     def prefill(self, tokens: np.ndarray, lens: np.ndarray,
                 mask: np.ndarray,
                 start: Optional[np.ndarray] = None) -> np.ndarray:
@@ -163,6 +184,18 @@ class DenseBackend:
                          self.cache.k, self.cache.v, self.cache.lengths)
         self.cache = SlotKVCache(k, v, ln)
         return np.asarray(t)
+
+    def verify(self, tokens: np.ndarray, active: np.ndarray):
+        """One batched [n_slots, k+1] draft-verify window (spec decode):
+        returns (greedy tokens [n_slots, k+1], n_accepted [n_slots])."""
+        from uccl_tpu.models.inference import SlotKVCache
+
+        fn = self._verify_fn(tokens.shape[1])
+        t, n_acc, k, v, ln = fn(self.params, tokens, active,
+                                self.cache.k, self.cache.v,
+                                self.cache.lengths)
+        self.cache = SlotKVCache(k, v, ln)
+        return np.asarray(t), np.asarray(n_acc)
 
     # slot KV movement (prefix-cache hits + the disagg p2p stream) — thin
     # shims over the cache's export/import views (models/inference.py)
@@ -222,6 +255,18 @@ class MoEBackend:
         )
         return np.asarray(t).reshape(self.n_slots)
 
+    def verify(self, tokens: np.ndarray, active: np.ndarray):
+        """One batched [n_slots, k+1] draft-verify window (spec decode),
+        through the sorted EP path — the multi-token regime, like prefill.
+        Returns (greedy tokens [n_slots, k+1], n_accepted [n_slots])."""
+        t, n_acc, self.cache = self.server.verify_slots(
+            self.params, self._grid(tokens, np.int32),
+            self._grid(active, bool), self.cache,
+        )
+        s = tokens.shape[1]
+        return (np.asarray(t).reshape(self.n_slots, s),
+                np.asarray(n_acc).reshape(self.n_slots))
+
     # slot KV movement — MoESlotCache maps flat slot ids to its [W, B_loc]
     # grid internally, so the engine-facing surface matches DenseBackend's
     def export_slot_kv(self, slot: int, lo: int, hi: int):
@@ -243,10 +288,22 @@ class ServingEngine:
     their prefill cursor by one C-token chunk per step (one compiled
     prefill program at [n_slots, C]) and in-flight decodes run every step —
     no decode ever waits behind more than one chunk. ``step_tokens`` caps a
-    step's committed token spend (decode token = 1, prefill chunk = C) by
-    deferring admission; it requires ``prefill_chunk`` (the whole-prompt
-    path has no sub-step unit to budget with). Decodes are never
-    budget-gated — they are the latency the budget protects.
+    step's committed token spend (decode slot = 1 token, or 1+k under
+    speculation; prefill chunk = C) by deferring admission; it requires
+    ``prefill_chunk`` (the whole-prompt path has no sub-step unit to budget
+    with). Decodes are never budget-gated — they are the latency the
+    budget protects.
+
+    ``spec_k=K`` enables speculative decoding (serving/spec.py,
+    docs/SERVING.md): each step's decode pass becomes one batched
+    [n_slots, K+1] draft-verify window — the ``drafter`` (default
+    :class:`~uccl_tpu.serving.spec.NGramDrafter`, no second model)
+    proposes K tokens per decoding slot, greedy acceptance commits each
+    slot's matched draft prefix plus one target-computed token, and
+    rejected-position KV is dead by cursor rollback (never a cache scrub).
+    Composes with chunked prefill (a prompt finishing its last chunk joins
+    the same step's verify), ``adopt()``, and prefix-cache hits; output
+    stays bit-identical to vanilla greedy decode.
     """
 
     _stats_seq = 0  # distinct registry source name per registered engine
@@ -257,7 +314,21 @@ class ServingEngine:
                  step_tokens: Optional[int] = None,
                  prefix_cache=None,
                  chunk_sink: Optional[Callable[[List[ChunkEvent]], None]]
-                 = None):
+                 = None,
+                 spec_k: Optional[int] = None,
+                 drafter=None):
+        if spec_k is not None:
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            if drafter is None:
+                from uccl_tpu.serving.spec import NGramDrafter
+
+                drafter = NGramDrafter()
+        elif drafter is not None:
+            raise ValueError(
+                "drafter requires spec_k: without a draft width there is "
+                "no verify window to fill"
+            )
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}"
@@ -292,6 +363,8 @@ class ServingEngine:
                 "emits no per-chunk availability events"
             )
         self.backend = backend
+        self.spec_k = spec_k
+        self.drafter = drafter
         self.prefill_chunk = prefill_chunk
         self.step_tokens = step_tokens
         self.prefix_cache = prefix_cache
@@ -443,10 +516,12 @@ class ServingEngine:
         c = self.prefill_chunk
         limit = None
         if self.step_tokens is not None:
-            # committed spend this step: 1 per decoding slot, C per
-            # mid-prefill slot; admit only what fits in the remainder
-            spend = (len(self._by_slot) - len(self._prefilling)
-                     + len(self._prefilling) * c)
+            # committed spend this step: 1 token per decoding slot (1+k
+            # when speculating — the verify window really runs k+1 rows),
+            # C per mid-prefill slot; admit only what fits the remainder
+            per_decode = 1 if self.spec_k is None else 1 + self.spec_k
+            spend = ((len(self._by_slot) - len(self._prefilling))
+                     * per_decode + len(self._prefilling) * c)
             limit = max(0, (self.step_tokens - spend) // c)
         events: List[ChunkEvent] = []
         # admit ONE at a time: each admission's prefix-cache match (and
@@ -643,6 +718,9 @@ class ServingEngine:
     def _decode(self, finished) -> None:
         decoding = {s: r for s, r in self._by_slot.items()
                     if s not in self._prefilling}
+        if self.spec_k is not None:
+            self._spec_decode(decoding, finished)
+            return
         active = np.zeros(self.backend.n_slots, bool)
         for slot in decoding:
             active[slot] = True
@@ -650,7 +728,8 @@ class ServingEngine:
         ts0 = tr.now_us() if tr is not None else 0.0
         t0 = now()
         tok = self.backend.decode(self._last_tok.copy(), active)
-        self.metrics.on_decode_step(now() - t0, len(decoding))
+        self.metrics.on_decode_step(now() - t0, len(decoding),
+                                    tokens=len(decoding))
         t_done = now()
         if tr is not None:
             tr.complete("wire.decode", ts0, tr.now_us() - ts0, "wire",
@@ -659,6 +738,69 @@ class ServingEngine:
             self._last_tok[slot] = tok[slot]
             req.out_tokens.append(int(tok[slot]))
             self._maybe_retire(slot, req, t_done, finished)
+
+    def _spec_decode(self, decoding, finished) -> None:
+        """One speculative decode iteration: draft k tokens per decoding
+        slot (host-side, jax-free), verify every slot in ONE batched
+        [n_slots, k+1] window, commit each slot's accepted prefix plus the
+        target-computed correction/bonus token. Commits stop early at EOS
+        or the token budget (both retire the request, so the over-advanced
+        device cursor is dead with the slot). Drafters may propose fewer
+        than k tokens — the window pads with zeros, and a pad that happens
+        to match still commits a correct token (acceptance only ever
+        commits the target's own argmaxes)."""
+        k = self.spec_k
+        n = self.backend.n_slots
+        tokens = np.zeros((n, k + 1), np.int32)
+        active = np.zeros(n, bool)
+        proposed = np.zeros(n, np.int32)
+        for slot, req in decoding.items():
+            tokens[slot, 0] = self._last_tok[slot]
+            d = np.asarray(self.drafter.draft(req.context(), k),
+                           np.int32).reshape(-1)[:k]
+            if d.size:
+                tokens[slot, 1:1 + d.size] = d
+            proposed[slot] = d.size
+            active[slot] = True
+        tr = obs.get_tracer()
+        ts0 = tr.now_us() if tr is not None else 0.0
+        t0 = now()
+        tok, n_acc = self.backend.verify(tokens, active)
+        dt = now() - t0
+        t_done = now()
+        if tr is not None:
+            # the device window only — the host commit loop below must not
+            # inflate the span (same placement as _decode's wire.decode)
+            tr.complete("wire.verify", ts0, tr.now_us() - ts0, "wire",
+                        n=len(decoding), k=k)
+        committed_total = 0
+        for slot, req in list(decoding.items()):
+            m = int(n_acc[slot])
+            committed = 0
+            for j in range(m + 1):
+                t = int(tok[slot, j])
+                self._last_tok[slot] = tok[slot, j]
+                req.out_tokens.append(t)
+                committed += 1
+                if ((req.eos_id is not None and t == req.eos_id)
+                        or req.n_generated >= req.max_new_tokens):
+                    break
+            committed_total += committed
+            # telemetry meters DRAFTED tokens only: the window pads
+            # undrafted positions with zeros, and a pad that coincidentally
+            # matches the argmax still COMMITS (it is the target's own
+            # token) but must not count as an accepted speculation — nor an
+            # abstention as k rejections
+            p = int(proposed[slot])
+            acc = min(m, p)
+            _SPEC_TOKENS.inc(acc, outcome="accepted")
+            _SPEC_TOKENS.inc(p - acc, outcome="rejected")
+            _SPEC_TOKENS.inc(1, outcome="bonus")
+            _SPEC_ACCEPTED_LEN.inc(1, len=str(acc))
+            self.metrics.on_spec(proposed=p, accepted=acc)
+            self._maybe_retire(slot, req, t_done, finished)
+        self.metrics.on_decode_step(dt, len(decoding),
+                                    tokens=committed_total)
 
     def _emit_first_token(self, slot: int, req: Request, tok_val, t: float,
                           finished) -> None:

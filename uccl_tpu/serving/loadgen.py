@@ -69,6 +69,35 @@ def synth_shared_workload(rng: np.random.Generator, n: int, prompt_len: int,
     return prompts, lens, arrivals
 
 
+def synth_repeat_workload(rng: np.random.Generator, n: int, prompt_len: int,
+                          vocab: int, arrival_rate: float,
+                          motif_max: int = 2):
+    """Repetitive-prompt workload — the regime a prompt-lookup drafter
+    (serving/spec.py) targets: template/boilerplate-heavy traffic whose
+    greedy continuations settle into short cycles. Each prompt tiles a
+    random 1..``motif_max``-token motif to a mixed length in
+    [max(1, L/2), L]; :func:`synth_workload`'s random prompts bound the
+    other end of the acceptance spectrum (novel text, near-zero
+    acceptance). Arrivals are drawn FIRST so every arm at the same seed
+    faces the identical arrival stream (the synth_shared_workload rule).
+    Returns (prompts, lens, arrivals)."""
+    if motif_max < 1:
+        raise ValueError(f"motif_max must be >= 1, got {motif_max}")
+    if arrival_rate > 0:
+        arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate, n))
+    else:
+        arrivals = np.zeros(n)
+    lo = max(1, prompt_len // 2)
+    prompts = []
+    for _ in range(n):
+        ml = int(rng.integers(1, motif_max + 1))
+        motif = rng.integers(0, vocab, ml).astype(np.int32)
+        length = int(rng.integers(lo, prompt_len + 1))
+        prompts.append(np.tile(motif, (length + ml - 1) // ml)[:length])
+    lens = np.asarray([p.size for p in prompts])
+    return prompts, lens, arrivals
+
+
 def warm_engine(engine: ServingEngine, lens, max_seq: int,
                 new_tokens: int) -> None:
     """Compile every prefill program the sampled lengths can hit plus the

@@ -55,6 +55,21 @@ def percentiles_ms(xs: List[float], qs=(50, 95)) -> Dict[str, float]:
     return out
 
 
+def dist(xs: List[float], qs=(50, 95)) -> Dict[str, float]:
+    """Percentiles + mean/max in the samples' OWN units (token counts,
+    ratios — anything that is not a duration; durations go through
+    :func:`percentiles_ms`). Empty dict when no samples."""
+    out = {}
+    for q in qs:
+        v = percentile(xs, q)
+        if v is not None:
+            out[f"p{q}"] = round(v, 3)
+    if xs:
+        out["mean"] = round(sum(xs) / len(xs), 3)
+        out["max"] = round(float(max(xs)), 3)
+    return out
+
+
 class ServingMetrics:
     """Counters + latency samples for one engine; host-only, jax-free."""
 
@@ -68,6 +83,18 @@ class ServingMetrics:
         self.prefill_calls = 0
         self.prefill_chunks = 0  # chunked-prefill calls (subset of prefill_calls)
         self.decode_calls = 0
+        # tokens COMMITTED by decode/verify calls — under speculative
+        # decoding a step commits 0..k+1 tokens per slot, so throughput
+        # derives from this count, never from an assumed 1 token per call
+        # (the PR 1 "1-token-delta window" assumption, generalized)
+        self.decode_tokens = 0
+        # speculative decoding (per active slot per verify window):
+        # proposed = tokens the drafter actually proposed (window pads
+        # from abstentions are excluded), accepted = its matched prefix
+        self.spec_windows = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.accepted_len: List[int] = []
         self.ttft_s: List[float] = []
         self.queue_wait_s: List[float] = []
         self.tpot_s: List[float] = []
@@ -139,9 +166,22 @@ class ServingMetrics:
             self.prefill_chunks += 1
         self.prefill_s.append(dt)
 
-    def on_decode_step(self, dt: float, n_active: int) -> None:
+    def on_decode_step(self, dt: float, n_active: int,
+                       tokens: Optional[int] = None) -> None:
+        """One masked decode/verify call over ``n_active`` slots that
+        committed ``tokens`` output tokens (None = the vanilla 1 token per
+        active slot; speculative steps pass their actual commit count)."""
         self.decode_calls += 1
         self.decode_step_s.append(dt)
+        self.decode_tokens += n_active if tokens is None else tokens
+
+    def on_spec(self, *, proposed: int, accepted: int) -> None:
+        """One slot's verify outcome: ``proposed`` drafted tokens entered
+        the window, ``accepted`` matched the target's greedy output."""
+        self.spec_windows += 1
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self.accepted_len.append(accepted)
 
     def on_step(self, dt: float) -> None:
         self.step_s.append(dt)
@@ -173,6 +213,7 @@ class ServingMetrics:
             "prefill_calls": self.prefill_calls,
             "prefill_chunks": self.prefill_chunks,
             "decode_calls": self.decode_calls,
+            "decode_tokens": self.decode_tokens,
             "ttft_ms": percentiles_ms(self.ttft_s),
             "queue_wait_ms": percentiles_ms(self.queue_wait_s),
             "tpot_ms": percentiles_ms(self.tpot_s),
@@ -183,6 +224,21 @@ class ServingMetrics:
         }
         if self.step_s:
             snap["max_step_ms"] = round(max(self.step_s) * 1e3, 3)
+        # decode throughput off the COMMITTED token count over decode-call
+        # wall time — honest whether a call commits n_active tokens
+        # (vanilla) or up to (k+1) * n_active (speculative)
+        decode_wall = sum(self.decode_step_s)
+        if decode_wall > 0 and self.decode_tokens:
+            snap["decode_tok_s"] = round(self.decode_tokens / decode_wall, 1)
+        if self.spec_windows:
+            snap["spec_windows"] = self.spec_windows
+            snap["spec_proposed"] = self.spec_proposed
+            snap["spec_accepted"] = self.spec_accepted
+            if self.spec_proposed:
+                snap["spec_acceptance_rate"] = round(
+                    self.spec_accepted / self.spec_proposed, 4
+                )
+            snap["accepted_len"] = dist(self.accepted_len)
         if self.adopted:
             snap["adopted"] = self.adopted
             snap["disagg_queue_ms"] = percentiles_ms(self.disagg_queue_s)

@@ -62,6 +62,12 @@ class Request:
     t_admit: Optional[float] = None
     t_first_token: Optional[float] = None
     t_finish: Optional[float] = None
+    # drafting-context buffer: prompt + committed tokens, grown in place
+    # (capacity = prompt + max_new_tokens is the request's hard ceiling)
+    # so the per-step speculative draft never re-concatenates O(len)
+    _ctx: Optional[np.ndarray] = field(default=None, repr=False,
+                                       compare=False)
+    _ctx_len: int = 0
 
     @property
     def track(self) -> str:
@@ -73,6 +79,26 @@ class Request:
     @property
     def n_generated(self) -> int:
         return len(self.out_tokens)
+
+    def context(self) -> np.ndarray:
+        """Prompt + committed continuation — what a speculative drafter
+        conditions on (never includes uncommitted draft tokens). Returns a
+        READ-ONLY view of an amortized buffer: tokens committed since the
+        last call are appended in place (decode calls this every step, so
+        re-concatenating the whole context would cost O(len) per step)."""
+        if not self.out_tokens:
+            return self.prompt
+        n = self.prompt.size + len(self.out_tokens)
+        if self._ctx is None:
+            self._ctx = np.empty(self.prompt.size + self.max_new_tokens,
+                                 np.int32)
+            self._ctx[:self.prompt.size] = self.prompt
+            self._ctx_len = self.prompt.size
+        while self._ctx_len < n:
+            self._ctx[self._ctx_len] = \
+                self.out_tokens[self._ctx_len - self.prompt.size]
+            self._ctx_len += 1
+        return self._ctx[:n]
 
     @property
     def queue_wait(self) -> Optional[float]:

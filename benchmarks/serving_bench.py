@@ -25,6 +25,16 @@ reports ``acceptance_rate`` off ``spec_tokens_total`` counter deltas and
 token/step); pair it with ``--workload repeat`` for the template-heavy
 prompt family whose looping continuations the prompt-lookup drafter
 predicts (``--workload random`` bounds the novel-text end).
+``--replicas 1,2`` switches to the scale-out sweep
+(``bench=serving_router`` lines): N engines behind the least-loaded
+router under sustained Poisson overload (``--overload`` multiplies the
+offered rate), optionally class-mixed (``--priority-mix`` interactive
+fraction, short interactive turns via ``--interactive-new-tokens`` over
+long batch jobs) with chunk-boundary preemption on/off (``--preempt``).
+Each arm reports per-class TTFT/TPOT SLO attainment against
+``--slo-ttft-ms``/``--slo-tpot-ms`` and labels itself off REAL counter
+deltas — per-replica routed counts, spillovers, router rejections,
+preemptions/resumes (docs/SERVING.md).
 
     python benchmarks/serving_bench.py --devices 2 --rates 4,16 --slots 2,4
     python benchmarks/serving_bench.py --stack moe --devices 4 --slots 4
@@ -37,6 +47,10 @@ predicts (``--workload random`` bounds the novel-text end).
     python benchmarks/serving_bench.py --stack dense --workload repeat \
         --rates 24 --slots 4 --prefill-chunks off --spec-k 0,2,4 \
         --prompt-len 24 --new-tokens 32     # the speculative-decode sweep
+    python benchmarks/serving_bench.py --stack dense --rates 12 --slots 4 \
+        --prefill-chunks 8 --replicas 1,2 --overload 1,2,4 \
+        --priority-mix 0.25 --preempt on,off --interactive-new-tokens 8 \
+        --prompt-len 32 --new-tokens 96     # the scale-out/SLO sweep
 """
 
 from __future__ import annotations
@@ -58,6 +72,11 @@ _ARM_COUNTERS = (
     ("spec_tokens_total", {"outcome": "accepted"}),
     ("spec_tokens_total", {"outcome": "rejected"}),
     ("spec_tokens_total", {"outcome": "bonus"}),
+    ("serving_preempted_total", {}),
+    ("serving_resumed_total", {}),
+    ("serving_router_spillover_total", {}),
+    ("serving_router_rejected_total", {"reason": "saturated"}),
+    ("serving_admission_rejected_total", {}),
 )
 
 
@@ -113,6 +132,50 @@ def _make_backend(args, jax, stack, n_slots, max_seq):
         max_seq=max_seq,
     )
     return backend, world, cfg.vocab
+
+
+def _make_backends(args, jax, stack, n_slots, max_seq, n):
+    """N replica backends (or None when the pool doesn't tile the MoE
+    mesh) — the sharing rule (dense: one compiled-fn cache; MoE: one
+    server) lives in serving.replicate_backend, the same path serve.py
+    builds its replica set through."""
+    from uccl_tpu.serving import replicate_backend
+
+    first, world, vocab = _make_backend(args, jax, stack, n_slots, max_seq)
+    if first is None:
+        return None, world, vocab
+    return replicate_backend(first, n), world, vocab
+
+
+def _slo_attainment(reqs, slo_ttft_ms, slo_tpot_ms):
+    """Per-class SLO attainment over the arm's completed requests: the
+    fraction whose measured TTFT / TPOT met the target — the headline the
+    overload sweep plots (docs/SERVING.md)."""
+    from uccl_tpu.serving import RequestState
+
+    out = {}
+    for r in reqs:
+        if r.state is not RequestState.FINISHED:
+            continue
+        c = out.setdefault(r.priority, {"n": 0, "ttft_ok": 0,
+                                        "tpot_ok": 0, "tpot_n": 0})
+        c["n"] += 1
+        if r.ttft is not None and r.ttft * 1e3 <= slo_ttft_ms:
+            c["ttft_ok"] += 1
+        if r.tpot is not None:
+            c["tpot_n"] += 1
+            if r.tpot * 1e3 <= slo_tpot_ms:
+                c["tpot_ok"] += 1
+    return {
+        cls: {
+            "completed": c["n"],
+            "ttft_attainment": round(c["ttft_ok"] / c["n"], 4)
+            if c["n"] else None,
+            "tpot_attainment": round(c["tpot_ok"] / c["tpot_n"], 4)
+            if c["tpot_n"] else None,
+        }
+        for cls, c in sorted(out.items())
+    }
 
 
 def _workload(args, vocab, rate, hit_rate):
@@ -259,6 +322,102 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
     return arm
 
 
+def run_router_arm(args, jax, stack, rate, n_slots, prefill_chunk,
+                   n_replicas, mix, preempt_on, overload):
+    """One replica-router arm under sustained Poisson (over)load:
+    ``n_replicas`` engines behind the least-loaded router, offered
+    ``rate × overload`` req/s, optionally class-mixed (``mix`` =
+    interactive fraction) with chunk-boundary preemption on or off. The
+    line reports per-class TTFT/TPOT SLO attainment (measured against
+    --slo-ttft-ms/--slo-tpot-ms) and labels itself off REAL counter
+    deltas: per-replica routed counts, spillovers, router rejections,
+    preemptions/resumes — never mirrored scheduler math."""
+    priority = mix is not None
+    preempt = bool(priority and preempt_on and prefill_chunk)
+    if preempt_on and not preempt:
+        return None  # preemption-on arm without classes/chunks: no-op
+    step_tokens = (args.step_tokens or None) if prefill_chunk else None
+    if step_tokens is not None and step_tokens < (prefill_chunk or 0):
+        return None
+
+    import numpy as np
+
+    from uccl_tpu import obs
+    from uccl_tpu.serving import Router, ServingEngine
+    from uccl_tpu.serving.loadgen import (
+        assign_classes, drive, warm_replicas,
+    )
+
+    max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
+    backends, world, vocab = _make_backends(args, jax, stack, n_slots,
+                                            max_seq, n_replicas)
+    if backends is None:
+        return None
+    engines = [ServingEngine(
+        b, prefill_chunk=prefill_chunk, step_tokens=step_tokens,
+        max_queue=args.max_queue or None,
+        priority_classes=priority, preempt=preempt,
+    ) for b in backends]
+    router = Router(engines)
+    eff_rate = rate * overload
+    prompts, lens, arrivals = _workload(args, vocab, eff_rate, None)
+    rng_cls = np.random.default_rng(args.seed + 1)  # classes after arrivals
+    priorities = (assign_classes(rng_cls, args.requests, mix,
+                                 pattern=args.class_pattern)
+                  if priority else None)
+    warm_replicas(router, lens, max_seq, args.new_tokens)
+    # short interactive turns over long batch jobs (the Llumnix-shape
+    # workload preemption exists for): per-class token budgets when the
+    # arm is classed and --interactive-new-tokens is set
+    new_tokens = args.new_tokens
+    if priority and args.interactive_new_tokens:
+        new_tokens = [args.interactive_new_tokens
+                      if c == "interactive" else args.new_tokens
+                      for c in priorities]
+    routed_c = obs.counter("serving_router_requests_total")
+    routed0 = [routed_c.get(replica=str(i)) for i in range(n_replicas)]
+    before = _counter_state()
+    reqs, wall = drive(router, prompts, arrivals, new_tokens,
+                       priorities=priorities)
+    deltas = _counter_deltas(before)
+    snap = router.snapshot()
+    router.close()
+
+    arm = _arm_header(args, stack, world, rate, n_slots, prefill_chunk,
+                      step_tokens, None)
+    arm.update({
+        "bench": "serving_router",
+        "replicas": n_replicas,
+        "overload": overload,
+        "offered_rate": eff_rate,
+        "priority_mix": mix,
+        "preempt": preempt,
+        "wall_s": round(wall, 3),
+        "completed": snap["completed"], "rejected": snap["rejected"],
+        "expired": snap["expired"],
+        "goodput_tok_s": snap.get("goodput_tok_s"),
+        "ttft_ms": snap["ttft_ms"], "queue_wait_ms": snap["queue_wait_ms"],
+        "tpot_ms": snap["tpot_ms"],
+        "tpot_p95_ms": snap["tpot_ms"].get("p95"),
+        "max_step_ms": snap.get("max_step_ms"),
+        # the routing decisions this arm is labeled from — counter deltas
+        "routed": [routed_c.get(replica=str(i)) - routed0[i]
+                   for i in range(n_replicas)],
+        "spillovers": deltas["serving_router_spillover"],
+        "router_rejected": deltas["serving_router_rejected_saturated"],
+        "engine_rejected": deltas["serving_admission_rejected"],
+        "preemptions": deltas["serving_preempted"],
+        "resumes": deltas["serving_resumed"],
+        "slo_ttft_ms": args.slo_ttft_ms,
+        "slo_tpot_ms": args.slo_tpot_ms,
+        "slo": _slo_attainment(reqs, args.slo_ttft_ms, args.slo_tpot_ms),
+    })
+    if "per_class" in snap:
+        arm["per_class"] = snap["per_class"]
+    arm["obs"] = obs.REGISTRY.snapshot()["metrics"]
+    return arm
+
+
 def run_disagg_arm(args, jax, stack, rate, n_slots, prefill_chunk,
                    hit_rate=None, spec_k=None):
     """One disaggregated arm: prefill engine → chunk-streamed KV over
@@ -389,6 +548,48 @@ def main():
                          "prefill->decode pair (chunk-streamed KV over "
                          "loopback p2p) instead of one engine, reporting "
                          "the TTFT queue/prefill/transfer split")
+    ap.add_argument("--replicas", default="",
+                    help="comma-separated replica counts (e.g. '1,2'): "
+                         "each arm runs N engines behind the least-loaded "
+                         "router and reports per-class SLO attainment + "
+                         "counter-derived routing/preemption labels "
+                         "(bench=serving_router lines). Composes with "
+                         "--overload and --priority-mix; not with "
+                         "--disagg/--prefix-hit-rates/--spec-k sweeps")
+    ap.add_argument("--overload", default="1",
+                    help="comma-separated offered-load multipliers on each "
+                         "--rates value for router arms (e.g. '1,2,4' — "
+                         "sustained Poisson overload is where preemption "
+                         "and rejection earn their keep)")
+    ap.add_argument("--priority-mix", default="",
+                    help="comma-separated interactive fractions for "
+                         "router arms (e.g. '0.3,0.5'; 'off' = no "
+                         "classes): requests split interactive/batch and "
+                         "the line carries per-class TTFT/TPOT SLO "
+                         "attainment")
+    ap.add_argument("--preempt", default="on",
+                    help="comma-separated preemption arms for classed "
+                         "router sweeps: 'on', 'off', or 'on,off' for "
+                         "the paired comparison at equal load")
+    ap.add_argument("--class-pattern", default="bernoulli",
+                    choices=["bernoulli", "batch-first"],
+                    help="how classes map onto arrival order (bernoulli "
+                         "= interleaved mixed traffic; batch-first = the "
+                         "deterministic preemption fixture)")
+    ap.add_argument("--interactive-new-tokens", type=int, default=0,
+                    help="router arms: token budget for INTERACTIVE "
+                         "requests (0 = same as --new-tokens). Short "
+                         "interactive turns over long batch jobs is the "
+                         "workload shape chunk-boundary preemption "
+                         "exists for")
+    ap.add_argument("--slo-ttft-ms", type=float, default=250.0,
+                    help="TTFT target for per-class attainment")
+    ap.add_argument("--slo-tpot-ms", type=float, default=50.0,
+                    help="TPOT target for per-class attainment")
+    ap.add_argument("--max-queue", type=int, default=0,
+                    help="router arms: bounded per-replica queue depth "
+                         "(0 = unbounded) — the backpressure the router's "
+                         "spillover/rejection counters need to fire")
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=8)
@@ -423,6 +624,39 @@ def main():
     spec_ks = ([None if int(k) == 0 else int(k)
                 for k in args.spec_k.split(",")]
                if args.spec_k else [None])
+
+    if args.replicas:
+        # the scale-out sweep: replicas x overload x priority-mix x
+        # preempt arms, each a serving_router JSON line labeled off real
+        # routing/preemption counter deltas
+        if args.disagg or args.prefix_hit_rates or args.spec_k:
+            raise SystemExit(
+                "--replicas composes with --overload/--priority-mix, not "
+                "the --disagg/--prefix-hit-rates/--spec-k sweeps"
+            )
+        mixes = ([None if m.strip() == "off" else float(m)
+                  for m in args.priority_mix.split(",")]
+                 if args.priority_mix else [None])
+        preempts = [p.strip() == "on" for p in args.preempt.split(",")]
+        for rate in [float(r) for r in args.rates.split(",")]:
+            for n_slots in [int(s) for s in args.slots.split(",")]:
+                for chunk in chunks:
+                    for n_rep in [int(x)
+                                  for x in args.replicas.split(",")]:
+                        for overload in [float(x) for x
+                                         in args.overload.split(",")]:
+                            for mix in mixes:
+                                for pre in (preempts if mix is not None
+                                            else [False]):
+                                    arm = run_router_arm(
+                                        args, jax, args.stack, rate,
+                                        n_slots, chunk, n_rep, mix, pre,
+                                        overload,
+                                    )
+                                    if arm is not None:
+                                        print(json.dumps(arm), flush=True)
+        return
+
     for rate in [float(r) for r in args.rates.split(",")]:
         for n_slots in [int(s) for s in args.slots.split(",")]:
             for chunk in chunks:

@@ -47,6 +47,15 @@ engine's committed-token accounting (``uccl_serving_decode_tokens``)
 must be present and nonzero — i.e. speculation really ran, really
 accepted drafts, and throughput derives from committed tokens rather
 than an assumed one token per step.
+
+``--router`` mode (the replica-router smoke arm, serve --server
+--replicas N --priority-classes ... --metrics-out): the metrics file
+must carry ≥2 replica-labeled ``serving_router_requests_total`` series
+with every replica nonzero (the router really spread admissions), ≥1
+counted ``serving_preempted_total`` with resumes == preemptions (every
+paused request came back), and per-class SLO percentile series
+(``uccl_serving_class_ttft_ms{cls="interactive"...}`` + batch) — i.e.
+routing, preemption and the per-class surfaces all demonstrably fired.
 """
 
 from __future__ import annotations
@@ -249,7 +258,50 @@ def check_spec_metrics(path: str) -> None:
           f"all present")
 
 
+def check_router_metrics(path: str) -> None:
+    with open(path) as f:
+        lines = f.read().splitlines()
+
+    def total(prefix: str) -> float:
+        return _prom_total(lines, prefix, path)
+
+    routed = {}
+    for ln in lines:
+        if ln.startswith("serving_router_requests_total{"):
+            label = ln[ln.index("{") + 1:ln.index("}")]
+            routed[label] = float(ln.rsplit(" ", 1)[1])
+    if len(routed) < 2:
+        fail(f"{path}: {len(routed)} replica-labeled "
+             f"serving_router_requests_total series — a replica set "
+             f"never routed (labels: {sorted(routed)})")
+    dead = [lab for lab, v in routed.items() if v <= 0]
+    if dead:
+        fail(f"{path}: replica series with zero admissions: {dead} — "
+             f"the router never spread load there")
+    preempted = total("serving_preempted_total")
+    if preempted < 1:
+        fail(f"{path}: zero serving_preempted_total — no interactive "
+             f"arrival ever paused batch work (the smoke arm must force "
+             f">= 1 preemption)")
+    resumed = total("serving_resumed_total")
+    if resumed != preempted:
+        fail(f"{path}: resumes ({int(resumed)}) != preemptions "
+             f"({int(preempted)}) — a paused request never came back")
+    for cls in ("interactive", "batch"):
+        prefix = f'uccl_serving_class_ttft_ms{{cls="{cls}"'
+        if not any(ln.startswith(prefix) for ln in lines):
+            fail(f"{path}: missing per-class TTFT percentile series for "
+                 f"{cls!r} — SLO attainment has nothing to read")
+    print(f"check_obs: router metrics OK — {len(routed)} replicas "
+          f"routed, {int(preempted)} preemption(s) all resumed, "
+          f"per-class percentile series present")
+
+
 def main(argv) -> None:
+    if len(argv) == 3 and argv[1] == "--router":
+        check_router_metrics(argv[2])
+        print("check_obs: ALL OK")
+        return
     if len(argv) == 3 and argv[1] == "--spec":
         check_spec_metrics(argv[2])
         print("check_obs: ALL OK")
@@ -271,7 +323,8 @@ def main(argv) -> None:
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
              "check_obs.py --plan METRICS_PROM BENCH_JSON | "
              "check_obs.py --disagg METRICS_PROM | "
-             "check_obs.py --spec METRICS_PROM")
+             "check_obs.py --spec METRICS_PROM | "
+             "check_obs.py --router METRICS_PROM")
     check_trace(argv[1])
     check_metrics(argv[2])
     print("check_obs: ALL OK")

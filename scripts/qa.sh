@@ -40,6 +40,13 @@ JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --sl
   --check-oracle --metrics-out /tmp/qa_spec_metrics.prom; check $?
 python scripts/check_obs.py --spec /tmp/qa_spec_metrics.prom; check $?
 
+note "replica router + preemption smoke tier (2 replicas, 2 SLO classes, batch-first overload: oracle-exact, >=1 preemption counted, routing + per-class series validated)"
+JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --stack dense --slots 2 \
+  --replicas 2 --priority-classes --class-pattern batch-first --prefill-chunk 4 \
+  --requests 12 --prompt-len 12 --new-tokens 24 --arrival-rate 100 --check-oracle \
+  --metrics-out /tmp/qa_router_metrics.prom; check $?
+python scripts/check_obs.py --router /tmp/qa_router_metrics.prom; check $?
+
 note "disagg serving smoke tier (prefill+decode worker pair over p2p: chunk-streamed KV, >=1 prefix-cache hit, oracle-exact, telemetry validated)"
 UCCL_TPU_EXAMPLE_CPU=1 JAX_PLATFORMS=cpu timeout 600 python examples/disagg_kv.py --cpu \
   --metrics-out /tmp/qa_disagg_metrics.prom; check $?

@@ -516,6 +516,69 @@ class TestDisaggFanIn:
 
 
 @pytest.mark.slow
+class TestDisaggBackpressure:
+    """Adoption backpressure over a real loopback pair (the PR 8 remnant
+    the router consumes): GRANT responses carry the decode side's
+    free-slot/queue-depth hints, the prefill worker surfaces them as
+    ``adoption_backpressure()``, and the router's signal reader sees a
+    saturated decode peer — then everything drains oracle-exact."""
+
+    def test_grant_hints_surface_saturation(self, dense_setup):
+        import time as _time
+
+        from uccl_tpu.serving import DenseBackend, replica_signals
+        from uccl_tpu.serving.disagg import make_local_pair, warm_pair
+
+        cfg, params, _ = dense_setup
+        pb = DenseBackend(params, cfg, n_slots=2, max_seq=MAX_SEQ)
+        db = DenseBackend(params, cfg, n_slots=1, max_seq=MAX_SEQ)
+        pe = ServingEngine(pb, prefill_chunk=4)
+        de = ServingEngine(db)
+        pw, dw = make_local_pair(pe, de)
+        warm_pair(pw, dw, prompt_len=8)
+        assert pw.adoption_backpressure() == 0
+
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, 8).astype(np.int32)
+                   for _ in range(3)]
+        for p in prompts:
+            assert pw.submit(p, max_new_tokens=4) is not None
+        # drive to completion, watching the pressure surfaces as streams
+        # contend for the single decode slot (the first GRANT can land
+        # before the later BEGIN notifs drain, so saturation shows up on
+        # the running maxima, not necessarily the first hint)
+        deadline = _time.monotonic() + 120.0
+        finished = []
+        max_bp = seen_queued = sig_bp = 0
+        while len(finished) < 3:
+            pw.step()
+            finished.extend(dw.step())
+            bp = pw.adoption_backpressure()
+            if bp > max_bp:
+                max_bp = bp
+                # the router reads the same number via its signal surface
+                sig_bp = replica_signals(pw)["backpressure"]
+            if pw.decode_hint is not None:
+                # every grant empties the 1-slot pool
+                assert pw.decode_hint["free"] == 0
+                seen_queued = max(seen_queued, pw.decode_hint["queued"])
+            if _time.monotonic() > deadline:
+                raise TimeoutError(f"stalled at {len(finished)}/3")
+        assert max_bp >= 1, "three streams never pressured one decode slot"
+        assert seen_queued >= 1, "no GRANT ever reported a waiting BEGIN"
+        assert sig_bp >= 1
+        pw.drain()
+        assert pw.adoption_backpressure() == 0  # pressure cleared
+        for r in finished:
+            assert r.adopted
+            assert r.out_tokens == _oracle(params, cfg, r), r.rid
+        assert pe.pool.leaked() == 0 and de.pool.leaked() == 0
+        pw.close()
+        pw.ep.close()
+        dw.ep.close()
+
+
+@pytest.mark.slow
 class TestMoEHitExact:
     def test_moe_prefix_hit_bit_exact(self, devices):
         """Prefix-cache hits on the EP-sharded MoE stack: the grid-mapped

@@ -1,0 +1,471 @@
+"""Replica router + SLO priority scheduling: host-only property tests.
+
+Everything here runs on stub backends (no jax compiles — tier-1 budget):
+
+* **PriorityScheduler** — class-ordered admission (interactive before
+  batch, FIFO within class), requeue-at-head for preemption victims.
+* **Queue aging + cancellation** — deadline-expired and cancelled queued
+  requests leave as ``RequestState.EXPIRED``, counted on
+  ``serving_rejected_total{reason=...}``, with conservation intact.
+* **Preemption scheduling** — an interactive arrival pauses the newest
+  batch request at a chunk boundary (KV exported, slot freed, victim
+  requeued at its class head) and the victim resumes with its KV imported
+  into whatever slot frees up; the save/restore call pairing is asserted
+  on the stub. Oracle bit-exactness of preempted runs lives in
+  tests/test_serving.py (real models).
+* **Router** — least-loaded admission off live signals (the invariant:
+  a strictly less-loaded replica always wins), spillover on a bounded-
+  queue race, counted router-level rejection when every replica is
+  saturated, backpressure steering, and conservation: every submitted
+  request finishes, rejects, or expires exactly once across the set.
+"""
+
+import numpy as np
+import pytest
+
+from uccl_tpu import obs
+from uccl_tpu.serving import (
+    PriorityScheduler, RequestState, Router, ServingEngine, SlotPool,
+    replica_signals,
+)
+from uccl_tpu.serving.request import Request, now
+
+
+def _req(rid, n=2, priority="interactive", deadline_ms=None):
+    r = Request(rid=rid, prompt=np.arange(n, dtype=np.int32),
+                max_new_tokens=2, t_submit=now(), priority=priority,
+                deadline_ms=deadline_ms)
+    return r
+
+
+class _ChunkStub:
+    """Chunk-aware stub backend recording every call, including the
+    preemption KV save/restore pair. Prefill emits 100, the i-th decode
+    step emits i."""
+
+    def __init__(self, n_slots=2, max_seq=64):
+        self.n_slots = n_slots
+        self.max_seq = max_seq
+        self.n_decodes = 0
+        self.calls = []
+
+    def prefill(self, tokens, lens, mask, start=None):
+        if start is None:
+            start = np.zeros(self.n_slots, np.int32)
+        slots = tuple(int(s) for s in np.flatnonzero(mask))
+        self.calls.append(
+            ("prefill", slots, tuple(int(start[s]) for s in slots))
+        )
+        return np.full(self.n_slots, 100, np.int32)
+
+    def decode(self, tokens, active):
+        self.n_decodes += 1
+        self.calls.append(
+            ("decode", tuple(int(s) for s in np.flatnonzero(active)))
+        )
+        return np.full(self.n_slots, self.n_decodes, np.int32)
+
+    def export_slot_kv(self, slot, lo, hi):
+        self.calls.append(("export", slot, lo, hi))
+        z = np.zeros((1, hi - lo, 1, 1), np.float32)
+        return z, z
+
+    def import_slot_kv(self, slot, k_rows, v_rows, *, length):
+        self.calls.append(("import", slot, length))
+
+
+class TestPriorityScheduler:
+    def test_class_order_beats_arrival_order(self):
+        sched = PriorityScheduler()
+        pool = SlotPool(4)
+        b = _req(0, priority="batch")
+        i1 = _req(1, priority="interactive")
+        b2 = _req(2, priority="batch")
+        i2 = _req(3, priority="interactive")
+        for r in (b, i1, b2, i2):
+            assert sched.submit(r)
+        admitted = [r.rid for _, r in sched.admit(pool)]
+        assert admitted == [1, 3, 0, 2], (
+            "interactive must drain before batch, FIFO within class"
+        )
+
+    def test_requeue_goes_to_class_head(self):
+        sched = PriorityScheduler()
+        b1, b2 = _req(0, priority="batch"), _req(1, priority="batch")
+        sched.submit(b1)
+        sched.submit(b2)
+        victim = _req(9, priority="batch")
+        victim.state = RequestState.PREEMPTED
+        sched.requeue(victim)
+        assert [r.rid for r in sched.queued_requests()] == [9, 0, 1]
+
+    def test_unknown_class_rejected(self):
+        sched = PriorityScheduler()
+        with pytest.raises(ValueError, match="unknown priority"):
+            sched.submit(_req(0, priority="realtime"))
+        eng = ServingEngine(_ChunkStub(), priority_classes=True,
+                            prefill_chunk=4)
+        with pytest.raises(ValueError, match="unknown priority"):
+            eng.submit([1, 2], priority="realtime")
+
+    def test_shared_bound_covers_both_classes(self):
+        sched = PriorityScheduler(max_queue=2)
+        assert sched.submit(_req(0, priority="batch"))
+        assert sched.submit(_req(1, priority="interactive"))
+        r = _req(2, priority="interactive")
+        assert not sched.submit(r)
+        assert r.state is RequestState.REJECTED
+
+    def test_engine_flag_validation(self):
+        with pytest.raises(ValueError, match="requires priority_classes"):
+            ServingEngine(_ChunkStub(), prefill_chunk=4, preempt=True)
+        with pytest.raises(ValueError, match="requires prefill_chunk"):
+            ServingEngine(_ChunkStub(), priority_classes=True,
+                          preempt=True)
+
+
+class TestAgingAndCancel:
+    def test_deadline_expires_queued_request(self):
+        import time
+
+        eng = ServingEngine(_ChunkStub(n_slots=1), prefill_chunk=4)
+        hog = eng.submit([1, 2], max_new_tokens=20)
+        doomed = eng.submit([1, 2], max_new_tokens=2, deadline_ms=1.0)
+        c0 = obs.counter("serving_rejected_total").get(reason="deadline")
+        eng.step()
+        time.sleep(0.005)
+        eng.step()
+        assert doomed.state is RequestState.EXPIRED
+        assert doomed.finish_reason == "deadline"
+        assert doomed.is_done()
+        assert obs.counter("serving_rejected_total").get(
+            reason="deadline") == c0 + 1
+        eng.drain()
+        assert hog.state is RequestState.FINISHED
+        s = eng.snapshot()
+        assert s["expired"] == 1
+        assert (s["submitted"] == s["completed"] + s["active"]
+                + s["queued"] + s["rejected"] + s["expired"])
+        assert eng.pool.leaked() == 0
+
+    def test_deadline_survives_fast_admission(self):
+        eng = ServingEngine(_ChunkStub(n_slots=2), prefill_chunk=4)
+        r = eng.submit([1, 2], max_new_tokens=2, deadline_ms=60000.0)
+        eng.drain()
+        assert r.state is RequestState.FINISHED  # admitted well in time
+
+    def test_cancel_queued_only(self):
+        eng = ServingEngine(_ChunkStub(n_slots=1), prefill_chunk=4)
+        a = eng.submit([1, 2], max_new_tokens=4)
+        b = eng.submit([1, 2], max_new_tokens=4)
+        eng.step()  # a admitted, b queued
+        c0 = obs.counter("serving_rejected_total").get(reason="cancel")
+        assert not eng.cancel(a.rid), "in-slot requests run to completion"
+        assert eng.cancel(b.rid)
+        assert not eng.cancel(b.rid), "second cancel is a no-op"
+        assert b.state is RequestState.EXPIRED
+        assert b.finish_reason == "cancel"
+        assert obs.counter("serving_rejected_total").get(
+            reason="cancel") == c0 + 1
+        eng.drain()
+        s = eng.snapshot()
+        assert s["expired"] == 1 and s["completed"] == 1
+        assert (s["submitted"] == s["completed"] + s["rejected"]
+                + s["expired"])
+
+    def test_submit_validation(self):
+        eng = ServingEngine(_ChunkStub(), prefill_chunk=4)
+        with pytest.raises(ValueError, match="deadline_ms"):
+            eng.submit([1], deadline_ms=0)
+
+
+class TestPreemptionScheduling:
+    def _engine(self, n_slots=2):
+        return ServingEngine(_ChunkStub(n_slots=n_slots), prefill_chunk=4,
+                             priority_classes=True, preempt=True)
+
+    def test_interactive_preempts_newest_batch(self):
+        eng = self._engine()
+        b1 = eng.submit(list(range(8)), max_new_tokens=6, priority="batch")
+        b2 = eng.submit(list(range(8)), max_new_tokens=6, priority="batch")
+        eng.step()
+        eng.step()  # both finished prefill, decoding
+        p0 = obs.counter("serving_preempted_total").get()
+        ia = eng.submit([1, 2, 3], max_new_tokens=2,
+                        priority="interactive")
+        done = eng.step()  # ia may finish inside the preempting step
+        # newest-first: b2 (higher admit_seq) pauses, b1 keeps decoding
+        assert b2.state is RequestState.PREEMPTED
+        assert b1.state is RequestState.ACTIVE
+        assert b2.slot is None and b2.preemptions == 1
+        assert obs.counter("serving_preempted_total").get() == p0 + 1
+        # save happened: KV exported for the victim's live rows
+        kinds = [c[0] for c in eng.backend.calls]
+        assert "export" in kinds
+        r0 = obs.counter("serving_resumed_total").get()
+        done += eng.drain()
+        assert {r.rid for r in done} == {b1.rid, b2.rid, ia.rid}
+        assert all(r.state is RequestState.FINISHED
+                   for r in (b1, b2, ia))
+        # restore happened exactly once, stamping back the victim's saved
+        # live length (prompt + tokens committed before the pause, minus
+        # the first token which writes no KV row)
+        imports = [c for c in eng.backend.calls if c[0] == "import"]
+        assert len(imports) == 1
+        assert imports[0][2] == b2.prompt.size + 1  # 2 tokens at preempt
+        assert obs.counter("serving_resumed_total").get() == r0 + 1
+        s = eng.snapshot()
+        assert s["preempted"] == 1 and s["resumed"] == 1
+        assert eng.pool.leaked() == 0
+
+    def test_batch_head_never_preempts(self):
+        eng = self._engine()
+        b1 = eng.submit(list(range(8)), max_new_tokens=8, priority="batch")
+        b2 = eng.submit(list(range(8)), max_new_tokens=8, priority="batch")
+        eng.step()
+        b3 = eng.submit([1, 2], max_new_tokens=2, priority="batch")
+        eng.step()
+        eng.step()
+        assert b3.state is RequestState.QUEUED, (
+            "a batch arrival must wait for a free slot, never preempt"
+        )
+        assert b1.state is not RequestState.PREEMPTED
+        assert b2.state is not RequestState.PREEMPTED
+        eng.drain()
+        assert eng.pool.leaked() == 0
+
+    def test_no_batch_victim_means_waiting(self):
+        eng = self._engine()
+        i1 = eng.submit(list(range(8)), max_new_tokens=8,
+                        priority="interactive")
+        i2 = eng.submit(list(range(8)), max_new_tokens=8,
+                        priority="interactive")
+        eng.step()
+        i3 = eng.submit([1, 2], max_new_tokens=2, priority="interactive")
+        eng.step()
+        assert i3.state is RequestState.QUEUED, (
+            "interactive never preempts interactive"
+        )
+        eng.drain()
+        assert all(r.state is RequestState.FINISHED for r in (i1, i2, i3))
+
+    def test_mid_prefill_victim_resumes_at_cursor(self):
+        eng = self._engine(n_slots=1)
+        bb = eng.submit(list(range(12)), max_new_tokens=2,
+                        priority="batch")
+        eng.step()  # one 4-token chunk in
+        assert bb.prefill_pos == 4
+        ia = eng.submit([1, 2], max_new_tokens=2, priority="interactive")
+        eng.step()
+        assert bb.state is RequestState.PREEMPTED
+        assert bb.prefill_pos == 4, "the cursor is the saved state"
+        eng.drain()
+        assert bb.state is RequestState.FINISHED
+        # the resumed prefill continued at start=4 — never re-ran chunk 0
+        starts = [c[2] for c in eng.backend.calls if c[0] == "prefill"]
+        resumed_starts = [s for st in starts for s in st]
+        assert resumed_starts.count(0) == 2  # bb chunk 0 + ia chunk 0
+        assert 4 in resumed_starts and 8 in resumed_starts
+        assert eng.pool.leaked() == 0
+
+    def test_victim_requeues_ahead_of_batch_arrivals(self):
+        eng = self._engine()
+        b1 = eng.submit(list(range(8)), max_new_tokens=6, priority="batch")
+        b2 = eng.submit(list(range(8)), max_new_tokens=6, priority="batch")
+        eng.step()
+        eng.step()
+        later = eng.submit([1, 2], max_new_tokens=2, priority="batch")
+        ia = eng.submit([1, 2, 3], max_new_tokens=2,
+                        priority="interactive")
+        eng.step()  # preempts b2; batch queue: [b2(head), later]
+        assert b2.state is RequestState.PREEMPTED
+        eng.drain()
+        # resume order: b2 re-admitted BEFORE `later` was first admitted
+        # (admit_seq is re-stamped at the resume admission)
+        assert b2.admit_seq < later.admit_seq
+        assert b2.state is RequestState.FINISHED
+        assert later.state is RequestState.FINISHED
+
+
+class TestRouter:
+    def _mk(self, n=2, n_slots=2, max_queue=None, **kw):
+        return [ServingEngine(_ChunkStub(n_slots=n_slots),
+                              prefill_chunk=4, max_queue=max_queue, **kw)
+                for _ in range(n)]
+
+    def test_least_loaded_invariant(self):
+        """THE routing property: a strictly less-loaded replica always
+        receives the next request, wherever it sits in the list."""
+        for busy_idx in (0, 1, 2):
+            engines = self._mk(3)
+            r = Router(engines)
+            # skew: load one replica with queued+active work
+            for _ in range(4):
+                engines[busy_idx].submit(list(range(8)),
+                                         max_new_tokens=8)
+            req = r.submit([1, 2], max_new_tokens=2)
+            chosen = [i for i, e in enumerate(engines)
+                      if any(q is req
+                             for q in e.sched.queued_requests())]
+            assert chosen and chosen[0] != busy_idx, (
+                f"routed to the loaded replica {busy_idx}"
+            )
+            r.drain()
+            assert r.leaked() == 0
+
+    def test_signals_expose_the_decision_inputs(self):
+        engines = self._mk(2)
+        engines[0].submit(list(range(8)), max_new_tokens=8)
+        s0 = replica_signals(engines[0])
+        s1 = replica_signals(engines[1])
+        assert s0["debt_tokens"] == 16 and s1["debt_tokens"] == 0
+        assert s0["queued"] == 1 and s1["queued"] == 0
+        assert s1["free_slots"] == 2
+        Router(engines).drain()
+
+    def test_conservation_across_replicas(self):
+        """Every submitted request finishes, rejects, or expires exactly
+        once across the replica set — the router never loses or
+        double-runs one."""
+        engines = self._mk(3, n_slots=2, max_queue=2)
+        r = Router(engines)
+        results = [r.submit([1, 2, 3], max_new_tokens=3)
+                   for _ in range(24)]
+        accepted = [q for q in results if q is not None]
+        rejected = 24 - len(accepted)
+        finished = r.drain()
+        assert len(finished) == len(accepted)
+        # exactly-once: the finished set IS the accepted set, no dupes
+        # (rids repeat across replicas — identity is the honest key)
+        assert {id(q) for q in finished} == {id(q) for q in accepted}
+        assert rejected >= 0  # bound 2×3 queues + 6 slots < 24 offered
+        snap = r.snapshot()
+        assert snap["completed"] == len(accepted)
+        assert (snap["submitted"] == snap["completed"] + snap["active"]
+                + snap["queued"] + snap["rejected"] + snap["expired"])
+        assert r.leaked() == 0
+        assert sum(snap["routed"]) == len(accepted)
+        assert all(s.state is RequestState.FINISHED for s in accepted)
+
+    def test_round_robin_when_equal(self):
+        engines = self._mk(3)
+        r = Router(engines)
+        for _ in range(6):
+            r.submit([1, 2], max_new_tokens=2)
+        assert r.routed == [2, 2, 2], (
+            "equal replicas must take turns, not pile on replica 0"
+        )
+        r.drain()
+
+    def test_spillover_when_choice_rejects(self):
+        """The bounded-queue race: the least-loaded replica can reject
+        between the signal read and the submit — the router spills to the
+        next-ranked one and counts it."""
+        engines = self._mk(2, n_slots=1)
+        # replica 0: lightly loaded but saturated — full pool, and the
+        # queue bound closes AFTER the hog is in its slot (max_queue=0
+        # rejects at submit, the documented backpressure edge)
+        hog = engines[0].submit(list(range(4)), max_new_tokens=8)
+        engines[0].step()  # hog admitted: pool full
+        engines[0].sched.max_queue = 0
+        assert engines[0].sched.qsize == 0
+        # replica 1: MORE debt so replica 0 ranks first, but queue room
+        engines[1].submit(list(range(8)), max_new_tokens=8)
+        engines[1].submit(list(range(8)), max_new_tokens=8)
+        engines[1].step()
+        s0 = obs.counter("serving_router_spillover_total").get()
+        r = Router(engines)
+        assert replica_signals(engines[0])["debt_tokens"] < \
+            replica_signals(engines[1])["debt_tokens"]
+        req = r.submit([1, 2], max_new_tokens=2)
+        assert req is not None
+        assert obs.counter("serving_router_spillover_total").get() == s0 + 1
+        r.drain()
+        assert r.leaked() == 0
+
+    def test_all_saturated_counts_router_rejection(self):
+        engines = self._mk(2, n_slots=1)
+        r = Router(engines)
+        for e in engines:
+            e.submit(list(range(4)), max_new_tokens=4)
+            e.step()
+            e.sched.max_queue = 0  # pool full + no queue room = saturated
+        c0 = obs.counter("serving_router_rejected_total").get(
+            reason="saturated")
+        assert r.submit([1, 2], max_new_tokens=2) is None
+        assert obs.counter("serving_router_rejected_total").get(
+            reason="saturated") == c0 + 1
+        r.drain()
+
+    def test_routed_counter_labels_per_replica(self):
+        engines = self._mk(2)
+        c = obs.counter("serving_router_requests_total")
+        before = [c.get(replica="0"), c.get(replica="1")]
+        r = Router(engines)
+        for _ in range(4):
+            r.submit([1, 2], max_new_tokens=2)
+        deltas = [c.get(replica="0") - before[0],
+                  c.get(replica="1") - before[1]]
+        assert deltas == r.routed == [2, 2]
+        r.drain()
+
+    def test_backpressure_steers_away(self):
+        """A disagg-style replica reporting adoption backpressure loses to
+        an equal-debt replica without it (the GRANT-hint signal)."""
+
+        class _Worker:
+            """Replica wrapper shaped like disagg.PrefillWorker."""
+
+            def __init__(self, engine, bp):
+                self.engine = engine
+                self._bp = bp
+
+            def adoption_backpressure(self):
+                return self._bp
+
+            def submit(self, prompt, *, max_new_tokens=16, eos_id=None,
+                       priority="interactive"):
+                return self.engine.submit(prompt,
+                                          max_new_tokens=max_new_tokens,
+                                          eos_id=eos_id,
+                                          priority=priority)
+
+            def step(self):
+                if self.engine.has_work():
+                    self.engine.step()
+
+            def idle(self):
+                return not self.engine.has_work()
+
+        engines = self._mk(2)
+        saturated = _Worker(engines[0], bp=3)
+        free = _Worker(engines[1], bp=0)
+        r = Router([saturated, free])
+        assert replica_signals(saturated)["backpressure"] == 3
+        req = r.submit([1, 2], max_new_tokens=2)
+        assert any(q.rid == req.rid
+                   for q in engines[1].sched.queued_requests()), (
+            "new prompts must steer away from the saturated decode peer"
+        )
+        r.drain()
+        assert r.leaked() == 0
+
+    def test_priority_and_deadline_ride_through(self):
+        engines = self._mk(2, priority_classes=True)
+        r = Router(engines)
+        req = r.submit([1, 2], max_new_tokens=2, priority="batch",
+                       deadline_ms=60000.0)
+        assert req.priority == "batch" and req.deadline_ms == 60000.0
+        r.drain()
+
+    def test_merged_snapshot_percentiles(self):
+        engines = self._mk(2)
+        r = Router(engines)
+        for _ in range(6):
+            r.submit([1, 2], max_new_tokens=3)
+        r.drain()
+        snap = r.snapshot()
+        assert snap["completed"] == 6
+        assert "p50" in snap["ttft_ms"]
+        assert len(snap["per_replica"]) == 2
+        assert sum(p["completed"] for p in snap["per_replica"]) == 6

@@ -627,6 +627,135 @@ class TestDenseSpecOracle:
         assert eng.pool.leaked() == 0
 
 
+class TestDensePreemptionOracle:
+    """Chunk-boundary preemption stays bit-exact on the dense stack: a
+    paused victim's KV rows round-trip through the host save/restore (raw
+    f32 — the PR 8 slot-row views), its cursor resumes via the PR 4 start
+    offset, and every output — victim, survivor, and the interactive
+    arrival that caused the pause — equals the one-shot oracle. Chunk 3
+    and the [2, 3] verify window are compile-cache hits from the chunked
+    and spec suites; the only new programs are the slot-row export/import
+    jits (one each per pool shape)."""
+
+    def _engine(self, backend, **kw):
+        return ServingEngine(backend, prefill_chunk=3,
+                             priority_classes=True, preempt=True, **kw)
+
+    def _check(self, params, cfg, reqs):
+        oracle = TestDenseOracle()
+        for r in reqs:
+            assert r.n_generated == r.max_new_tokens
+            assert r.out_tokens == oracle._oracle(params, cfg, r), r.rid
+
+    def test_preempt_mid_decode_exact(self, dense_setup):
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(0)
+        eng = self._engine(backend)
+        b1 = eng.submit(_prompt(rng, 5), max_new_tokens=6,
+                        priority="batch")
+        b2 = eng.submit(_prompt(rng, 3), max_new_tokens=6,
+                        priority="batch")
+        for _ in range(4):
+            eng.step()  # both past prefill, mid-decode
+        assert b1.state is RequestState.ACTIVE
+        assert b2.state is RequestState.ACTIVE
+        ia = eng.submit(_prompt(rng, 6), max_new_tokens=3,
+                        priority="interactive")
+        eng.step()
+        assert b2.state is RequestState.PREEMPTED, (
+            "newest batch request must pause for the interactive arrival"
+        )
+        assert b2.n_generated >= 1  # really paused MID-decode
+        eng.drain()
+        assert b2.preemptions == 1
+        self._check(params, cfg, [b1, b2, ia])
+        assert eng.pool.leaked() == 0
+        assert eng.metrics.preempted == 1 and eng.metrics.resumed == 1
+
+    def test_preempt_mid_prefill_exact(self, dense_setup):
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(1)
+        eng = self._engine(backend)
+        bb = eng.submit(_prompt(rng, 8), max_new_tokens=5,
+                        priority="batch")
+        other = eng.submit(_prompt(rng, 2), max_new_tokens=6,
+                           priority="batch")
+        eng.step()  # bb one 3-token chunk in, other already decoding
+        assert bb.state is RequestState.PARTIAL_PREFILL
+        assert bb.prefill_pos == 3
+        i1 = eng.submit(_prompt(rng, 6), max_new_tokens=3,
+                        priority="interactive")
+        i2 = eng.submit(_prompt(rng, 7), max_new_tokens=5,
+                        priority="interactive")
+        eng.step()  # preempts bb (newest), i1 takes its slot
+        assert bb.state is RequestState.PREEMPTED
+        assert bb.prefill_pos == 3, "the cursor is the saved state"
+        eng.drain()
+        assert bb.preemptions >= 1
+        self._check(params, cfg, [bb, other, i1, i2])
+        assert eng.pool.leaked() == 0
+
+    def test_preempt_spec_victim_exact(self, dense_setup):
+        """Preemption × speculative decoding: the victim pauses between
+        verify windows (its cursor already advanced by multi-token
+        commits) and resumes speculating — still bit-exact."""
+        from uccl_tpu.serving import NGramDrafter
+
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(0)
+        eng = self._engine(backend, spec_k=2, drafter=NGramDrafter())
+        b1 = eng.submit(_prompt(rng, 5), max_new_tokens=6,
+                        priority="batch")
+        b2 = eng.submit(_prompt(rng, 3), max_new_tokens=6,
+                        priority="batch")
+        for _ in range(3):
+            eng.step()
+        ia = eng.submit(_prompt(rng, 6), max_new_tokens=3,
+                        priority="interactive")
+        eng.step()
+        assert RequestState.PREEMPTED in (b1.state, b2.state)
+        eng.drain()
+        assert eng.metrics.preempted >= 1
+        self._check(params, cfg, [b1, b2, ia])
+        assert eng.pool.leaked() == 0
+
+    def test_preempt_prefix_cache_hit_victim_exact(self, dense_setup):
+        """Preemption × prefix cache: the victim resumed prefill from a
+        cached prefix (its KV partly COPIED, not computed), then got
+        preempted and resumed again — the save/restore must carry the
+        copied rows bit-exactly too. Chunk 4 matches the prefix-cache
+        suite's compiled programs."""
+        from uccl_tpu.serving import PrefixCache
+
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(3)
+        eng = ServingEngine(backend, prefill_chunk=4,
+                            prefix_cache=PrefixCache(4),
+                            priority_classes=True, preempt=True)
+        p0 = rng.integers(0, 64, 12).astype(np.int32)
+        donor = eng.submit(p0, max_new_tokens=4, priority="batch")
+        eng.drain()  # donor parks as a reuse donor
+        sharer = np.concatenate(
+            [p0[:8], rng.integers(0, 64, 8).astype(np.int32)]
+        )
+        hit = eng.submit(sharer, max_new_tokens=4, priority="batch")
+        eng.step()  # hit copies [0, 8) and prefills [8, 12) — mid-prefill
+        assert hit.cache_hit_len == 8
+        assert hit.state is RequestState.PARTIAL_PREFILL
+        # two interactive arrivals: the first evicts the parked donor for
+        # its slot, the second must preempt the mid-prefill hit victim
+        i1 = eng.submit(_prompt(rng, 6), max_new_tokens=3,
+                        priority="interactive")
+        i2 = eng.submit(_prompt(rng, 7), max_new_tokens=3,
+                        priority="interactive")
+        eng.step()
+        assert hit.state is RequestState.PREEMPTED
+        eng.drain()
+        assert hit.preemptions >= 1 and hit.cache_hit_len == 8
+        self._check(params, cfg, [donor, hit, i1, i2])
+        assert eng.pool.leaked() == 0
+
+
 @pytest.fixture(scope="module")
 def moe_setup(devices):
     """ONE 2-shard server/backend + ONE world-1 oracle server for every MoE
@@ -722,6 +851,54 @@ class TestMoEOracle:
         assert eng.metrics.spec_accepted > 0
         assert eng.metrics.decode_tokens > eng.metrics.decode_calls
         self._check(reqs, srv1, p1)
+
+    def test_preemption_exact(self, moe_setup):
+        """Chunk-boundary preemption on the EP-sharded MoE stack: the
+        victim's KV rows round-trip through the MoESlotCache numpy
+        mirrors (mid-prefill AND mid-decode victims across the two
+        arrivals), and every output still bit-equals the world-1 oracle.
+        Same (len, N) pairs as above — oracle + chunk programs are cache
+        hits; export/import are host-side numpy, no new compiles."""
+        backend, srv1, p1 = moe_setup
+        eng = ServingEngine(backend, prefill_chunk=3,
+                            priority_classes=True, preempt=True)
+        rng = np.random.default_rng(0)
+        b1 = eng.submit(_prompt(rng, 5), max_new_tokens=4,
+                        priority="batch")
+        b2 = eng.submit(_prompt(rng, 6), max_new_tokens=4,
+                        priority="batch")
+        eng.step()  # both mid-prefill (one 3-token chunk in)
+        assert b2.state is RequestState.PARTIAL_PREFILL
+        i1 = eng.submit(_prompt(rng, 8), max_new_tokens=4,
+                        priority="interactive")
+        eng.step()  # preempts the newest batch request mid-prefill
+        assert b2.state is RequestState.PREEMPTED
+        assert 0 < b2.prefill_pos < b2.prompt.size
+        eng.drain()  # b2 resumes at its cursor and finishes
+        assert b2.preemptions == 1
+        # phase 2: a mid-DECODE victim (same shapes — cache-hit programs)
+        b3 = eng.submit(_prompt(rng, 5), max_new_tokens=4,
+                        priority="batch")
+        b4 = eng.submit(_prompt(rng, 6), max_new_tokens=4,
+                        priority="batch")
+        for _ in range(16):
+            if (b3.state is RequestState.ACTIVE
+                    and b4.state is RequestState.ACTIVE):
+                break
+            eng.step()
+        assert b4.state is RequestState.ACTIVE
+        i2 = eng.submit(_prompt(rng, 5), max_new_tokens=4,
+                        priority="interactive")
+        eng.step()
+        assert b4.state is RequestState.PREEMPTED, (
+            "the newest decoding batch request must pause"
+        )
+        assert b4.n_generated >= 1  # really paused MID-decode
+        eng.drain()
+        assert eng.metrics.preempted == 2
+        assert eng.metrics.resumed == eng.metrics.preempted
+        assert eng.pool.leaked() == 0
+        self._check([b1, b2, i1, i2, b3, b4], srv1, p1)
 
     def test_droppable_capacity_rejected(self, devices):
         """Slot serving's exactness needs a drop-free wire: a config whose

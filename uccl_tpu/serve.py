@@ -181,9 +181,12 @@ def _serve_continuous(args, saved_cfg):
 
     from uccl_tpu import obs
     from uccl_tpu.serving import (
-        DenseBackend, MoEBackend, ServingEngine, ServingMetrics,
+        DenseBackend, MoEBackend, Router, ServingEngine, ServingMetrics,
+        replicate_backend,
     )
-    from uccl_tpu.serving.loadgen import drive, synth_workload, warm_engine
+    from uccl_tpu.serving.loadgen import (
+        assign_classes, drive, synth_workload, warm_engine, warm_replicas,
+    )
 
     stack = args.stack
     if stack == "auto":
@@ -193,6 +196,10 @@ def _serve_continuous(args, saved_cfg):
         raise SystemExit(f"--slots must be >= 1, got {args.slots}")
     if args.spec_k < 0:
         raise SystemExit(f"--spec-k must be >= 0, got {args.spec_k}")
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
+    if not (0.0 <= args.interactive_frac <= 1.0):
+        raise SystemExit("--interactive-frac must be in [0, 1]")
     if args.step_tokens and not args.prefill_chunk:
         raise SystemExit("--step-tokens needs --prefill-chunk (the "
                          "whole-prompt path has no sub-step unit to budget)")
@@ -225,8 +232,10 @@ def _serve_continuous(args, saved_cfg):
             print(f"serving {args.ckpt_dir}/step_{step} (dense)", flush=True)
         else:
             params = init_params(jax.random.PRNGKey(args.seed), dcfg)
-        backend = DenseBackend(
-            params, dcfg, n_slots=args.slots, max_seq=max_seq
+        backends = replicate_backend(
+            DenseBackend(params, dcfg, n_slots=args.slots,
+                         max_seq=max_seq),
+            args.replicas,
         )
         vocab = dcfg.vocab
 
@@ -273,10 +282,11 @@ def _serve_continuous(args, saved_cfg):
             print(f"serving {args.ckpt_dir}/step_{step}", flush=True)
         else:
             params = init_params(jax.random.PRNGKey(args.seed), cfg)
-        backend = MoEBackend(
-            server, server.shard_params(params),
-            batch_local=args.slots // world, max_seq=max_seq,
-            decode_impl=impl,
+        backends = replicate_backend(
+            MoEBackend(server, server.shard_params(params),
+                       batch_local=args.slots // world, max_seq=max_seq,
+                       decode_impl=impl),
+            args.replicas,
         )
         vocab = cfg.vocab
 
@@ -298,12 +308,18 @@ def _serve_continuous(args, saved_cfg):
             )
             return np.asarray(toks)[0, 0, : req.n_generated]
 
-    engine = ServingEngine(
-        backend, max_queue=args.max_queue or None, register_stats=True,
+    # preemption rides the priority flag whenever the engine is chunked
+    # (chunk boundaries are what make pause/resume nearly free); a
+    # whole-prompt priority engine still class-orders its queue
+    preempt = bool(args.priority_classes and args.prefill_chunk)
+    engines = [ServingEngine(
+        b, max_queue=args.max_queue or None, register_stats=True,
         prefill_chunk=args.prefill_chunk or None,
         step_tokens=args.step_tokens or None,
         spec_k=args.spec_k or None,
-    )
+        priority_classes=args.priority_classes, preempt=preempt,
+    ) for b in backends]
+    target = engines[0] if args.replicas == 1 else Router(engines)
 
     # synthetic workload (mixed prompt lengths, Poisson arrivals), compile
     # warmup, and the wall-clock drive loop — shared with
@@ -312,7 +328,14 @@ def _serve_continuous(args, saved_cfg):
     prompts, lens, arrivals = synth_workload(
         rng, args.requests, args.prompt_len, vocab, args.arrival_rate
     )
-    warm_engine(engine, lens, max_seq, args.new_tokens)
+    # classes AFTER arrivals: the mix knob never perturbs arrival timing
+    priorities = (assign_classes(rng, args.requests, args.interactive_frac,
+                                 pattern=args.class_pattern)
+                  if args.priority_classes else None)
+    if args.replicas == 1:
+        warm_engine(target, lens, max_seq, args.new_tokens)
+    else:
+        warm_replicas(target, lens, max_seq, args.new_tokens)
     metrics_srv = None
     if args.metrics_port:
         # live /metrics (Prometheus text) + /snapshot (JSON) for the run's
@@ -321,19 +344,20 @@ def _serve_continuous(args, saved_cfg):
         metrics_srv = obs.MetricsServer(
             args.metrics_port,
             extra_lines_fn=lambda: ServingMetrics.prometheus_lines(
-                engine.snapshot()
+                target.snapshot()
             ),
         )
         print(f"metrics: http://127.0.0.1:{metrics_srv.port}/metrics "
               f"(+ /snapshot)", flush=True)
     try:
-        reqs, wall = drive(engine, prompts, arrivals, args.new_tokens)
+        reqs, wall = drive(target, prompts, arrivals, args.new_tokens,
+                           priorities=priorities)
     finally:
         if metrics_srv is not None:
             metrics_srv.close()
 
-    snap = engine.snapshot()
-    engine.close()
+    snap = target.snapshot()
+    target.close()
     written = obs.dump_from_args(
         args, extra_lines=ServingMetrics.prometheus_lines(snap)
     )
@@ -347,19 +371,27 @@ def _serve_continuous(args, saved_cfg):
         "prefill_chunk": args.prefill_chunk or None,
         "step_tokens": args.step_tokens or None,
         "spec_k": args.spec_k or None,
+        "replicas": args.replicas,
+        "priority_classes": bool(args.priority_classes),
+        "preempt": preempt,
+        "interactive_frac": (args.interactive_frac
+                             if args.priority_classes else None),
         "wall_s": round(wall, 3), **snap,
     }
     if reqs:
         print(f"first request: {reqs[0].out_tokens}", flush=True)
 
     if args.check_oracle:
-        leaked = engine.pool.leaked()
+        leaked = (target.leaked() if args.replicas > 1
+                  else target.pool.leaked())
+        qsize = (target.qsize if args.replicas > 1
+                 else target.sched.qsize)
         mismatched = []
         for r in reqs:
             want = oracle(r)
             if r.out_tokens != want.tolist():
                 mismatched.append((r.rid, r.out_tokens, want.tolist()))
-        ok = (not leaked and not mismatched and engine.sched.qsize == 0
+        ok = (not leaked and not mismatched and qsize == 0
               and snap["completed"] == len(reqs))
         summary["oracle_match"] = bool(ok)
         summary["leaked_slots"] = leaked
@@ -438,6 +470,30 @@ def main(argv=None):
                          "commits each slot's accepted prefix + 1 "
                          "target token (bit-identical to vanilla greedy "
                          "decode, docs/SERVING.md). 0 = off")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="server: engine replica count behind the "
+                         "least-loaded router (each replica owns a "
+                         "--slots KV pool; admission steers by live "
+                         "free-slot/token-debt/queue-wait signals, "
+                         "docs/SERVING.md)")
+    ap.add_argument("--priority-classes", action="store_true",
+                    help="server: SLO classes — each request is "
+                         "'interactive' (admits first; with "
+                         "--prefill-chunk it preempts running batch work "
+                         "at chunk boundaries, bit-exact resume) or "
+                         "'batch', drawn per request at "
+                         "--interactive-frac")
+    ap.add_argument("--interactive-frac", type=float, default=0.5,
+                    help="server: fraction of requests in the "
+                         "interactive class under --priority-classes")
+    ap.add_argument("--class-pattern", default="bernoulli",
+                    choices=["bernoulli", "batch-first"],
+                    help="server: how classes map onto the arrival "
+                         "order — 'bernoulli' interleaves (realistic "
+                         "mixed traffic), 'batch-first' front-loads all "
+                         "batch work so every interactive arrival finds "
+                         "the slots occupied (the deterministic "
+                         "preemption smoke fixture)")
     ap.add_argument("--check-oracle", action="store_true",
                     help="server: verify every completed request is "
                          "bit-identical to the one-shot generate oracle "

@@ -9,13 +9,17 @@ oracle.
 
 from uccl_tpu.serving.engine import (  # noqa: F401
     ChunkEvent, DenseBackend, MoEBackend, ServingEngine,
+    replicate_backend,
 )
 from uccl_tpu.serving.metrics import (  # noqa: F401
     ServingMetrics, percentile, percentiles_ms,
 )
 from uccl_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from uccl_tpu.serving.request import Request, RequestState  # noqa: F401
-from uccl_tpu.serving.scheduler import FIFOScheduler  # noqa: F401
+from uccl_tpu.serving.router import Router, replica_signals  # noqa: F401
+from uccl_tpu.serving.scheduler import (  # noqa: F401
+    PRIORITY_CLASSES, FIFOScheduler, PriorityScheduler,
+)
 from uccl_tpu.serving.slots import SlotPool  # noqa: F401
 from uccl_tpu.serving.spec import Drafter, NGramDrafter  # noqa: F401
 
@@ -25,6 +29,7 @@ from uccl_tpu.serving.spec import Drafter, NGramDrafter  # noqa: F401
 __all__ = [
     "ChunkEvent", "DenseBackend", "MoEBackend", "ServingEngine",
     "ServingMetrics", "percentile", "percentiles_ms", "PrefixCache",
-    "Request", "RequestState", "FIFOScheduler", "SlotPool",
-    "Drafter", "NGramDrafter",
+    "Request", "RequestState", "FIFOScheduler", "PriorityScheduler",
+    "PRIORITY_CLASSES", "Router", "replica_signals", "SlotPool",
+    "Drafter", "NGramDrafter", "replicate_backend",
 ]

@@ -193,13 +193,18 @@ class PrefillWorker:
 
     # -- submission ----------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: int = 16,
-               eos_id: Optional[int] = None) -> Optional[Request]:
+               eos_id: Optional[int] = None,
+               priority: str = "interactive") -> Optional[Request]:
         """Open a KV stream and queue the prompt on the prefill engine
         (``max_new_tokens=1`` locally — this fleet never decodes; the
         requested budget rides the BEGIN message to the decode side).
-        Returns the local Request, or None on queue backpressure."""
+        ``priority`` orders this fleet's own prefill queue (when its
+        engine runs priority classes) and rides BEGIN so the adopted
+        request keeps its class label decode-side. Returns the local
+        Request, or None on queue backpressure."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        req = self.engine.submit(prompt, max_new_tokens=1)
+        req = self.engine.submit(prompt, max_new_tokens=1,
+                                 priority=priority)
         if req is None:
             return None
         st = _TxStream(req.rid, prompt, max_new_tokens, eos_id,
@@ -208,6 +213,7 @@ class PrefillWorker:
         _send_msg(self.ep, self.conn, {
             "t": "begin", "rid": req.rid, "prompt": prompt.tolist(),
             "max_new_tokens": max_new_tokens, "eos_id": eos_id,
+            "priority": priority,
             "t_submit": st.t_submit_wall,
         })
         return req
@@ -263,6 +269,20 @@ class PrefillWorker:
             _STREAM_CHUNKS.inc(role="tx")
         st.slabs.clear()
 
+    def adoption_backpressure(self) -> int:
+        """Requests stuck waiting for decode-side capacity, as this worker
+        can best estimate it: streams whose BEGIN has no GRANT yet (local,
+        always current) vs the decode peer's own reported pending depth as
+        of the last GRANT (covers OTHER prefill workers sharing the peer
+        under fan-in) — the larger of the two, since each is a lower bound
+        on the same backlog. 0 means the peer grants as fast as we BEGIN —
+        the router's steering signal (uccl_tpu/serving/router.py)."""
+        ungranted = sum(1 for st in self._streams.values()
+                        if st.remote_slot is None)
+        hinted = (self.decode_hint["queued"]
+                  if self.decode_hint is not None else 0)
+        return max(ungranted, hinted)
+
     def pump(self) -> None:
         """Drain GRANTs, ship queued slabs, close finished streams (wait
         for every slab's completion, then send FINAL — writes and notifs
@@ -272,6 +292,9 @@ class PrefillWorker:
                 st = self._streams.get(msg["rid"])
                 if st is not None:
                     st.remote_slot = int(msg["slot"])
+                if "free" in msg:
+                    self.decode_hint = {"free": int(msg["free"]),
+                                        "queued": int(msg["queued"])}
         for st in self._streams.values():
             if st.remote_slot is not None and st.slabs:
                 self._ship(st)
@@ -391,8 +414,15 @@ class DecodeWorker:
             self._granted[(conn, int(msg["rid"]))] = {
                 "slot": slot, "msg": msg, "t_grant": time.time(),
             }
+            # capacity hints ride every GRANT (the adoption-backpressure
+            # feed, docs/SERVING.md): free decode slots AFTER this grant
+            # and the BEGINs still waiting for one — the prefill side
+            # surfaces them so a router steers new prompts away from a
+            # saturated decode peer
             _send_msg(self.ep, conn, {
                 "t": "grant", "rid": int(msg["rid"]), "slot": slot,
+                "free": self.engine.pool.n_free,
+                "queued": len(self._pending),
             })
 
     def _on_final(self, conn: int, final: Dict) -> None:
@@ -423,6 +453,7 @@ class DecodeWorker:
             int(final["first_token"]),
             max_new_tokens=int(begin["max_new_tokens"]),
             eos_id=begin["eos_id"], slot=slot,
+            priority=begin.get("priority", "interactive"),
             queue_s=t_admit - t_submit, prefill_s=t_done - t_admit,
             transfer_s=t_adopt - t_done,
         )
@@ -550,6 +581,9 @@ def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,
     pw._fifo_v = FifoItem.unpack(_unb64(hello["v_fifo"]))
     pw._streams = {}
     pw._timeout_ms = timeout_ms
+    # decode-peer capacity as of the last GRANT (free slots + pending
+    # BEGIN depth) — feeds adoption_backpressure() / the replica router
+    pw.decode_hint = None
     engine.chunk_sink = pw._on_chunks
 
 

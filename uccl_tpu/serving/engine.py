@@ -37,7 +37,9 @@ import numpy as np
 from uccl_tpu import obs
 from uccl_tpu.serving.metrics import ServingMetrics
 from uccl_tpu.serving.request import Request, RequestState, now
-from uccl_tpu.serving.scheduler import FIFOScheduler
+from uccl_tpu.serving.scheduler import (
+    PRIORITY_CLASSES, FIFOScheduler, PriorityScheduler,
+)
 from uccl_tpu.serving.slots import SlotPool
 from uccl_tpu.serving.spec import (
     SPEC_ACCEPTED_LEN as _SPEC_ACCEPTED_LEN,
@@ -63,6 +65,21 @@ _PREFILL_TOKENS = obs.counter(
     "serving_prefill_tokens_total",
     "prompt tokens per prefill path: kind=computed ran the model, "
     "kind=skipped were reused from the prefix cache (the auditable cut)",
+)
+_DROPPED = obs.counter(
+    "serving_rejected_total",
+    "queued requests dropped before admission: reason=deadline (aged out "
+    "of the queue) or reason=cancel (caller withdrew it)",
+)
+_PREEMPTS = obs.counter(
+    "serving_preempted_total",
+    "batch-class requests paused at a chunk boundary (KV saved, slot "
+    "handed to an interactive arrival)",
+)
+_RESUMES = obs.counter(
+    "serving_resumed_total",
+    "preempted requests re-admitted with their KV restored (bit-exact "
+    "continuation at the saved cursor)",
 )
 
 
@@ -94,9 +111,15 @@ def _bucket(n: int, cap: int) -> int:
 
 
 class DenseBackend:
-    """Slot-pool serving over the dense KV stack (models/inference.py)."""
+    """Slot-pool serving over the dense KV stack (models/inference.py).
 
-    def __init__(self, params, cfg, *, n_slots: int, max_seq: int):
+    ``fns`` shares another backend's compiled-program cache: the jitted
+    programs are pure in params/cache (nothing baked but shapes), so N
+    replica backends of the same (cfg, n_slots, max_seq) can reuse ONE
+    compile set — a replica set costs one warmup, not N."""
+
+    def __init__(self, params, cfg, *, n_slots: int, max_seq: int,
+                 fns: Optional[LRUFnCache] = None):
         import jax
 
         from uccl_tpu.models.inference import SlotKVCache
@@ -106,7 +129,7 @@ class DenseBackend:
         self.n_slots = n_slots
         self.max_seq = max_seq
         self.cache = SlotKVCache.empty(cfg, n_slots, max_seq)
-        self._fns = LRUFnCache(16)
+        self._fns = fns if fns is not None else LRUFnCache(16)
         self._jax = jax
 
     def _prefill_fn(self, s: int):
@@ -281,6 +304,31 @@ class MoEBackend:
         self.cache = self.cache.copy_prefix(dst, src, n)
 
 
+def replicate_backend(backend, n: int) -> List:
+    """``n`` replica backends from one prototype — THE sharing rule for a
+    replica set (serve.py and serving_bench both build through here, so
+    it can't drift): every replica owns its KV pool, but dense replicas
+    share the prototype's compiled-program cache (the jitted fns are pure
+    in params/cache) and MoE replicas share its server (and therefore its
+    compiled programs) — N replicas cost one warmup."""
+    if n < 1:
+        raise ValueError(f"need n >= 1 replicas, got {n}")
+    out = [backend]
+    for _ in range(1, n):
+        if isinstance(backend, MoEBackend):
+            out.append(MoEBackend(
+                backend.server, backend.params,
+                batch_local=backend.b_loc, max_seq=backend.max_seq,
+                decode_impl=backend.decode_impl,
+            ))
+        else:
+            out.append(DenseBackend(
+                backend.params, backend.cfg, n_slots=backend.n_slots,
+                max_seq=backend.max_seq, fns=backend._fns,
+            ))
+    return out
+
+
 class ServingEngine:
     """submit()/step()/drain() over a backend (Dense or MoE).
 
@@ -316,7 +364,9 @@ class ServingEngine:
                  chunk_sink: Optional[Callable[[List[ChunkEvent]], None]]
                  = None,
                  spec_k: Optional[int] = None,
-                 drafter=None):
+                 drafter=None,
+                 priority_classes: bool = False,
+                 preempt: bool = False):
         if spec_k is not None:
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
@@ -362,6 +412,18 @@ class ServingEngine:
                 "chunk_sink requires prefill_chunk: the whole-prompt path "
                 "emits no per-chunk availability events"
             )
+        if preempt:
+            if not priority_classes:
+                raise ValueError(
+                    "preempt requires priority_classes: without classes "
+                    "there is no higher-priority arrival to preempt for"
+                )
+            if prefill_chunk is None:
+                raise ValueError(
+                    "preempt requires prefill_chunk: preemption pauses at "
+                    "chunk boundaries and resumes via the chunked "
+                    "start-offset program"
+                )
         self.backend = backend
         self.spec_k = spec_k
         self.drafter = drafter
@@ -369,8 +431,12 @@ class ServingEngine:
         self.step_tokens = step_tokens
         self.prefix_cache = prefix_cache
         self.chunk_sink = chunk_sink
+        self.priority_classes = priority_classes
+        self.preempt = preempt
         self.pool = SlotPool(backend.n_slots)
-        self.sched = FIFOScheduler(max_queue=max_queue)
+        self.sched = (PriorityScheduler(max_queue=max_queue)
+                      if priority_classes
+                      else FIFOScheduler(max_queue=max_queue))
         self.metrics = ServingMetrics()
         self._by_slot = {}  # slot -> Request (every occupied slot)
         self._prefilling = {}  # slot -> Request mid-prefill (chunked mode)
@@ -388,9 +454,16 @@ class ServingEngine:
 
     # -- submission ---------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: int = 16,
-               eos_id: Optional[int] = None) -> Optional[Request]:
+               eos_id: Optional[int] = None,
+               priority: str = "interactive",
+               deadline_ms: Optional[float] = None) -> Optional[Request]:
         """Queue one request. Returns the Request, or None when rejected by
-        backpressure (bounded queue full)."""
+        backpressure (bounded queue full). ``priority`` picks the SLO class
+        (``interactive`` admits before ``batch``; only meaningful on a
+        ``priority_classes`` engine — a FIFO engine records the label but
+        schedules by arrival order). ``deadline_ms`` is an ADMISSION
+        deadline: still queued that many ms after submit, the request
+        leaves as ``RequestState.EXPIRED`` instead of aging in place."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must be non-empty")
@@ -403,15 +476,23 @@ class ServingEngine:
                 f"prompt {prompt.size} + new {max_new_tokens} tokens exceed "
                 f"max_seq {self.backend.max_seq}: the slot would overflow"
             )
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority {priority!r} (classes: "
+                f"{PRIORITY_CLASSES})"
+            )
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         req = Request(
             rid=self._next_rid, prompt=prompt,
             max_new_tokens=max_new_tokens, eos_id=eos_id, t_submit=now(),
+            priority=priority, deadline_ms=deadline_ms,
         )
         self._next_rid += 1
         self.metrics.on_submit(req)
         obs.instant("submit", track=req.track, rid=req.rid,
                     prompt_len=int(prompt.size),
-                    max_new_tokens=max_new_tokens)
+                    max_new_tokens=max_new_tokens, cls=priority)
         if not self.sched.submit(req):
             self.metrics.on_reject(req)
             _REJECTS.inc()
@@ -419,8 +500,39 @@ class ServingEngine:
             return None
         return req
 
+    def cancel(self, rid: int) -> bool:
+        """Withdraw a still-QUEUED request: it leaves the queue as
+        ``RequestState.EXPIRED`` with ``finish_reason="cancel"``, counted
+        on ``serving_rejected_total{reason="cancel"}``. Returns False when
+        ``rid`` is not queued (already admitted, finished, or unknown) —
+        in-slot requests run to completion."""
+        req = self.sched.cancel(rid)
+        if req is None:
+            return False
+        self.metrics.on_expire(req)
+        _DROPPED.inc(reason="cancel")
+        obs.instant("cancel", track=req.track, rid=req.rid)
+        return True
+
+    def pending_tokens(self) -> int:
+        """Outstanding token work across queue and slots: every request's
+        remaining prefill tokens plus its remaining decode budget — the
+        router's per-replica step-debt signal (uccl_tpu/serving/router.py).
+        A queued fresh request counts in full; a queued PREEMPTED request
+        only its unfinished remainder; an in-slot request its unprefilled
+        tail plus undelivered tokens."""
+        debt = 0
+        for r in self.sched.queued_requests():
+            debt += max(0, int(r.prompt.size) - r.prefill_pos)
+            debt += max(0, r.max_new_tokens - r.n_generated)
+        for r in self._by_slot.values():
+            debt += max(0, int(r.prompt.size) - r.prefill_pos)
+            debt += max(0, r.max_new_tokens - r.n_generated)
+        return debt
+
     def adopt(self, prompt, first_token, *, max_new_tokens: int = 16,
               eos_id: Optional[int] = None, slot: Optional[int] = None,
+              priority: str = "interactive",
               queue_s: Optional[float] = None,
               prefill_s: Optional[float] = None,
               transfer_s: Optional[float] = None) -> Request:
@@ -431,8 +543,11 @@ class ServingEngine:
         fleet computed; the request enters ACTIVE directly and decodes from
         the next ``step()`` on. ``slot=None`` claims a free slot here;
         passing a slot means the caller reserved it (``pool.admit``) when
-        the KV stream opened. The ``*_s`` wall-clock splits (queue on the
-        prefill fleet, prefill compute, transfer tail) land on the metrics'
+        the KV stream opened. ``priority`` keeps the request's SLO-class
+        label (it rode the BEGIN message) so per-class metrics stay
+        truthful — adopted requests are ACTIVE at once, so the class never
+        queues here. The ``*_s`` wall-clock splits (queue on the prefill
+        fleet, prefill compute, transfer tail) land on the metrics'
         disaggregated-TTFT series. Returns the Request (already FINISHED
         when ``max_new_tokens == 1`` or the first token is EOS)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -447,10 +562,16 @@ class ServingEngine:
                 f"prompt {prompt.size} + new {max_new_tokens} tokens exceed "
                 f"max_seq {self.backend.max_seq}: the slot would overflow"
             )
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority {priority!r} (classes: "
+                f"{PRIORITY_CLASSES})"
+            )
         t = now()
         req = Request(
             rid=self._next_rid, prompt=prompt,
             max_new_tokens=max_new_tokens, eos_id=eos_id, t_submit=t,
+            priority=priority,
         )
         self._next_rid += 1
         if slot is None:
@@ -490,6 +611,13 @@ class ServingEngine:
         tr = obs.get_tracer()
         ts0 = tr.now_us() if tr is not None else 0.0
         finished: List[Request] = []
+        # queue aging first: an expired request must not take this step's
+        # admission (its deadline already passed at the step boundary)
+        for req in self.sched.expire(t0):
+            self.metrics.on_expire(req)
+            _DROPPED.inc(reason="deadline")
+            obs.instant("expire", track=req.track, rid=req.rid,
+                        deadline_ms=req.deadline_ms)
         if self.prefill_chunk is None:
             newly = self.sched.admit(self.pool)
             if newly:
@@ -536,6 +664,21 @@ class ServingEngine:
             if limit is not None:
                 limit -= 1
             slot, req = batch[0]
+            if req._saved_last_tok is not None:
+                # a preemption victim coming back: restore its saved KV and
+                # cursor instead of prefilling from scratch (no cache
+                # match — its rows are already exact). The restored prompt
+                # rows re-announce to the chunk sink: a victim preempted
+                # in the same step as its admission had its original event
+                # dropped (see the stale-event filter below), so the
+                # stream re-ships [0, cursor) — duplicate one-sided writes
+                # of identical rows are idempotent
+                self._resume(slot, req)
+                pos = min(req.prefill_pos, int(req.prompt.size))
+                if self.chunk_sink is not None and pos > 0:
+                    events.append(ChunkEvent(req, slot, 0, pos, False,
+                                             None, True))
+                continue
             req.state = RequestState.PARTIAL_PREFILL
             req.prefill_pos = 0
             if self.prefix_cache is not None:
@@ -564,12 +707,17 @@ class ServingEngine:
 
     def _make_room(self) -> bool:
         """Admission's last resort when no slot is free: evict the LRU
-        prefix-cache donor. Live requests' slots are never candidates —
-        only parked (retired, cache-resident) slots are in the cache. The
-        donor the queue-head request would match is protected: evicting it
-        would trade that admission's cache hit for its slot (when it is the
-        ONLY parked slot, admission waits instead — a live retire parks or
-        frees a slot within a bounded number of steps)."""
+        prefix-cache donor; failing that, preempt a running batch-class
+        request when the queue head is interactive (``preempt=True``)."""
+        return self._evict_cache_donor() or self._preempt_one()
+
+    def _evict_cache_donor(self) -> bool:
+        """Evict the LRU prefix-cache donor. Live requests' slots are never
+        candidates — only parked (retired, cache-resident) slots are in the
+        cache. The donor the queue-head request would match is protected:
+        evicting it would trade that admission's cache hit for its slot
+        (when it is the ONLY parked slot, admission waits instead — a live
+        retire parks or frees a slot within a bounded number of steps)."""
         if self.prefix_cache is None:
             return False
         protect = None
@@ -586,6 +734,84 @@ class ServingEngine:
         if protect is not None and not self._by_slot:
             return self.prefix_cache.evict_lru(self.pool) is not None
         return False
+
+    def _preempt_one(self) -> bool:
+        """Pause the most recently admitted batch-class request so the
+        interactive queue head can take its slot. The victim's live KV rows
+        are exported to host through the slot-row view (the PR 8 disagg/
+        prefix-cache machinery — raw f32 rows, so restore is bitwise), its
+        cursor (``prefill_pos``) and last emitted token are saved on the
+        request, the slot is freed with NO cache scrub (stale rows are dead
+        by the masked-attention argument), and the victim re-queues at the
+        HEAD of the batch class. Resume (:meth:`_resume`) imports the rows
+        into whatever slot frees up and continues mid-prefill via the
+        PR 4 ``start`` offset or mid-decode from the restored last token —
+        output bit-identical to the unpreempted run (tested).
+
+        Newest-first victim selection (max ``admit_seq``) preempts the
+        request with the least sunk work, so older batch requests keep
+        draining — preemption reorders *within* the batch class as little
+        as possible. Adopted (disagg) requests have no admit_seq and are
+        never victims: their KV provenance is the remote stream."""
+        if not self.preempt:
+            return False
+        head = self.sched.peek()
+        if head is None or head.priority != PRIORITY_CLASSES[0]:
+            return False
+        victims = [r for r in self._by_slot.values()
+                   if r.priority == "batch" and r.admit_seq is not None]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda r: r.admit_seq)
+        slot = victim.slot
+        kv_len = victim.kv_len
+        if kv_len > 0:
+            # full S_max rows: one compiled export program per pool shape
+            # (the import side pads to S_max anyway); the live window
+            # [0, kv_len) is what resume stamps back as the length
+            k_rows, v_rows = self.backend.export_slot_kv(
+                slot, 0, self.backend.max_seq
+            )
+            victim._saved_kv = (k_rows, v_rows, kv_len)
+        victim._saved_last_tok = int(self._last_tok[slot])
+        self._by_slot.pop(slot)
+        self._prefilling.pop(slot, None)
+        self.pool.free(slot)
+        victim.slot = None
+        victim.state = RequestState.PREEMPTED
+        victim.preemptions += 1
+        self.sched.requeue(victim)
+        self.metrics.on_preempt(victim)
+        _PREEMPTS.inc()
+        obs.instant("preempt", track=victim.track, slot=slot,
+                    pos=victim.prefill_pos, generated=victim.n_generated,
+                    for_rid=head.rid)
+        return True
+
+    def _resume(self, slot: int, req: Request) -> None:
+        """Re-enter a preempted request: import its saved KV rows into the
+        newly granted slot (possibly a different one — the rows carry the
+        state, not the slot id), restore the decode input token, and rejoin
+        at the saved cursor: mid-prefill victims continue chunking at
+        ``start=prefill_pos``, finished-prefill victims join this step's
+        decode pass directly."""
+        saved = req._saved_kv
+        if saved is not None:
+            k_rows, v_rows, kv_len = saved
+            self.backend.import_slot_kv(slot, k_rows, v_rows,
+                                        length=kv_len)
+            req._saved_kv = None
+        self._last_tok[slot] = np.int32(req._saved_last_tok)
+        req._saved_last_tok = None
+        self._by_slot[slot] = req
+        if req.prefill_pos < req.prompt.size:
+            req.state = RequestState.PARTIAL_PREFILL
+            self._prefilling[slot] = req
+        # else: sched.admit already stamped ACTIVE — it decodes this step
+        self.metrics.on_resume(req)
+        _RESUMES.inc()
+        obs.instant("resume", track=req.track, slot=slot,
+                    pos=req.prefill_pos, generated=req.n_generated)
 
     def drain(self, max_steps: int = 100000) -> List[Request]:
         """Step until queue and slots are empty; returns all finished."""
@@ -652,6 +878,10 @@ class ServingEngine:
                 tr.complete("prefill", ts0, dur, req.track, slot=slot)
         for slot, req in newly:
             self._by_slot[slot] = req
+            # the whole prompt is in KV now — keep the cursor truthful so
+            # pending_tokens() (the router's debt signal) never counts an
+            # already-prefilled prompt as outstanding work
+            req.prefill_pos = req.prompt.size
             self._emit_first_token(slot, req, tok[slot], t_done, finished)
 
     def _prefill_chunk_step(self, finished,
@@ -707,7 +937,12 @@ class ServingEngine:
             advanced.append((slot, req, done))
         _PREFILL_TOKENS.inc(computed, kind="computed")
         if self.chunk_sink is not None:
-            self.chunk_sink(events)
+            # drop events whose slot changed hands since they were queued:
+            # an admission-time prefix-copy event whose request was
+            # preempted later in the SAME admission loop would otherwise
+            # export rows now owned by the request that took the slot
+            self.chunk_sink([ev for ev in events
+                             if self._by_slot.get(ev.slot) is ev.req])
         for slot, req, done in advanced:
             if not done:
                 continue  # more chunks to go — next step

@@ -98,6 +98,31 @@ def synth_repeat_workload(rng: np.random.Generator, n: int, prompt_len: int,
     return prompts, lens, arrivals
 
 
+def assign_classes(rng: np.random.Generator, n: int,
+                   interactive_frac: float,
+                   pattern: str = "bernoulli"):
+    """Priority-class labels for a workload. ``bernoulli`` draws each
+    request ``interactive`` with probability ``interactive_frac`` (class
+    arrivals interleave the way mixed traffic really does); ``batch-first``
+    puts every batch request at the FRONT of the arrival order — the
+    deterministic preemption fixture: batch work occupies the slots before
+    any interactive request arrives, so each interactive arrival must
+    preempt (the qa/ci smoke arm's guarantee). Call AFTER drawing the
+    arrival stream in callers that share arrivals across arms, so the mix
+    knob never perturbs timing."""
+    if not (0.0 <= interactive_frac <= 1.0):
+        raise ValueError(
+            f"interactive_frac must be in [0, 1], got {interactive_frac}"
+        )
+    if pattern == "bernoulli":
+        return ["interactive" if rng.random() < interactive_frac
+                else "batch" for _ in range(n)]
+    if pattern == "batch-first":
+        n_int = round(n * interactive_frac)
+        return ["batch"] * (n - n_int) + ["interactive"] * n_int
+    raise ValueError(f"unknown class pattern {pattern!r}")
+
+
 def warm_engine(engine: ServingEngine, lens, max_seq: int,
                 new_tokens: int) -> None:
     """Compile every prefill program the sampled lengths can hit plus the
@@ -150,21 +175,45 @@ def _clear_warmup_trace() -> None:
         t.clear()
 
 
-def drive(engine: ServingEngine, prompts, arrivals, max_new_tokens: int,
-          eos_id: Optional[int] = None) -> Tuple[List[Request], float]:
+def warm_replicas(router, lens, max_seq: int, new_tokens: int) -> None:
+    """Compile warmup for every engine behind a Router (each replica owns
+    its own jit caches and KV pool), then zero the router's routed counts
+    — warmup submissions must not skew the routed distribution benches
+    label arms from."""
+    for eng in router.engines:
+        warm_engine(eng, lens, max_seq, new_tokens)
+    router.routed = [0] * len(router.replicas)
+
+
+def drive(engine, prompts, arrivals, max_new_tokens,
+          eos_id: Optional[int] = None, priorities=None,
+          deadlines_ms=None) -> Tuple[List[Request], float]:
     """Run the arrival stream to completion: submit requests as their
     arrival offsets come due (wall clock), stepping the engine whenever it
-    has work. Returns (accepted requests, wall seconds); rejected
-    submissions (bounded queue) are counted in the engine's metrics but
-    not returned."""
+    has work. ``engine`` is a ServingEngine or a Router (same submit/step/
+    has_work surface). ``max_new_tokens`` is one budget for every request
+    or a per-request list (mixed workloads: short interactive turns over
+    long batch jobs). ``priorities`` / ``deadlines_ms`` are optional
+    per-request lists (None entries = the submit defaults). Returns
+    (accepted requests, wall seconds); rejected submissions (bounded
+    queue) are counted in the engine's metrics but not returned — expired
+    requests ARE returned (they were accepted) and finish as EXPIRED."""
     reqs: List[Request] = []
     i, n = 0, len(prompts)
     t0 = now()
     while i < n or engine.has_work():
         t = now() - t0
         while i < n and arrivals[i] <= t:
-            r = engine.submit(prompts[i], max_new_tokens=max_new_tokens,
-                              eos_id=eos_id)
+            kw = {}
+            if priorities is not None and priorities[i] is not None:
+                kw["priority"] = priorities[i]
+            if deadlines_ms is not None and deadlines_ms[i] is not None:
+                kw["deadline_ms"] = deadlines_ms[i]
+            mnt = (max_new_tokens[i]
+                   if isinstance(max_new_tokens, (list, tuple))
+                   else max_new_tokens)
+            r = engine.submit(prompts[i], max_new_tokens=mnt,
+                              eos_id=eos_id, **kw)
             if r is not None:
                 reqs.append(r)
             i += 1

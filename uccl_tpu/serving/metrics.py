@@ -76,8 +76,11 @@ class ServingMetrics:
     def __init__(self):
         self.submitted = 0
         self.rejected = 0
+        self.expired = 0  # queued requests dropped by deadline or cancel()
         self.admitted = 0
         self.adopted = 0  # requests entering via adopt() (disagg decode)
+        self.preempted = 0  # pauses of a lower-class request at a chunk boundary
+        self.resumed = 0  # preempted requests re-admitted (KV restored)
         self.completed = 0
         self.output_tokens = 0  # completed requests only (goodput numerator)
         self.prefill_calls = 0
@@ -110,26 +113,54 @@ class ServingMetrics:
         self.disagg_prefill_s: List[float] = []
         self.disagg_transfer_s: List[float] = []
         self.disagg_ttft_s: List[float] = []
+        # per-priority-class series (SLO attainment is judged per class —
+        # docs/SERVING.md): every request lands in exactly one class bucket
+        self.class_submitted: Dict[str, int] = {}
+        self.class_completed: Dict[str, int] = {}
+        self.class_ttft_s: Dict[str, List[float]] = {}
+        self.class_tpot_s: Dict[str, List[float]] = {}
+        self.class_queue_wait_s: Dict[str, List[float]] = {}
         self.t_first_submit: Optional[float] = None
         self.t_last_finish: Optional[float] = None
 
     # -- lifecycle hooks (the engine calls these) ---------------------------
     def on_submit(self, req: Request) -> None:
         self.submitted += 1
+        self.class_submitted[req.priority] = \
+            self.class_submitted.get(req.priority, 0) + 1
         if self.t_first_submit is None:
             self.t_first_submit = req.t_submit
 
     def on_reject(self, req: Request) -> None:
         self.rejected += 1
 
+    def on_expire(self, req: Request) -> None:
+        """A queued request left by deadline expiry or cancellation."""
+        self.expired += 1
+
     def on_admit(self, req: Request) -> None:
         self.admitted += 1
         if req.queue_wait is not None:
             self.queue_wait_s.append(req.queue_wait)
+            self.class_queue_wait_s.setdefault(req.priority, []).append(
+                req.queue_wait
+            )
+
+    def on_preempt(self, req: Request) -> None:
+        """A lower-class request was paused at a chunk boundary (its KV
+        saved, its slot handed to an interactive arrival)."""
+        self.preempted += 1
+
+    def on_resume(self, req: Request) -> None:
+        """A preempted request re-entered a slot (KV restored) — NOT a new
+        admission: its queue-wait and admitted count were recorded at its
+        first admission, so conservation stays exact."""
+        self.resumed += 1
 
     def on_first_token(self, req: Request) -> None:
         if req.ttft is not None:
             self.ttft_s.append(req.ttft)
+            self.class_ttft_s.setdefault(req.priority, []).append(req.ttft)
 
     def on_adopt(self, req: Request, *, queue_s: Optional[float] = None,
                  prefill_s: Optional[float] = None,
@@ -152,10 +183,13 @@ class ServingMetrics:
 
     def on_finish(self, req: Request) -> None:
         self.completed += 1
+        self.class_completed[req.priority] = \
+            self.class_completed.get(req.priority, 0) + 1
         self.output_tokens += req.n_generated
         self.t_last_finish = req.t_finish
         if req.tpot is not None:
             self.tpot_s.append(req.tpot)
+            self.class_tpot_s.setdefault(req.priority, []).append(req.tpot)
         if req.latency is not None:
             self.latency_s.append(req.latency)
 
@@ -199,10 +233,12 @@ class ServingMetrics:
     def snapshot(self, *, queued: int = 0, active: int = 0,
                  n_slots: int = 0, occupancy: float = 0.0) -> Dict:
         """JSON-ready state. Conservation invariant (tested):
-        submitted == completed + active + queued + rejected."""
+        submitted == completed + active + queued + rejected + expired
+        (preemptions move requests between active and queued, never out)."""
         snap = {
             "submitted": self.submitted,
             "rejected": self.rejected,
+            "expired": self.expired,
             "admitted": self.admitted,
             "completed": self.completed,
             "queued": queued,
@@ -239,6 +275,28 @@ class ServingMetrics:
                     self.spec_accepted / self.spec_proposed, 4
                 )
             snap["accepted_len"] = dist(self.accepted_len)
+        if self.preempted or self.resumed:
+            snap["preempted"] = self.preempted
+            snap["resumed"] = self.resumed
+        # per-class SLO surfaces, emitted once a second class shows up (a
+        # single-class engine's snapshot stays byte-compatible with PR 3's)
+        if len(self.class_submitted) > 1:
+            snap["per_class"] = {
+                cls: {
+                    "submitted": n,
+                    "completed": self.class_completed.get(cls, 0),
+                    "ttft_ms": percentiles_ms(
+                        self.class_ttft_s.get(cls, [])
+                    ),
+                    "tpot_ms": percentiles_ms(
+                        self.class_tpot_s.get(cls, [])
+                    ),
+                    "queue_wait_ms": percentiles_ms(
+                        self.class_queue_wait_s.get(cls, [])
+                    ),
+                }
+                for cls, n in sorted(self.class_submitted.items())
+            }
         if self.adopted:
             snap["adopted"] = self.adopted
             snap["disagg_queue_ms"] = percentiles_ms(self.disagg_queue_s)
@@ -251,6 +309,44 @@ class ServingMetrics:
         if gp is not None:
             snap["goodput_tok_s"] = round(gp, 1)
         return snap
+
+    @staticmethod
+    def merged(parts: List["ServingMetrics"]) -> "ServingMetrics":
+        """One metrics object spanning N replica engines (the router's
+        aggregate snapshot): counts add, sample lists concatenate — so the
+        merged percentiles are computed over the REAL union of samples, not
+        averaged per-replica percentiles (which would be meaningless) —
+        and the goodput window spans first submit to last finish across
+        the whole replica set."""
+        out = ServingMetrics()
+        for m in parts:
+            for attr, v in vars(m).items():
+                cur = getattr(out, attr)
+                if attr in ("t_first_submit", "t_last_finish"):
+                    continue  # merged below (min/max, not sum)
+                if isinstance(v, bool):
+                    continue
+                if isinstance(v, (int, float)):
+                    setattr(out, attr, cur + v)
+                elif isinstance(v, list):
+                    cur.extend(v)
+                elif isinstance(v, dict):
+                    for k2, v2 in v.items():
+                        if isinstance(v2, list):
+                            cur.setdefault(k2, []).extend(v2)
+                        else:
+                            cur[k2] = cur.get(k2, 0) + v2
+            if m.t_first_submit is not None:
+                out.t_first_submit = (m.t_first_submit
+                                      if out.t_first_submit is None
+                                      else min(out.t_first_submit,
+                                               m.t_first_submit))
+            if m.t_last_finish is not None:
+                out.t_last_finish = (m.t_last_finish
+                                     if out.t_last_finish is None
+                                     else max(out.t_last_finish,
+                                              m.t_last_finish))
+        return out
 
     # -- repo-wide stats thread export --------------------------------------
     @staticmethod
@@ -266,6 +362,26 @@ class ServingMetrics:
         lines: List[str] = []
         for k, v in snapshot.items():
             name = sanitize_name(f"{prefix}_{k}")
+            if k == "per_class" and isinstance(v, dict):
+                # one series per (class, metric[, quantile]) — the SLO
+                # surfaces check_obs --router greps for
+                for cls, metrics in v.items():
+                    c = escape_label_value(str(cls))
+                    for mk, mv in metrics.items():
+                        mname = sanitize_name(f"{prefix}_class_{mk}")
+                        if isinstance(mv, dict):
+                            for q, qv in mv.items():
+                                if isinstance(qv, (int, float)) \
+                                        and not isinstance(qv, bool):
+                                    lines.append(
+                                        f'{mname}{{cls="{c}",'
+                                        f'q="{escape_label_value(str(q))}"'
+                                        f"}} {qv}"
+                                    )
+                        elif isinstance(mv, (int, float)) \
+                                and not isinstance(mv, bool):
+                            lines.append(f'{mname}{{cls="{c}"}} {mv}')
+                continue
             if isinstance(v, dict):
                 for q, qv in v.items():
                     if isinstance(qv, (int, float)) \

@@ -33,8 +33,10 @@ class RequestState(enum.Enum):
     QUEUED = "queued"
     PARTIAL_PREFILL = "partial_prefill"  # in a slot, prefill cursor mid-prompt
     ACTIVE = "active"
+    PREEMPTED = "preempted"  # paused at a chunk boundary, KV saved, re-queued
     FINISHED = "finished"
     REJECTED = "rejected"
+    EXPIRED = "expired"  # left the queue on deadline expiry or cancel()
 
 
 @dataclass
@@ -56,8 +58,11 @@ class Request:
     prefill_pos: int = 0  # chunked-prefill cursor: prompt[:prefill_pos] is in KV
     cache_hit_len: int = 0  # prompt tokens reused from the prefix cache
     adopted: bool = False  # entered via adopt() (disagg decode side), not submit()
+    priority: str = "interactive"  # SLO class: "interactive" | "batch"
+    deadline_ms: Optional[float] = None  # admission deadline after submit
+    preemptions: int = 0  # times this request was paused for a higher class
     out_tokens: List[int] = field(default_factory=list)
-    finish_reason: Optional[str] = None  # "eos" | "length"
+    finish_reason: Optional[str] = None  # "eos" | "length" | "deadline" | "cancel"
     t_submit: float = 0.0
     t_admit: Optional[float] = None
     t_first_token: Optional[float] = None
@@ -68,6 +73,13 @@ class Request:
     _ctx: Optional[np.ndarray] = field(default=None, repr=False,
                                        compare=False)
     _ctx_len: int = 0
+    # preemption save state: host copies of the slot's full KV rows plus
+    # the last emitted token, taken at the chunk boundary where the engine
+    # paused this request (None while not preempted)
+    _saved_kv: Optional[tuple] = field(default=None, repr=False,
+                                       compare=False)
+    _saved_last_tok: Optional[int] = field(default=None, repr=False,
+                                           compare=False)
 
     @property
     def track(self) -> str:
@@ -129,5 +141,20 @@ class Request:
             return None
         return self.t_finish - self.t_submit
 
+    @property
+    def kv_len(self) -> int:
+        """Rows of this request's KV that are live on device: the prefill
+        cursor plus one row per decode step taken (the first token comes
+        from prefill logits and writes no row; each decode/verify commit
+        advances the device length by its committed count). This is the
+        exact window preemption must save to resume bit-identically."""
+        return self.prefill_pos + max(0, self.n_generated - 1)
+
+    def deadline_passed(self, t: float) -> bool:
+        """Whether the admission deadline expired at engine-clock ``t``."""
+        return (self.deadline_ms is not None
+                and (t - self.t_submit) * 1e3 > self.deadline_ms)
+
     def is_done(self) -> bool:
-        return self.state in (RequestState.FINISHED, RequestState.REJECTED)
+        return self.state in (RequestState.FINISHED, RequestState.REJECTED,
+                              RequestState.EXPIRED)

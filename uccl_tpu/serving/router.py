@@ -1,0 +1,246 @@
+"""Replica router: least-loaded admission over N serving engine replicas.
+
+The heavy-traffic front end (docs/SERVING.md): UCCL's core move is
+software-driven multi-path scheduling over dumb transports (PAPER.md §0.1);
+the serving analogue sprays requests over a replica set using **live load
+signals** instead of round-robin — the same signals the obs layer already
+exports, read directly off each replica:
+
+* **free slots** — ``pool.n_free``: immediate admission capacity;
+* **token debt** — ``engine.pending_tokens()``: outstanding prefill +
+  decode work in step-token units (the per-step spend currency of
+  ``step_tokens``), queued AND in-slot — the forward-looking load;
+* **recent queue wait** — mean of the last few ``queue_wait_ms`` samples:
+  the realized scheduling delay, a lagging confirmation of the debt;
+* **adoption backpressure** — for disaggregated prefill fleets
+  (``disagg.PrefillWorker.adoption_backpressure()``): requests stuck
+  waiting for a decode-side GRANT, so new prompts steer away from a
+  prefill worker whose decode peer is saturated.
+
+Selection is lexicographic — ``(debt + bp_tokens·backpressure,
+-free_slots, queue_wait_ms, index)``, lowest wins — so the dominant
+forward-looking signal decides and the rest break ties deterministically
+(the index tail makes equal replicas round-robin-stable rather than
+id-0-biased: it rotates with the routed count).
+
+When the chosen replica rejects (bounded queue — the race between the
+signal read and the submit), the router **spills over** to the next-best
+replica (counted on ``serving_router_spillover_total``); when every
+replica rejects, the request is rejected at the router (counted on
+``serving_router_rejected_total{reason="saturated"}``) — sustained
+overload is visible as a counter, never a hang. Every accepted admission
+lands on ``serving_router_requests_total{replica=...}`` plus a ``route``
+trace instant carrying the signals the decision was made from, so benches
+label arms off real routing decisions (docs/OBSERVABILITY.md).
+
+Replicas are in-process ``ServingEngine``s, or disagg ``PrefillWorker``s
+(anything with an ``.engine`` and a ``submit``) — a prefill fleet routed
+per-peer. Mixed sets are allowed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from uccl_tpu import obs
+from uccl_tpu.serving.engine import ServingEngine
+from uccl_tpu.serving.metrics import ServingMetrics
+from uccl_tpu.serving.request import Request
+
+_ROUTED = obs.counter(
+    "serving_router_requests_total",
+    "requests admitted per replica by the least-loaded router",
+)
+_SPILLOVER = obs.counter(
+    "serving_router_spillover_total",
+    "admissions that fell through to a lower-ranked replica after the "
+    "chosen one rejected (bounded-queue race)",
+)
+_ROUTER_REJECTS = obs.counter(
+    "serving_router_rejected_total",
+    "requests rejected at the router: reason=saturated means every "
+    "replica's queue was full",
+)
+_REPLICAS = obs.gauge(
+    "serving_router_replicas", "replica count behind the serving router"
+)
+
+
+def replica_signals(replica, *, recent: int = 8) -> Dict[str, float]:
+    """The live load signals for one replica, as the router reads them.
+    Exposed as a function so tests and benches can audit the exact inputs
+    a routing decision saw."""
+    eng = engine_of(replica)
+    qw = eng.metrics.queue_wait_s[-recent:]
+    bp = 0
+    hook = getattr(replica, "adoption_backpressure", None)
+    if callable(hook):
+        bp = int(hook())
+    return {
+        "free_slots": eng.pool.n_free,
+        "queued": eng.sched.qsize,
+        "debt_tokens": eng.pending_tokens(),
+        "queue_wait_ms": round(sum(qw) / len(qw) * 1e3, 3) if qw else 0.0,
+        "backpressure": bp,
+    }
+
+
+def engine_of(replica) -> ServingEngine:
+    """The ServingEngine inside a replica (identity for a bare engine,
+    ``.engine`` for a disagg PrefillWorker)."""
+    return getattr(replica, "engine", replica)
+
+
+class Router:
+    """Least-loaded front end over N serving replicas.
+
+    ``bp_tokens`` prices one unit of adoption backpressure (one request
+    stuck awaiting decode capacity) in debt-token units when ranking —
+    the default assumes a stuck request is worth about one typical
+    request's work.
+    """
+
+    def __init__(self, replicas: List, *, bp_tokens: int = 64):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self.bp_tokens = bp_tokens
+        self.routed = [0] * len(self.replicas)  # per-replica admit counts
+        _REPLICAS.set(len(self.replicas))
+
+    # -- the routing decision ------------------------------------------
+    def _ranked(self) -> Tuple[List[Tuple[tuple, int]], Dict[int, Dict]]:
+        """Replicas ranked least-loaded first. The index tail rotates with
+        the total routed count so exactly-equal replicas take turns
+        instead of always electing replica 0 (cold-start skew)."""
+        n = len(self.replicas)
+        rot = sum(self.routed) % n
+        ranked = []
+        for i, r in enumerate(self.replicas):
+            s = replica_signals(r)
+            key = (
+                s["debt_tokens"] + self.bp_tokens * s["backpressure"],
+                -s["free_slots"],
+                s["queue_wait_ms"],
+                (i - rot) % n,
+            )
+            ranked.append((key, i, s))
+        ranked.sort(key=lambda t: t[0])
+        return [(k, i) for k, i, _ in ranked], {i: s for _, i, s in ranked}
+
+    def submit(self, prompt, *, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               priority: str = "interactive",
+               deadline_ms: Optional[float] = None) -> Optional[Request]:
+        """Admit one request to the least-loaded replica; on rejection,
+        spill to the next-ranked; None when every replica rejected.
+        ``deadline_ms`` is refused when the set contains disagg prefill
+        workers: their BEGIN already reserved decode-side state, so a
+        queue-expired prefill request would strand the peer's grant."""
+        if deadline_ms is not None and any(
+                r is not engine_of(r) for r in self.replicas):
+            raise ValueError(
+                "deadline_ms is not supported on disagg prefill "
+                "replicas: an expired queued request would strand its "
+                "decode-side grant"
+            )
+        ranked, signals = self._ranked()
+        for rank, (_, i) in enumerate(ranked):
+            replica = self.replicas[i]
+            eng = engine_of(replica)
+            if replica is eng:
+                req = eng.submit(prompt, max_new_tokens=max_new_tokens,
+                                 eos_id=eos_id, priority=priority,
+                                 deadline_ms=deadline_ms)
+            else:
+                # disagg prefill worker: the decode budget and the class
+                # label ride the BEGIN message (the worker's own engine
+                # schedules its prefill queue by the same class)
+                req = replica.submit(prompt,
+                                     max_new_tokens=max_new_tokens,
+                                     eos_id=eos_id, priority=priority)
+            if req is None:
+                continue  # bounded queue raced the signal read — spill
+            self.routed[i] += 1
+            _ROUTED.inc(replica=str(i))
+            if rank > 0:
+                _SPILLOVER.inc()
+            obs.instant("route", track="router", replica=i, rank=rank,
+                        rid=req.rid, cls=priority, **signals[i])
+            return req
+        _ROUTER_REJECTS.inc(reason="saturated")
+        obs.instant("route_reject", track="router",
+                    replicas=len(self.replicas))
+        return None
+
+    def cancel(self, rid_replica: Tuple[int, int]) -> bool:
+        """Cancel a queued request by (replica index, rid)."""
+        i, rid = rid_replica
+        return engine_of(self.replicas[i]).cancel(rid)
+
+    # -- the drive surface (loadgen.drive-compatible) ------------------
+    def has_work(self) -> bool:
+        return any(engine_of(r).has_work() or
+                   (hasattr(r, "idle") and not r.idle())
+                   for r in self.replicas)
+
+    def step(self) -> List[Request]:
+        """One iteration of every replica that has work; returns requests
+        finished across the set this round."""
+        finished: List[Request] = []
+        for r in self.replicas:
+            eng = engine_of(r)
+            if r is not eng:
+                r.step()  # worker loop: engine step + wire pump
+            elif eng.has_work():
+                finished.extend(eng.step())
+        return finished
+
+    def drain(self, max_steps: int = 100000) -> List[Request]:
+        done: List[Request] = []
+        steps = 0
+        while self.has_work():
+            done.extend(self.step())
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"router drain exceeded {max_steps} steps "
+                    f"(queued={self.qsize}, active={self.n_active})"
+                )
+        return done
+
+    # -- aggregate inspection ------------------------------------------
+    @property
+    def engines(self) -> List[ServingEngine]:
+        return [engine_of(r) for r in self.replicas]
+
+    @property
+    def qsize(self) -> int:
+        return sum(e.sched.qsize for e in self.engines)
+
+    @property
+    def n_active(self) -> int:
+        return sum(len(e._by_slot) for e in self.engines)
+
+    def leaked(self) -> int:
+        return sum(e.pool.leaked() for e in self.engines)
+
+    def snapshot(self) -> dict:
+        """Replica-set snapshot: the merged metrics (samples concatenated,
+        counts summed — ServingMetrics.merged) plus per-replica snapshots
+        and the router's own routed distribution."""
+        merged = ServingMetrics.merged([e.metrics for e in self.engines])
+        snap = merged.snapshot(
+            queued=self.qsize, active=self.n_active,
+            n_slots=sum(e.pool.n_slots for e in self.engines),
+            occupancy=(sum(e.pool.n_active for e in self.engines)
+                       / max(1, sum(e.pool.n_slots for e in self.engines))),
+        )
+        snap["replicas"] = len(self.replicas)
+        snap["routed"] = list(self.routed)
+        snap["per_replica"] = [e.snapshot() for e in self.engines]
+        return snap
+
+    def close(self) -> None:
+        for e in self.engines:
+            e.close()

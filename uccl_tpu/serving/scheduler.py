@@ -1,10 +1,23 @@
-"""FIFO admission scheduling with backpressure.
+"""Admission scheduling: FIFO with backpressure, plus SLO priority classes.
 
 Orca/vLLM-shape policy, smallest useful core: arrivals queue in submission
 order; every engine step admits from the queue head while KV slots are free
 (so a long-running sequence never starves the queue — it just occupies one
 slot); a bounded queue rejects at submit when full (backpressure — the
 caller sees it immediately instead of timing out later).
+
+Both schedulers support **queue aging + cancellation**: a request submitted
+with ``deadline_ms`` that is still queued when the deadline passes leaves
+the queue as ``RequestState.EXPIRED`` (the engine counts it on
+``serving_rejected_total{reason="deadline"}``), and ``cancel(rid)`` removes
+a queued request the same way — a stale queued request no longer occupies
+the queue forever (previously it could only be rejected at submit time).
+
+:class:`PriorityScheduler` adds latency classes (Llumnix/SLO-aware shape,
+docs/SERVING.md): one FIFO queue per class, admission drains strictly in
+class order (``interactive`` before ``batch``), and :meth:`requeue` puts an
+engine-preempted request back at the HEAD of its class queue so a paused
+victim resumes before any later same-class arrival.
 """
 
 from __future__ import annotations
@@ -14,6 +27,9 @@ from typing import Callable, List, Optional, Tuple
 
 from uccl_tpu.serving.request import Request, RequestState, now
 from uccl_tpu.serving.slots import SlotPool
+
+# class order: admission drains lower-index classes first
+PRIORITY_CLASSES = ("interactive", "batch")
 
 
 class FIFOScheduler:
@@ -25,24 +41,87 @@ class FIFOScheduler:
         self.max_queue = max_queue
         self._queue: deque = deque()
         self._admit_seq = 0
+        # queued requests carrying a deadline — expire()'s early-out, so
+        # deadline-free engines never pay an O(qsize) scan per step
+        self._n_deadlined = 0
+
+    # single-queue view — PriorityScheduler overrides to expose its class
+    # queues through the same iteration surface
+    def _queues(self) -> List[deque]:
+        return [self._queue]
 
     @property
     def qsize(self) -> int:
-        return len(self._queue)
+        return sum(len(q) for q in self._queues())
 
     def peek(self) -> Optional[Request]:
         """The request the next admission would take (None when empty) —
         lets the engine's make_room hook protect the prefix-cache donor
         this request is about to match from being the eviction victim."""
-        return self._queue[0] if self._queue else None
+        for q in self._queues():
+            if q:
+                return q[0]
+        return None
+
+    def queued_requests(self) -> List[Request]:
+        """Every queued request, in admission order (the router's token-debt
+        signal sums outstanding work over these)."""
+        return [r for q in self._queues() for r in q]
 
     def submit(self, req: Request) -> bool:
         """Queue a request; False = rejected (queue full, backpressure)."""
-        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+        if self.max_queue is not None and self.qsize >= self.max_queue:
             req.state = RequestState.REJECTED
             return False
         self._queue.append(req)
+        if req.deadline_ms is not None:
+            self._n_deadlined += 1
         return True
+
+    def requeue(self, req: Request) -> None:
+        """Put an engine-preempted request back at the queue head: a paused
+        victim resumes before any later arrival of its class. Never bounded
+        — the request already passed backpressure at submit."""
+        self._queue.appendleft(req)
+
+    def expire(self, t: float) -> List[Request]:
+        """Drop every QUEUED request whose admission deadline passed at
+        engine-clock ``t`` (state → EXPIRED, finish_reason "deadline").
+        Preempted requests waiting to resume are exempt: their deadline was
+        an *admission* deadline and they were already admitted once. Free
+        when nothing queued carries a deadline (the common case — one
+        counter check, no queue scan)."""
+        if self._n_deadlined == 0:
+            return []
+        expired: List[Request] = []
+        for q in self._queues():
+            for _ in range(len(q)):  # one full rotation keeps queue order
+                r = q.popleft()
+                if (r.state is RequestState.QUEUED
+                        and r.deadline_passed(t)):
+                    r.state = RequestState.EXPIRED
+                    r.finish_reason = "deadline"
+                    self._n_deadlined -= 1
+                    expired.append(r)
+                else:
+                    q.append(r)
+        return expired
+
+    def cancel(self, rid: int) -> Optional[Request]:
+        """Remove a queued request by id (state → EXPIRED, finish_reason
+        "cancel"). Returns the request, or None when ``rid`` is not queued
+        (already admitted, finished, or unknown) — only QUEUED requests are
+        cancellable here; in-slot requests run to completion."""
+        for q in self._queues():
+            for r in q:
+                if r.rid == rid and r.state is RequestState.QUEUED:
+                    q.remove(r)
+                    if r.deadline_ms is not None:
+                        self._n_deadlined -= 1
+                    r.state = RequestState.EXPIRED
+                    r.finish_reason = "cancel"
+                    return r
+        return None
 
     def admit(self, pool: SlotPool, limit: Optional[int] = None,
               make_room: Optional[Callable[[], bool]] = None,
@@ -55,14 +134,21 @@ class FIFOScheduler:
         ``make_room()`` is consulted only when the pool has no free slot
         and the queue still has work: return True after freeing one (the
         prefix cache's LRU eviction — parked donor slots yield to live
-        admissions), False to stop admitting. Returns the newly admitted
-        (slot, request) pairs — the engine prefills exactly these."""
+        admissions — or the engine's priority preemption), False to stop
+        admitting. Returns the newly admitted (slot, request) pairs — the
+        engine prefills exactly these."""
         admitted: List[Tuple[int, Request]] = []
-        while self._queue and (limit is None or len(admitted) < limit):
+        while (limit is None or len(admitted) < limit):
+            queue = next((q for q in self._queues() if q), None)
+            if queue is None:
+                break
             if not pool.n_free and not (make_room is not None
                                         and make_room()):
                 break
-            req = self._queue.popleft()
+            req = queue.popleft()
+            if (req.deadline_ms is not None
+                    and req.state is RequestState.QUEUED):
+                self._n_deadlined -= 1  # made it in before the deadline
             slot = pool.admit(req.rid)
             assert slot is not None  # n_free was checked
             req.slot = slot
@@ -72,3 +158,43 @@ class FIFOScheduler:
             self._admit_seq += 1
             admitted.append((slot, req))
         return admitted
+
+
+class PriorityScheduler(FIFOScheduler):
+    """Class-ordered admission: one bounded FIFO queue per priority class.
+
+    ``interactive`` requests are always admitted before ``batch`` requests
+    regardless of arrival order; within a class, order is FIFO. The shared
+    ``max_queue`` bounds the TOTAL queued count (one backpressure surface —
+    a saturated engine rejects both classes, and the router's spillover
+    handles the rest). ``requeue`` (the engine's preemption path) restores
+    a victim to the head of its own class queue.
+    """
+
+    def __init__(self, max_queue: Optional[int] = None):
+        super().__init__(max_queue=max_queue)
+        self._by_class = {cls: deque() for cls in PRIORITY_CLASSES}
+
+    def _queues(self) -> List[deque]:
+        return [self._by_class[cls] for cls in PRIORITY_CLASSES]
+
+    def _class_queue(self, req: Request) -> deque:
+        q = self._by_class.get(req.priority)
+        if q is None:
+            raise ValueError(
+                f"unknown priority class {req.priority!r} "
+                f"(classes: {PRIORITY_CLASSES})"
+            )
+        return q
+
+    def submit(self, req: Request) -> bool:
+        if self.max_queue is not None and self.qsize >= self.max_queue:
+            req.state = RequestState.REJECTED
+            return False
+        self._class_queue(req).append(req)
+        if req.deadline_ms is not None:
+            self._n_deadlined += 1
+        return True
+
+    def requeue(self, req: Request) -> None:
+        self._class_queue(req).appendleft(req)

@@ -77,6 +77,7 @@ _ARM_COUNTERS = (
     ("serving_router_spillover_total", {}),
     ("serving_router_rejected_total", {"reason": "saturated"}),
     ("serving_admission_rejected_total", {}),
+    ("obs_trace_contexts_total", {}),
 )
 
 
@@ -84,6 +85,36 @@ def _counter_state():
     from uccl_tpu import obs
 
     return [obs.counter(name).get(**labels) for name, labels in _ARM_COUNTERS]
+
+
+def _hist_state(name):
+    """Cumulative bucket state of one latency histogram (serving/metrics
+    observes them alongside the sample lists) — diffed around the
+    measured window like the counters above."""
+    from uccl_tpu import obs
+
+    return obs.histogram(name).state()
+
+
+def _hist_delta_ms(name, before):
+    """Histogram-DERIVED p50/p95 (ms) of the window since ``before`` —
+    stamped next to the sample-derived percentiles so the two derivations
+    cross-check in every recorded arm line (they must agree within one
+    bucket width; obs/aggregate.py federates only the histogram form
+    across processes, so the cross-check is what certifies it)."""
+    from uccl_tpu import obs
+
+    fam = obs.histogram(name)
+    zero = ((0,) * (len(fam.uppers) + 1), 0.0)
+    out = {}
+    for key, (counts, _) in fam.state().items():
+        prev = before.get(key, zero)[0]
+        delta = [a - b for a, b in zip(counts, prev)]
+        for q in (50, 95):
+            v = obs.histogram_quantile(fam.uppers, delta, q)
+            if v is not None:
+                out[f"p{q}"] = round(v * 1e3, 3)
+    return out
 
 
 def _counter_deltas(before):
@@ -289,6 +320,7 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
     prompts, lens, arrivals = _workload(args, vocab, rate, hit_rate)
     warm_engine(engine, lens, max_seq, args.new_tokens)
     before = _counter_state()
+    ttft_hist_before = _hist_state("serving_ttft_seconds")
     _, wall = drive(engine, prompts, arrivals, args.new_tokens)
     deltas = _counter_deltas(before)
 
@@ -300,8 +332,16 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
     arm.update({
         "wall_s": round(wall, 3),
         "completed": snap["completed"], "rejected": snap["rejected"],
+        # one trace context per request timeline (obs/context.py) — the
+        # arm's requests are individually traceable across processes
+        "trace_ids": deltas["obs_trace_contexts"],
         "goodput_tok_s": snap.get("goodput_tok_s"),
         "ttft_ms": snap["ttft_ms"], "queue_wait_ms": snap["queue_wait_ms"],
+        # histogram-derived TTFT percentiles beside the sample-derived
+        # ones: the merge-safe path and the exact path cross-check in
+        # every recorded line (docs/OBSERVABILITY.md)
+        "ttft_hist_ms": _hist_delta_ms("serving_ttft_seconds",
+                                       ttft_hist_before),
         "tpot_ms": snap["tpot_ms"],
         "tpot_p95_ms": snap["tpot_ms"].get("p95"),
         "decode_step_ms": snap["decode_step_ms"],
@@ -377,6 +417,7 @@ def run_router_arm(args, jax, stack, rate, n_slots, prefill_chunk,
     routed_c = obs.counter("serving_router_requests_total")
     routed0 = [routed_c.get(replica=str(i)) for i in range(n_replicas)]
     before = _counter_state()
+    ttft_hist_before = _hist_state("serving_ttft_seconds")
     reqs, wall = drive(router, prompts, arrivals, new_tokens,
                        priorities=priorities)
     deltas = _counter_deltas(before)
@@ -395,8 +436,11 @@ def run_router_arm(args, jax, stack, rate, n_slots, prefill_chunk,
         "wall_s": round(wall, 3),
         "completed": snap["completed"], "rejected": snap["rejected"],
         "expired": snap["expired"],
+        "trace_ids": deltas["obs_trace_contexts"],
         "goodput_tok_s": snap.get("goodput_tok_s"),
         "ttft_ms": snap["ttft_ms"], "queue_wait_ms": snap["queue_wait_ms"],
+        "ttft_hist_ms": _hist_delta_ms("serving_ttft_seconds",
+                                       ttft_hist_before),
         "tpot_ms": snap["tpot_ms"],
         "tpot_p95_ms": snap["tpot_ms"].get("p95"),
         "max_step_ms": snap.get("max_step_ms"),
@@ -453,6 +497,7 @@ def run_disagg_arm(args, jax, stack, rate, n_slots, prefill_chunk,
         warm_pair(pw, dw, args.prompt_len, args.new_tokens)
         prompts, _, arrivals = _workload(args, vocab, rate, hit_rate)
         before = _counter_state()
+        ttft_hist_before = _hist_state("serving_disagg_ttft_seconds")
         finished, wall = drive_pair(pw, dw, prompts, arrivals,
                                     args.new_tokens)
         deltas = _counter_deltas(before)
@@ -473,11 +518,14 @@ def run_disagg_arm(args, jax, stack, rate, n_slots, prefill_chunk,
         "wall_s": round(wall, 3),
         "completed": dsnap["completed"],
         "adopted": dsnap.get("adopted", 0),
+        "trace_ids": deltas["obs_trace_contexts"],
         "goodput_tok_s": dsnap.get("goodput_tok_s"),
         # the end-to-end TTFT and its split, from the stream's wall-clock
         # marks (docs/SERVING.md): queue+prefill on the prefill fleet,
         # transfer = prefill-done -> adopt on the decode fleet
         "ttft_ms": dsnap.get("disagg_ttft_ms", {}),
+        "ttft_hist_ms": _hist_delta_ms("serving_disagg_ttft_seconds",
+                                       ttft_hist_before),
         "ttft_p95_ms": dsnap.get("disagg_ttft_ms", {}).get("p95"),
         "ttft_queue_ms": dsnap.get("disagg_queue_ms", {}),
         "ttft_prefill_ms": dsnap.get("disagg_prefill_ms", {}),

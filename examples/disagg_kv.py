@@ -69,17 +69,33 @@ def _prompt():
 
 
 # -- default: chunk-streamed disaggregated serving --------------------------
-def stream_decode_worker(port_q, result_q, n_requests):
+def _role_path(path: str, role: str) -> str:
+    """Per-role artifact path: ``/tmp/t.json`` -> ``/tmp/t.decode.json``.
+    The fleet smoke arm (qa.sh / ci.yml) merges the two roles' traces
+    with scripts/trace_merge.py and federates the two metrics files with
+    ``python -m uccl_tpu.obs.aggregate``."""
+    stem, ext = os.path.splitext(path)
+    return f"{stem}.{role}{ext or '.json'}"
+
+
+def stream_decode_worker(port_q, result_q, n_requests, trace_out="",
+                         metrics_out=""):
     """Decode-fleet process: advertises its slot-pool KV mirror, grants
     incoming streams, adopts + decodes each request, reports the outputs
-    and its engine snapshot (with the disagg TTFT split)."""
+    and its engine snapshot (with the disagg TTFT split). With
+    ``trace_out``/``metrics_out`` it dumps its OWN role-labeled
+    observability artifacts — the decode half of the fleet trace (its
+    clock metadata carries the offset the HELLO exchange estimated)."""
     _maybe_force_cpu()
     import numpy as np
 
+    from uccl_tpu import obs
     from uccl_tpu.p2p import Endpoint
-    from uccl_tpu.serving import DenseBackend, ServingEngine
+    from uccl_tpu.serving import DenseBackend, ServingEngine, ServingMetrics
     from uccl_tpu.serving.disagg import DecodeWorker
 
+    if trace_out:
+        obs.enable_tracing()
     cfg, params = _make()
     backend = DenseBackend(params, cfg, n_slots=2, max_seq=MAX_SEQ)
     engine = ServingEngine(backend)
@@ -88,10 +104,18 @@ def stream_decode_worker(port_q, result_q, n_requests):
     dw = DecodeWorker(engine, ep)
     dw.attach()
     done = dw.serve(n_requests, timeout_s=180.0)
+    snap = engine.snapshot()
+    if trace_out:
+        obs.write_trace(trace_out, process_name="uccl_tpu.decode")
+    if metrics_out:
+        obs.write_metrics(
+            metrics_out,
+            extra_lines=ServingMetrics.prometheus_lines(snap),
+        )
     result_q.put((
         [(np.asarray(r.prompt), list(r.out_tokens), int(r.cache_hit_len))
          for r in done],
-        engine.snapshot(),
+        snap,
     ))
     ep.close()
 
@@ -103,14 +127,20 @@ def _stream_main(args) -> int:
     from uccl_tpu import obs
     from uccl_tpu.models.inference import generate
     from uccl_tpu.p2p import Endpoint
-    from uccl_tpu.serving import DenseBackend, PrefixCache, ServingEngine
+    from uccl_tpu.serving import (
+        DenseBackend, PrefixCache, ServingEngine, ServingMetrics,
+    )
     from uccl_tpu.serving.disagg import PrefillWorker
 
     ctx = mp.get_context("spawn")
     port_q, result_q = ctx.Queue(), ctx.Queue()
     worker = ctx.Process(
         target=stream_decode_worker,
-        args=(port_q, result_q, STREAM_REQUESTS),
+        args=(port_q, result_q, STREAM_REQUESTS,
+              _role_path(args.trace_out, "decode") if args.trace_out
+              else "",
+              _role_path(args.metrics_out, "decode") if args.metrics_out
+              else ""),
     )
     worker.start()
 
@@ -158,6 +188,20 @@ def _stream_main(args) -> int:
         f"split p50 queue/prefill/transfer = {split['disagg_queue_ms']}/"
         f"{split['disagg_prefill_ms']}/{split['disagg_transfer_ms']} ms"
     )
+
+    # per-role observability dumps: this (prefill) process writes the
+    # paths the CLI asked for; the decode process already wrote its
+    # _role_path siblings — together they are the fleet-trace inputs
+    written = obs.dump_from_args(
+        args, extra_lines=ServingMetrics.prometheus_lines(engine.snapshot()),
+        process_name="uccl_tpu.prefill",
+    )
+    for path in written:
+        print(f"wrote {path} (+ decode-role sibling "
+              f"{_role_path(path, 'decode')})")
+    if pw.clock_rtt_s is not None:
+        print(f"clock exchange: offset {pw.clock_offset_s * 1e6:+.1f} us, "
+              f"rtt {pw.clock_rtt_s * 1e6:.1f} us (decode vs prefill wall)")
 
     ok = len(results) == STREAM_REQUESTS and hits >= 1
     for prompt, toks, hit in results:

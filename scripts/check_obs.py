@@ -48,6 +48,20 @@ must be present and nonzero — i.e. speculation really ran, really
 accepted drafts, and throughput derives from committed tokens rather
 than an assumed one token per step.
 
+``--fleet`` mode (the fleet-tracing smoke arm: the 2-process disagg
+example dumped per-role with --trace-out/--metrics-out, merged by
+scripts/trace_merge.py and federated by uccl_tpu.obs.aggregate): the
+MERGED trace must hold >= 1 request whose events span >= 2 pids with a
+resolved cross-process flow pair (s on one pid, f on another) and
+causally ordered lifecycle stages (submit <= grant <= adopt) after clock
+alignment; the FLEET metrics must carry >= 2 replica-labeled
+``serving_ttft_seconds`` histograms whose fleet-summed ``_count`` equals
+the per-replica sum, and every replica exporting a sample-derived
+``uccl_serving_ttft_ms`` percentile must agree with its own
+histogram-derived percentile within one bucket width — i.e. tracing
+crossed the process boundary, the clocks aligned, and the merge-safe
+histograms tell the same story as the exact in-process samples.
+
 ``--router`` mode (the replica-router smoke arm, serve --server
 --replicas N --priority-classes ... --metrics-out): the metrics file
 must carry ≥2 replica-labeled ``serving_router_requests_total`` series
@@ -297,7 +311,204 @@ def check_router_metrics(path: str) -> None:
           f"per-class percentile series present")
 
 
+def _parse_prom_labeled(path):
+    """[(name, {label: value}, float)] from a Prometheus text file —
+    enough label-awareness for the fleet checks (stdlib-only)."""
+    import re
+
+    sample = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$'
+    )
+    label = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    out = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            m = sample.match(ln)
+            if not m:
+                continue
+            try:
+                v = float(m.group(3))
+            except ValueError:
+                continue
+            labels = {k: raw for k, raw in label.findall(m.group(2) or "")}
+            out.append((m.group(1), labels, v))
+    return out
+
+
+def _hist_quantile(uppers, counts, q):
+    """Quantile off per-bucket counts (last = +Inf overflow); returns
+    (value, width of its bucket) or (None, None) when empty — the
+    stdlib mirror of obs.histogram_quantile/bucket_width."""
+    n = sum(counts)
+    if n == 0:
+        return None, None
+    target = 1.0 + (n - 1) * q / 100.0  # the obs.histogram_quantile rank
+    cum = 0
+    for i, c in enumerate(counts):
+        if c and cum + c >= target:
+            if i >= len(uppers):
+                return float(uppers[-1]), float("inf")
+            lo = uppers[i - 1] if i > 0 else 0.0
+            hi = uppers[i]
+            return lo + (hi - lo) * (target - cum) / c, hi - lo
+        cum += c
+    return float(uppers[-1]), float("inf")
+
+
+def _width_at(uppers, v):
+    """Width of the bucket containing value ``v`` (inf for overflow)."""
+    import bisect
+
+    i = bisect.bisect_left(uppers, v)
+    if i >= len(uppers):
+        return float("inf")
+    return uppers[i] - (uppers[i - 1] if i > 0 else 0.0)
+
+
+def _replica_hist(samples, family, replica):
+    """(uppers, per-bucket counts) of one replica's histogram, from its
+    cumulative ``_bucket`` lines."""
+    buckets = []
+    for name, labels, v in samples:
+        if name != f"{family}_bucket" or labels.get("replica") != replica:
+            continue
+        le = labels.get("le")
+        if le is None:
+            continue
+        buckets.append((float("inf") if le == "+Inf" else float(le), v))
+    if not buckets:
+        return None, None
+    buckets.sort()
+    uppers = [u for u, _ in buckets if u != float("inf")]
+    cum = [c for _, c in buckets]
+    counts = [cum[0]] + [cum[i] - cum[i - 1] for i in range(1, len(cum))]
+    return uppers, counts
+
+
+def check_fleet_trace(path: str) -> None:
+    with open(path) as f:
+        trace = json.loads(f.read())
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        fail(f"{path}: no traceEvents")
+    by_trace = defaultdict(list)
+    flows = defaultdict(lambda: {"s": set(), "f": set()})
+    for ev in evs:
+        if ev.get("ph") in ("s", "f"):
+            flows[str(ev.get("id"))][ev["ph"]].add(ev["pid"])
+        tid = (ev.get("args") or {}).get("trace_id")
+        if tid:
+            by_trace[tid].append(ev)
+    cross = 0
+    for tid, tevs in by_trace.items():
+        pids = {ev["pid"] for ev in tevs}
+        if len(pids) < 2:
+            continue
+        try:
+            fid = str(int(tid[:15], 16))
+        except ValueError:
+            continue
+        sf = flows.get(fid)
+        if not (sf and sf["s"] and sf["f"] and sf["s"] != sf["f"]):
+            continue
+        # causal order on the aligned timeline: submit <= grant <= adopt
+        # (BEGIN <= GRANT <= FINAL in stream terms; local finishes are
+        # not globally ordered — the prefill fleet's 1-token request
+        # finishes before the decode side adopts)
+        stages = {}
+        for ev in tevs:
+            if ev["name"] in ("submit", "grant", "adopt") \
+                    and ev["name"] not in stages:
+                stages[ev["name"]] = ev["ts"]
+        chain = [stages[n] for n in ("submit", "grant", "adopt")
+                 if n in stages]
+        if len(chain) < 3:
+            fail(f"{path}: trace {tid} spans {sorted(pids)} but misses "
+                 f"lifecycle stages (have {sorted(stages)}) — the remote "
+                 f"side never stamped its events")
+        if chain != sorted(chain):
+            fail(f"{path}: trace {tid} lifecycle out of causal order "
+                 f"after alignment ({stages})")
+        cross += 1
+    if cross < 1:
+        fail(f"{path}: no request with flow-linked spans across >= 2 "
+             f"processes — cross-process tracing never happened "
+             f"({len(by_trace)} trace id(s) seen)")
+    print(f"check_obs: fleet trace OK — {cross} cross-process "
+          f"request(s), {len(by_trace)} trace id(s)")
+
+
+def check_fleet_metrics(path: str) -> None:
+    samples = _parse_prom_labeled(path)
+    fam = "serving_ttft_seconds"
+    replicas = sorted({lb["replica"] for n, lb, _ in samples
+                       if n == f"{fam}_count" and "replica" in lb})
+    if len(replicas) < 2:
+        fail(f"{path}: {len(replicas)} replica-labeled {fam} histogram(s) "
+             f"— the aggregate does not span a fleet "
+             f"(replicas: {replicas})")
+    per_rep_counts = {
+        r: sum(v for n, lb, v in samples
+               if n == f"{fam}_count" and lb.get("replica") == r)
+        for r in replicas
+    }
+    fleet_count = sum(v for n, lb, v in samples
+                      if n == f"{fam}_count" and "replica" not in lb)
+    if fleet_count != sum(per_rep_counts.values()):
+        fail(f"{path}: fleet {fam}_count {fleet_count} != per-replica sum "
+             f"{sum(per_rep_counts.values())} — histogram summation broke")
+    if fleet_count <= 0:
+        fail(f"{path}: fleet {fam} histogram is empty — no TTFT was ever "
+             f"observed")
+    checked = 0
+    for r in replicas:
+        uppers, counts = _replica_hist(samples, fam, r)
+        if uppers is None:
+            fail(f"{path}: replica {r} exports no {fam}_bucket series")
+        for q in (50, 95):
+            sample_ms = [v for n, lb, v in samples
+                         if n == "uccl_serving_ttft_ms"
+                         and lb.get("replica") == r
+                         and lb.get("q") == f"p{q}"]
+            if not sample_ms:
+                continue  # this replica had no completed samples
+            hist_s, width_s = _hist_quantile(uppers, counts, q)
+            if hist_s is None:
+                fail(f"{path}: replica {r} has sample p{q} but an empty "
+                     f"histogram — the two derivations diverged")
+            diff_ms = abs(hist_s * 1e3 - sample_ms[0])
+            # tolerance: one bucket width at EACH derivation's value. The
+            # histogram lands in the bucket of the order statistic at
+            # rank ceil(1+(n-1)q/100) while the sample percentile
+            # interpolates between that statistic and its predecessor —
+            # when the two straddle a bucket edge the values sit in
+            # different buckets, so a single-bucket tolerance (measured
+            # at the histogram alone) could fail a healthy run
+            tol_ms = (width_s + _width_at(uppers,
+                                          sample_ms[0] / 1e3)) * 1e3
+            if diff_ms > tol_ms + 1e-9:
+                fail(f"{path}: replica {r} TTFT p{q} disagrees — "
+                     f"histogram {hist_s * 1e3:.3f} ms vs samples "
+                     f"{sample_ms[0]:.3f} ms (diff {diff_ms:.3f} > "
+                     f"tolerance {tol_ms:.3f} ms)")
+            checked += 1
+    if checked < 1:
+        fail(f"{path}: no replica exported sample-derived "
+             f"uccl_serving_ttft_ms percentiles to cross-check")
+    print(f"check_obs: fleet metrics OK — {len(replicas)} replicas, "
+          f"fleet count {int(fleet_count)}, {checked} histogram-vs-sample "
+          f"percentile cross-check(s) within one bucket width")
+
+
 def main(argv) -> None:
+    if len(argv) == 4 and argv[1] == "--fleet":
+        check_fleet_trace(argv[2])
+        check_fleet_metrics(argv[3])
+        print("check_obs: ALL OK")
+        return
     if len(argv) == 3 and argv[1] == "--router":
         check_router_metrics(argv[2])
         print("check_obs: ALL OK")
@@ -324,7 +535,8 @@ def main(argv) -> None:
              "check_obs.py --plan METRICS_PROM BENCH_JSON | "
              "check_obs.py --disagg METRICS_PROM | "
              "check_obs.py --spec METRICS_PROM | "
-             "check_obs.py --router METRICS_PROM")
+             "check_obs.py --router METRICS_PROM | "
+             "check_obs.py --fleet MERGED_TRACE FLEET_PROM")
     check_trace(argv[1])
     check_metrics(argv[2])
     print("check_obs: ALL OK")

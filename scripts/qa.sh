@@ -47,10 +47,17 @@ JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --st
   --metrics-out /tmp/qa_router_metrics.prom; check $?
 python scripts/check_obs.py --router /tmp/qa_router_metrics.prom; check $?
 
-note "disagg serving smoke tier (prefill+decode worker pair over p2p: chunk-streamed KV, >=1 prefix-cache hit, oracle-exact, telemetry validated)"
+note "disagg serving smoke tier (prefill+decode worker pair over p2p: chunk-streamed KV, >=1 prefix-cache hit, oracle-exact, telemetry validated; per-role trace/metrics dumps feed the fleet tier below)"
 UCCL_TPU_EXAMPLE_CPU=1 JAX_PLATFORMS=cpu timeout 600 python examples/disagg_kv.py --cpu \
-  --metrics-out /tmp/qa_disagg_metrics.prom; check $?
+  --trace-out /tmp/qa_fleet_trace.json --metrics-out /tmp/qa_disagg_metrics.prom; check $?
 python scripts/check_obs.py --disagg /tmp/qa_disagg_metrics.prom; check $?
+
+note "fleet tracing smoke tier (merge the 2 processes' traces clock-aligned, federate their metrics: >=1 flow-linked cross-process request timeline, BEGIN<=GRANT<=FINAL after alignment, fleet histogram p50/p95 within one bucket of the per-replica sample percentiles)"
+python scripts/trace_merge.py --out /tmp/qa_fleet_merged.json \
+  /tmp/qa_fleet_trace.json /tmp/qa_fleet_trace.decode.json; check $?
+python -m uccl_tpu.obs.aggregate --out /tmp/qa_fleet.prom \
+  prefill=/tmp/qa_disagg_metrics.prom decode=/tmp/qa_disagg_metrics.decode.prom; check $?
+python scripts/check_obs.py --fleet /tmp/qa_fleet_merged.json /tmp/qa_fleet.prom; check $?
 
 note "observability smoke tier (2-slot serving run traced end to end: Chrome-trace lifecycle timelines + Prometheus metrics validate)"
 JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \

@@ -122,6 +122,121 @@ def _phase_counts(trace):
     return by_track, b, e_
 
 
+class TestHistograms:
+    def _fam(self, reg=None):
+        reg = reg if reg is not None else obs.Registry()
+        return reg.histogram("lat_seconds", "test latencies",
+                             buckets=[0.001, 0.01, 0.1, 1.0])
+
+    def test_observe_and_text_export(self):
+        reg = obs.Registry()
+        h = self._fam(reg)
+        for v in (0.0005, 0.0005, 0.05, 5.0):
+            h.observe(v)
+        reg.histogram("declared_empty_seconds", "exists as zeros")
+        txt = obs.prometheus_text(reg)
+        assert "# TYPE lat_seconds histogram" in txt
+        # cumulative buckets, inclusive le, +Inf last
+        assert 'lat_seconds_bucket{le="0.001"} 2' in txt
+        assert 'lat_seconds_bucket{le="0.01"} 2' in txt
+        assert 'lat_seconds_bucket{le="0.1"} 3' in txt
+        assert 'lat_seconds_bucket{le="+Inf"} 4' in txt
+        assert "lat_seconds_sum 5.051" in txt
+        assert "lat_seconds_count 4" in txt
+        # declared-but-empty family still exports an assertable series
+        assert 'declared_empty_seconds_bucket{le="+Inf"} 0' in txt
+        assert "declared_empty_seconds_count 0" in txt
+
+    def test_labels_and_inclusive_edge(self):
+        h = self._fam()
+        h.observe(0.001, cls="a")  # == the edge: le is inclusive
+        h.observe(0.002, cls="b")
+        assert h.get(cls="a") == 1 and h.total() == 2
+        (labels, counts, s), = [x for x in h.hist_samples()
+                                if x[0] == {"cls": "a"}]
+        assert counts[0] == 1 and sum(counts) == 1
+
+    def test_quantile_matches_samples_within_bucket_width(self, rng):
+        from uccl_tpu.serving.metrics import percentile
+
+        h = obs.Registry().histogram(
+            "q_seconds", buckets=obs.DEFAULT_LATENCY_BUCKETS
+        )
+        xs = list(rng.lognormal(-4.0, 1.5, 200))
+        for v in xs:
+            h.observe(v)
+        for q in (50, 95):
+            hv = h.quantile(q)
+            sv = percentile(xs, q)
+            assert abs(hv - sv) <= obs.bucket_width(h.uppers, hv), (q, hv, sv)
+
+    def test_merge_safety_sum_equals_union(self, rng):
+        """The fleet-aggregation property: two processes' bucket counts
+        SUM into the distribution one process observing everything would
+        have recorded — bit-exact, not approximate."""
+        a, b = self._fam(), self._fam()
+        union = self._fam()
+        xs, ys = rng.exponential(0.05, 50), rng.exponential(0.5, 70)
+        for v in xs:
+            a.observe(v)
+            union.observe(v)
+        for v in ys:
+            b.observe(v)
+            union.observe(v)
+        (_, ca, sa), = a.hist_samples()
+        (_, cb, sb), = b.hist_samples()
+        (_, cu, su), = union.hist_samples()
+        assert [x + y for x, y in zip(ca, cb)] == cu
+        assert abs((sa + sb) - su) < 1e-9
+        for q in (50, 95):
+            assert obs.histogram_quantile(
+                a.uppers, [x + y for x, y in zip(ca, cb)], q
+            ) == union.quantile(q)
+
+    def test_bucket_mismatch_and_type_conflict_rejected(self):
+        reg = obs.Registry()
+        reg.histogram("h_seconds", buckets=[0.1, 1.0])
+        with pytest.raises(ValueError):
+            reg.histogram("h_seconds", buckets=[0.2, 1.0])
+        with pytest.raises(TypeError):
+            reg.counter("h_seconds")
+
+    def test_serving_hooks_observe_histograms(self):
+        """The lifecycle hooks feed the merge-safe histograms the SAME
+        values they append as samples — the within-one-bucket agreement
+        the fleet gate rests on."""
+        from uccl_tpu.serving.metrics import (
+            TTFT_HIST, ServingMetrics, reset_latency_histograms,
+        )
+
+        reset_latency_histograms()
+        m = ServingMetrics()
+        rng = np.random.default_rng(1)
+        eng = ServingEngine(_StubBackend(n_slots=2))
+        eng.submit(_prompt(rng, 4), max_new_tokens=2)
+        eng.drain()
+        assert TTFT_HIST.total() == 1
+        assert len(eng.metrics.ttft_s) == 1
+        assert abs(TTFT_HIST.quantile(50) - eng.metrics.ttft_s[0]) \
+            <= obs.bucket_width(TTFT_HIST.uppers, TTFT_HIST.quantile(50))
+        eng.reset_metrics()  # warmup reset clears the histograms too
+        assert TTFT_HIST.total() == 0
+        del m
+
+    def test_trace_dropped_total_exported(self):
+        obs.disable_tracing()
+        txt = obs.prometheus_text(obs.Registry())
+        assert "obs_trace_dropped_total 0" in txt
+        t = obs.enable_tracing(4)
+        try:
+            for i in range(10):
+                t.instant(f"e{i}", track="x")
+            txt = obs.prometheus_text(obs.Registry())
+            assert "obs_trace_dropped_total 6" in txt
+        finally:
+            obs.disable_tracing()
+
+
 class TestChromeTrace:
     def test_valid_json_balanced_and_nonnegative(self, tracer):
         obs.begin("open-span", track="manual")
@@ -145,6 +260,25 @@ class TestChromeTrace:
         trace = obs.to_chrome_trace()
         _, b, e_ = _phase_counts(trace)
         assert b == e_ == Counter()
+
+    def test_flow_events_and_clock_metadata(self, tracer):
+        fid = obs.flow_id("deadbeefcafe0123")
+        with obs.span("tx", track="wire"):
+            obs.flow_start("kv_handoff", fid, track="wire")
+        with obs.span("import", track="wire"):
+            obs.flow_end("kv_handoff", fid, track="wire")
+        obs.set_clock_offset(-1234.5, rtt_us=80.0, peer="prefill")
+        trace = obs.to_chrome_trace(process_name="uccl_tpu.decode")
+        s = [e for e in trace["traceEvents"] if e["ph"] == "s"]
+        f = [e for e in trace["traceEvents"] if e["ph"] == "f"]
+        assert len(s) == len(f) == 1
+        assert s[0]["id"] == f[0]["id"] == fid
+        assert s[0]["cat"] == "flow" and f[0]["bp"] == "e"
+        clock = trace["otherData"]["clock"]
+        assert clock["offset_us"] == -1234.5
+        assert clock["rtt_us"] == 80.0 and clock["peer"] == "prefill"
+        assert clock["wall_epoch_us"] > 0
+        assert trace["otherData"]["process_name"] == "uccl_tpu.decode"
 
 
 class TestRequestLifecycle:

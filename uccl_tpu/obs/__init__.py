@@ -6,8 +6,14 @@ all host-only and jax-free:
 * :mod:`uccl_tpu.obs.tracer` — thread-safe ring-buffered event tracer
   (spans + instants, monotonic timestamps, per-thread tracks, bounded
   memory, zero-cost when disabled);
-* :mod:`uccl_tpu.obs.counters` — labeled counter/gauge registry + pull
-  sources (absorbs and supersedes ``utils.stats``'s registration surface);
+* :mod:`uccl_tpu.obs.counters` — labeled counter/gauge/histogram registry
+  + pull sources (absorbs and supersedes ``utils.stats``'s registration
+  surface); histograms are the merge-safe fleet latency surface
+  (:mod:`uccl_tpu.obs.aggregate` sums N workers' exports);
+* :mod:`uccl_tpu.obs.context` — cross-process trace context (trace ids
+  minted at request ingress, carried in disagg control notifs, bound
+  across processes by Chrome-trace flow events) + the RTT-midpoint
+  clock-offset estimator behind ``scripts/trace_merge.py``;
 * :mod:`uccl_tpu.obs.chrome_trace` / :mod:`uccl_tpu.obs.export` — the
   Chrome-trace/Perfetto JSON exporter and the Prometheus-text ``/metrics``
   + JSON ``/snapshot`` surfaces (file dump via ``--trace-out`` /
@@ -28,11 +34,16 @@ just dict adds).
 """
 
 from uccl_tpu.obs.counters import (  # noqa: F401
-    REGISTRY, CounterFamily, GaugeFamily, Registry, counter,
-    escape_label_value, gauge, sanitize_name,
+    DEFAULT_LATENCY_BUCKETS, REGISTRY, CounterFamily, GaugeFamily,
+    HistogramFamily, Registry, bucket_width, counter, escape_label_value,
+    gauge, histogram, histogram_quantile, log_buckets, sanitize_name,
+)
+from uccl_tpu.obs.context import (  # noqa: F401
+    TraceContext, estimate_clock_offset, flow_id, new_context,
 )
 from uccl_tpu.obs.tracer import (  # noqa: F401
-    Event, Tracer, begin, complete, end, get_tracer, instant, span,
+    Event, Tracer, begin, complete, end, flow_end, flow_start, get_tracer,
+    instant, set_clock_offset, span,
 )
 from uccl_tpu.obs.tracer import enable as enable_tracing  # noqa: F401
 from uccl_tpu.obs.tracer import disable as disable_tracing  # noqa: F401
@@ -45,9 +56,13 @@ from uccl_tpu.obs.export import (  # noqa: F401
 from uccl_tpu.obs.chrome_trace import to_chrome_trace  # noqa: F401
 
 __all__ = [
-    "REGISTRY", "CounterFamily", "GaugeFamily", "Registry", "counter",
-    "gauge", "sanitize_name", "escape_label_value", "Event", "Tracer",
+    "REGISTRY", "CounterFamily", "GaugeFamily", "HistogramFamily",
+    "Registry", "counter", "gauge", "histogram", "histogram_quantile",
+    "bucket_width", "log_buckets", "DEFAULT_LATENCY_BUCKETS",
+    "sanitize_name", "escape_label_value", "Event", "Tracer",
     "begin", "complete", "end", "get_tracer", "instant", "span",
+    "flow_start", "flow_end", "set_clock_offset",
+    "TraceContext", "new_context", "flow_id", "estimate_clock_offset",
     "enable_tracing", "disable_tracing", "tracing_enabled",
     "SCHEMA_VERSION", "MetricsServer", "add_cli_args", "dump_at_exit",
     "dump_from_args", "json_snapshot", "prometheus_text", "setup_from_args",

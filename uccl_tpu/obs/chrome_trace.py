@@ -13,7 +13,15 @@ Format notes (the parts tools are strict about):
 * ``i`` (instant) events carry a scope ``s`` ("t" = thread-scoped);
 * every ``B`` should be closed by an ``E`` on the same pid/tid —
   :func:`to_chrome_trace` closes any still-open ``B`` at the trace's end
-  timestamp rather than emitting an unbalanced file.
+  timestamp rather than emitting an unbalanced file;
+* flow events (``s``/``f``) carry ``cat`` + ``id`` (the s/f pair binds by
+  both), and the finish end binds to its enclosing slice (``bp: "e"``).
+
+Fleet metadata: ``otherData.clock`` records the tracer's wall-clock anchor
+(``wall_epoch_us`` — wall time of monotonic ts 0) and the process's
+estimated offset from the fleet reference clock (``offset_us``, set by the
+disagg HELLO clock exchange). ``scripts/trace_merge.py`` reads exactly
+these fields to align N per-process trace files onto one timeline.
 """
 
 from __future__ import annotations
@@ -68,6 +76,11 @@ def to_chrome_trace(tracer: Optional[Tracer] = None, *,
             rec["dur"] = round(max(0.0, ev.dur_us), 3)
         elif ev.ph == "i":
             rec["s"] = "t"
+        elif ev.ph in ("s", "f"):
+            rec["cat"] = "flow"
+            rec["id"] = ev.fid
+            if ev.ph == "f":
+                rec["bp"] = "e"  # bind to the enclosing slice
         elif ev.ph == "B":
             open_b.setdefault(t, []).append(ev.name)
         elif ev.ph == "E":
@@ -87,10 +100,19 @@ def to_chrome_trace(tracer: Optional[Tracer] = None, *,
     trace = {
         "traceEvents": out,
         "displayTimeUnit": "ms",
-        "otherData": {"producer": "uccl_tpu.obs"},
+        "otherData": {"producer": "uccl_tpu.obs",
+                      "process_name": process_name},
     }
-    if tracer is not None and tracer.dropped:
-        trace["otherData"]["dropped_events"] = tracer.dropped
+    if tracer is not None:
+        # per-process clock metadata — the merge tool's alignment inputs
+        clock = {
+            "wall_epoch_us": round(tracer.wall_epoch_us, 3),
+            "offset_us": round(tracer.clock_offset_us, 3),
+        }
+        clock.update(tracer.clock_meta)
+        trace["otherData"]["clock"] = clock
+        if tracer.dropped:
+            trace["otherData"]["dropped_events"] = tracer.dropped
     return trace
 
 

@@ -4,10 +4,14 @@ Three consumers, one source of truth (:data:`uccl_tpu.obs.counters.REGISTRY`
 and the global tracer):
 
 * **Prometheus text** (:func:`prometheus_text`) — counters/gauges with
-  labels, plus every pull source's numeric leaves flattened to gauges
-  (``<source>_<path>``), all through the shared sanitizer. Declared-but-
-  empty counter families export an unlabeled 0 sample so dashboards and CI
-  can assert a series exists before its first event.
+  labels, histogram families as merge-safe ``_bucket``/``_sum``/``_count``
+  lines (identical log-spaced edges in every process, so N workers'
+  exports SUM — obs/aggregate.py federates them), the live tracer's ring
+  drops as ``obs_trace_dropped_total``, plus every pull source's numeric
+  leaves flattened to gauges (``<source>_<path>``), all through the shared
+  sanitizer. Declared-but-empty families export an unlabeled 0 sample (or
+  an all-zero histogram) so dashboards and CI can assert a series exists
+  before its first event.
 * **JSON snapshot** (:func:`json_snapshot`) — the registry's snapshot plus
   tracer stats, schema-versioned.
 * **files / HTTP** — ``--trace-out`` / ``--metrics-out`` dump files from
@@ -25,7 +29,7 @@ from typing import Dict, List, Optional
 
 from uccl_tpu.obs import chrome_trace, tracer as _tracer
 from uccl_tpu.obs.counters import (
-    REGISTRY, Registry, escape_label_value, sanitize_name,
+    REGISTRY, Registry, escape_label_value, fmt_value, sanitize_name,
 )
 
 __all__ = [
@@ -49,6 +53,38 @@ def _flatten(prefix: str, node, out: Dict[str, float]) -> None:
         out[sanitize_name(prefix)] = float(node)
 
 
+def _label_str(labels: Dict[str, str]) -> str:
+    return ",".join(
+        f'{sanitize_name(k)}="{escape_label_value(str(v))}"'
+        for k, v in sorted(labels.items())
+    )
+
+
+def _histogram_lines(fam, lines: List[str]) -> None:
+    """One labeled histogram as Prometheus ``_bucket``/``_sum``/``_count``
+    lines (cumulative buckets, inclusive ``le``, ``+Inf`` last). Identical
+    bucket edges across processes make these lines SUMMABLE — the merge
+    property obs/aggregate.py federates on."""
+    name = sanitize_name(fam.name)
+    samples = fam.hist_samples()
+    if not samples:
+        # declared-but-empty: an all-zero unlabeled histogram, so the
+        # series is assertable before its first observation (the counter
+        # families' rule, docs/OBSERVABILITY.md)
+        samples = [({}, [0] * (len(fam.uppers) + 1), 0.0)]
+    for labels, counts, total in samples:
+        lbl = _label_str(labels)
+        cum = 0
+        for ub, c in zip(list(fam.uppers) + ["+Inf"], counts):
+            cum += c
+            le = ub if isinstance(ub, str) else _fmt(ub)
+            sep = "," if lbl else ""
+            lines.append(f'{name}_bucket{{{lbl}{sep}le="{le}"}} {cum}')
+        suffix = f"{{{lbl}}}" if lbl else ""
+        lines.append(f"{name}_sum{suffix} {_fmt(total)}")
+        lines.append(f"{name}_count{suffix} {cum}")
+
+
 def prometheus_text(registry: Registry = REGISTRY,
                     extra_lines: Optional[List[str]] = None) -> str:
     """The registry in Prometheus text exposition format."""
@@ -58,6 +94,9 @@ def prometheus_text(registry: Registry = REGISTRY,
         if fam.help:
             lines.append(f"# HELP {name} {fam.help}")
         lines.append(f"# TYPE {name} {fam.kind}")
+        if fam.kind == "histogram":
+            _histogram_lines(fam, lines)
+            continue
         samples = fam.samples()
         if not samples:
             # a declared family with no events yet still exports its series
@@ -65,13 +104,18 @@ def prometheus_text(registry: Registry = REGISTRY,
             continue
         for labels, value in samples:
             if labels:
-                lbl = ",".join(
-                    f'{sanitize_name(k)}="{escape_label_value(str(v))}"'
-                    for k, v in sorted(labels.items())
+                lines.append(
+                    f"{name}{{{_label_str(labels)}}} {_fmt(value)}"
                 )
-                lines.append(f"{name}{{{lbl}}} {_fmt(value)}")
             else:
                 lines.append(f"{name} {_fmt(value)}")
+    # the tracer's silent ring drops, surfaced as a counter: a truncated
+    # trace is visible in every scrape, not just in the dump footer
+    t = _tracer.get_tracer()
+    lines.append("# TYPE obs_trace_dropped_total counter")
+    lines.append(
+        f"obs_trace_dropped_total {int(t.dropped) if t is not None else 0}"
+    )
     for src, snap in sorted(registry.sources_snapshot().items()):
         flat: Dict[str, float] = {}
         _flatten(sanitize_name(src), snap, flat)
@@ -83,8 +127,7 @@ def prometheus_text(registry: Registry = REGISTRY,
     return "\n".join(lines) + "\n"
 
 
-def _fmt(v: float) -> str:
-    return str(int(v)) if float(v).is_integer() else repr(float(v))
+_fmt = fmt_value  # shared with aggregate.py so the exporters cannot drift
 
 
 def json_snapshot(registry: Registry = REGISTRY) -> Dict:
@@ -106,16 +149,22 @@ def write_metrics(path: str, registry: Registry = REGISTRY,
     return path
 
 
-def write_trace(path: str) -> str:
-    return chrome_trace.dump(path)
+def write_trace(path: str, process_name: str = "uccl_tpu") -> str:
+    return chrome_trace.dump(path, process_name=process_name)
 
 
 class MetricsServer:
     """``/metrics`` (Prometheus text) + ``/snapshot`` (JSON) on a daemon
     thread. ``extra_lines_fn`` lets the owner append live series (the
-    serving engine's percentile lines) to each /metrics scrape."""
+    serving engine's percentile lines) to each /metrics scrape.
 
-    def __init__(self, port: int, registry: Registry = REGISTRY,
+    ``port=0`` (the default) binds an EPHEMERAL port — the fleet-safe
+    choice: two workers starting on one host with a fixed default port
+    would race to bind and one would crash. The bound port is always on
+    ``self.port`` and in the start log; a fleet aggregator
+    (obs/aggregate.py) collects the per-worker ports from there."""
+
+    def __init__(self, port: int = 0, registry: Registry = REGISTRY,
                  extra_lines_fn=None):
         import http.server
 
@@ -152,6 +201,10 @@ class MetricsServer:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        from uccl_tpu.utils.logging import log
+
+        log("INFO", "metrics server listening on 127.0.0.1:%d "
+            "(/metrics + /snapshot)", self.port, subsys="UTIL")
 
     def close(self) -> None:
         self._httpd.shutdown()
@@ -184,12 +237,15 @@ def setup_from_args(args, capacity: int = 65536) -> None:
 _dumped_args: set = set()  # id(args) namespaces an explicit dump already ran
 
 
-def dump_from_args(args, extra_lines: Optional[List[str]] = None
-                   ) -> List[str]:
-    """Write the files the CLI asked for; returns the paths written."""
+def dump_from_args(args, extra_lines: Optional[List[str]] = None,
+                   process_name: str = "uccl_tpu") -> List[str]:
+    """Write the files the CLI asked for; returns the paths written.
+    ``process_name`` labels the trace's process row — per-role names
+    (``uccl_tpu.prefill``/``uccl_tpu.decode``) keep merged fleet traces
+    readable (scripts/trace_merge.py)."""
     written = []
     if getattr(args, "trace_out", ""):
-        written.append(write_trace(args.trace_out))
+        written.append(write_trace(args.trace_out, process_name))
     if getattr(args, "metrics_out", ""):
         written.append(write_metrics(args.metrics_out,
                                      extra_lines=extra_lines))

@@ -27,7 +27,17 @@ never interleave on one row; instrumentation that owns a logical timeline
 
 Event phases follow the Chrome-trace vocabulary: ``X`` (complete span with
 a duration — what :func:`span`/:meth:`Tracer.complete` emit), ``B``/``E``
-(open/close pairs for spans that cross call boundaries), ``i`` (instant).
+(open/close pairs for spans that cross call boundaries), ``i`` (instant),
+and ``s``/``f`` flow start/finish pairs (:meth:`Tracer.flow`) whose shared
+``fid`` binds two spans — possibly in DIFFERENT processes' traces, once
+merged by ``scripts/trace_merge.py`` — into one Perfetto arrow.
+
+Fleet clocks: each tracer records ``wall_epoch_us`` (the wall-clock time of
+its monotonic ts 0) at construction, and :meth:`set_clock_offset` stores
+the process's estimated wall-clock offset from the fleet's reference
+process (the disagg HELLO clock exchange, obs/context.py). Both land in
+the exported trace's ``otherData.clock`` so the merge tool can place N
+per-process traces on one causally ordered timeline.
 """
 
 from __future__ import annotations
@@ -41,20 +51,23 @@ from typing import Dict, List, NamedTuple, Optional
 __all__ = [
     "Event", "Tracer", "enable", "disable", "enabled", "get_tracer",
     "span", "instant", "begin", "end", "complete",
+    "flow_start", "flow_end", "set_clock_offset",
 ]
 
 
 class Event(NamedTuple):
     """One trace event. ``ts_us`` is microseconds since the tracer's epoch;
     ``dur_us`` is only meaningful for ``ph == "X"``; ``args`` is a small
-    JSON-ready dict (or None)."""
+    JSON-ready dict (or None); ``fid`` is the flow-event id, set only for
+    ``ph in ("s", "f")``."""
 
     name: str
-    ph: str  # "X" | "B" | "E" | "i"
+    ph: str  # "X" | "B" | "E" | "i" | "s" | "f"
     ts_us: float
     dur_us: float
     track: str
     args: Optional[dict]
+    fid: Optional[int] = None
 
 
 class Tracer:
@@ -66,14 +79,34 @@ class Tracer:
         self.capacity = capacity
         self._buf: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        # wall anchor first, monotonic epoch immediately after: the pair
+        # relates ts 0 to the wall clock (the merge tool's per-file
+        # alignment anchor); the sub-µs gap between the two reads is far
+        # below the cross-process offset the anchor exists to absorb
+        self.wall_epoch_us = time.time() * 1e6
         self._t0 = time.perf_counter()
         self._threads: Dict[int, str] = {}  # ident -> auto track label
         self.dropped = 0
+        # this process's estimated wall-clock offset from the fleet's
+        # reference process (0 until a clock exchange sets it); clock_meta
+        # carries the estimate's provenance (rtt, peer, source)
+        self.clock_offset_us = 0.0
+        self.clock_meta: Dict = {}
 
     # -- clock ---------------------------------------------------------------
     def now_us(self) -> float:
         """Microseconds since this tracer's epoch (monotonic)."""
         return (time.perf_counter() - self._t0) * 1e6
+
+    def set_clock_offset(self, offset_us: float, **meta) -> None:
+        """Record this process's estimated wall-clock offset from the
+        fleet's reference process (``local_wall - reference_wall``, µs).
+        The merge tool subtracts it when aligning this trace's timestamps
+        (docs/OBSERVABILITY.md). ``meta`` (rtt_us, peer, ...) is exported
+        verbatim in the trace's ``otherData.clock``."""
+        with self._lock:
+            self.clock_offset_us = float(offset_us)
+            self.clock_meta = dict(meta)
 
     # -- recording -----------------------------------------------------------
     def _track(self, track: Optional[str]) -> str:
@@ -116,6 +149,20 @@ class Tracer:
         several tracks (e.g. a batched prefill covering many requests)."""
         self._record(Event(name, "X", ts_us, max(0.0, dur_us),
                            self._track(track), args or None))
+
+    def flow(self, name: str, ph: str, fid: int,
+             track: Optional[str] = None,
+             ts_us: Optional[float] = None) -> None:
+        """Record a flow start ("s") or finish ("f") event. The s/f pair
+        sharing ``fid`` (and ``name``) binds the spans enclosing their
+        timestamps into one Perfetto arrow — pass ``ts_us`` INSIDE the
+        span the flow should attach to (Chrome binds a flow event to the
+        slice containing its timestamp on that track)."""
+        if ph not in ("s", "f"):
+            raise ValueError(f"flow phase must be 's' or 'f', got {ph!r}")
+        self._record(Event(name, ph,
+                           self.now_us() if ts_us is None else ts_us,
+                           0.0, self._track(track), None, int(fid)))
 
     @contextlib.contextmanager
     def span(self, name: str, track: Optional[str] = None, **args):
@@ -211,3 +258,25 @@ def complete(name: str, ts_us: float, dur_us: float,
     t = _tracer
     if t is not None:
         t.complete(name, ts_us, dur_us, track, **args)
+
+
+def flow_start(name: str, fid: int, track: Optional[str] = None,
+               ts_us: Optional[float] = None) -> None:
+    t = _tracer
+    if t is not None:
+        t.flow(name, "s", fid, track, ts_us)
+
+
+def flow_end(name: str, fid: int, track: Optional[str] = None,
+             ts_us: Optional[float] = None) -> None:
+    t = _tracer
+    if t is not None:
+        t.flow(name, "f", fid, track, ts_us)
+
+
+def set_clock_offset(offset_us: float, **meta) -> None:
+    """Record the process's clock offset on the global tracer (no-op when
+    tracing is off — the estimate still lives on whoever measured it)."""
+    t = _tracer
+    if t is not None:
+        t.set_clock_offset(offset_us, **meta)

@@ -358,6 +358,17 @@ def _serve_continuous(args, saved_cfg):
 
     snap = target.snapshot()
     target.close()
+    # histogram-derived TTFT percentiles beside the sample-derived ones
+    # (snap["ttft_ms"]): warmup reset both, so the two derivations cover
+    # the same observations and must agree within one bucket width — the
+    # recorded cross-check for the merge-safe fleet path
+    # (docs/OBSERVABILITY.md)
+    from uccl_tpu.serving.metrics import TTFT_HIST
+
+    ttft_hist_ms = {
+        f"p{q}": round(v * 1e3, 3) for q in (50, 95)
+        for v in [TTFT_HIST.quantile(q)] if v is not None
+    }
     written = obs.dump_from_args(
         args, extra_lines=ServingMetrics.prometheus_lines(snap)
     )
@@ -376,7 +387,7 @@ def _serve_continuous(args, saved_cfg):
         "preempt": preempt,
         "interactive_frac": (args.interactive_frac
                              if args.priority_classes else None),
-        "wall_s": round(wall, 3), **snap,
+        "wall_s": round(wall, 3), "ttft_hist_ms": ttft_hist_ms, **snap,
     }
     if reqs:
         print(f"first request: {reqs[0].out_tokens}", flush=True)

@@ -34,6 +34,20 @@ JSON notifs per request — BEGIN (prompt + timing), GRANT (slot), FINAL
 Control-plane timestamps are wall-clock (``time.time()``): the TTFT split
 (queue / prefill / transfer) spans two processes, where the engines'
 monotonic clocks share no epoch.
+
+Distributed tracing (docs/OBSERVABILITY.md): every submitted request's
+:class:`~uccl_tpu.obs.TraceContext` rides the BEGIN notif verbatim, the
+decode side stamps it onto its GRANT/adopt/import events, and a
+Chrome-trace flow pair (``s`` inside the first ``kv_stream.tx`` span,
+``f`` inside ``kv_stream.import``, ids derived from the trace_id) binds
+the two processes' spans into one Perfetto arrow once
+``scripts/trace_merge.py`` merges the per-role dumps. The HELLO handshake
+is followed by a notif-borne clock exchange (``clock_ping`` →
+``clock_pong`` → ``clock_sync``): the prefill side estimates the wall
+offset to its decode peer by the RTT midpoint
+(:func:`uccl_tpu.obs.estimate_clock_offset`) and hands the decode process
+its offset from the reference (prefill) clock, which lands in that
+process's trace metadata for merge-time alignment.
 """
 
 from __future__ import annotations
@@ -164,6 +178,7 @@ class _TxStream:
     max_new_tokens: int
     eos_id: Optional[int]
     t_submit_wall: float
+    trace: Optional["obs.TraceContext"] = None  # rides BEGIN verbatim
     t_admit_wall: Optional[float] = None
     t_done_wall: Optional[float] = None
     slabs: List[Tuple[int, int, np.ndarray, np.ndarray]] = field(
@@ -171,6 +186,7 @@ class _TxStream:
     remote_slot: Optional[int] = None  # GRANTed decode-side slot
     xids: List[int] = field(default_factory=list)
     n_shipped: int = 0
+    flow_emitted: bool = False  # the one flow-start per request went out
     first_token: Optional[int] = None
     done: bool = False  # prefill finished (first token known)
     cache_hit_len: int = 0  # rows reused from the prefix cache
@@ -194,27 +210,33 @@ class PrefillWorker:
     # -- submission ----------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
-               priority: str = "interactive") -> Optional[Request]:
+               priority: str = "interactive",
+               trace=None) -> Optional[Request]:
         """Open a KV stream and queue the prompt on the prefill engine
         (``max_new_tokens=1`` locally — this fleet never decodes; the
         requested budget rides the BEGIN message to the decode side).
         ``priority`` orders this fleet's own prefill queue (when its
         engine runs priority classes) and rides BEGIN so the adopted
-        request keeps its class label decode-side. Returns the local
-        Request, or None on queue backpressure."""
+        request keeps its class label decode-side. ``trace`` carries a
+        router-minted :class:`~uccl_tpu.obs.TraceContext` (None mints one
+        here); it rides BEGIN verbatim so the decode side's spans join the
+        same fleet-wide timeline. Returns the local Request, or None on
+        queue backpressure."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        ctx = trace if trace is not None else obs.new_context()
         req = self.engine.submit(prompt, max_new_tokens=1,
-                                 priority=priority)
+                                 priority=priority, trace=ctx)
         if req is None:
             return None
         st = _TxStream(req.rid, prompt, max_new_tokens, eos_id,
-                       t_submit_wall=time.time())
+                       t_submit_wall=time.time(), trace=ctx)
         self._streams[req.rid] = st
         _send_msg(self.ep, self.conn, {
             "t": "begin", "rid": req.rid, "prompt": prompt.tolist(),
             "max_new_tokens": max_new_tokens, "eos_id": eos_id,
             "priority": priority,
             "t_submit": st.t_submit_wall,
+            "trace": ctx.to_wire(),
         })
         return req
 
@@ -259,12 +281,28 @@ class PrefillWorker:
                        for layer in range(self.fmt.n_layers)])
             fifos = ([fifos_k.slice(off, ln).pack() for off, ln in spans]
                      + [fifos_v.slice(off, ln).pack() for off, ln in spans])
-            with obs.span("kv_stream.tx", track="wire", rid=st.rid,
-                          slot=st.remote_slot, lo=lo, hi=hi,
-                          bytes=sum(s.nbytes for s in srcs)):
-                st.xids.extend(
-                    self.ep.writev_async(self.conn, srcs, fifos)
-                )
+            tr = obs.get_tracer()
+            t0 = tr.now_us() if tr is not None else 0.0
+            st.xids.extend(
+                self.ep.writev_async(self.conn, srcs, fifos)
+            )
+            if tr is not None:
+                dur = tr.now_us() - t0
+                tr.complete("kv_stream.tx", t0, dur, "wire", rid=st.rid,
+                            slot=st.remote_slot, lo=lo, hi=hi,
+                            bytes=sum(s.nbytes for s in srcs),
+                            trace_id=(st.trace.trace_id
+                                      if st.trace else None))
+                if st.trace is not None and not st.flow_emitted:
+                    # ONE flow-start per request, timestamped INSIDE the
+                    # first tx span so Perfetto binds the arrow to it; the
+                    # decode side's matching flow-finish sits inside its
+                    # kv_stream.import span (same derived id, no extra
+                    # coordination — the id IS the trace_id)
+                    tr.flow("kv_handoff", "s",
+                            obs.flow_id(st.trace.trace_id), "wire",
+                            ts_us=t0 + dur / 2.0)
+                    st.flow_emitted = True
             st.n_shipped += 1
             _STREAM_CHUNKS.inc(role="tx")
         st.slabs.clear()
@@ -295,6 +333,8 @@ class PrefillWorker:
                 if "free" in msg:
                     self.decode_hint = {"free": int(msg["free"]),
                                         "queued": int(msg["queued"])}
+            elif msg.get("t") == "clock_pong":
+                self._on_clock_pong(msg)
         for st in self._streams.values():
             if st.remote_slot is not None and st.slabs:
                 self._ship(st)
@@ -319,6 +359,46 @@ class PrefillWorker:
             })
             _STREAM_REQS.inc(role="tx")
             del self._streams[rid]
+
+    def _send_clock_ping(self) -> None:
+        self._clock_pings_left -= 1
+        _send_msg(self.ep, self.conn, {
+            "t": "clock_ping", "t0": time.time(),
+            "mono_us": time.perf_counter() * 1e6,
+        })
+
+    def _on_clock_pong(self, msg: Dict) -> None:
+        """Second leg of the HELLO clock exchange: the pong carries our
+        ping's send time (t0) plus the peer's receive/send wall marks
+        (t1/t2); with our receive time (t3) the RTT midpoint estimates the
+        peer's wall-clock offset (obs/context.py). One round is not
+        enough: the first ping can sit in the peer's notif queue across
+        its compile warmup, inflating the RTT and (with it) the offset
+        error bound of rtt/2 — so the exchange repeats a few rounds and
+        keeps the MINIMUM-RTT estimate (the classic NTP clock filter).
+        Each improvement goes BACK to the peer as ``clock_sync`` so the
+        DECODE process records its own offset from the reference
+        (prefill) clock in its trace metadata — scripts/trace_merge.py
+        aligns on exactly that field."""
+        t3 = time.time()
+        offset_s, rtt_s = obs.estimate_clock_offset(
+            float(msg["t0"]), float(msg["t1"]), float(msg["t2"]), t3
+        )
+        if self.clock_rtt_s is None or rtt_s < self.clock_rtt_s:
+            self.clock_offset_s = offset_s
+            self.clock_rtt_s = rtt_s
+            # the reference process's own offset is 0 by definition;
+            # record the measurement's provenance in this side's trace
+            # metadata too
+            obs.set_clock_offset(0.0, rtt_us=round(rtt_s * 1e6, 3),
+                                 peer="decode", role="reference")
+            _send_msg(self.ep, self.conn, {
+                "t": "clock_sync",
+                "offset_us": offset_s * 1e6,
+                "rtt_us": rtt_s * 1e6,
+            })
+        if self._clock_pings_left > 0:
+            self._send_clock_ping()
 
     def step(self) -> None:
         """One loop iteration: advance the engine (chunks export through
@@ -372,6 +452,12 @@ class DecodeWorker:
         self.closed = False
         self._n_conns = 0
         self._n_byes = 0
+        # this process's wall offset from the reference (prefill) clock,
+        # as estimated by the peer's clock exchange (None until synced;
+        # under fan-in the last sync wins — all peers measure the same
+        # two clocks)
+        self.clock_offset_us: Optional[float] = None
+        self.clock_rtt_us: Optional[float] = None
 
     @property
     def port(self) -> int:
@@ -399,6 +485,23 @@ class DecodeWorker:
                 self._pending.append((conn, msg))
             elif kind == "final":
                 self._on_final(conn, msg)
+            elif kind == "clock_ping":
+                # timestamp on arrival AND on reply: the gap between the
+                # two is the peer-side processing time the RTT-midpoint
+                # formula subtracts out
+                t1 = time.time()
+                _send_msg(self.ep, conn, {
+                    "t": "clock_pong", "t0": msg["t0"], "t1": t1,
+                    "t2": time.time(),
+                    "mono_us": time.perf_counter() * 1e6,
+                    "wall_us": t1 * 1e6,
+                })
+            elif kind == "clock_sync":
+                self.clock_offset_us = float(msg["offset_us"])
+                self.clock_rtt_us = float(msg["rtt_us"])
+                obs.set_clock_offset(self.clock_offset_us,
+                                     rtt_us=round(self.clock_rtt_us, 3),
+                                     peer="prefill", role="synced")
             elif kind == "bye":
                 self._n_byes += 1
                 self.closed = self._n_byes >= self._n_conns
@@ -411,9 +514,14 @@ class DecodeWorker:
             if slot is None:
                 break  # pool full: BEGINs wait (admission backpressure)
             self._pending.popleft()
+            trace = obs.TraceContext.from_wire(msg.get("trace"))
             self._granted[(conn, int(msg["rid"]))] = {
                 "slot": slot, "msg": msg, "t_grant": time.time(),
+                "trace": trace,
             }
+            obs.instant("grant", track="wire", rid=int(msg["rid"]),
+                        slot=slot,
+                        trace_id=trace.trace_id if trace else None)
             # capacity hints ride every GRANT (the adoption-backpressure
             # feed, docs/SERVING.md): free decode slots AFTER this grant
             # and the BEGINs still waiting for one — the prefill side
@@ -432,17 +540,28 @@ class DecodeWorker:
                 f"FINAL for unknown stream rid={final['rid']} (no BEGIN "
                 "grant recorded)"
             )
-        slot, begin = st["slot"], st["msg"]
+        slot, begin, trace = st["slot"], st["msg"], st["trace"]
         plen = int(final["length"])
         # full S_max rows: rows past plen are dead (masked attention), and
         # the fixed shape keeps every import on one compiled program
         k_rows = self.mirror_k[:, slot, :]
         v_rows = self.mirror_v[:, slot, :]
-        with obs.span("kv_stream.import", track="wire", slot=slot,
-                      rows=plen, chunks=int(final["chunks"])):
-            self.engine.backend.import_slot_kv(
-                slot, k_rows, v_rows, length=plen
-            )
+        tr = obs.get_tracer()
+        ts0 = tr.now_us() if tr is not None else 0.0
+        self.engine.backend.import_slot_kv(
+            slot, k_rows, v_rows, length=plen
+        )
+        if tr is not None:
+            dur = tr.now_us() - ts0
+            tr.complete("kv_stream.import", ts0, dur, "wire", slot=slot,
+                        rows=plen, chunks=int(final["chunks"]),
+                        trace_id=trace.trace_id if trace else None)
+            if trace is not None:
+                # the flow-finish matching the prefill side's flow-start:
+                # same derived id, timestamped inside this import span so
+                # the merged trace renders one arrow tx -> import
+                tr.flow("kv_handoff", "f", obs.flow_id(trace.trace_id),
+                        "wire", ts_us=ts0 + dur / 2.0)
         _STREAM_CHUNKS.inc(int(final["chunks"]), role="rx")
         _STREAM_REQS.inc(role="rx")
         t_adopt = time.time()
@@ -456,6 +575,7 @@ class DecodeWorker:
             priority=begin.get("priority", "interactive"),
             queue_s=t_admit - t_submit, prefill_s=t_done - t_admit,
             transfer_s=t_adopt - t_done,
+            trace=trace,
         )
         req.cache_hit_len = int(final.get("cache_hit_len", 0))
         self.origin[req.rid] = (conn, int(final["rid"]))
@@ -584,6 +704,16 @@ def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,
     # decode-peer capacity as of the last GRANT (free slots + pending
     # BEGIN depth) — feeds adoption_backpressure() / the replica router
     pw.decode_hint = None
+    # clock exchange (docs/OBSERVABILITY.md): the first ping rides a
+    # notif right after HELLO and its pong comes back through the regular
+    # pump, so the exchange needs no extra blocking recv (the in-process
+    # loopback pair pumps both sides from one thread); follow-up rounds
+    # refine the estimate by minimum RTT (_on_clock_pong). None until the
+    # first pong lands.
+    pw.clock_offset_s = None  # estimated decode_wall - prefill_wall
+    pw.clock_rtt_s = None
+    pw._clock_pings_left = 8
+    pw._send_clock_ping()
     engine.chunk_sink = pw._on_chunks
 
 

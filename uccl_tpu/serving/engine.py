@@ -456,14 +456,19 @@ class ServingEngine:
     def submit(self, prompt, *, max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
                priority: str = "interactive",
-               deadline_ms: Optional[float] = None) -> Optional[Request]:
+               deadline_ms: Optional[float] = None,
+               trace=None) -> Optional[Request]:
         """Queue one request. Returns the Request, or None when rejected by
         backpressure (bounded queue full). ``priority`` picks the SLO class
         (``interactive`` admits before ``batch``; only meaningful on a
         ``priority_classes`` engine — a FIFO engine records the label but
         schedules by arrival order). ``deadline_ms`` is an ADMISSION
         deadline: still queued that many ms after submit, the request
-        leaves as ``RequestState.EXPIRED`` instead of aging in place."""
+        leaves as ``RequestState.EXPIRED`` instead of aging in place.
+        ``trace`` carries an upstream :class:`~uccl_tpu.obs.TraceContext`
+        (the Router, or a disagg prefill worker relaying its own ingress
+        mint); None mints a fresh one here — either way every request owns
+        a fleet-unique trace_id stamped on its lifecycle events."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must be non-empty")
@@ -483,16 +488,19 @@ class ServingEngine:
             )
         if deadline_ms is not None and deadline_ms <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        ctx = trace if trace is not None else obs.new_context()
         req = Request(
             rid=self._next_rid, prompt=prompt,
             max_new_tokens=max_new_tokens, eos_id=eos_id, t_submit=now(),
             priority=priority, deadline_ms=deadline_ms,
+            trace_id=ctx.trace_id, span_id=ctx.span_id,
         )
         self._next_rid += 1
         self.metrics.on_submit(req)
         obs.instant("submit", track=req.track, rid=req.rid,
                     prompt_len=int(prompt.size),
-                    max_new_tokens=max_new_tokens, cls=priority)
+                    max_new_tokens=max_new_tokens, cls=priority,
+                    trace_id=req.trace_id)
         if not self.sched.submit(req):
             self.metrics.on_reject(req)
             _REJECTS.inc()
@@ -535,7 +543,8 @@ class ServingEngine:
               priority: str = "interactive",
               queue_s: Optional[float] = None,
               prefill_s: Optional[float] = None,
-              transfer_s: Optional[float] = None) -> Request:
+              transfer_s: Optional[float] = None,
+              trace=None) -> Request:
         """Admit a request whose prefill happened ELSEWHERE — the disagg
         decode side. The caller must already have imported the prompt's KV
         into ``slot`` (``backend.import_slot_kv`` with length =
@@ -548,8 +557,12 @@ class ServingEngine:
         truthful — adopted requests are ACTIVE at once, so the class never
         queues here. The ``*_s`` wall-clock splits (queue on the prefill
         fleet, prefill compute, transfer tail) land on the metrics'
-        disaggregated-TTFT series. Returns the Request (already FINISHED
-        when ``max_new_tokens == 1`` or the first token is EOS)."""
+        disaggregated-TTFT series. ``trace`` is the context the request
+        was minted with at the PREFILL fleet's ingress (it rode the BEGIN
+        notif verbatim) — passing it keeps the adopted request on the same
+        fleet-wide timeline; None mints a local one. Returns the Request
+        (already FINISHED when ``max_new_tokens == 1`` or the first token
+        is EOS)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must be non-empty")
@@ -568,10 +581,12 @@ class ServingEngine:
                 f"{PRIORITY_CLASSES})"
             )
         t = now()
+        ctx = trace if trace is not None else obs.new_context()
         req = Request(
             rid=self._next_rid, prompt=prompt,
             max_new_tokens=max_new_tokens, eos_id=eos_id, t_submit=t,
             priority=priority,
+            trace_id=ctx.trace_id, span_id=ctx.span_id,
         )
         self._next_rid += 1
         if slot is None:
@@ -592,7 +607,7 @@ class ServingEngine:
                               transfer_s=transfer_s)
         self._by_slot[slot] = req
         obs.instant("adopt", track=req.track, rid=req.rid, slot=slot,
-                    prompt_len=int(prompt.size))
+                    prompt_len=int(prompt.size), trace_id=req.trace_id)
         finished: List[Request] = []
         self._emit_first_token(slot, req, np.int32(first_token), now(),
                                finished)
@@ -835,8 +850,15 @@ class ServingEngine:
 
     def reset_metrics(self) -> None:
         """Zero counters/samples (e.g. after compile warmup) — the slot
-        pool, queue and compiled programs are untouched."""
+        pool, queue and compiled programs are untouched. Also zeroes the
+        process-wide serving latency HISTOGRAMS (serving/metrics.py):
+        warmups reset every engine in the process before the measured
+        window, so the histogram- and sample-derived percentiles keep
+        describing the same observation set."""
+        from uccl_tpu.serving.metrics import reset_latency_histograms
+
         self.metrics = ServingMetrics()
+        reset_latency_histograms()
 
     def close(self) -> None:
         # only tear down the stats export THIS engine registered — a
@@ -1046,7 +1068,8 @@ class ServingEngine:
         req.t_first_token = t
         self.metrics.on_first_token(req)
         obs.instant("first_token", track=req.track,
-                    ttft_ms=round(req.ttft * 1e3, 3))
+                    ttft_ms=round(req.ttft * 1e3, 3),
+                    trace_id=req.trace_id)
         self._maybe_retire(slot, req, t, finished)
 
     def _maybe_retire(self, slot: int, req: Request, t: float,
@@ -1069,5 +1092,6 @@ class ServingEngine:
         self._by_slot.pop(slot, None)
         self.metrics.on_finish(req)
         obs.instant("finish", track=req.track, reason=req.finish_reason,
-                    tokens=req.n_generated, parked=parked)
+                    tokens=req.n_generated, parked=parked,
+                    trace_id=req.trace_id)
         finished.append(req)

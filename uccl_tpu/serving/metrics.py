@@ -27,7 +27,50 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional
 
+from uccl_tpu import obs
 from uccl_tpu.serving.request import Request, now
+
+# Merge-safe latency histograms (docs/OBSERVABILITY.md): the sample lists
+# below stay the exact in-process percentile source, but sample lists
+# cannot be combined across processes — these log-bucketed families SUM,
+# so obs/aggregate.py can federate N workers' /metrics into one fleet
+# distribution. Observed by the SAME lifecycle hooks that append the
+# samples, so the two derivations agree to a bucket width by construction
+# (check_obs --fleet asserts it; serving_bench stamps both).
+TTFT_HIST = obs.histogram(
+    "serving_ttft_seconds", "submit -> first token, queue wait included"
+)
+QUEUE_WAIT_HIST = obs.histogram(
+    "serving_queue_wait_seconds", "submit -> admission into a KV slot"
+)
+TPOT_HIST = obs.histogram(
+    "serving_tpot_seconds", "per-token decode steady state after the first"
+)
+STEP_HIST = obs.histogram(
+    "serving_step_seconds", "one full engine step() wall time"
+)
+TRANSFER_HIST = obs.histogram(
+    "serving_transfer_seconds",
+    "disagg KV transfer tail: prefill-done -> adopt (decode side)",
+)
+DISAGG_TTFT_HIST = obs.histogram(
+    "serving_disagg_ttft_seconds",
+    "disaggregated end-to-end TTFT: queue + prefill + transfer "
+    "(wall-clock marks carried in the stream's control messages)",
+)
+
+_LATENCY_HISTS = (TTFT_HIST, QUEUE_WAIT_HIST, TPOT_HIST, STEP_HIST,
+                  TRANSFER_HIST, DISAGG_TTFT_HIST)
+
+
+def reset_latency_histograms() -> None:
+    """Zero the process-wide serving latency histograms — called with
+    ``ServingEngine.reset_metrics`` so compile-warmup observations never
+    pollute the recorded distributions (warmups reset every engine in the
+    process before the measured window, so clearing the shared families
+    there is exact)."""
+    for fam in _LATENCY_HISTS:
+        fam.clear()
 
 
 def percentile(xs: List[float], q: float) -> Optional[float]:
@@ -142,6 +185,7 @@ class ServingMetrics:
         self.admitted += 1
         if req.queue_wait is not None:
             self.queue_wait_s.append(req.queue_wait)
+            QUEUE_WAIT_HIST.observe(req.queue_wait)
             self.class_queue_wait_s.setdefault(req.priority, []).append(
                 req.queue_wait
             )
@@ -160,6 +204,7 @@ class ServingMetrics:
     def on_first_token(self, req: Request) -> None:
         if req.ttft is not None:
             self.ttft_s.append(req.ttft)
+            TTFT_HIST.observe(req.ttft)
             self.class_ttft_s.setdefault(req.priority, []).append(req.ttft)
 
     def on_adopt(self, req: Request, *, queue_s: Optional[float] = None,
@@ -175,11 +220,12 @@ class ServingMetrics:
             self.disagg_prefill_s.append(max(0.0, prefill_s))
         if transfer_s is not None:
             self.disagg_transfer_s.append(max(0.0, transfer_s))
+            TRANSFER_HIST.observe(max(0.0, transfer_s))
         if None not in (queue_s, prefill_s, transfer_s):
-            self.disagg_ttft_s.append(
-                max(0.0, queue_s) + max(0.0, prefill_s)
-                + max(0.0, transfer_s)
-            )
+            ttft = (max(0.0, queue_s) + max(0.0, prefill_s)
+                    + max(0.0, transfer_s))
+            self.disagg_ttft_s.append(ttft)
+            DISAGG_TTFT_HIST.observe(ttft)
 
     def on_finish(self, req: Request) -> None:
         self.completed += 1
@@ -189,6 +235,7 @@ class ServingMetrics:
         self.t_last_finish = req.t_finish
         if req.tpot is not None:
             self.tpot_s.append(req.tpot)
+            TPOT_HIST.observe(req.tpot)
             self.class_tpot_s.setdefault(req.priority, []).append(req.tpot)
         if req.latency is not None:
             self.latency_s.append(req.latency)
@@ -219,6 +266,7 @@ class ServingMetrics:
 
     def on_step(self, dt: float) -> None:
         self.step_s.append(dt)
+        STEP_HIST.observe(dt)
 
     # -- derived ------------------------------------------------------------
     def goodput(self) -> Optional[float]:

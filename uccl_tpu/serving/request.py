@@ -60,6 +60,12 @@ class Request:
     adopted: bool = False  # entered via adopt() (disagg decode side), not submit()
     priority: str = "interactive"  # SLO class: "interactive" | "batch"
     deadline_ms: Optional[float] = None  # admission deadline after submit
+    # distributed-tracing identity (obs/context.py): trace_id is minted
+    # once at ingress (submit / Router.submit) and carried VERBATIM across
+    # the disagg stream, so one request is one timeline fleet-wide;
+    # span_id is the minting side's root span
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
     preemptions: int = 0  # times this request was paused for a higher class
     out_tokens: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None  # "eos" | "length" | "deadline" | "cancel"

@@ -144,6 +144,11 @@ class Router:
                 "replicas: an expired queued request would strand its "
                 "decode-side grant"
             )
+        # the router IS the fleet ingress: mint the trace context here so
+        # the routing decision and every downstream span (including a
+        # disagg peer's, across processes) share one trace_id — a spilled
+        # retry is the same request, so the context survives the loop
+        ctx = obs.new_context()
         ranked, signals = self._ranked()
         for rank, (_, i) in enumerate(ranked):
             replica = self.replicas[i]
@@ -151,14 +156,15 @@ class Router:
             if replica is eng:
                 req = eng.submit(prompt, max_new_tokens=max_new_tokens,
                                  eos_id=eos_id, priority=priority,
-                                 deadline_ms=deadline_ms)
+                                 deadline_ms=deadline_ms, trace=ctx)
             else:
                 # disagg prefill worker: the decode budget and the class
                 # label ride the BEGIN message (the worker's own engine
                 # schedules its prefill queue by the same class)
                 req = replica.submit(prompt,
                                      max_new_tokens=max_new_tokens,
-                                     eos_id=eos_id, priority=priority)
+                                     eos_id=eos_id, priority=priority,
+                                     trace=ctx)
             if req is None:
                 continue  # bounded queue raced the signal read — spill
             self.routed[i] += 1
@@ -166,7 +172,8 @@ class Router:
             if rank > 0:
                 _SPILLOVER.inc()
             obs.instant("route", track="router", replica=i, rank=rank,
-                        rid=req.rid, cls=priority, **signals[i])
+                        rid=req.rid, cls=priority,
+                        trace_id=ctx.trace_id, **signals[i])
             return req
         _ROUTER_REJECTS.inc(reason="saturated")
         obs.instant("route_reject", track="router",

@@ -1,25 +1,57 @@
-"""Incast microbenchmark: N senders blast one receiver over the DCN engine.
+"""Incast benchmark: N senders converge on one receiver — the scenario
+receiver-driven CC exists for (reference EQDS, include/cc/eqds.h).
 
-The scenario receiver-driven CC (the reference's EQDS, include/cc/eqds.h)
-exists for: many senders converging on one receiver link. This bench measures
-what our transport (framed TCP streams + per-conn non-blocking engine)
-delivers under incast: aggregate goodput and per-sender fairness (Jain's
-index). Results ground the EQDS design decision in docs/EQDS.md.
+Two modes:
 
-Usage: python benchmarks/incast_bench.py [n_senders] [mb_per_sender]
+* **Legacy** (``incast_bench.py [n] [mb]``): N raw-Endpoint sender
+  *processes* blast framed-TCP writes at one receiver — measures the
+  engine's own scheduling fairness (the docs/EQDS.md round-1 table).
+
+* **Windowed-transport sweep** (``--fan-in N --cc ... --drop-rates ...``):
+  N multipath *Channels* in one process (each sender thread owns its own
+  Endpoint; the native engine threads move the bytes) drive the windowed
+  SACK transport through a fault-injected loopback — drop × reorder ×
+  congestion-control arm — and report **counter-audited** goodput, the
+  fast-vs-RTO retransmit split, cwnd/srtt/rto, and credit-stall seconds.
+  Arms: ``off`` (static window), ``timely``/``swift`` (sender-side window
+  CC fed by per-chunk completion RTTs), ``eqds`` (receiver-driven
+  PullPacer credit at the receiver's configured drain rate — the incast
+  actuator). Every payload is verified bit-exact against its seeded
+  source before an arm may report goodput.
+
+  ``--disagg`` adds the serving arm: 2 PrefillWorkers → 1 DecodeWorker
+  over the channel transport on a lossy/reordering loopback, oracle-exact
+  with the TTFT transfer leg measured under incast (needs jax; CPU ok).
+
+Honest caveat: in-process senders share the GIL for the windowed
+bookkeeping loop, so absolute MB/s undersells a multi-process deployment;
+arms are compared against each other under identical conditions, and the
+counters (not wall-clock mirrors) label every arm.
+
+Usage:
+  python benchmarks/incast_bench.py 8 64                       # legacy
+  python benchmarks/incast_bench.py --fan-in 4 --mb 8 \\
+      --drop-rates 0,0.01,0.05 --cc off,timely,swift,eqds \\
+      --json-out docs/incast_sack_r01.json
+  python benchmarks/incast_bench.py --smoke --metrics-out m.prom
 """
 
 from __future__ import annotations
 
 import _bootstrap  # noqa: F401  (repo path)
+import argparse
 import json
 import multiprocessing as mp
 import sys
+import threading
 import time
 
 import numpy as np
 
 
+# --------------------------------------------------------------------------
+# legacy raw-endpoint multiprocess mode (docs/EQDS.md round-1 measurement)
+# --------------------------------------------------------------------------
 def _sender(port, mb, out_q, idx):
     import os, sys
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -39,9 +71,7 @@ def _sender(port, mb, out_q, idx):
         out_q.put((idx, (mb << 20) / dt))
 
 
-def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    mb = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+def run_legacy(n: int, mb: int) -> None:
     from uccl_tpu.p2p import Endpoint
 
     mp.set_start_method("spawn", force=True)
@@ -91,6 +121,326 @@ def main():
             }
         )
     )
+
+
+# --------------------------------------------------------------------------
+# windowed-transport channel sweep
+# --------------------------------------------------------------------------
+def _counter_totals():
+    """Snapshot the transport counters the arms are labeled from."""
+    from uccl_tpu.p2p.channel import (_CHAN_CHUNKS, _CHAN_RETX,
+                                      _CREDIT_STALL)
+
+    retx = {"fast": 0.0, "rto": 0.0}
+    for labels, v in _CHAN_RETX.samples():
+        k = labels.get("kind", "rto")
+        retx[k] = retx.get(k, 0.0) + v
+    return {
+        "chunks": _CHAN_CHUNKS.total(),
+        "retx_fast": retx.get("fast", 0.0),
+        "retx_rto": retx.get("rto", 0.0),
+        "credit_stall_s": _CREDIT_STALL.total(),
+    }
+
+
+def run_channel_arm(n: int, mb: int, cc: str, drop: float, reorder: float,
+                    *, chunk_kb: int = 64, n_paths: int = 4,
+                    retries: int = 8, pull_rate_mbps: float = 400.0,
+                    timeout_s: float = 300.0) -> dict:
+    from uccl_tpu.p2p import Endpoint, PullPacer
+    from uccl_tpu.p2p.channel import Channel, ChannelAcceptor
+
+    recv_ep = Endpoint(n_engines=4)
+    accepted = {}
+    acceptor = ChannelAcceptor(
+        recv_ep, lambda ch: accepted.setdefault(ch.meta[0], ch),
+        chunk_bytes=chunk_kb << 10,
+    )
+    send_eps, chans = [], []
+    try:
+        for i in range(n):
+            ep = Endpoint(n_engines=2)
+            ch = Channel.connect(ep, "127.0.0.1", recv_ep.port,
+                                 n_paths=n_paths,
+                                 chunk_bytes=chunk_kb << 10,
+                                 meta=bytes([i]))
+            ch.retries = retries
+            ep.set_drop_rate(drop)
+            ep.set_reorder_rate(reorder)
+            if cc in ("timely", "swift"):
+                ch.enable_window_cc(cc)
+            elif cc == "eqds":
+                ch.enable_pull_sender()
+            elif cc != "off":
+                raise ValueError(f"unknown cc arm {cc!r}")
+            send_eps.append(ep)
+            chans.append(ch)
+        deadline = time.monotonic() + 60
+        while len(accepted) < n:
+            if time.monotonic() > deadline:
+                raise TimeoutError("acceptor never collected every channel")
+            time.sleep(0.002)
+
+        srcs = [np.random.default_rng(1000 + i).integers(
+                    0, 255, mb << 20, dtype=np.uint8) for i in range(n)]
+        dsts, fifos = [], []
+        for i in range(n):
+            dst = np.zeros(mb << 20, np.uint8)
+            fifos.append(recv_ep.advertise(recv_ep.reg(dst)))
+            dsts.append(dst)
+
+        pacer = None
+        if cc == "eqds":
+            # the receiver's KNOWN drain rate: attach right before the gun
+            # so credit cannot pre-accumulate while senders set up
+            pacer = PullPacer(pull_rate_mbps * 1e6)
+            for ch in accepted.values():
+                pacer.attach(ch)
+
+        before = _counter_totals()
+        barrier = threading.Barrier(n + 1)
+        per_flow, errors = {}, []
+
+        def tx(i):
+            try:
+                barrier.wait()
+                t0 = time.perf_counter()
+                chans[i].write(srcs[i], fifos[i],
+                               timeout_ms=int(timeout_s * 1e3))
+                per_flow[i] = (mb << 20) / (time.perf_counter() - t0)
+            except Exception as e:  # surfaced after join
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=tx, args=(i,)) for i in range(n)]
+        [t.start() for t in threads]
+        if pacer is not None:
+            pacer.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        [t.join(timeout=timeout_s) for t in threads]
+        wall = time.perf_counter() - t0
+        if pacer is not None:
+            pacer.stop()
+        if errors:
+            raise IOError(f"arm cc={cc} drop={drop}: sender failures {errors}")
+        for i in range(n):
+            if not np.array_equal(dsts[i], srcs[i]):
+                raise AssertionError(
+                    f"arm cc={cc} drop={drop}: sender {i} payload corrupt"
+                )
+        after = _counter_totals()
+
+        rates = np.array([per_flow[i] for i in sorted(per_flow)])
+        jain = float(rates.sum() ** 2 / (len(rates) * (rates ** 2).sum()))
+        stats = [ch.transport_stats() for ch in chans]
+        arm = {
+            "bench": "incast_sack",
+            "n_senders": n,
+            "mb_per_sender": mb,
+            "cc": cc,
+            "drop_rate": drop,
+            "reorder_rate": reorder,
+            "chunk_kb": chunk_kb,
+            "n_paths": n_paths,
+            "goodput_MBps": round(n * (mb << 20) / wall / 1e6, 2),
+            "per_flow_MBps_min": round(float(rates.min()) / 1e6, 2),
+            "per_flow_MBps_max": round(float(rates.max()) / 1e6, 2),
+            "jain_fairness": round(jain, 4),
+            "wall_s": round(wall, 3),
+            "payload": "bit_exact",
+            # counter-delta labels (the REAL series, not mirrored math)
+            "chunks_issued": int(after["chunks"] - before["chunks"]),
+            "retx_fast": int(after["retx_fast"] - before["retx_fast"]),
+            "retx_rto": int(after["retx_rto"] - before["retx_rto"]),
+            "credit_stall_s": round(
+                after["credit_stall_s"] - before["credit_stall_s"], 4),
+            "cwnd_bytes_mean": int(np.mean([s["cwnd_bytes"] for s in stats])),
+            "srtt_us_mean": round(
+                float(np.mean([s["srtt_us"] for s in stats])), 1),
+            "rto_ms_mean": round(
+                float(np.mean([s["rto_ms"] for s in stats])), 2),
+        }
+        if cc == "eqds":
+            arm["pull_rate_mbps"] = pull_rate_mbps
+            arm["granted_bytes"] = int(sum(
+                ch.pull_granted for ch in accepted.values()))
+        return arm
+    finally:
+        acceptor.close()
+        for ch in list(accepted.values()):
+            try:
+                ch.close()
+            except Exception:
+                pass
+        for ep in send_eps:
+            ep.close()
+        recv_ep.close()
+
+
+# --------------------------------------------------------------------------
+# disagg fan-in arm: 2 prefill workers -> 1 decode worker over the channel
+# transport, lossy loopback, oracle-exact with the TTFT split measured
+# --------------------------------------------------------------------------
+def run_disagg_arm(drop: float, reorder: float, *, requests: int = 6,
+                   pull_rate_mbps: float = 64.0) -> dict:
+    import jax
+
+    from uccl_tpu.models import dense
+    from uccl_tpu.models.inference import generate
+    from uccl_tpu.p2p import Endpoint
+    from uccl_tpu.serving import DenseBackend, ServingEngine
+    from uccl_tpu.serving.disagg import DecodeWorker, add_local_prefill
+
+    MAX_SEQ = 32
+    cfg = dense.DenseConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, head_dim=8, ffn=64)
+    params = dense.init_params(jax.random.PRNGKey(0), cfg)
+    pes = [ServingEngine(DenseBackend(params, cfg, n_slots=2,
+                                      max_seq=MAX_SEQ), prefill_chunk=4)
+           for _ in range(2)]
+    de = ServingEngine(DenseBackend(params, cfg, n_slots=4, max_seq=MAX_SEQ))
+    dw = DecodeWorker(de, Endpoint(), pull_rate_bps=pull_rate_mbps * 1e6)
+    pws = [add_local_prefill(dw, pe, transport="channel", n_paths=2,
+                             chunk_bytes=8 << 10, pull=True,
+                             window_cc="swift") for pe in pes]
+    for pw in pws:
+        pw.chan.retries = 8
+
+    def pump(n_done, done, deadline_s=180.0):
+        deadline = time.monotonic() + deadline_s
+        while len(done) < n_done:
+            for pw in pws:
+                pw.step()
+            done.extend(dw.step())
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"disagg arm stalled at {len(done)}")
+        return done
+
+    try:
+        for pw in pws:
+            pw.submit(np.zeros(8, np.int32), max_new_tokens=2)
+        pump(2, [])
+        for eng in pes + [de]:
+            eng.reset_metrics()
+        before = _counter_totals()
+        for pw in pws:
+            pw.ep.set_drop_rate(drop)
+            pw.ep.set_reorder_rate(reorder)
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, 64, 6 + i % 5).astype(np.int32)
+                   for i in range(requests)]
+        done = []
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            assert pws[i % 2].submit(p, max_new_tokens=4) is not None
+            for pw in pws:
+                pw.step()
+            done.extend(dw.step())
+        pump(requests, done)
+        wall = time.perf_counter() - t0
+    finally:
+        for pw in pws:
+            pw.ep.set_drop_rate(0.0)
+            pw.ep.set_reorder_rate(0.0)
+        granted = sum(ch.pull_granted for ch in dw.channels)
+        dw.close()  # stops the pacer, releases the channel list
+
+    # oracle-exactness asserted from real comparisons, not assumed
+    exact = True
+    for r in done:
+        toks = generate(params, np.asarray(r.prompt)[None], cfg,
+                        max_new_tokens=r.max_new_tokens, max_seq=MAX_SEQ)
+        exact &= (np.asarray(toks)[0, : r.n_generated].tolist()
+                  == r.out_tokens)
+    after = _counter_totals()
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)) * 1e3, 2) if xs else None
+
+    m = de.metrics
+    return {
+        "bench": "incast_disagg",
+        "fan_in": "2->1",
+        "transport": "channel+pull(swift cc)",
+        "drop_rate": drop,
+        "reorder_rate": reorder,
+        "requests": requests,
+        "oracle_exact": bool(exact),
+        "wall_s": round(wall, 3),
+        "retx_fast": int(after["retx_fast"] - before["retx_fast"]),
+        "retx_rto": int(after["retx_rto"] - before["retx_rto"]),
+        "chunks_issued": int(after["chunks"] - before["chunks"]),
+        "credit_stall_s": round(
+            after["credit_stall_s"] - before["credit_stall_s"], 4),
+        "granted_bytes": int(granted),
+        "disagg_ttft_ms_p50": pct(m.disagg_ttft_s, 50),
+        "disagg_ttft_ms_p95": pct(m.disagg_ttft_s, 95),
+        "transfer_ms_p50": pct(m.disagg_transfer_s, 50),
+        "transfer_ms_p95": pct(m.disagg_transfer_s, 95),
+    }
+
+
+def main():
+    argv = sys.argv[1:]
+    if argv and not argv[0].startswith("-"):
+        n = int(argv[0])
+        mb = int(argv[1]) if len(argv) > 1 else 64
+        run_legacy(n, mb)
+        return
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fan-in", type=int, default=4)
+    ap.add_argument("--mb", type=int, default=8)
+    ap.add_argument("--cc", default="off,timely,swift,eqds")
+    ap.add_argument("--drop-rates", default="0,0.01,0.05")
+    ap.add_argument("--reorder", type=float, default=0.0)
+    ap.add_argument("--chunk-kb", type=int, default=64)
+    ap.add_argument("--n-paths", type=int, default=4)
+    ap.add_argument("--retries", type=int, default=8)
+    ap.add_argument("--pull-rate-mbps", type=float, default=400.0)
+    ap.add_argument("--disagg", action="store_true",
+                    help="add the 2->1 disagg serving arm (needs jax)")
+    ap.add_argument("--disagg-only", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI preset: tiny lossy+reordering sweep "
+                    "(fan-in 4, 2 MB, drop 2%%, reorder 20%%, swift+eqds)")
+    ap.add_argument("--json-out", default="")
+    ap.add_argument("--metrics-out", default="")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.fan_in, args.mb = 4, 2
+        args.cc, args.drop_rates, args.reorder = "swift,eqds", "0.02", 0.2
+
+    arms = []
+    if not args.disagg_only:
+        ccs = [c.strip() for c in args.cc.split(",") if c.strip()]
+        drops = [float(d) for d in args.drop_rates.split(",")]
+        for drop in drops:
+            for cc in ccs:
+                arm = run_channel_arm(
+                    args.fan_in, args.mb, cc, drop, args.reorder,
+                    chunk_kb=args.chunk_kb, n_paths=args.n_paths,
+                    retries=args.retries,
+                    pull_rate_mbps=args.pull_rate_mbps,
+                )
+                arms.append(arm)
+                print(json.dumps(arm), flush=True)
+    if args.disagg or args.disagg_only:
+        drops = [float(d) for d in args.drop_rates.split(",")]
+        for drop in drops:
+            arm = run_disagg_arm(drop, args.reorder or 0.2)
+            arms.append(arm)
+            print(json.dumps(arm), flush=True)
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            for arm in arms:
+                f.write(json.dumps(arm) + "\n")
+    if args.metrics_out:
+        from uccl_tpu.obs.export import write_metrics
+
+        write_metrics(args.metrics_out)
 
 
 if __name__ == "__main__":

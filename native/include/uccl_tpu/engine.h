@@ -210,7 +210,26 @@ class Endpoint {
   void reap(uint64_t xfer_id);
 
   // --- fault injection (reference kTestLoss knobs, transport_config.h:222)
+  // TCP mode scopes injection to the one-sided DATA plane (kWrite/kRead/
+  // kReadResp/kWriteAck): loss/reorder model a lossy data fabric under a
+  // reliable control plane, so two-sided send/notif rendezvous (and the
+  // kHello handshake) survive any injected rate. UDP wire mode injects at
+  // the packet level instead (engine.cc udp_send_seg_locked).
   void set_drop_rate(double p) { drop_rate_ = p; }
+  // Reorder injection: with probability p a data frame is held back in a
+  // per-conn stash and released AFTER the next enqueued frame (or after a
+  // 2 ms flush deadline), so same-conn frames swap on the wire — chunk
+  // writes and their acks land/complete out of order.
+  void set_reorder_rate(double p) { reorder_rate_ = p; }
+  // Delay jitter: each data frame gets a uniform [0, max_us] not-before
+  // stamp; the tx thread holds the conn's queue until the head frame is
+  // due (head-of-line, like a genuinely slow path).
+  void set_delay_jitter_us(int64_t max_us) { jitter_us_ = max_us; }
+  // Per-conn overrides (<0 inherits the endpoint-global knobs): lets a
+  // multipath channel make SOME paths lossy/slow while the control path
+  // stays clean — the per-path-quality steering testbed.
+  bool set_conn_fault(uint64_t conn_id, double drop, double reorder,
+                      int64_t jitter_us);
 
   // --- pacing (reference: Carousel timing wheel, collective/rdma/
   // timing_wheel.h — paces chunk injection; here a token bucket on the tx
@@ -257,6 +276,7 @@ class Endpoint {
     size_t off = 0;              // bytes of (header+payload) already sent
     bool credited = false;       // stats counted (exactly once per frame)
     uint64_t t_enq_ns = 0;       // enqueue time: tx service-latency sample
+    uint64_t t_not_before_ns = 0;  // delay-jitter injection: hold until due
     const uint8_t* payload() const {
       return owned.empty() ? static_cast<const uint8_t*>(src) : owned.data();
     }
@@ -339,9 +359,20 @@ class Endpoint {
     std::unique_ptr<UdpState> udp; // present only in UDP wire mode
     std::atomic<uint64_t> rate_bps{0};  // per-conn pacing (0 = global)
 
+    // --- fault-injection overrides (<0 = inherit the endpoint-global
+    // knobs). Atomics: set from app threads, read on every enqueue.
+    std::atomic<double> fault_drop{-1.0};
+    std::atomic<double> fault_reorder{-1.0};
+    std::atomic<int64_t> fault_jitter_us{-1};
+
     // --- tx queue (tx thread drains; any thread appends)
     std::mutex txq_mtx;
     std::deque<TxItem> txq;
+    // Reorder-injection stash (txq_mtx guards): frames held back so a later
+    // enqueue overtakes them; flushed into txq on the next enqueue or by
+    // service_tx after stash_deadline_ns (the tx loop ticks every 1 ms).
+    std::deque<TxItem> reorder_stash;
+    uint64_t stash_deadline_ns = 0;
     std::atomic<size_t> txq_bytes{0};  // queued wire bytes (backpressure)
     // Set on any fatal condition; ONLY the tx thread then clears the queue
     // and fails its transfers (single-owner teardown — no cross-thread races
@@ -524,6 +555,8 @@ class Endpoint {
   std::atomic<uint64_t> bytes_tx_{0};
   std::atomic<uint64_t> bytes_rx_{0};
   std::atomic<double> drop_rate_{0.0};
+  std::atomic<double> reorder_rate_{0.0};
+  std::atomic<int64_t> jitter_us_{0};
   std::atomic<uint64_t> rate_bps_{0};
   // task recycling (reference: shared_pool feeding the engine hot loops,
   // include/util/shared_pool.h:15) — tasks come from per-thread magazines

@@ -177,6 +177,22 @@ void ucclt_set_drop_rate(void* ep, double p) {
   static_cast<Endpoint*>(ep)->set_drop_rate(p);
 }
 
+void ucclt_set_reorder_rate(void* ep, double p) {
+  static_cast<Endpoint*>(ep)->set_reorder_rate(p);
+}
+
+void ucclt_set_delay_jitter_us(void* ep, int64_t max_us) {
+  static_cast<Endpoint*>(ep)->set_delay_jitter_us(max_us);
+}
+
+int ucclt_set_conn_fault(void* ep, uint64_t conn, double drop, double reorder,
+                         int64_t jitter_us) {
+  return static_cast<Endpoint*>(ep)->set_conn_fault(conn, drop, reorder,
+                                                    jitter_us)
+             ? 0
+             : -1;
+}
+
 void ucclt_set_rate_limit(void* ep, uint64_t bytes_per_sec) {
   static_cast<Endpoint*>(ep)->set_rate_limit(bytes_per_sec);
 }

@@ -190,6 +190,14 @@ bool should_drop(double p) {
   return d(gen) < p;
 }
 
+// Delay-jitter injection sample: uniform [0, max_us] in nanoseconds.
+uint64_t jitter_ns(int64_t max_us) {
+  static thread_local std::mt19937_64 gen{std::random_device{}()};
+  std::uniform_int_distribution<uint64_t> d(
+      0, static_cast<uint64_t>(max_us) * 1000ull);
+  return d(gen);
+}
+
 uint64_t now_ns() {
   return static_cast<uint64_t>(
       std::chrono::duration_cast<std::chrono::nanoseconds>(
@@ -843,11 +851,19 @@ void Endpoint::enqueue_frame(const std::shared_ptr<Conn>& c,
   // Fault injection: silently drop the frame (reference kTestLoss,
   // transport_config.h:222) — the transfer then times out at the caller.
   // In UDP wire mode injection moves down to the PACKET level (real loss on
-  // an unreliable wire, recovered by the reliability layer, not timeouts);
-  // kHello must never be dropped (it carries the handshake over TCP).
-  if (!udp_mode_ && static_cast<Op>(h.op) != Op::kHello &&
-      should_drop(drop_rate_.load())) {
-    return;
+  // an unreliable wire, recovered by the reliability layer, not timeouts).
+  // TCP-mode injection (drop, reorder, jitter) is scoped to the one-sided
+  // DATA plane (kWrite/kRead/kReadResp/kWriteAck): it models a lossy data
+  // fabric under a reliable control plane, so send/notif rendezvous and
+  // the kHello handshake survive any injected rate. Per-conn overrides
+  // (fault_*, <0 = inherit) let a multipath layer fault individual paths.
+  Op op = static_cast<Op>(h.op);
+  bool data_op = op == Op::kWrite || op == Op::kRead ||
+                 op == Op::kReadResp || op == Op::kWriteAck;
+  if (!udp_mode_ && data_op) {
+    double dr = c->fault_drop.load(std::memory_order_relaxed);
+    if (dr < 0.0) dr = drop_rate_.load();
+    if (should_drop(dr)) return;
   }
   TxItem it;
   it.h = h;
@@ -857,13 +873,45 @@ void Endpoint::enqueue_frame(const std::shared_ptr<Conn>& c,
               : (src != nullptr ? static_cast<size_t>(h.len) : 0);
   it.fail_xfer = fail_xfer;
   it.t_enq_ns = now_ns();
+  double rr = -1.0;
+  if (!udp_mode_ && data_op) {
+    int64_t jit = c->fault_jitter_us.load(std::memory_order_relaxed);
+    if (jit < 0) jit = jitter_us_.load();
+    if (jit > 0) it.t_not_before_ns = now_ns() + jitter_ns(jit);
+    rr = c->fault_reorder.load(std::memory_order_relaxed);
+    if (rr < 0.0) rr = reorder_rate_.load();
+  }
   size_t total = it.total();
   {
     std::lock_guard<std::mutex> lk(c->txq_mtx);
-    c->txq.push_back(std::move(it));
+    if (rr > 0.0 && should_drop(rr)) {
+      // Reorder injection: hold this frame back so the NEXT enqueued
+      // frame overtakes it on the wire. push_back-only queue mutation —
+      // service_tx holds a reference to txq.front() outside the lock, and
+      // deque end-insertion preserves element references. If nothing
+      // follows, service_tx force-flushes after the deadline.
+      c->reorder_stash.push_back(std::move(it));
+      c->stash_deadline_ns = now_ns() + 2000000;  // 2 ms max holdback
+    } else {
+      c->txq.push_back(std::move(it));
+      while (!c->reorder_stash.empty()) {
+        c->txq.push_back(std::move(c->reorder_stash.front()));
+        c->reorder_stash.pop_front();
+      }
+    }
   }
   c->txq_bytes.fetch_add(total, std::memory_order_relaxed);
   engines_[c->engine]->cv.notify_one();
+}
+
+bool Endpoint::set_conn_fault(uint64_t conn_id, double drop, double reorder,
+                              int64_t jitter_us) {
+  auto c = get_conn(conn_id);
+  if (!c) return false;
+  c->fault_drop.store(drop, std::memory_order_relaxed);
+  c->fault_reorder.store(reorder, std::memory_order_relaxed);
+  c->fault_jitter_us.store(jitter_us, std::memory_order_relaxed);
+  return true;
 }
 
 // --- UDP wire mode: selective repeat + SACK over datagrams -----------------
@@ -1176,11 +1224,25 @@ bool Endpoint::service_tx(Conn* c, bool* blocked) {
     TxItem* it = nullptr;
     {
       std::lock_guard<std::mutex> lk(c->txq_mtx);
-      if (c->txq.empty()) return true;
+      if (c->txq.empty()) {
+        // Reorder-injection stash nothing overtook: force-flush once the
+        // holdback deadline passes (this loop ticks every ~1 ms).
+        if (c->reorder_stash.empty() || now_ns() < c->stash_deadline_ns)
+          return true;
+        while (!c->reorder_stash.empty()) {
+          c->txq.push_back(std::move(c->reorder_stash.front()));
+          c->reorder_stash.pop_front();
+        }
+      }
       // Safe to use outside the lock: this thread is the sole consumer, and
       // deque push_back never invalidates references to existing elements.
       it = &c->txq.front();
     }
+    // Delay-jitter injection: the head frame is not due yet — park the
+    // whole queue (head-of-line, like a genuinely slow path) and let the
+    // tx loop's 1 ms tick retry.
+    if (it->t_not_before_ns != 0 && now_ns() < it->t_not_before_ns)
+      return true;
     // Stats credit up front: a peer can receive (and ack) the final bytes
     // while this thread is between its last send syscall and any post-hoc
     // accounting, which would let a completed blocking write observe a
@@ -1237,6 +1299,10 @@ void Endpoint::fail_txq(Conn* c) {
   {
     std::lock_guard<std::mutex> lk(c->txq_mtx);
     q.swap(c->txq);
+    while (!c->reorder_stash.empty()) {  // stashed frames die with the conn
+      q.push_back(std::move(c->reorder_stash.front()));
+      c->reorder_stash.pop_front();
+    }
   }
   size_t bytes = 0;
   for (auto& it : q) {

@@ -62,6 +62,16 @@ histogram-derived percentile within one bucket width — i.e. tracing
 crossed the process boundary, the clocks aligned, and the merge-safe
 histograms tell the same story as the exact in-process samples.
 
+``--transport`` mode (the windowed-SACK-transport smoke arm,
+benchmarks/incast_bench.py --smoke --metrics-out ... [--json-out ...]):
+the metrics file must show the lossy+reordering loopback run really
+exercised the transport — nonzero ``p2p_channel_retx_total`` WITH its
+``kind="fast"|"rto"`` split (selective repeat's fast-vs-timeout
+recovery), nonzero chunk issues, the credit plane visible (granted and
+consumed gauges nonzero, ``p2p_credit_stall_seconds_total`` present)
+and a nonzero srtt gauge (completion RTTs fed the estimator); with a
+bench JSON, every arm must carry its counter-delta retx labels.
+
 ``--router`` mode (the replica-router smoke arm, serve --server
 --replicas N --priority-classes ... --metrics-out): the metrics file
 must carry ≥2 replica-labeled ``serving_router_requests_total`` series
@@ -244,6 +254,71 @@ def check_disagg_metrics(path: str) -> None:
     total('serving_prefill_tokens_total{kind="computed"}')  # must exist
     print(f"check_obs: disagg metrics OK — {int(hits)} prefix-cache "
           f"hit(s), stream + skip series all nonzero")
+
+
+def check_transport_metrics(path: str, bench_json: str = "") -> None:
+    """The windowed-transport smoke arm (incast_bench --smoke): the lossy
+    +reordering loopback run must land its evidence on the REAL series —
+    nonzero SACK retransmissions with the fast/timeout split exported
+    (p2p_channel_retx_total{kind=}), chunk issues counted, the credit
+    plane visible (granted/consumed gauges nonzero, stall counter
+    present), and the RTT estimator fed (srtt gauge nonzero). With a
+    bench JSON, every arm's retx labels must have come from counter
+    deltas (retx_fast/retx_rto fields present and consistent with a
+    counted total)."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+
+    def total(prefix: str) -> float:
+        return _prom_total(lines, prefix, path)
+
+    if total("p2p_channel_chunks_total") <= 0:
+        fail(f"{path}: zero channel chunks — the windowed spray never ran")
+    retx_lines = [ln for ln in lines
+                  if ln.startswith("p2p_channel_retx_total")]
+    split = [ln for ln in retx_lines if 'kind="' in ln]
+    if not split:
+        fail(f"{path}: p2p_channel_retx_total carries no kind= split — "
+             f"fast-vs-timeout recovery is not distinguishable")
+    retx_total = sum(float(ln.rsplit(" ", 1)[1]) for ln in split)
+    if retx_total <= 0:
+        fail(f"{path}: zero SACK retransmissions — the lossy arm never "
+             f"exercised recovery")
+    for ln in split:
+        kind = ln.split('kind="', 1)[1].split('"', 1)[0]
+        if kind not in ("fast", "rto"):
+            fail(f"{path}: unexpected retx kind {kind!r}")
+    if total("p2p_credit_granted_bytes") <= 0:
+        fail(f"{path}: no pull credit granted — the eqds arm never ran "
+             f"receiver-driven")
+    if total("p2p_credit_consumed_bytes") <= 0:
+        fail(f"{path}: no pull credit consumed — senders never issued "
+             f"under credit")
+    if not any(ln.startswith("p2p_credit_stall_seconds_total")
+               for ln in lines):
+        fail(f"{path}: missing p2p_credit_stall_seconds_total — incast "
+             f"credit waits are invisible")
+    if total("p2p_chan_srtt_us") <= 0:
+        fail(f"{path}: p2p_chan_srtt_us zero — completion RTTs never fed "
+             f"the estimator")
+    arms_checked = 0
+    if bench_json:
+        with open(bench_json) as f:
+            for ln in f.read().splitlines():
+                if not ln.strip():
+                    continue
+                arm = json.loads(ln)
+                for k in ("retx_fast", "retx_rto", "chunks_issued"):
+                    if k not in arm:
+                        fail(f"{bench_json}: arm {arm.get('cc')} missing "
+                             f"counter-delta label {k!r}")
+                arms_checked += 1
+        if not arms_checked:
+            fail(f"{bench_json}: no bench arms recorded")
+    print(f"check_obs: transport metrics OK — {int(retx_total)} SACK "
+          f"retx with kind split, credit plane visible"
+          + (f", {arms_checked} counter-labeled arm(s)"
+             if bench_json else ""))
 
 
 def check_spec_metrics(path: str) -> None:
@@ -521,6 +596,10 @@ def main(argv) -> None:
         check_disagg_metrics(argv[2])
         print("check_obs: ALL OK")
         return
+    if len(argv) in (3, 4) and argv[1] == "--transport":
+        check_transport_metrics(argv[2], argv[3] if len(argv) == 4 else "")
+        print("check_obs: ALL OK")
+        return
     if len(argv) == 4 and argv[1] == "--quant":
         check_quant_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
@@ -534,6 +613,7 @@ def main(argv) -> None:
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
              "check_obs.py --plan METRICS_PROM BENCH_JSON | "
              "check_obs.py --disagg METRICS_PROM | "
+             "check_obs.py --transport METRICS_PROM [BENCH_JSON] | "
              "check_obs.py --spec METRICS_PROM | "
              "check_obs.py --router METRICS_PROM | "
              "check_obs.py --fleet MERGED_TRACE FLEET_PROM")

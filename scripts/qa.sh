@@ -47,6 +47,12 @@ JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --st
   --metrics-out /tmp/qa_router_metrics.prom; check $?
 python scripts/check_obs.py --router /tmp/qa_router_metrics.prom; check $?
 
+note "windowed transport smoke tier (lossy+reordering loopback incast: 4->1 channel fan-in at 2% drop / 20% reorder, swift + eqds-credit arms, payload bit-exact, SACK retx split + credit series validated)"
+timeout 600 python benchmarks/incast_bench.py --smoke \
+  --metrics-out /tmp/qa_transport_metrics.prom \
+  --json-out /tmp/qa_transport_bench.json; check $?
+python scripts/check_obs.py --transport /tmp/qa_transport_metrics.prom /tmp/qa_transport_bench.json; check $?
+
 note "disagg serving smoke tier (prefill+decode worker pair over p2p: chunk-streamed KV, >=1 prefix-cache hit, oracle-exact, telemetry validated; per-role trace/metrics dumps feed the fleet tier below)"
 UCCL_TPU_EXAMPLE_CPU=1 JAX_PLATFORMS=cpu timeout 600 python examples/disagg_kv.py --cpu \
   --trace-out /tmp/qa_fleet_trace.json --metrics-out /tmp/qa_disagg_metrics.prom; check $?

@@ -62,6 +62,73 @@ class TestSwift:
         assert cc.rate_for_rtt(1000.0) == pytest.approx(1e9)
 
 
+class TestSwiftRateAdapter:
+    """The controller adapter that used to live inline in Channel.enable_cc
+    — now a tested class beside RateController (cc.py)."""
+
+    def test_tracks_swift_rate(self):
+        from uccl_tpu.p2p.cc import SwiftRateAdapter
+
+        s = SwiftCC(cwnd=1e6)
+        ad = SwiftRateAdapter(s)
+        assert ad.rate == pytest.approx(s.rate_for_rtt(s.target_delay_us))
+        r = ad.on_rtt(100.0)  # under target: cwnd grows, rate from THIS rtt
+        assert r == ad.rate == pytest.approx(s.rate_for_rtt(100.0))
+        assert s.cwnd > 1e6
+
+    def test_duck_types_for_rate_controller(self):
+        from uccl_tpu.p2p.cc import SwiftRateAdapter
+
+        ad = SwiftRateAdapter(SwiftCC())
+        assert callable(ad.on_rtt) and ad.rate > 0
+
+
+class TestWindowCCAdapters:
+    """Window-bytes CC protocol for the data path (the windowed channel
+    sender feeds per-chunk completion RTTs and loss events)."""
+
+    def test_windowed_swift_acks_grow_losses_shrink(self):
+        from uccl_tpu.p2p.cc import WindowedSwift
+
+        cc = WindowedSwift(SwiftCC(cwnd=1e6))
+        for _ in range(5):
+            cc.on_ack(100.0, 64 << 10)  # under target delay
+        grown = cc.cwnd_bytes()
+        assert grown > 1e6
+        cc.on_loss(now=1e9)  # force past the decrease guard
+        assert cc.cwnd_bytes() < grown
+
+    def test_windowed_timely_is_rate_times_srtt(self):
+        from uccl_tpu.p2p.cc import TimelyCC, WindowedTimely
+
+        cc = WindowedTimely(TimelyCC(rate=100e6))
+        cc.on_ack(1000.0, 64 << 10)
+        # BDP of the controlled rate at the observed srtt
+        expect = cc.timely.rate * cc.srtt_us / 1e6
+        assert cc.cwnd_bytes() == pytest.approx(expect, rel=0.01)
+
+    def test_windowed_timely_loss_collapses_window(self):
+        from uccl_tpu.p2p.cc import TimelyCC, WindowedTimely
+
+        cc = WindowedTimely(TimelyCC(rate=1e9))
+        cc.on_ack(500.0, 64 << 10)
+        w = cc.cwnd_bytes()
+        for _ in range(10):
+            cc.on_loss()  # loss-is-congestion: fed as rtt >> t_high
+        assert cc.cwnd_bytes() < w
+
+    def test_factory(self):
+        from uccl_tpu.p2p.cc import (WindowedSwift, WindowedTimely,
+                                     make_window_cc)
+
+        assert make_window_cc(None) is None
+        assert make_window_cc("off") is None
+        assert isinstance(make_window_cc("swift"), WindowedSwift)
+        assert isinstance(make_window_cc("timely"), WindowedTimely)
+        with pytest.raises(ValueError):
+            make_window_cc("vegas")
+
+
 class TestPacing:
     def test_rate_limit_slows_transfers(self, rng):
         """With a 20 MB/s cap, a 4 MB transfer must take >= ~150 ms."""
@@ -199,6 +266,37 @@ class TestChannelCC:
             c_chan.disable_cc()
             client.close(); server.close()
 
+    def test_probe_errors_counted_not_swallowed(self):
+        """A failing probe loop must be VISIBLE (log-once + counted on
+        p2p_cc_probe_errors_total) and must keep running — the old
+        `except Exception: pass` silently killed CC for the channel's
+        lifetime on the first transient error."""
+        from uccl_tpu.p2p.channel import _CC_PROBE_ERRS
+
+        server, client, s_chan, c_chan = self._chan_pair()
+        try:
+            c_chan.enable_cc("timely", interval_s=0.003,
+                             probe_timeout_ms=50)
+
+            def boom(*a, **k):
+                raise RuntimeError("injected probe fault")
+
+            c_chan.cc.probe = boom
+            base = _CC_PROBE_ERRS.total()
+            deadline = time.time() + 5
+            while _CC_PROBE_ERRS.total() < base + 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert _CC_PROBE_ERRS.total() >= base + 2, (
+                "probe errors not counted"
+            )
+            # ≥2 counted increments == the loop SURVIVED the first raise
+            assert c_chan._cc_thread.is_alive()
+            assert any(labels.get("reason") == "RuntimeError"
+                       for labels, _ in _CC_PROBE_ERRS.samples())
+        finally:
+            c_chan.disable_cc()
+            client.close(); server.close()
+
 
 class TestProbeIsolation:
     """CC probes must not ride the control path (VERDICT round-2 weak #7):
@@ -268,10 +366,14 @@ class TestProbeIsolation:
                 # GENUINELY queued behind the burst (tb past a floor) —
                 # otherwise a drained-early burst would let any fast ti
                 # pass vacuously, with no HOL present to be immune to.
-                return tb > 0.02 and ti < tb / 4
+                # The floor is ms-scale, not the burst's full drain time:
+                # a fast loopback partially drains the queue before the
+                # busy probe lands, and several ms of queueing is already
+                # orders beyond an unblocked probe's RTT.
+                return tb > 0.002 and ti < tb / 4
 
             attempts = []
-            for _ in range(3):
+            for _ in range(6):
                 drained = _th.Thread(
                     target=lambda: [s_chan.recv(max_bytes=16 << 20,
                                                 timeout_ms=30000)
@@ -279,7 +381,12 @@ class TestProbeIsolation:
                 )
                 hol = _th.Thread(target=control_burst)
                 drained.start(); hol.start()
-                _time.sleep(0.05)  # let the burst occupy path 0's tx queue
+                # let the burst reach path 0's tx queue, but probe while
+                # it is still DRAINING — sleeping longer lets a fast
+                # loopback drain the whole burst first, and the busy
+                # probe then never queues (tb under the validity floor:
+                # every attempt vacuous, the test flakes)
+                _time.sleep(0.02)
                 t_isolated = timed_probe(c_chan.probe_conn)
                 t_busy = timed_probe(c_chan.conns[0])
                 hol.join(timeout=120); drained.join(timeout=120)

@@ -266,6 +266,28 @@ class TestRetransmission:
             assert "still in flight" in str(e)
         np.testing.assert_array_equal(dst, src)
 
+    def test_retx_split_is_counted(self, chan_pair, rng):
+        """Windowed recovery exports its fast-vs-RTO split: after a lossy
+        transfer the per-channel totals reconcile with the lifetime
+        retransmission count."""
+        server, client, s_chan, c_chan = chan_pair
+        c_chan.retries = 8
+        n = 1 << 20
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        client.set_drop_rate(0.3)
+        try:
+            c_chan.write(src, fifo, timeout_ms=1000)
+        finally:
+            client.set_drop_rate(0.0)
+        np.testing.assert_array_equal(dst, src)
+        assert c_chan.retransmitted_chunks > 0
+        assert c_chan.retx_fast + c_chan.retx_rto == c_chan.retransmitted_chunks
+        st = c_chan.transport_stats()
+        assert st["retx_fast_total"] == c_chan.retx_fast
+        assert st["srtt_us"] > 0  # completion RTTs fed the estimator
+
     @pytest.mark.parametrize("seed", range(4))
     def test_lossy_write_never_corrupts(self, chan_pair, seed):
         """THE retransmission invariant, fuzzed: whatever the (drop rate,
@@ -290,3 +312,159 @@ class TestRetransmission:
         finally:
             client.set_drop_rate(0.0)
         np.testing.assert_array_equal(dst, src)
+
+
+class TestReorderInjection:
+    """Out-of-order delivery (satellite of the windowed-transport PR): the
+    engine's reorder injection swaps same-conn data frames, so chunks land
+    — and their completions arrive — out of order. The SACK window must
+    converge bit-exactly, and pure reordering must never trigger the mass
+    or spurious retransmission the old attempt-batched path risked."""
+
+    def test_reordered_chunks_bit_exact_no_spurious_retx(self, chan_pair, rng):
+        server, client, s_chan, c_chan = chan_pair
+        # dup-ack fast retx disabled (k > chunk count): with no loss, ANY
+        # retransmission would be spurious — the assert below is exact
+        c_chan.dupack_k = 64
+        n = 1 << 20  # 16 chunks of 64K over 4 paths
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        client.set_reorder_rate(0.5)
+        try:
+            c_chan.write(src, fifo, timeout_ms=5000)
+        finally:
+            client.set_reorder_rate(0.0)
+        np.testing.assert_array_equal(dst, src)
+        assert c_chan.retransmitted_chunks == 0
+        win = c_chan._last_win
+        assert win.done() and win.sack_bitmap() == 0  # SACK converged
+
+    def test_reorder_with_default_dupack_k_stays_selective(self, chan_pair,
+                                                           rng):
+        """With the default k=3, heavy injected reorder may fire a few
+        fast retransmits (dup-ack schemes trade exactly this) — but
+        recovery must stay bounded and bit-exact, never the pending set."""
+        server, client, s_chan, c_chan = chan_pair
+        n = 1 << 20
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        client.set_reorder_rate(0.5)
+        try:
+            c_chan.write(src, fifo, timeout_ms=5000)
+        finally:
+            client.set_reorder_rate(0.0)
+        np.testing.assert_array_equal(dst, src)
+        assert c_chan.retransmitted_chunks <= 4  # selective, not mass
+
+    def test_drop_plus_reorder_bit_exact(self, chan_pair, rng):
+        """The combined fault the acceptance bar names: loss AND
+        reordering at once, recovered exactly."""
+        server, client, s_chan, c_chan = chan_pair
+        c_chan.retries = 8
+        n = 1 << 20
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        client.set_drop_rate(0.15)
+        client.set_reorder_rate(0.3)
+        try:
+            c_chan.write(src, fifo, timeout_ms=2000)
+        finally:
+            client.set_drop_rate(0.0)
+            client.set_reorder_rate(0.0)
+        np.testing.assert_array_equal(dst, src)
+        assert c_chan.retransmitted_chunks > 0
+
+    def test_delay_jitter_completes_and_samples_rtt(self, chan_pair, rng):
+        server, client, s_chan, c_chan = chan_pair
+        c_chan.dupack_k = 64  # jitter != loss: no fast retx wanted
+        n = 512 << 10
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        client.set_delay_jitter_us(3000)
+        try:
+            c_chan.write(src, fifo, timeout_ms=10000)
+        finally:
+            client.set_delay_jitter_us(0)
+        np.testing.assert_array_equal(dst, src)
+        assert c_chan._last_win.srtt_us > 500  # the jitter showed up
+
+
+class TestPathSteering:
+    def test_retx_and_new_chunks_avoid_lossy_path(self, chan_pair, rng):
+        """Per-path quality EWMA (the anti-blind-rotation satellite of the
+        tentpole): with ONE path fault-injected lossy, recovery is exact
+        and the window's learned path score for the lossy path drops below
+        the healthy ones."""
+        server, client, s_chan, c_chan = chan_pair
+        c_chan.retries = 8
+        lossy = 1
+        client.set_conn_fault(c_chan.conns[lossy], drop=0.7)
+        n = 2 << 20  # 32 chunks
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        try:
+            c_chan.write(src, fifo, timeout_ms=2000)
+        finally:
+            client.set_conn_fault(c_chan.conns[lossy], drop=-1.0)
+        np.testing.assert_array_equal(dst, src)
+        scores = c_chan._last_win.stats()["path_scores"]
+        healthy = [s for i, s in enumerate(scores) if i != lossy]
+        assert scores[lossy] < min(healthy), scores
+
+
+class TestWindowCC:
+    def test_swift_window_cc_recovers_lossy_transfer(self, chan_pair, rng):
+        """Window CC on the data path: Swift fed by per-chunk completion
+        RTTs carries a lossy transfer exactly, and losses shrink the cwnd
+        below its starting point."""
+        from uccl_tpu.p2p.cc import WindowedSwift
+
+        server, client, s_chan, c_chan = chan_pair
+        c_chan.retries = 8
+        c_chan.enable_window_cc("swift")
+        assert isinstance(c_chan.window_cc, WindowedSwift)
+        cwnd0 = c_chan.window_cc.cwnd_bytes()
+        n = 1 << 20
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        client.set_drop_rate(0.3)
+        try:
+            c_chan.write(src, fifo, timeout_ms=2000)
+        finally:
+            client.set_drop_rate(0.0)
+            c_chan.disable_window_cc()
+        np.testing.assert_array_equal(dst, src)
+        assert c_chan.retransmitted_chunks > 0
+
+    def test_timely_window_cc_clean_transfer(self, chan_pair, rng):
+        server, client, s_chan, c_chan = chan_pair
+        c_chan.enable_window_cc("timely")
+        n = 1 << 20
+        dst = np.zeros(n, np.uint8)
+        fifo = server.advertise(server.reg(dst))
+        src = rng.integers(0, 255, n).astype(np.uint8)
+        try:
+            c_chan.write(src, fifo, timeout_ms=5000)
+        finally:
+            c_chan.disable_window_cc()
+        np.testing.assert_array_equal(dst, src)
+        assert c_chan.retransmitted_chunks == 0
+
+    def test_writev_windowed_batch(self, chan_pair, rng):
+        """writev: many (src, fifo) elements ride ONE windowed transfer."""
+        server, client, s_chan, c_chan = chan_pair
+        dst = np.zeros(256 << 10, np.uint8)
+        mr = server.reg(dst)
+        srcs, fifos = [], []
+        step = 32 << 10
+        for off in range(0, dst.nbytes, step):
+            srcs.append(rng.integers(0, 255, step).astype(np.uint8))
+            fifos.append(server.advertise(mr, offset=off, length=step))
+        c_chan.writev(srcs, fifos, timeout_ms=5000)
+        np.testing.assert_array_equal(dst, np.concatenate(srcs))

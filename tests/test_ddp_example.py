@@ -12,6 +12,8 @@ import re
 import subprocess
 import sys
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXAMPLE = os.path.join(_REPO, "examples", "ddp_train.py")
 
@@ -27,7 +29,8 @@ def _run(extra):
     return re.findall(r"step\s+(\d+) loss ([\d.]+)", r.stdout)
 
 
-def test_process_ranks_match_mesh_trajectory():
+@pytest.mark.slow  # ~9 s subprocess example; covered by qa.sh's example
+def test_process_ranks_match_mesh_trajectory():  # tier + unfiltered pytest
     mesh = _run(["--devices", "2"])
     procs = _run(["--processes", "2"])
     assert mesh and procs

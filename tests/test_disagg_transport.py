@@ -1,0 +1,169 @@
+"""Disagg fan-in over the windowed SACK transport, under injected faults.
+
+The acceptance arm of the windowed-transport PR: TWO PrefillWorkers stream
+interleaved KV into ONE DecodeWorker over multipath *Channels* (selective
+repeat + per-path steering + receiver-driven pull credit), with drop AND
+reorder injected on both prefill endpoints' data planes. Every adopted
+request must stay bit-identical to the one-shot oracle — loss is recovered
+by the transport, not visible to serving — and the run must actually
+exercise the machinery: ≥1 counted retransmission, pull credit granted and
+consumed.
+
+Multi-compile (three engines) + native transfer engine => slow-marked;
+runs unfiltered in CI/qa.sh like the other disagg arms. The transport
+itself is tier-1-covered host-only (tests/test_sack.py) and at channel
+level (tests/test_channel.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from uccl_tpu.serving import ServingEngine
+
+MAX_SEQ = 32
+
+
+@pytest.fixture(scope="module")
+def dense_setup():
+    import jax
+
+    from uccl_tpu.models import dense
+    from uccl_tpu.serving import DenseBackend
+
+    cfg = dense.DenseConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, head_dim=8,
+        ffn=64,
+    )
+    params = dense.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params, DenseBackend
+
+
+def _oracle(params, cfg, req):
+    import jax.numpy as jnp
+
+    from uccl_tpu.models.inference import generate
+
+    toks = generate(params, jnp.asarray(req.prompt)[None], cfg,
+                    max_new_tokens=req.max_new_tokens, max_seq=MAX_SEQ)
+    return np.asarray(toks)[0, : req.n_generated].tolist()
+
+
+@pytest.mark.slow
+class TestLossyChannelFanIn:
+    def test_two_prefill_one_decode_lossy_reordering(self, dense_setup):
+        from uccl_tpu.p2p import Endpoint
+        from uccl_tpu.serving.disagg import DecodeWorker, add_local_prefill
+
+        cfg, params, DenseBackend = dense_setup
+        pes = [ServingEngine(DenseBackend(params, cfg, n_slots=2,
+                                          max_seq=MAX_SEQ),
+                             prefill_chunk=4) for _ in range(2)]
+        de = ServingEngine(DenseBackend(params, cfg, n_slots=4,
+                                        max_seq=MAX_SEQ))
+        # decode side = the incast actuator: PullPacer grants byte credit
+        # across both inbound channels at the configured drain rate
+        dw = DecodeWorker(de, Endpoint(), pull_rate_bps=64e6)
+        pws = [
+            add_local_prefill(dw, pe, transport="channel", n_paths=2,
+                              chunk_bytes=8 << 10, pull=True,
+                              window_cc="swift")
+            for pe in pes
+        ]
+        for pw in pws:
+            assert pw.chan is not None
+            pw.chan.retries = 8  # loss-soak budget
+
+        def pump(n_done, done, deadline_s=120.0):
+            deadline = time.monotonic() + deadline_s
+            while len(done) < n_done:
+                for pw in pws:
+                    pw.step()
+                done.extend(dw.step())
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"fan-in stalled at {len(done)}")
+            return done
+
+        try:
+            # warm (compiles + full wire path), then zero the metrics
+            for pw in pws:
+                pw.submit(np.zeros(8, np.int32), max_new_tokens=2)
+            pump(2, [])
+            for eng in pes + [de]:
+                eng.reset_metrics()
+
+            # loss + reorder on BOTH prefill data planes (scoped to
+            # one-sided data frames: BEGIN/GRANT/FINAL notifs are the
+            # reliable control plane, like the reference's ctrl QP)
+            for pw in pws:
+                pw.ep.set_drop_rate(0.2)
+                pw.ep.set_reorder_rate(0.3)
+
+            rng = np.random.default_rng(23)
+            prompts = [rng.integers(0, 64, 6 + i).astype(np.int32)
+                       for i in range(6)]
+            done = []
+            for i, p in enumerate(prompts):
+                r = pws[i % 2].submit(p, max_new_tokens=4)
+                assert r is not None
+                for pw in pws:
+                    pw.step()
+                done.extend(dw.step())
+            pump(6, done)
+        finally:
+            for pw in pws:
+                pw.ep.set_drop_rate(0.0)
+                pw.ep.set_reorder_rate(0.0)
+            rx_chans = list(dw.channels)  # close() releases the list
+            dw.close()
+
+        # oracle-exact through injected loss+reorder — the transport
+        # recovered every slab bit-exactly or this fails loudly
+        assert len(done) == 6
+        for r in done:
+            assert r.adopted
+            assert r.out_tokens == _oracle(params, cfg, r), r.rid
+        for eng in pes + [de]:
+            assert eng.pool.leaked() == 0
+
+        # the run really exercised the windowed transport:
+        retx = sum(pw.chan.retransmitted_chunks for pw in pws)
+        assert retx >= 1, "no retransmission counted at 20% injected drop"
+        # ...selectively: far fewer retx than total issued chunks
+        issued = sum(pw.chan._last_win.stats()["chunks"] for pw in pws)
+        assert issued > 0
+        # ...and under receiver-driven credit that actually flowed
+        assert rx_chans and all(ch.pull_granted > 0 for ch in rx_chans)
+        assert all(pw.chan.pull_credit > 0 for pw in pws)
+        assert all(pw.chan._pull_sent > 0 for pw in pws)
+        # the TTFT transfer leg was measured under incast for every adopt
+        assert len(de.metrics.disagg_transfer_s) == 6
+        assert all(t >= 0 for t in de.metrics.disagg_transfer_s)
+
+    def test_ttft_transfer_leg_measured(self, dense_setup):
+        """The TTFT split survives the channel transport: adopted requests
+        carry a nonzero transfer leg (measured under the windowed ship)."""
+        from uccl_tpu.p2p import Endpoint
+        from uccl_tpu.serving.disagg import DecodeWorker, add_local_prefill
+
+        cfg, params, DenseBackend = dense_setup
+        pe = ServingEngine(DenseBackend(params, cfg, n_slots=2,
+                                        max_seq=MAX_SEQ), prefill_chunk=4)
+        de = ServingEngine(DenseBackend(params, cfg, n_slots=2,
+                                        max_seq=MAX_SEQ))
+        dw = DecodeWorker(de, Endpoint())
+        pw = add_local_prefill(dw, pe, transport="channel", n_paths=2,
+                               chunk_bytes=8 << 10)
+        pw.submit(np.arange(8, dtype=np.int32) % 64, max_new_tokens=3)
+        done = []
+        deadline = time.monotonic() + 120.0
+        while len(done) < 1:
+            pw.step()
+            done.extend(dw.step())
+            assert time.monotonic() < deadline
+        (r,) = done
+        assert r.adopted
+        assert len(de.metrics.disagg_transfer_s) == 1
+        assert de.metrics.disagg_transfer_s[0] >= 0
+        assert r.out_tokens == _oracle(params, cfg, r)

@@ -57,13 +57,38 @@ class TestPullMode:
             assert s_chan.pull_granted == 256 << 10
 
     def test_credit_stall_times_out(self):
+        from uccl_tpu.p2p.channel import _CREDIT_STALL
+
         with Endpoint(n_engines=2) as server, Endpoint(n_engines=2) as client:
             s_chan, c_chan = _chan_pair(server, client)
             c_chan.enable_pull_sender()
             dst = np.zeros(4096, np.uint8)
             fifo = server.advertise(server.reg(dst))
+            base = _CREDIT_STALL.total()
             with pytest.raises(TimeoutError, match="pull credit stalled"):
                 c_chan.write(np.ones(4096, np.uint8), fifo, timeout_ms=300)
+            # the stall is VISIBLE: ~0.3 s landed on the counter
+            assert _CREDIT_STALL.total() - base >= 0.25
+
+    def test_credit_gauges_track_grant_and_consumption(self):
+        from uccl_tpu.p2p.channel import _CREDIT_CONSUMED, _CREDIT_GRANTED
+
+        with Endpoint(n_engines=2) as server, Endpoint(n_engines=2) as client:
+            s_chan, c_chan = _chan_pair(server, client)
+            c_chan.chunk_bytes = 16 << 10
+            c_chan.enable_pull_sender()
+            dst = np.zeros(64 << 10, np.uint8)
+            fifo = server.advertise(server.reg(dst))
+            src = np.arange(64 << 10, dtype=np.uint8) % 251
+            s_chan.grant_credit(64 << 10)
+            c_chan.write(src, fifo, timeout_ms=20000)
+            np.testing.assert_array_equal(dst, src)
+            granted = {labels.get("conn"): v
+                       for labels, v in _CREDIT_GRANTED.samples()}
+            consumed = {labels.get("conn"): v
+                        for labels, v in _CREDIT_CONSUMED.samples()}
+            assert granted[str(s_chan.conns[0])] == 64 << 10
+            assert consumed[str(c_chan.conns[0])] == 64 << 10
 
     def test_pacer_rate_bounds_transfer(self):
         """8 MB at a 32 MB/s grant rate cannot finish in under ~200 ms (the

@@ -13,6 +13,13 @@ import subprocess
 
 import pytest
 
+# slow: these build + run the native binaries under three sanitizer
+# configs (~40 s pinned) and are exact duplicates of CI's dedicated
+# `native` job (make -C native test/tsan/asan) and qa.sh's native step —
+# tier-1 sat at the 870 s cap, so the duplicated copies moved out of it
+# (they still run in the unfiltered qa.sh/CI pytest tiers).
+pytestmark = pytest.mark.slow
+
 _NATIVE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 
 

@@ -21,17 +21,25 @@ from uccl_tpu.models.resnet import (
 
 
 class TestArchitecture:
+    # The full-width 1000-class param-count builds and the depth-50
+    # forward are ~15 s of init on a pinned CPU core; tier-1 sits at its
+    # 870 s cap, so they ride the unfiltered qa.sh/CI tiers (depth-18
+    # shape coverage stays in tier-1).
+    @pytest.mark.slow
     def test_resnet50_param_count(self):
         """25.56M @ 1000 classes — the canonical ResNet-50 size."""
         p, _ = init_params(jax.random.PRNGKey(0), ResNetConfig(depth=50))
         assert abs(num_params(p) / 1e6 - 25.56) < 0.02
 
+    @pytest.mark.slow
     def test_resnet18_param_count(self):
         """11.69M @ 1000 classes — canonical ResNet-18."""
         p, _ = init_params(jax.random.PRNGKey(0), ResNetConfig(depth=18))
         assert abs(num_params(p) / 1e6 - 11.69) < 0.02
 
-    @pytest.mark.parametrize("depth", [18, 50])
+    @pytest.mark.parametrize(
+        "depth", [18, pytest.param(50, marks=pytest.mark.slow)]
+    )
     def test_forward_shapes(self, depth):
         cfg = ResNetConfig(depth=depth, num_classes=10, width=16)
         p, s = init_params(jax.random.PRNGKey(0), cfg)

@@ -1,0 +1,244 @@
+"""SACK window state machine: host-only properties (no transport, fast).
+
+The windowed channel sender's whole reliability brain lives in
+uccl_tpu/p2p/sack.py as a pure state machine; these tests drive it with a
+virtual clock and scripted loss/reorder, pinning the properties the wire
+tests then observe end-to-end: cumulative ack monotonicity, SACK bitmap
+layout (native udp_send_ack convention), *selective* repeat (retx count ==
+lost attempts, never the pending set), dup-ack fast retransmit, RTO
+exponential backoff with Karn's rule, window gating, and per-path quality
+steering.
+"""
+
+import numpy as np
+import pytest
+
+from uccl_tpu.p2p.sack import FAST, NEW, RTO, PathQuality, SackTxWindow
+
+
+def _issue_all(win, now, cwnd=1 << 30):
+    """Issue everything sendable; returns [(seq, kind, path)]."""
+    out = []
+    for seq, kind in win.sendable(now, cwnd):
+        path = win.pick_path(seq, kind)
+        win.mark_sent(seq, path, kind, now)
+        out.append((seq, kind, path))
+    return out
+
+
+class TestPathQuality:
+    def test_ack_and_loss_move_score(self):
+        pq = PathQuality(2)
+        for _ in range(5):
+            pq.on_loss(0)
+        assert pq.score[0] < 0.3 < pq.score[1]
+        for _ in range(20):
+            pq.on_ack(0)
+        assert pq.score[0] > 0.9
+
+    def test_pick_new_round_robins_healthy_paths(self):
+        pq = PathQuality(4)
+        picks = []
+        for _ in range(8):
+            p = pq.pick_new()
+            pq.on_sent(p)
+            picks.append(p)
+        # equal scores -> quality/load balancing degenerates to round-robin
+        assert picks[:4] == [0, 1, 2, 3] and sorted(picks[4:]) == [0, 1, 2, 3]
+
+    def test_pick_new_starves_lossy_path(self):
+        pq = PathQuality(3)
+        for _ in range(6):
+            pq.on_loss(1)
+        picks = []
+        for _ in range(9):
+            p = pq.pick_new()
+            pq.on_sent(p)
+            picks.append(p)
+        assert picks.count(1) < picks.count(0)
+        assert picks.count(1) < picks.count(2)
+
+    def test_pick_retx_avoids_failed_path(self):
+        pq = PathQuality(3)
+        for _ in range(50):
+            assert pq.pick_retx(avoid=1) != 1
+        # single path has no choice
+        assert PathQuality(1).pick_retx(avoid=0) == 0
+
+    def test_srtt_ewma(self):
+        pq = PathQuality(1)
+        pq.on_sent(0)
+        pq.on_ack(0, rtt_us=100.0)
+        assert pq.srtt_us[0] == 100.0
+        for _ in range(50):
+            pq.on_sent(0)
+            pq.on_ack(0, rtt_us=500.0)
+        assert 400 < pq.srtt_us[0] <= 500
+
+
+class TestWindowBasics:
+    def test_in_order_acks_advance_cum_ack(self):
+        win = SackTxWindow([100] * 4, n_paths=2)
+        _issue_all(win, 0.0)
+        for s in range(4):
+            win.on_ack(s, now=0.01, path=s % 2, rtt_us=100.0)
+            assert win.cum_ack == s + 1
+            assert win.sack_bitmap() == 0  # no holes ever
+        assert win.done() and win.retx_fast == 0 and win.retx_rto == 0
+
+    def test_sack_bitmap_matches_native_layout(self):
+        """bit rel-1 set for acked seq cum_ack+rel (rel>=1) — the layout
+        native udp_send_ack puts on the wire."""
+        win = SackTxWindow([10] * 8, n_paths=1, dupack_k=100)
+        _issue_all(win, 0.0)
+        for s in (1, 3, 4, 7):
+            win.on_ack(s, now=0.0)
+        assert win.cum_ack == 0
+        assert win.sack_bitmap() == (
+            (1 << 0) | (1 << 2) | (1 << 3) | (1 << 6)
+        )
+        win.on_ack(0, now=0.0)  # fills the head hole
+        assert win.cum_ack == 2
+        assert win.sack_bitmap() == ((1 << 0) | (1 << 1) | (1 << 4))
+
+    def test_window_gates_new_chunks_by_bytes(self):
+        win = SackTxWindow([100] * 10, n_paths=1)
+        sent = _issue_all(win, 0.0, cwnd=350)
+        assert len(sent) == 3  # 3x100 <= 350, 4th would exceed
+        assert win.inflight_bytes() == 300
+        win.on_ack(0, now=0.01)
+        sent = _issue_all(win, 0.01, cwnd=350)
+        assert [s for s, _, _ in sent] == [3]  # exactly the freed room
+
+    def test_collapsed_window_still_admits_one_chunk(self):
+        win = SackTxWindow([1000], n_paths=1)
+        assert [s for s, _ in win.sendable(0.0, 1)] == [0]
+
+    def test_duplicate_ack_ignored(self):
+        win = SackTxWindow([10, 10], n_paths=1)
+        _issue_all(win, 0.0)
+        assert win.on_ack(0, now=0.0)
+        assert not win.on_ack(0, now=0.0)  # stale duplicate
+        assert win.acks == 1
+
+
+class TestSelectiveRepeat:
+    def test_fast_retx_after_k_dupacks(self):
+        win = SackTxWindow([10] * 6, n_paths=2, dupack_k=3)
+        _issue_all(win, 0.0)
+        # chunk 0 lost; later chunks complete out of order around it
+        win.on_ack(1, now=0.01)
+        win.on_ack(2, now=0.01)
+        assert win.sendable(0.02, 1 << 30) == []  # 2 dupacks: not yet
+        win.on_ack(3, now=0.02)
+        out = win.sendable(0.02, 1 << 30)
+        assert out == [(0, FAST)]
+        path = win.pick_path(0, FAST)
+        win.mark_sent(0, path, FAST, 0.02)
+        assert win.retx_fast == 1
+        # at most one fast retx per transmission: more dupacks don't re-mark
+        win.on_ack(4, now=0.03)
+        win.on_ack(5, now=0.03)
+        assert win.sendable(0.03, 1 << 30) == []
+        win.on_ack(0, now=0.04)
+        assert win.done()
+
+    def test_swap_adjacent_reorder_never_fast_retxes(self):
+        """Reorder-by-one (the injected stash swap) yields exactly one
+        dup-ack per displaced chunk — below k=3, so pure reordering never
+        triggers spurious retransmission."""
+        win = SackTxWindow([10] * 8, n_paths=1, dupack_k=3)
+        _issue_all(win, 0.0)
+        order = [1, 0, 3, 2, 5, 4, 7, 6]
+        for s in order:
+            win.on_ack(s, now=0.01)
+            assert win.sendable(0.011, 1 << 30) == []
+        assert win.done() and win.retx_fast == 0 and win.retx_rto == 0
+
+    def test_retx_count_equals_lost_attempts(self):
+        """THE selectivity property: with chunk i's first d_i attempts
+        scripted lost, total retransmissions == sum(d_i) — never the whole
+        pending set."""
+        rng = np.random.default_rng(7)
+        drops = {i: int(d) for i, d in enumerate(rng.integers(0, 3, 20))}
+        win = SackTxWindow([64] * 20, n_paths=4, dupack_k=3, max_tx=8,
+                           rto_init_s=0.1, rto_min_s=0.05)
+        t, rtt = 0.0, 0.001
+        pending = []  # (deliver_t, seq, path)
+        attempts = {i: 0 for i in drops}
+        while not win.done():
+            assert t < 60.0, "window failed to converge"
+            for seq, kind in win.sendable(t, 1 << 30):
+                path = win.pick_path(seq, kind)
+                win.mark_sent(seq, path, kind, t)
+                attempts[seq] += 1
+                if attempts[seq] > drops[seq]:  # this attempt survives
+                    pending.append((t + rtt, seq, path))
+            t += 0.0005
+            due = [p for p in pending if p[0] <= t]
+            pending = [p for p in pending if p[0] > t]
+            for _, seq, path in due:
+                win.on_ack(seq, now=t, path=path, rtt_us=rtt * 1e6)
+        lost = sum(drops.values())
+        assert win.retx_fast + win.retx_rto == lost
+        assert win.stats()["cum_ack"] == 20
+
+    def test_rto_fires_with_exponential_backoff(self):
+        win = SackTxWindow([10], n_paths=1, max_tx=4, rto_init_s=0.1,
+                           rto_min_s=0.1, rto_max_s=10.0)
+        _issue_all(win, 0.0)
+        assert win.sendable(0.05, 1 << 30) == []       # < rto
+        assert win.sendable(0.11, 1 << 30) == [(0, RTO)]
+        win.mark_sent(0, 0, RTO, 0.11)
+        assert win.retx_rto == 1
+        assert win.sendable(0.11 + 0.15, 1 << 30) == []  # backoff doubled
+        assert win.sendable(0.11 + 0.21, 1 << 30) == [(0, RTO)]
+
+    def test_exhausted_after_max_tx(self):
+        win = SackTxWindow([10, 10], n_paths=1, max_tx=2, rto_init_s=0.1,
+                           rto_min_s=0.1)
+        _issue_all(win, 0.0)
+        win.on_ack(1, now=0.01)
+        _ = win.sendable(0.15, 1 << 30)
+        win.mark_sent(0, 0, RTO, 0.15)          # 2nd and final attempt
+        assert win.exhausted(0.2) == []          # still in flight
+        assert win.exhausted(0.4) == [0]         # due again, no budget
+        assert win.sendable(0.4, 1 << 30) == []  # never offered again
+
+    def test_on_error_reissues_without_rto_wait(self):
+        win = SackTxWindow([10] * 2, n_paths=2, rto_init_s=5.0,
+                           rto_min_s=5.0)
+        _issue_all(win, 0.0)
+        win.on_error(1, path=1, now=0.001)  # conn died under the attempt
+        out = win.sendable(0.002, 1 << 30)
+        assert out == [(1, RTO)]
+        assert win.pick_path(1, RTO) == 0  # steered off the dead path
+
+
+class TestRttEstimator:
+    def test_jacobson_srtt_and_rto(self):
+        win = SackTxWindow([10] * 4, n_paths=1, rto_min_s=0.001)
+        _issue_all(win, 0.0)
+        for s in range(4):
+            win.on_ack(s, now=0.01, rtt_us=1000.0)
+        assert win.srtt_us == pytest.approx(1000.0)
+        # steady 1ms RTT -> rttvar decays -> rto well under the 2s cap
+        assert win.rto_s < 0.01
+
+    def test_karn_rule_skips_retransmitted_samples(self):
+        win = SackTxWindow([10, 10], n_paths=1, rto_init_s=0.1,
+                           rto_min_s=0.05)
+        _issue_all(win, 0.0)
+        win.on_ack(1, now=0.01, rtt_us=500.0)
+        srtt_before = win.srtt_us
+        _ = win.sendable(0.2, 1 << 30)
+        win.mark_sent(0, 0, RTO, 0.2)
+        # ambiguous sample from a retransmitted chunk: no estimator update
+        win.on_ack(0, now=0.25, rtt_us=250000.0)
+        assert win.srtt_us == srtt_before
+
+    def test_issue_kinds_label_correctly(self):
+        win = SackTxWindow([10] * 5, n_paths=2, dupack_k=2, rto_init_s=0.1,
+                           rto_min_s=0.1)
+        kinds = [k for _, k in win.sendable(0.0, 1 << 30)]
+        assert kinds == [NEW] * 5

@@ -9,5 +9,7 @@ from uccl_tpu.p2p.endpoint import Endpoint, FIFO_ITEM_BYTES
 from uccl_tpu.p2p.ray_api import XferEndpoint
 from uccl_tpu.p2p.channel import Channel, FifoItem
 from uccl_tpu.p2p.eqds import PullPacer
+from uccl_tpu.p2p.sack import PathQuality, SackTxWindow
 
-__all__ = ["Endpoint", "FIFO_ITEM_BYTES", "Channel", "FifoItem", "PullPacer", "XferEndpoint"]
+__all__ = ["Endpoint", "FIFO_ITEM_BYTES", "Channel", "FifoItem", "PullPacer",
+           "PathQuality", "SackTxWindow", "XferEndpoint"]

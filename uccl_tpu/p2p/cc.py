@@ -115,6 +115,125 @@ class SwiftCC:
         return self.cwnd / (max(rtt_us, 1.0) / 1e6)
 
 
+class CongestionControl:
+    """Window-bytes congestion-control protocol for the data path.
+
+    The windowed channel sender (``Channel`` + :class:`~uccl_tpu.p2p.sack.
+    SackTxWindow`) gates NEW chunk issue on ``cwnd_bytes()`` and feeds the
+    controller every chunk's **completion RTT** (``on_ack``) and every
+    loss event (``on_loss`` — RTO fire or path death). Window-sized rather
+    than rate-sized because the sender's actuator is "how many bytes may
+    be un-acked", the same quantity Swift controls natively and the
+    reference actuates per flow (include/cc/swift.h cwnd). Implementations
+    are plain objects with these three methods — duck-typed, no inheritance
+    required; this class just documents the contract.
+    """
+
+    def cwnd_bytes(self) -> int:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def on_ack(self, rtt_us: float, nbytes: int) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def on_loss(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class WindowedSwift(CongestionControl):
+    """Swift on the data path: the cwnd IS the sender window.
+
+    Completion RTTs feed the delay-target AIMD directly (they include
+    queueing on the path — the signal Swift wants); a loss event applies
+    the multiplicative decrease, bounded to once per target-delay interval
+    exactly like the over-target path (include/cc/swift.h's
+    retransmit-triggered decrease)."""
+
+    def __init__(self, swift: Optional[SwiftCC] = None,
+                 loss_beta: float = 0.7):
+        self.swift = swift if swift is not None else SwiftCC()
+        self.loss_beta = loss_beta
+
+    def cwnd_bytes(self) -> int:
+        return int(self.swift.cwnd)
+
+    def on_ack(self, rtt_us: float, nbytes: int) -> None:
+        self.swift.on_delay(rtt_us)
+
+    def on_loss(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        s = self.swift
+        if now - s._last_decrease > s.target_delay_us / 1e6:
+            s.cwnd = max(s.min_cwnd, s.cwnd * self.loss_beta)
+            s._last_decrease = now
+
+    def __repr__(self) -> str:
+        return f"WindowedSwift(cwnd={int(self.swift.cwnd)})"
+
+
+class WindowedTimely(CongestionControl):
+    """Timely on the data path: rate control converted to a window.
+
+    Timely emits a RATE; the sender needs a WINDOW. The bridge is the
+    bandwidth-delay product of the controlled rate: ``cwnd = rate × srtt``
+    (srtt EWMA'd from the same completion samples). Loss feeds the
+    gradient an RTT pinned above ``t_high`` — the loss-IS-congestion
+    stance ``CcController.tick`` already takes for the UDP wire — so
+    multiplicative decrease engages even when surviving chunks look
+    healthy."""
+
+    def __init__(self, timely: Optional[TimelyCC] = None,
+                 min_window: int = 64 * 1024, max_window: int = 1 << 30):
+        self.timely = timely if timely is not None else TimelyCC()
+        self.srtt_us = 0.0
+        self.min_window = min_window
+        self.max_window = max_window
+
+    def cwnd_bytes(self) -> int:
+        srtt = max(self.srtt_us, self.timely.min_rtt_us)
+        w = self.timely.rate * srtt / 1e6
+        return int(min(max(w, self.min_window), self.max_window))
+
+    def on_ack(self, rtt_us: float, nbytes: int) -> None:
+        self.srtt_us = (rtt_us if self.srtt_us == 0.0
+                        else 0.875 * self.srtt_us + 0.125 * rtt_us)
+        self.timely.on_rtt(rtt_us)
+
+    def on_loss(self) -> None:
+        self.timely.on_rtt(self.timely.t_high_us * 2.0)
+
+    def __repr__(self) -> str:
+        return (f"WindowedTimely(rate={self.timely.rate:.3g}, "
+                f"cwnd={self.cwnd_bytes()})")
+
+
+def make_window_cc(algo: Optional[str]) -> Optional[CongestionControl]:
+    """Factory for the channel's data-path CC: "timely", "swift" or None
+    (fixed window)."""
+    if algo is None or algo in ("", "off", "none"):
+        return None
+    if algo == "timely":
+        return WindowedTimely()
+    if algo == "swift":
+        return WindowedSwift()
+    raise ValueError(f"unknown window cc algo {algo!r}")
+
+
+class SwiftRateAdapter:
+    """Feed delays to Swift; expose ``on_rtt`` for :class:`RateController`
+    (the probe-thread path wants a rate). Lived inline in
+    ``Channel.enable_cc`` before the windowed data path existed — it is
+    controller-adapter logic and belongs beside RateController."""
+
+    def __init__(self, swift: SwiftCC):
+        self._s = swift
+        self.rate = swift.rate_for_rtt(swift.target_delay_us)
+
+    def on_rtt(self, rtt_us: float) -> float:
+        self._s.on_delay(rtt_us)
+        self.rate = self._s.rate_for_rtt(rtt_us)
+        return self.rate
+
+
 class RateController:
     """Wires a CC algorithm onto an Endpoint's pacer.
 

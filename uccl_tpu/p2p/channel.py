@@ -1,14 +1,26 @@
-"""Multipath transfer channel: chunk spraying over parallel connections.
+"""Multipath transfer channel: windowed SACK transport over parallel conns.
 
 The DCN re-expression of UCCL-Tran's core idea — spray chunks of one message
 over many paths and complete out-of-order (reference: 32-way packet spraying,
 collective/rdma/transport_config.h:40 PORT_ENTROPY; chunk size knob
 UCCL_CHUNK_SIZE_KB:42). A :class:`Channel` bundles ``n_paths`` engine
-connections to one peer; large writes split into chunks issued round-robin
-across paths as independent one-sided writes into the same advertised window
-(each chunk at its own offset), completing when every chunk acks. Each
-connection is served by its own engine thread pair on both ends, so paths
-genuinely move bytes in parallel.
+connections to one peer; large writes split into chunks issued as independent
+one-sided writes into the same advertised window (each chunk at its own
+offset). Each connection is served by its own engine thread pair on both
+ends, so paths genuinely move bytes in parallel.
+
+Reliability is a real sender window (:mod:`uccl_tpu.p2p.sack`): per-chunk
+sequence numbers, bounded in-flight bytes, cumulative-ack + SACK state fed
+by per-chunk completion acks, *selective repeat* — fast-retransmit of
+exactly the SACK-gap chunks after K duplicate acks, RTO with exponential
+backoff for the rest — and a per-path quality EWMA steering both
+retransmits and new chunks away from lossy/slow paths (reference:
+__retransmit_for_flow + pcb.h SACK bitmaps, collective/rdma/transport.cc).
+Congestion control plugs into the same loop as a window-bytes protocol
+(:class:`uccl_tpu.p2p.cc.CongestionControl` — Timely/Swift fed by per-chunk
+completion RTTs via :meth:`Channel.enable_window_cc`), and EQDS-style
+receiver-driven credit (:mod:`uccl_tpu.p2p.eqds`) gates chunk issue under
+incast.
 """
 
 from __future__ import annotations
@@ -32,11 +44,49 @@ from uccl_tpu.utils.config import param
 # credit-paced spray (docs/OBSERVABILITY.md).
 _CHAN_CHUNKS = obs.counter(
     "p2p_channel_chunks_total",
-    "chunk transfers issued by the multipath channel spray",
+    "chunk transfers issued by the multipath channel spray (incl. retx)",
 )
 _CHAN_RETX = obs.counter(
     "p2p_channel_retx_total",
-    "channel chunks re-issued after a completion timeout (loss/failover)",
+    "channel chunks retransmitted, split by recovery kind "
+    "(kind=fast: SACK-gap dup-ack fast retransmit; kind=rto: timeout "
+    "with exponential backoff / path death)",
+)
+_CC_PROBE_ERRS = obs.counter(
+    "p2p_cc_probe_errors_total",
+    "background CC delay-probe iterations that raised (reason=exception "
+    "class) — a dead CC loop is visible here instead of silent",
+)
+_CREDIT_STALL = obs.counter(
+    "p2p_credit_stall_seconds_total",
+    "seconds senders spent stalled waiting for receiver pull credit "
+    "(EQDS pull mode) — the incast backpressure face of the credit plane",
+)
+_CREDIT_GRANTED = obs.gauge(
+    "p2p_credit_granted_bytes",
+    "cumulative pull-credit bytes GRANTED to the peer, per channel "
+    "(conn=path-0 conn id of the granting side)",
+)
+_CREDIT_CONSUMED = obs.gauge(
+    "p2p_credit_consumed_bytes",
+    "cumulative pull-credit bytes CONSUMED by issued chunks, per channel "
+    "(conn=path-0 conn id of the sending side)",
+)
+_CHAN_CWND = obs.gauge(
+    "p2p_chan_cwnd_bytes",
+    "sender window in effect at the last windowed transfer "
+    "(CC cwnd when window CC is on, else the static cap; "
+    "last-writer-wins across channels)",
+)
+_CHAN_SRTT = obs.gauge(
+    "p2p_chan_srtt_us",
+    "smoothed per-chunk completion RTT of the last windowed transfer "
+    "(last-writer-wins across channels)",
+)
+_CHAN_RTO = obs.gauge(
+    "p2p_chan_rto_ms",
+    "retransmission timeout of the last windowed transfer "
+    "(last-writer-wins across channels)",
 )
 
 _chunk_kb = param("chunk_size_kb", 1024, help="multipath chunk size in KiB")
@@ -50,12 +100,26 @@ _abandoned_cap = param(
 _chunk_retries = param(
     "chunk_retries",
     2,
-    help="extra attempts for chunks whose completion times out: the chunk "
-    "is re-issued on the next path (rotation = failover). The engine wire "
-    "is reliable TCP, so a timeout means injected loss (set_drop_rate), a "
-    "dead path, or a stalled peer — the channel-level analog of the "
-    "reference's SACK retransmit path (collective/rdma/pcb.h:20, "
-    "__retransmit_for_flow transport.cc:3376)",
+    help="extra transmissions per chunk (max_tx = retries + 1) for the "
+    "windowed SACK sender: a chunk is re-issued by dup-ack fast "
+    "retransmit or RTO, steered to the best-quality path. The engine "
+    "wire is reliable TCP, so losing a chunk means injected loss "
+    "(set_drop_rate), a dead path, or a stalled peer — the channel-level "
+    "analog of the reference's SACK retransmit path "
+    "(collective/rdma/pcb.h:20, __retransmit_for_flow transport.cc:3376)",
+)
+_window_bytes = param(
+    "chan_window_bytes",
+    8 << 20,
+    help="static cap on a windowed transfer's in-flight bytes; window CC "
+    "(Channel.enable_window_cc) tightens it dynamically, never widens it",
+)
+_dupack_k = param(
+    "chan_dupack_k",
+    3,
+    help="duplicate-ack threshold for SACK-gap fast retransmit: K "
+    "later-sequence completions while a chunk is outstanding mark it "
+    "lost (TCP's classic 3, tolerant of mild multipath reordering)",
 )
 _nic_list = param(
     "nic_list",
@@ -117,7 +181,17 @@ class Channel:
         self.chunk_bytes = chunk_bytes or _chunk_kb.get() * 1024
         self.retries = _chunk_retries.get()
         self.retransmitted_chunks = 0  # lifetime count of re-issued chunks
+        self.retx_fast = 0  # lifetime SACK-gap fast retransmits
+        self.retx_rto = 0   # lifetime RTO/path-death retransmits
+        self.window_bytes = _window_bytes.get()
+        self.dupack_k = _dupack_k.get()
+        # window-bytes CC on the data path (cc.CongestionControl); None =
+        # fixed window_bytes cap. Enable via enable_window_cc().
+        self.window_cc = None
+        self._last_win = None  # last transfer's SackTxWindow (stats)
         self._abandoned: List[int] = []  # timed-out xids awaiting terminal
+        self._grant_xids: List[int] = []  # fire-and-forget grant writes
+        self._cc_probe_logged = False  # log-once guard for probe errors
         # application tag carried in the connect handshake (e.g. which peer
         # rank dialed, for multi-channel topologies like a DCN full mesh)
         self.meta = meta
@@ -187,7 +261,9 @@ class Channel:
         full timeout (loss is a congestion signal)."""
         import threading
 
-        from uccl_tpu.p2p.cc import RateController, SwiftCC, TimelyCC
+        from uccl_tpu.p2p.cc import (RateController, SwiftCC,
+                                     SwiftRateAdapter, TimelyCC)
+        from uccl_tpu.utils.logging import get_logger
 
         if self._peer_probe_fifo is None:
             raise RuntimeError(
@@ -198,35 +274,35 @@ class Channel:
         if algo == "timely":
             rc = RateController(self.ep, TimelyCC())
         elif algo == "swift":
-            swift = SwiftCC()
-
-            class _SwiftAdapter:
-                """Feed delays to Swift; expose on_rtt for RateController."""
-
-                def __init__(self, s):
-                    self._s = s
-                    self.rate = s.rate_for_rtt(s.target_delay_us)
-
-                def on_rtt(self, rtt_us):
-                    self._s.on_delay(rtt_us)
-                    self.rate = self._s.rate_for_rtt(rtt_us)
-                    return self.rate
-
-            rc = RateController(self.ep, _SwiftAdapter(swift))
+            rc = RateController(self.ep, SwiftRateAdapter(SwiftCC()))
         else:
             raise ValueError(f"unknown cc algo {algo!r}")
         self.cc = rc
         self._cc_stop = threading.Event()
+        log = get_logger("P2P")
 
         def loop():
             try:
                 while not self._cc_stop.wait(interval_s):
-                    rc.probe(
-                        self.probe_conn, self._peer_probe_fifo,
-                        probe_timeout_ms,
-                    )
-            except Exception:
-                pass  # endpoint/conn closed under us
+                    try:
+                        rc.probe(
+                            self.probe_conn, self._peer_probe_fifo,
+                            probe_timeout_ms,
+                        )
+                    except ValueError:
+                        return  # endpoint closed under us: loop is done
+                    except Exception as e:
+                        # A broken probe path must be VISIBLE, not a
+                        # silently dead CC loop: count every failed
+                        # iteration, log the first one per channel.
+                        _CC_PROBE_ERRS.inc(reason=type(e).__name__)
+                        if not self._cc_probe_logged:
+                            self._cc_probe_logged = True
+                            log.warning(
+                                "channel CC probe failing (%s: %s); "
+                                "counting on p2p_cc_probe_errors_total",
+                                type(e).__name__, e,
+                            )
             finally:
                 # Never exit leaving the pacer stuck at a collapsed rate.
                 try:
@@ -244,6 +320,20 @@ class Channel:
         self._cc_thread.join(timeout=5)
         self._cc_thread = None
         self.ep.set_rate_limit(0)
+
+    # -- window CC on the data path (no probe thread) ----------------------
+    def enable_window_cc(self, algo="swift") -> None:
+        """Congestion-control the windowed sender itself: a window-bytes
+        controller (:class:`uccl_tpu.p2p.cc.CongestionControl`) fed by
+        every chunk's COMPLETION RTT and loss event inside the transfer
+        loop — no side probe thread, no decoupled pacer. ``algo`` is
+        "swift" | "timely" | a CongestionControl instance."""
+        from uccl_tpu.p2p.cc import make_window_cc
+
+        self.window_cc = make_window_cc(algo) if isinstance(algo, str) else algo
+
+    def disable_window_cc(self) -> None:
+        self.window_cc = None
 
     # -- EQDS-style receiver-driven pull mode ------------------------------
     def enable_pull_sender(self) -> None:
@@ -283,13 +373,44 @@ class Channel:
         ``nbytes`` — one 8-byte one-sided write into the peer's credit
         window on the isolated probe path (ordered per conn, so the
         cumulative counter is monotonic on the peer). Returns the new
-        cumulative grant. The EQDS 'pull quantum'."""
+        cumulative grant. The EQDS 'pull quantum'.
+
+        Fire-and-forget: the counter is CUMULATIVE, so a lost grant write
+        (or a fault-injected lost ack) is superseded by the next one —
+        blocking for the completion here would couple the receiver's
+        grant loop to data-plane fault injection. Completion ids are
+        reaped opportunistically, bounded."""
         if self._peer_credit_fifo is None:
             raise RuntimeError("channel has no peer credit window")
         self._granted = getattr(self, "_granted", 0) + int(nbytes)
         arr = np.asarray([self._granted], np.uint64)
-        self.ep.write(self.probe_conn, arr, self._peer_credit_fifo)
+        self._grant_xids.append(
+            self.ep.write_async(self.probe_conn, arr, self._peer_credit_fifo)
+        )
+        if len(self._grant_xids) > 64:
+            self._reap_grants()
+        _CREDIT_GRANTED.set(self._granted, conn=str(self.conns[0]))
         return self._granted
+
+    def _reap_grants(self) -> None:
+        still = []
+        for xid in self._grant_xids:
+            try:
+                r = self.ep.poll_async(xid)
+            except IOError:
+                self.ep.reap(xid)  # consumed error: clear parked state
+                continue
+            if r is None:
+                still.append(xid)
+            else:
+                self.ep.reap(xid)
+        # cap: a grant whose ack was fault-injected away never terminates;
+        # past the cap the OLDEST is force-reaped — same documented
+        # test-only trade as _abandon (only injected loss reaches here,
+        # and by then the 8-byte frame left the tx queue long ago)
+        while len(still) > 256:
+            self.ep.reap(still.pop(0))
+        self._grant_xids = still
 
     @classmethod
     def connect(
@@ -394,31 +515,17 @@ class Channel:
         # scalar slice) rejects view() but reshapes to (1,) for free
         return arr.reshape(-1).view(np.uint8)
 
-    def _await_credit(self, needed: int, timeout_ms: int) -> None:
-        """Block until the peer's cumulative grant covers ``needed`` bytes.
-
-        The receiver one-sided-writes a growing uint64 into our credit
-        window (ordered per conn, so the counter never regresses); polling
-        local memory costs nothing on the wire — the EQDS pull-quanta
-        mechanism with the grant carried by an RDMA-style write instead of a
-        pull packet."""
-        import time as _time
-
-        deadline = _time.monotonic() + timeout_ms / 1e3
-        while int(self._credit_buf[0]) < needed:
-            if _time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"pull credit stalled: need {needed}, have "
-                    f"{int(self._credit_buf[0])}"
-                )
-            _time.sleep(0.0005)
-
-    def _spray(self, arr, fifo, async_op, timeout_ms: int) -> None:
-        """Shared chunk fan-out for one-sided ops: small transfers ride one
-        path; large ones split round-robin across paths. Under pull mode
-        every chunk issue is licensed by receiver credit. Everything issues
-        through the async op so the caller's timeout_ms governs waits."""
-        item = FifoItem.unpack(fifo)
+    def _elem_chunks(self, arr, fifo: bytes, scalar_ok: bool = False):
+        """One transfer element → its windowed chunk descriptors
+        ``(view, packed_fifo, nbytes)`` — the single place element
+        validation and chunk splitting live (shared by write/read and
+        writev so the entry points cannot drift)."""
+        if scalar_ok and isinstance(arr, np.generic):
+            # numpy scalar (e.g. a 1-D array's row slice): value-copy is
+            # fine for a TX source — never for a read destination (the
+            # transfer would land in a discarded temporary; reads keep
+            # the TypeError below)
+            arr = np.asarray(arr).reshape(1)
         if not isinstance(arr, np.ndarray):
             # lists/bytes would be silently copied — fatal on the read path
             # (the transfer would land in a discarded temporary)
@@ -428,102 +535,185 @@ class Channel:
         if arr.ndim == 0:
             arr = arr.reshape(1)  # 0-d → (1,) view: same memory, both paths
         flat = self._flat_view(arr)
-        total = flat.nbytes
+        item = FifoItem.unpack(fifo)
+        return [
+            (flat[off:off + ln], item.slice(off, ln).pack(), ln)
+            for off, ln in self._chunks(flat.nbytes)
+        ]
+
+    def _spray(self, arr, fifo, async_op, timeout_ms: int,
+               scalar_ok: bool = False) -> None:
+        """Windowed chunk fan-out for one-sided ops: the transfer's chunks
+        run through the selective-repeat SACK window (`p2p/sack.py`) over
+        all paths. Under pull mode every NEW chunk issue is licensed by
+        receiver credit; window CC bounds in-flight bytes."""
+        chunks = self._elem_chunks(arr, fifo, scalar_ok=scalar_ok)
         self._prune_abandoned()
-        # Pull-mode credit is charged ONCE per payload byte, at first issue:
-        # the receiver granted an allowance for the message, and a
-        # retransmission replaces a lost frame rather than sending new
-        # payload — re-debiting would wedge exact-credit receivers.
-        if total <= self.chunk_bytes or self.n_paths == 1:
-            if self._pull_mode:
-                self._await_credit(self._pull_sent + total, timeout_ms)
-                self._pull_sent += total
-            # async + wait so the caller's timeout_ms governs each attempt
-            # (the native sync op carries its own fixed internal timeout)
-            for attempt in range(self.retries + 1):
-                _CHAN_CHUNKS.inc()
-                xid = async_op(
-                    self.conns[attempt % self.n_paths], arr, fifo
-                )
-                if self.ep.wait(xid, timeout_ms):
-                    return
-                self._abandon(xid)
-                if attempt < self.retries:
-                    self.retransmitted_chunks += 1
-                    _CHAN_RETX.inc()
-            raise IOError(
-                f"transfer failed: undelivered after {self.retries + 1} "
-                "attempts"
-            )
-        # Chunked path with retransmission: a chunk whose completion times
-        # out is re-issued on the NEXT path (rotation doubles as failover).
-        # Re-writes are idempotent — same bytes into the same window slice.
-        pending = list(enumerate(self._chunks(total)))  # (chunk_idx, (off, ln))
-        for attempt in range(self.retries + 1):
-            xids = []
-            for ci, (off, ln) in pending:
-                if self._pull_mode and attempt == 0:
-                    self._await_credit(self._pull_sent + ln, timeout_ms)
-                    self._pull_sent += ln
-                _CHAN_CHUNKS.inc()
-                xids.append(
-                    async_op(
-                        self.conns[(ci + attempt) % self.n_paths],
-                        flat[off : off + ln],
-                        item.slice(off, ln).pack(),
-                    )
-                )
-            # Progress-based deadline: chunks complete concurrently, so an
-            # attempt times out only after timeout_ms with ZERO completions
-            # — a slow-but-moving transfer keeps extending its budget (no
-            # mass-retransmit of in-flight chunks), while total loss is
-            # detected within ~one timeout. Detection is a non-blocking
-            # poll sweep + one short sleep per pass, so scan cost per pass
-            # is O(1) in wall time regardless of chunk count.
-            pend = list(zip(xids, pending))
-            dead = []  # terminal-error chunks (conn died): retry immediately
-            last_progress = time.monotonic()
-            while pend:
-                # Block on the oldest pending chunk: completion-driven wake,
-                # O(n) waits total in the no-loss case. Only when the oldest
-                # TIMES OUT (loss suspected) does a non-blocking sweep
-                # classify the rest — so sweeps are paced at ≥50 ms apart,
-                # not run per completion.
-                if self.ep.wait(pend[0][0], 50):
-                    last_progress = time.monotonic()
-                    pend.pop(0)
-                    continue
-                nxt = []
-                progressed = False
-                for x, p in pend:
+        self._run_window(chunks, async_op, timeout_ms)
+
+    def _run_window(self, chunks, async_op, timeout_ms: int) -> None:
+        """Drive one windowed transfer: issue chunks within the sender
+        window, feed completions (acks/errors) and their RTTs back into
+        the SACK state machine and the window CC, retransmit exactly what
+        the SACK state marks lost. ``chunks`` is a list of
+        ``(src_or_dst_view, packed_fifo, nbytes)``.
+
+        Pull-mode credit is charged ONCE per payload byte, at first issue:
+        the receiver granted an allowance for the message, and a
+        retransmission replaces a lost frame rather than sending new
+        payload — re-debiting would wedge exact-credit receivers. A
+        credit shortfall pauses NEW chunks only; retransmits (already
+        licensed) keep flowing, so loss recovery is never credit-gated.
+        """
+        from uccl_tpu.p2p.sack import NEW, SackTxWindow
+
+        if not chunks:
+            return
+        win = SackTxWindow(
+            [ln for _, _, ln in chunks],
+            self.n_paths,
+            max_tx=self.retries + 1,
+            dupack_k=self.dupack_k,
+            rto_init_s=min(max(0.05, timeout_ms / 1e3 / 4.0), 1.0),
+            rto_max_s=max(0.2, timeout_ms / 1e3),
+        )
+        self._last_win = win
+        cc = self.window_cc
+        inflight = {}  # xid -> (seq, t_issue, path); attempt-granular
+        last_progress = time.monotonic()
+        credit_stall_t0 = None  # monotonic start of a continuous stall
+
+        def on_complete(xid: int, ok: bool, now: float) -> None:
+            nonlocal last_progress
+            seq, t0, path = inflight.pop(xid)
+            if ok:
+                rtt_us = (now - t0) * 1e6
+                if win.on_ack(seq, path=path, rtt_us=rtt_us, now=now):
+                    if cc is not None:
+                        cc.on_ack(rtt_us, chunks[seq][2])
+                last_progress = now
+            else:
+                # CC hears about this loss when the retransmit issues
+                # (every lost chunk causes exactly one) — not here too.
+                # t_sent lets the window ignore a SUPERSEDED attempt's
+                # late error (a newer attempt owns recovery).
+                win.on_error(seq, path, now, t_sent=t0)
+
+        try:
+            while not win.done():
+                now = time.monotonic()
+                # 1) non-blocking completion sweep (acks arrive out of
+                # order across paths — this IS the SACK feed)
+                for xid in list(inflight):
                     try:
-                        r = self.ep.poll_async(x)
+                        r = self.ep.poll_async(xid)
                     except IOError:
-                        dead.append(p)  # consumed error; no keepalive held
+                        on_complete(xid, False, now)
                         continue
                     if r is None:
-                        nxt.append((x, p))
-                    else:
-                        self.ep.wait(x, 0)  # consume the parked success
-                        progressed = True
-                pend = nxt
-                if progressed:
-                    last_progress = time.monotonic()
-                elif time.monotonic() - last_progress > timeout_ms / 1e3:
+                        continue
+                    self.ep.reap(xid)  # consume the parked success
+                    on_complete(xid, True, now)
+                if win.done():
                     break
-            if not pend and not dead:
-                return
-            for x, _ in pend:
-                self._abandon(x)
-            failed = dead + [p for _, p in pend]
-            if attempt < self.retries:
-                self.retransmitted_chunks += len(failed)
-                _CHAN_RETX.inc(len(failed))
-            pending = failed
-        raise IOError(
-            f"chunked transfer failed: {len(pending)} chunks undelivered "
-            f"after {self.retries + 1} attempts"
+                # 2) issue within the window (retransmits first — sendable
+                # orders them ahead of new chunks)
+                limit = self.window_bytes
+                if cc is not None:
+                    limit = min(limit, cc.cwnd_bytes())
+                for seq, kind in win.sendable(now, limit):
+                    view, fifo_b, ln = chunks[seq]
+                    if self._pull_mode and kind == NEW:
+                        need = self._pull_sent + ln
+                        if int(self._credit_buf[0]) < need:
+                            # new chunks pause for credit; sendable lists
+                            # retransmits first, so nothing lost waits
+                            if credit_stall_t0 is None:
+                                credit_stall_t0 = now
+                            break
+                        if credit_stall_t0 is not None:
+                            _CREDIT_STALL.inc(now - credit_stall_t0)
+                            credit_stall_t0 = None
+                        self._pull_sent += ln
+                        _CREDIT_CONSUMED.set(
+                            self._pull_sent, conn=str(self.conns[0])
+                        )
+                    path = win.pick_path(seq, kind)
+                    _CHAN_CHUNKS.inc()
+                    if kind != NEW:
+                        self.retransmitted_chunks += 1
+                        _CHAN_RETX.inc(kind=kind)
+                        if kind == "fast":
+                            self.retx_fast += 1
+                        else:
+                            self.retx_rto += 1
+                        if cc is not None:
+                            cc.on_loss()
+                    t_issue = time.monotonic()
+                    xid = async_op(self.conns[path], view, fifo_b)
+                    win.mark_sent(seq, path, kind, t_issue)
+                    inflight[xid] = (seq, t_issue, path)
+                # 3) failure checks
+                now = time.monotonic()
+                dead = win.exhausted(now)
+                if dead:
+                    raise IOError(
+                        f"transfer failed: {len(dead)} chunks undelivered "
+                        f"after {win.max_tx} attempts"
+                    )
+                if (credit_stall_t0 is not None
+                        and now - credit_stall_t0 > timeout_ms / 1e3):
+                    _CREDIT_STALL.inc(now - credit_stall_t0)
+                    credit_stall_t0 = None
+                    raise TimeoutError(
+                        f"pull credit stalled: need "
+                        f"{self._pull_sent + chunks[win._next_new][2]}, "
+                        f"have {int(self._credit_buf[0])}"
+                    )
+                if now - last_progress > timeout_ms / 1e3:
+                    raise IOError(
+                        f"transfer stalled: no chunk completion in "
+                        f"{timeout_ms} ms ({len(inflight)} in flight)"
+                    )
+                # 4) completion-driven wake: block briefly on the OLDEST
+                # in-flight attempt instead of spinning the sweep
+                if inflight:
+                    oldest = next(iter(inflight))
+                    if self.ep.wait(oldest, 2):
+                        on_complete(oldest, True, time.monotonic())
+                else:
+                    time.sleep(0.0002)
+        finally:
+            if credit_stall_t0 is not None:
+                _CREDIT_STALL.inc(time.monotonic() - credit_stall_t0)
+            # stale attempts (superseded by a delivered retransmit, or a
+            # failed transfer's in-flight chunks) keep their keepalive
+            # until a terminal state is observed
+            for xid in inflight:
+                self._abandon(xid)
+            _CHAN_CWND.set(
+                cc.cwnd_bytes() if cc is not None else self.window_bytes
+            )
+            _CHAN_SRTT.set(win.srtt_us)
+            _CHAN_RTO.set(win.rto_s * 1e3)
+
+    def transport_stats(self) -> dict:
+        """Snapshot of the windowed transport's state: last transfer's
+        SACK/RTT/path-quality stats plus lifetime retransmit splits — the
+        numbers the incast bench reports per arm."""
+        st = dict(self._last_win.stats()) if self._last_win is not None else {}
+        st.update(
+            retx_fast_total=self.retx_fast,
+            retx_rto_total=self.retx_rto,
+            retransmitted_chunks=self.retransmitted_chunks,
+            cwnd_bytes=(self.window_cc.cwnd_bytes()
+                        if self.window_cc is not None else self.window_bytes),
+            pull_mode=self._pull_mode,
+            pull_sent=self._pull_sent,
+            pull_credit=(int(self._credit_buf[0])
+                         if self._credit_buf is not None else 0),
         )
+        return st
 
     def _abandon(self, xid: int) -> None:
         """Stop waiting on a timed-out transfer WITHOUT freeing its
@@ -599,11 +789,20 @@ class Channel:
 
     def write(self, src: np.ndarray, fifo: bytes, timeout_ms: int = 60000) -> None:
         """Spray `src` into the peer's advertised window across all paths."""
-        if isinstance(src, np.generic):
-            # numpy scalar (e.g. a 1-D array's row slice): value-copy is
-            # fine for a TX source — never for a read destination
-            src = np.asarray(src).reshape(1)
-        self._spray(src, fifo, self.ep.write_async, timeout_ms)
+        self._spray(src, fifo, self.ep.write_async, timeout_ms,
+                    scalar_ok=True)
+
+    def writev(self, srcs, fifos, timeout_ms: int = 60000) -> None:
+        """Vectorized windowed write: every (src, fifo) element becomes
+        one or more chunks of ONE windowed transfer, so selective repeat,
+        path steering, CC and pull credit act across the whole batch (the
+        disagg KV slab path — reference: writev over descriptor lists,
+        engine.h:311). Returns once every element is delivered."""
+        chunks = []
+        for src, fifo in zip(srcs, fifos):
+            chunks.extend(self._elem_chunks(src, fifo, scalar_ok=True))
+        self._prune_abandoned()
+        self._run_window(chunks, self.ep.write_async, timeout_ms)
 
     def write_compressed(
         self, src: np.ndarray, fifo: bytes, timeout_ms: int = 60000,

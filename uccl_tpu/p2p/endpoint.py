@@ -216,6 +216,14 @@ def _load():
                 ctypes.c_size_t,
             ]
         lib.ucclt_set_drop_rate.argtypes = [c, ctypes.c_double]
+        if hasattr(lib, "ucclt_set_reorder_rate"):
+            lib.ucclt_set_reorder_rate.argtypes = [c, ctypes.c_double]
+            lib.ucclt_set_delay_jitter_us.argtypes = [c, ctypes.c_int64]
+            lib.ucclt_set_conn_fault.restype = ctypes.c_int
+            lib.ucclt_set_conn_fault.argtypes = [
+                c, ctypes.c_uint64, ctypes.c_double, ctypes.c_double,
+                ctypes.c_int64,
+            ]
         lib.ucclt_set_rate_limit.argtypes = [c, ctypes.c_uint64]
         if hasattr(lib, "ucclt_conn_stats"):
             lib.ucclt_conn_stats.restype = ctypes.c_int
@@ -588,7 +596,40 @@ class Endpoint:
 
     # -- observability / fault injection ---------------------------------
     def set_drop_rate(self, p: float) -> None:
+        """Drop each one-sided DATA-plane frame (kWrite/kRead/kReadResp/
+        kWriteAck) with probability ``p``. Two-sided send/notif and the
+        handshake ride untouched — injection models a lossy data fabric
+        under a reliable control plane (UDP wire mode injects at the
+        packet level instead, recovered by its SACK layer)."""
         self._lib.ucclt_set_drop_rate(self._handle(), p)
+
+    def set_reorder_rate(self, p: float) -> None:
+        """Hold each data frame back with probability ``p`` so the next
+        frame on its conn overtakes it (released after ≤2 ms regardless):
+        chunks land — and their completions arrive — out of order."""
+        fn = getattr(self._lib, "ucclt_set_reorder_rate", None)
+        if fn is None:
+            raise RuntimeError("loaded libuccl_tpu.so predates fault ABI")
+        fn(self._handle(), p)
+
+    def set_delay_jitter_us(self, max_us: int) -> None:
+        """Stamp each data frame with a uniform [0, max_us] not-before
+        delay (head-of-line per conn — an artificially slow path)."""
+        fn = getattr(self._lib, "ucclt_set_delay_jitter_us", None)
+        if fn is None:
+            raise RuntimeError("loaded libuccl_tpu.so predates fault ABI")
+        fn(self._handle(), max_us)
+
+    def set_conn_fault(self, conn_id: int, *, drop: float = -1.0,
+                       reorder: float = -1.0, jitter_us: int = -1) -> None:
+        """Per-conn fault overrides (−1 inherits the endpoint-global
+        knobs) — make SOME multipath channel paths lossy/slow while the
+        control path stays clean (the path-quality steering testbed)."""
+        fn = getattr(self._lib, "ucclt_set_conn_fault", None)
+        if fn is None:
+            raise RuntimeError("loaded libuccl_tpu.so predates fault ABI")
+        if fn(self._handle(), conn_id, drop, reorder, jitter_us) != 0:
+            raise KeyError(f"unknown conn {conn_id}")
 
     def set_rate_limit(self, bytes_per_sec: int) -> None:
         """Token-bucket pacing on the tx proxies; 0 disables (reference:

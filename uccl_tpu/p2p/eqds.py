@@ -3,19 +3,23 @@
 The reference ships EQDS (include/cc/eqds.h; pacer thread
 collective/rdma/eqds.h:93) — NSDI'22 receiver-driven credit, built for
 incast: many senders converging on one receiver link, where sender-side
-delay CC reacts a full RTT late. docs/EQDS.md records why kernel-TCP rwnd
-covers most of that role on this framework's DCN wire; this module is the
-revisit path it specifies, for fabrics without kernel flow control (future
-zero-copy wires) or measured incast collapse.
+delay CC reacts a full RTT late. This is the credit half of the windowed
+SACK transport (channel.py + sack.py): docs/EQDS.md records the measured
+incast sweep where sender-side window CC collapses under loss while this
+pacer holds goodput at the receiver's drain rate, and the disagg decode
+worker runs it as the fan-in actuator (serving/disagg.py
+``DecodeWorker(pull_rate_bps=...)``).
 
 Mechanism (Channel-layer, wire-agnostic):
 
 * every Channel minted a 1×uint64 **credit window** at setup (symmetric,
   like the CC probe window);
-* a sender in pull mode (``chan.enable_pull_sender()``) issues a chunk only
-  once the receiver's CUMULATIVE grant covers it (``Channel._await_credit``)
-  — the pull quantum, carried by an 8-byte one-sided write instead of a
-  pull packet;
+* a sender in pull mode (``chan.enable_pull_sender()``) issues a NEW chunk
+  only once the receiver's CUMULATIVE grant covers it (the non-blocking
+  credit gate inside ``Channel._run_window`` — retransmits are
+  pre-licensed, and stalled wall time lands on
+  ``p2p_credit_stall_seconds_total``) — the pull quantum, carried by an
+  8-byte one-sided write instead of a pull packet;
 * the receiver runs ONE :class:`PullPacer` for all inbound channels: a
   token bucket at the receiver's known link rate, split round-robin across
   active channels — the same fair pull schedule the reference's pacer

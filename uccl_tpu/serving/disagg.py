@@ -283,9 +283,16 @@ class PrefillWorker:
                      + [fifos_v.slice(off, ln).pack() for off, ln in spans])
             tr = obs.get_tracer()
             t0 = tr.now_us() if tr is not None else 0.0
-            st.xids.extend(
-                self.ep.writev_async(self.conn, srcs, fifos)
-            )
+            if self.chan is not None:
+                # windowed SACK transport: the whole slab batch is ONE
+                # selective-repeat transfer (loss recovered inside, pull
+                # credit gates issue) — delivered when this returns, so
+                # FINAL needs no per-xid waits for these slabs
+                self.chan.writev(srcs, fifos, timeout_ms=self._timeout_ms)
+            else:
+                st.xids.extend(
+                    self.ep.writev_async(self.conn, srcs, fifos)
+                )
             if tr is not None:
                 dur = tr.now_us() - t0
                 tr.complete("kv_stream.tx", t0, dur, "wire", rid=st.rid,
@@ -435,7 +442,8 @@ class DecodeWorker:
     engine's device cache and ``adopt()``s the request.
     """
 
-    def __init__(self, engine: ServingEngine, ep):
+    def __init__(self, engine: ServingEngine, ep,
+                 pull_rate_bps: Optional[float] = None):
         self.engine = engine
         self.ep = ep
         self.fmt = wire_format_for(engine.backend)
@@ -443,6 +451,20 @@ class DecodeWorker:
         self.mirror_v = np.zeros(self.fmt.pool_shape(), KV_DTYPE)
         self._mr_k = ep.reg(self.mirror_k)
         self._mr_v = ep.reg(self.mirror_v)
+        # EQDS receiver-driven credit at disagg fan-in (docs/EQDS.md): the
+        # GRANT already bounds concurrent inbound streams (slot admission
+        # — "half of EQDS"); pull_rate_bps adds the other half, a
+        # PullPacer granting byte credit across ALL attached inbound
+        # channels at this decode worker's known drain rate, so N prefill
+        # workers cannot burst past the fan-in link. Only active for
+        # prefill workers attached over the channel transport with
+        # pull=True (add_local_prefill).
+        self.channels: List[object] = []
+        self._pacer = None
+        if pull_rate_bps:
+            from uccl_tpu.p2p.eqds import PullPacer
+
+            self._pacer = PullPacer(pull_rate_bps)
         self._pending: Deque[Tuple[int, Dict]] = deque()
         self._granted: Dict[Tuple[int, int], Dict] = {}  # (conn, rid) -> st
         self._finished: List[Request] = []
@@ -466,6 +488,29 @@ class DecodeWorker:
     def attach(self, timeout_ms: int = 30000) -> int:
         """Accept one prefill worker and hand it the pool descriptors."""
         conn = self.ep.accept(timeout_ms=timeout_ms)
+        return self._finish_attach(conn)
+
+    def attach_channel(self, timeout_ms: int = 30000,
+                       chunk_bytes: Optional[int] = None):
+        """Accept one prefill worker dialing over a multipath
+        :class:`~uccl_tpu.p2p.channel.Channel` (the windowed SACK
+        transport): KV slabs arrive as windowed chunk sprays instead of
+        raw writev, control notifs ride the channel's path-0 conn, and —
+        when this worker was built with ``pull_rate_bps`` — the channel
+        attaches to the receiver-driven credit pacer, making the decode
+        side the incast actuator. Returns the server-side Channel."""
+        from uccl_tpu.p2p.channel import Channel
+
+        chan = Channel.accept(self.ep, timeout_ms=timeout_ms,
+                              chunk_bytes=chunk_bytes)
+        self.channels.append(chan)
+        if self._pacer is not None:
+            self._pacer.attach(chan)
+            self._pacer.start()
+        self._finish_attach(chan.conns[0])
+        return chan
+
+    def _finish_attach(self, conn: int) -> int:
         self._n_conns += 1
         # a conn attaching AFTER earlier conns all said BYE re-opens the
         # decoder (sequential fan-in must not inherit a stale closed flag)
@@ -476,6 +521,21 @@ class DecodeWorker:
             "v_fifo": _b64(self.ep.advertise(self._mr_v)),
         }).encode())
         return conn
+
+    def close(self) -> None:
+        """Stop the credit pacer (with a final flush so in-flight senders
+        finish) and close attached channels (their conns + probe/credit
+        registrations on this worker's endpoint). The endpoint itself
+        stays open — it was handed in by the caller, who owns it."""
+        if self._pacer is not None:
+            self._pacer.stop(flush_bytes=self.fmt.pool_nbytes())
+            self._pacer = None
+        for chan in self.channels:
+            try:
+                chan.close()
+            except Exception:
+                pass  # peer already gone
+        self.channels = []
 
     # -- control-plane handling ----------------------------------------
     def poll(self) -> None:
@@ -645,38 +705,95 @@ def decode_continue(params, cfg, cache, first_tok, new_tokens: int):
 
 def make_local_pair(prefill_engine: ServingEngine,
                     decode_engine: ServingEngine,
-                    ) -> Tuple[PrefillWorker, DecodeWorker]:
+                    *,
+                    transport: str = "ep",
+                    pull_rate_bps: Optional[float] = None,
+                    **transport_kw) -> Tuple[PrefillWorker, DecodeWorker]:
     """Both roles in ONE process over loopback endpoints — the in-process
     harness tests and benches drive (the example runs the same classes in
-    two real processes)."""
+    two real processes). ``transport``/``pull_rate_bps``/extras route the
+    KV plane over the windowed Channel transport (add_local_prefill)."""
     from uccl_tpu.p2p import Endpoint
 
-    dw = DecodeWorker(decode_engine, Endpoint())
-    return add_local_prefill(dw, prefill_engine), dw
+    dw = DecodeWorker(decode_engine, Endpoint(), pull_rate_bps=pull_rate_bps)
+    return add_local_prefill(dw, prefill_engine, transport=transport,
+                             **transport_kw), dw
 
 
 def add_local_prefill(dw: DecodeWorker,
-                      prefill_engine: ServingEngine) -> PrefillWorker:
+                      prefill_engine: ServingEngine,
+                      *,
+                      transport: str = "ep",
+                      n_paths: int = 2,
+                      chunk_bytes: Optional[int] = None,
+                      pull: bool = False,
+                      window_cc: Optional[str] = None) -> PrefillWorker:
     """Attach one more in-process prefill worker to ``dw`` — the loopback
     fan-in arrangement (N prefill engines streaming into one decode pool;
     each stream is its own conn, so GRANT/FINAL bookkeeping stays
-    per-(conn, rid) and workers never see each other's slots)."""
+    per-(conn, rid) and workers never see each other's slots).
+
+    ``transport="channel"`` dials a multipath
+    :class:`~uccl_tpu.p2p.channel.Channel` instead of a bare conn: KV
+    slabs ride the windowed SACK transport (selective repeat, per-path
+    quality steering, loss/reorder-proof), ``pull=True`` gates slab issue
+    on the decode worker's receiver-driven credit (requires ``dw`` built
+    with ``pull_rate_bps``), and ``window_cc`` ("timely"|"swift") runs
+    sender-side window CC off per-chunk completion RTTs."""
     from uccl_tpu.p2p import Endpoint
 
     ep_p = Endpoint()
-    # loopback: connect() completes against the listening endpoint before
-    # accept() is called (the test_p2p pair idiom)
     pw = PrefillWorker.__new__(PrefillWorker)
-    conn_p = ep_p.connect("127.0.0.1", dw.ep.port)
-    dw.attach()
-    _init_prefill_worker(pw, prefill_engine, ep_p, conn_p)
+    if transport == "channel":
+        import threading
+
+        from uccl_tpu.p2p.channel import Channel
+
+        res: Dict[str, object] = {}
+
+        def _accept():
+            try:
+                res["chan"] = dw.attach_channel(chunk_bytes=chunk_bytes)
+            except Exception as e:  # surfaced below, not swallowed
+                res["err"] = e
+
+        t = threading.Thread(target=_accept)
+        t.start()
+        chan = Channel.connect(ep_p, "127.0.0.1", dw.ep.port,
+                               n_paths=n_paths, chunk_bytes=chunk_bytes)
+        t.join(timeout=30)
+        if "err" in res:
+            raise res["err"]  # the real accept-side failure, with traceback
+        if "chan" not in res:
+            raise TimeoutError("decode side never accepted the channel")
+        if pull:
+            if dw._pacer is None:
+                raise ValueError(
+                    "pull=True needs a DecodeWorker(pull_rate_bps=...)"
+                )
+            chan.enable_pull_sender()
+        if window_cc:
+            chan.enable_window_cc(window_cc)
+        _init_prefill_worker(pw, prefill_engine, ep_p, chan.conns[0],
+                             chan=chan)
+    elif transport == "ep":
+        # loopback: connect() completes against the listening endpoint
+        # before accept() is called (the test_p2p pair idiom)
+        conn_p = ep_p.connect("127.0.0.1", dw.ep.port)
+        dw.attach()
+        _init_prefill_worker(pw, prefill_engine, ep_p, conn_p)
+    else:
+        raise ValueError(f"unknown transport {transport!r}")
     return pw
 
 
 def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,
-                         conn: int, timeout_ms: int = 30000) -> None:
+                         conn: int, timeout_ms: int = 30000,
+                         chan=None) -> None:
     """PrefillWorker init against an already-open conn (the local-pair
-    path, where connect must precede the peer's accept)."""
+    path, where connect must precede the peer's accept). ``chan`` routes
+    KV slabs over the windowed multipath Channel transport (conn must be
+    its path-0 conn — the notif/control path)."""
     if engine.prefill_chunk is None:
         raise ValueError("PrefillWorker needs a chunked engine")
     if engine.chunk_sink is not None:
@@ -688,6 +805,7 @@ def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,
     pw.engine = engine
     pw.ep = ep
     pw.conn = conn
+    pw.chan = chan
     pw.fmt = KVWireFormat.from_meta(hello["fmt"])
     dims = _model_dims(engine.backend)
     dims["max_seq"] = engine.backend.max_seq

@@ -58,18 +58,20 @@ def _plan_snapshot():
     return {tuple(sorted(lb.items())): v for lb, v in fam.samples()}
 
 
-def _planned_label(before):
+def _planned_label(before, verb=None):
     """The plan decision an arm ACTUALLY emitted (counter delta around its
     compile) — the real label, never the CLI arg mirrored back. A
     ``fallback`` delta (the planned kernel degraded to its lax mirror at
     trace time) wins over the decision delta: the arm's timings are the
     mirror's, and plan_calibrate must be able to exclude them. Otherwise
-    the largest allreduce-plan delta; None if nothing moved."""
+    the largest plan delta; None if nothing moved. ``verb`` restricts to
+    one verb's series (broadcast/all_gather carry a verb= label; the
+    allreduce series has none — verb=None)."""
     deltas = []
     for k, v in _plan_snapshot().items():
         d = v - before.get(k, 0)
         lb = dict(k)
-        if d > 0 and lb.get("algo") != "ep_a2a":
+        if d > 0 and lb.get("algo") != "ep_a2a" and lb.get("verb") == verb:
             deltas.append((d, lb))
     if not deltas:
         return None
@@ -84,9 +86,10 @@ def _modeled_us(label):
     planner set at decision time (shared arithmetic, not mirrored)."""
     from uccl_tpu.obs import counters as obsc
 
+    extra = {"verb": label["verb"]} if label.get("verb") else {}
     return obsc.gauge("collective_plan_predicted_us").get(
         algo=label["algo"], chunks=label["chunks"],
-        wire_dtype=label["wire_dtype"],
+        wire_dtype=label["wire_dtype"], **extra,
     )
 
 
@@ -163,6 +166,101 @@ def quant_sweep(jax, n, wire_dtypes, args):
         size *= 4
 
 
+def _bcast_bytes_snapshot():
+    from uccl_tpu.obs import counters as obsc
+
+    fam = obsc.counter("ep_bytes_total")
+    return {tuple(sorted(lb.items())): v for lb, v in fam.samples()
+            if lb.get("verb") == "bcast"}
+
+
+def verb_sweep(jax, n, verb, args):
+    """The --bench bcast|ag arms: per size one ``collective_plan`` JSON
+    line whose arms are labeled off the REAL
+    ``collective_plan_total{verb=...}`` counter delta (the new verbs'
+    decisions — docs/PLAN_BENCH.md round-9) with the gauge-read
+    modeled_us beside the measured time; broadcast arms additionally
+    carry the counter-audited per-member wire bytes (``ep_bytes_total
+    {verb="bcast"}`` delta) so the psum-baseline reduction is a recorded
+    counter fact. ``--check`` asserts every arm bit-exact against the
+    root row / input (broadcast and all-gather are pure data movement at
+    full precision)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from uccl_tpu import obs
+    from uccl_tpu.collective import Communicator
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    comm = Communicator(mesh, "dp")
+    algos = (["psum", "xla", "tree", "scatter_ag", "auto"]
+             if verb == "bcast" else ["xla", "ring", "bidir", "auto"])
+    plan_verb = "broadcast" if verb == "bcast" else "all_gather"
+    root = 1 % n
+    failed = 0
+
+    size = args.min_bytes
+    while size <= args.max_bytes:
+        elems = size // 4
+        x = np.random.default_rng(0).standard_normal(
+            (n, elems)).astype(np.float32)
+        gx = comm.device_put(x)
+        ref = np.tile(x[root], (n, 1)) if verb == "bcast" else x
+        arms = []
+        for algo in algos:
+            before = _plan_snapshot()
+            bbytes = _bcast_bytes_snapshot() if verb == "bcast" else {}
+            if verb == "bcast":
+                out = comm.broadcast(gx, root, algo=algo)
+            else:
+                out = comm.all_gather(gx, algo=algo)
+            got = np.asarray(out)  # compile + host sync
+            label = _planned_label(before, plan_verb) or {
+                "algo": algo, "chunks": "1", "wire_dtype": "none",
+                "verb": plan_verb}
+            wire_delta = None
+            if verb == "bcast":
+                wire_delta = sum(
+                    int(v - bbytes.get(k, 0))
+                    for k, v in _bcast_bytes_snapshot().items()
+                    if v - bbytes.get(k, 0) > 0
+                ) or None
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                if verb == "bcast":
+                    out = comm.broadcast(gx, root, algo=algo)
+                else:
+                    out = comm.all_gather(gx, algo=algo)
+            np.asarray(out)
+            dt = (time.perf_counter() - t0) / args.iters
+            ok = bool(np.array_equal(got, ref))
+            if args.check and not ok:
+                print(f"all_reduce_perf: CHECK FAILED {verb}/{algo} @ "
+                      f"{size}B (planned {label['algo']})", flush=True)
+                failed = 1
+            arms.append({
+                "requested": algo,
+                "algo": label["algo"],
+                "chunks": int(label["chunks"]),
+                "outcome": label.get("outcome", "explicit"),
+                "time_us": round(dt * 1e6, 1),
+                "modeled_us": round(_modeled_us(label), 2),
+                "wire_bytes_per_member": wire_delta,
+                "oracle_ok": ok,
+            })
+        print(json.dumps({
+            "bench": "collective_plan",
+            "verb": plan_verb,
+            "schema_version": obs.SCHEMA_VERSION,
+            "bytes": size, "world": n, "root": root, "n_axes": 1,
+            "mesh2d": None,
+            "substrate": jax.default_backend(),
+            "arms": arms,
+        }), flush=True)
+        size *= 4
+    return failed
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=0,
@@ -190,6 +288,12 @@ def main():
                          "labeled off the real collective_plan_total delta "
                          "with modeled_us beside measured (the record "
                          "plan_calibrate.py refits from)")
+    ap.add_argument("--bench", default="ar",
+                    help="comma list of verbs to sweep: ar (the allreduce "
+                         "sweep, default) and/or bcast,ag — the broadcast/"
+                         "all-gather arms emit collective_plan JSON lines "
+                         "labeled off the verb-labeled plan counter "
+                         "(plan_calibrate.py fits the new verbs from them)")
     ap.add_argument("--check", action="store_true",
                     help="oracle mode: every arm must match the numpy sum oracle "
                          "(exit nonzero on mismatch) — the planner smoke")
@@ -208,6 +312,24 @@ def main():
     obs.setup_from_args(args)
 
     n = len(jax.devices())
+    benches = [b for b in args.bench.split(",") if b]
+    for b in benches:
+        if b not in ("ar", "bcast", "ag"):
+            ap.error(f"unknown --bench verb {b!r} (want ar/bcast/ag)")
+    if benches != ["ar"]:
+        if args.mesh2d or args.wire_dtype:
+            ap.error("--bench bcast/ag rides the single-axis sweep; drop "
+                     "--mesh2d/--wire-dtype")
+        failed = 0
+        for b in benches:
+            if b == "ar":
+                ap.error("--bench ar composes with bcast/ag only when "
+                         "listed alone (the ar sweep has its own flags)")
+            failed |= verb_sweep(jax, n, b, args)
+        obs.dump_from_args(args)
+        if failed:
+            raise SystemExit(failed)
+        return
     if args.wire_dtype:
         # quant_sweep builds its own raw single-axis mesh (the legacy
         # discharge interpreter can't address peers on the canonical

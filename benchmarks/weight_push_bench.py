@@ -1,0 +1,242 @@
+"""Time-to-consistent-fleet sweep for the versioned weight-push service.
+
+The fleet question (ISSUE 14): N replicas need the same published weight
+version — how long until EVERY peer holds a verified, bit-exact copy?
+Two push shapes per (N, wire) point, in-process peers (each subscriber
+owns its own Endpoint; the native engine threads move the bytes):
+
+* ``naive``  — N point-to-point copies out of the root, one per peer
+  (the root's egress serialized: the spin-up shape this service
+  replaces). Time-to-consistent-fleet grows ~linearly in N.
+* ``relay``  — ONE pipelined chain root → s1 → ... → sN: every node
+  fetches from its upstream and forwards each verified slab group
+  downstream while later groups are still in flight
+  (``weight_push.fetch(forward_to=...)``). The root ships each chunk
+  once — counter-audited as ``weight_push_bytes_total{role="tx",
+  src="publisher"}`` staying ONE snapshot — and fleet time approaches
+  one snapshot time plus (N-1) group times: sublinear in N.
+
+Every arm verifies every peer's tree bit-exact against the published
+version (CRC-gated on the wire, then an explicit array_equal here) and
+is labeled from REAL counter deltas, never assumed arithmetic. One JSON
+line per arm; ``--json-out`` records them (docs/weight_push_r01.json),
+``--metrics-out`` dumps the Prometheus snapshot for
+``scripts/check_obs.py --weights``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+from _bootstrap import init_devices  # noqa: F401  (repo path side effect)
+
+from uccl_tpu import obs
+from uccl_tpu.p2p import Channel, Endpoint, WeightPublisher
+from uccl_tpu.p2p import weight_push as wp
+
+
+def chan_pair(server_ep, client_ep, n_paths=2):
+    """(server-side, client-side) channel between two in-process
+    endpoints."""
+    res = {}
+    t = threading.Thread(
+        target=lambda: res.setdefault("c", Channel.accept(server_ep)))
+    t.start()
+    c = Channel.connect(client_ep, "127.0.0.1", server_ep.port,
+                        n_paths=n_paths)
+    t.join(timeout=20)
+    if "c" not in res:
+        raise TimeoutError("channel accept timed out")
+    return res["c"], c
+
+
+def _push_snapshot():
+    fam = obs.counter("weight_push_bytes_total")
+    return {tuple(sorted(lb.items())): v for lb, v in fam.samples()}
+
+
+def _delta(before, **labels):
+    want = set(labels.items())
+    out = 0.0
+    for k, v in _push_snapshot().items():
+        if want <= set(k):
+            out += v - before.get(k, 0)
+    return out
+
+
+def run_arm(n: int, mode: str, wire, tree, canon, group_kb: int,
+            timeout_ms: int, nic_bps: int = 0) -> dict:
+    pub = WeightPublisher(group_bytes=group_kb << 10)
+    version = pub.publish("fleet", tree, wire=wire)
+    snap = pub.get("fleet", version)
+    eps = [Endpoint(n_engines=2) for _ in range(n + 1)]  # [root, s1..sN]
+    if nic_bps:
+        # model per-NIC egress (the resource the relay actually relieves):
+        # every endpoint's tx rides its own token-bucket pacer, so the
+        # naive root serializes N copies through ONE pacer while the
+        # relay's hops ride N distinct ones concurrently — the loopback
+        # stand-in for a NIC-bound fleet (in-process un-paced endpoints
+        # share one host's memory bandwidth, which hides the difference)
+        for ep in eps:
+            ep.set_rate_limit(nic_bps)
+    peers_before = obs.counter("weight_push_peers_total").get(name="fleet")
+    bytes_before = _push_snapshot()
+    snaps = [None] * n
+    errs = []
+    try:
+        if mode == "relay":
+            # chain root -> s1 -> ... -> sN; node i forwards to i+1
+            ups, downs = [], []
+            for i in range(n):
+                up_srv, up_cli = chan_pair(eps[i], eps[i + 1])
+                ups.append(up_cli)
+                downs.append(up_srv)  # node i's downstream-serving side
+            # downs[i] is served BY node i-1 (or the root for i=0): node
+            # i fetches on ups[i] and forwards on downs[i+1]
+            def node(i):
+                try:
+                    fwd = [downs[i + 1]] if i + 1 < n else []
+                    snaps[i] = wp.fetch(ups[i], "fleet", forward_to=fwd,
+                                        timeout_ms=timeout_ms)
+                except BaseException as e:
+                    errs.append(e)
+
+            ts = [threading.Thread(target=node, args=(i,))
+                  for i in range(n)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            pub.serve(downs[0], timeout_ms=timeout_ms)
+            for t in ts:
+                t.join(timeout=timeout_ms / 1e3)
+            t_fleet = time.perf_counter() - t0
+        else:  # naive: N sequential point-to-point copies out of the root
+            pairs = [chan_pair(eps[0], eps[i + 1]) for i in range(n)]
+            t0 = time.perf_counter()
+            for i, (srv, cli) in enumerate(pairs):
+
+                def one(i=i, cli=cli):
+                    try:
+                        snaps[i] = wp.fetch(cli, "fleet",
+                                            timeout_ms=timeout_ms)
+                    except BaseException as e:
+                        errs.append(e)
+
+                t = threading.Thread(target=one)
+                t.start()
+                pub.serve(srv, timeout_ms=timeout_ms)
+                t.join(timeout=timeout_ms / 1e3)
+            t_fleet = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        bitexact = all(
+            s is not None and all(
+                np.array_equal(s.flat()[k], canon[k]) for k in canon)
+            for s in snaps
+        )
+        root_tx = _delta(bytes_before, role="tx", src="publisher")
+        fleet_tx = _delta(bytes_before, role="tx")
+        peers = obs.counter("weight_push_peers_total").get(
+            name="fleet") - peers_before
+        return {
+            "bench": "weight_push",
+            "schema_version": obs.SCHEMA_VERSION,
+            "n_peers": n, "mode": mode, "wire_dtype": wire or "none",
+            "snapshot_bytes": snap.total_bytes,
+            "groups": len(snap.manifest["groups"]),
+            "nic_mbps": nic_bps / 1e6 if nic_bps else None,
+            "t_fleet_s": round(t_fleet, 4),
+            "fleet_mb_s": round(
+                n * snap.total_bytes / t_fleet / 1e6, 2),
+            "root_tx_bytes": int(root_tx),
+            "fleet_tx_bytes": int(fleet_tx),
+            "peers_consistent": int(peers),
+            "bitexact": bool(bitexact),
+        }
+    finally:
+        for ep in eps:
+            ep.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fleet", default="2,4,8",
+                    help="comma list of peer counts N to sweep")
+    ap.add_argument("--mb", type=float, default=8.0,
+                    help="approximate snapshot megabytes")
+    ap.add_argument("--wire", default="none",
+                    help="comma list of wire codecs: none,fp8,lossless")
+    ap.add_argument("--modes", default="relay,naive")
+    ap.add_argument("--group-kb", type=int, default=512,
+                    help="slab-group (pipeline tick) size in KiB")
+    ap.add_argument("--nic-mbps", type=float, default=100.0,
+                    help="per-endpoint egress pacing in MB/s (0 = off): "
+                    "the NIC-bound fleet model — without it the "
+                    "in-process peers share one host's memory bandwidth "
+                    "and both modes converge on it")
+    ap.add_argument("--timeout-ms", type=int, default=120000)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI arm: N=3, ~2 MB, relay+naive, exits nonzero "
+                    "unless every peer lands bit-exact and the relay's "
+                    "root egress stayed one snapshot")
+    ap.add_argument("--json-out", default="")
+    obs.add_cli_args(ap)
+    args = ap.parse_args()
+    obs.setup_from_args(args)
+
+    if args.smoke:
+        fleets, modes, wires, mb = [3], ["relay", "naive"], [None], 2.0
+    else:
+        fleets = [int(v) for v in args.fleet.split(",") if v]
+        modes = [m for m in args.modes.split(",") if m]
+        wires = [None if w in ("none", "") else w
+                 for w in args.wire.split(",")]
+        mb = args.mb
+
+    # a dense-model-shaped tree: a few big matrices + small vectors
+    rng = np.random.default_rng(0)
+    dim = max(64, int((mb * 1e6 / 6 / 4) ** 0.5))
+    tree = {}
+    for i in range(6):
+        tree[f"layer{i}.w"] = rng.standard_normal(
+            (dim, dim)).astype(np.float32)
+        tree[f"layer{i}.b"] = rng.standard_normal(dim).astype(np.float32)
+
+    lines = []
+    failed = 0
+    for wire in wires:
+        canon_pub = WeightPublisher()
+        canon_pub.publish("fleet", tree, wire=wire)
+        canon = canon_pub.get("fleet").flat()
+        for n in fleets:
+            for mode in modes:
+                rec = run_arm(n, mode, wire, tree, canon, args.group_kb,
+                              args.timeout_ms,
+                              nic_bps=int(args.nic_mbps * 1e6))
+                print(json.dumps(rec), flush=True)
+                lines.append(rec)
+                if not rec["bitexact"] or rec["peers_consistent"] != n:
+                    failed = 1
+    if args.smoke:
+        relay = next(r for r in lines if r["mode"] == "relay")
+        if relay["root_tx_bytes"] != relay["snapshot_bytes"]:
+            print("weight_push_bench: SMOKE FAILED — relay root egress "
+                  f"{relay['root_tx_bytes']} != one snapshot "
+                  f"{relay['snapshot_bytes']}", flush=True)
+            failed = 1
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            for rec in lines:
+                f.write(json.dumps(rec) + "\n")
+    obs.dump_from_args(args)
+    if failed:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,19 @@ consumed gauges nonzero, ``p2p_credit_stall_seconds_total`` present)
 and a nonzero srtt gauge (completion RTTs fed the estimator); with a
 bench JSON, every arm must carry its counter-delta retx labels.
 
+``--weights`` mode (the bandwidth-optimal collectives + weight-push
+smoke arm: ``weight_push_bench.py --smoke --metrics-out`` and
+``all_reduce_perf.py --bench bcast,ag --metrics-out``): the PUSH metrics
+must show the fleet distribution really ran — nonzero
+``weight_push_bytes_total`` for BOTH roles (tx and rx), a counted
+``weight_push_versions_total`` publish, ≥1 peer on
+``weight_push_peers_total`` and the service-verb byte series
+``p2p_bytes_total{verb="weight_push"}`` nonzero; the PLAN metrics must
+carry nonzero ``collective_plan_total`` decisions for BOTH new verbs
+(``verb="broadcast"`` and ``verb="all_gather"``) — i.e. the planner's
+broadcast/all-gather coverage and the weight-push plane both
+demonstrably fired.
+
 ``--router`` mode (the replica-router smoke arm, serve --server
 --replicas N --priority-classes ... --metrics-out): the metrics file
 must carry ≥2 replica-labeled ``serving_router_requests_total`` series
@@ -347,6 +360,46 @@ def check_spec_metrics(path: str) -> None:
           f"all present")
 
 
+def check_weights_metrics(push_path: str, plan_path: str) -> None:
+    with open(push_path) as f:
+        lines = f.read().splitlines()
+
+    def total(prefix: str) -> float:
+        return _prom_total(lines, prefix, push_path)
+
+    for role in ("tx", "rx"):
+        hits = [ln for ln in lines
+                if ln.startswith("weight_push_bytes_total{")
+                and f'role="{role}"' in ln
+                and float(ln.rsplit(" ", 1)[1]) > 0]
+        if not hits:
+            fail(f"{push_path}: no nonzero weight_push_bytes_total "
+                 f"role={role} — the push plane never moved bytes that "
+                 f"way")
+    if total("weight_push_versions_total") < 1:
+        fail(f"{push_path}: no counted snapshot publish")
+    peers = total("weight_push_peers_total")
+    if peers < 1:
+        fail(f"{push_path}: no peer ever reached consistency")
+    if total('p2p_bytes_total{verb="weight_push"}') <= 0:
+        fail(f"{push_path}: weight bytes missing from the "
+             f'p2p_bytes_total{{verb="weight_push"}} fleet series')
+    with open(plan_path) as f:
+        plines = f.read().splitlines()
+    for verb in ("broadcast", "all_gather"):
+        hits = [ln for ln in plines
+                if ln.startswith("collective_plan_total{")
+                and f'verb="{verb}"' in ln
+                and float(ln.rsplit(" ", 1)[1]) > 0]
+        if not hits:
+            fail(f"{plan_path}: no nonzero collective_plan_total series "
+                 f"with verb={verb!r} — the planner never decided that "
+                 f"verb")
+    print(f"check_obs: weights metrics OK — {int(peers)} consistent "
+          f"peer(s), push byte/version series nonzero, plan series "
+          f"present for both new verbs")
+
+
 def check_router_metrics(path: str) -> None:
     with open(path) as f:
         lines = f.read().splitlines()
@@ -608,10 +661,15 @@ def main(argv) -> None:
         check_plan_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
         return
+    if len(argv) == 4 and argv[1] == "--weights":
+        check_weights_metrics(argv[2], argv[3])
+        print("check_obs: ALL OK")
+        return
     if len(argv) != 3:
         fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
              "check_obs.py --plan METRICS_PROM BENCH_JSON | "
+             "check_obs.py --weights PUSH_PROM PLAN_PROM | "
              "check_obs.py --disagg METRICS_PROM | "
              "check_obs.py --transport METRICS_PROM [BENCH_JSON] | "
              "check_obs.py --spec METRICS_PROM | "

@@ -7,15 +7,22 @@ Usage:
     python scripts/plan_calibrate.py sweep.json [more.json ...]
     python scripts/plan_calibrate.py < sweep.json
 
-Reads ``all_reduce_plan`` lines (benchmarks/all_reduce_perf.py --json; any
-other JSON lines are skipped), builds the design matrix from the SAME
-feature arithmetic the planner charges (uccl_tpu.collective.plan.
-cost_features — shared import, never mirrored), and least-squares fits:
+Reads ``all_reduce_plan`` lines (benchmarks/all_reduce_perf.py --json)
+AND ``collective_plan`` lines (the round-9 broadcast/all_gather verbs,
+``--bench bcast,ag``; any other JSON lines are skipped), builds the
+design matrix from the SAME feature arithmetic the planner charges
+(uccl_tpu.collective.plan.cost_features / verb_cost_features — shared
+import, never mirrored), and least-squares fits:
 
-* plan-family arms (ring | hd | bidir | torus | pallas):
-  ``time_us ~= alpha * hops + beta * serial_wire_bytes + gamma * launches``
-* xla arms: ``time_us ~= xla_alpha + xla_beta * snake * bytes`` (snake
-  estimated from 2-axis lines when present, else left at its default).
+* plan-family arms (ring | hd | bidir | torus | pallas | tree |
+  scatter_ag): ``time_us ~= alpha * hops + beta * serial_wire_bytes +
+  gamma * launches`` — ONE constant set across every verb, which is what
+  lets a single calibration reprice broadcast, all-gather and allreduce
+  together;
+* xla arms (incl. the psum broadcast baseline): ``time_us ~= xla_alpha +
+  xla_beta * snake * volume`` with the verb's wire volume
+  (plan.xla_wire_volume); snake estimated from 2-axis lines when
+  present, else left at its default.
 
 Prints the fitted constants, per-arm residuals under them, and the
 ``export UCCL_TPU_PLAN_*`` lines that pin the planner to this substrate
@@ -34,15 +41,18 @@ import numpy as np
 # this script runs on (the same container the bench ran in)
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-PLAN_ALGOS = ("ring", "hd", "bidir", "torus", "pallas")
+PLAN_ALGOS = ("ring", "hd", "bidir", "torus", "pallas", "tree",
+              "scatter_ag")
+XLA_ALGOS = ("xla", "psum")  # the psum broadcast baseline rides the line
+_BENCHES = ("all_reduce_plan", "collective_plan")
 
 
 def _rows(lines):
-    """(algo, world, worlds, n_axes, bytes, time_us) per arm of every
-    all_reduce_plan line. Arms whose plan label carries
-    ``outcome="fallback"`` are dropped: their timings are the lax mirror's,
-    not the kernel's — fitting them as the kernel would teach the planner
-    to pick it exactly where it degrades."""
+    """(verb, algo, world, worlds, n_axes, bytes, time_us) per arm of
+    every all_reduce_plan / collective_plan line. Arms whose plan label
+    carries ``outcome="fallback"`` are dropped: their timings are the lax
+    mirror's, not the kernel's — fitting them as the kernel would teach
+    the planner to pick it exactly where it degrades."""
     out = []
     for ln in lines:
         ln = ln.strip()
@@ -52,8 +62,9 @@ def _rows(lines):
             rec = json.loads(ln)
         except json.JSONDecodeError:
             continue
-        if rec.get("bench") != "all_reduce_plan":
+        if rec.get("bench") not in _BENCHES:
             continue
+        verb = rec.get("verb", "all_reduce")
         worlds = None
         if rec.get("mesh2d"):
             a, b = (int(v) for v in rec["mesh2d"].lower().split("x"))
@@ -61,7 +72,7 @@ def _rows(lines):
         for arm in rec.get("arms", []):
             if arm.get("outcome") == "fallback":
                 continue
-            out.append((arm["algo"], int(rec["world"]), worlds,
+            out.append((verb, arm["algo"], int(rec["world"]), worlds,
                         int(rec.get("n_axes", 1)), float(rec["bytes"]),
                         float(arm["time_us"])))
     return out
@@ -70,15 +81,15 @@ def _rows(lines):
 def fit(rows):
     from uccl_tpu.collective import plan as _plan
 
-    plan_rows = [r for r in rows if r[0] in PLAN_ALGOS]
-    xla_rows = [r for r in rows if r[0] == "xla"]
+    plan_rows = [r for r in rows if r[1] in PLAN_ALGOS]
+    xla_rows = [r for r in rows if r[1] in XLA_ALGOS]
     fitted = {}
 
     if plan_rows:
         feats, times = [], []
-        for algo, world, worlds, _n_axes, nbytes, t in plan_rows:
-            feats.append(_plan.cost_features(algo, world, nbytes,
-                                             worlds=worlds))
+        for verb, algo, world, worlds, _n_axes, nbytes, t in plan_rows:
+            feats.append(_plan.verb_cost_features(verb, algo, world,
+                                                  nbytes, worlds=worlds))
             times.append(t)
         a = np.asarray(feats, np.float64)
         y = np.asarray(times, np.float64)
@@ -88,8 +99,13 @@ def fit(rows):
                       PLAN_GAMMA_US=gamma)
 
     if xla_rows:
-        one = [(b, t) for _a, _w, _ws, nx, b, t in xla_rows if nx == 1]
-        two = [(b, t) for _a, _w, _ws, nx, b, t in xla_rows if nx > 1]
+        def vol(verb, world, b):
+            return _plan.xla_wire_volume(verb, world, b)
+
+        one = [(vol(v, w, b), t)
+               for v, _a, w, _ws, nx, b, t in xla_rows if nx == 1]
+        two = [(vol(v, w, b), t)
+               for v, _a, w, _ws, nx, b, t in xla_rows if nx > 1]
         base = one or two  # fit the line on whichever topology we have
         a = np.stack([np.ones(len(base)),
                       np.asarray([b for b, _ in base], np.float64)], axis=1)
@@ -105,7 +121,8 @@ def fit(rows):
 
 
 def residuals(rows, fitted):
-    """Per-arm (algo, bytes, measured, modeled) under the fitted model."""
+    """Per-arm (verb, algo, bytes, measured, modeled) under the fitted
+    model."""
     from uccl_tpu.collective import plan as _plan
 
     model = _plan.CostModel(
@@ -120,11 +137,12 @@ def residuals(rows, fitted):
         xla_snake=fitted.get("PLAN_XLA_SNAKE", _plan._PLAN_XLA_SNAKE.get()),
     )
     out = []
-    for algo, world, worlds, n_axes, nbytes, t in rows:
-        if algo not in PLAN_ALGOS + ("xla",):
+    for verb, algo, world, worlds, n_axes, nbytes, t in rows:
+        if algo not in PLAN_ALGOS + XLA_ALGOS:
             continue
-        pred = model.predict(algo, world, int(nbytes), n_axes, worlds)
-        out.append((algo, int(nbytes), t, pred))
+        pred = model.predict_verb(verb, algo, world, int(nbytes), n_axes,
+                                  worlds)
+        out.append((verb, algo, int(nbytes), t, pred))
     return out
 
 
@@ -143,11 +161,14 @@ def main(argv) -> int:
         return 1
     fitted = fit(rows)
     print(f"# plan_calibrate: {len(rows)} arms "
-          f"({sum(1 for r in rows if r[0] in PLAN_ALGOS)} plan-family, "
-          f"{sum(1 for r in rows if r[0] == 'xla')} xla)")
-    print(f"# {'algo':>8} {'bytes':>12} {'measured_us':>12} {'modeled_us':>12}")
-    for algo, nbytes, t, pred in residuals(rows, fitted):
-        print(f"  {algo:>8} {nbytes:>12} {t:>12.1f} {pred:>12.1f}")
+          f"({sum(1 for r in rows if r[1] in PLAN_ALGOS)} plan-family, "
+          f"{sum(1 for r in rows if r[1] in XLA_ALGOS)} xla-family) over "
+          f"verbs {sorted({r[0] for r in rows})}")
+    print(f"# {'verb':>10} {'algo':>10} {'bytes':>12} {'measured_us':>12} "
+          f"{'modeled_us':>12}")
+    for verb, algo, nbytes, t, pred in residuals(rows, fitted):
+        print(f"  {verb:>10} {algo:>10} {nbytes:>12} {t:>12.1f} "
+              f"{pred:>12.1f}")
     print("# pin the planner to this substrate:")
     for k, v in sorted(fitted.items()):
         print(f"export UCCL_TPU_{k}={v:.6g}")

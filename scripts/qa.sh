@@ -26,6 +26,14 @@ timeout 300 python benchmarks/all_reduce_perf.py --devices 4 --algo bidir \
   --metrics-out /tmp/qa_plan_metrics.prom > /tmp/qa_plan_bench.json; check $?
 python scripts/check_obs.py --plan /tmp/qa_plan_metrics.prom /tmp/qa_plan_bench.json; check $?
 
+note "bcast/allgather + fleet weight-push smoke tier (planned verbs oracle-exact + labeled off the verb-labeled plan counter; relay push: every peer bit-exact, root egress = one snapshot)"
+timeout 300 python benchmarks/all_reduce_perf.py --devices 4 --bench bcast,ag \
+  --json --check --min-bytes 16384 --max-bytes 16384 --iters 2 \
+  --metrics-out /tmp/qa_bcastag_metrics.prom > /tmp/qa_bcastag_bench.json; check $?
+timeout 300 python benchmarks/weight_push_bench.py --smoke \
+  --metrics-out /tmp/qa_push_metrics.prom --json-out /tmp/qa_push_bench.json; check $?
+python scripts/check_obs.py --weights /tmp/qa_push_metrics.prom /tmp/qa_bcastag_metrics.prom; check $?
+
 note "serving engine smoke tier (fail-fast: 2 slots, 6 mixed-length requests, oracle match + no leaked slots)"
 JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
   --requests 6 --prompt-len 8 --new-tokens 4 --arrival-rate 50 --check-oracle; check $?

@@ -293,15 +293,85 @@ class Communicator:
 
         return self._compiled(key, build)(x)
 
-    def all_gather(self, x: jax.Array) -> jax.Array:
-        """Every rank receives the concatenation over the rank dim: out is the
-        same global array, fully replicated (NCCL allgather semantics)."""
+    def _resolve_ag_plan(self, x, algo, wire_dtype):
+        """Resolve one all_gather request to (algo, wire_dtype), emitting
+        the planner decision (verb="all_gather") and counting any quant
+        downgrade — once per distinct request (the _plan_memo guard)."""
+        from uccl_tpu.collective import plan as _plan
+
+        planner = _plan.get_planner()
+        payload_shape = self._payload_shape(x)
+        worlds = tuple(self.mesh.shape[a] for a in self.axes)
+        if algo == "auto":
+            p = planner.plan_all_gather(
+                payload_shape, x.dtype, self.world,
+                n_axes=len(self.axes), worlds=worlds,
+                wire_dtype=wire_dtype, pallas_ok=self._pallas_ok(),
+            )
+            algo = p.algo
+            if wire_dtype is not None and algo not in ("ring", "bidir"):
+                from uccl_tpu.collective import dma as _dma
+
+                _dma.record_fallback(
+                    "all_gather_plan", "quant_algo", detail=algo,
+                    msg=f"all_gather plan {algo!r} cannot carry a "
+                        f"quantized wire; shipping full precision",
+                )
+                wire_dtype = None
+            return algo, wire_dtype
+        if algo not in ("xla", "ring", "bidir"):
+            raise ValueError(f"unknown all_gather algo {algo!r}")
+        planner.plan_explicit(
+            algo, payload_shape, x.dtype, self.world,
+            n_axes=len(self.axes), worlds=worlds, wire_dtype=wire_dtype,
+            verb="all_gather",
+        )
+        return algo, wire_dtype
+
+    def all_gather(self, x: jax.Array, algo: str = "auto",
+                   wire_dtype=None) -> jax.Array:
+        """Every rank receives the concatenation over the rank dim: out is
+        the same global array, fully replicated (NCCL allgather
+        semantics).
+
+        ``algo="xla"`` lowers to lax.all_gather; ``algo="ring"`` runs the
+        write-once pallas ring kernel
+        (:func:`~uccl_tpu.collective.pallas_ccl.ring_all_gather`);
+        ``algo="bidir"`` pairs two counter-rotating AG kernels, each
+        carrying half the payload; ``algo="auto"`` (the default) asks the
+        :class:`~uccl_tpu.collective.plan.CollectivePlanner` — priced at
+        actual wire bytes, emitted on ``collective_plan_total`` with
+        ``verb="all_gather"``. ``wire_dtype="fp8"|"int8"`` (ring/bidir)
+        block-quantizes the contributed payload ONCE and forwards wire
+        bytes verbatim: one quantize round trip of error, all members
+        identical. Full precision stays bit-exact (pure data movement)."""
         self._check(x)
+        if wire_dtype is not None and algo not in ("ring", "bidir", "auto"):
+            raise ValueError(
+                "wire_dtype quantization rides the ring/bidir all_gather "
+                "only"
+            )
         ax = self._axis_name()
-        key = ("ag", x.shape, x.dtype)
+        req = ("ag", algo, x.shape, x.dtype, wire_dtype)
+        memo = self._plan_memo.get(req)
+        if memo is None:
+            memo = self._resolve_ag_plan(x, algo, wire_dtype)
+            self._plan_memo[req] = memo
+        algo, wire_dtype = memo
+        key = ("ag", algo, x.shape, x.dtype, wire_dtype)
 
         def build():
             def f(v):
+                if algo in ("ring", "bidir"):
+                    if len(self.axes) != 1:
+                        raise ValueError(
+                            f"{algo} all_gather rings a single mesh axis"
+                        )
+                    from uccl_tpu.collective import pallas_ccl
+
+                    fn = (pallas_ccl.bidir_all_gather if algo == "bidir"
+                          else pallas_ccl.ring_all_gather)
+                    return fn(v, ax, wire_dtype=wire_dtype)
                 return lax.all_gather(v, ax, axis=0, tiled=True)
 
             return self._shard_jit(f, self._ranked(x.ndim - 1), P(*([None] * x.ndim)))
@@ -351,19 +421,109 @@ class Communicator:
 
         return self._compiled(key, build)(x)
 
-    def broadcast(self, x: jax.Array, root: int = 0) -> jax.Array:
-        """out[i] = x[root] for every i."""
+    def _resolve_bcast_plan(self, x, algo, wire_dtype):
+        """Resolve one broadcast request to (algo, wire_dtype), emitting
+        the planner decision (verb="broadcast") and counting any quant
+        downgrade — once per distinct request (the _plan_memo guard)."""
+        from uccl_tpu.collective import plan as _plan
+
+        planner = _plan.get_planner()
+        payload_shape = self._payload_shape(x)
+        worlds = tuple(self.mesh.shape[a] for a in self.axes)
+        if algo == "auto":
+            p = planner.plan_broadcast(
+                payload_shape, x.dtype, self.world,
+                n_axes=len(self.axes), worlds=worlds,
+                wire_dtype=wire_dtype, pallas_ok=self._pallas_ok(),
+            )
+            algo = p.algo
+            if wire_dtype is not None and algo != "scatter_ag":
+                from uccl_tpu.collective import dma as _dma
+
+                _dma.record_fallback(
+                    "broadcast_plan", "quant_algo", detail=algo,
+                    msg=f"broadcast plan {algo!r} cannot carry a "
+                        f"quantized wire; shipping full precision",
+                )
+                wire_dtype = None
+            return algo, wire_dtype
+        if algo not in ("xla", "tree", "scatter_ag", "psum"):
+            raise ValueError(f"unknown broadcast algo {algo!r}")
+        planner.plan_explicit(
+            algo, payload_shape, x.dtype, self.world,
+            n_axes=len(self.axes), worlds=worlds, wire_dtype=wire_dtype,
+            verb="broadcast",
+        )
+        return algo, wire_dtype
+
+    def broadcast(self, x: jax.Array, root: int = 0, algo: str = "auto",
+                  wire_dtype=None) -> jax.Array:
+        """out[i] = x[root] for every i.
+
+        ``algo="xla"`` lowers to the lax scatter-allgather schedule
+        (:func:`~uccl_tpu.collective.pallas_ccl.
+        scatter_gather_broadcast_lax` — direct root→j chunk ppermutes +
+        one ring all-gather), replacing the legacy psum-of-zeros lowering
+        that shipped the full payload through a reduction plus world-1
+        adds of zeros; ``algo="tree"`` runs the binomial tree
+        (:func:`~uccl_tpu.collective.plan.tree_broadcast` — log2(n)
+        full-payload rounds, the alpha-dominated range);
+        ``algo="scatter_ag"`` runs the pallas scatter-allgather kernel
+        pair (root scatters S/n chunks, a counter-rotating all-gather
+        pair completes — the bandwidth-optimal decomposition, PAPERS.md);
+        ``algo="psum"`` keeps the legacy masked-psum lowering as the
+        counter-audited baseline; ``algo="auto"`` (the default) asks the
+        planner — emitted on ``collective_plan_total`` with
+        ``verb="broadcast"``. ``wire_dtype="fp8"|"int8"`` (scatter_ag)
+        quantizes the all-gather legs once: one round trip of error,
+        every member identical; full precision is bit-exact on every
+        algo (pure data movement — psum aside, which adds zeros)."""
         self._check(x)
+        if not 0 <= root < self.world:
+            raise ValueError(f"root {root} outside world {self.world}")
+        if wire_dtype is not None and algo not in ("scatter_ag", "auto"):
+            raise ValueError(
+                "wire_dtype quantization rides the scatter_ag broadcast "
+                "only"
+            )
         ax = self._axis_name()
-        key = ("bc", root, x.shape, x.dtype)
+        req = ("bc", algo, x.shape, x.dtype, wire_dtype)
+        memo = self._plan_memo.get(req)
+        if memo is None:
+            memo = self._resolve_bcast_plan(x, algo, wire_dtype)
+            self._plan_memo[req] = memo
+        algo, wire_dtype = memo
+        key = ("bc", root, algo, x.shape, x.dtype, wire_dtype)
 
         def build():
             def f(v):
-                # Mask every non-root contribution to zero, then psum: one
-                # reduced buffer moves instead of the full world-sized gather.
-                idx = lax.axis_index(ax).reshape((1,) * v.ndim)
-                masked = jnp.where(idx == root, v, jnp.zeros_like(v))
-                return lax.psum(masked, ax)
+                from uccl_tpu.collective import pallas_ccl
+                from uccl_tpu.collective import plan as _plan
+
+                if algo == "scatter_ag":
+                    if len(self.axes) != 1:
+                        raise ValueError(
+                            "scatter_ag broadcast rings a single mesh axis"
+                        )
+                    return pallas_ccl.scatter_ag_broadcast(
+                        v, ax, root, wire_dtype=wire_dtype
+                    )
+                if algo == "tree":
+                    return _plan.tree_broadcast(v, ax, root)
+                if algo == "psum":
+                    # the legacy lowering, kept as the wire-byte baseline:
+                    # mask every non-root contribution to zero, then psum —
+                    # a full-payload reduction whose every hop carries the
+                    # whole buffer (counted at the up-and-down tree volume
+                    # 2S; a ring-lowered psum would pay 2(n-1)/n·S, still
+                    # ~2x the scatter-allgather's ~S — docs/PLAN_BENCH.md)
+                    pallas_ccl._count_wire_bytes(
+                        "bcast", "psum", None, 2 * v.size * v.dtype.itemsize
+                    )
+                    idx = lax.axis_index(ax).reshape((1,) * v.ndim)
+                    masked = jnp.where(idx == root, v, jnp.zeros_like(v))
+                    return lax.psum(masked, ax)
+                return pallas_ccl.scatter_gather_broadcast_lax(v, ax, root)
 
             spec = self._ranked(x.ndim - 1)
             return self._shard_jit(f, spec, spec)

@@ -106,6 +106,13 @@ CID_EP_COMBINE = 4  # combine chunks rotate {4, 5}
 CID_A2A = 6  # the generic/unchunked EP all-to-all lane, rotating {6, 7}
 CID_SCALE_OFFSET = 8  # fp8 scale exchange = value id + 8
 CID_RING_BIDIR = 16  # bidir allreduce: fwd ring 16, bwd ring 17
+# bidir all-gather pair {18, 19} (scales {26, 27}) and the broadcast's
+# counter-rotating AG pair {20, 21} (scales {28, 29}) — same concurrency
+# rationale as CID_RING_BIDIR: the paired kernels are airborne at once, so
+# they must never share a barrier id, and a broadcast overlapping a
+# standalone all-gather must not alias either.
+CID_AG_BIDIR = 18
+CID_BCAST = 20
 
 
 def chunk_collective_id(base: int, chunk: int) -> int:

@@ -534,48 +534,57 @@ class DcnGroup:
 
     def broadcast(self, x: np.ndarray, root: int = 0) -> np.ndarray:
         """Rooted broadcast: every rank returns root's x. Binomial tree —
-        ceil(log2 world) rounds; each rank builds only its own tree edges and
-        sends at most log(world) copies (vs the old gather path's world×
-        traffic)."""
+        ceil(log2 world) rounds; each rank walks only its own edges of the
+        SHARED tree schedule (``utils.topology.bcast_tree_rounds`` — the
+        same arithmetic the on-mesh ``plan.tree_broadcast`` lowers and the
+        planner's tree cost features charge, so the host and device trees
+        cannot drift) and sends at most log(world) copies. The decision
+        lands on ``collective_plan_total{verb="broadcast", algo="tree"}``
+        beside the on-mesh verbs (compat/dist.broadcast shims here)."""
+        from uccl_tpu.obs import counters as _obsc
+        from uccl_tpu.utils.topology import bcast_tree_rounds
+
         n = self.active_world
         if n == 1:
             return x.copy()
         if root not in self._active:
             raise ValueError(f"broadcast root {root} is not an active rank")
         root_pos = self._active.index(root)
-        vr = (self.pos - root_pos) % n
+        me = self.pos
+        rounds = bcast_tree_rounds(n, root_pos)  # position-space pairs
         # Only this rank's tree edges — log(world) channels, not a full mesh.
         partners = set()
-        mask = 1
-        while mask < n:
-            if vr < mask and vr + mask < n:
-                partners.add(self._active[(vr + mask + root_pos) % n])
-            elif mask <= vr < 2 * mask:
-                partners.add(self._active[(vr - mask + root_pos) % n])
-            mask <<= 1
+        for pairs in rounds:
+            for s, d in pairs:
+                if s == me:
+                    partners.add(self._active[d])
+                elif d == me:
+                    partners.add(self._active[s])
+        _obsc.counter("collective_plan_total").inc(
+            algo="tree", chunks=1, wire_dtype="none", outcome="explicit",
+            verb="broadcast",
+        )
         self._setup_mesh_buf(x.nbytes, partners)
-        buf = np.ascontiguousarray(x).copy() if vr == 0 else np.empty_like(x)
-        mask = 1
-        while mask < n:
-            if vr < mask:  # holders fan out
-                dst_vr = vr + mask
-                if dst_vr < n:
-                    dst = self._active[(dst_vr + root_pos) % n]
+        buf = (np.ascontiguousarray(x).copy() if me == root_pos
+               else np.empty_like(x))
+        for pairs in rounds:
+            for s, d in pairs:
+                if s == me:  # this round's holder: fan out
+                    dst = self._active[d]
                     ch = self._mesh[dst]
                     if self._ctrl_recv(ch, dst) != b"R":
                         raise IOError("broadcast: expected READY")
                     item = self._mesh_fifos[dst]
                     ch.write(buf, item.slice(0, buf.nbytes).pack())
                     ch.send(b"D")
-            elif vr < 2 * mask:  # this round's receivers
-                src = self._active[((vr - mask) + root_pos) % n]
-                ch = self._mesh[src]
-                ch.send(b"R")
-                if self._ctrl_recv(ch, src) != b"D":
-                    raise IOError("broadcast: expected DONE")
-                flat = self._mesh_region(src, buf.nbytes).view(buf.dtype)
-                buf = flat.reshape(x.shape).copy()
-            mask <<= 1
+                elif d == me:  # this round's receiver
+                    src = self._active[s]
+                    ch = self._mesh[src]
+                    ch.send(b"R")
+                    if self._ctrl_recv(ch, src) != b"D":
+                        raise IOError("broadcast: expected DONE")
+                    flat = self._mesh_region(src, buf.nbytes).view(buf.dtype)
+                    buf = flat.reshape(x.shape).copy()
         return buf
 
     def barrier(self):
@@ -617,7 +626,11 @@ def hierarchical_all_reduce(comm, dcn: DcnGroup, x):
     # back onto the mesh shard-wise (N/local per device over the host link),
     # then the final hop is a true ICI all-gather + on-device broadcast
     shard_dev = comm.device_put(reduced)
-    gathered = comm.all_gather(shard_dev)  # replicated [local, N/local]
+    # the AG leg stays the XLA lowering: the cross-pod schedule was already
+    # planned as ONE "hier" decision above — re-planning its inner leg
+    # would double-emit and could swap a kernel into a path priced as xla
+    gathered = comm.all_gather(shard_dev, algo="xla")  # replicated
+
     out_sharding = NamedSharding(comm.mesh, comm._ranked(1))
     return jax.jit(
         lambda g: jnp.broadcast_to(g.reshape(1, -1), (local, n)),

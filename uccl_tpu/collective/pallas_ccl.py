@@ -460,7 +460,7 @@ def _ag_ring(chunk, axis, n, *, direction, interpret, faithful,
 
 def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
                     interpret=None, collective_id: int = 0,
-                    wire_dtype=None) -> jax.Array:
+                    wire_dtype=None, count: bool = True) -> jax.Array:
     """Per-shard ``[k, ...] -> [n*k, ...]`` ring all-gather as one Pallas
     kernel (n-1 neighbor DMA hops). Falls back to the plan lowering when the
     gathered buffer exceeds the VMEM budget.
@@ -468,7 +468,12 @@ def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
     ``wire_dtype``: quantize the payload once (shared block codec, one f32
     scale per 128-lane row) and circulate payload + scale sidecar — every
     member dequantizes the same wire bytes, so the result is identical on
-    all members and one quantize round trip from the input."""
+    all members and one quantize round trip from the input.
+
+    ``count=False`` suppresses the ``ep_bytes_total`` tally — for callers
+    that compose this ring into a larger schedule and count the WHOLE
+    schedule's bytes under their own verb (scatter_ag_broadcast), so no
+    byte is ever counted on two series."""
     n = lax.axis_size(axis)
     if n == 1:
         return x
@@ -479,6 +484,15 @@ def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
     chunk, _, m = _pad_chunks(flat, 1)  # [1, rows, 128]
     rows = m // _LANES
     faithful = _dma.faithful_sync(interpret)
+    if wire_dtype is not None and direction == -1 and not faithful:
+        # The legacy discharge interpreter (jax 0.4.x) mis-propagates the
+        # sharding of the REVERSE-ring payload+scale gather pair (XLA
+        # Array::Reshape check failure at compile). An all-gather's result
+        # is direction-independent — write-once verbatim forwarding — so
+        # ride the forward ring there: the counter-rotation only buys
+        # concurrency on substrates with real DMAs, which the discharge
+        # interpreter serializes anyway. Bit-identical output either way.
+        direction = 1
     itemsize = x.dtype.itemsize
     hop_bytes = _hop_wire_bytes(m, itemsize, wire_dtype)
 
@@ -487,11 +501,13 @@ def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
                              interpret):
             from uccl_tpu.collective import plan
 
-            _count_wire_bytes("ring_all_gather", "lax", None,
-                              (n - 1) * hop_bytes)
+            if count:
+                _count_wire_bytes("ring_all_gather", "lax", None,
+                                  (n - 1) * hop_bytes)
             return plan.ring_all_gather(x, axis)
-        _count_wire_bytes("ring_all_gather", "pallas", None,
-                          (n - 1) * hop_bytes)
+        if count:
+            _count_wire_bytes("ring_all_gather", "pallas", None,
+                              (n - 1) * hop_bytes)
         buf = _ag_ring(chunk, axis, n, direction=direction,
                        interpret=interpret, faithful=faithful,
                        collective_id=collective_id)
@@ -504,14 +520,16 @@ def ring_all_gather(x: jax.Array, axis, *, direction: int = 1,
     if not _check_budget(n * hop_bytes, "all_gather", interpret):
         from uccl_tpu.collective import plan
 
-        _count_wire_bytes("ring_all_gather", "lax", wire_dtype,
-                          (n - 1) * hop_bytes)
+        if count:
+            _count_wire_bytes("ring_all_gather", "lax", wire_dtype,
+                              (n - 1) * hop_bytes)
         qg = plan.ring_all_gather(q, axis)  # [n, rows, 128]
         sg = plan.ring_all_gather(sc, axis)  # [n, rows, 1]
         out = _dequantize_rows(qg, sg, x.dtype)
     else:
-        _count_wire_bytes("ring_all_gather", "pallas", wire_dtype,
-                          (n - 1) * hop_bytes)
+        if count:
+            _count_wire_bytes("ring_all_gather", "pallas", wire_dtype,
+                              (n - 1) * hop_bytes)
         sp = _dma.pack_row_scales(sc[..., 0], srows)  # [1, srows, 128]
         qbuf = _ag_ring(q, axis, n, direction=direction,
                         interpret=interpret, faithful=faithful,
@@ -890,3 +908,276 @@ def bidir_all_reduce(x: jax.Array, axis, *, interpret=None,
                           collective_id=collective_id + 1,
                           wire_dtype=wire_dtype)
     return jnp.concatenate([fwd, bwd]).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Broadcast / all-gather as first-class planned verbs (ISSUE 14).
+#
+# The other half of the collective layer: serving fleets replicate one
+# buffer to N peers constantly (replica spin-up, warm spares, RL weight
+# refresh), and the bandwidth-optimal form is the scatter-allgather
+# decomposition (Network-Offloaded Bandwidth-Optimal Broadcast and
+# Allgather, PAPERS.md): the root scatters S/N chunks — (N-1)/N of the
+# payload leaves the root exactly ONCE — and a counter-rotating all-gather
+# pair completes every member's copy, vs the legacy masked full-payload
+# psum that ships the whole buffer through a reduction plus world-1 adds of
+# zeros. Everything below reuses the ring substrate verbatim: write-once AG
+# slots, credit rotation, wire_dtype quantize-once-forward-verbatim, paired
+# collective ids, counted budget fallbacks onto bit-identical lax mirrors.
+
+
+def ag_charge(nelems: int, itemsize: int, n: int, wire_dtype,
+              interpret) -> int:
+    """VMEM charge of ONE all-gather ring kernel on a flat ``nelems``
+    payload: the gathered buffer (full precision) or the gathered wire
+    payload + scale sidecar (quantized) — EXACTLY what ring_all_gather's
+    own gate charges, shared with the planner's quiet probe."""
+    del interpret  # per-kernel charge; the limit differs, not the charge
+    if wire_dtype is None:
+        return n * nelems * itemsize
+    m = _dma.padded_chunk_elems(nelems)
+    return n * _hop_wire_bytes(m, itemsize, wire_dtype)
+
+
+def ag_pair_charge(nelems: int, itemsize: int, n: int, wire_dtype,
+                   interpret) -> int:
+    """Charge of the counter-rotating all-gather PAIR (bidir_all_gather):
+    both kernels airborne concurrently → sum of the halves; under the
+    interpreter kernels run sequentially and the ceiling is per-buffer —
+    charge the larger half (the bidir_pair_charge convention)."""
+    half = nelems // 2
+    halves = (half, nelems - half) if half else (nelems,)
+    charges = [ag_charge(h, itemsize, n, wire_dtype, interpret)
+               for h in halves]
+    return max(charges) if interpret else sum(charges)
+
+
+def bcast_pair_charge(nelems: int, itemsize: int, n: int, wire_dtype,
+                      interpret) -> int:
+    """Charge of the scatter-allgather broadcast on a flat ``nelems``
+    payload: the AG pair over ONE padded S/n chunk (the scatter leg is
+    lax ppermutes — no kernel residency)."""
+    m = _dma.padded_chunk_elems(-(-nelems // n))
+    return ag_pair_charge(m, itemsize, n, wire_dtype, interpret)
+
+
+def _ag_lax_mirror(x, axis, n, wire_dtype):
+    """The pure-lax mirror of one all-gather ring on per-shard ``x``
+    [k, ...]: the plan lowering of the same write-once schedule. With a
+    wire dtype: quantize ONCE (same padded chunk view as the kernel),
+    gather payload + scales verbatim, dequantize — bit-identical to the
+    kernel, because forwarding moves bytes verbatim and every member
+    dequantizes the same bytes. Without one, pure data movement — exact
+    by construction (and direction-independent, so one mirror covers
+    both rings of a pair)."""
+    from uccl_tpu.collective import plan
+
+    if wire_dtype is None:
+        return plan.ring_all_gather(x, axis)
+    k = x.shape[0]
+    flat = x.reshape(-1)
+    chunk, _, m = _pad_chunks(flat, 1)  # [1, rows, 128] — the kernel's view
+    q, sc = _quantize_rows(chunk, wire_dtype)
+    qg = plan.ring_all_gather(q, axis)  # [n, rows, 128]
+    sg = plan.ring_all_gather(sc, axis)  # [n, rows, 1]
+    out = _dequantize_rows(qg, sg, x.dtype)
+    out = out.reshape(n, m)[:, : flat.size]
+    return out.reshape((n * k,) + x.shape[1:])
+
+
+def _ag_pair_lax_mirror(flat, axis, n, wire_dtype):
+    """The pure-lax mirror of the counter-rotating AG PAIR on a flat
+    payload: the same half split, per-half :func:`_ag_lax_mirror`, and
+    block-wise reassembly to ``[n, flat.size]`` — THE one fallback the
+    bidir all-gather and the scatter-allgather broadcast both ride, so
+    the two cannot drift."""
+    half = flat.size // 2
+    outs = [_ag_lax_mirror(flat[:half], axis, n, wire_dtype),
+            _ag_lax_mirror(flat[half:], axis, n, wire_dtype)]
+    return jnp.concatenate(
+        [outs[0].reshape(n, half), outs[1].reshape(n, flat.size - half)],
+        axis=1,
+    )
+
+
+def bidir_all_gather(x: jax.Array, axis, *, interpret=None,
+                     collective_id=None, wire_dtype=None,
+                     count: bool = True) -> jax.Array:
+    """Per-shard ``[k, ...] -> [n*k, ...]`` all-gather over TWO
+    counter-rotating ring kernels on paired collective ids (the FlexLink
+    pairing of :func:`bidir_all_reduce`, applied to the write-once AG
+    schedule): the flat payload is split in half, the first half rings
+    forward, the second backward, each kernel carrying half the serial
+    volume concurrently. ``wire_dtype`` quantizes each half once at the
+    source and forwards wire bytes verbatim (one round trip of error,
+    members identical). The budget fallback rides the bit-identical lax
+    mirror as a pair — counted on ``ep_wire_fallback_total`` AND
+    ``collective_plan_total{verb="all_gather", outcome="fallback"}``."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    interpret = _resolve_interpret(interpret)
+    wire_dtype = _ring_wire_dtype(x, wire_dtype, "all_gather_bidir")
+    if collective_id is None:
+        collective_id = _dma.CID_AG_BIDIR
+    k = x.shape[0]
+    shape = x.shape
+    flat = x.reshape(-1)
+    half = flat.size // 2
+    if half == 0:  # nothing to split: one directed ring carries it
+        return ring_all_gather(x, axis, interpret=interpret,
+                               collective_id=collective_id,
+                               wire_dtype=wire_dtype, count=count)
+    halves = (flat[:half], flat[half:])
+    itemsize = x.dtype.itemsize
+    pair_charge = ag_pair_charge(flat.size, itemsize, n, wire_dtype,
+                                 interpret)
+    if not _check_budget(pair_charge, "all_gather_bidir", interpret):
+        from uccl_tpu.collective import plan
+
+        plan.PLAN_TOTAL.inc(algo="bidir", chunks=2,
+                            wire_dtype=wire_dtype or "none",
+                            outcome="fallback", verb="all_gather")
+        if count:
+            wire_total = sum(
+                (n - 1) * _hop_wire_bytes(_dma.padded_chunk_elems(h.size),
+                                          itemsize, wire_dtype)
+                for h in halves
+            )
+            _count_wire_bytes("ring_all_gather", "lax", wire_dtype,
+                              wire_total)
+        out = _ag_pair_lax_mirror(flat, axis, n, wire_dtype)  # [n, S]
+    else:
+        # pair gate passing implies each half passes its own ring gate
+        # (half charge <= pair charge <= limit): the pair flies as a pair
+        outs = [
+            ring_all_gather(halves[0], axis, direction=1,
+                            interpret=interpret,
+                            collective_id=collective_id,
+                            wire_dtype=wire_dtype, count=count),
+            ring_all_gather(halves[1], axis, direction=-1,
+                            interpret=interpret,
+                            collective_id=collective_id + 1,
+                            wire_dtype=wire_dtype, count=count),
+        ]
+        # outs[i]: [n * half_i] — member j's half at block j; reassemble
+        # so block j is member j's FULL flat payload
+        out = jnp.concatenate(
+            [outs[0].reshape(n, half), outs[1].reshape(n, flat.size - half)],
+            axis=1,
+        )
+    return out.reshape((n * k,) + shape[1:])
+
+
+def _scatter_from_root(chunks, axis, n, root):
+    """Per-shard rooted scatter on a ``[n, ...]`` chunk view: member r
+    ends holding ROOT's chunk r (the root keeps its own). Direct
+    (root → j) ppermutes — (n-1)/n of the payload leaves the root exactly
+    once, and the selects are pure (no adds), so every received chunk is
+    bit-identical to the root's bytes."""
+    r = lax.axis_index(axis)
+    my_chunk = lax.dynamic_index_in_dim(chunks, r, 0, keepdims=False)
+    for j in range(n):
+        if j == root:
+            continue
+        got = lax.ppermute(chunks[j], axis, [(root, j)])
+        my_chunk = jnp.where(r == j, got, my_chunk)
+    return my_chunk
+
+
+def _bcast_wire_bytes(n: int, m: int, itemsize: int, wire_dtype) -> int:
+    """Counter-audited per-member wire bytes of one scatter-allgather
+    broadcast: the root's (n-1) scatter chunks amortized over the world
+    (only the root sends that leg) + the AG pair's (n-1) hops per half.
+    The scatter leg ships full precision (raw chunk ppermutes); the AG
+    legs ship the wire dtype."""
+    scatter = -(-(n - 1) * m * itemsize // n)
+    h1 = m // 2
+    ag = sum(
+        (n - 1) * _hop_wire_bytes(_dma.padded_chunk_elems(h), itemsize,
+                                  wire_dtype)
+        for h in ((h1, m - h1) if h1 else (m,))
+    )
+    return scatter + ag
+
+
+def scatter_ag_broadcast(x: jax.Array, axis, root: int = 0, *,
+                         interpret=None, collective_id=None,
+                         wire_dtype=None) -> jax.Array:
+    """Per-shard rooted broadcast: every member returns the ROOT's ``x``,
+    as the bandwidth-optimal scatter-allgather decomposition — the root
+    scatters S/n chunks (direct ppermutes, (n-1)/n·S leaves the root
+    once), then the counter-rotating pallas all-gather pair completes
+    every member's copy (~(n-1)/n·S per member vs the masked psum's full
+    reduction volume). Full precision is BIT-exact (pure data movement);
+    ``wire_dtype`` quantizes the AG legs once per chunk — one round trip
+    of error, every member identical. Budget fallback: the bit-identical
+    lax mirror (same scatter, the pair's AG mirror), counted on
+    ``ep_wire_fallback_total{what="broadcast"}`` AND
+    ``collective_plan_total{verb="broadcast", outcome="fallback"}``."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    interpret = _resolve_interpret(interpret)
+    wire_dtype = _ring_wire_dtype(x, wire_dtype, "broadcast")
+    if collective_id is None:
+        collective_id = _dma.CID_BCAST
+    shape = x.shape
+    flat = x.reshape(-1)
+    chunks, kk, m = _pad_chunks(flat, n)  # [n, rows, 128]
+    itemsize = x.dtype.itemsize
+    wire_total = _bcast_wire_bytes(n, m, itemsize, wire_dtype)
+    pair_charge = bcast_pair_charge(flat.size, itemsize, n, wire_dtype,
+                                    interpret)
+    kernel_ok = _check_budget(pair_charge, "broadcast", interpret)
+    if not kernel_ok:
+        from uccl_tpu.collective import plan
+
+        plan.PLAN_TOTAL.inc(algo="scatter_ag", chunks=2,
+                            wire_dtype=wire_dtype or "none",
+                            outcome="fallback", verb="broadcast")
+    # the WHOLE schedule's bytes (scatter leg + both AG legs) land once,
+    # here, under verb="bcast" — the composed all-gather runs count=False
+    # so no byte is ever tallied on two series, and kernel and fallback
+    # report identically
+    _count_wire_bytes("bcast", "pallas" if kernel_ok else "lax",
+                      wire_dtype, wire_total)
+    my_chunk = _scatter_from_root(chunks, axis, n, root)  # [rows, 128]
+    if kernel_ok:
+        gathered = bidir_all_gather(
+            my_chunk, axis, interpret=interpret,
+            collective_id=collective_id, wire_dtype=wire_dtype,
+            count=False,
+        )  # [n*rows, 128]
+    else:
+        gathered = _ag_pair_lax_mirror(my_chunk.reshape(-1), axis, n,
+                                       wire_dtype)  # [n, m]
+    out = gathered.reshape(n, m)[:, :kk]
+    return out.reshape(-1)[: flat.size].reshape(shape)
+
+
+def scatter_gather_broadcast_lax(x: jax.Array, axis,
+                                 root: int = 0) -> jax.Array:
+    """The planned ``xla`` broadcast lowering (per-shard): the same
+    scatter-allgather schedule in pure lax — direct root→j chunk
+    ppermutes + one plan.ring_all_gather — replacing the legacy
+    psum-of-zeros (which shipped the full payload through a reduction
+    plus world-1 adds of zeros). Bit-exact (pure data movement); wire
+    bytes counted on ``ep_bytes_total{verb="bcast", wire="xla"}`` so the
+    reduction vs the psum baseline is a counter delta, not model math."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    shape = x.shape
+    flat = x.reshape(-1)
+    chunks, kk, m = _pad_chunks(flat, n)
+    itemsize = x.dtype.itemsize
+    scatter = -(-(n - 1) * m * itemsize // n)
+    _count_wire_bytes("bcast", "xla", None,
+                      scatter + (n - 1) * m * itemsize)
+    from uccl_tpu.collective import plan
+
+    my_chunk = _scatter_from_root(chunks, axis, n, root)
+    gathered = plan.ring_all_gather(my_chunk, axis)  # [n*rows, 128]
+    out = gathered.reshape(n, m)[:, :kk]
+    return out.reshape(-1)[: flat.size].reshape(shape)

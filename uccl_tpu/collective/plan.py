@@ -423,7 +423,11 @@ def tree_broadcast(x: jax.Array, axis: Axis, root: int = 0) -> jax.Array:
     """Binomial-tree broadcast over a mesh axis (per-shard fn): at round t,
     members with virtual rank < 2^t forward to virtual rank + 2^t via a
     partial ppermute; everyone else passes zeros and keeps its value. log2(n)
-    rounds vs one big all-gather."""
+    rounds vs one big all-gather. The round schedule is the shared
+    ``utils.topology.bcast_tree_rounds`` arithmetic — the same edges the
+    host-side DCN broadcast walks."""
+    from uccl_tpu.utils.topology import bcast_tree_rounds
+
     n = lax.axis_size(axis)
     if n == 1:
         return x
@@ -431,12 +435,7 @@ def tree_broadcast(x: jax.Array, axis: Axis, root: int = 0) -> jax.Array:
     vr = (r - root) % n
     cur = jnp.where(vr == 0, x, jnp.zeros_like(x))
     mask = 1
-    while mask < n:
-        pairs = [
-            (((v + root) % n), ((v + mask + root) % n))
-            for v in range(mask)
-            if v + mask < n
-        ]
+    for pairs in bcast_tree_rounds(n, root):
         got = lax.ppermute(cur, axis, pairs)
         receiving = (vr >= mask) & (vr < 2 * mask)
         cur = jnp.where(receiving, got, cur)
@@ -677,6 +676,30 @@ class CostModel:
                   * 2.0 * (dcn_world - 1) / dcn_world * wire_bytes)
         return t
 
+    def predict_verb(self, verb: str, algo: str, world: int,
+                     wire_bytes: int, n_axes: int = 1,
+                     worlds=None) -> float:
+        """Predicted us of one ``verb`` collective — the allreduce surface
+        delegates to :meth:`predict`; broadcast/all_gather charge the SAME
+        alpha/beta/gamma constants over their own schedule features
+        (:func:`verb_cost_features`) and the same xla line over their own
+        wire volume (:func:`xla_wire_volume`), so one calibration fits
+        every verb."""
+        if verb == "all_reduce":
+            return self.predict(algo, world, wire_bytes, n_axes, worlds)
+        if world <= 1:
+            return 0.0
+        if algo in ("xla", "psum"):
+            snake = self.xla_snake if n_axes > 1 else 1.0
+            vol = xla_wire_volume(verb, world, wire_bytes)
+            return self.xla_alpha_us + self.xla_beta_us_per_byte * snake * vol
+        hops, serial_bytes, launches = verb_cost_features(
+            verb, algo, world, wire_bytes, worlds=worlds
+        )
+        return (self.alpha_us * hops
+                + self.beta_us_per_byte * serial_bytes
+                + self.gamma_us * launches)
+
 
 def torus_split(world: int) -> Tuple[int, int]:
     """The (a, b) factor pair of ``world`` closest to square — the planner's
@@ -727,6 +750,64 @@ def cost_features(algo: str, world: int, wire_bytes: int,
     raise ValueError(f"unknown plan algo {algo!r}")
 
 
+def xla_wire_volume(verb: str, world: int, wire_bytes: int) -> float:
+    """Per-member byte volume the xla line of ``verb`` is priced (and
+    calibrated) over: allreduce and broadcast move ~one payload per member,
+    an all-gather's per-member contribution crosses the wire world-1
+    times. The ONE volume arithmetic CostModel.predict_verb and
+    scripts/plan_calibrate.py share."""
+    if verb == "all_gather":
+        return float((world - 1) * wire_bytes)
+    return float(wire_bytes)
+
+
+def verb_cost_features(verb: str, algo: str, world: int, wire_bytes: int,
+                      worlds=None) -> Tuple[float, float, int]:
+    """(hops, serial wire bytes per member, kernel launches) of one
+    broadcast / all_gather under ``algo`` — the design-matrix row
+    convention of :func:`cost_features` extended to the new verbs (ISSUE
+    14), shared by CostModel.predict_verb and plan_calibrate.py.
+
+    Broadcast:
+    * ``tree`` — binomial tree (bcast_tree_rounds): ceil(log2 w) rounds,
+      each shipping the FULL payload along the critical path.
+    * ``scatter_ag`` — the bandwidth-optimal scatter-allgather
+      decomposition: the root's serial scatter leg ((w-1)/w of the
+      payload leaves the root once) plus a counter-rotating all-gather
+      PAIR (each ring carries half of the (w-1)/w·S gather volume
+      concurrently — the FlexLink move).
+
+    All-gather (``wire_bytes`` = one member's CONTRIBUTED wire bytes):
+    * ``ring`` — w-1 write-once hops, each member forwarding its slot.
+    * ``bidir`` — the counter-rotating pair: half the serial volume,
+      two launches.
+    """
+    w = world
+    b = float(wire_bytes)
+    if verb == "all_reduce":
+        return cost_features(algo, w, b, worlds=worlds)
+    import math
+
+    if verb == "broadcast":
+        if algo == "tree":
+            r = math.ceil(math.log2(max(w, 2)))
+            return float(r), float(r) * b, 1
+        if algo == "scatter_ag":
+            return 2.0 * (w - 1), 1.5 * (w - 1) / w * b, 2
+        if algo == "xla":
+            return 1.0, b, 1
+        raise ValueError(f"unknown broadcast algo {algo!r}")
+    if verb == "all_gather":
+        if algo in ("ring", "pallas"):
+            return float(w - 1), float(w - 1) * b, 1
+        if algo == "bidir":
+            return float(w - 1), (w - 1) * b / 2.0, 2
+        if algo == "xla":
+            return 1.0, float(w - 1) * b, 1
+        raise ValueError(f"unknown all_gather algo {algo!r}")
+    raise ValueError(f"unknown plan verb {verb!r}")
+
+
 @dataclasses.dataclass(frozen=True)
 class Plan:
     """One planner decision: what will carry the collective and why."""
@@ -738,6 +819,11 @@ class Plan:
     wire_bytes: int
     predicted_us: float
     outcome: str  # "model" | "forced" | "explicit"
+    # which collective verb the decision is for. Allreduce decisions keep
+    # their PR-7 label set on collective_plan_total (no verb label — the
+    # pinned back-compat series); broadcast/all_gather decisions add a
+    # verb= label so the fleet can be audited per verb (ISSUE 14).
+    verb: str = "all_reduce"
 
 
 class CollectivePlanner:
@@ -837,22 +923,121 @@ class CollectivePlanner:
 
     def plan_explicit(self, algo: str, payload_shape, dtype, world: int, *,
                       n_axes: int = 1, worlds=None, wire_dtype=None,
-                      emit: bool = True, outcome: str = "explicit") -> Plan:
+                      emit: bool = True, outcome: str = "explicit",
+                      verb: str = "all_reduce") -> Plan:
         """Record a caller-named algorithm as a plan (outcome "explicit",
         overridable when relaying a decision made elsewhere — e.g. the
         per-shard wrapper recording the algo it actually lowered under the
         original plan's outcome) with the model's predicted cost beside it
-        — how bench arms get a modeled time without mirroring the model."""
+        — how bench arms get a modeled time without mirroring the model.
+        ``verb`` extends the surface to broadcast/all_gather decisions
+        (priced via predict_verb, emitted with a verb= label)."""
         from uccl_tpu.ops import quant as _quant
 
         wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
         wire_bytes = self.wire_bytes(payload_shape, dtype, wire_dtype)
-        pred = self.model.predict(algo, world, wire_bytes, n_axes, worlds) \
-            if algo in ("xla", "ring", "hd", "torus", "pallas", "bidir",
-                        "hier") else 0.0
-        plan_ = Plan(algo, 2 if algo == "bidir" else 1, wire_dtype, world,
-                     wire_bytes, pred, outcome)
+        try:
+            pred = self.model.predict_verb(verb, algo, world, wire_bytes,
+                                           n_axes, worlds)
+        except ValueError:
+            pred = 0.0  # un-modeled algo: recorded, not priced
+        plan_ = Plan(algo, 2 if algo in ("bidir", "scatter_ag") else 1,
+                     wire_dtype, world, wire_bytes, pred, outcome, verb)
         return self._emit(plan_) if emit else plan_
+
+    # -- the broadcast / all_gather decisions (ISSUE 14) ---------------------
+
+    def plan_broadcast(self, payload_shape, dtype, world: int, *,
+                       n_axes: int = 1, worlds=None, wire_dtype=None,
+                       pallas_ok: bool = False, emit: bool = True) -> Plan:
+        """Pick the broadcast algorithm for a per-member payload:
+        ``xla`` (the lax ppermute scatter + ring all-gather lowering),
+        ``tree`` (binomial — alpha-dominated small payloads), or
+        ``scatter_ag`` (the pallas scatter-allgather kernel pair —
+        bandwidth range, quantizable wire). Selection is priced at WIRE
+        bytes (quantized payloads shift the crossovers AND the budget
+        probe, per the PR 7 rule); a winner that cannot carry a quantized
+        wire (xla/tree) is re-labeled and re-priced at full precision —
+        the caller counts the downgrade."""
+        from uccl_tpu.ops import quant as _quant
+
+        wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
+        m = self.model
+        wire_bytes = self.wire_bytes(payload_shape, dtype, wire_dtype)
+
+        def _final(algo: str, cost, outcome: str) -> Plan:
+            wd, wb, c = wire_dtype, wire_bytes, cost
+            if wd is not None and algo != "scatter_ag":
+                wd = None
+                wb = self.wire_bytes(payload_shape, dtype, None)
+                c = None
+            if c is None:
+                c = m.predict_verb("broadcast", algo, world, wb, n_axes,
+                                   worlds)
+            plan_ = Plan(algo, 2 if algo == "scatter_ag" else 1, wd, world,
+                         wb, c, outcome, "broadcast")
+            return self._emit(plan_) if emit else plan_
+
+        if world <= 1:
+            return _final("xla", 0.0, "model")
+        candidates = ["xla", "tree"]
+        if pallas_ok and n_axes == 1 and self._bcast_budget_ok(
+                payload_shape, dtype, wire_dtype, world):
+            candidates.append("scatter_ag")
+        best, best_cost = "xla", None
+        for algo in candidates:
+            cost = m.predict_verb("broadcast", algo, world, wire_bytes,
+                                  n_axes, worlds)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = algo, cost
+        return _final(best, best_cost, "model")
+
+    def plan_all_gather(self, payload_shape, dtype, world: int, *,
+                        n_axes: int = 1, worlds=None, wire_dtype=None,
+                        pallas_ok: bool = False, emit: bool = True) -> Plan:
+        """Pick the all-gather algorithm for one member's CONTRIBUTED
+        payload: ``xla`` (lax.all_gather), ``ring`` (the pallas write-once
+        ring kernel), or ``bidir`` (the counter-rotating pair — half the
+        serial volume). Same wire-byte pricing + quant re-label rule as
+        the other verbs; the kernel candidates are budget-probed quietly
+        so auto never plans a kernel whose first act is a counted
+        downgrade."""
+        from uccl_tpu.ops import quant as _quant
+
+        wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
+        m = self.model
+        wire_bytes = self.wire_bytes(payload_shape, dtype, wire_dtype)
+
+        def _final(algo: str, cost, outcome: str) -> Plan:
+            wd, wb, c = wire_dtype, wire_bytes, cost
+            if wd is not None and algo not in ("ring", "bidir"):
+                wd = None
+                wb = self.wire_bytes(payload_shape, dtype, None)
+                c = None
+            if c is None:
+                c = m.predict_verb("all_gather", algo, world, wb, n_axes,
+                                   worlds)
+            plan_ = Plan(algo, 2 if algo == "bidir" else 1, wd, world, wb,
+                         c, outcome, "all_gather")
+            return self._emit(plan_) if emit else plan_
+
+        if world <= 1:
+            return _final("xla", 0.0, "model")
+        candidates = ["xla"]
+        if pallas_ok and n_axes == 1:
+            if self._ag_budget_ok(payload_shape, dtype, wire_dtype, world,
+                                  pair=False):
+                candidates.append("ring")
+            if self._ag_budget_ok(payload_shape, dtype, wire_dtype, world,
+                                  pair=True):
+                candidates.append("bidir")
+        best, best_cost = "xla", None
+        for algo in candidates:
+            cost = m.predict_verb("all_gather", algo, world, wire_bytes,
+                                  n_axes, worlds)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = algo, cost
+        return _final(best, best_cost, "model")
 
     def _bidir_budget_ok(self, payload_shape, dtype, wire_dtype,
                          world: int) -> bool:
@@ -870,6 +1055,42 @@ class CollectivePlanner:
         itemsize = jnp.dtype(dtype).itemsize
         interpret = _dma.resolve_interpret(None)
         charge = _pccl.bidir_pair_charge(elems, itemsize, world, wire_dtype,
+                                         interpret)
+        return charge <= _dma.budget_limit(interpret)
+
+    @staticmethod
+    def _payload_elems(payload_shape) -> int:
+        elems = 1
+        for s in payload_shape:
+            elems *= int(s)
+        return elems
+
+    def _ag_budget_ok(self, payload_shape, dtype, wire_dtype, world: int,
+                      *, pair: bool) -> bool:
+        """Quiet probe of the all-gather kernel budget — charges EXACTLY
+        what the ring/pair gate charges (pallas_ccl.ag_charge /
+        ag_pair_charge, one shared arithmetic), counts nothing."""
+        from uccl_tpu.collective import dma as _dma
+        from uccl_tpu.collective import pallas_ccl as _pccl
+
+        elems = self._payload_elems(payload_shape)
+        itemsize = jnp.dtype(dtype).itemsize
+        interpret = _dma.resolve_interpret(None)
+        fn = _pccl.ag_pair_charge if pair else _pccl.ag_charge
+        charge = fn(elems, itemsize, world, wire_dtype, interpret)
+        return charge <= _dma.budget_limit(interpret)
+
+    def _bcast_budget_ok(self, payload_shape, dtype, wire_dtype,
+                         world: int) -> bool:
+        """Quiet probe of the scatter-allgather broadcast kernel budget
+        (pallas_ccl.bcast_pair_charge — the gate's own arithmetic)."""
+        from uccl_tpu.collective import dma as _dma
+        from uccl_tpu.collective import pallas_ccl as _pccl
+
+        elems = self._payload_elems(payload_shape)
+        itemsize = jnp.dtype(dtype).itemsize
+        interpret = _dma.resolve_interpret(None)
+        charge = _pccl.bcast_pair_charge(elems, itemsize, world, wire_dtype,
                                          interpret)
         return charge <= _dma.budget_limit(interpret)
 
@@ -907,17 +1128,20 @@ class CollectivePlanner:
     # -- emission -------------------------------------------------------------
 
     def _emit(self, plan_: Plan) -> Plan:
+        # allreduce keeps the PR-7 label set (benches/tests pin those exact
+        # series keys); the new verbs carry an explicit verb= label
+        extra = {} if plan_.verb == "all_reduce" else {"verb": plan_.verb}
         PLAN_TOTAL.inc(algo=plan_.algo, chunks=plan_.chunks,
                        wire_dtype=plan_.wire_dtype or "none",
-                       outcome=plan_.outcome)
+                       outcome=plan_.outcome, **extra)
         PLAN_PREDICTED.set(plan_.predicted_us, algo=plan_.algo,
                            chunks=plan_.chunks,
-                           wire_dtype=plan_.wire_dtype or "none")
+                           wire_dtype=plan_.wire_dtype or "none", **extra)
         _obstr.instant(
             "collective_plan", track="wire", algo=plan_.algo,
             chunks=plan_.chunks, wire_dtype=plan_.wire_dtype or "none",
             outcome=plan_.outcome, world=plan_.world,
-            wire_bytes=plan_.wire_bytes,
+            wire_bytes=plan_.wire_bytes, verb=plan_.verb,
             predicted_us=round(plan_.predicted_us, 2),
         )
         return plan_

@@ -247,3 +247,39 @@ class ElasticKVCache:
         )
         ekv.append_tokens(cache.k[:, :, :length], cache.v[:, :, :length])
         return ekv
+
+
+def admit_warm_spare(buf: ElasticBuffer, weights, *, prefix: str = "",
+                     pin: bool = False) -> int:
+    """Warm-spare admission: import a model's weights into an elastic
+    store — the spin-up path of an elastic resize (a spare joining the
+    fleet mid-run stages its params here before taking traffic).
+
+    ``weights`` is a fetched weight-push snapshot
+    (:class:`uccl_tpu.p2p.weight_push.WeightSnapshot` — the versioned
+    fleet distribution path, whose wire bytes were already counted at
+    fetch time) or a plain ``{name: array}`` mapping / param pytree. A
+    raw tree is the legacy local-copy path: its bytes land on
+    ``p2p_bytes_total{verb="weight_push"}`` here so a spare admitted off
+    an untracked host copy is visible on the SAME fleet byte series as a
+    wire-fetched one — never silent. Returns the bytes imported; entries
+    are named ``prefix + dotted-path``."""
+    from uccl_tpu import obs
+    from uccl_tpu.p2p import weight_push as _wp
+
+    if isinstance(weights, _wp.WeightSnapshot):
+        pairs = list(weights.flat().items())
+        version = weights.version
+    else:
+        pairs = [(k, np.asarray(v))
+                 for k, v in _wp.flatten_tree(weights)]
+        version = None
+        obs.counter("p2p_bytes_total").inc(
+            sum(int(a.nbytes) for _, a in pairs), verb="weight_push")
+    total = 0
+    for key, arr in pairs:
+        buf.put(prefix + key, jnp.asarray(arr), pin=pin)
+        total += int(arr.nbytes)
+    obs.instant("warm_spare_admit", track="wire", entries=len(pairs),
+                bytes=total, version=version)
+    return total

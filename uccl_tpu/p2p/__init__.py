@@ -10,6 +10,9 @@ from uccl_tpu.p2p.ray_api import XferEndpoint
 from uccl_tpu.p2p.channel import Channel, FifoItem
 from uccl_tpu.p2p.eqds import PullPacer
 from uccl_tpu.p2p.sack import PathQuality, SackTxWindow
+from uccl_tpu.p2p.weight_push import (WeightPublisher, WeightSnapshot,
+                                      fetch as fetch_weights)
 
 __all__ = ["Endpoint", "FIFO_ITEM_BYTES", "Channel", "FifoItem", "PullPacer",
-           "PathQuality", "SackTxWindow", "XferEndpoint"]
+           "PathQuality", "SackTxWindow", "XferEndpoint", "WeightPublisher",
+           "WeightSnapshot", "fetch_weights"]

@@ -304,15 +304,27 @@ class MoEBackend:
         self.cache = self.cache.copy_prefix(dst, src, n)
 
 
-def replicate_backend(backend, n: int) -> List:
+def replicate_backend(backend, n: int, weights=None) -> List:
     """``n`` replica backends from one prototype — THE sharing rule for a
     replica set (serve.py and serving_bench both build through here, so
     it can't drift): every replica owns its KV pool, but dense replicas
     share the prototype's compiled-program cache (the jitted fns are pure
     in params/cache) and MoE replicas share its server (and therefore its
-    compiled programs) — N replicas cost one warmup."""
+    compiled programs) — N replicas cost one warmup.
+
+    ``weights``: a fetched weight-push snapshot
+    (:class:`uccl_tpu.p2p.weight_push.WeightSnapshot`) or a param pytree
+    — every replica INCLUDING the prototype serves these params instead
+    of the prototype's in-memory ones. This is the fleet spin-up path:
+    replicas import the published version off the p2p wire (its bytes
+    already counted on ``p2p_bytes_total{verb="weight_push"}``) rather
+    than cloning untracked host references. The tree structure must
+    match the prototype's params (same leaf paths/shapes) — mismatches
+    fail loudly before any replica serves a stale mix."""
     if n < 1:
         raise ValueError(f"need n >= 1 replicas, got {n}")
+    if weights is not None:
+        backend = _reweight_backend(backend, weights)
     out = [backend]
     for _ in range(1, n):
         if isinstance(backend, MoEBackend):
@@ -327,6 +339,41 @@ def replicate_backend(backend, n: int) -> List:
                 max_seq=backend.max_seq, fns=backend._fns,
             ))
     return out
+
+
+def _reweight_backend(backend, weights):
+    """A same-shape backend serving ``weights`` (a WeightSnapshot or a
+    param pytree) — compiled-fn caches are reused (the jitted programs
+    are pure in params), so swapping a pushed version in costs zero new
+    compiles."""
+    import jax
+    import numpy as np
+
+    tree = weights.tree() if hasattr(weights, "tree") else weights
+    want, want_def = jax.tree_util.tree_flatten(backend.params)
+    got, got_def = jax.tree_util.tree_flatten(tree)
+    if want_def != got_def or len(want) != len(got):
+        raise ValueError(
+            f"pushed weight tree does not match the prototype's params "
+            f"(treedef {got_def} vs {want_def})"
+        )
+    for w, g in zip(want, got):
+        if tuple(np.shape(w)) != tuple(np.shape(g)):
+            raise ValueError(
+                f"pushed weight leaf shape {np.shape(g)} != prototype "
+                f"{np.shape(w)}"
+            )
+    params = jax.tree_util.tree_map(
+        lambda w, g: jax.numpy.asarray(g, dtype=w.dtype), backend.params,
+        tree,
+    )
+    if isinstance(backend, MoEBackend):
+        return MoEBackend(backend.server, params,
+                          batch_local=backend.b_loc,
+                          max_seq=backend.max_seq,
+                          decode_impl=backend.decode_impl)
+    return DenseBackend(params, backend.cfg, n_slots=backend.n_slots,
+                        max_seq=backend.max_seq, fns=backend._fns)
 
 
 class ServingEngine:

@@ -66,6 +66,29 @@ def factor_2d(n: int) -> Tuple[int, int]:
     return best
 
 
+def bcast_tree_rounds(n: int, root: int = 0) -> List[List[Tuple[int, int]]]:
+    """Binomial-tree broadcast schedule: per round, the (src, dst) member
+    pairs (absolute indices on a ring of ``n`` rooted at ``root``). Round t
+    doubles the holder set — members at virtual rank < 2^t forward to
+    virtual rank + 2^t — so the whole tree is ceil(log2 n) rounds and every
+    member sends at most log2(n) copies.
+
+    THE one tree-edge arithmetic: the lax lowering
+    (``collective.plan.tree_broadcast``), the host-side DCN broadcast
+    (``collective.hierarchical.DcnGroup.broadcast``) and the planner's
+    tree cost features all derive their schedule from this list, so the
+    three surfaces cannot drift."""
+    rounds: List[List[Tuple[int, int]]] = []
+    mask = 1
+    while mask < n:
+        rounds.append(
+            [((v + root) % n, (v + mask + root) % n)
+             for v in range(mask) if v + mask < n]
+        )
+        mask <<= 1
+    return rounds
+
+
 def recursive_halving_peers(rank: int, n: int) -> List[int]:
     """Peer schedule for recursive-halving/doubling collectives (n power of two)."""
     if n & (n - 1):

@@ -1,0 +1,493 @@
+"""Chaos harness: kill replicas mid-run, drop control notifs, prove recovery.
+
+The fault-tolerance acceptance bench (docs/SERVING.md): every resilience
+claim the serving fleet makes is asserted here against REAL failures, with
+the evidence landing on real counters —
+
+* **router arm** — N dense replicas behind a health-enabled ``Router``
+  under a Poisson stream. One replica is ``kill()``ed mid-run at a chosen
+  point (``--kill-at prefill`` waits until the victim holds BOTH a
+  mid-prefill and a mid-decode request; ``decode`` waits for decode-only
+  work), the failure detector walks it HEALTHY→SUSPECT→DEAD, and router
+  recovery resubmits/restarts its requests on the survivor. Asserted:
+  every completed request **bit-exact** vs the one-shot ``generate``
+  oracle, each accepted trace_id completes at most once (exactly-once),
+  the extended conservation invariant ``submitted == completed + active +
+  queued + rejected + expired + lost`` across the fleet, ``leaked() == 0``
+  on all survivors, ``serving_recovered_total`` deltas equal to the
+  evacuated request count, and a **bounded goodput dip** vs an unfaulted
+  twin run of the same workload (reported, gated by
+  ``--min-goodput-frac``).
+
+* **disagg arm** — an in-process prefill/decode pair over the windowed
+  SACK channel transport with BOTH fault planes injected: the native
+  data-plane injector (``Endpoint.set_drop_rate`` — KV slab frames,
+  recovered by PR 13 selective repeat) and the control-plane injector
+  (``disagg.set_ctrl_drop`` — BEGIN/GRANT/FINAL/ack notifs; the native
+  injector deliberately never faults notifs, so control loss is injected
+  at the send site with a seeded RNG). The retried, rid-idempotent
+  control plane must converge: every request completes **bit-exact**
+  under loss, retries counted on ``disagg_ctrl_retries_total``. Then the
+  **post-GRANT kill**: a request's prefill worker dies after GRANT and
+  before FINAL — the decode side's lease expires, the reserved slot is
+  reclaimed (``disagg_leases_expired_total``), and the decode pool ends
+  with ``leaked() == 0``.
+
+``--smoke`` runs both arms at CI sizes (1 killed replica out of 2, 5%
+control drop) and the combined fleet conservation snapshot is dumped via
+``--metrics-out`` for ``scripts/check_obs.py --chaos`` to audit. Each arm
+also emits one JSON line (``--json-out``) labeled off counter deltas.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from _bootstrap import init_devices
+
+
+def _counters(*specs):
+    """Cumulative counter reads: labels=None sums the whole family."""
+    from uccl_tpu import obs
+
+    return [obs.counter(name).total() if labels is None
+            else obs.counter(name).get(**labels)
+            for name, labels in specs]
+
+
+_ROUTER_COUNTERS = (
+    ("serving_recovered_total", {"outcome": "resubmitted"}),
+    ("serving_recovered_total", {"outcome": "restarted"}),
+    ("serving_recovered_total", {"outcome": "lost"}),
+    ("fleet_heartbeats_total", None),
+)
+_DISAGG_COUNTERS = (
+    ("disagg_ctrl_retries_total", {"msg": "begin"}),
+    ("disagg_ctrl_retries_total", {"msg": "grant"}),
+    ("disagg_ctrl_retries_total", {"msg": "final"}),
+    ("disagg_ctrl_dropped_total", None),
+)
+
+
+def _make_dense(args, jax, n_slots, max_seq, n):
+    from uccl_tpu.models.dense import DenseConfig, init_params
+    from uccl_tpu.serving.engine import DenseBackend, replicate_backend
+
+    cfg = DenseConfig(
+        vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+        n_heads=4, n_kv_heads=2, head_dim=args.dim // 4,
+        ffn=args.dim * 2,
+    )
+    params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    proto = DenseBackend(params, cfg, n_slots=n_slots, max_seq=max_seq)
+    return replicate_backend(proto, n), params, cfg
+
+
+def _oracle_fn(params, cfg, max_seq):
+    import jax.numpy as jnp
+
+    from uccl_tpu.models.inference import generate
+
+    def oracle(req):
+        toks = generate(params, jnp.asarray(req.prompt)[None], cfg,
+                        max_new_tokens=req.max_new_tokens,
+                        max_seq=max_seq)
+        return np.asarray(toks)[0, :req.n_generated]
+
+    return oracle
+
+
+def _check_oracle(reqs, oracle) -> int:
+    """Every FINISHED request's tokens vs the unfaulted one-shot oracle;
+    returns the number checked, raises on the first mismatch."""
+    checked = 0
+    for r in reqs:
+        want = oracle(r)
+        got = np.asarray(r.out_tokens, np.int32)
+        if got.shape != want.shape or not np.array_equal(got, want):
+            raise SystemExit(
+                f"ORACLE MISMATCH rid={r.rid} trace={r.trace_id}: "
+                f"got {got.tolist()} want {want.tolist()}"
+            )
+        checked += 1
+    return checked
+
+
+def _drive_with_kill(router, engines, victim, prompts, arrivals,
+                     new_tokens, kill_at, timeout_s=300.0):
+    """The faulted drive loop: submit per arrivals, step the router, and
+    kill the victim engine once the trigger condition holds (victim has
+    mid-prefill + mid-decode work for ``prefill``, decode-only for
+    ``decode``; ``off`` never kills — the baseline twin). Returns
+    (accepted, finished, wall_s, t_killed)."""
+    from uccl_tpu.serving.request import now
+
+    accepted, finished = [], []
+    killed_t = None
+    i, n = 0, len(prompts)
+    t0 = now()
+    deadline = time.monotonic() + timeout_s
+    while i < n or router.has_work():
+        t = now() - t0
+        while i < n and arrivals[i] <= t:
+            r = router.submit(prompts[i], max_new_tokens=new_tokens)
+            if r is not None:
+                accepted.append(r)
+            i += 1
+        if router.has_work():
+            finished.extend(router.step())
+        if kill_at != "off" and killed_t is None:
+            eng = engines[victim]
+            decoding = (len(eng._by_slot) - len(eng._prefilling)) > 0
+            trigger = ((kill_at == "prefill" and eng._prefilling)
+                       or (kill_at == "decode" and decoding)
+                       # stream fully offered and never triggered: kill
+                       # while the victim still holds ANY work so the
+                       # arm always tests recovery
+                       or (i >= n and eng.has_work()))
+            if trigger and not eng.dead:
+                eng.kill()
+                killed_t = now() - t0
+        if time.monotonic() > deadline:
+            raise SystemExit(
+                f"chaos drive stalled: {len(finished)}/{len(accepted)} "
+                f"finished, recoveries={router.recoveries}"
+            )
+    return accepted, finished, now() - t0, killed_t
+
+
+def run_router_arm(args, jax, kill_at):
+    from uccl_tpu import obs
+    from uccl_tpu.serving import Router, ServingEngine
+    from uccl_tpu.serving.loadgen import synth_workload, warm_replicas
+
+    max_seq = args.prompt_len + args.new_tokens
+    rng = np.random.default_rng(args.seed)
+    prompts, lens, arrivals = synth_workload(
+        rng, args.requests, args.prompt_len, args.vocab, args.rate
+    )
+
+    def build():
+        backends, params, cfg = _make_dense(
+            args, jax, args.slots, max_seq, args.replicas
+        )
+        engines = [ServingEngine(b, prefill_chunk=args.prefill_chunk,
+                                 max_queue=args.max_queue)
+                   for b in backends]
+        router = Router(engines)
+        router.enable_health(suspect_after_s=args.suspect_s,
+                             dead_after_s=args.dead_s)
+        warm_replicas(router, lens, max_seq, args.new_tokens)
+        return router, engines, params, cfg
+
+    # unfaulted twin first: same workload, same replica count — the
+    # goodput baseline the dip is measured against
+    router0, engines0, params, cfg = build()
+    acc0, fin0, wall0, _ = _drive_with_kill(
+        router0, engines0, 0, prompts, arrivals, args.new_tokens, "off"
+    )
+    snap0 = router0.snapshot()
+    base_goodput = snap0.get("goodput_tok_s", 0.0)
+    router0.close()
+
+    c0 = _counters(*_ROUTER_COUNTERS)
+    router, engines, params, cfg = build()
+    victim = 0
+    acc, fin, wall, killed_t = _drive_with_kill(
+        router, engines, victim, prompts, arrivals, args.new_tokens,
+        kill_at,
+    )
+    snap = router.snapshot()
+    deltas = dict(zip(("resubmitted", "restarted", "lost", "heartbeats"),
+                      (a - b for a, b in
+                       zip(_counters(*_ROUTER_COUNTERS), c0))))
+
+    # -- the chaos assertions (each a named SystemExit on violation) ----
+    oracle = _oracle_fn(params, cfg, max_seq)
+    checked = _check_oracle(fin, oracle)
+    lost_traces = {r["trace_id"] for r in router.recoveries
+                   if r["outcome"] == "lost"}
+    done_traces = [r.trace_id for r in fin]
+    if len(done_traces) != len(set(done_traces)):
+        raise SystemExit("EXACTLY-ONCE VIOLATED: a trace_id completed "
+                         "more than once across the fleet")
+    want_traces = {r.trace_id for r in acc}
+    if set(done_traces) | lost_traces != want_traces:
+        raise SystemExit(
+            f"CONSERVATION VIOLATED: accepted {len(want_traces)} traces, "
+            f"completed {len(set(done_traces))} + lost "
+            f"{len(lost_traces)} do not cover them"
+        )
+    if snap["submitted"] != (snap["completed"] + snap["active"]
+                             + snap["queued"] + snap["rejected"]
+                             + snap["expired"] + snap["lost"]):
+        raise SystemExit(f"INVARIANT VIOLATED: {snap}")
+    if router.leaked() != 0:
+        raise SystemExit(f"LEAKED SLOTS: {router.leaked()}")
+    n_rec = deltas["resubmitted"] + deltas["restarted"] + deltas["lost"]
+    if len(router.recoveries) != n_rec:
+        raise SystemExit(
+            f"recovery log ({len(router.recoveries)}) != counter delta "
+            f"({n_rec}) — recoveries are not counter-audited"
+        )
+    if kill_at != "off" and n_rec < 1:
+        raise SystemExit("kill arm recovered nothing — the chaos never "
+                         "bit")
+    goodput = snap.get("goodput_tok_s", 0.0)
+    frac = (goodput / base_goodput) if base_goodput else 1.0
+    # bounded dip: the faulted run may pay (a) the configured detection
+    # window (suspect grace + dead threshold — dead work sits still
+    # until the detector fires) plus (b) re-running recovered work on
+    # the surviving capacity (≤ dip-wall-factor × the unfaulted wall)
+    # plus scheduling slack. Anything beyond that budget is an
+    # UNEXPLAINED stall — a wedged retry loop, not a bounded dip.
+    budget = (wall0 * args.dip_wall_factor + args.dead_s
+              + args.dip_slack_s)
+    if wall > budget:
+        raise SystemExit(
+            f"GOODPUT DIP UNBOUNDED: faulted wall {wall:.3f}s exceeds "
+            f"the explained budget {budget:.3f}s (= unfaulted "
+            f"{wall0:.3f}s x {args.dip_wall_factor} + detection "
+            f"{args.dead_s}s + slack {args.dip_slack_s}s); goodput "
+            f"{goodput:.1f} vs {base_goodput:.1f} tok/s"
+        )
+    arm = {
+        "bench": "chaos_router", "kill_at": kill_at,
+        "replicas": args.replicas, "requests": args.requests,
+        "accepted": len(acc), "completed": len(fin),
+        "oracle_checked": checked, "oracle_exact": True,
+        "killed_at_s": round(killed_t, 3) if killed_t else None,
+        "recovered": deltas, "lost": snap["lost"],
+        "leaked": router.leaked(),
+        "goodput_tok_s": goodput, "goodput_unfaulted_tok_s": base_goodput,
+        "goodput_frac": round(frac, 3),
+        "wall_s": round(wall, 3), "wall_unfaulted_s": round(wall0, 3),
+        "conservation_ok": True,
+    }
+    metrics = [m for m in ([e.metrics for e in router.engines])]
+    router.close()
+    obs.gauge("serving_leaked_slots",
+              "live-occupied slots left after a chaos arm drained "
+              "(must be 0)").set(0 if router.leaked() == 0 else
+                                 router.leaked(), component="router")
+    print(json.dumps(arm), flush=True)
+    return arm, metrics
+
+
+def run_disagg_arm(args, jax):
+    from uccl_tpu import obs
+    from uccl_tpu.serving import FailureDetector, ServingEngine
+    from uccl_tpu.serving import health as health_mod
+    from uccl_tpu.serving.disagg import (
+        make_local_pair, set_ctrl_drop, warm_pair,
+    )
+    from uccl_tpu.serving.loadgen import synth_workload
+
+    max_seq = args.prompt_len + args.new_tokens
+    backends, params, cfg = _make_dense(args, jax, args.slots, max_seq, 2)
+    pe = ServingEngine(backends[0], prefill_chunk=args.prefill_chunk)
+    de = ServingEngine(backends[1])
+    detector = FailureDetector(suspect_after_s=args.suspect_s,
+                               dead_after_s=args.dead_s)
+    pw, dw = make_local_pair(
+        pe, de, transport="channel",
+        grant_lease_s=args.lease_s, detector=detector,
+        heartbeat_s=args.suspect_s / 4, ctrl_retry_s=args.ctrl_retry_s,
+    )
+    try:
+        warm_pair(pw, dw, args.prompt_len, args.new_tokens)
+        rng = np.random.default_rng(args.seed + 1)
+        prompts, lens, arrivals = synth_workload(
+            rng, args.requests, args.prompt_len, args.vocab, args.rate
+        )
+        c0 = _counters(*_DISAGG_COUNTERS)
+        # both fault planes on: native data-plane drop (KV slab frames,
+        # recovered by the SACK window) + control-notif drop (recovered
+        # by the idempotent retry plane)
+        pw.ep.set_drop_rate(args.data_drop)
+        set_ctrl_drop(args.ctrl_drop, seed=args.seed)
+        finished = []
+        i, accepted = 0, 0
+        t_start = time.monotonic()
+        deadline = t_start + 600.0
+        while i < len(prompts) or not pw.idle() \
+                or len(finished) < accepted:
+            t = time.monotonic() - t_start
+            while i < len(prompts) and arrivals[i] <= t:
+                if pw.submit(prompts[i],
+                             max_new_tokens=args.new_tokens) is not None:
+                    accepted += 1
+                i += 1
+            pw.step()
+            finished.extend(dw.step())
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    f"disagg chaos stalled: {len(finished)}/{accepted}, "
+                    f"outstanding={pw.outstanding()}"
+                )
+        set_ctrl_drop(0.0)
+        pw.ep.set_drop_rate(0.0)
+        oracle = _oracle_fn(params, cfg, max_seq)
+        checked = _check_oracle(finished, oracle)
+
+        # -- post-GRANT kill: lease reclaims the reserved decode slot --
+        # (reason may be "timeout" or "peer_dead": the detector's missed
+        # heartbeats can win the race against the lease clock — either
+        # way the slot comes back, so the audit sums the family)
+        lease0 = obs.counter("disagg_leases_expired_total").total()
+        doomed = pw.submit(np.asarray(prompts[0], np.int32),
+                           max_new_tokens=args.new_tokens)
+        grant_deadline = time.monotonic() + 30.0
+        while not dw._granted:
+            pw.pump()  # BEGIN out, GRANT back — the engine never steps
+            dw.poll()
+            if time.monotonic() > grant_deadline:
+                raise SystemExit("post-GRANT arm never saw the GRANT")
+        # the prefill process "dies": its engine is killed, its stranded
+        # requests counted lost, and it never pumps again — no FINAL
+        # will ever arrive for the granted stream
+        pe.kill()
+        health_mod.abandon_engine(pe)
+        reclaim_deadline = time.monotonic() + 30.0
+        while dw._granted:
+            dw.poll()
+            time.sleep(0.005)
+            if time.monotonic() > reclaim_deadline:
+                raise SystemExit(
+                    f"LEASE NEVER EXPIRED: granted={sorted(dw._granted)}"
+                )
+        expired = obs.counter("disagg_leases_expired_total").total() \
+            - lease0
+        if expired < 1:
+            raise SystemExit("post-GRANT kill reclaimed no lease")
+        if dw.engine.pool.leaked() != 0:
+            raise SystemExit(
+                f"DECODE LEAKED {dw.engine.pool.leaked()} slot(s) after "
+                f"lease reclaim"
+            )
+        if dw.engine.pool.n_free != dw.engine.pool.n_slots:
+            raise SystemExit("reclaimed slot did not return to the pool")
+        deltas = dict(zip(
+            ("retry_begin", "retry_grant", "retry_final", "ctrl_dropped"),
+            (a - b for a, b in zip(_counters(*_DISAGG_COUNTERS), c0)),
+        ))
+        obs.gauge("serving_leaked_slots").set(
+            dw.engine.pool.leaked(), component="decode")
+        obs.gauge("serving_leaked_slots").set(
+            pe.pool.leaked(), component="prefill")
+        arm = {
+            "bench": "chaos_disagg", "requests": args.requests,
+            "ctrl_drop": args.ctrl_drop, "data_drop": args.data_drop,
+            "completed": len(finished), "oracle_checked": checked,
+            "oracle_exact": True, "leases_expired": int(expired),
+            "decode_leaked": dw.engine.pool.leaked(),
+            "conservation_ok": True, "recovered": deltas,
+        }
+        print(json.dumps(arm), flush=True)
+        _ = doomed
+        return arm, [pe.metrics, de.metrics]
+    finally:
+        set_ctrl_drop(0.0)
+        try:
+            dw.close()
+        except Exception:
+            pass
+        pw.ep.close()
+        dw.ep.close()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--arm", default="router,disagg",
+                    help="comma list: router,disagg")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI sizes: 2 replicas, 1 killed, 5%% ctrl drop")
+    ap.add_argument("--devices", type=int, default=2)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--rate", type=float, default=50.0)
+    ap.add_argument("--prompt-len", type=int, default=12)
+    ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--prefill-chunk", type=int, default=4)
+    ap.add_argument("--max-queue", type=int, default=None)
+    ap.add_argument("--kill-at", default="prefill",
+                    help="router-arm kill trigger: prefill|decode|off")
+    ap.add_argument("--suspect-s", type=float, default=0.08,
+                    help="detector suspect window (seconds)")
+    ap.add_argument("--dead-s", type=float, default=0.25,
+                    help="detector dead window (seconds)")
+    ap.add_argument("--lease-s", type=float, default=1.0,
+                    help="decode-side GRANT lease (seconds)")
+    ap.add_argument("--ctrl-retry-s", type=float, default=0.1)
+    ap.add_argument("--ctrl-drop", type=float, default=0.05,
+                    help="control-notif drop rate (Python injector)")
+    ap.add_argument("--data-drop", type=float, default=0.05,
+                    help="native data-plane frame drop rate")
+    ap.add_argument("--dip-wall-factor", type=float, default=3.0,
+                    help="bounded-dip gate: recovered work may cost up "
+                    "to this many unfaulted walls of recompute on the "
+                    "surviving capacity")
+    ap.add_argument("--dip-slack-s", type=float, default=1.0,
+                    help="bounded-dip gate: fixed scheduling slack on "
+                    "top of the detection window")
+    ap.add_argument("--json-out", default="")
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    from uccl_tpu import obs
+
+    obs.add_cli_args(ap)
+    args = ap.parse_args()
+    obs.setup_from_args(args)
+    if args.smoke:
+        args.replicas, args.requests = 2, 10
+        args.ctrl_drop = 0.05
+        # burst arrivals: the whole stream is queued when the kill
+        # fires, so recovery always has both in-slot work to restart
+        # AND queued work to resubmit (deterministic chaos bite)
+        args.rate = 0.0
+    jax = init_devices(args.devices)
+
+    arms, fleet_metrics = [], []
+    for arm_name in [a.strip() for a in args.arm.split(",") if a.strip()]:
+        if arm_name == "router":
+            arm, ms = run_router_arm(args, jax, args.kill_at)
+        elif arm_name == "disagg":
+            arm, ms = run_disagg_arm(args, jax)
+        else:
+            raise SystemExit(f"unknown arm {arm_name!r}")
+        arms.append(arm)
+        fleet_metrics.extend(ms)
+
+    # the FLEET conservation snapshot: every engine the chaos touched
+    # (survivors, victims, both disagg roles) merged — check_obs --chaos
+    # re-asserts the invariant straight off these exported lines
+    from uccl_tpu.serving.metrics import ServingMetrics
+
+    merged = ServingMetrics.merged(fleet_metrics)
+    snap = merged.snapshot()
+    if snap["submitted"] != (snap["completed"] + snap["active"]
+                             + snap["queued"] + snap["rejected"]
+                             + snap["expired"] + snap["lost"]):
+        raise SystemExit(f"FLEET INVARIANT VIOLATED: {snap}")
+    written = obs.dump_from_args(
+        args, extra_lines=ServingMetrics.prometheus_lines(snap)
+    )
+    for w in written:
+        print(f"chaos_bench: wrote {w}", flush=True)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            for arm in arms:
+                f.write(json.dumps(arm) + "\n")
+        print(f"chaos_bench: wrote {args.json_out}", flush=True)
+    print(f"chaos_bench: ALL OK ({len(arms)} arm(s))", flush=True)
+
+
+if __name__ == "__main__":
+    main()

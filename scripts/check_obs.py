@@ -85,6 +85,19 @@ carry nonzero ``collective_plan_total`` decisions for BOTH new verbs
 broadcast/all-gather coverage and the weight-push plane both
 demonstrably fired.
 
+``--chaos`` mode (the fault-tolerance smoke arm,
+benchmarks/chaos_bench.py --smoke --metrics-out [--json-out]): the
+metrics must prove the chaos really bit AND the fleet really recovered —
+≥1 recovered request on ``serving_recovered_total`` with a nonzero
+resubmitted/restarted split (not everything lost), the EXTENDED
+conservation invariant ``submitted == completed + active + queued +
+rejected + expired + lost`` re-asserted from the exported
+``uccl_serving_*`` fleet lines, ≥1 reclaimed GRANT lease on
+``disagg_leases_expired_total``, and every ``serving_leaked_slots``
+component gauge exactly 0 (survivors AND the decode pool's reclaimed
+slots). With a bench JSON, every arm must be ``oracle_exact`` with a
+counter-delta ``recovered`` label block.
+
 ``--router`` mode (the replica-router smoke arm, serve --server
 --replicas N --priority-classes ... --metrics-out): the metrics file
 must carry ≥2 replica-labeled ``serving_router_requests_total`` series
@@ -400,6 +413,75 @@ def check_weights_metrics(push_path: str, plan_path: str) -> None:
           f"present for both new verbs")
 
 
+def check_chaos_metrics(path: str, bench_json: str = "") -> None:
+    with open(path) as f:
+        lines = f.read().splitlines()
+
+    recovered = {}
+    for ln in lines:
+        if ln.startswith("serving_recovered_total{"):
+            label = ln[ln.index("{") + 1:ln.index("}")]
+            outcome = label.split('outcome="', 1)[1].split('"', 1)[0]
+            recovered[outcome] = float(ln.rsplit(" ", 1)[1])
+    placed = recovered.get("resubmitted", 0) + recovered.get(
+        "restarted", 0)
+    if placed < 1:
+        fail(f"{path}: no resubmitted/restarted recovery on "
+             f"serving_recovered_total (have {recovered}) — the killed "
+             f"replica's requests never reached a survivor")
+    unknown = set(recovered) - {"resubmitted", "restarted", "lost"}
+    if unknown:
+        fail(f"{path}: unexpected recovery outcomes {sorted(unknown)}")
+
+    # the EXTENDED conservation invariant, re-asserted from the exported
+    # fleet lines (not trusted from the bench's own in-process check)
+    terms = {}
+    for term in ("submitted", "completed", "active", "queued",
+                 "rejected", "expired", "lost"):
+        terms[term] = _prom_total(lines, f"uccl_serving_{term} ", path)
+    rhs = sum(v for k, v in terms.items() if k != "submitted")
+    if terms["submitted"] != rhs:
+        fail(f"{path}: conservation violated — submitted "
+             f"{terms['submitted']} != completed+active+queued+rejected"
+             f"+expired+lost = {rhs} ({terms})")
+    if terms["lost"] < 1:
+        fail(f"{path}: zero lost requests — the kill arms never "
+             f"exercised the recovery sink term")
+
+    if _prom_total(lines, "disagg_leases_expired_total", path) < 1:
+        fail(f"{path}: no reclaimed GRANT lease — the post-GRANT kill "
+             f"never exercised lease expiry")
+
+    leaked = [ln for ln in lines
+              if ln.startswith("serving_leaked_slots{")]
+    if not leaked:
+        fail(f"{path}: no serving_leaked_slots component gauges")
+    bad = [ln for ln in leaked if float(ln.rsplit(" ", 1)[1]) != 0]
+    if bad:
+        fail(f"{path}: leaked slots after chaos: {bad}")
+
+    arms = 0
+    if bench_json:
+        with open(bench_json) as f:
+            for ln in f.read().splitlines():
+                if not ln.strip():
+                    continue
+                arm = json.loads(ln)
+                if arm.get("oracle_exact") is not True:
+                    fail(f"{bench_json}: arm {arm.get('bench')} is not "
+                         f"oracle_exact — a recovered output diverged")
+                if "recovered" not in arm:
+                    fail(f"{bench_json}: arm {arm.get('bench')} carries "
+                         f"no counter-delta recovered labels")
+                arms += 1
+        if not arms:
+            fail(f"{bench_json}: no chaos arms recorded")
+    print(f"check_obs: chaos metrics OK — {int(placed)} recovered "
+          f"request(s) placed on survivors, {int(terms['lost'])} lost, "
+          f"conservation holds, leases reclaimed, zero leaked slots"
+          + (f", {arms} oracle-exact arm(s)" if bench_json else ""))
+
+
 def check_router_metrics(path: str) -> None:
     with open(path) as f:
         lines = f.read().splitlines()
@@ -637,6 +719,10 @@ def main(argv) -> None:
         check_fleet_metrics(argv[3])
         print("check_obs: ALL OK")
         return
+    if len(argv) in (3, 4) and argv[1] == "--chaos":
+        check_chaos_metrics(argv[2], argv[3] if len(argv) == 4 else "")
+        print("check_obs: ALL OK")
+        return
     if len(argv) == 3 and argv[1] == "--router":
         check_router_metrics(argv[2])
         print("check_obs: ALL OK")
@@ -671,6 +757,7 @@ def main(argv) -> None:
              "check_obs.py --plan METRICS_PROM BENCH_JSON | "
              "check_obs.py --weights PUSH_PROM PLAN_PROM | "
              "check_obs.py --disagg METRICS_PROM | "
+             "check_obs.py --chaos METRICS_PROM [BENCH_JSON] | "
              "check_obs.py --transport METRICS_PROM [BENCH_JSON] | "
              "check_obs.py --spec METRICS_PROM | "
              "check_obs.py --router METRICS_PROM | "

@@ -61,6 +61,11 @@ timeout 600 python benchmarks/incast_bench.py --smoke \
   --json-out /tmp/qa_transport_bench.json; check $?
 python scripts/check_obs.py --transport /tmp/qa_transport_metrics.prom /tmp/qa_transport_bench.json; check $?
 
+note "chaos smoke tier (1 of 2 replicas killed mid-run + 5% control-notif drop + 5% data drop + post-GRANT kill: recovered outputs oracle-exact, extended conservation incl. lost, >=1 reclaimed lease, zero leaked slots — all counter-audited)"
+JAX_PLATFORMS=cpu timeout 600 python benchmarks/chaos_bench.py --smoke \
+  --metrics-out /tmp/qa_chaos_metrics.prom --json-out /tmp/qa_chaos_bench.json; check $?
+python scripts/check_obs.py --chaos /tmp/qa_chaos_metrics.prom /tmp/qa_chaos_bench.json; check $?
+
 note "disagg serving smoke tier (prefill+decode worker pair over p2p: chunk-streamed KV, >=1 prefix-cache hit, oracle-exact, telemetry validated; per-role trace/metrics dumps feed the fleet tier below)"
 UCCL_TPU_EXAMPLE_CPU=1 JAX_PLATFORMS=cpu timeout 600 python examples/disagg_kv.py --cpu \
   --trace-out /tmp/qa_fleet_trace.json --metrics-out /tmp/qa_disagg_metrics.prom; check $?

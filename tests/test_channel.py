@@ -208,6 +208,8 @@ class TestRetransmission:
         assert c_chan.retransmitted_chunks > 0
 
     def test_total_loss_raises_after_retries(self, chan_pair, rng):
+        from uccl_tpu import obs
+
         server, client, s_chan, c_chan = chan_pair
         c_chan.retries = 1
         n = 256 << 10  # 4 chunks
@@ -215,11 +217,17 @@ class TestRetransmission:
         fifo = server.advertise(server.reg(dst))
         src = rng.integers(0, 255, n).astype(np.uint8)
         client.set_drop_rate(1.0)
+        f0 = obs.counter("p2p_transfer_failures_total").get(
+            reason="undelivered")
         try:
             with pytest.raises(IOError, match="after 2 attempts"):
                 c_chan.write(src, fifo, timeout_ms=300)
         finally:
             client.set_drop_rate(0.0)
+        # the terminal failure is auditable from metrics alone: every
+        # exhausted chunk counted on the failure family
+        assert obs.counter("p2p_transfer_failures_total").get(
+            reason="undelivered") >= f0 + 1
 
     def test_single_path_retry_honors_timeout(self, chan_pair, rng):
         """Small (single-chunk) transfers retry on the caller's timeout

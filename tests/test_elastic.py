@@ -156,3 +156,42 @@ class TestElasticDecodeParity:
                 np.asarray(logits_e), np.asarray(logits_d), rtol=2e-4, atol=2e-5
             )
         assert ekv.length == 21 + 6
+
+
+@pytest.mark.slow
+class TestWarmReplicaAdmission:
+    def test_admit_warm_replica_attaches_and_serves(self, rng):
+        """The elastic up-scale twin of Router.detach: a warm spare built
+        off the prototype (shared compile caches) joins the live router
+        mid-run, is routable, serves bit-exact, and can be detached
+        again with nothing leaked."""
+        from uccl_tpu.ep.elastic import admit_warm_replica
+        from uccl_tpu.models.inference import generate
+        from uccl_tpu.serving import Router, ServingEngine
+        from uccl_tpu.serving.engine import DenseBackend
+
+        cfg = dense.DenseConfig(vocab=64, dim=32, n_layers=1, n_heads=2,
+                                n_kv_heads=1, head_dim=16, ffn=64)
+        params = dense.init_params(jax.random.PRNGKey(0), cfg)
+        proto = DenseBackend(params, cfg, n_slots=2, max_seq=16)
+        eng0 = ServingEngine(proto, prefill_chunk=4)
+        r = Router([eng0])
+        r.enable_health(suspect_after_s=5, dead_after_s=10)
+        spare = admit_warm_replica(
+            r, proto, engine_kw={"prefill_chunk": 4})
+        assert len(r.replicas) == 2
+        assert spare.backend._fns is proto._fns, "compiles must share"
+        # load the original so the spare wins the route
+        eng0.submit(list(range(8)), max_new_tokens=4)
+        prompt = np.arange(1, 7, dtype=np.int32)
+        req = r.submit(prompt, max_new_tokens=4)
+        assert any(q is req for q in spare.sched.queued_requests())
+        done = r.drain()
+        want = np.asarray(generate(params, jnp.asarray(prompt)[None],
+                                   cfg, max_new_tokens=4, max_seq=16))[0]
+        got = [q for q in done if q is req][0]
+        np.testing.assert_array_equal(np.asarray(got.out_tokens), want)
+        finished = r.detach(1)
+        assert len(r.replicas) == 1 and not finished
+        assert r.leaked() == 0 and spare.pool.leaked() == 0
+        r.close()

@@ -1,0 +1,627 @@
+"""Fleet fault tolerance: detector, recovery, leases, idempotent retries.
+
+Host-only stub tier (no jax compiles — tier-1 budget): the
+HEALTHY→SUSPECT→DEAD state machine under an injected clock (no sleeps),
+the suspect-grace no-flap property, exactly-once recovery conservation
+across a killed replica, GRANT-lease expiry reclaiming the decode slot,
+idempotent BEGIN retry (rid-keyed dedup never double-reserves), the
+structured drain-timeout diagnostics, and the detach/attach elastic
+membership primitives. The oracle-exact 2-process/real-model chaos arms
+live in ``benchmarks/chaos_bench.py --smoke`` (qa.sh + ci.yml chaos
+tier) with a ``slow``-marked pytest wrapper here.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from uccl_tpu import obs
+from uccl_tpu.serving import (
+    DEAD, HEALTHY, SUSPECT, FailureDetector, RequestState, Router,
+    ServingEngine, abandon_engine,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _ChunkStub:
+    """Chunk-aware stub backend (tests/test_router.py shape): prefill
+    emits 100, the i-th decode step emits i."""
+
+    def __init__(self, n_slots=2, max_seq=64):
+        self.n_slots = n_slots
+        self.max_seq = max_seq
+        self.n_decodes = 0
+
+    def prefill(self, tokens, lens, mask, start=None):
+        return np.full(self.n_slots, 100, np.int32)
+
+    def decode(self, tokens, active):
+        self.n_decodes += 1
+        return np.full(self.n_slots, self.n_decodes, np.int32)
+
+    def export_slot_kv(self, slot, lo, hi):
+        z = np.zeros((1, hi - lo, 1, 1), np.float32)
+        return z, z
+
+    def import_slot_kv(self, slot, k_rows, v_rows, *, length):
+        pass
+
+    def copy_slot_prefix(self, dst, src, n):
+        pass
+
+
+class _StubKV(_ChunkStub):
+    """_ChunkStub plus the model dims the disagg wire format needs — the
+    full BEGIN/GRANT/FINAL control plane runs over loopback endpoints in
+    milliseconds (tests/test_trace_fleet.py idiom)."""
+
+    class _Cfg:
+        n_layers = 1
+        n_kv_heads = 1
+        head_dim = 2
+
+    cfg = _Cfg()
+
+    def __init__(self, n_slots=2, max_seq=32):
+        super().__init__(n_slots=n_slots, max_seq=max_seq)
+
+    def export_slot_kv(self, slot, lo, hi):
+        z = np.zeros((1, hi - lo, 1, 2), np.float32)
+        return z, z
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestFailureDetector:
+    def test_window_validation(self):
+        with pytest.raises(ValueError, match="suspect_after_s"):
+            FailureDetector(suspect_after_s=0)
+        with pytest.raises(ValueError, match="grace window"):
+            FailureDetector(suspect_after_s=1.0, dead_after_s=1.0)
+
+    def test_transitions_and_telemetry(self):
+        clk = _Clock()
+        det = FailureDetector(suspect_after_s=0.5, dead_after_s=1.5,
+                              clock=clk)
+        det.register("a")
+        assert det.state("a") == HEALTHY and det.is_routable("a")
+        clk.t = 0.6
+        assert det.tick() == [("a", SUSPECT)]
+        assert det.state("a") == SUSPECT and not det.is_routable("a")
+        assert obs.gauge("fleet_peer_state").get(peer="a") == 1
+        clk.t = 1.6
+        assert det.tick() == [("a", DEAD)]
+        assert obs.gauge("fleet_peer_state").get(peer="a") == 2
+        # DEAD is terminal per registration: a late heartbeat must not
+        # resurrect state the fleet already recovered
+        det.heartbeat("a")
+        assert det.state("a") == DEAD
+        assert det.tick() == []
+        # explicit resurrection: re-register
+        det.register("a")
+        assert det.state("a") == HEALTHY
+
+    def test_suspect_grace_no_flap(self):
+        """A peer that resumes heartbeating inside the grace window
+        returns to HEALTHY — no DEAD fire, no recovery churn."""
+        clk = _Clock()
+        det = FailureDetector(suspect_after_s=0.5, dead_after_s=1.5,
+                              clock=clk)
+        det.register("a")
+        h0 = obs.counter("fleet_heartbeats_total").get(peer="a")
+        clk.t = 0.7
+        assert det.tick() == [("a", SUSPECT)]
+        det.heartbeat("a")  # inside the grace window
+        assert det.state("a") == HEALTHY
+        assert obs.counter("fleet_heartbeats_total").get(
+            peer="a") == h0 + 1
+        clk.t = 1.1  # 0.4s after the hb: inside suspect window again
+        assert det.tick() == []
+        assert det.state("a") == HEALTHY, "no flap"
+        clk.t = 3.0  # now genuinely silent past dead_after
+        fired = det.tick()
+        assert ("a", DEAD) in fired
+
+    def test_probe_is_the_inprocess_heartbeat(self):
+        clk = _Clock()
+        det = FailureDetector(suspect_after_s=0.5, dead_after_s=1.5,
+                              clock=clk)
+        alive = [True]
+        det.register("e", probe=lambda: alive[0])
+        clk.t = 10.0  # probes True: age never accumulates
+        assert det.tick() == []
+        assert det.state("e") == HEALTHY
+        alive[0] = False
+        clk.t = 10.6
+        assert det.tick() == [("e", SUSPECT)]
+        alive[0] = True  # probe recovers inside the grace window
+        clk.t = 10.7
+        det.tick()
+        assert det.state("e") == HEALTHY
+        alive[0] = False
+        clk.t = 12.3
+        assert ("e", DEAD) in det.tick()
+
+    def test_raising_probe_is_dead(self):
+        clk = _Clock()
+        det = FailureDetector(suspect_after_s=0.1, dead_after_s=0.2,
+                              clock=clk)
+
+        def boom():
+            raise RuntimeError("gone")
+
+        det.register("e", probe=boom)
+        clk.t = 0.5
+        assert ("e", DEAD) in det.tick()
+
+
+class TestRouterRecovery:
+    def _router(self, n=3, n_slots=2, **kw):
+        engines = [ServingEngine(_ChunkStub(n_slots=n_slots),
+                                 prefill_chunk=4, **kw)
+                   for _ in range(n)]
+        r = Router(engines)
+        clk = _Clock()
+        r.enable_health(suspect_after_s=0.05, dead_after_s=0.1,
+                        clock=clk)
+        return r, engines, clk
+
+    def test_exactly_once_conservation_across_a_kill(self):
+        r, engines, clk = self._router()
+        reqs = [r.submit(list(range(8)), max_new_tokens=4)
+                for _ in range(6)]
+        assert all(q is not None for q in reqs)
+        r.step()  # work lands in slots on every replica
+        victim = 0
+        assert engines[victim].has_work()
+        n_stranded = (engines[victim].sched.qsize
+                      + len(engines[victim]._by_slot))
+        c0 = [obs.counter("serving_recovered_total").get(outcome=o)
+              for o in ("resubmitted", "restarted", "lost")]
+        engines[victim].kill()
+        clk.t = 0.2  # past dead_after: next step recovers
+        done = r.drain()
+        deltas = [obs.counter("serving_recovered_total").get(outcome=o)
+                  - b for o, b in
+                  zip(("resubmitted", "restarted", "lost"), c0)]
+        assert sum(deltas) == n_stranded == len(r.recoveries)
+        assert deltas[2] == 0, "2 healthy survivors: nothing lost"
+        # exactly-once: every accepted trace completes exactly once
+        traces = [q.trace_id for q in done]
+        assert len(traces) == len(set(traces)) == 6
+        assert set(traces) == {q.trace_id for q in reqs}
+        snap = r.snapshot()
+        assert snap["lost"] == n_stranded  # the dead replica's copies
+        assert snap["submitted"] == (
+            snap["completed"] + snap["active"] + snap["queued"]
+            + snap["rejected"] + snap["expired"] + snap["lost"]
+        )
+        assert r.leaked() == 0
+        assert snap["dead_replicas"] == 1
+        r.close()
+
+    def test_double_dead_fire_recovers_once(self):
+        r, engines, clk = self._router(n=2)
+        r.submit([1, 2, 3], max_new_tokens=4)
+        r.step()
+        engines_with_work = [e for e in engines if e.has_work()]
+        victim = engines.index(engines_with_work[0])
+        engines[victim].kill()
+        clk.t = 0.2
+        r.step()
+        n = len(r.recoveries)
+        assert n >= 1
+        r._recover(victim)  # a duplicate fire must be a no-op
+        assert len(r.recoveries) == n
+        r.drain()
+        assert r.leaked() == 0
+        r.close()
+
+    def test_suspect_excluded_but_not_recovered(self):
+        """SUSPECT = routing exclusion only: the grace window must not
+        trigger recovery, and a heartbeat restores routability."""
+        r, engines, clk = self._router(n=2)
+        det = r.detector
+        # make replica 0 probe-less so silence (not the probe) drives it
+        det._peers["0"].probe = None
+        clk.t = 0.07  # past suspect, inside dead
+        r.step()
+        assert det.state("0") == SUSPECT
+        assert not r._routable(0) and r._routable(1)
+        req = r.submit([1, 2], max_new_tokens=2)
+        assert any(q is req
+                   for q in engines[1].sched.queued_requests())
+        assert not r.recoveries, "grace window must not recover"
+        det.heartbeat("0")
+        assert r._routable(0)
+        r.drain()
+        r.close()
+
+    def test_cascading_failure_recovers_the_same_trace_again(self):
+        """A survivor that took recovered work can die too: the trace is
+        legitimately recovered AGAIN (a new incarnation under the same
+        context) — never silently dropped, conservation intact."""
+        r, engines, clk = self._router(n=3)
+        req = r.submit(list(range(8)), max_new_tokens=4)
+        # first death: whoever holds the request
+        holder = next(i for i, e in enumerate(engines)
+                      if any(q is req for q in e.sched.queued_requests()))
+        engines[holder].kill()
+        clk.t = 0.2
+        r.step()
+        assert len(r.recoveries) == 1
+        # the survivor that took it dies too, before finishing
+        taker = next(i for i, e in enumerate(engines)
+                     if not e.dead and e.has_work())
+        engines[taker].kill()
+        clk.t = 0.4
+        done = r.drain()
+        recovered_traces = [x["trace_id"] for x in r.recoveries]
+        assert recovered_traces.count(req.trace_id) == 2
+        assert [q.trace_id for q in done] == [req.trace_id]
+        snap = r.snapshot()
+        assert snap["submitted"] == (
+            snap["completed"] + snap["active"] + snap["queued"]
+            + snap["rejected"] + snap["expired"] + snap["lost"]
+        )
+        assert snap["lost"] == 2  # one dead copy per incarnation
+        assert r.leaked() == 0
+        r.close()
+
+    def test_lost_when_no_survivor_has_room(self):
+        r, engines, clk = self._router(n=2, n_slots=1)
+        a = r.submit(list(range(8)), max_new_tokens=8)
+        b = r.submit(list(range(8)), max_new_tokens=8)
+        r.step()
+        # saturate the survivor's queue so recovery cannot place work
+        victim = 0
+        survivor = 1
+        engines[survivor].sched.max_queue = engines[survivor].sched.qsize
+        engines[victim].kill()
+        clk.t = 0.2
+        c0 = obs.counter("serving_recovered_total").get(outcome="lost")
+        done = r.drain()
+        lost = obs.counter("serving_recovered_total").get(
+            outcome="lost") - c0
+        assert lost >= 1
+        snap = r.snapshot()
+        assert snap["lost"] >= 1
+        assert snap["submitted"] == (
+            snap["completed"] + snap["active"] + snap["queued"]
+            + snap["rejected"] + snap["expired"] + snap["lost"]
+        )
+        assert r.leaked() == 0
+        # the lost request object is terminally marked
+        lost_reqs = [q for q in (a, b)
+                     if q.state is RequestState.LOST]
+        assert len(lost_reqs) == lost
+        assert all(q.finish_reason == "replica_dead" for q in lost_reqs)
+        assert all(q.is_done() for q in lost_reqs)
+        _ = done
+        r.close()
+
+    def test_abandon_engine_counts_all_lost(self):
+        eng = ServingEngine(_ChunkStub(), prefill_chunk=4)
+        r1 = eng.submit([1, 2, 3], max_new_tokens=4)
+        eng.step()
+        r2 = eng.submit([1, 2, 3], max_new_tokens=4)
+        eng.kill()
+        c0 = obs.counter("serving_recovered_total").get(outcome="lost")
+        gone = abandon_engine(eng)
+        assert {q.rid for q in gone} == {r1.rid, r2.rid}
+        assert obs.counter("serving_recovered_total").get(
+            outcome="lost") == c0 + 2
+        snap = eng.snapshot()
+        assert snap["lost"] == 2
+        assert snap["submitted"] == (
+            snap["completed"] + snap["active"] + snap["queued"]
+            + snap["rejected"] + snap["expired"] + snap["lost"]
+        )
+        assert eng.pool.leaked() == 0
+
+    def test_killed_engine_step_raises(self):
+        eng = ServingEngine(_ChunkStub(), prefill_chunk=4)
+        eng.kill()
+        with pytest.raises(RuntimeError, match="dead"):
+            eng.step()
+
+
+class TestElasticMembership:
+    def test_detach_drains_then_removes(self):
+        engines = [ServingEngine(_ChunkStub(), prefill_chunk=4)
+                   for _ in range(2)]
+        r = Router(engines)
+        reqs = [r.submit([1, 2, 3], max_new_tokens=3) for _ in range(4)]
+        d0 = obs.counter("serving_router_detached_total").get()
+        finished = r.detach(0)
+        assert obs.counter("serving_router_detached_total").get() == d0 + 1
+        assert len(r.replicas) == 1
+        # the detached replica's work FINISHED (drained, not dropped)
+        done = finished + r.drain()
+        assert {q.rid for q in done} == {q.rid for q in reqs}
+        assert all(q.state is RequestState.FINISHED for q in reqs)
+        assert r.leaked() == 0
+        with pytest.raises(ValueError, match="last replica"):
+            r.detach(0)
+        r.close()
+
+    def test_detach_hands_parked_donors_back(self):
+        from uccl_tpu.serving import PrefixCache
+
+        engines = [
+            ServingEngine(_ChunkStub(), prefill_chunk=4,
+                          prefix_cache=PrefixCache(chunk=4)),
+            ServingEngine(_ChunkStub(), prefill_chunk=4),
+        ]
+        r = Router(engines)
+        r.submit(list(range(8)), max_new_tokens=2)
+        r.submit(list(range(8)), max_new_tokens=2)
+        r.drain()
+        parked = engines[0].pool.n_parked
+        assert parked >= 1, "retire should park a donor"
+        r.detach(0)
+        assert engines[0].pool.n_parked == 0
+        assert engines[0].pool.n_free == engines[0].pool.n_slots
+        r.close()
+
+    def test_attach_is_routable_with_stable_ids(self):
+        engines = [ServingEngine(_ChunkStub(), prefill_chunk=4)
+                   for _ in range(2)]
+        r = Router(engines)
+        r.enable_health(suspect_after_s=10, dead_after_s=20)
+        spare = ServingEngine(_ChunkStub(), prefill_chunk=4)
+        pid = r.attach(spare)
+        assert pid == 2 and len(r.replicas) == 3
+        assert r.detector.state(str(pid)) == HEALTHY
+        # load the originals so the spare wins the next route
+        for e in engines:
+            e.submit(list(range(8)), max_new_tokens=8)
+        req = r.submit([1, 2], max_new_tokens=2)
+        assert any(q is req for q in spare.sched.queued_requests())
+        r.drain()
+        assert r.leaked() == 0
+        r.close()
+
+
+class TestDisaggLease:
+    def _pair(self, **kw):
+        from uccl_tpu.serving.disagg import make_local_pair
+
+        pe = ServingEngine(_StubKV(), prefill_chunk=4)
+        de = ServingEngine(_StubKV())
+        pw, dw = make_local_pair(pe, de, **kw)
+        return pw, dw
+
+    def test_lease_expiry_reclaims_slot(self):
+        pw, dw = self._pair(grant_lease_s=0.15, ctrl_retry_s=30.0)
+        try:
+            pw.submit(np.arange(6, dtype=np.int32), max_new_tokens=3)
+            deadline = time.monotonic() + 10
+            while not dw._granted:  # BEGIN -> GRANT; engine never steps
+                dw.poll()
+                assert time.monotonic() < deadline
+            assert dw.engine.pool.n_free == dw.engine.pool.n_slots - 1
+            c0 = obs.counter("disagg_leases_expired_total").get(
+                reason="timeout")
+            time.sleep(0.2)  # the prefill worker "dies": no FINAL ever
+            dw.poll()
+            assert not dw._granted
+            assert dw.engine.pool.n_free == dw.engine.pool.n_slots
+            assert dw.engine.pool.leaked() == 0
+            assert obs.counter("disagg_leases_expired_total").get(
+                reason="timeout") == c0 + 1
+        finally:
+            pw.ep.close()
+            dw.ep.close()
+
+    def test_peer_dead_expires_lease(self):
+        det = FailureDetector(suspect_after_s=0.05, dead_after_s=0.1)
+        pw, dw = self._pair(grant_lease_s=60.0, detector=det,
+                            ctrl_retry_s=30.0)
+        try:
+            pw.submit(np.arange(6, dtype=np.int32), max_new_tokens=3)
+            deadline = time.monotonic() + 10
+            while not dw._granted:
+                dw.poll()
+                assert time.monotonic() < deadline
+            c0 = obs.counter("disagg_leases_expired_total").get(
+                reason="peer_dead")
+            time.sleep(0.15)  # no heartbeats: the conn goes DEAD
+            dw.poll()
+            assert not dw._granted
+            assert dw.engine.pool.leaked() == 0
+            assert obs.counter("disagg_leases_expired_total").get(
+                reason="peer_dead") == c0 + 1
+        finally:
+            pw.ep.close()
+            dw.ep.close()
+
+    def test_live_peer_timeout_quarantines_until_final(self):
+        """A lease timing out while the peer is provably ALIVE (still
+        heartbeating) must NOT free the slot — its stream may still be
+        one-sided-writing into the mirror rows. The slot is quarantined
+        (expiry counted) and freed only when the stream terminates: the
+        late FINAL is dropped as stale, never adopted."""
+        det = FailureDetector(suspect_after_s=60, dead_after_s=120)
+        pw, dw = self._pair(grant_lease_s=0.1, detector=det,
+                            ctrl_retry_s=30.0, heartbeat_s=0.01)
+        try:
+            pw.submit(np.arange(6, dtype=np.int32), max_new_tokens=3)
+            deadline = time.monotonic() + 10
+            while not dw._granted:
+                pw.pump()  # heartbeats flow; the engine never steps
+                dw.poll()
+                assert time.monotonic() < deadline
+            c0 = obs.counter("disagg_leases_expired_total").get(
+                reason="timeout")
+            s0 = obs.counter("disagg_stale_finals_total").get()
+            time.sleep(0.15)  # past the lease, peer still heartbeating
+            pw.pump()
+            dw.poll()
+            st = next(iter(dw._granted.values()))
+            assert st.get("expired"), "lease should be quarantined"
+            assert obs.counter("disagg_leases_expired_total").get(
+                reason="timeout") == c0 + 1
+            assert dw.engine.pool.n_free == dw.engine.pool.n_slots - 1, \
+                "quarantined slot must stay reserved (no mid-write reuse)"
+            # the stalled stream finally finishes: FINAL arrives, is
+            # dropped as stale, and ONLY THEN is the slot freed
+            done = []
+            deadline = time.monotonic() + 30
+            while dw._granted:
+                pw.step()
+                done.extend(dw.step())
+                assert time.monotonic() < deadline
+            assert not done, "a lapsed lease's request must not adopt"
+            assert dw.engine.pool.n_free == dw.engine.pool.n_slots
+            assert dw.engine.pool.leaked() == 0
+            assert obs.counter("disagg_stale_finals_total").get() \
+                == s0 + 1
+        finally:
+            pw.ep.close()
+            dw.ep.close()
+
+    def test_begin_retry_unwedges_a_reclaimed_lease(self):
+        """All GRANTs lost for a whole lease: after reclaim, the still-
+        retrying BEGIN (which proves nothing was ever shipped) must open
+        a FRESH stream, not be dropped forever."""
+        from uccl_tpu.serving.disagg import set_ctrl_drop
+
+        pw, dw = self._pair(grant_lease_s=0.08, ctrl_retry_s=0.02)
+        try:
+            # BEGIN #1 gets through, then a TOTAL control blackout: the
+            # GRANT and every retried BEGIN vanish (retries would
+            # otherwise renew the lease — contact is renewal), so the
+            # never-delivered grant's lease reclaims at timeout
+            req = pw.submit(np.arange(6, dtype=np.int32),
+                            max_new_tokens=3)
+            set_ctrl_drop(1.0, seed=11)
+            deadline = time.monotonic() + 10
+            while not dw._expired_leases:
+                pw.pump()
+                dw.poll()
+                time.sleep(0.005)
+                assert time.monotonic() < deadline
+            set_ctrl_drop(0.0)
+            done = []
+            deadline = time.monotonic() + 30
+            while len(done) < 1:
+                pw.step()
+                done.extend(dw.step())
+                assert time.monotonic() < deadline
+            assert done[0].n_generated == 3
+            assert dw.engine.pool.leaked() == 0
+            _ = req
+        finally:
+            set_ctrl_drop(0.0)
+            pw.ep.close()
+            dw.ep.close()
+
+    def test_idempotent_begin_never_double_reserves(self):
+        from uccl_tpu.serving.disagg import _send_msg
+
+        pw, dw = self._pair(ctrl_retry_s=30.0)
+        try:
+            msg = {"t": "begin", "rid": 7, "prompt": [1, 2, 3],
+                   "max_new_tokens": 2, "eos_id": None,
+                   "priority": "interactive", "t_submit": time.time(),
+                   "trace": None}
+            _send_msg(pw.ep, pw.conn, msg)
+            deadline = time.monotonic() + 10
+            while not dw._granted:
+                dw.poll()
+                assert time.monotonic() < deadline
+            free = dw.engine.pool.n_free
+            slot = next(iter(dw._granted.values()))["slot"]
+            g0 = obs.counter("disagg_ctrl_retries_total").get(msg="grant")
+            _send_msg(pw.ep, pw.conn, msg)  # retried BEGIN (lost GRANT)
+            deadline = time.monotonic() + 10
+            while obs.counter("disagg_ctrl_retries_total").get(
+                    msg="grant") != g0 + 1:
+                dw.poll()
+                assert time.monotonic() < deadline
+            assert dw.engine.pool.n_free == free, "double-reserved!"
+            assert len(dw._granted) == 1
+            assert next(iter(dw._granted.values()))["slot"] == slot
+        finally:
+            pw.ep.close()
+            dw.ep.close()
+
+    def test_begin_retry_converges_after_total_ctrl_loss(self):
+        from uccl_tpu.serving.disagg import set_ctrl_drop
+
+        pw, dw = self._pair(ctrl_retry_s=0.02)
+        try:
+            set_ctrl_drop(1.0, seed=3)  # the first BEGIN vanishes
+            req = pw.submit(np.arange(6, dtype=np.int32),
+                            max_new_tokens=3)
+            assert req is not None
+            dw.poll()
+            assert not dw._granted and not dw._pending
+            set_ctrl_drop(0.0)  # plane heals: the retry must converge
+            done = []
+            deadline = time.monotonic() + 30
+            while len(done) < 1:
+                pw.step()
+                done.extend(dw.step())
+                assert time.monotonic() < deadline
+            assert done[0].n_generated == 3
+        finally:
+            set_ctrl_drop(0.0)
+            pw.ep.close()
+            dw.ep.close()
+
+    def test_drain_timeout_names_outstanding(self):
+        from uccl_tpu.serving.disagg import set_ctrl_drop
+
+        pw, dw = self._pair(ctrl_retry_s=30.0)
+        try:
+            set_ctrl_drop(1.0, seed=5)  # BEGIN never reaches decode
+            req = pw.submit(np.arange(6, dtype=np.int32),
+                            max_new_tokens=3)
+            d0 = obs.counter("disagg_drain_timeouts_total").get(
+                role="prefill")
+            with pytest.raises(TimeoutError) as ei:
+                pw.drain(timeout_s=0.05)
+            assert f"rid=[{req.rid}]" in str(ei.value)
+            assert "ungranted" in str(ei.value)
+            assert obs.counter("disagg_drain_timeouts_total").get(
+                role="prefill") == d0 + 1
+        finally:
+            set_ctrl_drop(0.0)
+            pw.ep.close()
+            dw.ep.close()
+
+
+@pytest.mark.slow
+class TestChaosSmoke:
+    def test_chaos_bench_smoke_and_validator(self, tmp_path):
+        """The full real-model chaos proof (router kill + disagg lease
+        arms, oracle-exact, counter-audited) as CI runs it."""
+        metrics = tmp_path / "chaos.prom"
+        bench = tmp_path / "chaos.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        subprocess.run(
+            [sys.executable, os.path.join(_REPO, "benchmarks",
+                                          "chaos_bench.py"),
+             "--smoke", "--metrics-out", str(metrics),
+             "--json-out", str(bench)],
+            check=True, env=env, cwd=_REPO, timeout=600,
+        )
+        subprocess.run(
+            [sys.executable, os.path.join(_REPO, "scripts",
+                                          "check_obs.py"),
+             "--chaos", str(metrics), str(bench)],
+            check=True, cwd=_REPO, timeout=60,
+        )

@@ -283,3 +283,28 @@ def admit_warm_spare(buf: ElasticBuffer, weights, *, prefix: str = "",
     obs.instant("warm_spare_admit", track="wire", entries=len(pairs),
                 bytes=total, version=version)
     return total
+
+
+def admit_warm_replica(router, prototype_backend, *, weights=None,
+                       engine_kw: Optional[Dict] = None):
+    """Elastic UP-scale: build a warm-spare serving replica off
+    ``prototype_backend`` (sharing its compiled-program caches — N
+    replicas cost one warmup, the ``serving.replicate_backend`` rule),
+    optionally serving a pushed weight snapshot
+    (:class:`~uccl_tpu.p2p.weight_push.WeightSnapshot` — its wire bytes
+    were counted at fetch), and :meth:`~uccl_tpu.serving.Router.attach`
+    it to the live router mid-run. The twin of ``Router.detach`` (the
+    graceful down-scale): together they are the fleet-resize primitive
+    the load-following control loop actuates. Returns the new
+    ``ServingEngine`` (its stable replica id is on the router's
+    ``attach`` instant)."""
+    from uccl_tpu.serving.engine import (
+        ServingEngine, _reweight_backend, replicate_backend,
+    )
+
+    backend = replicate_backend(prototype_backend, 2)[1]
+    if weights is not None:
+        backend = _reweight_backend(backend, weights)
+    eng = ServingEngine(backend, **(engine_kw or {}))
+    router.attach(eng)
+    return eng

@@ -88,6 +88,10 @@ _CHAN_RTO = obs.gauge(
     "retransmission timeout of the last windowed transfer "
     "(last-writer-wins across channels)",
 )
+# declared in p2p/endpoint.py — the windowed transport's terminal
+# failures land on the same family so a chaos run's failure mix is
+# auditable from metrics alone (docs/OBSERVABILITY.md)
+_XFER_FAILS = obs.counter("p2p_transfer_failures_total")
 
 _chunk_kb = param("chunk_size_kb", 1024, help="multipath chunk size in KiB")
 _abandoned_cap = param(
@@ -657,6 +661,10 @@ class Channel:
                 now = time.monotonic()
                 dead = win.exhausted(now)
                 if dead:
+                    _XFER_FAILS.inc(len(dead), reason="undelivered")
+                    obs.instant("p2p_transfer_failed", track="wire",
+                                reason="undelivered", chunks=len(dead),
+                                attempts=win.max_tx)
                     raise IOError(
                         f"transfer failed: {len(dead)} chunks undelivered "
                         f"after {win.max_tx} attempts"
@@ -665,12 +673,18 @@ class Channel:
                         and now - credit_stall_t0 > timeout_ms / 1e3):
                     _CREDIT_STALL.inc(now - credit_stall_t0)
                     credit_stall_t0 = None
+                    _XFER_FAILS.inc(reason="credit_stall")
+                    obs.instant("p2p_transfer_failed", track="wire",
+                                reason="credit_stall")
                     raise TimeoutError(
                         f"pull credit stalled: need "
                         f"{self._pull_sent + chunks[win._next_new][2]}, "
                         f"have {int(self._credit_buf[0])}"
                     )
                 if now - last_progress > timeout_ms / 1e3:
+                    _XFER_FAILS.inc(reason="stalled")
+                    obs.instant("p2p_transfer_failed", track="wire",
+                                reason="stalled", inflight=len(inflight))
                     raise IOError(
                         f"transfer stalled: no chunk completion in "
                         f"{timeout_ms} ms ({len(inflight)} in flight)"

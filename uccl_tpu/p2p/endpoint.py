@@ -32,6 +32,16 @@ _P2P_BYTES = obs.counter(
     "payload bytes entering the p2p engine per verb "
     "(write/read/send/recv/notif; vectorized calls count per element)",
 )
+# Terminal transfer failures, by reason — raised exceptions also land
+# here so a chaos run's failure mix is auditable from metrics alone
+# (reason=wait_timeout: a vectorized write/read element never completed;
+# reason=undelivered/stalled/credit_stall: the windowed SACK transport
+# gave up — p2p/channel.py; reason=kv_slab: a disagg KV slab write —
+# serving/disagg.py).
+_P2P_FAILS = obs.counter(
+    "p2p_transfer_failures_total",
+    "one-sided transfers that failed terminally, by reason",
+)
 
 _stage_chunk_bytes = param(
     "stage_chunk_bytes", 8 << 20,
@@ -455,6 +465,10 @@ class Endpoint:
         # batch would leak their _inflight keepalives + native completions.
         failed = [x for x in xids if not self.wait(x)]
         if failed:
+            _P2P_FAILS.inc(len(failed), reason="wait_timeout")
+            obs.instant("p2p_transfer_failed", track="wire",
+                        reason="wait_timeout", what=what,
+                        failed=len(failed), total=len(xids))
             raise IOError(f"{what}: {len(failed)}/{len(xids)} elements failed")
 
     def writev(self, conn_id: int, srcs, fifos) -> None:

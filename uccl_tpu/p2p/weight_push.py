@@ -80,6 +80,13 @@ _PUSH_PEERS = obs.counter(
     "weight_push_peers_total",
     "peers that completed a verified fetch (reached consistency) by name",
 )
+_PUSH_RESUMED = obs.counter(
+    "weight_push_resumed_groups_total",
+    "slab groups SKIPPED on a resumed fetch because the partial buffer "
+    "from the failed attempt already held them CRC-verified — the "
+    "counter-audited face of not re-shipping a whole snapshot on a "
+    "mid-transfer error",
+)
 # the one shared p2p byte family (p2p/endpoint.py declares it): the
 # service-level verb beside the transport-level write/read/send series
 _P2P_BYTES = obs.counter(
@@ -88,6 +95,20 @@ _P2P_BYTES = obs.counter(
 )
 
 _MAGIC = b"UWP1"
+
+
+class FetchError(IOError):
+    """A fetch died mid-transfer. ``partial`` is the WeightSnapshot as
+    far as it got (manifest + partially-filled buffer) and ``groups_ok``
+    the groups whose CRCs verified before the failure — pass it back as
+    ``fetch(..., resume=err.partial)`` and only the missing groups cross
+    the wire again (counted on ``weight_push_resumed_groups_total``)."""
+
+    def __init__(self, msg: str, partial: "WeightSnapshot" = None,
+                 groups_ok: Optional[List[int]] = None):
+        super().__init__(msg)
+        self.partial = partial
+        self.groups_ok = list(groups_ok or [])
 
 
 # -- param-tree <-> flat slabs ------------------------------------------------
@@ -287,7 +308,7 @@ def _recv_msg(chan: Channel, timeout_ms: int) -> dict:
 
 def _serve_groups(chan: Channel, snap: WeightSnapshot, fifo: bytes,
                   timeout_ms: int, have_group=None,
-                  src: str = "publisher") -> None:
+                  src: str = "publisher", skip=frozenset()) -> None:
     """Ship every group of ``snap`` into the peer's window ``fifo`` — one
     windowed writev per group, a group control msg after each (the relay
     pipeline tick). ``have_group(g)`` blocks until group g's bytes are
@@ -295,7 +316,10 @@ def _serve_groups(chan: Channel, snap: WeightSnapshot, fifo: bytes,
     (the publisher). ``src`` labels the tx byte series
     (publisher|relay) — the counter-audited form of "the root ships each
     chunk once": under a relay chain the publisher-labeled tx bytes stay
-    ONE snapshot however many peers reach consistency."""
+    ONE snapshot however many peers reach consistency. ``skip`` holds
+    groups the peer already verified locally (a resumed fetch): no bytes
+    move, just a ``skipped`` control tick keeping the in-order group
+    protocol intact."""
     item = FifoItem.unpack(fifo)
     if item.size < snap.total_bytes:
         raise IOError(
@@ -304,6 +328,10 @@ def _serve_groups(chan: Channel, snap: WeightSnapshot, fifo: bytes,
         )
     name = snap.name
     for g in range(len(snap.manifest["groups"])):
+        if g in skip:
+            _send_msg(chan, {"op": "group", "idx": g, "skipped": True,
+                             "crc": int(snap.manifest["group_crcs"][g])})
+            continue
         if have_group is not None:
             have_group(g)
         a, b = snap.group_range(g)
@@ -393,7 +421,8 @@ class WeightPublisher:
             if win.get("op") != "window":
                 raise IOError(f"weight_push: expected window, got {win}")
             _serve_groups(chan, snap, bytes.fromhex(win["fifo"]),
-                          timeout_ms)
+                          timeout_ms,
+                          skip=frozenset(win.get("have", [])))
         return snap.name, snap.version
 
     def serve_forever(self, chan: Channel, timeout_ms: int = 60000):
@@ -426,16 +455,48 @@ class WeightPublisher:
         return t
 
 
+def _resume_groups(resume: Optional[WeightSnapshot], man: Dict,
+                   buf: np.ndarray) -> List[int]:
+    """CRC-verify which groups of a prior partial fetch already match
+    ``man``'s published bytes, copy them into ``buf``, and return their
+    indices — the guarded skip list of a resumed fetch. A resume against
+    a DIFFERENT snapshot/version (the publisher moved on mid-retry)
+    matches nothing and the fetch falls back to a full transfer."""
+    if resume is None:
+        return []
+    rman = resume.manifest
+    if (rman.get("name") != man["name"]
+            or rman.get("version") != man["version"]
+            or int(rman.get("total", -1)) != int(man["total"])
+            or rman.get("group_crcs") != man["group_crcs"]
+            or resume.buf.nbytes != buf.nbytes):
+        return []
+    tmp = WeightSnapshot(man, resume.buf)  # range math off the manifest
+    have = []
+    for g in range(len(man["groups"])):
+        a, b = tmp.group_range(g)
+        if zlib.crc32(resume.buf[a:b]) == int(man["group_crcs"][g]):
+            buf[a:b] = resume.buf[a:b]
+            have.append(g)
+    return have
+
+
 def fetch(chan: Channel, name: str, *, version: Optional[int] = None,
-          forward_to: Sequence[Channel] = (), timeout_ms: int = 60000
-          ) -> WeightSnapshot:
+          forward_to: Sequence[Channel] = (), timeout_ms: int = 60000,
+          resume: Optional[WeightSnapshot] = None,
+          on_group=None) -> WeightSnapshot:
     """Fetch ``name`` (latest or pinned ``version``) from the upstream on
     ``chan``; with ``forward_to``, act as a relay — downstream peers'
     fetch requests are accepted against the SAME manifest and every
     verified group is forwarded the moment it lands, while later groups
     are still in flight from upstream (the pipeline that makes
     time-to-consistent-fleet sublinear in N). Returns the verified
-    snapshot; raises on CRC mismatch or version skew."""
+    snapshot; raises :class:`FetchError` on CRC mismatch, version skew
+    or a mid-transfer failure — the error carries the partial snapshot,
+    and passing it back as ``resume=`` skips every group whose CRC
+    already verified (counted ``weight_push_resumed_groups_total``)
+    instead of restarting the whole snapshot. ``on_group(g)`` fires as
+    each group verifies (progress hook)."""
     ep = chan.ep
     _send_msg(chan, {"op": "fetch", "name": name, "version": version})
     man = _recv_msg(chan, timeout_ms)
@@ -447,16 +508,34 @@ def fetch(chan: Channel, name: str, *, version: Optional[int] = None,
     mr = ep.reg(buf)
     n_groups = len(man["groups"])
     got = threading.Event()
-    landed = [0]  # groups verified locally (monotonic)
+    lock = threading.Lock()
+    landed: set = set()  # groups verified locally
     dead = [False]  # upstream fetch aborted: wake + fail the forwarders
     fail: List[BaseException] = []
+    have = _resume_groups(resume, man, buf)
+    if have:
+        _PUSH_RESUMED.inc(len(have))
+        landed.update(have)
+        obs.instant("weight_push.resume", track="wire",
+                    snapshot=man["name"], version=man["version"],
+                    groups=len(have))
 
     def have_group(g: int):
-        while landed[0] <= g:
+        while True:
+            with lock:
+                if g in landed:
+                    return
             if fail or dead[0]:
                 raise IOError("weight_push: upstream fetch failed")
             got.wait(0.05)
             got.clear()
+
+    def mark(g: int):
+        with lock:
+            landed.add(g)
+        got.set()
+        if on_group is not None:
+            on_group(g)
 
     # downstream relays: accept each peer's fetch, hand it OUR manifest
     # (same name/version/groups), then forward groups as they land
@@ -480,22 +559,29 @@ def fetch(chan: Channel, name: str, *, version: Optional[int] = None,
                 if win.get("op") != "window":
                     raise IOError(f"weight_push: expected window, got {win}")
 
-                def fwd(dc=dchan, wf=bytes.fromhex(win["fifo"])):
+                def fwd(dc=dchan, wf=bytes.fromhex(win["fifo"]),
+                        sk=frozenset(win.get("have", []))):
                     try:
                         _serve_groups(dc, snap, wf, timeout_ms,
-                                      have_group=have_group, src="relay")
+                                      have_group=have_group, src="relay",
+                                      skip=sk)
                     except BaseException as e:  # surfaced on join below
                         fail.append(e)
 
                 t = threading.Thread(target=fwd, daemon=True)
                 t.start()
                 down_threads.append(t)
-            _send_msg(chan, {"op": "window", "fifo": fifo.hex()})
+            _send_msg(chan, {"op": "window", "fifo": fifo.hex(),
+                             "have": have})
             for g in range(n_groups):
                 msg = _recv_msg(chan, timeout_ms)
                 if msg.get("op") != "group" or msg["idx"] != g:
                     raise IOError(f"weight_push: expected group {g}, "
                                   f"got {msg}")
+                if msg.get("skipped"):
+                    # our own resume skip, ticked back in order: the
+                    # bytes were CRC-verified before the window opened
+                    continue
                 if snap.group_crc(g) != int(msg["crc"]):
                     raise IOError(
                         f"weight_push: group {g} CRC mismatch (wire "
@@ -504,8 +590,7 @@ def fetch(chan: Channel, name: str, *, version: Optional[int] = None,
                 a, b = snap.group_range(g)
                 _PUSH_BYTES.inc(b - a, role="rx", name=man["name"])
                 _P2P_BYTES.inc(b - a, verb="weight_push")
-                landed[0] = g + 1
-                got.set()
+                mark(g)
             done = _recv_msg(chan, timeout_ms)
             if done.get("op") != "done" or snap.crc() != int(done["crc"]):
                 raise IOError("weight_push: snapshot CRC mismatch")
@@ -519,6 +604,16 @@ def fetch(chan: Channel, name: str, *, version: Optional[int] = None,
         obs.instant("weight_push.consistent", track="wire",
                     snapshot=man["name"], version=man["version"])
         return snap
+    except Exception as e:
+        # Exception, not BaseException: KeyboardInterrupt/SystemExit must
+        # terminate, not be rewrapped into the retry-with-resume contract
+        ok = sorted(landed)
+        raise FetchError(
+            f"weight_push: fetch of {man['name']} v{man['version']} "
+            f"failed with {len(ok)}/{n_groups} groups verified "
+            f"({type(e).__name__}: {e}) — retry with resume= to skip "
+            f"them", partial=snap, groups_ok=ok,
+        ) from e
     finally:
         dead[0] = True  # no-op after success (every group landed)
         got.set()

@@ -14,6 +14,9 @@ from uccl_tpu.serving.engine import (  # noqa: F401
 from uccl_tpu.serving.metrics import (  # noqa: F401
     ServingMetrics, percentile, percentiles_ms,
 )
+from uccl_tpu.serving.health import (  # noqa: F401
+    DEAD, HEALTHY, SUSPECT, FailureDetector, abandon_engine,
+)
 from uccl_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from uccl_tpu.serving.request import Request, RequestState  # noqa: F401
 from uccl_tpu.serving.router import Router, replica_signals  # noqa: F401
@@ -32,4 +35,5 @@ __all__ = [
     "Request", "RequestState", "FIFOScheduler", "PriorityScheduler",
     "PRIORITY_CLASSES", "Router", "replica_signals", "SlotPool",
     "Drafter", "NGramDrafter", "replicate_backend",
+    "FailureDetector", "HEALTHY", "SUSPECT", "DEAD", "abandon_engine",
 ]

@@ -63,6 +63,7 @@ import numpy as np
 
 from uccl_tpu import obs
 from uccl_tpu.serving.engine import ChunkEvent, ServingEngine
+from uccl_tpu.serving.health import DEAD as _PEER_DEAD
 from uccl_tpu.serving.request import Request, now
 
 KV_DTYPE = np.float32
@@ -75,6 +76,55 @@ _STREAM_REQS = obs.counter(
     "kv_stream_requests_total",
     "requests whose KV crossed the disagg stream (role=tx|rx)",
 )
+_LEASES_EXPIRED = obs.counter(
+    "disagg_leases_expired_total",
+    "GRANT leases reclaimed on the decode side: the reserved slot's KV "
+    "never completed before expiry (reason=timeout) or its prefill peer "
+    "was declared dead (reason=peer_dead) — the slot returns to the "
+    "pool instead of leaking forever",
+)
+_STALE_FINALS = obs.counter(
+    "disagg_stale_finals_total",
+    "FINALs arriving for a stream whose lease already expired — dropped "
+    "(the slot was reclaimed; importing would corrupt its new occupant)",
+)
+_CTRL_RETRIES = obs.counter(
+    "disagg_ctrl_retries_total",
+    "control-plane retransmissions by message (msg=begin: no GRANT "
+    "within the retry window; msg=grant: a duplicate BEGIN re-answered "
+    "idempotently; msg=final: no FINAL-ack within the window)",
+)
+_CTRL_DROPPED = obs.counter(
+    "disagg_ctrl_dropped_total",
+    "control notifs dropped by the Python-level chaos injector "
+    "(set_ctrl_drop) — the notif plane's fault-injection face",
+)
+_DRAIN_TIMEOUTS = obs.counter(
+    "disagg_drain_timeouts_total",
+    "drain/serve deadlines that expired with work outstanding, by role "
+    "— the structured-timeout counter (the raise names the stuck "
+    "rids/conns)",
+)
+
+# -- control-plane fault injection ------------------------------------------
+# The native injector (Endpoint.set_drop_rate / set_conn_fault) faults the
+# one-sided DATA plane only — notifs ride the reliable control path by
+# design (p2p/endpoint.py). Chaos runs that want control-plane loss
+# (dropped GRANTs, lost FINALs) inject it HERE, at the send site, with a
+# seeded RNG so runs reproduce. HELLO/clock/bye are exempt: they are
+# handshake/teardown, not the retried steady-state plane under test.
+_CTRL_DROP: Dict[str, object] = {"rate": 0.0, "rng": None}
+_DROPPABLE = ("begin", "grant", "final", "final_ack", "hb")
+
+
+def set_ctrl_drop(rate: float, seed: int = 0) -> None:
+    """Drop each outgoing steady-state control notif (BEGIN/GRANT/FINAL/
+    final-ack/heartbeat) with probability ``rate``, process-wide —
+    counted on ``disagg_ctrl_dropped_total{msg}``. 0 disables."""
+    import random
+
+    _CTRL_DROP["rate"] = float(rate)
+    _CTRL_DROP["rng"] = random.Random(seed)
 
 
 # -- wire format ------------------------------------------------------------
@@ -152,6 +202,11 @@ def wire_format_for(backend) -> KVWireFormat:
 
 # -- control plane ----------------------------------------------------------
 def _send_msg(ep, conn: int, msg: Dict) -> None:
+    rate = _CTRL_DROP["rate"]
+    if rate and msg.get("t") in _DROPPABLE \
+            and _CTRL_DROP["rng"].random() < rate:
+        _CTRL_DROPPED.inc(msg=str(msg.get("t")))
+        return
     ep.send_notif(conn, json.dumps(msg).encode())
 
 
@@ -179,6 +234,8 @@ class _TxStream:
     eos_id: Optional[int]
     t_submit_wall: float
     trace: Optional["obs.TraceContext"] = None  # rides BEGIN verbatim
+    begin_msg: Optional[Dict] = None  # resent verbatim until GRANTed
+    t_begin_sent: float = 0.0  # monotonic mark of the last BEGIN tx
     t_admit_wall: Optional[float] = None
     t_done_wall: Optional[float] = None
     slabs: List[Tuple[int, int, np.ndarray, np.ndarray]] = field(
@@ -203,9 +260,13 @@ class PrefillWorker:
     """
 
     def __init__(self, engine: ServingEngine, ep, ip: str, port: int,
-                 *, timeout_ms: int = 30000):
+                 *, timeout_ms: int = 30000,
+                 heartbeat_s: Optional[float] = 0.5,
+                 ctrl_retry_s: float = 0.5):
         _init_prefill_worker(self, engine, ep, ep.connect(ip, port),
-                             timeout_ms=timeout_ms)
+                             timeout_ms=timeout_ms,
+                             heartbeat_s=heartbeat_s,
+                             ctrl_retry_s=ctrl_retry_s)
 
     # -- submission ----------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: int = 16,
@@ -231,13 +292,15 @@ class PrefillWorker:
         st = _TxStream(req.rid, prompt, max_new_tokens, eos_id,
                        t_submit_wall=time.time(), trace=ctx)
         self._streams[req.rid] = st
-        _send_msg(self.ep, self.conn, {
+        st.begin_msg = {
             "t": "begin", "rid": req.rid, "prompt": prompt.tolist(),
             "max_new_tokens": max_new_tokens, "eos_id": eos_id,
             "priority": priority,
             "t_submit": st.t_submit_wall,
             "trace": ctx.to_wire(),
-        })
+        }
+        st.t_begin_sent = time.monotonic()
+        _send_msg(self.ep, self.conn, st.begin_msg)
         return req
 
     # -- engine hook ---------------------------------------------------
@@ -329,9 +392,19 @@ class PrefillWorker:
         return max(ungranted, hinted)
 
     def pump(self) -> None:
-        """Drain GRANTs, ship queued slabs, close finished streams (wait
-        for every slab's completion, then send FINAL — writes and notifs
-        share the conn, so the decode side sees all rows before FINAL)."""
+        """Drain GRANTs/acks, retry unanswered control messages, ship
+        queued slabs, close finished streams (wait for every slab's
+        completion, then send FINAL — writes and notifs share the conn,
+        so the decode side sees all rows before FINAL).
+
+        The control plane is LOSS-TOLERANT (docs/SERVING.md): a BEGIN
+        with no GRANT inside ``ctrl_retry_s`` is resent verbatim (the
+        decode side's rid-keyed dedup makes the retry idempotent — a
+        lost GRANT never double-reserves), and a FINAL waits for an
+        explicit ``final_ack`` and is resent until it lands (the decode
+        side re-acks an already-adopted rid without re-adopting). Both
+        retries count on ``disagg_ctrl_retries_total{msg}``."""
+        now_m = time.monotonic()
         for _, msg in _drain_msgs(self.ep):
             if msg.get("t") == "grant":
                 st = self._streams.get(msg["rid"])
@@ -340,9 +413,21 @@ class PrefillWorker:
                 if "free" in msg:
                     self.decode_hint = {"free": int(msg["free"]),
                                         "queued": int(msg["queued"])}
+            elif msg.get("t") == "final_ack":
+                self._finaled.pop(int(msg["rid"]), None)
             elif msg.get("t") == "clock_pong":
                 self._on_clock_pong(msg)
+        if self.heartbeat_s is not None \
+                and now_m - self._last_hb > self.heartbeat_s:
+            self._last_hb = now_m
+            _send_msg(self.ep, self.conn, {"t": "hb"})
         for st in self._streams.values():
+            if (st.remote_slot is None
+                    and now_m - st.t_begin_sent > self._ctrl_retry_s):
+                # GRANT (or the BEGIN itself) lost: resend, idempotent
+                st.t_begin_sent = now_m
+                _CTRL_RETRIES.inc(msg="begin")
+                _send_msg(self.ep, self.conn, st.begin_msg)
             if st.remote_slot is not None and st.slabs:
                 self._ship(st)
         for rid, st in list(self._streams.items()):
@@ -351,10 +436,14 @@ class PrefillWorker:
                 continue
             for xid in st.xids:
                 if not self.ep.wait(xid, self._timeout_ms):
+                    obs.counter("p2p_transfer_failures_total").inc(
+                        reason="kv_slab")
+                    obs.instant("p2p_transfer_failed", track="wire",
+                                reason="kv_slab", rid=rid)
                     raise IOError(
                         f"kv stream rid={rid}: slab write undelivered"
                     )
-            _send_msg(self.ep, self.conn, {
+            final = {
                 "t": "final", "rid": rid,
                 "length": int(st.prompt.size),
                 "first_token": int(st.first_token),
@@ -363,9 +452,17 @@ class PrefillWorker:
                 "t_submit": st.t_submit_wall,
                 "t_admit": st.t_admit_wall,
                 "t_done": st.t_done_wall,
-            })
+            }
+            _send_msg(self.ep, self.conn, final)
             _STREAM_REQS.inc(role="tx")
+            # await the decode side's final_ack; resent until it lands
+            self._finaled[rid] = {"msg": final, "t_sent": now_m}
             del self._streams[rid]
+        for rid, ent in self._finaled.items():
+            if now_m - ent["t_sent"] > self._ctrl_retry_s:
+                ent["t_sent"] = now_m
+                _CTRL_RETRIES.inc(msg="final")
+                _send_msg(self.ep, self.conn, ent["msg"])
 
     def _send_clock_ping(self) -> None:
         self._clock_pings_left -= 1
@@ -415,15 +512,37 @@ class PrefillWorker:
         self.pump()
 
     def idle(self) -> bool:
-        return not self.engine.has_work() and not self._streams
+        return (not self.engine.has_work() and not self._streams
+                and not self._finaled)
+
+    def outstanding(self) -> Dict[str, List[int]]:
+        """What this worker is still waiting on, by kind — the structured
+        face of a stuck drain (``ungranted`` BEGINs with no GRANT,
+        ``granted`` streams mid-ship, ``unacked_final`` FINALs with no
+        ack): a timeout names these instead of raising context-free."""
+        return {
+            "ungranted": sorted(rid for rid, st in self._streams.items()
+                                if st.remote_slot is None),
+            "granted": sorted(rid for rid, st in self._streams.items()
+                              if st.remote_slot is not None),
+            "unacked_final": sorted(self._finaled),
+        }
 
     def drain(self, timeout_s: float = 120.0) -> None:
         deadline = time.monotonic() + timeout_s
         while not self.idle():
             if time.monotonic() > deadline:
+                _DRAIN_TIMEOUTS.inc(role="prefill")
+                out = self.outstanding()
+                obs.instant("drain_timeout", track="wire", role="prefill",
+                            **{k: len(v) for k, v in out.items()})
                 raise TimeoutError(
-                    f"prefill drain stalled: {len(self._streams)} streams "
-                    f"open (ungranted decode slots?)"
+                    f"prefill drain stalled after {timeout_s}s: "
+                    f"ungranted BEGINs rid={out['ungranted']}, "
+                    f"granted streams mid-ship rid={out['granted']}, "
+                    f"unacked FINALs rid={out['unacked_final']}, "
+                    f"engine queued={self.engine.sched.qsize} "
+                    f"active={len(self.engine._by_slot)}"
                 )
             self.step()
             if not self.engine.has_work():
@@ -440,12 +559,42 @@ class DecodeWorker:
     GRANT is the admission backpressure), streamed slabs land one-sided in
     the registered host mirror, FINAL imports rows [0, plen) into the
     engine's device cache and ``adopt()``s the request.
+
+    **Lease-guarded grants** (docs/SERVING.md): with ``grant_lease_s``
+    a GRANT is a *lease*, not a gift — if the stream's FINAL does not
+    land before expiry (the prefill peer died post-GRANT, or its FINAL
+    is lost forever), the reserved slot is reclaimed into the pool,
+    counted on ``disagg_leases_expired_total{reason}``, and a late
+    FINAL for the expired stream is dropped (``disagg_stale_finals_
+    total``) instead of importing into the slot's new occupant. BEGINs
+    are **idempotent** by (conn, rid): a retried BEGIN whose GRANT was
+    lost re-answers with the SAME slot (counted ``disagg_ctrl_retries_
+    total{msg="grant"}``) and never double-reserves; a retried FINAL
+    after adoption re-acks without re-adopting. ``detector`` plugs a
+    :class:`~uccl_tpu.serving.health.FailureDetector` under the conn
+    set — every control notif counts as a heartbeat (plus explicit hb
+    messages from a ``heartbeat_s`` prefill worker), and a conn going
+    DEAD expires its leases immediately (reason="peer_dead").
     """
 
     def __init__(self, engine: ServingEngine, ep,
-                 pull_rate_bps: Optional[float] = None):
+                 pull_rate_bps: Optional[float] = None,
+                 grant_lease_s: Optional[float] = None,
+                 detector=None):
         self.engine = engine
         self.ep = ep
+        self.grant_lease_s = grant_lease_s
+        self.detector = detector
+        self._pending_keys: set = set()  # (conn, rid) of queued BEGINs
+        # settled-stream dedup windows, insertion-ordered and BOUNDED: a
+        # retried BEGIN/FINAL only arrives within the sender's retry
+        # horizon, so a long-lived decode worker must not accumulate one
+        # key per request forever — past the cap the oldest settles for
+        # good (a duplicate for an evicted key would raise as unknown,
+        # which by then is the right answer)
+        self._adopted_keys: Dict[Tuple[int, int], None] = {}
+        self._expired_leases: Dict[Tuple[int, int], None] = {}
+        self._settled_cap = 4096
         self.fmt = wire_format_for(engine.backend)
         self.mirror_k = np.zeros(self.fmt.pool_shape(), KV_DTYPE)
         self.mirror_v = np.zeros(self.fmt.pool_shape(), KV_DTYPE)
@@ -515,6 +664,8 @@ class DecodeWorker:
         # a conn attaching AFTER earlier conns all said BYE re-opens the
         # decoder (sequential fan-in must not inherit a stale closed flag)
         self.closed = self._n_byes >= self._n_conns
+        if self.detector is not None:
+            self.detector.register(conn)
         self.ep.send(conn, json.dumps({
             "t": "hello", "fmt": self.fmt.to_meta(),
             "k_fifo": _b64(self.ep.advertise(self._mr_k)),
@@ -537,11 +688,53 @@ class DecodeWorker:
                 pass  # peer already gone
         self.channels = []
 
+    def _settle(self, window: Dict, key: Tuple[int, int]) -> None:
+        window[key] = None
+        while len(window) > self._settled_cap:
+            window.pop(next(iter(window)))
+
     # -- control-plane handling ----------------------------------------
     def poll(self) -> None:
         for conn, msg in _drain_msgs(self.ep):
             kind = msg.get("t")
+            if self.detector is not None:
+                # ANY control traffic proves the peer alive; hb messages
+                # exist so an idle peer still proves it
+                self.detector.heartbeat(conn)
+            if kind == "hb":
+                continue
             if kind == "begin":
+                key = (conn, int(msg["rid"]))
+                granted = self._granted.get(key)
+                if granted is not None:
+                    # retried BEGIN whose GRANT was lost: idempotent —
+                    # re-answer with the SAME slot, never re-reserve.
+                    # Contact also RENEWS the lease (and lifts any
+                    # quarantine): the retry proves the sender never had
+                    # a grant, so nothing was ever shipped at this slot
+                    # — the lease clock restarts from a real exchange,
+                    # not from the first (lost) GRANT
+                    granted["t_grant"] = time.monotonic()
+                    granted.pop("expired", None)
+                    _CTRL_RETRIES.inc(msg="grant")
+                    _send_msg(self.ep, conn, {
+                        "t": "grant", "rid": key[1],
+                        "slot": granted["slot"],
+                        "free": self.engine.pool.n_free,
+                        "queued": len(self._pending),
+                    })
+                    continue
+                if key in self._expired_leases:
+                    # the old incarnation was reclaimed, yet the sender
+                    # is STILL asking to begin — it never held a grant
+                    # (it only retries while ungranted), so nothing of
+                    # the old stream was ever shipped: treat it as a
+                    # fresh stream instead of wedging the retry loop
+                    self._expired_leases.pop(key, None)
+                if (key in self._pending_keys
+                        or key in self._adopted_keys):
+                    continue  # duplicate of a queued/settled stream
+                self._pending_keys.add(key)
                 self._pending.append((conn, msg))
             elif kind == "final":
                 self._on_final(conn, msg)
@@ -565,7 +758,73 @@ class DecodeWorker:
             elif kind == "bye":
                 self._n_byes += 1
                 self.closed = self._n_byes >= self._n_conns
+        if self.detector is not None:
+            self.detector.tick()
+        self._expire_leases()
         self._try_grant()
+
+    def _expire_leases(self) -> None:
+        """Reclaim GRANTed slots whose stream never FINALed: past the
+        lease (reason=timeout), or the moment the granting conn's peer
+        is declared DEAD by the failure detector (reason=peer_dead).
+        The reclaimed slot returns to the pool — the decode side never
+        leaks capacity to a dead prefill worker — and the stream key is
+        remembered so a late FINAL is dropped, not imported.
+
+        One hazard needs care: a peer that is provably ALIVE (still
+        heartbeating) but stalled mid-ship may still be one-sided-
+        writing slabs into the slot's mirror rows — freeing the slot now
+        would hand those rows to a new occupant mid-write. So with a
+        detector attached, a timed-out lease on a live conn is
+        **quarantined** instead: the expiry is counted (the lease DID
+        lapse) but the slot stays reserved until the stream terminates
+        (its FINAL arrives and is dropped as stale), the peer dies, or a
+        retried BEGIN renews the lease (nothing was ever shipped — the
+        poll handler's renewal path). Without a detector the decode side
+        cannot tell alive from dead and frees at timeout — size
+        ``grant_lease_s`` above the worst-case ship stall there, or run
+        heartbeats + a detector (the default pairing)."""
+        if self.grant_lease_s is None and self.detector is None:
+            return
+        now_m = time.monotonic()
+        for key, st in list(self._granted.items()):
+            dead_peer = False
+            if self.detector is not None:
+                try:
+                    dead_peer = self.detector.state(key[0]) == _PEER_DEAD
+                except KeyError:
+                    pass
+            overdue = (self.grant_lease_s is not None
+                       and now_m - st["t_grant"] > self.grant_lease_s)
+            if dead_peer:
+                self._reclaim(key, st, "peer_dead")
+            elif overdue:
+                if self.detector is not None:
+                    if not st.get("expired"):
+                        st["expired"] = True
+                        _LEASES_EXPIRED.inc(reason="timeout")
+                        trace = st.get("trace")
+                        obs.instant(
+                            "lease_expired", track="wire", conn=key[0],
+                            rid=key[1], slot=st["slot"],
+                            reason="timeout", quarantined=True,
+                            trace_id=(trace.trace_id if trace
+                                      else None))
+                else:
+                    self._reclaim(key, st, "timeout")
+
+    def _reclaim(self, key, st, reason: str) -> None:
+        """Actually free a granted slot and settle the stream key (late
+        FINALs drop). Counts the expiry unless quarantine already did."""
+        del self._granted[key]
+        self._settle(self._expired_leases, key)
+        self.engine.pool.free(st["slot"])
+        if not st.get("expired"):
+            _LEASES_EXPIRED.inc(reason=reason)
+        trace = st.get("trace")
+        obs.instant("lease_reclaimed", track="wire", conn=key[0],
+                    rid=key[1], slot=st["slot"], reason=reason,
+                    trace_id=trace.trace_id if trace else None)
 
     def _try_grant(self) -> None:
         while self._pending:
@@ -574,9 +833,13 @@ class DecodeWorker:
             if slot is None:
                 break  # pool full: BEGINs wait (admission backpressure)
             self._pending.popleft()
+            self._pending_keys.discard((conn, int(msg["rid"])))
             trace = obs.TraceContext.from_wire(msg.get("trace"))
             self._granted[(conn, int(msg["rid"]))] = {
-                "slot": slot, "msg": msg, "t_grant": time.time(),
+                # monotonic: the lease is a purely LOCAL interval (never
+                # crosses the wire), and a wall-clock step (NTP, VM
+                # resume) must not spuriously expire every live lease
+                "slot": slot, "msg": msg, "t_grant": time.monotonic(),
                 "trace": trace,
             }
             obs.instant("grant", track="wire", rid=int(msg["rid"]),
@@ -594,7 +857,33 @@ class DecodeWorker:
             })
 
     def _on_final(self, conn: int, final: Dict) -> None:
-        st = self._granted.pop((conn, int(final["rid"])), None)
+        key = (conn, int(final["rid"]))
+        if key in self._adopted_keys:
+            # retried FINAL (our ack was lost): re-ack, never re-adopt
+            _send_msg(self.ep, conn, {"t": "final_ack", "rid": key[1]})
+            return
+        if key in self._expired_leases:
+            # the lease already reclaimed this stream's slot — importing
+            # now would corrupt the slot's new occupant. Ack it anyway so
+            # the sender stops retrying a stream the fleet gave up on.
+            _STALE_FINALS.inc()
+            obs.instant("stale_final", track="wire", conn=conn,
+                        rid=key[1])
+            _send_msg(self.ep, conn, {"t": "final_ack", "rid": key[1]})
+            return
+        quarantined = self._granted.get(key)
+        if quarantined is not None and quarantined.get("expired"):
+            # a QUARANTINED lease's stream just terminated: this FINAL is
+            # the last thing the stream will ever write, so the slot is
+            # finally safe to free — but the lease lapsed long ago, so
+            # the request itself is dropped as stale, never adopted
+            _STALE_FINALS.inc()
+            obs.instant("stale_final", track="wire", conn=conn,
+                        rid=key[1], quarantined=True)
+            self._reclaim(key, quarantined, "timeout")
+            _send_msg(self.ep, conn, {"t": "final_ack", "rid": key[1]})
+            return
+        st = self._granted.pop(key, None)
         if st is None:
             raise KeyError(
                 f"FINAL for unknown stream rid={final['rid']} (no BEGIN "
@@ -639,6 +928,8 @@ class DecodeWorker:
         )
         req.cache_hit_len = int(final.get("cache_hit_len", 0))
         self.origin[req.rid] = (conn, int(final["rid"]))
+        self._settle(self._adopted_keys, key)
+        _send_msg(self.ep, conn, {"t": "final_ack", "rid": key[1]})
         if req.is_done():  # max_new_tokens == 1 or EOS at the first token
             self._finished.append(req)
 
@@ -667,9 +958,18 @@ class DecodeWorker:
             if not self.engine.has_work():
                 time.sleep(0.001)
             if time.monotonic() > deadline:
+                _DRAIN_TIMEOUTS.inc(role="decode")
+                open_keys = sorted(self._granted)
+                pending = sorted((c, int(m["rid"]))
+                                 for c, m in self._pending)
+                obs.instant("drain_timeout", track="wire", role="decode",
+                            granted=len(open_keys), pending=len(pending))
                 raise TimeoutError(
-                    f"decode serve stalled at {len(done)} finished "
-                    f"({len(self._granted)} streams open)"
+                    f"decode serve stalled after {timeout_s}s at "
+                    f"{len(done)} finished: granted-unFINALed "
+                    f"(conn,rid)={open_keys}, queued BEGINs "
+                    f"(conn,rid)={pending}, engine "
+                    f"active={len(self.engine._by_slot)}"
                 )
 
 
@@ -708,14 +1008,20 @@ def make_local_pair(prefill_engine: ServingEngine,
                     *,
                     transport: str = "ep",
                     pull_rate_bps: Optional[float] = None,
+                    grant_lease_s: Optional[float] = None,
+                    detector=None,
                     **transport_kw) -> Tuple[PrefillWorker, DecodeWorker]:
     """Both roles in ONE process over loopback endpoints — the in-process
     harness tests and benches drive (the example runs the same classes in
     two real processes). ``transport``/``pull_rate_bps``/extras route the
-    KV plane over the windowed Channel transport (add_local_prefill)."""
+    KV plane over the windowed Channel transport (add_local_prefill);
+    ``grant_lease_s``/``detector`` arm the decode side's lease guard and
+    failure detector (docs/SERVING.md fault tolerance)."""
     from uccl_tpu.p2p import Endpoint
 
-    dw = DecodeWorker(decode_engine, Endpoint(), pull_rate_bps=pull_rate_bps)
+    dw = DecodeWorker(decode_engine, Endpoint(),
+                      pull_rate_bps=pull_rate_bps,
+                      grant_lease_s=grant_lease_s, detector=detector)
     return add_local_prefill(dw, prefill_engine, transport=transport,
                              **transport_kw), dw
 
@@ -727,7 +1033,9 @@ def add_local_prefill(dw: DecodeWorker,
                       n_paths: int = 2,
                       chunk_bytes: Optional[int] = None,
                       pull: bool = False,
-                      window_cc: Optional[str] = None) -> PrefillWorker:
+                      window_cc: Optional[str] = None,
+                      heartbeat_s: Optional[float] = 0.5,
+                      ctrl_retry_s: float = 0.5) -> PrefillWorker:
     """Attach one more in-process prefill worker to ``dw`` — the loopback
     fan-in arrangement (N prefill engines streaming into one decode pool;
     each stream is its own conn, so GRANT/FINAL bookkeeping stays
@@ -775,13 +1083,16 @@ def add_local_prefill(dw: DecodeWorker,
         if window_cc:
             chan.enable_window_cc(window_cc)
         _init_prefill_worker(pw, prefill_engine, ep_p, chan.conns[0],
-                             chan=chan)
+                             chan=chan, heartbeat_s=heartbeat_s,
+                             ctrl_retry_s=ctrl_retry_s)
     elif transport == "ep":
         # loopback: connect() completes against the listening endpoint
         # before accept() is called (the test_p2p pair idiom)
         conn_p = ep_p.connect("127.0.0.1", dw.ep.port)
         dw.attach()
-        _init_prefill_worker(pw, prefill_engine, ep_p, conn_p)
+        _init_prefill_worker(pw, prefill_engine, ep_p, conn_p,
+                             heartbeat_s=heartbeat_s,
+                             ctrl_retry_s=ctrl_retry_s)
     else:
         raise ValueError(f"unknown transport {transport!r}")
     return pw
@@ -789,11 +1100,18 @@ def add_local_prefill(dw: DecodeWorker,
 
 def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,
                          conn: int, timeout_ms: int = 30000,
-                         chan=None) -> None:
+                         chan=None, heartbeat_s: Optional[float] = 0.5,
+                         ctrl_retry_s: float = 0.5) -> None:
     """PrefillWorker init against an already-open conn (the local-pair
     path, where connect must precede the peer's accept). ``chan`` routes
     KV slabs over the windowed multipath Channel transport (conn must be
-    its path-0 conn — the notif/control path)."""
+    its path-0 conn — the notif/control path). ``heartbeat_s`` sends a
+    liveness hb notif at that interval (the decode side's failure
+    detector feeds off it; ON by default — a detector-armed decode peer
+    would otherwise age an idle-but-healthy conn to DEAD, and one tiny
+    notif per interval is free; None disables); ``ctrl_retry_s`` is the
+    control-plane retransmission window (BEGIN without GRANT, FINAL
+    without ack)."""
     if engine.prefill_chunk is None:
         raise ValueError("PrefillWorker needs a chunked engine")
     if engine.chunk_sink is not None:
@@ -818,7 +1136,11 @@ def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,
     pw._fifo_k = FifoItem.unpack(_unb64(hello["k_fifo"]))
     pw._fifo_v = FifoItem.unpack(_unb64(hello["v_fifo"]))
     pw._streams = {}
+    pw._finaled = {}  # rid -> FINAL awaiting the decode side's ack
     pw._timeout_ms = timeout_ms
+    pw._ctrl_retry_s = ctrl_retry_s
+    pw.heartbeat_s = heartbeat_s
+    pw._last_hb = time.monotonic()
     # decode-peer capacity as of the last GRANT (free slots + pending
     # BEGIN depth) — feeds adoption_backpressure() / the replica router
     pw.decode_hint = None
@@ -859,8 +1181,15 @@ def drive_pair(pw: PrefillWorker, dw: DecodeWorker, prompts, arrivals,
         if not pw.engine.has_work() and not dw.engine.has_work():
             time.sleep(0.0005)
         if time.monotonic() > deadline:
+            _DRAIN_TIMEOUTS.inc(role="pair")
+            out = pw.outstanding()
             raise TimeoutError(
-                f"disagg drive stalled: {len(finished)}/{accepted} finished"
+                f"disagg drive stalled after {timeout_s}s: "
+                f"{len(finished)}/{accepted} finished; prefill side "
+                f"ungranted rid={out['ungranted']} granted "
+                f"rid={out['granted']} unacked-final "
+                f"rid={out['unacked_final']}; decode side granted "
+                f"(conn,rid)={sorted(dw._granted)}"
             )
     return finished, now() - t0
 
@@ -882,7 +1211,15 @@ def warm_pair(pw: PrefillWorker, dw: DecodeWorker, prompt_len: int,
             pw.step()
             got.extend(dw.step())
             if time.monotonic() > deadline:
-                raise TimeoutError("disagg warmup stalled")
+                _DRAIN_TIMEOUTS.inc(role="pair")
+                out = pw.outstanding()
+                raise TimeoutError(
+                    f"disagg warmup stalled after 120s: prefill "
+                    f"ungranted rid={out['ungranted']} granted "
+                    f"rid={out['granted']} unacked-final "
+                    f"rid={out['unacked_final']}; decode granted "
+                    f"(conn,rid)={sorted(dw._granted)}"
+                )
     pw.drain()
     if pw.engine.prefix_cache is not None:
         pw.engine.prefix_cache.clear(pw.engine.pool)

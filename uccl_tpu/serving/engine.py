@@ -487,6 +487,7 @@ class ServingEngine:
         self.metrics = ServingMetrics()
         self._by_slot = {}  # slot -> Request (every occupied slot)
         self._prefilling = {}  # slot -> Request mid-prefill (chunked mode)
+        self.dead = False  # killed (chaos / failure injection): step() raises
         self._last_tok = np.zeros(backend.n_slots, np.int32)
         self._next_rid = 0
         self._stats_name: Optional[str] = None
@@ -660,6 +661,36 @@ class ServingEngine:
                                finished)
         return req
 
+    # -- failure injection + recovery ---------------------------------------
+    def kill(self) -> None:
+        """Simulate this replica's process dying (the chaos harness /
+        failure-detector testbed): the engine stops serving — ``step()``
+        raises, the Router's liveness probe sees it dead — but its
+        bookkeeping stays frozen until recovery :meth:`evacuate`s it.
+        There is no un-kill: a returning process is a NEW replica
+        (``Router.attach``), exactly as in a real fleet."""
+        self.dead = True
+
+    def evacuate(self):
+        """Strip every queued and in-slot request out of this engine —
+        the dead-replica recovery feed (uccl_tpu/serving/router.py): the
+        requests will be re-run elsewhere (or counted lost), and THIS
+        engine's queue/slot bookkeeping is zeroed so fleet aggregates
+        (qsize, n_active, leaked) stop counting phantom state that died
+        with the process. Parked prefix-cache donors are reclaimed too —
+        a dead replica's cache is gone. Returns ``(queued, active)``
+        request lists; metrics accounting is the CALLER's job (the
+        router counts each on the dead engine's ``lost`` term)."""
+        queued = self.sched.take_all()
+        active = list(self._by_slot.values())
+        for slot in list(self._by_slot):
+            self.pool.free(slot)
+        self._by_slot.clear()
+        self._prefilling.clear()
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear(self.pool)
+        return queued, active
+
     # -- the engine iteration ----------------------------------------------
     def has_work(self) -> bool:
         return bool(self.sched.qsize or self._by_slot)
@@ -669,6 +700,11 @@ class ServingEngine:
         Whole-prompt mode prefills admitted prompts in full; chunked mode
         advances every mid-prefill request by one chunk (budget-gated
         admission). Returns requests finished during this step."""
+        if self.dead:
+            raise RuntimeError(
+                "engine is dead (killed): a dead replica cannot step — "
+                "recover its requests via Router health handling"
+            )
         t0 = now()
         tr = obs.get_tracer()
         ts0 = tr.now_us() if tr is not None else 0.0

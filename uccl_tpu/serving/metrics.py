@@ -120,6 +120,12 @@ class ServingMetrics:
         self.submitted = 0
         self.rejected = 0
         self.expired = 0  # queued requests dropped by deadline or cancel()
+        # requests stranded on THIS engine when its replica died (the
+        # fault-recovery sink term): a recovered request's resubmission on
+        # a survivor is a NEW submission there, so the dead copy must
+        # leave through `lost` for fleet conservation to stay exact —
+        # submitted == completed+active+queued+rejected+expired+lost
+        self.lost = 0
         self.admitted = 0
         self.adopted = 0  # requests entering via adopt() (disagg decode)
         self.preempted = 0  # pauses of a lower-class request at a chunk boundary
@@ -180,6 +186,13 @@ class ServingMetrics:
     def on_expire(self, req: Request) -> None:
         """A queued request left by deadline expiry or cancellation."""
         self.expired += 1
+
+    def on_lost(self, req: Request) -> None:
+        """A request stranded on this (dead) engine left the fleet — or
+        was re-run on a survivor as a metrically-new submission. Either
+        way THIS engine's copy exits through the `lost` term (the
+        conservation invariant's recovery sink, docs/SERVING.md)."""
+        self.lost += 1
 
     def on_admit(self, req: Request) -> None:
         self.admitted += 1
@@ -282,11 +295,15 @@ class ServingMetrics:
                  n_slots: int = 0, occupancy: float = 0.0) -> Dict:
         """JSON-ready state. Conservation invariant (tested):
         submitted == completed + active + queued + rejected + expired
-        (preemptions move requests between active and queued, never out)."""
+        + lost (preemptions move requests between active and queued,
+        never out; `lost` is the fault-recovery sink — a request
+        stranded on a dead replica leaves here, and its survivor-side
+        re-run is a new submission there)."""
         snap = {
             "submitted": self.submitted,
             "rejected": self.rejected,
             "expired": self.expired,
+            "lost": self.lost,
             "admitted": self.admitted,
             "completed": self.completed,
             "queued": queued,

@@ -37,6 +37,8 @@ class RequestState(enum.Enum):
     FINISHED = "finished"
     REJECTED = "rejected"
     EXPIRED = "expired"  # left the queue on deadline expiry or cancel()
+    LOST = "lost"  # stranded on a dead replica (recovery re-runs a NEW
+    # Request under the same trace_id on a survivor; this copy is done)
 
 
 @dataclass
@@ -163,4 +165,4 @@ class Request:
 
     def is_done(self) -> bool:
         return self.state in (RequestState.FINISHED, RequestState.REJECTED,
-                              RequestState.EXPIRED)
+                              RequestState.EXPIRED, RequestState.LOST)

@@ -68,6 +68,17 @@ class FIFOScheduler:
         signal sums outstanding work over these)."""
         return [r for q in self._queues() for r in q]
 
+    def take_all(self) -> List[Request]:
+        """Remove and return EVERY queued request, in admission order —
+        the dead-replica evacuation (the router resubmits them to
+        survivors, or counts them lost). The queues end empty."""
+        out: List[Request] = []
+        for q in self._queues():
+            out.extend(q)
+            q.clear()
+        self._n_deadlined = 0
+        return out
+
     def submit(self, req: Request) -> bool:
         """Queue a request; False = rejected (queue full, backpressure)."""
         if self.max_queue is not None and self.qsize >= self.max_queue:

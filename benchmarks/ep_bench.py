@@ -239,6 +239,180 @@ def bench_quant_sweep(jax, *, tokens, hidden, experts, topk, iters, mode,
     return line
 
 
+def bench_skew_sweep(jax, *, tokens, hidden, experts, topk, iters, alphas,
+                     modes, fp8=False, n_chunks=1):
+    """Contention-aware scheduled a2a sweep: Zipf(alpha) routing skew x
+    ``a2a_sched`` mode (docs/EP_BENCH.md "scheduled all-to-all").
+
+    Per alpha, one routing draw (uccl_tpu.ep.a2a_sched.zipf_topk) fixes the
+    traffic matrix for every mode arm, so the wire ORDER is the only
+    difference. Every arm label comes off REAL counters, never the CLI
+    knob mirrored back: the algo that actually drove the exchange from the
+    ``collective_plan_total{verb="ep_a2a"}`` delta, the round count from
+    ``ep_a2a_rounds_total``, wire bytes from ``ep_bytes_total``, and any
+    budget downgrade from ``ep_wire_fallback_total``. The off-arm recv
+    buffer is the exactness anchor: scheduled arms must match it
+    bit-for-bit (the schedule is a pure reordering of the same write-once
+    DMAs). ``fp8``/``n_chunks`` compose the sweep with the quantized wire
+    and chunk pipelining — on the CPU-fit interpret budget that
+    composition is what makes the model's sched/streams crossover
+    physically reachable (the per-chunk gate, not the monolithic one).
+    Each sweep also records the cost model's round-time for BOTH wire
+    orders at the measured skew (``model``): on interpret substrates the
+    wall-clock columns measure the rendezvous emulation, so the audited
+    model delta is the honest "what a real wire would save" number."""
+    import json
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from uccl_tpu import obs
+    from uccl_tpu.collective import dma
+    from uccl_tpu.collective import plan as _plan
+    from uccl_tpu.ep import Buffer, a2a_sched
+    from uccl_tpu.obs import counters as obsc
+
+    n = len(jax.devices())
+    # single-named-axis mesh: the legacy discharge interpreter's pallas
+    # addressing constraint, same as the --wire pallas arm above
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    experts = max(experts, n)
+    experts -= experts % n
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal((n, tokens, hidden)).astype(np.float32)
+    wts_np = np.full((n, tokens, topk), 1.0 / topk, np.float32)
+
+    def _snap(name, **match):
+        return {tuple(sorted(lb.items())): v
+                for lb, v in obsc.counter(name).samples()
+                if all(lb.get(k) == v2 for k, v2 in match.items())}
+
+    def _delta(name, before, **match):
+        return {k: int(v - before.get(k, 0))
+                for k, v in _snap(name, **match).items()
+                if v - before.get(k, 0) > 0}
+
+    wire_dtype = "fp8" if fp8 else None
+    sweeps = []
+    for alpha in alphas:
+        idx_np = a2a_sched.zipf_topk(rng, n, tokens, topk, experts, alpha)
+        arms = []
+        ref_recv = None
+        traffic = None
+        for mode in modes:
+            r0 = _snap("ep_a2a_rounds_total")
+            p0 = _snap("collective_plan_total", verb="ep_a2a")
+            b0 = _ep_bytes_snapshot()
+            f0 = _snap("ep_wire_fallback_total")
+            buf = Buffer(mesh, "dp", num_experts=experts,
+                         num_selected=topk, wire="pallas",
+                         n_chunks=n_chunks, wire_dtype=wire_dtype,
+                         a2a_sched=mode)
+            if traffic is None:
+                traffic = a2a_sched.traffic_from_topk(
+                    idx_np, experts, buf.capacity(tokens), n
+                )
+            if mode != "off":
+                # rebuild with the measured matrix (static per Buffer)
+                buf = Buffer(mesh, "dp", num_experts=experts,
+                             num_selected=topk, wire="pallas",
+                             n_chunks=n_chunks, wire_dtype=wire_dtype,
+                             a2a_sched=mode, a2a_traffic=traffic)
+            x = buf.device_put(x_np)
+            idx = buf.device_put(idx_np)
+            wts = buf.device_put(wts_np)
+            recv, handle = buf.dispatch(x, idx, wts)
+            buf.combine(recv, handle)
+            rounds = _delta("ep_a2a_rounds_total", r0)
+            plans = _delta("collective_plan_total", p0, verb="ep_a2a")
+            wire_bytes = _ep_bytes_delta(b0)
+            fallbacks = {f"{dict(k)['what']}:{dict(k)['reason']}": v
+                         for k, v in
+                         _delta("ep_wire_fallback_total", f0).items()}
+            algos = sorted({dict(k)["algo"] for k in plans}) or (
+                ["ep_streams"] if mode == "off" else [])
+            recv_np = np.asarray(recv)
+            if mode == "off":
+                ref_recv = recv_np
+            dt_dispatch = _time_fn(
+                lambda a, b, c: buf.dispatch(a, b, c)[0],
+                (x, idx, wts), iters,
+            )
+            dt_combine = _time_fn(
+                lambda y: buf.combine(y, handle), (recv,), iters
+            )
+            arms.append({
+                "a2a_sched": mode,
+                "algo": "+".join(algos),
+                "sched_active": bool(handle.a2a_sched),
+                "rounds": {dict(k)["algo"]: v for k, v in rounds.items()},
+                "dispatch_us": round(dt_dispatch * 1e6, 1),
+                "combine_us": round(dt_combine * 1e6, 1),
+                "wire_bytes": wire_bytes,
+                "wire_fallbacks": fallbacks,
+                "bit_identical_to_off": bool(
+                    ref_recv is not None
+                    and np.array_equal(recv_np, ref_recv)
+                ),
+            })
+        # the cost model's round-time for BOTH wire orders at the measured
+        # skew and the REAL round count (plan_ep_a2a's own arithmetic, one
+        # quiet plan call cross-checks the reconstruction) — on interpret
+        # substrates this is the honest perf column; the wall clocks above
+        # time the rendezvous emulation, not a wire
+        skew_v = a2a_sched.skew(traffic)
+        rounds_n = len(a2a_sched.wire_schedule(traffic, n)[0])
+        cap = buf.capacity(tokens)
+        shape = (n, experts // n, cap, hidden)
+        cep = buf._sched_chunk_charge(n_chunks, cap,
+                                      (experts // n) * hidden)
+        planner = _plan.get_planner()
+        mdl = planner.model
+        mean_bytes = (n - 1) / n * planner.wire_bytes(
+            shape, np.float32, wire_dtype)
+        streams_us = (mdl.alpha_us * (n - 1)
+                      + mdl.beta_us_per_byte * max(1.0, skew_v) * mean_bytes
+                      + mdl.gamma_us)
+        sched_us = (mdl.alpha_us * rounds_n
+                    + mdl.beta_us_per_byte * mean_bytes
+                    + mdl.gamma_us * rounds_n)
+        p = planner.plan_ep_a2a(
+            shape, np.float32, n, skew=skew_v, n_rounds=rounds_n,
+            wire_dtype=wire_dtype,
+            n_chunks=n_chunks if cep is not None else 1,
+            chunk_elems_per_peer=cep, emit=False,
+        )
+        assert abs(p.predicted_us
+                   - (sched_us if p.algo == "ep_sched" else streams_us)) \
+            < 1e-6, "bench model reconstruction drifted from plan_ep_a2a"
+        sweeps.append({
+            "alpha": alpha,
+            "skew": round(skew_v, 3),
+            "traffic_rows": [int(v) for v in
+                             np.asarray(traffic).sum(axis=1)],
+            "model": {
+                "n_rounds": rounds_n,
+                "streams_us": round(streams_us, 2),
+                "sched_us": round(sched_us, 2),
+                "round_time_reduction_pct": round(
+                    100.0 * (streams_us - sched_us) / streams_us, 1),
+                "planner_algo": p.algo,
+            },
+            "arms": arms,
+        })
+    line = {
+        "bench": "ep_sched_sweep", "schema_version": obs.SCHEMA_VERSION,
+        "tokens": tokens, "hidden": hidden, "experts": experts,
+        "topk": topk, "world": n, "fp8": bool(fp8), "n_chunks": n_chunks,
+        "interpret_budget_bytes": dma.budget_limit(
+            dma.resolve_interpret(None)),
+        "substrate": jax.default_backend(),
+        "sweeps": sweeps,
+    }
+    print(json.dumps(line))
+    return line
+
+
 def bench_chunk_sweep(jax, *, tokens, hidden, ffn, experts, topk, iters,
                       chunks, fp8):
     """Chunk-pipelined MoE layer sweep on the pallas wire.
@@ -458,6 +632,19 @@ def main():
              "counter-derived wire bytes, effective bandwidth, wire-byte "
              "reduction, and max-abs/rel error per arm (docs/QUANT_WIRE.md)",
     )
+    ap.add_argument(
+        "--skew", default="",
+        help="comma list of Zipf alphas (e.g. '0,0.8,1.2'): the "
+             "contention-aware scheduled-a2a sweep — per alpha one routing "
+             "draw, per --a2a-sched mode one counter-audited arm "
+             "(docs/EP_BENCH.md). Size --tokens/--hidden to the interpret "
+             "budget on CPU (e.g. --tokens 16 --hidden 64 --devices 4)",
+    )
+    ap.add_argument(
+        "--a2a-sched", default="off,on,auto",
+        help="comma list of Buffer a2a_sched modes for the --skew sweep "
+             "(subset of off/on/auto; 'off' anchors the exactness check)",
+    )
     ap.add_argument("--ffn", type=int, default=256,
                     help="expert FFN width for --cross-pod and the --chunks "
                          "sweep")
@@ -486,7 +673,7 @@ def main():
     if args.cross_pod and len(chunk_list) != 1:
         ap.error("--cross-pod takes a single --chunks depth (the sweep is "
                  "the pallas-wire mode)")
-    if chunk_list != [1] and not args.cross_pod:
+    if chunk_list != [1] and not args.cross_pod and not args.skew:
         # the chunk sweep is its own mode: validate the combination up
         # front instead of silently ignoring half the flags
         if args.wire != "pallas":
@@ -512,8 +699,39 @@ def main():
         ap.error("--wire-dtype is its own sweep mode; drop "
                  "--cross-pod/--table/--chunks")
 
+    if args.skew:
+        try:
+            alphas = [float(a) for a in args.skew.split(",") if a != ""]
+        except ValueError:
+            ap.error(f"--skew wants a comma list of floats, got "
+                     f"{args.skew!r}")
+        sched_modes = [m for m in args.a2a_sched.split(",") if m]
+        for m in sched_modes:
+            if m not in ("off", "on", "auto"):
+                ap.error(f"unknown --a2a-sched mode {m!r} (want off/on/auto)")
+        if "off" not in sched_modes:
+            sched_modes = ["off"] + sched_modes  # the exactness anchor
+        if args.cross_pod or args.table or args.ll or wire_dtypes:
+            ap.error("--skew is its own sweep mode; drop "
+                     "--cross-pod/--table/--ll/--wire-dtype (--fp8 and a "
+                     "single --chunks depth DO compose with it)")
+        if len(chunk_list) != 1 or chunk_list[0] < 1:
+            ap.error("--skew takes a single --chunks depth >= 1 (the "
+                     "sweep axis is alpha x mode, not chunk depth)")
+    else:
+        alphas = sched_modes = None
+
     jax = init_devices(args.devices)
     n = len(jax.devices())
+
+    if alphas is not None:
+        bench_skew_sweep(
+            jax, tokens=args.tokens, hidden=args.hidden,
+            experts=args.experts, topk=args.topk, iters=args.iters,
+            alphas=alphas, modes=sched_modes, fp8=args.fp8,
+            n_chunks=chunk_list[0],
+        )
+        return
 
     if wire_dtypes:
         bench_quant_sweep(

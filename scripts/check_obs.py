@@ -4,6 +4,7 @@
 Usage: python scripts/check_obs.py TRACE_JSON METRICS_PROM
        python scripts/check_obs.py --quant METRICS_PROM WIRE_DTYPE
        python scripts/check_obs.py --plan METRICS_PROM BENCH_JSON
+       python scripts/check_obs.py --a2a-sched METRICS_PROM BENCH_JSON
        python scripts/check_obs.py --disagg METRICS_PROM
 
 Asserts, with a named failure for each:
@@ -97,6 +98,19 @@ rejected + expired + lost`` re-asserted from the exported
 component gauge exactly 0 (survivors AND the decode pool's reclaimed
 slots). With a bench JSON, every arm must be ``oracle_exact`` with a
 counter-delta ``recovered`` label block.
+
+``--a2a-sched`` mode (the contention-aware scheduled a2a smoke arm,
+``ep_bench.py --skew ... --a2a-sched on --metrics-out``): the metrics
+must show a scheduled decision really landed and really drove rounds —
+a nonzero ``collective_plan_total{verb="ep_a2a",algo="ep_sched"}``
+sample, nonzero ``ep_a2a_rounds_total{algo="ep_sched"}``, and the
+``ep_a2a_skew`` gauge present at >= 1.0; every arm of the bench's
+``ep_sched_sweep`` JSON must be bit-identical to its off-arm anchor
+(the schedule is a pure reordering of the same write-once DMAs), carry
+algo labels present on the plan counter, and >= 1 arm must have
+actually ridden the schedule (``sched_active`` with counted
+``ep_sched`` rounds) — i.e. the scheduled wire demonstrably fired,
+oracle-exact, with every label counter-audited.
 
 ``--router`` mode (the replica-router smoke arm, serve --server
 --replicas N --priority-classes ... --metrics-out): the metrics file
@@ -482,6 +496,94 @@ def check_chaos_metrics(path: str, bench_json: str = "") -> None:
           + (f", {arms} oracle-exact arm(s)" if bench_json else ""))
 
 
+def check_a2a_sched_metrics(path: str, bench_json: str) -> None:
+    with open(path) as f:
+        lines = f.read().splitlines()
+
+    def _nonzero(prefix: str, what: str) -> float:
+        hits = [ln for ln in lines if ln.startswith(prefix)
+                and float(ln.rsplit(" ", 1)[1]) > 0]
+        if not hits:
+            fail(f"{path}: no nonzero {prefix!r} sample — {what}")
+        return sum(float(ln.rsplit(" ", 1)[1]) for ln in hits)
+
+    plan_algos = set()
+    for ln in lines:
+        if (ln.startswith("collective_plan_total{")
+                and 'verb="ep_a2a"' in ln
+                and float(ln.rsplit(" ", 1)[1]) > 0):
+            for part in ln[ln.index("{") + 1:ln.index("}")].split(","):
+                k, _, v = part.partition("=")
+                if k == "algo":
+                    plan_algos.add(v.strip('"'))
+    if "ep_sched" not in plan_algos:
+        fail(f"{path}: no nonzero collective_plan_total{{verb=\"ep_a2a\","
+             f"algo=\"ep_sched\"}} — the planner never committed a "
+             f"scheduled decision (algos: {sorted(plan_algos)})")
+    rounds = _nonzero('ep_a2a_rounds_total{algo="ep_sched"}',
+                      "no scheduled round ever drove the wire")
+    skews = [float(ln.rsplit(" ", 1)[1]) for ln in lines
+             if ln.startswith("ep_a2a_skew")]
+    if not skews:
+        fail(f"{path}: missing ep_a2a_skew gauge — the planner's "
+             f"contention feature is invisible")
+    if max(skews) < 1.0:
+        fail(f"{path}: ep_a2a_skew {max(skews)} < 1.0 — not a valid "
+             f"max/mean load ratio")
+
+    sweeps = arms = active = 0
+    with open(bench_json) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("bench") != "ep_sched_sweep":
+                continue
+            for sweep in rec.get("sweeps", []):
+                sweeps += 1
+                if "model" not in sweep:
+                    fail(f"{bench_json}: sweep alpha={sweep.get('alpha')} "
+                         f"carries no model round-time block")
+                for arm in sweep.get("arms", []):
+                    arms += 1
+                    tag = (f"alpha={sweep.get('alpha')} "
+                           f"mode={arm.get('a2a_sched')}")
+                    if arm.get("bit_identical_to_off") is not True:
+                        fail(f"{bench_json}: arm {tag} is not bit-"
+                             f"identical to the off-arm anchor — the "
+                             f"schedule changed the bytes, not just "
+                             f"their order")
+                    # the off arm never consults the planner — its
+                    # ep_streams label is definitional, not a delta
+                    audited = (arm.get("algo", "").split("+")
+                               if arm.get("a2a_sched") != "off" else [])
+                    for algo in filter(None, audited):
+                        if algo not in plan_algos:
+                            fail(f"{bench_json}: arm {tag} labeled "
+                                 f"{algo!r} with no matching "
+                                 f"collective_plan_total series in "
+                                 f"{path} — the label did not come off "
+                                 f"the plan counter")
+                    if arm.get("sched_active"):
+                        active += 1
+                        if arm.get("rounds", {}).get("ep_sched", 0) <= 0:
+                            fail(f"{bench_json}: arm {tag} claims "
+                                 f"sched_active but counted no ep_sched "
+                                 f"rounds")
+    if not sweeps:
+        fail(f"{bench_json}: no ep_sched_sweep records to cross-check")
+    if active < 1:
+        fail(f"{bench_json}: no arm ever rode the schedule — the smoke "
+             f"arm proved nothing about the scheduled wire")
+    print(f"check_obs: a2a-sched metrics OK — {int(rounds)} scheduled "
+          f"round(s) counted, {arms} bit-identical arm(s) across "
+          f"{sweeps} sweep(s), {active} schedule-active")
+
+
 def check_router_metrics(path: str) -> None:
     with open(path) as f:
         lines = f.read().splitlines()
@@ -747,6 +849,10 @@ def main(argv) -> None:
         check_plan_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
         return
+    if len(argv) == 4 and argv[1] == "--a2a-sched":
+        check_a2a_sched_metrics(argv[2], argv[3])
+        print("check_obs: ALL OK")
+        return
     if len(argv) == 4 and argv[1] == "--weights":
         check_weights_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
@@ -755,6 +861,7 @@ def main(argv) -> None:
         fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
              "check_obs.py --plan METRICS_PROM BENCH_JSON | "
+             "check_obs.py --a2a-sched METRICS_PROM BENCH_JSON | "
              "check_obs.py --weights PUSH_PROM PLAN_PROM | "
              "check_obs.py --disagg METRICS_PROM | "
              "check_obs.py --chaos METRICS_PROM [BENCH_JSON] | "

@@ -26,6 +26,12 @@ timeout 300 python benchmarks/all_reduce_perf.py --devices 4 --algo bidir \
   --metrics-out /tmp/qa_plan_metrics.prom > /tmp/qa_plan_bench.json; check $?
 python scripts/check_obs.py --plan /tmp/qa_plan_metrics.prom /tmp/qa_plan_bench.json; check $?
 
+note "scheduled a2a smoke tier (interpret-mode Zipf-skewed routing at world 4: Birkhoff rounds pinned on, recv bit-identical to the fixed-stream anchor, plan/rounds/skew series counter-audited)"
+timeout 300 python benchmarks/ep_bench.py --devices 4 --tokens 16 --hidden 64 \
+  --experts 8 --topk 2 --iters 1 --skew 1.2 --a2a-sched on \
+  --metrics-out /tmp/qa_sched_metrics.prom > /tmp/qa_sched_bench.json; check $?
+python scripts/check_obs.py --a2a-sched /tmp/qa_sched_metrics.prom /tmp/qa_sched_bench.json; check $?
+
 note "bcast/allgather + fleet weight-push smoke tier (planned verbs oracle-exact + labeled off the verb-labeled plan counter; relay push: every peer bit-exact, root egress = one snapshot)"
 timeout 300 python benchmarks/all_reduce_perf.py --devices 4 --bench bcast,ag \
   --json --check --min-bytes 16384 --max-bytes 16384 --iters 2 \

@@ -113,6 +113,15 @@ CID_RING_BIDIR = 16  # bidir allreduce: fwd ring 16, bwd ring 17
 # standalone all-gather must not alias either.
 CID_AG_BIDIR = 18
 CID_BCAST = 20
+# scheduled EP a2a: Birkhoff permutation rounds rotate {22, 23} (one round
+# kernel per permutation, globally tie_chunk'd at depth 2 across chunks AND
+# rounds — one linear launch sequence, so the 2-id rotation stays sound);
+# scale lanes {30, 31} via CID_SCALE_OFFSET. A scheduled combine may be
+# airborne while a scheduled dispatch is still draining (same rationale as
+# the unscheduled {2,3}/{4,5} split), so it gets its own pair {32, 33}
+# (scales {40, 41}).
+CID_SCHED = 22
+CID_SCHED_COMBINE = 32
 
 
 def chunk_collective_id(base: int, chunk: int) -> int:

@@ -480,7 +480,7 @@ class DcnGroup:
                 )
             raise IOError(f"all_to_all: unexpected control message {m[:8]!r}")
 
-    def all_to_all(self, x: np.ndarray) -> np.ndarray:
+    def all_to_all(self, x: np.ndarray, schedule=None) -> np.ndarray:
         """x: [world, ...] — row j goes to rank j; out[i] = rank i's row for us.
 
         This is the cross-pod EP exchange primitive (the DCN leg of a
@@ -491,6 +491,17 @@ class DcnGroup:
         yours — each rank moves (world-1) rows total. Writes are licensed by
         the deferred parity protocol above, so the only blocking wait per
         step is the peer's data arrival.
+
+        ``schedule`` — an optional ``(rounds, K)`` pair from
+        :func:`uccl_tpu.ep.a2a_sched.wire_schedule` — replaces the fixed
+        hop order with the contention-aware round order: each round's
+        K-designated edges form a partial matching (no pod's NIC carries
+        two transfers at once) and heavy inter-pod flows go first. Only
+        K-designated edges cross the DCN — the device wire's shadow
+        padding never ships here (host predication has no rendezvous to
+        deadlock). Every write still rides the multipath Channel (SACK +
+        PathQuality steering). Same bytes, same result, any order; all
+        pods must pass the SAME schedule (it is SPMD state).
         """
         n = self.active_world
         if x.shape[0] != n:
@@ -504,14 +515,11 @@ class DcnGroup:
         row = x[0]
         self._setup_mesh_buf(2 * row.nbytes, self._active)  # parity pair
         epoch = self._a2a_epoch
-        for s in range(1, n):
-            dst_pos = (me + s) % n
-            src_pos = (me - s) % n
+
+        def _send_row(dst_pos: int) -> None:
             dst = self._active[dst_pos]
-            src = self._active[src_pos]
-            ch_src, ch_dst = self._mesh[src], self._mesh[dst]
+            ch_dst = self._mesh[dst]
             wi = self._a2a_w.get(dst, 0)
-            ri = self._a2a_r.get(src, 0)
             if wi >= 2:  # license: dst consumed call wi-2 from this parity
                 self._a2a_wait(ch_dst, dst, "C", wi - 2)
             item = self._mesh_fifos[dst]
@@ -521,6 +529,11 @@ class DcnGroup:
             )
             ch_dst.send(self._a2a_msg(b"AD", epoch, wi))
             self._a2a_w[dst] = wi + 1
+
+        def _recv_row(src_pos: int) -> None:
+            src = self._active[src_pos]
+            ch_src = self._mesh[src]
+            ri = self._a2a_r.get(src, 0)
             self._a2a_wait(ch_src, src, "D", ri)
             off = src * self._mesh_seg + (ri % 2) * row.nbytes
             out[src_pos] = (
@@ -530,6 +543,42 @@ class DcnGroup:
             )
             ch_src.send(self._a2a_msg(b"AC", epoch, ri))
             self._a2a_r[src] = ri + 1
+
+        if schedule is None:
+            for s in range(1, n):
+                _send_row((me + s) % n)
+                _recv_row((me - s) % n)
+            return out
+
+        rounds, k_mat = schedule
+        perms = [tuple(getattr(rnd, "perm", rnd)) for rnd in rounds]
+        k_mat = np.asarray(k_mat)
+        # completeness BEFORE any wire traffic: K must designate every
+        # off-diagonal pair to a round that actually carries it, or some
+        # row would never arrive
+        if k_mat.shape != (n, n):
+            raise ValueError(f"schedule K is {k_mat.shape}, want {(n, n)}")
+        for s in range(n):
+            for d in range(n):
+                if s == d:
+                    continue
+                r = int(k_mat[s, d])
+                if not (0 <= r < len(perms)) or perms[r][s] != d:
+                    raise ValueError(
+                        f"schedule round {r} does not carry pair ({s}, {d})"
+                    )
+        for r, perm in enumerate(perms):
+            if sorted(perm) != list(range(n)):
+                raise ValueError(
+                    f"schedule round {perm} is not a permutation of "
+                    f"range({n})"
+                )
+            dst_pos = perm[me]
+            src_pos = perm.index(me)
+            if dst_pos != me and int(k_mat[me, dst_pos]) == r:
+                _send_row(dst_pos)
+            if src_pos != me and int(k_mat[src_pos, me]) == r:
+                _recv_row(src_pos)
         return out
 
     def broadcast(self, x: np.ndarray, root: int = 0) -> np.ndarray:

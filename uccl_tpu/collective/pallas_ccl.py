@@ -569,8 +569,8 @@ def ring_reduce_scatter(x: jax.Array, axis, *, direction: int = 1,
     faithful = _dma.faithful_sync(interpret)
 
     if wire_dtype is None:
-        if not _check_budget(x.size * itemsize, "reduce_scatter",
-                             interpret):
+        if not _check_budget(rs_charge(x.size, itemsize, n, None, interpret),
+                             "reduce_scatter", interpret):
             from uccl_tpu.collective import plan
 
             _count_wire_bytes("ring_reduce_scatter", "lax", None,
@@ -605,7 +605,7 @@ def ring_reduce_scatter(x: jax.Array, axis, *, direction: int = 1,
     # quantized wire: accumulator stays input precision; the wire scratches
     # (send + 2-slot staging for payload and scales) ride on top
     srows = _dma.scale_rows(rows)
-    charge = x.size * itemsize + 3 * hop_bytes
+    charge = rs_charge(x.size, itemsize, n, wire_dtype, interpret)
     if not _check_budget(charge, "reduce_scatter", interpret):
         _count_wire_bytes("ring_reduce_scatter", "lax", wire_dtype,
                           (n - 1) * hop_bytes)
@@ -924,6 +924,20 @@ def bidir_all_reduce(x: jax.Array, axis, *, interpret=None,
 # zeros. Everything below reuses the ring substrate verbatim: write-once AG
 # slots, credit rotation, wire_dtype quantize-once-forward-verbatim, paired
 # collective ids, counted budget fallbacks onto bit-identical lax mirrors.
+
+
+def rs_charge(nelems: int, itemsize: int, n: int, wire_dtype,
+              interpret) -> int:
+    """VMEM charge of ONE reduce-scatter ring kernel on a flat ``nelems``
+    payload: the full-precision accumulator, plus — when the wire is
+    quantized — the send + 2-slot staging wire scratches. EXACTLY what
+    ring_reduce_scatter's own gate charges, shared with the planner's
+    quiet probe (``CollectivePlanner._rs_budget_ok``)."""
+    del interpret  # per-kernel charge; the limit differs, not the charge
+    if wire_dtype is None:
+        return nelems * itemsize
+    m = _dma.padded_chunk_elems(-(-nelems // n))
+    return nelems * itemsize + 3 * _hop_wire_bytes(m, itemsize, wire_dtype)
 
 
 def ag_charge(nelems: int, itemsize: int, n: int, wire_dtype,

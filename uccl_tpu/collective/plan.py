@@ -754,10 +754,13 @@ def xla_wire_volume(verb: str, world: int, wire_bytes: int) -> float:
     """Per-member byte volume the xla line of ``verb`` is priced (and
     calibrated) over: allreduce and broadcast move ~one payload per member,
     an all-gather's per-member contribution crosses the wire world-1
-    times. The ONE volume arithmetic CostModel.predict_verb and
-    scripts/plan_calibrate.py share."""
+    times, a reduce-scatter ships the (w-1)/w of each member's payload
+    that reduces elsewhere. The ONE volume arithmetic
+    CostModel.predict_verb and scripts/plan_calibrate.py share."""
     if verb == "all_gather":
         return float((world - 1) * wire_bytes)
+    if verb == "reduce_scatter":
+        return float(world - 1) / float(world) * wire_bytes
     return float(wire_bytes)
 
 
@@ -805,6 +808,14 @@ def verb_cost_features(verb: str, algo: str, world: int, wire_bytes: int,
         if algo == "xla":
             return 1.0, float(w - 1) * b, 1
         raise ValueError(f"unknown all_gather algo {algo!r}")
+    if verb == "reduce_scatter":
+        # ``wire_bytes`` = one member's FULL [w*k, ...] input bytes; the RS
+        # half of the ring pair ships (w-1)/w of it over w-1 reducing hops.
+        if algo in ("ring", "pallas"):
+            return float(w - 1), (w - 1) / float(w) * b, 1
+        if algo == "xla":
+            return 1.0, (w - 1) / float(w) * b, 1
+        raise ValueError(f"unknown reduce_scatter algo {algo!r}")
     raise ValueError(f"unknown plan verb {verb!r}")
 
 
@@ -1038,6 +1049,148 @@ class CollectivePlanner:
             if best_cost is None or cost < best_cost:
                 best, best_cost = algo, cost
         return _final(best, best_cost, "model")
+
+    def plan_reduce_scatter(self, payload_shape, dtype, world: int, *,
+                            n_axes: int = 1, worlds=None, wire_dtype=None,
+                            pallas_ok: bool = False,
+                            emit: bool = True) -> Plan:
+        """Pick the reduce-scatter algorithm for one member's FULL
+        ``[world*k, ...]`` input: ``xla`` (lax.psum_scatter) or ``ring``
+        (the RS half of the pallas ring pair — write-once reducing hops,
+        with its bit-identical lax mirror past the budget). The fourth and
+        final verb under the ONE alpha-beta-gamma model: same wire-byte
+        pricing, quant re-label rule and quiet budget probing as the
+        others."""
+        from uccl_tpu.ops import quant as _quant
+
+        wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
+        m = self.model
+        wire_bytes = self.wire_bytes(payload_shape, dtype, wire_dtype)
+
+        def _final(algo: str, cost, outcome: str) -> Plan:
+            wd, wb, c = wire_dtype, wire_bytes, cost
+            if wd is not None and algo != "ring":
+                wd = None
+                wb = self.wire_bytes(payload_shape, dtype, None)
+                c = None
+            if c is None:
+                c = m.predict_verb("reduce_scatter", algo, world, wb,
+                                   n_axes, worlds)
+            plan_ = Plan(algo, 1, wd, world, wb, c, outcome,
+                         "reduce_scatter")
+            return self._emit(plan_) if emit else plan_
+
+        if world <= 1:
+            return _final("xla", 0.0, "model")
+        candidates = ["xla"]
+        if pallas_ok and n_axes == 1 and self._rs_budget_ok(
+                payload_shape, dtype, wire_dtype, world):
+            candidates.append("ring")
+        best, best_cost = "xla", None
+        for algo in candidates:
+            cost = m.predict_verb("reduce_scatter", algo, world, wire_bytes,
+                                  n_axes, worlds)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = algo, cost
+        return _final(best, best_cost, "model")
+
+    # -- scheduled EP a2a ----------------------------------------------------
+
+    def plan_ep_a2a(self, payload_shape, dtype, world: int, *,
+                    skew: float = 1.0, n_rounds=None, wire_dtype=None,
+                    n_chunks: int = 1, chunk_elems_per_peer=None,
+                    emit: bool = True) -> Plan:
+        """Arbitrate the EP all-to-all wire ORDER: ``ep_streams`` (the fixed
+        counter-rotating 2-stream kernel) vs ``ep_sched`` (the
+        contention-aware Birkhoff round schedule, uccl_tpu.ep.a2a_sched).
+
+        ``payload_shape`` is one member's full [W, ...] exchange buffer;
+        ``skew`` is a2a_sched.skew(traffic) — hottest-port/mean-port
+        off-diagonal load. The fixed streams serialize behind the hottest
+        port (serial bytes = skew x the mean per-member volume), while the
+        scheduled wire moves every row concurrently round by round but
+        pays gamma per round kernel: under the ONE cost model the
+        crossover sits where (skew - 1) x beta x bytes outgrows
+        (rounds - 1) x gamma, so uniform matrices (skew 1) keep the
+        streams and skewed routing flips to the schedule. ``n_chunks``
+        is the buffer's chunk-pipeline depth: the scheduled path budgets
+        per chunk (dma.chunk_budget), so chunked buffers can schedule
+        payloads the monolithic gate would refuse — callers that know
+        the device layout pass ``chunk_elems_per_peer`` (per-chunk
+        per-peer element count, the gate's own quantity) so the probe
+        charges EXACTLY what _scheduled_chunked will. Decisions land on
+        collective_plan_total{verb="ep_a2a"} like every other verb."""
+        m = self.model
+        wire_bytes = self.wire_bytes(payload_shape, dtype, wire_dtype)
+        if world <= 1:
+            plan_ = Plan("ep_streams", 1, wire_dtype, world, wire_bytes,
+                         0.0, "model", "ep_a2a")
+            return self._emit(plan_) if emit else plan_
+        rounds = int(n_rounds) if n_rounds else world - 1
+        skew = max(1.0, float(skew))
+        # mean per-member a2a volume: (w-1)/w of the buffer leaves home
+        mean_bytes = (world - 1) / float(world) * wire_bytes
+        streams_us = (m.alpha_us * (world - 1)
+                      + m.beta_us_per_byte * skew * mean_bytes
+                      + m.gamma_us)
+        sched_us = (m.alpha_us * rounds
+                    + m.beta_us_per_byte * mean_bytes
+                    + m.gamma_us * rounds)
+        if (sched_us < streams_us
+                and self._ep_sched_budget_ok(
+                    payload_shape, dtype, wire_dtype, world,
+                    n_chunks=n_chunks,
+                    chunk_elems_per_peer=chunk_elems_per_peer)):
+            algo, cost, chunks = "ep_sched", sched_us, rounds
+        else:
+            algo, cost, chunks = "ep_streams", streams_us, 1
+        plan_ = Plan(algo, chunks, wire_dtype, world, wire_bytes, cost,
+                     "model", "ep_a2a")
+        return self._emit(plan_) if emit else plan_
+
+    def _ep_sched_budget_ok(self, payload_shape, dtype, wire_dtype,
+                            world: int, n_chunks: int = 1,
+                            chunk_elems_per_peer=None) -> bool:
+        """Quiet probe of the scheduled-round kernel budget — charges
+        EXACTLY what pallas_a2a.scheduled_all_to_all's gate charges (the
+        [W, ...] send view + one round slot, two kernels airborne), so
+        auto never schedules rounds whose first act is a counted
+        downgrade onto the unscheduled wire. With ``n_chunks > 1`` the
+        device runs _scheduled_chunked, whose gate is dma.chunk_budget on
+        the PER-CHUNK per-peer footprint: callers that know the device
+        layout pass it as ``chunk_elems_per_peer`` (exact mirror);
+        otherwise the probe estimates ceil(elems / (world x n_chunks)) —
+        the un-padded footprint, close enough that the 1024-element wire
+        quantum usually absorbs the slot-padding difference."""
+        from uccl_tpu.collective import dma as _dma
+
+        elems = self._payload_elems(payload_shape)
+        itemsize = 1 if wire_dtype else jnp.dtype(dtype).itemsize
+        interpret = _dma.resolve_interpret(None)
+        if n_chunks > 1:
+            per_peer = chunk_elems_per_peer
+            if per_peer is None:
+                per_peer = -(-elems // (world * int(n_chunks)))
+            return _dma.chunk_budget(world, int(per_peer), itemsize,
+                                     "ep_a2a_sched", interpret,
+                                     quiet=True)
+        m = _dma.padded_chunk_elems(-(-elems // world))
+        charge = 2 * (world + 1) * m * itemsize
+        return charge <= _dma.budget_limit(interpret)
+
+    def _rs_budget_ok(self, payload_shape, dtype, wire_dtype,
+                      world: int) -> bool:
+        """Quiet probe of the reduce-scatter ring kernel budget
+        (pallas_ccl.rs_charge — the gate's own arithmetic)."""
+        from uccl_tpu.collective import dma as _dma
+        from uccl_tpu.collective import pallas_ccl as _pccl
+
+        elems = self._payload_elems(payload_shape)
+        itemsize = jnp.dtype(dtype).itemsize
+        interpret = _dma.resolve_interpret(None)
+        charge = _pccl.rs_charge(elems, itemsize, world, wire_dtype,
+                                 interpret)
+        return charge <= _dma.budget_limit(interpret)
 
     def _bidir_budget_ok(self, payload_shape, dtype, wire_dtype,
                          world: int) -> bool:

@@ -129,6 +129,7 @@ class Config:
     wire_fp8: bool = True
     n_chunks: Optional[int] = None  # pallas chunk-pipeline depth (0 = auto)
     wire_dtype: Optional[str] = None  # fp8 | int8 | None (full precision)
+    a2a_sched: Optional[str] = None  # off | on | auto (None = Buffer's)
 
 
 class DispatchHandle(NamedTuple):
@@ -157,6 +158,8 @@ class DispatchHandle(NamedTuple):
     wire: str = "lax"  # lax | pallas (defaulted: pre-wire handles pickle)
     n_chunks: int = 1  # pallas chunk depth (defaulted: pre-chunk handles)
     wire_dtype: Optional[str] = None  # fp8 | int8 | None (pre-quant: None)
+    a2a_sched: bool = False  # dispatch rode the scheduled rounds; combine
+    #   rebuilds the TRANSPOSED schedule (defaulted: pre-sched handles)
 
 
 class LowLatencyHandle(NamedTuple):
@@ -205,7 +208,14 @@ class Buffer:
     block-scale codec ("fp8" | "int8", :mod:`uccl_tpu.ops.quant`; values +
     per-block f32 scales move, one quantize round trip of error per
     exchange — docs/QUANT_WIRE.md). Per-call ``wire_dtype=``/``wire_fp8=``
-    keywords and a Config override it; None keeps full precision."""
+    keywords and a Config override it; None keeps full precision.
+
+    ``a2a_sched`` orders the pallas wire's exchange as contention-free
+    permutation rounds built from ``a2a_traffic`` (the host [W, W] routing
+    matrix; :mod:`uccl_tpu.ep.a2a_sched`): "on" pins the schedule, "auto"
+    lets the planner flip between it and the fixed streams off the traffic
+    skew, "off" (default) keeps the streams. Bit-identical output either
+    way — the schedule reorders the same write-once DMAs."""
 
     def __init__(
         self,
@@ -218,6 +228,8 @@ class Buffer:
         wire: str = "auto",
         n_chunks: int = 1,
         wire_dtype: Optional[str] = None,
+        a2a_sched: str = "off",
+        a2a_traffic=None,
     ):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.axes = (axis,) if isinstance(axis, str) else tuple(axis)
@@ -234,6 +246,11 @@ class Buffer:
         if n_chunks < 0:
             raise ValueError(f"n_chunks must be >= 0 (0 = auto), got "
                              f"{n_chunks}")
+        if a2a_sched not in ("off", "on", "auto"):
+            raise ValueError(
+                f"unknown a2a_sched {a2a_sched!r} (want 'off', 'on', or "
+                "'auto')"
+            )
         from uccl_tpu.ops import quant as _quant
 
         self.num_experts = num_experts
@@ -243,6 +260,17 @@ class Buffer:
         self.wire = wire
         self.n_chunks = n_chunks
         self.wire_dtype = _quant.resolve_wire_dtype(wire_dtype)
+        # contention-aware a2a rounds (uccl_tpu.ep.a2a_sched): "on" always
+        # rides the Birkhoff schedule on the pallas wire, "auto" lets the
+        # planner arbitrate off the traffic skew. ``a2a_traffic`` is the
+        # host [W, W] per-step routing matrix the schedule is built from
+        # (a2a_sched.traffic_from_topk / zipf_topk; None = uniform, which
+        # auto correctly answers with the fixed streams). Static per
+        # Buffer — a new routing regime warrants a new matrix, i.e. a new
+        # Buffer or an explicit re-assignment before the next dispatch.
+        self.a2a_sched = a2a_sched
+        self.a2a_traffic = (None if a2a_traffic is None
+                            else np.asarray(a2a_traffic, float))
         self._cache = {}
         # host-path wire/chunk resolutions memoize per distinct config:
         # the fallback counter's contract is one event per compiled
@@ -355,6 +383,106 @@ class Buffer:
         if self.wire_dtype is not None:
             return self.wire_dtype
         return "fp8" if default_fp8 else None
+
+    def _sched_chunk_charge(self, n_chunks: int, cap: int, slot_elems: int):
+        """Per-chunk per-peer element count of the chunk-pipelined
+        scheduled wire — pallas_a2a._scheduled_chunked's own arithmetic
+        (slot axis padded to a chunk multiple), so plan_ep_a2a's budget
+        probe charges exactly what the device gate will. None when the
+        effective depth degenerates to 1 (monolithic gate applies)."""
+        from uccl_tpu.collective import dma as _dma
+
+        nc = min(int(n_chunks), int(cap))
+        if nc <= 1:
+            return None
+        return int(slot_elems) * (_dma.pad_capacity(int(cap), nc) // nc)
+
+    def _resolve_a2a_sched(self, config, wire: str, verb: str,
+                           payload_shape, dtype, wire_dtype,
+                           n_chunks: int = 1):
+        """Effective round schedule for a verb's exchange, or None for the
+        fixed streams: resolution Config > Buffer mode, then — on the
+        pallas wire at world > 1 — the Birkhoff schedule is built from the
+        Buffer's traffic matrix (combine sees it TRANSPOSED: traffic flows
+        home) and either pinned ("on", recorded as an explicit plan) or
+        arbitrated by the planner off the skew ("auto",
+        CollectivePlanner.plan_ep_a2a). Memoized per static config — the
+        decision, the plan counter event and the rounds/skew series fire
+        once per compiled program, like every other host resolution."""
+        mode = None
+        if config is not None and config.a2a_sched is not None:
+            mode = config.a2a_sched
+        if mode is None:
+            mode = self.a2a_sched
+        if mode not in ("off", "on", "auto"):
+            raise ValueError(
+                f"unknown a2a_sched {mode!r} (want 'off', 'on', or 'auto')"
+            )
+        if mode == "off" or self.world <= 1:
+            return None
+        if wire != "pallas":
+            # an explicit "on" off the pallas wire is a real downgrade (the
+            # lax wire has no round order to steer) — counted once
+            if mode == "on" and "a2a_sched_wire" not in self._resolve_memo:
+                self._resolve_memo["a2a_sched_wire"] = True
+                from uccl_tpu.collective import dma
+
+                dma.record_fallback(
+                    "ep_a2a_sched", "wire", detail=wire,
+                    msg="a2a_sched='on' needs the pallas wire (XLA owns "
+                        "the lax schedule); riding the fixed streams",
+                )
+            return None
+        mat = self.a2a_traffic
+        if mat is None:
+            mat = np.ones((self.world, self.world), float)
+            np.fill_diagonal(mat, 0.0)
+        mat = np.asarray(mat, float)
+        if verb == "combine":
+            mat = mat.T
+        key = ("a2a_sched", mode, verb, tuple(payload_shape),
+               jnp.dtype(dtype).name, wire_dtype, n_chunks, mat.tobytes())
+        if key in self._resolve_memo:
+            return self._resolve_memo[key]
+        from uccl_tpu.collective.plan import get_planner
+        from uccl_tpu.ep import a2a_sched as _sched
+
+        schedule = _sched.wire_schedule(mat, self.world)
+        n_rounds = len(schedule[0])
+        planner = get_planner()
+        if mode == "on":
+            planner.plan_explicit("ep_sched", payload_shape, dtype,
+                                  self.world, wire_dtype=wire_dtype,
+                                  verb="ep_a2a")
+            algo = "ep_sched"
+        else:
+            # the wire buffer is [W, E_local, C, H] for both verbs; its
+            # chunked slot axis is C, so the per-chunk per-peer charge
+            # (what _scheduled_chunked's gate checks) is E_local*cs*H.
+            # dispatch's payload_shape is that buffer; combine's is the
+            # [E_local, W*C, H] expert view of the same bytes.
+            elems = int(np.prod(payload_shape))
+            cap = int(payload_shape[-2])
+            if verb != "dispatch":
+                cap //= self.world
+            slot_elems = elems // self.world // max(cap, 1)
+            cep = (self._sched_chunk_charge(n_chunks, cap, slot_elems)
+                   if n_chunks > 1 and cap else None)
+            algo = planner.plan_ep_a2a(
+                payload_shape, dtype, self.world,
+                skew=_sched.skew(mat), n_rounds=n_rounds,
+                wire_dtype=wire_dtype,
+                n_chunks=n_chunks if cep is not None else 1,
+                chunk_elems_per_peer=cep,
+            ).algo
+        _sched.record_decision(
+            algo, self.world,
+            n_rounds=n_rounds if algo == "ep_sched" else None,
+            matrix=mat,
+        )
+        result = schedule if algo == "ep_sched" else None
+        self._resolve_memo[key] = result
+        return result
 
     def _spec(self, extra_dims: int) -> P:
         return P(self.axes, *([None] * extra_dims))
@@ -552,10 +680,17 @@ class Buffer:
                     wire_dtype=wire_dtype,
                 )
             n_chunks = self._resolve_memo[rkey]
+        schedule = self._resolve_a2a_sched(
+            config, wire, "dispatch",
+            (self.world, self.num_local_experts, cap, h), x.dtype,
+            wire_dtype, n_chunks=n_chunks,
+        )
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
         key = ("dispatch", x.shape, topk_idx.shape, wire_dtype, x.dtype,
-               wire, n_chunks, has_ev and (tok.shape, tok.dtype))
+               wire, n_chunks, has_ev and (tok.shape, tok.dtype),
+               schedule is not None
+               and (tuple(schedule[0]), schedule[1].tobytes()))
 
         def f(xv, idx, *tok_arg):
             xv, idx = xv[0], idx[0]
@@ -570,6 +705,7 @@ class Buffer:
             recv = ep_ops.dispatch_sorted(
                 xv, plan, e, cap, self._axis_name(),
                 wire_dtype=wire_dtype, wire=wire, n_chunks=n_chunks,
+                schedule=schedule,
             )
             # per-(source, local-expert) received-row counts: kept[E] is MY
             # contribution per global expert; the all_to_all hands each
@@ -597,7 +733,8 @@ class Buffer:
         self._last_dispatch = (topk_idx, cap)
         # weights go straight into the handle (combine reshards them itself)
         handle = DispatchHandle(slot, topk_weights, recv_counts, wire,
-                                n_chunks, wire_dtype)
+                                n_chunks, wire_dtype,
+                                schedule is not None)
         if async_finish:
             return recv, handle, EventOverlap((recv, slot, recv_counts))
         return recv, handle
@@ -632,10 +769,22 @@ class Buffer:
             )
         wire = handle.wire
         n_chunks = handle.n_chunks  # retrace dispatch's chunking exactly
+        schedule = None
+        if handle.a2a_sched:
+            # dispatch rode the scheduled rounds: the return exchange is
+            # the transposed traffic (every row flows home), so combine
+            # rebuilds its own schedule, arbitrated for ITS direction (row
+            # and column skew differ on asymmetric matrices)
+            schedule = self._resolve_a2a_sched(
+                config, wire, "combine", expert_out.shape[1:],
+                expert_out.dtype, wire_dtype, n_chunks=n_chunks,
+            )
         has_ev = previous_event is not None
         tok = previous_event.token if has_ev else None
         key = ("combine", expert_out.shape, handle.slot.shape, wire_dtype,
-               wire, n_chunks, has_ev and (tok.shape, tok.dtype))
+               wire, n_chunks, has_ev and (tok.shape, tok.dtype),
+               schedule is not None
+               and (tuple(schedule[0]), schedule[1].tobytes()))
 
         def f(y, slot, wts, *tok_arg):
             if tok_arg:
@@ -643,6 +792,7 @@ class Buffer:
             out = ep_ops.combine_sorted(
                 y[0], slot[0], wts[0], self._axis_name(),
                 wire_dtype=wire_dtype, wire=wire, n_chunks=n_chunks,
+                schedule=schedule,
             )
             return out[None]
 

@@ -113,6 +113,16 @@ class CrossPodMoE:
       capacity_factor: per-(token, pod) bucketing slack.
       n_chunks: slot-space pipelining depth (1 = no overlap; 2+ overlaps DCN
         exchanges with expert compute).
+      a2a_sched: "off" | "on" | "auto" — order the pairwise DCN exchanges
+        by the contention-aware round schedule built from ``a2a_traffic``
+        (uccl_tpu.ep.a2a_sched; heavy inter-pod flows first, each round a
+        partial matching so no pod's NIC carries two transfers at once;
+        every write still rides the multipath Channel's SACK + PathQuality
+        steering). "auto" schedules only when the matrix is actually
+        skewed — a uniform matrix keeps the fixed hop order. Identical
+        bytes and result either way. The matrix is SPMD state: every pod
+        must construct with the same one.
+      a2a_traffic: host [P, P] inter-pod routing matrix (None = uniform).
     """
 
     def __init__(
@@ -124,6 +134,8 @@ class CrossPodMoE:
         num_selected: int = 2,
         capacity_factor: float = 1.25,
         n_chunks: int = 1,
+        a2a_sched: str = "off",
+        a2a_traffic=None,
     ):
         self.dcn = dcn
         self.mesh = mesh
@@ -132,14 +144,51 @@ class CrossPodMoE:
             raise ValueError(
                 f"experts {num_global_experts} must divide pods {self.n_pods}"
             )
+        if a2a_sched not in ("off", "on", "auto"):
+            raise ValueError(
+                f"unknown a2a_sched {a2a_sched!r} (want 'off', 'on', or "
+                "'auto')"
+            )
         self.num_global_experts = num_global_experts
         self.experts_per_pod = num_global_experts // self.n_pods
         self.num_selected = num_selected
         self.capacity_factor = capacity_factor
         self.n_chunks = max(1, int(n_chunks))
+        self._dcn_schedule = self._resolve_dcn_schedule(a2a_sched,
+                                                        a2a_traffic)
         self._compute_cache = {}
         self._vjp_cache = {}
         self._ctx = None
+
+    def _resolve_dcn_schedule(self, mode: str, traffic):
+        """Build the (rounds, K) order the DCN exchanges will follow, or
+        None for the fixed hop order. Decided once at construction (the
+        matrix is static per layer, like ep.Buffer's): "on" pins the
+        schedule; "auto" takes it only when the matrix is skewed — the
+        host-side rounds cost nothing extra, so the only reason to keep
+        the fixed order on a uniform matrix is that it IS that schedule
+        already. The decision lands on the rounds/skew series either way
+        (a2a_sched.record_decision)."""
+        if mode == "off" or self.n_pods <= 1:
+            return None
+        from uccl_tpu.ep import a2a_sched as _sched
+
+        mat = traffic
+        if mat is None:
+            mat = np.ones((self.n_pods, self.n_pods), float)
+            np.fill_diagonal(mat, 0.0)
+        mat = np.asarray(mat, float)
+        sk = _sched.skew(mat)
+        if mode == "auto" and sk <= 1.0 + 1e-9:
+            _sched.record_decision("ep_streams", self.n_pods, matrix=mat)
+            return None
+        schedule = _sched.wire_schedule(mat, self.n_pods)
+        _sched.record_decision("ep_sched", self.n_pods,
+                               n_rounds=len(schedule[0]), matrix=mat)
+        # the return exchange sees the transposed traffic (partials flow
+        # home) — ordered by its own decomposition
+        back = _sched.wire_schedule(mat.T, self.n_pods)
+        return (schedule, back)
 
     # ------------------------------------------------------------------
     def _pod_capacity(self, t: int) -> int:
@@ -271,10 +320,12 @@ class CrossPodMoE:
         resolves. wire: [P, cap, D]. Returns [P*cap, H] numpy."""
         n_pods, cap = wire.shape[0], wire.shape[1]
         cs = cap // self.n_chunks
+        sched_out, sched_back = self._dcn_schedule or (None, None)
         partials = []
         for c in range(self.n_chunks):
             sl = slice(c * cs, (c + 1) * cs)
-            recv = self.dcn.all_to_all(np.ascontiguousarray(wire[:, sl]))
+            recv = self.dcn.all_to_all(np.ascontiguousarray(wire[:, sl]),
+                                       schedule=sched_out)
             if clk:
                 clk.lap("a2a_out")
             partials.append(fn(*fn_args_builder(recv)))  # async dispatch
@@ -288,7 +339,8 @@ class CrossPodMoE:
             h = part.shape[-1]
             backs.append(
                 self.dcn.all_to_all(
-                    np.ascontiguousarray(part.reshape(n_pods, cs, h))
+                    np.ascontiguousarray(part.reshape(n_pods, cs, h)),
+                    schedule=sched_back,
                 )
             )
             if clk:

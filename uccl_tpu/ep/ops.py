@@ -272,6 +272,7 @@ def dispatch_sorted(
     wire: str = "lax",
     n_chunks: int = 1,
     wire_dtype=None,
+    schedule=None,
 ) -> jax.Array:
     """Ragged dispatch: one gather packs [E*C, H] slot payloads, then the same
     member-major all-to-all as the dense path. Empty slots (sentinel index T,
@@ -281,6 +282,8 @@ def dispatch_sorted(
     many double-buffered chunk kernels (identical numerics; lax wire
     ignores it — XLA owns that schedule). ``wire_dtype="fp8"|"int8"``
     block-quantizes the wire payload (wire_fp8=True = legacy "fp8").
+    ``schedule`` runs the pallas wire one contention-free permutation
+    round at a time (a2a_sched.wire_schedule; bit-identical output).
     Returns [E_local, W*C, H]."""
     if isinstance(token_for_slot, SlotPlan):
         token_for_slot = token_for_slot.token_for_slot
@@ -291,10 +294,11 @@ def dispatch_sorted(
     h = x.shape[-1]
     buf = jnp.take(x, token_for_slot, axis=0, mode="fill", fill_value=0)
     buf = buf.reshape(w, e_local, capacity, h)
+    cid = _dma.CID_SCHED if schedule is not None else _dma.CID_EP_DISPATCH
     buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group, x.dtype, wire,
                            n_chunks=n_chunks, chunk_axis=2,
-                           collective_id=_dma.CID_EP_DISPATCH,
-                           wire_dtype=wire_dtype)
+                           collective_id=cid,
+                           wire_dtype=wire_dtype, schedule=schedule)
     return buf.transpose(1, 0, 2, 3).reshape(e_local, w * capacity, h)
 
 
@@ -309,23 +313,28 @@ def combine_sorted(
     wire: str = "lax",
     n_chunks: int = 1,
     wire_dtype=None,
+    schedule=None,
 ) -> jax.Array:
     """Ragged combine: all-to-all the expert outputs home, then one [T, K]-row
     gather + weighted sum. Dropped assignments (sentinel slot E*C, out of
     bounds) gather as zeros. ``slot`` may be the raw [T, K] array or the
     :class:`SlotPlan` dispatch already used — the same permutation, never
-    re-derived. expert_out: [E_local, W*C, H] → [T, H]."""
+    re-derived. ``schedule`` is the combine-direction round schedule (the
+    dispatch matrix TRANSPOSED — traffic flows home). expert_out:
+    [E_local, W*C, H] → [T, H]."""
     if isinstance(slot, SlotPlan):
         slot = slot.slot
     w = lax.axis_size(axis)
     e_local, wc, h = expert_out.shape
     c = wc // w
     buf = expert_out.reshape(e_local, w, c, h).transpose(1, 0, 2, 3)
+    cid = (_dma.CID_SCHED_COMBINE if schedule is not None
+           else _dma.CID_EP_COMBINE)
     buf = _wire_all_to_all(buf, axis, wire_fp8, quant_group,
                            expert_out.dtype, wire,
                            n_chunks=n_chunks, chunk_axis=2,
-                           collective_id=_dma.CID_EP_COMBINE,
-                           wire_dtype=wire_dtype)
+                           collective_id=cid,
+                           wire_dtype=wire_dtype, schedule=schedule)
     y = buf.reshape(w * e_local * c, h)  # [E*C, H], expert-major
     yk = jnp.take(y, slot, axis=0, mode="fill", fill_value=0)  # [T, K, H]
     return jnp.einsum("tk,tkh->th", weights.astype(yk.dtype), yk)
@@ -364,17 +373,23 @@ def dispatch(
 
 
 def _member_all_to_all(buf, axis, wire, *, n_chunks=1, chunk_axis=1,
-                       collective_id=None):
+                       collective_id=None, schedule=None):
     """One member-major [W, ...] exchange on the selected wire: the XLA
     collective ("lax") or the device-initiated Pallas remote-DMA kernel
     ("pallas", uccl_tpu.ep.pallas_a2a — falls back to lax past its VMEM
     budget). Both implement the identical tiled contract. ``n_chunks``/
-    ``chunk_axis``/``collective_id`` reach only the pallas kernel (slot-axis
-    chunking on 2-parity rotated ids); the lax wire is XLA-scheduled and
-    ignores them."""
+    ``chunk_axis``/``collective_id``/``schedule`` reach only the pallas
+    kernel (slot-axis chunking on 2-parity rotated ids; ``schedule`` —
+    a ``(rounds, K)`` pair from a2a_sched.wire_schedule — swaps in the
+    contention-aware per-round wire, bit-identical output); the lax wire
+    is XLA-scheduled and ignores them."""
     if wire == "pallas":
         from uccl_tpu.ep import pallas_a2a
 
+        if schedule is not None:
+            return pallas_a2a.scheduled_all_to_all(
+                buf, axis, schedule, n_chunks=n_chunks,
+                chunk_axis=chunk_axis, collective_id=collective_id)
         return pallas_a2a.all_to_all(buf, axis, n_chunks=n_chunks,
                                      chunk_axis=chunk_axis,
                                      collective_id=collective_id)
@@ -420,16 +435,19 @@ wire_bytes_of = _quant.wire_bytes_of
 
 def _wire_all_to_all(buf, axis, wire_fp8, quant_group, dtype, wire="lax", *,
                      n_chunks=1, chunk_axis=1, collective_id=None,
-                     wire_dtype=None):
+                     wire_dtype=None, schedule=None):
     """Member-major all-to-all of a [W, ...] buffer, optionally block-scale
     quantized on the wire (``wire_dtype="fp8"|"int8"``; ``wire_fp8=True``
     is the legacy spelling of "fp8" — the analog of internode_ll.cu's
-    fp8+scales message packing)."""
+    fp8+scales message packing). ``schedule`` selects the contention-aware
+    per-round pallas wire; when quantizing, the scale exchange rides the
+    same schedule on its own id lane (same rounds, same exactness)."""
 
     def xchg(rows, cid_off=0):
         cid = None if collective_id is None else collective_id + cid_off
         return _member_all_to_all(rows, axis, wire, n_chunks=n_chunks,
-                                  chunk_axis=chunk_axis, collective_id=cid)
+                                  chunk_axis=chunk_axis, collective_id=cid,
+                                  schedule=schedule)
 
     wire_dtype = resolve_wire_dtype(wire_fp8, wire_dtype)
     if wire_dtype is not None and not jnp.issubdtype(

@@ -290,3 +290,248 @@ def all_to_all(
     )(view)
     out = buf.reshape(n, m)[:, :k]
     return out.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Scheduled (contention-aware) wire: one Pallas kernel per Birkhoff round
+#
+# The unscheduled kernel above ships every (src, dst) pair on two fixed
+# counter-rotating streams; under skewed routing the hottest link serializes
+# while cold links idle. The scheduled wire drives the SAME one-sided
+# write-once DMAs in a different ORDER: the host scheduler
+# (uccl_tpu.ep.a2a_sched.wire_schedule) decomposes the traffic matrix into
+# contention-free full-permutation rounds (heaviest flows first), and each
+# round runs as its own small kernel — every member sends exactly one chunk
+# and receives exactly one chunk per round, so no ICI port ever carries two
+# transfers at once. Exactness is structural: the same per-pair capacity
+# chunks cross the wire exactly once each (shadow duplicates are never read
+# back), merely reordered, so the assembled result is bit-identical to the
+# unscheduled kernel and to lax.all_to_all.
+#
+# Rounds must be FULL permutations (self-loops allowed — a self-DMA is a
+# local copy): under the legacy discharge interpreter a remote DMA lowers to
+# a rendezvous collective over ALL mesh members, so a member predicated out
+# of a round would deadlock the rendezvous; on real hardware full rounds
+# also keep the entry barrier and semaphore accounting uniform.
+
+
+def _sched_round_kernel(axis, n: int, faithful: bool):
+    """One permutation round: member ``r`` DMAs its chunk for ``pi[r]`` into
+    that member's single round-output slot. Write-once per kernel (every
+    member receives exactly one chunk), so no credit protocol is needed —
+    cross-round airborne discipline is the launch-level 2-id rotation +
+    tie_chunk, exactly like the chunk pipeline."""
+
+    def kernel(pi_ref, x_ref, out_ref, send_sem, recv_sem):
+        r = lax.axis_index(axis)
+        if faithful:
+            _dma.all_barrier(axis, n)
+        dst = pi_ref[r]
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref.at[dst],
+            dst_ref=out_ref,
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            **_dma.remote_kwargs(axis, dst, faithful),
+        )
+        rdma.start()
+        rdma.wait_send()
+        rdma.wait_recv()
+
+    return kernel
+
+
+def _run_rounds(view, axis, n: int, perms, interpret, base_cid: int,
+                launch_seq: list):
+    """Launch one round kernel per permutation over ``view`` ([n, rows,
+    LANES]). ``launch_seq`` is the GLOBAL launch list shared across chunks:
+    kernel i ties to kernel i-2's output and takes id parity i&1, so the
+    whole scheduled exchange is one linear sequence with at most two
+    kernels airborne — the invariant that makes the {base, base+1} id
+    rotation sound across chunk AND round boundaries."""
+    rows = view.shape[1]
+    faithful = _dma.faithful_sync(interpret)
+    kern = _sched_round_kernel(axis, n, faithful)
+    outs = []
+    for pi in perms:
+        i = len(launch_seq)
+        v = _dma.tie_chunk(view, launch_seq[i - 2] if i >= 2 else None)
+        out = pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((rows, _dma.LANES), view.dtype),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA(()),  # send
+                pltpu.SemaphoreType.DMA(()),  # recv
+            ],
+            compiler_params=_dma.compiler_params(
+                _dma.chunk_collective_id(base_cid, i)
+            ),
+            interpret=_dma.interp(interpret),
+        )(jnp.asarray(pi, jnp.int32), v)
+        launch_seq.append(out)
+        outs.append(out)
+    return outs
+
+
+def _assemble_rounds(view, round_outs, k_mat, axis, n: int):
+    """Gather each source's slot from its designated round and overwrite
+    the diagonal with the local chunk. ``k_mat`` is the static [W, W]
+    designated-round matrix; on member ``r`` the needed column is
+    ``k_mat[:, r]`` — a dynamic slice of a constant by the traced rank."""
+    r = lax.axis_index(axis)
+    stacked = jnp.stack(round_outs)  # [R, rows, LANES]
+    col = lax.dynamic_index_in_dim(
+        jnp.asarray(k_mat, jnp.int32), r, axis=1, keepdims=False
+    )  # [n]: designated round per source
+    gathered = jnp.take(stacked, col, axis=0)  # [n, rows, LANES]
+    local = lax.dynamic_index_in_dim(view, r, axis=0, keepdims=False)
+    return lax.dynamic_update_index_in_dim(gathered, local, r, axis=0)
+
+
+def _normalize_schedule(schedule, n: int):
+    """Accept (rounds, K) from a2a_sched.wire_schedule (Round objects or
+    raw permutation tuples) and return (perm tuples, K) validated against
+    the axis size."""
+    rounds, k_mat = schedule
+    perms = []
+    for rnd in rounds:
+        perm = tuple(getattr(rnd, "perm", rnd))
+        if sorted(perm) != list(range(n)):
+            raise ValueError(
+                f"scheduled a2a round {perm} is not a permutation of "
+                f"range({n})"
+            )
+        perms.append(perm)
+    import numpy as _np
+
+    k_arr = _np.asarray(k_mat, _np.int32)
+    if k_arr.shape != (n, n):
+        raise ValueError(
+            f"designated-round matrix is {k_arr.shape}, want {(n, n)}"
+        )
+    if perms and (k_arr.max() >= len(perms) or k_arr.min() < 0):
+        raise ValueError("designated-round matrix indexes a missing round")
+    for s in range(n):
+        for d in range(n):
+            if s != d and perms and perms[k_arr[s, d]][s] != d:
+                raise ValueError(
+                    f"round {k_arr[s, d]} does not carry pair ({s}, {d})"
+                )
+    return perms, k_arr
+
+
+def _scheduled_chunked(x, axis, n: int, perms, k_mat, interpret,
+                       collective_id: int, n_chunks: int, chunk_axis: int):
+    """Chunk-pipelined scheduled exchange: the capacity axis splits exactly
+    like :func:`_all_to_all_chunked`, each chunk runs the full round
+    schedule, and ALL (chunk, round) kernels share one global launch
+    sequence (see :func:`_run_rounds`) so two are airborne at most. Returns
+    None past the double-buffer budget (caller falls back unchunked)."""
+    if x.ndim <= chunk_axis:
+        return None
+    size = x.shape[chunk_axis]
+    if size == 0:
+        return None
+    n_chunks = min(n_chunks, size)
+    if n_chunks <= 1:
+        return None
+    padded = _dma.pad_capacity(size, n_chunks)
+    cs = padded // n_chunks
+    chunk_elems_per_peer = x.size // size * cs // n
+    if not _dma.chunk_budget(n, chunk_elems_per_peer, x.dtype.itemsize,
+                             "ep_a2a_sched_chunked", interpret):
+        return None
+    if padded != size:
+        pad = [(0, 0)] * x.ndim
+        pad[chunk_axis] = (0, padded - size)
+        x = jnp.pad(x, pad)
+    launch_seq: list = []
+    outs = []
+    for c in range(n_chunks):
+        sl = [slice(None)] * x.ndim
+        sl[chunk_axis] = slice(c * cs, (c + 1) * cs)
+        xc = x[tuple(sl)]
+        cshape = xc.shape
+        view, kc, mc = _dma.pad_chunks(xc.reshape(-1), n)
+        round_outs = _run_rounds(view, axis, n, perms, interpret,
+                                 collective_id, launch_seq)
+        buf = _assemble_rounds(view, round_outs, k_mat, axis, n)
+        outs.append(buf.reshape(n, mc)[:, :kc].reshape(cshape))
+    out = jnp.concatenate(outs, axis=chunk_axis)
+    if padded != size:
+        sl = [slice(None)] * x.ndim
+        sl[chunk_axis] = slice(0, size)
+        out = out[tuple(sl)]
+    return out
+
+
+def scheduled_all_to_all(
+    x: jax.Array,
+    axis,
+    schedule,
+    *,
+    interpret=None,
+    collective_id=None,
+    n_chunks: int = 1,
+    chunk_axis: int = 1,
+) -> jax.Array:
+    """Per-shard ``[W, ...] -> [W, ...]`` all-to-all driven one contention-
+    free permutation round at a time.
+
+    ``schedule`` is the host-built ``(rounds, K)`` pair from
+    :func:`uccl_tpu.ep.a2a_sched.wire_schedule`: load-ordered full
+    permutations plus the designated-round matrix. Same tiled contract —
+    and bit-identical output — as :func:`all_to_all` and
+    ``lax.all_to_all``: the rounds are a pure reordering of the same
+    write-once per-pair DMAs, reassembled by designated round. Composes
+    with ``n_chunks`` pipelining exactly like the unscheduled wire (one
+    global launch sequence keeps at most two kernels airborne on the
+    rotated {22, 23} id pair). Falls back to the unscheduled kernel — and
+    transitively to lax — past the VMEM budget or on meshes the kernel
+    cannot address."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    if x.shape[0] != n:
+        raise ValueError(
+            f"all_to_all leading dim {x.shape[0]} != axis size {n}"
+        )
+    interpret = _dma.resolve_interpret(interpret)
+    if (
+        isinstance(axis, (tuple, list))
+        and len(axis) > 1
+        and not _dma.faithful_sync(interpret)
+    ):
+        _dma.record_fallback("ep_a2a_sched", "tuple_axis_mesh",
+                             detail=tuple(axis))
+        return _lax_fallback(x, axis)
+    perms, k_mat = _normalize_schedule(schedule, n)
+    if not perms:  # empty schedule: nothing crosses the wire at n > 1
+        raise ValueError("scheduled a2a needs at least one round at n > 1")
+    if collective_id is None:
+        collective_id = _dma.CID_SCHED
+    if n_chunks > 1:
+        if chunk_axis == 0:
+            raise ValueError("chunk_axis 0 is the member axis; chunk a "
+                             "trailing (slot) axis instead")
+        out = _scheduled_chunked(x, axis, n, perms, k_mat, interpret,
+                                 collective_id, n_chunks, chunk_axis)
+        if out is not None:
+            return out
+    view, k, m = _dma.pad_chunks(x.reshape(-1), n)  # [n, m//128, 128]
+    # resident per round kernel: the [n, ...] send view + one round slot,
+    # two kernels airborne (the global tie_chunk sequence)
+    if not _dma.check_budget(2 * (n + 1) * m * x.dtype.itemsize,
+                             "ep_a2a_sched", interpret):
+        return all_to_all(x, axis, interpret=interpret)
+    launch_seq: list = []
+    round_outs = _run_rounds(view, axis, n, perms, interpret, collective_id,
+                             launch_seq)
+    buf = _assemble_rounds(view, round_outs, k_mat, axis, n)
+    out = buf.reshape(n, m)[:, :k]
+    return out.reshape(x.shape)

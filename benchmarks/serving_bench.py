@@ -67,6 +67,7 @@ _ARM_COUNTERS = (
     ("prefix_cache_evictions_total", {}),
     ("prefix_cache_tokens_reused_total", {}),
     ("serving_prefill_tokens_total", {"kind": "computed"}),
+    ("serving_prefill_tokens_total", {"kind": "skipped"}),
     ("kv_stream_chunks_total", {"role": "tx"}),
     ("p2p_bytes_total", {"verb": "write"}),
     ("spec_tokens_total", {"outcome": "accepted"}),
@@ -124,6 +125,32 @@ def _counter_deltas(before):
         if labels:
             key += "_" + "_".join(labels.values())
         out[key] = a - b
+    return out
+
+
+# the tiered-KV counter families, delta'd per arm across every tier label
+_KV_TIER_COUNTERS = ("kv_tier_hits_total", "kv_tier_promotions_total",
+                     "kv_tier_demotions_total", "kv_tier_drops_total")
+_KV_TIERS = ("t0", "t1", "t2")
+
+
+def _kv_tier_state():
+    from uccl_tpu import obs
+
+    return {(name, t): obs.counter(name).get(tier=t)
+            for name in _KV_TIER_COUNTERS for t in _KV_TIERS}
+
+
+def _kv_tier_deltas(before):
+    """Per-tier traffic of the measured window: ``{hits: {t0: n, ...},
+    promotions: {...}, demotions: {...}, drops: {...}}`` — the audited
+    tier-traffic block every kv-tier arm line carries."""
+    after = _kv_tier_state()
+    out = {}
+    for name in _KV_TIER_COUNTERS:
+        short = name[len("kv_tier_"):-len("_total")]
+        out[short] = {t: after[(name, t)] - before[(name, t)]
+                      for t in _KV_TIERS}
     return out
 
 
@@ -362,6 +389,222 @@ def run_arm(args, jax, stack, rate, n_slots, prefill_chunk=None,
     return arm
 
 
+def _parse_tier_cfg(cfg: str):
+    """One --kv-tiers arm label -> (enable tiers, wire_dtype, enable T2).
+    ``t0`` = prefix cache only (the baseline the sweep beats), ``t1`` =
+    + lossless host pool, ``t1-fp8``/``t1-int8`` = host pool quantized at
+    rest, ``t1-t2`` = lossless host pool + loopback remote peer."""
+    cfg = cfg.strip()
+    if cfg == "t0":
+        return False, None, False
+    if cfg == "t1":
+        return True, None, False
+    if cfg in ("t1-fp8", "t1-int8"):
+        return True, cfg.split("-")[1], False
+    if cfg == "t1-t2":
+        return True, None, True
+    raise SystemExit(f"unknown --kv-tiers config {cfg!r} (want "
+                     "t0|t1|t1-fp8|t1-int8|t1-t2)")
+
+
+def run_kv_tier_arm(args, jax, stack, rate, n_slots, prefill_chunk,
+                    tier_cfg, working_set):
+    """One tiered-KV-cache arm: the multi-prefix working-set workload
+    (``working_set`` × ``n_slots`` distinct shared prefixes, round-robin —
+    every prefix's donor is evicted before its next use) against one tier
+    config. The line carries counter-delta tier traffic (hits/promotions/
+    demotions/drops per tier), computed-vs-skipped prefill tokens, TTFT,
+    and — with --check-oracle — every finished request verified against
+    the one-shot oracle (hard-exact on lossless-at-rest configs; quantized
+    configs record the match fraction instead, their documented
+    bounded-divergence contract)."""
+    if not prefill_chunk:
+        return None  # the prefix cache is chunk-granular by construction
+    if stack != "dense":
+        return None  # the sweep's oracle runs the dense stack (MoE
+        # lossless exactness is pinned in tests/test_kv_tiers.py)
+    shared = args.shared_prefix_len or max(1, args.prompt_len // 2)
+    if not (prefill_chunk <= shared < args.prompt_len):
+        return None  # no chunk-aligned hit would ever be possible
+
+    import numpy as np
+
+    from uccl_tpu import obs
+    from uccl_tpu.models.dense import DenseConfig, init_params
+    from uccl_tpu.serving import (
+        DenseBackend, PrefixCache, ServingEngine, TieredKVCache,
+    )
+    from uccl_tpu.serving.loadgen import (
+        drive, synth_multi_prefix_workload, warm_engine,
+    )
+
+    enable, wire_dtype, enable_t2 = _parse_tier_cfg(tier_cfg)
+    max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
+    cfg = DenseConfig(
+        vocab=args.vocab, dim=args.dim, n_layers=args.layers,
+        n_heads=4, n_kv_heads=2, head_dim=args.dim // 4, ffn=args.ffn,
+    )
+    params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    backend = DenseBackend(params, cfg, n_slots=n_slots, max_seq=max_seq)
+
+    # T1 capacity in ENTRY units: --host-tier-entries × the raw f32 bytes
+    # of one full-depth entry. The same byte budget holds ~3.6× the
+    # entries quantized at rest — that capacity, not speed, is the
+    # quantized mode's measured win.
+    ent_tokens = (args.prompt_len // prefill_chunk) * prefill_chunk
+    ent_bytes = 2 * cfg.n_layers * ent_tokens * cfg.n_kv_heads \
+        * cfg.head_dim * 4
+    n_prefixes = working_set * n_slots
+
+    server = remote = chan_pair = None
+    tiers = None
+    if enable:
+        remote = None
+        if enable_t2:
+            import threading
+
+            from uccl_tpu.p2p import Channel, Endpoint
+            from uccl_tpu.serving import KvTierServer, RemoteKVTier
+
+            sep, cep = Endpoint(), Endpoint()
+            res = {}
+            t = threading.Thread(
+                target=lambda: res.setdefault("c", Channel.accept(sep)))
+            t.start()
+            c = Channel.connect(cep, "127.0.0.1", sep.port, n_paths=2)
+            t.join(timeout=20)
+            chan_pair = (sep, cep, res["c"], c)
+            # the remote peer advertises room for the WHOLE working set:
+            # T1 spills land there instead of dropping
+            server = KvTierServer(capacity_bytes=ent_bytes * n_prefixes
+                                  + (1 << 16))
+            server.serve_forever(res["c"], timeout_ms=10000)
+            remote = RemoteKVTier(c, max_entry_bytes=ent_bytes + (1 << 12))
+        tiers = TieredKVCache(
+            host_bytes=args.host_tier_entries * ent_bytes + 16,
+            wire_dtype=wire_dtype, remote=remote,
+        )
+    engine = ServingEngine(
+        backend, prefill_chunk=prefill_chunk,
+        step_tokens=(args.step_tokens or None),
+        prefix_cache=PrefixCache(prefill_chunk), kv_tiers=tiers,
+    )
+    rng = np.random.default_rng(args.seed)
+    prompts, lens, arrivals = synth_multi_prefix_workload(
+        rng, args.requests, args.prompt_len, cfg.vocab, rate,
+        n_prefixes, shared,
+    )
+    warm_engine(engine, lens, max_seq, args.new_tokens)
+    if tiers is not None:
+        # codec compile warmup: the first real demote at each entry shape
+        # would otherwise compile the quantize/dequantize programs INSIDE
+        # the measured window (entry token counts vary with the random
+        # tail — one warm round trip per reachable chunk depth)
+        from uccl_tpu.serving.kv_tiers import decode_entry, encode_entry
+
+        for tok in sorted({(s // prefill_chunk) * prefill_chunk
+                           for s in range(shared + 1,
+                                          args.prompt_len + 1)}):
+            dummy = np.zeros((cfg.n_layers, tok, cfg.n_kv_heads,
+                              cfg.head_dim), np.float32)
+            decode_entry(*encode_entry(dummy, dummy, tiers.wire_dtype,
+                                       tiers.block))
+        # ...and the DEVICE side of each cycle: demotion jit-compiles
+        # export_rows per donor depth and promotion compiles import_rows
+        # at the matched length. One real demote per reachable depth plus
+        # one promoting hit keeps those compiles out of the window too.
+        from uccl_tpu.serving.loadgen import _clear_warmup_trace
+
+        wrng = np.random.default_rng(args.seed + 10_007)
+        base = wrng.integers(0, cfg.vocab, args.prompt_len).astype(np.int32)
+        for tok in sorted({(s // prefill_chunk) * prefill_chunk
+                           for s in range(shared + 1,
+                                          args.prompt_len + 1)}):
+            engine.submit(base[:tok], max_new_tokens=1)
+            engine.drain()
+            engine.prefix_cache.evict_lru(engine.pool,
+                                          demote=tiers.demote)
+        sc = (shared // prefill_chunk) * prefill_chunk
+        engine.submit(np.concatenate([base[:sc], base[-1:]]),
+                      max_new_tokens=1)
+        engine.drain()
+        engine.prefix_cache.clear(engine.pool)
+        engine.reset_metrics()
+        _clear_warmup_trace()
+    before = _counter_state()
+    kv_before = _kv_tier_state()
+    ttft_hist_before = _hist_state("serving_ttft_seconds")
+    reqs, wall = drive(engine, prompts, arrivals, args.new_tokens)
+    deltas = _counter_deltas(before)
+    snap = engine.snapshot()
+
+    exact_rest = tiers is None or tiers.exact
+    oracle_checked = oracle_matched = 0
+    if args.check_oracle:
+        import jax.numpy as jnp
+
+        from uccl_tpu.models.inference import generate
+
+        for r in reqs:
+            toks = generate(params, jnp.asarray(r.prompt)[None], cfg,
+                            max_new_tokens=r.max_new_tokens,
+                            max_seq=max_seq)
+            want = np.asarray(toks)[0, :r.n_generated].tolist()
+            oracle_checked += 1
+            if r.out_tokens == want:
+                oracle_matched += 1
+            elif exact_rest:
+                raise SystemExit(
+                    f"ORACLE MISMATCH on lossless tier config "
+                    f"{tier_cfg}: rid={r.rid} got {r.out_tokens} "
+                    f"want {want}"
+                )
+    if chan_pair is not None:
+        remote.close()
+        for ep in (chan_pair[0], chan_pair[1]):
+            ep.close()
+
+    arm = _arm_header(args, stack, 1, rate, n_slots, prefill_chunk,
+                      args.step_tokens or None, None)
+    arm.update({
+        "bench": "serving_kv_tiers",
+        "workload": "multi_prefix",
+        "tier_config": tier_cfg,
+        "working_set": working_set,
+        "n_prefixes": n_prefixes,
+        "shared_prefix_len": shared,
+        "host_tier_entries": args.host_tier_entries if enable else 0,
+        "entry_bytes_raw": ent_bytes,
+        "wire_dtype": wire_dtype,
+        "exact_rest": exact_rest,
+        "wall_s": round(wall, 3),
+        "completed": snap["completed"],
+        "goodput_tok_s": snap.get("goodput_tok_s"),
+        "ttft_ms": snap["ttft_ms"],
+        "ttft_hist_ms": _hist_delta_ms("serving_ttft_seconds",
+                                       ttft_hist_before),
+        "tpot_ms": snap["tpot_ms"],
+        "kv_tier": _kv_tier_deltas(kv_before),
+        "prefill_tokens_skipped": deltas["serving_prefill_tokens_skipped"],
+        "slot_high_water": engine.pool.high_water,
+    })
+    arm.update(_cache_fields(deltas))
+    if enable:
+        arm["t1_resident_bytes"] = tiers.t1.used_bytes
+        arm["t1_resident_entries"] = len(tiers.t1)
+        if server is not None:
+            arm["t2_resident_entries"] = len(server)
+            arm["t2_resident_bytes"] = server.used_bytes
+    if args.check_oracle:
+        arm["oracle_checked"] = oracle_checked
+        arm["oracle_exact"] = oracle_matched == oracle_checked
+        if not exact_rest and oracle_checked:
+            arm["oracle_match_rate"] = round(
+                oracle_matched / oracle_checked, 4)
+    arm["obs"] = obs.REGISTRY.snapshot()["metrics"]
+    return arm
+
+
 def run_router_arm(args, jax, stack, rate, n_slots, prefill_chunk,
                    n_replicas, mix, preempt_on, overload):
     """One replica-router arm under sustained Poisson (over)load:
@@ -572,6 +815,30 @@ def main():
     ap.add_argument("--shared-prefix-len", type=int, default=0,
                     help="shared system-prompt length for the hit-rate "
                          "sweep (0 = prompt_len/2)")
+    ap.add_argument("--kv-tiers", default="",
+                    help="comma-separated tiered-KV-cache arms (e.g. "
+                         "'t0,t1,t1-fp8,t1-t2'): each runs the multi-"
+                         "prefix working-set workload against one tier "
+                         "config — t0 = prefix cache only, t1 = + bounded "
+                         "lossless host pool, t1-fp8/t1-int8 = host pool "
+                         "quantized at rest, t1-t2 = + a loopback remote "
+                         "peer over the SACK channel. Lines are "
+                         "bench=serving_kv_tiers with counter-delta tier "
+                         "traffic; dense chunked arms only")
+    ap.add_argument("--working-sets", default="10",
+                    help="comma-separated working-set multipliers for "
+                         "--kv-tiers arms: each arm uses N x slots "
+                         "distinct shared prefixes round-robin (the "
+                         "10-100x device-capacity axis)")
+    ap.add_argument("--host-tier-entries", type=int, default=8,
+                    help="T1 host-pool capacity in raw-f32 full-depth "
+                         "entry units (the same bytes hold ~3.6x the "
+                         "entries under fp8/int8 at rest)")
+    ap.add_argument("--check-oracle", action="store_true",
+                    help="kv-tier arms: verify every finished request "
+                         "against the one-shot oracle — hard-exact on "
+                         "lossless-at-rest configs, match-rate recorded "
+                         "on quantized ones")
     ap.add_argument("--spec-k", default="",
                     help="comma-separated speculative-decoding arms (e.g. "
                          "'0,2,4'; 0 = vanilla): each decoding slot "
@@ -672,6 +939,40 @@ def main():
     spec_ks = ([None if int(k) == 0 else int(k)
                 for k in args.spec_k.split(",")]
                if args.spec_k else [None])
+
+    if args.kv_tiers:
+        # the tiered-KV sweep: tier config x working set arms, each a
+        # serving_kv_tiers JSON line with audited per-tier traffic
+        if args.disagg or args.replicas or args.prefix_hit_rates \
+                or args.spec_k:
+            raise SystemExit(
+                "--kv-tiers composes with --working-sets/--host-tier-"
+                "entries, not the --disagg/--replicas/--prefix-hit-rates/"
+                "--spec-k sweeps"
+            )
+        for rate in [float(r) for r in args.rates.split(",")]:
+            for n_slots in [int(s) for s in args.slots.split(",")]:
+                for chunk in chunks:
+                    for ws in [int(w) for w in
+                               args.working_sets.split(",")]:
+                        for tc in args.kv_tiers.split(","):
+                            arm = run_kv_tier_arm(args, jax, args.stack,
+                                                  rate, n_slots, chunk,
+                                                  tc.strip(), ws)
+                            if arm is None:
+                                print(json.dumps({
+                                    "bench": "serving_kv_tiers",
+                                    "tier_config": tc.strip(),
+                                    "working_set": ws, "slots": n_slots,
+                                    "prefill_chunk": chunk,
+                                    "skipped": "kv-tier arms need the "
+                                               "dense stack, a prefill "
+                                               "chunk, and a chunk-"
+                                               "reachable shared prefix",
+                                }), flush=True)
+                                continue
+                            print(json.dumps(arm), flush=True)
+        return
 
     if args.replicas:
         # the scale-out sweep: replicas x overload x priority-mix x

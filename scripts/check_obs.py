@@ -112,6 +112,17 @@ actually ridden the schedule (``sched_active`` with counted
 ``ep_sched`` rounds) — i.e. the scheduled wire demonstrably fired,
 oracle-exact, with every label counter-audited.
 
+``--kv-tiers`` mode (the tiered-KV-cache smoke arm,
+``serving_bench.py --kv-tiers ... --check-oracle --metrics-out``): the
+metrics must prove every exercised tier demonstrably cycled — ≥1 counted
+``kv_tier_demotions_total`` AND ``kv_tier_promotions_total`` for the t1
+tier (and for t2 when any bench arm ran a t1-t2 config), nonzero
+``kv_tier_resident_bytes{tier="t1"}`` (entries really live at rest in
+the host pool), the ``prefix_cache_resident_tokens`` gauge exported, and
+— from the bench JSON — every lossless-at-rest arm (``exact_rest``)
+``oracle_exact`` with ≥1 such arm present, every tier-enabled arm's
+traffic labeled off real counter deltas.
+
 ``--router`` mode (the replica-router smoke arm, serve --server
 --replicas N --priority-classes ... --metrics-out): the metrics file
 must carry ≥2 replica-labeled ``serving_router_requests_total`` series
@@ -584,6 +595,77 @@ def check_a2a_sched_metrics(path: str, bench_json: str) -> None:
           f"{sweeps} sweep(s), {active} schedule-active")
 
 
+def check_kv_tiers_metrics(path: str, bench_json: str) -> None:
+    """The tiered-KV smoke arm: the host (and, when exercised, remote)
+    tier must have demonstrably cycled — counted demotions AND promotions
+    per tier, at-rest residency visible on the byte gauge, and every
+    lossless-at-rest bench arm oracle-exact."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+
+    def tier_total(name: str, tier: str) -> float:
+        hits = [float(ln.rsplit(" ", 1)[1]) for ln in lines
+                if ln.startswith(f"{name}{{") and f'tier="{tier}"' in ln]
+        return sum(hits)
+
+    arms = exact_arms = 0
+    tiers_run = set()
+    with open(bench_json) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                arm = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if arm.get("bench") != "serving_kv_tiers" or "skipped" in arm:
+                continue
+            arms += 1
+            cfg = arm.get("tier_config", "")
+            tiers_run.update(t for t in ("t1", "t2") if t in cfg)
+            if "kv_tier" not in arm:
+                fail(f"{bench_json}: arm {cfg!r} carries no counter-delta "
+                     f"kv_tier traffic block")
+            if arm.get("exact_rest"):
+                if "oracle_exact" not in arm:
+                    fail(f"{bench_json}: lossless arm {cfg!r} was never "
+                         f"oracle-checked (run with --check-oracle)")
+                if arm["oracle_exact"] is not True:
+                    fail(f"{bench_json}: lossless arm {cfg!r} is not "
+                         f"oracle_exact — a promoted prefix diverged")
+                exact_arms += 1
+            if cfg != "t0":
+                traffic = arm["kv_tier"]
+                if traffic.get("demotions", {}).get("t1", 0) < 1:
+                    fail(f"{bench_json}: tier arm {cfg!r} counted no t1 "
+                         f"demotion — eviction pressure never moved an "
+                         f"entry down")
+    if arms < 1:
+        fail(f"{bench_json}: no serving_kv_tiers arms recorded")
+    if exact_arms < 1:
+        fail(f"{bench_json}: no lossless-at-rest arm was oracle-checked "
+             f"— the bit-exact tier contract went unproven")
+    for tier in sorted(tiers_run):
+        for name, what in (("kv_tier_demotions_total",
+                            "an entry moved down"),
+                           ("kv_tier_promotions_total",
+                            "a hit imported back")):
+            if tier_total(name, tier) < 1:
+                fail(f"{path}: no counted {name} for tier {tier!r} — "
+                     f"never {what} through the exercised tier")
+    if tier_total("kv_tier_resident_bytes", "t1") <= 0:
+        fail(f"{path}: kv_tier_resident_bytes{{tier=\"t1\"}} is zero — "
+             f"no entry lives at rest in the host pool")
+    if not any(ln.startswith("prefix_cache_resident_tokens")
+               for ln in lines):
+        fail(f"{path}: missing prefix_cache_resident_tokens gauge — the "
+             f"device-tier pressure axis is invisible")
+    print(f"check_obs: kv-tiers metrics OK — {arms} arm(s), "
+          f"{exact_arms} oracle-exact lossless, tiers cycled: "
+          f"{sorted(tiers_run)}")
+
+
 def check_router_metrics(path: str) -> None:
     with open(path) as f:
         lines = f.read().splitlines()
@@ -857,12 +939,17 @@ def main(argv) -> None:
         check_weights_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
         return
+    if len(argv) == 4 and argv[1] == "--kv-tiers":
+        check_kv_tiers_metrics(argv[2], argv[3])
+        print("check_obs: ALL OK")
+        return
     if len(argv) != 3:
         fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
              "check_obs.py --plan METRICS_PROM BENCH_JSON | "
              "check_obs.py --a2a-sched METRICS_PROM BENCH_JSON | "
              "check_obs.py --weights PUSH_PROM PLAN_PROM | "
+             "check_obs.py --kv-tiers METRICS_PROM BENCH_JSON | "
              "check_obs.py --disagg METRICS_PROM | "
              "check_obs.py --chaos METRICS_PROM [BENCH_JSON] | "
              "check_obs.py --transport METRICS_PROM [BENCH_JSON] | "

@@ -61,6 +61,14 @@ JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --st
   --metrics-out /tmp/qa_router_metrics.prom; check $?
 python scripts/check_obs.py --router /tmp/qa_router_metrics.prom; check $?
 
+note "tiered KV cache smoke tier (2 device slots vs 6-prefix working set, t0/t1/t1-fp8/t1-t2 arms over a 4-entry host pool: demote->promote cycles per tier counter-audited, lossless arms oracle-exact, resident-bytes gauges live)"
+JAX_PLATFORMS=cpu timeout 600 python benchmarks/serving_bench.py --rates 50 --slots 2 \
+  --prefill-chunks 4 --kv-tiers t0,t1,t1-fp8,t1-t2 --working-sets 3 \
+  --host-tier-entries 4 --requests 24 --prompt-len 12 --shared-prefix-len 8 \
+  --new-tokens 4 --check-oracle \
+  --metrics-out /tmp/qa_kvtiers_metrics.prom > /tmp/qa_kvtiers_bench.json; check $?
+python scripts/check_obs.py --kv-tiers /tmp/qa_kvtiers_metrics.prom /tmp/qa_kvtiers_bench.json; check $?
+
 note "windowed transport smoke tier (lossy+reordering loopback incast: 4->1 channel fan-in at 2% drop / 20% reorder, swift + eqds-credit arms, payload bit-exact, SACK retx split + credit series validated)"
 timeout 600 python benchmarks/incast_bench.py --smoke \
   --metrics-out /tmp/qa_transport_metrics.prom \

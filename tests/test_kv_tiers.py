@@ -1,0 +1,468 @@
+"""Tiered KV cache (ISSUE 17): device → host → remote prefix reuse.
+
+Four layers, cheapest first:
+
+* **Codec units**: raw at-rest entries round-trip bit-exactly; fp8/int8
+  entries round-trip within the shared codec's documented
+  ``amax / ROUND_TRIP_DIVISOR`` bound (the quantized-tier exactness
+  contract) at a fraction of the raw footprint; malformed blobs rejected.
+* **Tier-manager invariants** (stub backend, no jax): an entry lives in
+  exactly ONE tier; promotion reads the donor entry without evicting it;
+  demotion under a full T1 spills-or-drops (counted) and never blocks; a
+  stale ref degrades to a cold miss (``promote`` → False); release is
+  idempotent and the resident gauges track every move.
+* **T2 loopback** (real p2p endpoints, the weight-push control framing):
+  put/get bit-exact with CRC verification, get-miss on unknown keys,
+  delete takes, server-side LRU eviction notices ride the put response.
+* **Oracle exactness** (real models): with the LOSSLESS tier configured,
+  demote→promote cycles keep every output bit-equal to the one-shot
+  ``generate`` oracle on the dense stack (tier-1) and the EP MoE stack
+  (slow, like every multi-compile arm); the fp8-at-rest arm (slow: codec
+  compiles per entry shape) stamps ``cache_hit_exact=False`` on every
+  deep hit so lossy reuse is attributable per request.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from uccl_tpu import obs
+from uccl_tpu.p2p import Channel, Endpoint
+from uccl_tpu.serving import (
+    PrefixCache, ServingEngine, SlotPool, TieredKVCache, TierRef,
+)
+from uccl_tpu.serving.kv_tiers import (
+    HostKVTier, KvTierServer, RemoteKVTier, decode_entry, encode_entry,
+)
+
+MAX_SEQ = 32
+
+
+def _rows(rng, n_tokens, layers=2, heads=2, dim=8):
+    shape = (layers, n_tokens, heads, dim)
+    return (rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32))
+
+
+class TestCodec:
+    def test_raw_round_trip_bit_exact(self, rng):
+        k, v = _rows(rng, 8)
+        blob, meta = encode_entry(k, v)
+        assert meta["enc"] == "raw" and blob.dtype == np.uint8
+        assert blob.nbytes == 2 * k.nbytes
+        k2, v2 = decode_entry(blob, meta)
+        assert np.array_equal(k, k2) and np.array_equal(v, v2)
+
+    @pytest.mark.parametrize("wd", ["fp8", "int8"])
+    def test_quantized_round_trip_within_documented_bound(self, rng, wd):
+        """THE lossy-tier contract: max abs error ≤ the codec's published
+        ``round_trip_bound`` per block — and the blob is materially
+        smaller than raw (the reason to opt in)."""
+        from uccl_tpu.ops import quant
+
+        k, v = _rows(rng, 8)
+        blob, meta = encode_entry(k, v, wire_dtype=wd, block=4)
+        assert meta["enc"] == wd
+        k2, v2 = decode_entry(blob, meta)
+        per_unit = quant.round_trip_bound(1.0, wd)  # bound scales with amax
+        for a, b in ((k, k2), (v, v2)):
+            amax = np.abs(a.reshape(-1, 4)).max(axis=1, keepdims=True)
+            err = np.abs(a - b).reshape(-1, 4)
+            assert (err <= amax * per_unit + 1e-7).all()
+        # payload 1B/elem + f32 scale per 4-elem block = half of raw f32
+        # (production block=32 amortizes the sidecar to ~28% of raw)
+        raw_nbytes = 2 * k.nbytes
+        assert blob.nbytes <= raw_nbytes / 2
+
+    def test_malformed_inputs_rejected(self, rng):
+        k, v = _rows(rng, 4)
+        with pytest.raises(ValueError, match="shapes differ"):
+            encode_entry(k, v[:, :3])
+        blob, meta = encode_entry(k, v)
+        with pytest.raises(ValueError, match="blob"):
+            decode_entry(blob[:-4], meta)
+
+
+class _TierStubBackend:
+    """Host-array KV pool with the engine backends' export/import surface;
+    rows are deterministic per (slot, position) so imports are checkable."""
+
+    def __init__(self, n_slots=2, max_seq=MAX_SEQ, layers=2, heads=2,
+                 dim=8):
+        self.n_slots = n_slots
+        self.k = np.zeros((layers, n_slots, max_seq, heads, dim),
+                          np.float32)
+        self.v = np.zeros_like(self.k)
+
+    def fill(self, slot, n, seed):
+        rng = np.random.default_rng(seed)
+        self.k[:, slot, :n] = rng.standard_normal(
+            self.k[:, slot, :n].shape)
+        self.v[:, slot, :n] = rng.standard_normal(
+            self.v[:, slot, :n].shape)
+        return self.k[:, slot, :n].copy(), self.v[:, slot, :n].copy()
+
+    def export_slot_kv(self, slot, lo, hi):
+        return self.k[:, slot, lo:hi].copy(), self.v[:, slot, lo:hi].copy()
+
+    def import_slot_kv(self, slot, k_rows, v_rows, *, length):
+        self.k[:, slot, :length] = k_rows
+        self.v[:, slot, :length] = v_rows
+
+
+def _entry_bytes(n_tokens, layers=2, heads=2, dim=8):
+    return 2 * layers * n_tokens * heads * dim * 4
+
+
+def _tier_setup(host_entries, *, entry_tokens=8, wire_dtype=None,
+                remote=None, n_slots=2):
+    backend = _TierStubBackend(n_slots=n_slots)
+    pool = SlotPool(n_slots)
+    pc = PrefixCache(4)
+    tiers = TieredKVCache(
+        host_bytes=host_entries * _entry_bytes(entry_tokens) + 1,
+        wire_dtype=wire_dtype, remote=remote,
+    )
+    tiers.attach(backend, pc)
+    return backend, pool, pc, tiers
+
+
+def _park(backend, pool, pc, rid, prompt, seed):
+    slot = pool.admit(rid)
+    backend.fill(slot, prompt.size, seed)
+    assert pc.park(pool, slot, prompt)
+    return slot
+
+
+class TestTierManager:
+    def test_demotion_moves_entry_to_exactly_one_tier(self):
+        backend, pool, pc, tiers = _tier_setup(4)
+        p = np.arange(8, dtype=np.int32)
+        _park(backend, pool, pc, 0, p, seed=1)
+        d0 = obs.counter("kv_tier_demotions_total").get(tier="t1")
+        victim = pc.evict_lru(pool, demote=tiers.demote)
+        assert victim is not None and pool.n_free == 2
+        # the entry lives in T1 and ONLY T1: no parked slot remains, the
+        # trie's resident is the tier ref, and a match still finds it
+        assert pool.n_parked == 0 and len(tiers.t1) == 1
+        assert pc.n_resident == 0 and pc.n_tier_refs == 1
+        m, donor = pc.match(np.concatenate([p, [9]]).astype(np.int32))
+        assert m == 8 and isinstance(donor, TierRef)
+        assert donor.tier == "t1" and donor.exact
+        assert obs.counter("kv_tier_demotions_total").get(
+            tier="t1") == d0 + 1
+        assert obs.gauge("kv_tier_resident_bytes").get(
+            tier="t1") == _entry_bytes(8)
+        assert obs.gauge("kv_tier_resident_tokens").get(tier="t1") == 8
+
+    def test_promotion_bit_exact_and_never_evicts_donor(self):
+        backend, pool, pc, tiers = _tier_setup(4)
+        p = np.arange(8, dtype=np.int32)
+        slot = pool.admit(0)
+        k_orig, v_orig = backend.fill(slot, 8, seed=2)
+        assert pc.park(pool, slot, p)
+        pc.evict_lru(pool, demote=tiers.demote)
+        ref = pc.peek_donor(np.concatenate([p, [9]]).astype(np.int32))
+        pr0 = obs.counter("kv_tier_promotions_total").get(tier="t1")
+        # promote TWICE into different slots: the donor entry is read in
+        # place, so the second hit must still find it intact
+        for rid, seed in ((1, 77), (2, 78)):
+            dst = pool.admit(rid)
+            backend.fill(dst, 8, seed=seed)  # stale garbage to overwrite
+            assert tiers.promote(ref, dst, 8)
+            assert np.array_equal(backend.k[:, dst, :8], k_orig)
+            assert np.array_equal(backend.v[:, dst, :8], v_orig)
+            assert len(tiers.t1) == 1  # donor survived serving the hit
+            pool.free(dst)
+        assert obs.counter("kv_tier_promotions_total").get(
+            tier="t1") == pr0 + 2
+
+    def test_full_t1_drops_counted_never_blocks(self):
+        """1-entry host pool under 3 demotions (no T2): each demotion
+        succeeds immediately — the pool spills its LRU entry OUT (counted
+        on drops) rather than refusing the newcomer, and the spilled
+        entries' trie refs are gone."""
+        backend, pool, pc, tiers = _tier_setup(1, n_slots=3)
+        d0 = obs.counter("kv_tier_drops_total").get(tier="t1")
+        prompts = [np.asarray([i, i, i + 1, i + 1, i + 2, i + 2, i + 3,
+                               i + 3], np.int32) for i in (10, 20, 30)]
+        for i, p in enumerate(prompts):
+            _park(backend, pool, pc, i, p, seed=i)
+            assert pc.evict_lru(pool, demote=tiers.demote) is not None
+        assert len(tiers.t1) == 1
+        assert tiers.t1.used_bytes <= tiers.t1.capacity_bytes
+        assert obs.counter("kv_tier_drops_total").get(tier="t1") == d0 + 2
+        # only the LAST prefix survived the churn
+        hits = [pc.match(np.concatenate([p, [9]]).astype(np.int32))[0]
+                for p in prompts]
+        assert hits == [0, 0, 8]
+
+    def test_oversize_entry_dropped_not_stored(self):
+        backend, pool, pc, tiers = _tier_setup(1, entry_tokens=4)
+        d0 = obs.counter("kv_tier_drops_total").get(tier="t1")
+        p = np.arange(8, dtype=np.int32)  # 8-token entry > 4-token pool
+        _park(backend, pool, pc, 0, p, seed=3)
+        assert pc.evict_lru(pool, demote=tiers.demote) is not None
+        assert len(tiers.t1) == 0 and pc.n_tier_refs == 0
+        assert obs.counter("kv_tier_drops_total").get(tier="t1") == d0 + 1
+        assert pc.match(np.concatenate([p, [9]]).astype(np.int32))[0] == 0
+
+    def test_stale_ref_promotes_false(self):
+        backend, pool, pc, tiers = _tier_setup(4)
+        p = np.arange(8, dtype=np.int32)
+        _park(backend, pool, pc, 0, p, seed=4)
+        pc.evict_lru(pool, demote=tiers.demote)
+        ref = pc.peek_donor(np.concatenate([p, [9]]).astype(np.int32))
+        tiers.t1.pop(ref.key)  # simulate out-of-band loss
+        dst = pool.admit(1)
+        assert tiers.promote(ref, dst, 8) is False
+        with pytest.raises(ValueError, match="promote of"):
+            tiers.promote(ref, dst, 9)
+
+    def test_release_is_idempotent_and_gauges_zero(self):
+        backend, pool, pc, tiers = _tier_setup(4)
+        p = np.arange(8, dtype=np.int32)
+        _park(backend, pool, pc, 0, p, seed=5)
+        pc.evict_lru(pool, demote=tiers.demote)
+        ref = pc.peek_donor(np.concatenate([p, [9]]).astype(np.int32))
+        pc.replace_ref(ref, None)  # trie drop → embedded release
+        assert len(tiers.t1) == 0 and tiers.t1.used_bytes == 0
+        tiers.release(ref)  # second release: no-op, no underflow
+        assert tiers.t1.used_bytes == 0 and tiers.t1.used_tokens == 0
+        assert obs.gauge("kv_tier_resident_bytes").get(tier="t1") == 0
+        assert obs.gauge("kv_tier_resident_tokens").get(tier="t1") == 0
+
+    def test_host_tier_rejects_duplicates_and_bad_capacity(self):
+        with pytest.raises(ValueError, match="capacity_bytes"):
+            HostKVTier(0)
+        t1 = HostKVTier(1 << 20)
+        blob = np.zeros(16, np.uint8)
+        ref = TierRef("t1", 0, 4, True, 16)
+        t1.put(0, blob, {}, ref)
+        with pytest.raises(ValueError, match="already stored"):
+            t1.put(0, blob, {}, ref)
+
+
+def chan_pair(server_ep, client_ep, n_paths=2):
+    res = {}
+    t = threading.Thread(
+        target=lambda: res.setdefault("c", Channel.accept(server_ep)))
+    t.start()
+    c = Channel.connect(client_ep, "127.0.0.1", server_ep.port,
+                        n_paths=n_paths)
+    t.join(timeout=20)
+    assert "c" in res, "channel accept timed out"
+    return res["c"], c
+
+
+class TestRemoteTier:
+    def test_put_get_delete_over_loopback(self, rng):
+        """The T2 wire: CRC-verified put/get round trips bit-exactly
+        through real endpoints, unknown keys miss, deletes take, and the
+        service-level ingress rides p2p_bytes_total{verb="kv_tier"}."""
+        k, v = _rows(rng, 8)
+        blob, meta = encode_entry(k, v)
+        verb0 = obs.counter("p2p_bytes_total").get(verb="kv_tier")
+        srv = KvTierServer(capacity_bytes=4 * blob.nbytes)
+        with Endpoint(n_engines=2) as sep, Endpoint(n_engines=2) as cep:
+            schan, cchan = chan_pair(sep, cep)
+            t = srv.serve_forever(schan, timeout_ms=2000)
+            cli = RemoteKVTier(cchan, max_entry_bytes=blob.nbytes,
+                               timeout_ms=2000)
+            assert cli.put(7, blob, meta) == []
+            got = cli.get(7)
+            assert got is not None
+            k2, v2 = decode_entry(*got)
+            assert np.array_equal(k, k2) and np.array_equal(v, v2)
+            assert cli.get(99) is None
+            cli.delete(7)
+            assert cli.get(7) is None
+            cli.close()
+            t.join(timeout=20)
+        assert (obs.counter("p2p_bytes_total").get(verb="kv_tier")
+                >= verb0 + 2 * blob.nbytes)  # put ingress + get egress
+
+    def test_server_eviction_notice_rides_put_response(self, rng):
+        """A 2-entry server under 3 puts LRU-drops the oldest key and
+        NAMES it in the put response — the client's eager-invalidation
+        feed (discovering staleness at promotion time would cost a wire
+        round trip per doomed hit)."""
+        k, v = _rows(rng, 4)
+        blob, meta = encode_entry(k, v)
+        srv = KvTierServer(capacity_bytes=2 * blob.nbytes)
+        with Endpoint(n_engines=2) as sep, Endpoint(n_engines=2) as cep:
+            schan, cchan = chan_pair(sep, cep)
+            t = srv.serve_forever(schan, timeout_ms=2000)
+            cli = RemoteKVTier(cchan, max_entry_bytes=blob.nbytes,
+                               timeout_ms=2000)
+            assert cli.put(1, blob, meta) == []
+            assert cli.put(2, blob, meta) == []
+            assert cli.put(3, blob, meta) == [1]  # oldest key evicted
+            assert cli.get(1) is None and cli.get(3) is not None
+            # an entry larger than the server's whole capacity is refused
+            big = np.zeros(3 * blob.nbytes, np.uint8)
+            assert cli.put(4, big, {"enc": "raw", "shape": [1]}) is None
+            cli.close()
+            t.join(timeout=20)
+
+
+def _engine_with_tiers(backend, tiers):
+    pc = PrefixCache(4)
+    return ServingEngine(backend, prefill_chunk=4, prefix_cache=pc,
+                         kv_tiers=tiers)
+
+
+def _oracle(params, cfg, req):
+    import jax.numpy as jnp
+
+    from uccl_tpu.models.inference import generate
+
+    toks = generate(params, jnp.asarray(req.prompt)[None], cfg,
+                    max_new_tokens=req.max_new_tokens, max_seq=MAX_SEQ)
+    return np.asarray(toks)[0, : req.n_generated].tolist()
+
+
+@pytest.fixture(scope="module")
+def dense_setup():
+    """Same config family as test_serving/test_prefix_cache so the
+    one-shot oracle programs are _GEN_CACHE hits across files."""
+    import jax
+
+    from uccl_tpu.models import dense
+    from uccl_tpu.serving import DenseBackend
+
+    cfg = dense.DenseConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, head_dim=8,
+        ffn=64,
+    )
+    params = dense.init_params(jax.random.PRNGKey(0), cfg)
+    backend = DenseBackend(params, cfg, n_slots=2, max_seq=MAX_SEQ)
+    return cfg, params, backend
+
+
+class TestDenseTieredExact:
+    def test_demote_promote_cycles_stay_bit_exact(self, dense_setup):
+        """THE acceptance property: a working set of 4 distinct prefixes
+        through 2 device slots — every donor is LRU-demoted to the host
+        tier before its prefix returns, so round two serves exclusively
+        tier promotions, and every output (both rounds) bit-equals the
+        one-shot oracle with ``cache_hit_exact`` True throughout."""
+        cfg, params, backend = dense_setup
+        tiers = TieredKVCache(host_bytes=1 << 20)
+        eng = _engine_with_tiers(backend, tiers)
+        pr0 = obs.counter("kv_tier_promotions_total").get(tier="t1")
+        rng = np.random.default_rng(7)
+        bases = [rng.integers(0, 64, 12).astype(np.int32)
+                 for _ in range(4)]
+        reqs = []
+        for rnd in range(2):
+            for p in bases:
+                reqs.append(eng.submit(p.copy(), max_new_tokens=4))
+                eng.drain()
+        promoted = (obs.counter("kv_tier_promotions_total").get(tier="t1")
+                    - pr0)
+        assert promoted >= 4, "round two never hit the host tier"
+        hits = [r.cache_hit_len for r in reqs]
+        assert hits[:4] == [0] * 4 and all(h == 8 for h in hits[4:]), hits
+        for r in reqs:
+            assert r.cache_hit_exact is True
+            assert r.out_tokens == _oracle(params, cfg, r), r.rid
+        assert eng.pool.leaked() == 0
+        eng.prefix_cache.clear(eng.pool)
+
+    def test_promote_failure_degrades_to_cold_miss(self, dense_setup):
+        """A stale tier ref at admission (entry lost under the trie) must
+        cold-prefill and still match the oracle — never serve garbage."""
+        cfg, params, backend = dense_setup
+        tiers = TieredKVCache(host_bytes=1 << 20)
+        eng = _engine_with_tiers(backend, tiers)
+        rng = np.random.default_rng(8)
+        p = rng.integers(0, 64, 12).astype(np.int32)
+        eng.submit(p.copy(), max_new_tokens=4)
+        eng.drain()
+        eng.prefix_cache.evict_lru(eng.pool, demote=tiers.demote)
+        for ref in eng.prefix_cache.tier_refs():
+            tiers.t1.pop(ref.key)  # lose the bytes, keep the trie ref
+        r = eng.submit(p.copy(), max_new_tokens=4)
+        eng.drain()
+        assert r.cache_hit_len == 0  # the stale hit became a cold miss
+        assert r.out_tokens == _oracle(params, cfg, r)
+        assert eng.pool.leaked() == 0
+        eng.prefix_cache.clear(eng.pool)
+
+
+@pytest.mark.slow
+class TestDenseQuantizedAtRest:
+    def test_fp8_hits_stamped_inexact_and_bounded(self, dense_setup):
+        """The opt-in lossy tier: deep hits resume from fp8-at-rest rows —
+        each request that reused them carries ``cache_hit_exact=False``
+        (attributable divergence, never silent), cold requests stay True,
+        and generation still completes to budget."""
+        cfg, params, backend = dense_setup
+        tiers = TieredKVCache(host_bytes=1 << 20, wire_dtype="fp8")
+        assert not tiers.exact
+        eng = _engine_with_tiers(backend, tiers)
+        rng = np.random.default_rng(9)
+        bases = [rng.integers(0, 64, 12).astype(np.int32)
+                 for _ in range(4)]
+        reqs = []
+        for rnd in range(2):
+            for p in bases:
+                reqs.append(eng.submit(p.copy(), max_new_tokens=4))
+                eng.drain()
+        deep = [r for r in reqs if not r.cache_hit_exact]
+        assert len(deep) >= 4, "no request ever resumed from fp8 rows"
+        assert all(r.cache_hit_len == 8 for r in deep)
+        assert all(r.cache_hit_exact for r in reqs[:4])  # cold round
+        assert all(r.n_generated == 4 for r in reqs)
+        assert eng.pool.leaked() == 0
+        eng.prefix_cache.clear(eng.pool)
+
+
+@pytest.mark.slow
+class TestMoETieredExact:
+    def test_moe_demote_promote_bit_exact(self, devices):
+        """The lossless tier through the EP-sharded MoE stack: the grid-
+        mapped export/import views feed the same codec, and demote→promote
+        cycles stay bit-exact vs the world-1 oracle."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from uccl_tpu.models.moe_inference import (
+            MoEServeConfig, MoEServer, init_params,
+        )
+        from uccl_tpu.serving import MoEBackend
+
+        cfg = MoEServeConfig(
+            vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            head_dim=8, moe_experts=8, moe_topk=2, moe_ffn=64,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        srv = MoEServer(cfg, Mesh(np.array(devices[:2]), ("dp",)))
+        backend = MoEBackend(srv, srv.shard_params(params), batch_local=1,
+                             max_seq=MAX_SEQ)
+        tiers = TieredKVCache(host_bytes=1 << 20)
+        eng = ServingEngine(backend, prefill_chunk=3,
+                            prefix_cache=PrefixCache(3), kv_tiers=tiers)
+        srv1 = MoEServer(cfg, Mesh(np.array(devices[:1]), ("dp",)))
+        p1p = srv1.shard_params(params)
+        pr0 = obs.counter("kv_tier_promotions_total").get(tier="t1")
+        rng = np.random.default_rng(0)
+        bases = [rng.integers(0, 64, 8).astype(np.int32)
+                 for _ in range(3)]
+        reqs = []
+        for rnd in range(2):
+            for p in bases:
+                reqs.append(eng.submit(p.copy(), max_new_tokens=4))
+                eng.drain()
+        assert (obs.counter("kv_tier_promotions_total").get(tier="t1")
+                > pr0), "no MoE promotion exercised"
+        assert all(r.cache_hit_len == 6 for r in reqs[3:])
+        for r in reqs:
+            assert r.cache_hit_exact is True
+            want = srv1.generate(p1p, jnp.asarray(r.prompt)[None, None],
+                                 r.max_new_tokens, MAX_SEQ, impl="ll")
+            assert r.out_tokens == np.asarray(want)[0, 0].tolist(), r.rid
+        assert eng.pool.leaked() == 0

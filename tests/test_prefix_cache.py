@@ -120,6 +120,78 @@ class TestTrie:
         assert pool.n_free == 3 and pc.n_resident == 0
 
 
+class _PoisonedChildren(dict):
+    """A children dict that detonates on any whole-dict traversal but
+    still answers keyed lookups — removal along a DIFFERENT branch must
+    never iterate this one."""
+
+    def _boom(self, *a, **kw):
+        raise AssertionError("sibling branch was traversed during _remove")
+
+    __iter__ = keys = values = items = _boom
+
+
+class TestRemoveByPath:
+    def test_remove_walks_only_the_victim_branch(self):
+        """The O(depth) eviction contract (ISSUE 17): ``_remove`` follows
+        the victim's RECORDED chunk-key path, so a sibling branch — here
+        booby-trapped to fail on iteration — is never visited. The pre-17
+        implementation pruned the whole trie per eviction and trips this
+        immediately."""
+        pool = SlotPool(4)
+        pc = PrefixCache(4)
+        pa = np.asarray([1, 1, 1, 1, 2, 2, 2, 2], np.int32)
+        pb = np.asarray([3, 3, 3, 3, 4, 4, 4, 4], np.int32)
+        sa, sb = pool.admit(0), pool.admit(1)
+        pc.park(pool, sa, pa)
+        pc.park(pool, sb, pb)
+        node_b = pc._root.children[pb[:4].tobytes()]
+        node_b.children = _PoisonedChildren(node_b.children)
+        assert pc.evict_lru(pool) == sa  # A out; B's branch untouched
+        # B still serves hits (keyed .get() walks are allowed), and its
+        # own removal — along ITS path — is equally traversal-free
+        m, donor = pc.match(np.concatenate([pb, [9]]).astype(np.int32))
+        assert (m, donor) == (8, sb)
+        assert pc.evict_lru(pool) == sb
+        assert pc.n_resident == 0 and pool.n_free == 4
+
+    def test_deep_shared_prefix_prunes_deepest_first(self):
+        """Two residents sharing chunk 1: evicting the deeper one prunes
+        only its exclusive tail nodes; the shared node survives for the
+        shallower resident."""
+        pool = SlotPool(4)
+        pc = PrefixCache(2)
+        shallow = np.asarray([7, 7, 8, 8], np.int32)
+        deep = np.asarray([7, 7, 8, 8, 9, 9], np.int32)
+        s1 = pool.admit(0)
+        pc.park(pool, s1, shallow)
+        s2 = pool.admit(1)
+        pc.park(pool, s2, deep)
+        pc.match(np.concatenate([deep, [1]]).astype(np.int32))  # s2 hot
+        assert pc.evict_lru(pool) == s1
+        # the shared [7,7]/[8,8] nodes still resolve for the survivor
+        m, donor = pc.match(np.concatenate([deep, [1]]).astype(np.int32))
+        assert (m, donor) == (6, s2)
+
+    def test_resident_tokens_gauge_tracks_park_evict_clear(self):
+        """prefix_cache_resident_tokens (ISSUE 17): depth x chunk summed
+        over device-tier residents, restamped on every park, eviction and
+        clear — the cache-pressure axis capacity sweeps read."""
+        from uccl_tpu import obs
+
+        g = obs.gauge("prefix_cache_resident_tokens")
+        pool = SlotPool(4)
+        pc = PrefixCache(4)
+        pc.park(pool, pool.admit(0), np.arange(8, dtype=np.int32))
+        assert g.get() == 8  # depth 2 x chunk 4
+        pc.park(pool, pool.admit(1), np.full(12, 9, np.int32))
+        assert g.get() == 20
+        pc.evict_lru(pool)
+        assert g.get() == 12
+        pc.clear(pool)
+        assert g.get() == 0
+
+
 class TestWireFormat:
     def test_spans_match_numpy_flat_offsets(self):
         fmt = KVWireFormat(n_layers=3, n_slots=4, max_seq=16,

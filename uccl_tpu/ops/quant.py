@@ -66,6 +66,19 @@ WIRE_DTYPES = {
     "int8": (jnp.int8, INT8_MAX, True),
 }
 
+# Documented per-block round-trip error divisors (module docstring): one
+# quantize→dequantize trip is bounded by |err| <= amax / ROUND_TRIP_DIVISOR.
+# Every consumer that budgets or TESTS against the bound reads it from here
+# (tests/test_quant.py, the tiered KV cache's quantized-at-rest contract in
+# serving/kv_tiers.py) so the codec and its promises cannot drift apart.
+ROUND_TRIP_DIVISOR = {"fp8": 27.7, "int8": 254.0}
+
+
+def round_trip_bound(amax: float, wire_dtype: str) -> float:
+    """Max |error| of one quantize→dequantize round trip for a block whose
+    abs-max is ``amax`` (the documented contract, not a re-derivation)."""
+    return float(amax) / ROUND_TRIP_DIVISOR[resolve_wire_dtype(wire_dtype)]
+
 # scale floor: the smallest NORMAL f32. A denormal scale risks flushing to
 # zero (then x / scale = inf) and denormal arithmetic on some substrates;
 # flooring here keeps |x / scale| finite (clipped to QMAX right after).

@@ -17,6 +17,9 @@ from uccl_tpu.serving.metrics import (  # noqa: F401
 from uccl_tpu.serving.health import (  # noqa: F401
     DEAD, HEALTHY, SUSPECT, FailureDetector, abandon_engine,
 )
+from uccl_tpu.serving.kv_tiers import (  # noqa: F401
+    HostKVTier, KvTierServer, RemoteKVTier, TieredKVCache, TierRef,
+)
 from uccl_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from uccl_tpu.serving.request import Request, RequestState  # noqa: F401
 from uccl_tpu.serving.router import Router, replica_signals  # noqa: F401
@@ -36,4 +39,6 @@ __all__ = [
     "PRIORITY_CLASSES", "Router", "replica_signals", "SlotPool",
     "Drafter", "NGramDrafter", "replicate_backend",
     "FailureDetector", "HEALTHY", "SUSPECT", "DEAD", "abandon_engine",
+    "TieredKVCache", "HostKVTier", "KvTierServer", "RemoteKVTier",
+    "TierRef",
 ]

@@ -408,6 +408,7 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  step_tokens: Optional[int] = None,
                  prefix_cache=None,
+                 kv_tiers=None,
                  chunk_sink: Optional[Callable[[List[ChunkEvent]], None]]
                  = None,
                  spec_k: Optional[int] = None,
@@ -454,6 +455,12 @@ class ServingEngine:
                     f"prefill_chunk ({prefill_chunk}): a match boundary "
                     "must be a resumable prefill position"
                 )
+        if kv_tiers is not None and prefix_cache is None:
+            raise ValueError(
+                "kv_tiers requires prefix_cache: the trie is the one index "
+                "over every tier — without it there is nothing to demote "
+                "from or promote into"
+            )
         if chunk_sink is not None and prefill_chunk is None:
             raise ValueError(
                 "chunk_sink requires prefill_chunk: the whole-prompt path "
@@ -477,6 +484,9 @@ class ServingEngine:
         self.prefill_chunk = prefill_chunk
         self.step_tokens = step_tokens
         self.prefix_cache = prefix_cache
+        self.kv_tiers = kv_tiers
+        if kv_tiers is not None:
+            kv_tiers.attach(backend, prefix_cache)
         self.chunk_sink = chunk_sink
         self.priority_classes = priority_classes
         self.preempt = preempt
@@ -782,16 +792,35 @@ class ServingEngine:
             if self.prefix_cache is not None:
                 matched, donor = self.prefix_cache.match(req.prompt)
                 if matched > 0:
-                    # resume at the cached boundary: copy the donor's KV
-                    # rows [0, matched) into the fresh slot, then the
-                    # chunked program continues from start=matched —
-                    # bit-exact by the PR 4 resumability contract
-                    self.backend.copy_slot_prefix(slot, donor, matched)
+                    # resume at the cached boundary: land the donor's KV
+                    # rows [0, matched) in the fresh slot — a device-to-
+                    # device copy for a parked-slot (T0) donor, a tier
+                    # promotion (fetch + decode + import) for a T1/T2 ref —
+                    # then the chunked program continues from
+                    # start=matched, bit-exact by the PR 4 resumability
+                    # contract when the serving tier is lossless
+                    if isinstance(donor, (int, np.integer)):
+                        self.backend.copy_slot_prefix(slot, donor, matched)
+                        if self.kv_tiers is not None:
+                            self.kv_tiers.count_hit("t0")
+                    elif not self.kv_tiers.promote(donor, slot, matched):
+                        # stale ref (the remote peer LRU-dropped it):
+                        # drop it from the trie and prefill cold. The
+                        # hit counter already ticked, but the exact
+                        # compute ledger below only credits real skips.
+                        self.prefix_cache.replace_ref(donor, None)
+                        matched = 0
+                if matched > 0:
                     req.prefill_pos = matched
                     req.cache_hit_len = matched
+                    req.cache_hit_exact = getattr(donor, "exact", True)
                     _PREFILL_TOKENS.inc(matched, kind="skipped")
                     obs.instant("prefix_hit", track=req.track, slot=slot,
-                                donor=donor, matched=matched)
+                                donor=(int(donor)
+                                       if isinstance(donor,
+                                                     (int, np.integer))
+                                       else repr(donor)),
+                                matched=matched)
                     events.append(ChunkEvent(req, slot, 0, matched,
                                              False, None, True))
             self._by_slot[slot] = req
@@ -818,19 +847,24 @@ class ServingEngine:
         retire parks or frees a slot within a bounded number of steps)."""
         if self.prefix_cache is None:
             return False
+        demote = (self.kv_tiers.demote if self.kv_tiers is not None
+                  else None)
         protect = None
         head = self.sched.peek()
         if head is not None:
             protect = self.prefix_cache.peek_donor(head.prompt)
-        if self.prefix_cache.evict_lru(self.pool,
-                                       protect=protect) is not None:
+        if self.prefix_cache.evict_lru(self.pool, protect=protect,
+                                       demote=demote) is not None:
             return True
         # the protected donor was the ONLY candidate: with live requests
         # in flight a retire will park/free a slot within bounded steps, so
         # defer; with none, nothing can ever free a slot — evict the donor
-        # (trading the head's cache hit for forward progress)
+        # (trading the head's cache hit for forward progress — though with
+        # tiers attached the demotion keeps the ENTRY alive, so the head
+        # still hits, just via a promotion)
         if protect is not None and not self._by_slot:
-            return self.prefix_cache.evict_lru(self.pool) is not None
+            return self.prefix_cache.evict_lru(
+                self.pool, demote=demote) is not None
         return False
 
     def _preempt_one(self) -> bool:

@@ -69,6 +69,45 @@ def synth_shared_workload(rng: np.random.Generator, n: int, prompt_len: int,
     return prompts, lens, arrivals
 
 
+def synth_multi_prefix_workload(rng: np.random.Generator, n: int,
+                                prompt_len: int, vocab: int,
+                                arrival_rate: float, n_prefixes: int,
+                                shared_len: int):
+    """Working-set workload for the tiered KV cache: ``n_prefixes``
+    distinct fixed ``shared_len``-token prefixes (a fleet of tenants'
+    system prompts), request ``i`` using prefix ``i % n_prefixes`` plus a
+    random tail. The deterministic round-robin is the point: with a
+    working set larger than the device slot count, every prefix's donor is
+    LRU-evicted (demoted, with tiers attached) before its next use, so the
+    stream forces demote→promote cycles instead of lucky T0 re-hits.
+    ``n_prefixes`` IS the working set — sweep it against ``n_slots`` for
+    the 10–100× capacity axis. Arrivals are drawn FIRST so every tier
+    config at the same seed faces the identical arrival stream (the
+    synth_shared_workload rule). Returns (prompts, lens, arrivals)."""
+    if n_prefixes < 1:
+        raise ValueError(f"n_prefixes must be >= 1, got {n_prefixes}")
+    if not (0 < shared_len < prompt_len):
+        raise ValueError(
+            f"shared_len must be in (0, prompt_len), got {shared_len} of "
+            f"{prompt_len}"
+        )
+    if arrival_rate > 0:
+        arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate, n))
+    else:
+        arrivals = np.zeros(n)
+    prefixes = [rng.integers(0, vocab, shared_len).astype(np.int32)
+                for _ in range(n_prefixes)]
+    prompts = []
+    for i in range(n):
+        tail = int(rng.integers(1, prompt_len - shared_len + 1))
+        prompts.append(np.concatenate(
+            [prefixes[i % n_prefixes],
+             rng.integers(0, vocab, tail).astype(np.int32)]
+        ))
+    lens = np.asarray([p.size for p in prompts])
+    return prompts, lens, arrivals
+
+
 def synth_repeat_workload(rng: np.random.Generator, n: int, prompt_len: int,
                           vocab: int, arrival_rate: float,
                           motif_max: int = 2):

@@ -15,15 +15,26 @@ match boundary is always a position the chunked prefill program can resume
 from, and trie keys are the raw bytes of one chunk's tokens (no hashing
 collisions to reason about).
 
-Residency is charged against the slot pool (``SlotPool.park``): parked donors
-occupy real KV rows, and admission pressure evicts them LRU-first via the
-scheduler's ``make_room`` hook — a live request's slot is never evicted
-because live slots are, by construction, never *in* the cache (only retire
-parks). Everything is host-only and jax-free; KV bytes move in the backend.
+Residency is **tier-tagged** (ISSUE 17): a resident is either an ``int`` —
+a parked device slot, tier 0, charged against the slot pool exactly as
+before — or an opaque tier reference (``serving/kv_tiers.py``'s
+:class:`TierRef`) naming an entry demoted to the host pool (T1) or a remote
+peer (T2). The trie is the ONE index over all tiers: lookup walks the same
+nodes whatever tier the donor lives in, eviction demotes T0 victims through
+the ``demote=`` hook instead of dropping them, and a deep-tier hit promotes
+through :class:`~uccl_tpu.serving.kv_tiers.TieredKVCache`. This module stays
+host-only and jax-free — it never touches KV bytes, only names them.
+
+Each resident's chunk-key path is recorded at insert time, so ``_remove``
+walks ONLY the victim's branch (O(depth), not O(total trie nodes) — the
+pre-17 implementation pruned the entire trie on every eviction).
 
 Counters (obs registry, docs/OBSERVABILITY.md): ``prefix_cache_hits_total``,
 ``prefix_cache_misses_total``, ``prefix_cache_evictions_total``,
-``prefix_cache_tokens_reused_total``, gauge ``prefix_cache_resident_slots``.
+``prefix_cache_tokens_reused_total``, gauges ``prefix_cache_resident_slots``
+and ``prefix_cache_resident_tokens`` (both device-tier: parked slots and
+their depth×chunk token sum — deeper tiers report on the
+``kv_tier_resident_{tokens,bytes}`` families).
 """
 
 from __future__ import annotations
@@ -54,27 +65,39 @@ _RESIDENT = obs.gauge(
     "prefix_cache_resident_slots",
     "slots currently parked as prefix-cache donors",
 )
+_RESIDENT_TOKENS = obs.gauge(
+    "prefix_cache_resident_tokens",
+    "prompt tokens held by parked prefix-cache donors (depth x chunk summed "
+    "over device-tier residents) — the cache-pressure axis capacity sweeps "
+    "read in tokens rather than slots",
+)
 
 
 class _Node:
     """One trie node: children keyed by the raw bytes of a C-token chunk;
-    ``slots`` is every parked slot whose cached prompt passes through this
-    node (i.e. whose KV holds at least this node's depth in chunks)."""
+    ``slots`` is every resident (parked slot id or tier ref) whose cached
+    prompt passes through this node (i.e. whose KV holds at least this
+    node's depth in chunks)."""
 
     __slots__ = ("children", "slots")
 
     def __init__(self):
         self.children: Dict[bytes, _Node] = {}
-        self.slots: Set[int] = set()
+        self.slots: Set = set()
 
 
 class PrefixCache:
-    """Chunk-granular prefix trie over parked KV slots, LRU-evicted.
+    """Chunk-granular prefix trie over parked KV slots + demoted tier
+    entries, LRU-evicted.
 
-    The engine owns the pool and the KV copies; this class owns WHICH slot
-    holds WHICH prefix and for how long. Invariant: every slot referenced
-    anywhere in the trie is parked in the engine's pool (never a live
-    request's slot), so eviction can only ever reclaim cache residency.
+    The engine owns the pool and the KV copies; this class owns WHICH
+    resident holds WHICH prefix and for how long. Invariant: every ``int``
+    resident referenced anywhere in the trie is parked in the engine's pool
+    (never a live request's slot), so eviction can only ever reclaim cache
+    residency; every non-int resident is a tier ref whose bytes live in the
+    attached :class:`~uccl_tpu.serving.kv_tiers.TieredKVCache` — and each
+    logical entry lives in EXACTLY ONE tier (a demotion moves the resident,
+    never copies it).
     """
 
     def __init__(self, chunk: int):
@@ -82,23 +105,44 @@ class PrefixCache:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.chunk = chunk
         self._root = _Node()
-        # slot -> (depth in chunks, last-use sequence number). Depth is how
-        # many full chunks of the slot's prompt are keyed in the trie.
-        self._resident: Dict[int, Tuple[int, int]] = {}
+        # resident -> (depth in chunks, last-use sequence number). Depth is
+        # how many full chunks of the resident's prompt are keyed in the
+        # trie. Keys are slot ints (T0) or TierRefs (T1/T2).
+        self._resident: Dict = {}
+        # resident -> its chunk-key path, recorded at insert time so
+        # removal walks only this branch (never the whole trie)
+        self._paths: Dict = {}
         self._seq = 0
+        self._t0_tokens = 0  # running depth*chunk sum over int residents
+        self._tiers = None  # TieredKVCache once attach_tiers() is called
 
     # -- inspection -------------------------------------------------------
     @property
     def n_resident(self) -> int:
-        return len(self._resident)
+        """Device-tier (parked-slot) residents — the pre-tier meaning."""
+        return sum(1 for r in self._resident if isinstance(r, int))
+
+    @property
+    def n_tier_refs(self) -> int:
+        """Deep-tier (T1/T2) residents."""
+        return len(self._resident) - self.n_resident
 
     def resident_slots(self) -> List[int]:
-        return sorted(self._resident)
+        return sorted(r for r in self._resident if isinstance(r, int))
 
-    def _touch(self, slot: int) -> None:
-        depth, _ = self._resident[slot]
+    def tier_refs(self) -> List:
+        return [r for r in self._resident if not isinstance(r, int)]
+
+    def attach_tiers(self, tiers) -> None:
+        """Bind the tier manager: ``_remove`` of a tier-ref resident then
+        releases its store bytes (``tiers.release(ref)``, idempotent) so
+        dropping a trie entry can never strand tier capacity."""
+        self._tiers = tiers
+
+    def _touch(self, resident) -> None:
+        depth, _ = self._resident[resident]
         self._seq += 1
-        self._resident[slot] = (depth, self._seq)
+        self._resident[resident] = (depth, self._seq)
 
     def _chunks(self, prompt: np.ndarray, n: int):
         c = self.chunk
@@ -106,8 +150,12 @@ class PrefixCache:
         for i in range(n):
             yield p[i * c:(i + 1) * c].tobytes()
 
+    def _stamp_gauges(self) -> None:
+        _RESIDENT.set(self.n_resident)
+        _RESIDENT_TOKENS.set(self._t0_tokens)
+
     # -- lookup -----------------------------------------------------------
-    def _lookup(self, prompt) -> Tuple[int, Optional[int]]:
+    def _lookup(self, prompt) -> Tuple[int, Optional[object]]:
         """Side-effect-free deepest-usable-prefix walk (no counters, no
         LRU refresh) — shared by :meth:`match` and :meth:`peek_donor`."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -124,15 +172,19 @@ class PrefixCache:
         if best is None:
             return 0, None
         depth, node = best
-        # prefer the most recently used donor among equals (keeps hot
-        # shared prompts hot)
-        donor = max(node.slots, key=lambda s: self._resident[s][1])
+        # prefer a device-tier donor over a deep-tier ref at equal depth
+        # (a slot copy beats a decode+import promotion), then the most
+        # recently used among equals (keeps hot shared prompts hot)
+        donor = max(node.slots,
+                    key=lambda s: (isinstance(s, int),
+                                   self._resident[s][1]))
         return depth * self.chunk, donor
 
-    def match(self, prompt) -> Tuple[int, Optional[int]]:
+    def match(self, prompt) -> Tuple[int, Optional[object]]:
         """Deepest cached chunk-aligned prefix of ``prompt`` that is usable
-        for resumption. Returns ``(matched_len, donor_slot)`` with
-        ``matched_len`` a multiple of ``chunk``; ``(0, None)`` on a miss.
+        for resumption. Returns ``(matched_len, donor)`` with
+        ``matched_len`` a multiple of ``chunk`` and ``donor`` a parked slot
+        id (int, tier 0) or a tier ref; ``(0, None)`` on a miss.
 
         A match is capped at the largest chunk multiple ≤ ``len(prompt)-1``:
         at least one prompt position must remain to prefill, because the
@@ -150,16 +202,16 @@ class PrefixCache:
         _TOKENS_REUSED.inc(matched)
         return matched, donor
 
-    def peek_donor(self, prompt) -> Optional[int]:
-        """The slot :meth:`match` would reuse for ``prompt``, with no
+    def peek_donor(self, prompt) -> Optional[object]:
+        """The resident :meth:`match` would reuse for ``prompt``, with no
         counter or LRU side effects — the engine protects it from being
         its own admission's eviction victim."""
         return self._lookup(prompt)[1]
 
-    def covered(self, prompt) -> Optional[int]:
+    def covered(self, prompt) -> Optional[object]:
         """If the trie already caches ``prompt``'s full-chunk prefix at
-        maximal depth, return a slot holding it (parking another copy would
-        waste a slot); else None."""
+        maximal depth, return a resident holding it (parking another copy
+        would waste a slot); else None."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         k = prompt.size // self.chunk
         if k < 1:
@@ -174,6 +226,25 @@ class PrefixCache:
         return max(node.slots, key=lambda s: self._resident[s][1])
 
     # -- residency --------------------------------------------------------
+    def _insert(self, resident, path: List[bytes],
+                seq: Optional[int] = None) -> None:
+        """Add ``resident`` along ``path`` (a list of chunk keys) and
+        record the path for O(depth) removal. ``seq`` pins the LRU stamp —
+        a demotion re-inserts at the victim's OLD stamp, because moving an
+        entry down a tier must not refresh its recency."""
+        node = self._root
+        for key in path:
+            node = node.children.setdefault(key, _Node())
+            node.slots.add(resident)
+        if seq is None:
+            self._seq += 1
+            seq = self._seq
+        self._resident[resident] = (len(path), seq)
+        self._paths[resident] = list(path)
+        if isinstance(resident, int):
+            self._t0_tokens += len(path) * self.chunk
+        self._stamp_gauges()
+
     def park(self, pool, slot: int, prompt) -> bool:
         """Try to keep a retiring request's slot resident as a donor.
 
@@ -181,6 +252,14 @@ class PrefixCache:
         False when caching is useless — prompt shorter than one chunk, or
         its full-chunk prefix is already cached (the existing donor's LRU
         stamp is refreshed instead) — and the caller should free the slot.
+
+        One tier-crossing rule: when the covering resident is a DEEP-tier
+        ref at exactly this prompt's full-chunk depth, the fresh slot
+        supersedes it — the entry moves back to tier 0 (the slot parks,
+        the ref is dropped and its store bytes released), because serving
+        future hits from a device slot beats re-promoting the same bytes
+        every time. A ref covering a DEEPER prefix is a different entry
+        and blocks nothing.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         k = prompt.size // self.chunk
@@ -188,59 +267,91 @@ class PrefixCache:
             return False
         existing = self.covered(prompt)
         if existing is not None:
-            self._touch(existing)
-            return False
-        node = self._root
-        for key in self._chunks(prompt, k):
-            node = node.children.setdefault(key, _Node())
-            node.slots.add(slot)
-        self._seq += 1
-        self._resident[slot] = (k, self._seq)
+            if isinstance(existing, int) or self._resident[existing][0] > k:
+                self._touch(existing)
+                return False
+            # deep-tier ref at exactly depth k: supersede it with the slot
+            self._remove(existing)
+        self._insert(slot, list(self._chunks(prompt, k)))
         pool.park(slot)
-        _RESIDENT.set(len(self._resident))
         return True
 
-    def _remove(self, slot: int) -> None:
-        """Drop every trie reference to ``slot`` (prune empty branches)."""
-        del self._resident[slot]
+    def _remove(self, resident) -> None:
+        """Drop every trie reference to ``resident`` by walking ONLY its
+        recorded chunk-key path (pruning nodes left empty, deepest-first).
+        A removed tier ref also releases its store bytes through the
+        attached tier manager."""
+        depth, _ = self._resident.pop(resident)
+        path = self._paths.pop(resident)
+        nodes = [self._root]
+        node = self._root
+        for key in path:
+            node = node.children[key]
+            node.slots.discard(resident)
+            nodes.append(node)
+        for i in range(len(path) - 1, -1, -1):
+            child = nodes[i + 1]
+            if child.slots or child.children:
+                break
+            del nodes[i].children[path[i]]
+        if isinstance(resident, int):
+            self._t0_tokens -= depth * self.chunk
+        elif self._tiers is not None:
+            self._tiers.release(resident)
+        self._stamp_gauges()
 
-        def prune(node: _Node) -> None:
-            dead = []
-            for key, child in node.children.items():
-                child.slots.discard(slot)
-                prune(child)
-                if not child.slots and not child.children:
-                    dead.append(key)
-            for key in dead:
-                del node.children[key]
+    def replace_ref(self, old_ref, new_ref) -> None:
+        """Swap a deep-tier resident for another AT THE SAME PATH AND LRU
+        STAMP (or drop it when ``new_ref`` is None) — the tier manager's
+        hook for T1→T2 spills and stale-ref invalidation. The manager has
+        already moved/freed the store bytes, so the embedded release is a
+        no-op by idempotence."""
+        _, seq = self._resident[old_ref]
+        path = self._paths[old_ref]
+        self._remove(old_ref)
+        if new_ref is not None:
+            self._insert(new_ref, path, seq=seq)
 
-        prune(self._root)
-        _RESIDENT.set(len(self._resident))
-
-    def evict_lru(self, pool, protect: Optional[int] = None) -> Optional[int]:
+    def evict_lru(self, pool, protect: Optional[int] = None,
+                  demote=None) -> Optional[int]:
         """Reclaim the least-recently-used parked slot for admission: the
         slot returns to the pool's free list and every trie entry for it is
-        dropped. Only parked slots are candidates (live requests are never
-        resident), so a pinned/live slot can never be freed here.
-        ``protect`` exempts one slot — the donor the admission triggering
-        this eviction is about to match (evicting it would trade the hit
-        for the slot). Returns the evicted slot id, or None when no
-        candidate remains."""
-        candidates = [s for s in self._resident if s != protect]
+        dropped — or, with a ``demote`` hook, MOVED: ``demote(slot,
+        n_tokens)`` may export the victim's rows to a deeper tier and
+        return a tier ref, which is re-inserted at the victim's exact path
+        and LRU stamp (the entry keeps its identity and recency, only its
+        bytes change tier). Only parked slots are candidates (live
+        requests are never resident, tier refs hold no slot).
+        ``protect`` exempts one resident — the donor the admission
+        triggering this eviction is about to match (evicting it would
+        trade the hit for the slot). Returns the evicted slot id, or None
+        when no candidate remains."""
+        candidates = [s for s in self._resident
+                      if isinstance(s, int) and s != protect]
         if not candidates:
             return None
         slot = min(candidates, key=lambda s: self._resident[s][1])
+        depth, seq = self._resident[slot]
+        path = self._paths[slot]
+        ref = demote(slot, depth * self.chunk) if demote is not None else None
         self._remove(slot)
+        if ref is not None:
+            self._insert(ref, path, seq=seq)
         pool.reclaim(slot)
         _EVICTIONS.inc()
         return slot
 
     def clear(self, pool) -> None:
-        """Reclaim every parked slot and empty the trie (e.g. after compile
-        warmup, whose synthetic prompts must not act as donors). Counters
-        are untouched — benches isolate arms by delta."""
-        for slot in list(self._resident):
-            self._remove(slot)
-            pool.reclaim(slot)
+        """Reclaim every parked slot, release every tier ref, and empty the
+        trie (e.g. after compile warmup, whose synthetic prompts must not
+        act as donors). Counters are untouched — benches isolate arms by
+        delta."""
+        for resident in list(self._resident):
+            self._remove(resident)
+            if isinstance(resident, int):
+                pool.reclaim(resident)
         self._root = _Node()
+        self._paths.clear()
+        self._t0_tokens = 0
         _RESIDENT.set(0)
+        _RESIDENT_TOKENS.set(0)

@@ -59,6 +59,11 @@ class Request:
     admit_seq: Optional[int] = None  # admission order (FIFO is testable)
     prefill_pos: int = 0  # chunked-prefill cursor: prompt[:prefill_pos] is in KV
     cache_hit_len: int = 0  # prompt tokens reused from the prefix cache
+    # whether the reused rows are bit-exact w.r.t. recomputation: True for
+    # T0 slot copies and lossless-tier promotions; False when the serving
+    # tier stored them quantized at rest (bounded error, never silent —
+    # the kv_tiers exactness contract)
+    cache_hit_exact: bool = True
     adopted: bool = False  # entered via adopt() (disagg decode side), not submit()
     priority: str = "interactive"  # SLO class: "interactive" | "batch"
     deadline_ms: Optional[float] = None  # admission deadline after submit

@@ -94,6 +94,12 @@ class SlotPool:
         heapq.heappush(self._free, slot)
         self.total_frees += 1
 
+    def is_parked(self, slot: int) -> bool:
+        """Whether ``slot`` is in the parked (cache-resident) state — the
+        tier manager's sanity check that a demotion victim really is cache
+        residency and not a live request's KV."""
+        return slot in self._parked
+
     def parked_slots(self) -> List[int]:
         return sorted(self._parked)
 

@@ -115,6 +115,37 @@ def _entry_bytes(n_tokens, layers=2, heads=2, dim=8):
     return 2 * layers * n_tokens * heads * dim * 4
 
 
+class _FakeRemote:
+    """In-process stand-in for :class:`RemoteKVTier`: the surface
+    :class:`TieredKVCache` drives (put/get/delete + used accounting),
+    with switchable failure injection for the never-blocks contracts."""
+
+    def __init__(self, fail_puts=0, fail_gets=0):
+        self.store = {}
+        self.used_bytes = 0
+        self.used_tokens = 0
+        self.fail_puts = fail_puts
+        self.fail_gets = fail_gets
+        self.puts = 0
+
+    def put(self, key, blob, meta):
+        self.puts += 1
+        if self.fail_puts:
+            self.fail_puts -= 1
+            raise IOError("t2 channel down")
+        self.store[key] = (np.asarray(blob).copy(), dict(meta))
+        return []
+
+    def get(self, key):
+        if self.fail_gets:
+            self.fail_gets -= 1
+            raise IOError("t2 channel down")
+        return self.store.get(key)
+
+    def delete(self, key):
+        self.store.pop(key, None)
+
+
 def _tier_setup(host_entries, *, entry_tokens=8, wire_dtype=None,
                 remote=None, n_slots=2):
     backend = _TierStubBackend(n_slots=n_slots)
@@ -220,6 +251,80 @@ class TestTierManager:
         with pytest.raises(ValueError, match="promote of"):
             tiers.promote(ref, dst, 9)
 
+    def test_t2_stale_ref_caller_owns_trie_drop(self):
+        """promote() returning False on a stale T2 ref must release only
+        the tier accounting and leave the trie resident to the CALLER
+        (the engine drops it next) — dropping it inside promote too made
+        the engine's follow-up ``replace_ref`` KeyError out of
+        admission."""
+        remote = _FakeRemote()
+        backend, pool, pc, tiers = _tier_setup(1, remote=remote)
+        pa = np.asarray([1, 1, 2, 2, 3, 3, 4, 4], np.int32)
+        pb = np.asarray([5, 5, 6, 6, 7, 7, 8, 8], np.int32)
+        for i, p in enumerate((pa, pb)):
+            _park(backend, pool, pc, i, p, seed=i)
+            pc.evict_lru(pool, demote=tiers.demote)
+        ref = pc.peek_donor(np.concatenate([pa, [9]]).astype(np.int32))
+        assert isinstance(ref, TierRef) and ref.tier == "t2"
+        remote.store.clear()  # the peer lost the entry out-of-band
+        d0 = obs.counter("kv_tier_drops_total").get(tier="t2")
+        dst = pool.admit(9)
+        assert tiers.promote(ref, dst, 8) is False
+        assert ref in pc._resident  # the trie drop was left to us
+        pc.replace_ref(ref, None)  # the engine's follow-up: must not raise
+        assert ref not in pc._resident and pc.n_tier_refs == 1  # pb's ref
+        assert obs.counter("kv_tier_drops_total").get(tier="t2") == d0 + 1
+        assert remote.used_bytes == 0 and remote.used_tokens == 0
+
+    def test_spill_remote_failure_drops_counted_never_raises(self):
+        """A remote-put failure mid-spill degrades to the counted T1 drop
+        (demotion never raises into the admission path), and after
+        ``remote_fail_limit`` consecutive failures the tier latches dead
+        so later spills stop touching the channel."""
+        remote = _FakeRemote(fail_puts=99)
+        backend, pool, pc, tiers = _tier_setup(1, remote=remote)
+        d0 = obs.counter("kv_tier_drops_total").get(tier="t1")
+        prompts = [np.asarray([i, i, i + 1, i + 1, i + 2, i + 2, i + 3,
+                               i + 3], np.int32)
+                   for i in (10, 20, 30, 40, 50)]
+        for i, p in enumerate(prompts):
+            _park(backend, pool, pc, i, p, seed=i)
+            assert pc.evict_lru(pool, demote=tiers.demote) is not None
+        # 4 spills attempted; the channel was only tried until the latch
+        assert tiers._remote_dead
+        assert remote.puts == tiers.remote_fail_limit
+        assert obs.counter("kv_tier_drops_total").get(tier="t1") == d0 + 4
+        assert len(tiers.t1) == 1 and pc.n_tier_refs == 1
+        # the dropped prefixes left the trie; the survivor still hits
+        hits = [pc.match(np.concatenate([p, [9]]).astype(np.int32))[0]
+                for p in prompts]
+        assert hits == [0, 0, 0, 0, 8]
+
+    def test_stale_hit_counters_degrade_to_miss(self):
+        """The reuse ledger on a stale deep ref: match() defers deep-tier
+        hit counting to commit_hit(), so a failed promotion counts ONE
+        miss and zero hit/reused tokens — metrics never credit skipped
+        compute that was not skipped."""
+        backend, pool, pc, tiers = _tier_setup(4)
+        p = np.arange(8, dtype=np.int32)
+        _park(backend, pool, pc, 0, p, seed=6)
+        pc.evict_lru(pool, demote=tiers.demote)
+        h0 = obs.counter("prefix_cache_hits_total").get()
+        m0 = obs.counter("prefix_cache_misses_total").get()
+        t0 = obs.counter("prefix_cache_tokens_reused_total").get()
+        q = np.concatenate([p, [9]]).astype(np.int32)
+        matched, donor = pc.match(q)
+        assert matched == 8 and isinstance(donor, TierRef)
+        assert obs.counter("prefix_cache_hits_total").get() == h0
+        tiers.t1.pop(donor.key)  # lose the bytes: the promotion fails
+        assert tiers.promote(donor, pool.admit(1), 8) is False
+        pc.replace_ref(donor, None)  # the engine's stale sequence
+        pc.count_stale_miss()
+        assert obs.counter("prefix_cache_hits_total").get() == h0
+        assert obs.counter("prefix_cache_misses_total").get() == m0 + 1
+        assert (obs.counter("prefix_cache_tokens_reused_total").get()
+                == t0)
+
     def test_release_is_idempotent_and_gauges_zero(self):
         backend, pool, pc, tiers = _tier_setup(4)
         p = np.arange(8, dtype=np.int32)
@@ -306,6 +411,33 @@ class TestRemoteTier:
             cli.close()
             t.join(timeout=20)
 
+    def test_entry_larger_than_client_window_guarded_both_sides(self, rng):
+        """Nothing may writev past the client's registered scratch
+        window: an oversize put is refused CLIENT-side before touching
+        the wire, and a get whose stored entry exceeds the requesting
+        client's advertised window is served as a miss, never as an
+        overrunning write."""
+        k, v = _rows(rng, 8)
+        blob, meta = encode_entry(k, v)
+        srv = KvTierServer(capacity_bytes=4 * blob.nbytes)
+        with Endpoint(n_engines=2) as sep, Endpoint(n_engines=2) as cep:
+            schan, cchan = chan_pair(sep, cep)
+            t = srv.serve_forever(schan, timeout_ms=2000)
+            cli = RemoteKVTier(cchan, max_entry_bytes=blob.nbytes,
+                               timeout_ms=2000)
+            big = np.zeros(blob.nbytes + 1, np.uint8)
+            assert cli.put(1, big, {"enc": "raw", "shape": [1]}) is None
+            assert len(srv) == 0  # the oversize put never hit the wire
+            assert cli.put(2, blob, meta) == []
+            # shrink the advertised window: the server must miss rather
+            # than write past the registration
+            cli.max_entry_bytes = blob.nbytes - 1
+            assert cli.get(2) is None
+            cli.max_entry_bytes = blob.nbytes
+            assert cli.get(2) is not None
+            cli.close()
+            t.join(timeout=20)
+
 
 def _engine_with_tiers(backend, tiers):
     pc = PrefixCache(4)
@@ -352,6 +484,9 @@ class TestDenseTieredExact:
         tiers = TieredKVCache(host_bytes=1 << 20)
         eng = _engine_with_tiers(backend, tiers)
         pr0 = obs.counter("kv_tier_promotions_total").get(tier="t1")
+        h0 = obs.counter("prefix_cache_hits_total").get()
+        s0 = sum(obs.counter("kv_tier_hits_total").get(tier=t)
+                 for t in ("t0", "t1", "t2"))
         rng = np.random.default_rng(7)
         bases = [rng.integers(0, 64, 12).astype(np.int32)
                  for _ in range(4)]
@@ -363,6 +498,11 @@ class TestDenseTieredExact:
         promoted = (obs.counter("kv_tier_promotions_total").get(tier="t1")
                     - pr0)
         assert promoted >= 4, "round two never hit the host tier"
+        # the per-tier hit split sums to the trie's hit counter
+        split = sum(obs.counter("kv_tier_hits_total").get(tier=t)
+                    for t in ("t0", "t1", "t2")) - s0
+        assert (obs.counter("prefix_cache_hits_total").get() - h0
+                == split > 0)
         hits = [r.cache_hit_len for r in reqs]
         assert hits[:4] == [0] * 4 and all(h == 8 for h in hits[4:]), hits
         for r in reqs:
@@ -373,7 +513,9 @@ class TestDenseTieredExact:
 
     def test_promote_failure_degrades_to_cold_miss(self, dense_setup):
         """A stale tier ref at admission (entry lost under the trie) must
-        cold-prefill and still match the oracle — never serve garbage."""
+        cold-prefill and still match the oracle — never serve garbage —
+        and the reuse ledger must record it as the miss it became, not
+        the hit it promised."""
         cfg, params, backend = dense_setup
         tiers = TieredKVCache(host_bytes=1 << 20)
         eng = _engine_with_tiers(backend, tiers)
@@ -384,9 +526,41 @@ class TestDenseTieredExact:
         eng.prefix_cache.evict_lru(eng.pool, demote=tiers.demote)
         for ref in eng.prefix_cache.tier_refs():
             tiers.t1.pop(ref.key)  # lose the bytes, keep the trie ref
+        h0 = obs.counter("prefix_cache_hits_total").get()
+        m0 = obs.counter("prefix_cache_misses_total").get()
+        t0 = obs.counter("prefix_cache_tokens_reused_total").get()
         r = eng.submit(p.copy(), max_new_tokens=4)
         eng.drain()
         assert r.cache_hit_len == 0  # the stale hit became a cold miss
+        assert obs.counter("prefix_cache_hits_total").get() == h0
+        assert obs.counter("prefix_cache_misses_total").get() == m0 + 1
+        assert (obs.counter("prefix_cache_tokens_reused_total").get()
+                == t0)
+        assert r.out_tokens == _oracle(params, cfg, r)
+        assert eng.pool.leaked() == 0
+        eng.prefix_cache.clear(eng.pool)
+
+    def test_t2_stale_ref_cold_miss_through_engine(self, dense_setup):
+        """The exact scenario REVIEW flagged: a remote peer answering a
+        promotion's get with a miss. Admission must degrade to a counted
+        cold miss (no KeyError out of the trie drop), stay oracle-exact,
+        and leak nothing."""
+        cfg, params, backend = dense_setup
+        remote = _FakeRemote()
+        tiers = TieredKVCache(host_bytes=1 << 20, remote=remote)
+        eng = _engine_with_tiers(backend, tiers)
+        rng = np.random.default_rng(11)
+        p = rng.integers(0, 64, 12).astype(np.int32)
+        eng.submit(p.copy(), max_new_tokens=4)
+        eng.drain()
+        eng.prefix_cache.evict_lru(eng.pool, demote=tiers.demote)  # → t1
+        tiers._spill_lru()  # → t2 (the fake peer)
+        assert [r.tier for r in eng.prefix_cache.tier_refs()] == ["t2"]
+        remote.store.clear()  # the peer LRU-dropped the entry
+        r = eng.submit(p.copy(), max_new_tokens=4)
+        eng.drain()
+        assert r.cache_hit_len == 0
+        assert eng.prefix_cache.n_tier_refs == 0  # stale ref dropped once
         assert r.out_tokens == _oracle(params, cfg, r)
         assert eng.pool.leaked() == 0
         eng.prefix_cache.clear(eng.pool)

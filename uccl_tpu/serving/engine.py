@@ -803,12 +803,18 @@ class ServingEngine:
                         self.backend.copy_slot_prefix(slot, donor, matched)
                         if self.kv_tiers is not None:
                             self.kv_tiers.count_hit("t0")
-                    elif not self.kv_tiers.promote(donor, slot, matched):
-                        # stale ref (the remote peer LRU-dropped it):
-                        # drop it from the trie and prefill cold. The
-                        # hit counter already ticked, but the exact
-                        # compute ledger below only credits real skips.
+                    elif self.kv_tiers.promote(donor, slot, matched):
+                        # the deferred deep-tier hit: match() leaves
+                        # counting to this commit so a stale ref never
+                        # inflates the reuse ledger
+                        self.prefix_cache.commit_hit(matched)
+                    else:
+                        # stale ref (entry lost under the trie): drop it
+                        # — promote() released the tier accounting and
+                        # left the trie drop to this caller — and
+                        # prefill cold, counted as the miss it became
                         self.prefix_cache.replace_ref(donor, None)
+                        self.prefix_cache.count_stale_miss()
                         matched = 0
                 if matched > 0:
                     req.prefill_pos = matched

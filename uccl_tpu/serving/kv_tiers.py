@@ -273,7 +273,7 @@ class HostKVTier:
 #
 #   put:  c -> {op:put, key, nbytes, crc, meta}   s -> {op:win, fifo}
 #         c writev(blob)  c -> {op:sent}          s -> {op:ok, evicted:[..]}
-#   get:  c -> {op:get, key, fifo}                s -> {op:miss}
+#   get:  c -> {op:get, key, fifo, max}           s -> {op:miss}
 #                                     | s writev(blob) -> {op:hit, nbytes,
 #                                                          crc, meta}
 #   del:  c -> {op:del, key}                      s -> {op:ok}
@@ -378,7 +378,11 @@ class KvTierServer:
             return op
         if op == "get":
             ent = self._get(int(req["key"]))
-            if ent is None:
+            limit = int(req.get("max", 0))
+            if ent is None or (limit and ent[0].nbytes > limit):
+                # unknown key, or an entry too large for this client's
+                # advertised window (the writev would overrun its
+                # registration): both are a miss to this client
                 _send_msg(chan, {"op": "miss"})
                 return op
             blob, meta = ent
@@ -439,9 +443,14 @@ class RemoteKVTier:
 
     def put(self, key: int, blob: np.ndarray, meta: dict):
         """Ship one entry; returns the server's evicted-key list (stale
-        refs the caller must invalidate), or None when the server refused
-        (entry larger than its capacity)."""
+        refs the caller must invalidate), or None when the entry is
+        refused — larger than the server's capacity, or larger than this
+        client's ``max_entry_bytes`` scratch window (stored, it could
+        never be fetched back without the server writing past the
+        window's registration)."""
         blob = np.ascontiguousarray(np.asarray(blob, np.uint8))
+        if blob.nbytes > self.max_entry_bytes:
+            return None
         _send_msg(self.chan, {"op": "put", "key": int(key),
                               "nbytes": int(blob.nbytes),
                               "crc": zlib.crc32(blob), "meta": meta})
@@ -463,13 +472,19 @@ class RemoteKVTier:
         a miss (the server LRU-dropped it — a stale ref)."""
         fifo = self.chan.ep.advertise(self._mr)
         _send_msg(self.chan, {"op": "get", "key": int(key),
-                              "fifo": fifo.hex()})
+                              "fifo": fifo.hex(),
+                              "max": self.max_entry_bytes})
         resp = _recv_msg(self.chan, self.timeout_ms)
         if resp.get("op") == "miss":
             return None
         if resp.get("op") != "hit":
             raise IOError(f"kv_tier: expected hit, got {resp}")
         nbytes = int(resp["nbytes"])
+        if nbytes > self.max_entry_bytes:
+            raise IOError(
+                f"kv_tier: peer claims a {nbytes}B entry landed in a "
+                f"{self.max_entry_bytes}B window"
+            )
         blob = self._buf[:nbytes].copy()
         if zlib.crc32(blob) != int(resp["crc"]):
             raise IOError("kv_tier: get CRC mismatch (wire corruption "
@@ -505,16 +520,20 @@ class TieredKVCache:
 
     def __init__(self, host_bytes: int, *,
                  wire_dtype: Optional[str] = None, block: int = 32,
-                 remote: Optional[RemoteKVTier] = None):
+                 remote: Optional[RemoteKVTier] = None,
+                 remote_fail_limit: int = 3):
         from uccl_tpu.ops import quant
 
         self.wire_dtype = quant.resolve_wire_dtype(wire_dtype)
         self.block = int(block)
         self.t1 = HostKVTier(host_bytes)
         self.remote = remote
+        self.remote_fail_limit = int(remote_fail_limit)
         self.backend = None
         self.cache = None
         self._next_key = 0
+        self._remote_failures = 0  # consecutive comms failures
+        self._remote_dead = False  # latched after remote_fail_limit
         # our view of what lives on the remote peer: key -> ref (pruned on
         # eviction notices, deletes, and discovered-stale gets)
         self._t2_refs: Dict[int, TierRef] = {}
@@ -573,15 +592,38 @@ class TieredKVCache:
         self._stamp()
         return ref
 
+    def _remote_failure(self, verb: str, exc: Exception) -> None:
+        """Count one remote-tier comms failure. After ``remote_fail_limit``
+        CONSECUTIVE failures the tier latches dead: spills drop (counted)
+        and T2 hits degrade to misses without touching the channel again —
+        a dying peer costs at most ``remote_fail_limit`` timeouts."""
+        self._remote_failures += 1
+        if self._remote_failures >= self.remote_fail_limit:
+            self._remote_dead = True
+        _log.warning(
+            "kv_tier: t2 %s failed (%s: %s) — failure %d/%d%s", verb,
+            type(exc).__name__, exc, self._remote_failures,
+            self.remote_fail_limit,
+            "; remote tier latched dead" if self._remote_dead else "",
+        )
+
     def _spill_lru(self) -> None:
         """Move T1's LRU entry down to T2 (or drop it, counted) — the
         trie's resident swaps via ``replace_ref`` at the SAME path and LRU
-        stamp, so the entry keeps its identity and recency."""
+        stamp, so the entry keeps its identity and recency. A remote-tier
+        failure (channel timeout, refused put) degrades to the same
+        counted drop: demotion never raises into the admission path."""
         key = self.t1.lru_key()
         blob, meta, ref = self.t1.pop(key)
         new_ref = None
-        if self.remote is not None:
-            evicted = self.remote.put(key, blob, meta)
+        if self.remote is not None and not self._remote_dead:
+            try:
+                evicted = self.remote.put(key, blob, meta)
+            except Exception as e:  # entry already out of T1: drop it
+                evicted = None
+                self._remote_failure("put", e)
+            else:
+                self._remote_failures = 0
             if evicted is not None:
                 new_ref = TierRef("t2", key, ref.tokens, ref.exact,
                                   int(blob.nbytes))
@@ -598,14 +640,19 @@ class TieredKVCache:
         self.cache.replace_ref(ref, new_ref)
         self._stamp()
 
-    def _invalidate_t2(self, key: int) -> None:
+    def _invalidate_t2(self, key: int, drop_trie: bool = True) -> None:
+        """Forget a remote entry (eviction notice, discovered-stale get).
+        ``drop_trie=False`` releases only this side's accounting and
+        leaves the trie resident to the caller — :meth:`promote`'s miss
+        path, whose contract already hands the trie drop to the engine
+        (dropping here too would double-remove and KeyError)."""
         stale = self._t2_refs.pop(key, None)
         if stale is None:
             return
         self.remote.used_bytes -= stale.nbytes
         self.remote.used_tokens -= stale.tokens
         _DROPS.inc(tier="t2")
-        if stale in self.cache._resident:
+        if drop_trie and stale in self.cache._resident:
             self.cache.replace_ref(stale, None)
 
     # -- promotion (the hit path) ------------------------------------------
@@ -629,10 +676,18 @@ class TieredKVCache:
                     return False
                 blob, meta, _ = ent
             else:
-                got = (self.remote.get(ref.key)
-                       if self.remote is not None else None)
+                got = None
+                if self.remote is not None and not self._remote_dead:
+                    try:
+                        got = self.remote.get(ref.key)
+                    except Exception as e:  # degrade to a stale miss
+                        self._remote_failure("get", e)
+                    else:
+                        self._remote_failures = 0
                 if got is None:
-                    self._invalidate_t2(ref.key)
+                    # release OUR accounting only: the caller is the
+                    # single owner of the trie drop on a stale ref
+                    self._invalidate_t2(ref.key, drop_trie=False)
                     return False
                 blob, meta = got
             k_rows, v_rows = decode_entry(blob, meta)
@@ -658,8 +713,9 @@ class TieredKVCache:
             del self._t2_refs[ref.key]
             self.remote.used_bytes -= ref.nbytes
             self.remote.used_tokens -= ref.tokens
-            try:
-                self.remote.delete(ref.key)
-            except Exception:
-                pass  # best-effort: the peer's LRU reclaims it anyway
+            if not self._remote_dead:
+                try:
+                    self.remote.delete(ref.key)
+                except Exception:
+                    pass  # best-effort: the peer's LRU reclaims it anyway
             self._stamp()

@@ -190,17 +190,39 @@ class PrefixCache:
         at least one prompt position must remain to prefill, because the
         first generated token comes from the final position's logits — a
         fully cached prompt still recomputes its last partial/full chunk.
-        Counts one hit (+ reused tokens) or one miss, and refreshes the
-        donor's LRU stamp.
+        Refreshes the donor's LRU stamp.
+
+        Counting: a miss counts here; so does a device-tier (``int``
+        donor) hit — its slot-to-slot copy cannot fail. A DEEP-tier
+        donor's hit (+ reused tokens) is counted only by
+        :meth:`commit_hit` once the promotion actually lands KV rows in
+        the slot; a stale ref instead counts a :meth:`count_stale_miss`
+        cold miss. The ledger never credits skipped compute that was not
+        skipped, and the per-tier split of ``kv_tier_hits_total`` keeps
+        summing to ``prefix_cache_hits_total``.
         """
         matched, donor = self._lookup(prompt)
         if donor is None:
             _MISSES.inc()
             return 0, None
         self._touch(donor)
+        if isinstance(donor, int):
+            _HITS.inc()
+            _TOKENS_REUSED.inc(matched)
+        return matched, donor
+
+    def commit_hit(self, matched: int) -> None:
+        """Count a deep-tier hit deferred by :meth:`match` — the engine
+        calls this after ``TieredKVCache.promote`` returned True, i.e.
+        after the donor's rows really landed in the admitted slot."""
         _HITS.inc()
         _TOKENS_REUSED.inc(matched)
-        return matched, donor
+
+    def count_stale_miss(self) -> None:
+        """Count the cold miss a stale deep-tier ref degraded to (the
+        promotion found the entry gone): the admission prefills from 0,
+        so it is a miss in every ledger that matters."""
+        _MISSES.inc()
 
     def peek_donor(self, prompt) -> Optional[object]:
         """The resident :meth:`match` would reuse for ``prompt``, with no

@@ -51,6 +51,20 @@ preemptions/resumes (docs/SERVING.md).
         --prefill-chunks 8 --replicas 1,2 --overload 1,2,4 \
         --priority-mix 0.25 --preempt on,off --interactive-new-tokens 8 \
         --prompt-len 32 --new-tokens 96     # the scale-out/SLO sweep
+    python benchmarks/serving_bench.py --stack dense --rates 24 --slots 4 \
+        --prefill-chunks off --tenants 100 --overload-tenant \
+        --adapter-rank 2 --requests 200     # the tenant-isolation sweep
+
+``--tenants N --overload-tenant`` runs the multi-tenant isolation sweep
+(``bench=serving_tenants`` lines): N synthetic tenants round-robin on one
+engine, three arms — fair/no-overload, fair/overload, nofair/overload —
+where the overloading tenant (t0) floods with as many extra requests as
+every other tenant combined. Each line reports victim-vs-overloader SLO
+attainment plus per-tenant traffic and (with ``--adapter-rank``) adapter
+cache hit/miss/eviction numbers, all from real counter deltas. The
+isolation claim: victim attainment with fairness on stays >= 0.9x its
+no-overload value while the fairness-off arm visibly collapses
+(docs/SERVING.md, scripts/check_obs.py --tenants).
 """
 
 from __future__ import annotations
@@ -605,6 +619,216 @@ def run_kv_tier_arm(args, jax, stack, rate, n_slots, prefill_chunk,
     return arm
 
 
+def _tenant_counter_state():
+    """Per-tenant label state of the tenancy counter families — diffed
+    around the measured window so an arm's per-tenant traffic is real
+    counter deltas, not mirrored loadgen math."""
+    from uccl_tpu import obs
+
+    out = {}
+    for name in ("serving_tenant_requests_total",
+                 "serving_tenant_tokens_total"):
+        for labels, v in obs.counter(name).samples():
+            out[(name, labels.get("tenant", ""))] = v
+    return out
+
+
+_ADAPTER_COUNTERS = ("adapter_cache_hits_total", "adapter_cache_misses_total",
+                     "adapter_cache_evictions_total")
+
+
+def _tenant_slo_split(reqs, slo_ttft_ms, slo_tpot_ms, overloader):
+    """Aggregate TTFT/TPOT SLO attainment over the VICTIM tenants (every
+    tenant except ``overloader``) and over the overloader itself — the
+    isolation headline: fairness on must hold the victim number near its
+    no-overload value while the overloader absorbs the queueing."""
+    from uccl_tpu.serving import RequestState
+
+    def agg(rs):
+        n = ttft_ok = tpot_ok = tpot_n = 0
+        for r in rs:
+            n += 1
+            if r.ttft is not None and r.ttft * 1e3 <= slo_ttft_ms:
+                ttft_ok += 1
+            if r.tpot is not None:
+                tpot_n += 1
+                if r.tpot * 1e3 <= slo_tpot_ms:
+                    tpot_ok += 1
+        return {
+            "completed": n,
+            "ttft_attainment": round(ttft_ok / n, 4) if n else None,
+            "tpot_attainment": round(tpot_ok / tpot_n, 4)
+            if tpot_n else None,
+        }
+
+    fin = [r for r in reqs if r.state is RequestState.FINISHED]
+    return (agg([r for r in fin if r.tenant != overloader]),
+            agg([r for r in fin if r.tenant == overloader]))
+
+
+def _tenant_workload(args, vocab, rate, n_tenants, overload):
+    """Round-robin multi-tenant stream, optionally with tenant t0
+    flooding: the overloader offers as many EXTRA requests as the entire
+    rest of the fleet combined, front-loaded as a 10x-rate Poisson burst
+    (the head-of-line-blocking shape admission fairness exists for) and
+    merged by arrival time. The base stream's draws come first at a fixed
+    seed, so the no-overload arm and both overload arms face identical
+    victim traffic — the paired-arm rule every sweep here follows."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    lo = max(1, args.prompt_len // 2)
+    n = args.requests
+    lens = rng.integers(lo, args.prompt_len + 1, n)
+    prompts = [rng.integers(0, vocab, l).astype(np.int32) for l in lens]
+    if rate > 0:
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, n))
+    else:
+        arrivals = np.zeros(n)
+    tenants = [f"t{i % n_tenants}" for i in range(n)]
+    if overload:
+        f_lens = rng.integers(lo, args.prompt_len + 1, n)
+        prompts += [rng.integers(0, vocab, l).astype(np.int32)
+                    for l in f_lens]
+        if rate > 0:
+            f_arr = np.cumsum(rng.exponential(1.0 / (10.0 * rate), n))
+        else:
+            f_arr = np.zeros(n)
+        arrivals = np.concatenate([arrivals, f_arr])
+        tenants += ["t0"] * n
+        order = np.argsort(arrivals, kind="stable")
+        prompts = [prompts[i] for i in order]
+        tenants = [tenants[i] for i in order]
+        arrivals = arrivals[order]
+    return prompts, tenants, arrivals
+
+
+def run_tenant_arm(args, jax, stack, rate, n_slots, prefill_chunk,
+                   fair, overload):
+    """One multi-tenant isolation arm: ``--tenants`` synthetic tenants
+    round-robin on one engine, with tenant-fair admission (DRR +
+    per-tenant accounting) on or off and tenant t0 optionally flooding.
+    With ``--adapter-rank`` every tenant carries its own LoRA adapter
+    staged through a bounded AdapterStore, so the arm's adapter cache
+    hit/miss/eviction deltas are live restaging traffic, not synthetic.
+    The line's victim/overloader SLO attainment comes from per-request
+    TTFT/TPOT against --slo-ttft-ms/--slo-tpot-ms; per-tenant traffic is
+    serving_tenant_* counter deltas."""
+    step_tokens = (args.step_tokens or None) if prefill_chunk else None
+    if step_tokens is not None and step_tokens < prefill_chunk:
+        return None
+
+    import numpy as np
+
+    from uccl_tpu import obs
+    from uccl_tpu.serving import AdapterStore, ServingEngine, make_lora
+    from uccl_tpu.serving.loadgen import (
+        _clear_warmup_trace, drive, warm_engine,
+    )
+
+    max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
+    backend, world, vocab = _make_backend(args, jax, stack, n_slots, max_seq)
+    if backend is None:
+        return None
+    store = None
+    if args.adapter_rank:
+        if stack != "dense":
+            return None  # adapter dims below are the dense head layout
+        head_dim = args.dim // 4
+        store = AdapterStore(args.layers, args.dim, 4 * head_dim,
+                             2 * head_dim, max_rank=args.adapter_rank,
+                             capacity=max(4, n_slots))
+        for j in range(args.tenants):
+            store.publish(f"t{j}", make_lora(
+                jax.random.PRNGKey(args.seed * 7919 + j + 1),
+                args.layers, args.dim, 4 * head_dim, 2 * head_dim,
+                args.adapter_rank,
+            ))
+    engine = ServingEngine(
+        backend, prefill_chunk=prefill_chunk, step_tokens=step_tokens,
+        adapters=store, tenant_fair=fair or None,
+    )
+    prompts, tenants, arrivals = _tenant_workload(args, vocab, rate,
+                                                  args.tenants, overload)
+    lens = np.array([p.size for p in prompts])
+    warm_engine(engine, lens, max_seq, args.new_tokens)
+    if store is not None:
+        # the fused-adapter programs (prefill/chunked-prefill/decode with
+        # the adapter tables as jit args) compile on the first ADAPTED
+        # call — warm them outside the window like every other sweep
+        wrng = np.random.default_rng(args.seed + 10_007)
+        engine.submit(
+            wrng.integers(0, vocab, args.prompt_len).astype(np.int32),
+            max_new_tokens=2, tenant="t0", adapter="t0",
+        )
+        engine.drain()
+        engine.reset_metrics()
+        _clear_warmup_trace()
+    adapters = tenants if store is not None else None
+    before = _counter_state()
+    tenant_before = _tenant_counter_state()
+    adapter_before = [obs.counter(n).get() for n in _ADAPTER_COUNTERS]
+    ttft_hist_before = _hist_state("serving_ttft_seconds")
+    reqs, wall = drive(engine, prompts, arrivals, args.new_tokens,
+                       tenants=tenants, adapters=adapters)
+    deltas = _counter_deltas(before)
+    tenant_after = _tenant_counter_state()
+    snap = engine.snapshot()
+
+    def tdelta(name, tenant):
+        return (tenant_after.get((name, tenant), 0.0)
+                - tenant_before.get((name, tenant), 0.0))
+
+    served = sorted({t for (n, t) in tenant_after
+                     if n == "serving_tenant_requests_total"
+                     and tdelta(n, t) > 0})
+    victim, overloader = _tenant_slo_split(reqs, args.slo_ttft_ms,
+                                           args.slo_tpot_ms, "t0")
+    arm = _arm_header(args, stack, world, rate, n_slots, prefill_chunk,
+                      step_tokens, None)
+    arm.update({
+        "bench": "serving_tenants",
+        "workload": "tenant_rr",
+        "tenants": args.tenants,
+        "fair": fair,
+        "overload": overload,
+        "adapter_rank": args.adapter_rank,
+        "wall_s": round(wall, 3),
+        "completed": snap["completed"], "rejected": snap["rejected"],
+        "trace_ids": deltas["obs_trace_contexts"],
+        "goodput_tok_s": snap.get("goodput_tok_s"),
+        "ttft_ms": snap["ttft_ms"], "queue_wait_ms": snap["queue_wait_ms"],
+        "ttft_hist_ms": _hist_delta_ms("serving_ttft_seconds",
+                                       ttft_hist_before),
+        "tpot_ms": snap["tpot_ms"],
+        "slot_high_water": engine.pool.high_water,
+        "slo_ttft_ms": args.slo_ttft_ms,
+        "slo_tpot_ms": args.slo_tpot_ms,
+        # the isolation headline and its label: counter-delta per-tenant
+        # traffic, victim vs overloader attainment
+        "tenant_series": len(served),
+        "overloader_requests": tdelta("serving_tenant_requests_total",
+                                      "t0"),
+        "overloader_tokens": tdelta("serving_tenant_tokens_total", "t0"),
+        "victim_requests": sum(
+            tdelta("serving_tenant_requests_total", t)
+            for t in served if t != "t0"),
+        "victim_slo": victim,
+        "overloader_slo": overloader,
+    })
+    if store is not None:
+        hits, misses, evictions = (
+            obs.counter(n).get() - b
+            for n, b in zip(_ADAPTER_COUNTERS, adapter_before))
+        arm.update({
+            "adapter_hits": hits, "adapter_misses": misses,
+            "adapter_evictions": evictions,
+            "adapter_resident": store.n_resident,
+        })
+    arm["obs"] = obs.REGISTRY.snapshot()["metrics"]
+    return arm
+
+
 def run_router_arm(args, jax, stack, rate, n_slots, prefill_chunk,
                    n_replicas, mix, preempt_on, overload):
     """One replica-router arm under sustained Poisson (over)load:
@@ -897,6 +1121,25 @@ def main():
                          "interactive turns over long batch jobs is the "
                          "workload shape chunk-boundary preemption "
                          "exists for")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant isolation sweep: N synthetic "
+                         "tenants round-robin on one engine "
+                         "(bench=serving_tenants lines). Runs the "
+                         "fair/no-overload baseline arm; add "
+                         "--overload-tenant for the paired overload "
+                         "arms. Does not compose with the other sweeps")
+    ap.add_argument("--overload-tenant", action="store_true",
+                    help="tenant sweep: add the overload arms — tenant "
+                         "t0 floods with as many extra requests as the "
+                         "whole rest of the fleet combined, once with "
+                         "tenant-fair admission on and once off (the "
+                         "isolation-vs-collapse paired comparison)")
+    ap.add_argument("--adapter-rank", type=int, default=0,
+                    help="tenant sweep: stage a rank-R LoRA adapter per "
+                         "tenant through a bounded AdapterStore (dense "
+                         "stack), so arm lines carry live adapter cache "
+                         "hit/miss/eviction counter deltas (0 = no "
+                         "adapters)")
     ap.add_argument("--slo-ttft-ms", type=float, default=250.0,
                     help="TTFT target for per-class attainment")
     ap.add_argument("--slo-tpot-ms", type=float, default=50.0,
@@ -972,6 +1215,42 @@ def main():
                                 }), flush=True)
                                 continue
                             print(json.dumps(arm), flush=True)
+        return
+
+    if args.tenants:
+        # the multi-tenant isolation sweep: baseline + (with
+        # --overload-tenant) the fair-on/fair-off overload pair, each a
+        # serving_tenants JSON line whose victim/overloader SLO split and
+        # per-tenant traffic come from real counter deltas
+        if args.disagg or args.replicas or args.prefix_hit_rates \
+                or args.spec_k or args.kv_tiers:
+            raise SystemExit(
+                "--tenants composes with --overload-tenant/"
+                "--adapter-rank, not the --disagg/--replicas/"
+                "--prefix-hit-rates/--spec-k/--kv-tiers sweeps"
+            )
+        arms = [(True, False)]
+        if args.overload_tenant:
+            arms += [(True, True), (False, True)]
+        for rate in [float(r) for r in args.rates.split(",")]:
+            for n_slots in [int(s) for s in args.slots.split(",")]:
+                for chunk in chunks:
+                    for fair, over in arms:
+                        arm = run_tenant_arm(args, jax, args.stack, rate,
+                                             n_slots, chunk, fair, over)
+                        if arm is None:
+                            print(json.dumps({
+                                "bench": "serving_tenants",
+                                "tenants": args.tenants, "fair": fair,
+                                "overload": over, "slots": n_slots,
+                                "prefill_chunk": chunk,
+                                "skipped": "slots must divide the MoE "
+                                           "world, --step-tokens < the "
+                                           "arm's chunk, or --adapter-"
+                                           "rank off the dense stack",
+                            }), flush=True)
+                            continue
+                        print(json.dumps(arm), flush=True)
         return
 
     if args.replicas:

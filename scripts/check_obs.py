@@ -666,6 +666,86 @@ def check_kv_tiers_metrics(path: str, bench_json: str) -> None:
           f"{sorted(tiers_run)}")
 
 
+def check_tenants_metrics(path: str, bench_json: str) -> None:
+    """The multi-tenant isolation smoke arm: per-tenant accounting must be
+    real (>= 2 tenant-labeled serving_tenant_* series with non-zero
+    counts), the bounded adapter store must have demonstrably cycled
+    (counted hits AND evictions), and the bench's paired arms must prove
+    isolation — victim SLO attainment with tenant-fair admission on under
+    overload >= 0.9x its no-overload value, while the fairness-off arm
+    sits visibly below the baseline (the collapse the fair path
+    prevents)."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+
+    tenants = {}
+    for ln in lines:
+        if ln.startswith("serving_tenant_requests_total{"):
+            label = ln[ln.index("{") + 1:ln.index("}")]
+            tenants[label] = float(ln.rsplit(" ", 1)[1])
+    if len(tenants) < 2:
+        fail(f"{path}: {len(tenants)} tenant-labeled "
+             f"serving_tenant_requests_total series — the engine never "
+             f"accounted more than one tenant (labels: {sorted(tenants)})")
+    dead = [lab for lab, v in tenants.items() if v <= 0]
+    if dead:
+        fail(f"{path}: tenant series with zero finished requests: {dead}")
+    if not any(ln.startswith("serving_tenant_tokens_total{")
+               for ln in lines):
+        fail(f"{path}: missing serving_tenant_tokens_total — per-tenant "
+             f"goodput is invisible")
+    hits = _prom_total(lines, "adapter_cache_hits_total", path)
+    evictions = _prom_total(lines, "adapter_cache_evictions_total", path)
+    if hits < 1:
+        fail(f"{path}: zero adapter_cache_hits_total — no acquisition "
+             f"ever reused a device-resident adapter row")
+    if evictions < 1:
+        fail(f"{path}: zero adapter_cache_evictions_total — the bounded "
+             f"store never restaged under pressure (capacity >= tenants?)")
+
+    arms = {}
+    with open(bench_json) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                arm = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if arm.get("bench") != "serving_tenants" or "skipped" in arm:
+                continue
+            if arm.get("tenant_series", 0) < 2:
+                fail(f"{bench_json}: arm fair={arm.get('fair')} "
+                     f"overload={arm.get('overload')} counted "
+                     f"{arm.get('tenant_series')} tenant series")
+            va = (arm.get("victim_slo") or {}).get("ttft_attainment")
+            if va is None:
+                fail(f"{bench_json}: arm fair={arm.get('fair')} "
+                     f"overload={arm.get('overload')} carries no victim "
+                     f"TTFT attainment")
+            arms[(bool(arm.get("fair")), bool(arm.get("overload")))] = va
+    for key, what in (((True, False), "fair/no-overload baseline"),
+                      ((True, True), "fair/overload"),
+                      ((False, True), "nofair/overload")):
+        if key not in arms:
+            fail(f"{bench_json}: missing the {what} arm — the isolation "
+                 f"claim needs all three")
+    base, fair, nofair = arms[(True, False)], arms[(True, True)], \
+        arms[(False, True)]
+    if fair < 0.9 * base:
+        fail(f"{bench_json}: victim TTFT attainment under overload with "
+             f"fairness on is {fair} < 0.9x its no-overload value {base} "
+             f"— the overloading tenant pushed victims off their SLO")
+    if not nofair < base - 0.05:
+        fail(f"{bench_json}: fairness-off victim attainment {nofair} did "
+             f"not visibly collapse below the baseline {base} — the smoke "
+             f"arm never demonstrated the failure mode fairness prevents")
+    print(f"check_obs: tenants metrics OK — {len(tenants)} tenant series, "
+          f"{int(hits)} adapter hit(s) / {int(evictions)} eviction(s), "
+          f"victim attainment base={base} fair={fair} nofair={nofair}")
+
+
 def check_router_metrics(path: str) -> None:
     with open(path) as f:
         lines = f.read().splitlines()
@@ -943,6 +1023,10 @@ def main(argv) -> None:
         check_kv_tiers_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
         return
+    if len(argv) == 4 and argv[1] == "--tenants":
+        check_tenants_metrics(argv[2], argv[3])
+        print("check_obs: ALL OK")
+        return
     if len(argv) != 3:
         fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
@@ -950,6 +1034,7 @@ def main(argv) -> None:
              "check_obs.py --a2a-sched METRICS_PROM BENCH_JSON | "
              "check_obs.py --weights PUSH_PROM PLAN_PROM | "
              "check_obs.py --kv-tiers METRICS_PROM BENCH_JSON | "
+             "check_obs.py --tenants METRICS_PROM BENCH_JSON | "
              "check_obs.py --disagg METRICS_PROM | "
              "check_obs.py --chaos METRICS_PROM [BENCH_JSON] | "
              "check_obs.py --transport METRICS_PROM [BENCH_JSON] | "

@@ -69,6 +69,19 @@ JAX_PLATFORMS=cpu timeout 600 python benchmarks/serving_bench.py --rates 50 --sl
   --metrics-out /tmp/qa_kvtiers_metrics.prom > /tmp/qa_kvtiers_bench.json; check $?
 python scripts/check_obs.py --kv-tiers /tmp/qa_kvtiers_metrics.prom /tmp/qa_kvtiers_bench.json; check $?
 
+note "multi-tenant isolation smoke tier (8 tenants + t0 burst-flooding, per-tenant LoRA via a 4-row adapter store: fair-on victim SLO >= 0.9x baseline, fair-off visibly collapsed, tenant/adapter series counter-audited)"
+JAX_PLATFORMS=cpu timeout 600 python benchmarks/serving_bench.py --rates 40 --slots 2 \
+  --prefill-chunks off --tenants 8 --overload-tenant --adapter-rank 2 \
+  --requests 48 --prompt-len 8 --new-tokens 32 --slo-ttft-ms 250 --slo-tpot-ms 100 \
+  --metrics-out /tmp/qa_tenants_metrics.prom > /tmp/qa_tenants_bench.json; check $?
+python scripts/check_obs.py --tenants /tmp/qa_tenants_metrics.prom /tmp/qa_tenants_bench.json; check $?
+
+note "sampled serving smoke tier (temperature/top-p/top-k + per-request seeds across 3 tenants with rank-2 adapters: every request bit-exact vs the sampled W+BA oracle)"
+JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
+  --requests 8 --prompt-len 8 --new-tokens 8 --arrival-rate 50 \
+  --temperature 0.8 --top-p 0.9 --top-k 20 --tenants 3 --adapter-rank 2 \
+  --check-oracle; check $?
+
 note "windowed transport smoke tier (lossy+reordering loopback incast: 4->1 channel fan-in at 2% drop / 20% reorder, swift + eqds-credit arms, payload bit-exact, SACK retx split + credit series validated)"
 timeout 600 python benchmarks/incast_bench.py --smoke \
   --metrics-out /tmp/qa_transport_metrics.prom \

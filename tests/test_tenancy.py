@@ -1,0 +1,806 @@
+"""Multi-tenant serving plane (ISSUE 18): stochastic sampling, batched
+per-slot LoRA adapters, per-tenant fair scheduling.
+
+Layers of test, cheapest first:
+
+* **Sampling math units** (tiny jit): temp-0 rows are exact argmax,
+  top-k/top-p restrict the support, lockstep keys are deterministic in
+  (seed, position) alone, and the sampled histogram tracks softmax — the
+  residual-distribution property behind the rejection-sampling acceptance
+  rule (a deterministic drafter's proposal is a point mass, so "accept iff
+  draft == the position's lockstep sample" IS exact rejection sampling;
+  docs/SERVING.md).
+* **AdapterStore units** (host + tiny tables): publish/acquire/release
+  refcount discipline, LRU eviction of unpinned rows, counter audit, and
+  the weight-push ingest round trip.
+* **TenantFairScheduler properties** (host only): DRR interleaving under
+  a flooding tenant, the token-bucket ceiling with an injected clock, and
+  deficit accumulation for requests costlier than one quantum.
+* **Engine exactness** (real models): at equal seeds the engine's sampled
+  output is bit-identical to the sampled one-shot ``generate`` oracle —
+  mixed greedy/sampled batches with slot reuse, and under chunked prefill
+  + speculative decoding; fused batched LoRA matches dense-materialized
+  ``W + B@A`` params with mixed ranks and adapter-free slots in one
+  batch; the prefix cache never crosses tenant/adapter-version
+  namespaces. MoE arms are marked ``slow`` (shard_map compiles).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from uccl_tpu.serving import (
+    AdapterStore, DenseBackend, MoEBackend, PrefixCache, RequestState,
+    SamplingParams, ServingEngine, SlotPool, TenantFairScheduler,
+    make_lora, materialize,
+)
+from uccl_tpu.serving.request import Request
+
+MAX_SEQ = 32
+
+
+def _prompt(rng, n):
+    return rng.integers(0, 64, n).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def dense_setup():
+    """Params + ONE shared backend per module (the test_serving rule):
+    the backend's jit cache makes later compiles cache hits."""
+    from uccl_tpu.models import dense
+
+    cfg = dense.DenseConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, head_dim=8,
+        ffn=64,
+    )
+    params = dense.init_params(jax.random.PRNGKey(0), cfg)
+    backend = DenseBackend(params, cfg, n_slots=2, max_seq=MAX_SEQ)
+    return cfg, params, backend
+
+
+def _store_for(cfg, **kw):
+    return AdapterStore(
+        cfg.n_layers, cfg.dim, cfg.n_heads * cfg.head_dim,
+        cfg.n_kv_heads * cfg.head_dim, **kw,
+    )
+
+
+def _lora_for(cfg, rank, seed, scale=0.8):
+    # scale 0.8 so the delta CHANGES the argmax: an adapter test whose
+    # adapted tokens equal the base tokens proves nothing
+    return make_lora(
+        jax.random.PRNGKey(seed), cfg.n_layers, cfg.dim,
+        cfg.n_heads * cfg.head_dim, cfg.n_kv_heads * cfg.head_dim, rank,
+        scale=scale,
+    )
+
+
+class TestSamplingParams:
+    def test_validation(self):
+        # temp <= 0 is LEGAL (the per-row greedy rule) — only non-finite
+        # temperatures are rejected
+        assert SamplingParams(temperature=0.0).temperature == 0.0
+        assert SamplingParams(temperature=-1.0).temperature == -1.0
+        with pytest.raises(ValueError):
+            SamplingParams(temperature=float("inf"))
+        with pytest.raises(ValueError):
+            SamplingParams(temperature=float("nan"))
+        with pytest.raises(ValueError):
+            SamplingParams(top_p=0.0)
+        with pytest.raises(ValueError):
+            SamplingParams(top_p=1.5)
+        with pytest.raises(ValueError):
+            SamplingParams(top_k=-1)
+        with pytest.raises(ValueError):
+            SamplingParams(seed=2**40)
+
+    def test_slot_stamp_roundtrip(self):
+        from uccl_tpu.serving.sampling import slot_arrays, stamp_slot
+
+        arrs = slot_arrays(3)
+        stamp_slot(arrs, 1, SamplingParams(temperature=0.7, top_p=0.9,
+                                           top_k=5, seed=42))
+        assert arrs["temp"][1] == np.float32(0.7)
+        assert arrs["seeds"][1] == 42 and arrs["top_k"][1] == 5
+        stamp_slot(arrs, 1, None)  # release → greedy defaults
+        assert arrs["temp"][1] == 0.0 and arrs["top_p"][1] == 1.0
+        assert arrs["temp"][0] == 0.0  # untouched rows stay greedy
+
+
+class TestSamplingMath:
+    def _rows(self, rng, b, v=16):
+        return jnp.asarray(rng.normal(size=(b, v)).astype(np.float32))
+
+    def test_temp0_is_exact_argmax(self, rng):
+        from uccl_tpu.models.sampling import sample_tokens
+
+        logits = self._rows(rng, 8)
+        toks = sample_tokens(
+            jnp.arange(8, dtype=jnp.int32), jnp.zeros(8, jnp.int32),
+            logits, jnp.zeros(8, jnp.float32), jnp.ones(8, jnp.float32),
+            jnp.zeros(8, jnp.int32),
+        )
+        assert np.array_equal(np.asarray(toks),
+                              np.argmax(np.asarray(logits), -1))
+
+    def test_lockstep_key_is_pure_in_seed_and_pos(self, rng):
+        from uccl_tpu.models.sampling import sample_tokens
+
+        logits = self._rows(rng, 4)
+
+        def draw(seed, pos):
+            return np.asarray(sample_tokens(
+                jnp.full(4, seed, jnp.int32), jnp.full(4, pos, jnp.int32),
+                logits, jnp.full(4, 1.0, jnp.float32),
+                jnp.ones(4, jnp.float32), jnp.zeros(4, jnp.int32),
+            ))
+
+        assert np.array_equal(draw(7, 3), draw(7, 3))  # deterministic
+        # over several positions, the draws cannot all coincide
+        assert any(not np.array_equal(draw(7, 3), draw(7, p))
+                   for p in range(4, 12))
+        assert any(not np.array_equal(draw(7, 3), draw(s, 3))
+                   for s in range(8, 16))
+
+    def test_top_k_restricts_support(self, rng):
+        from uccl_tpu.models.sampling import sample_tokens
+
+        b = 64
+        logits = jnp.tile(self._rows(rng, 1), (b, 1))
+        top2 = set(np.argsort(-np.asarray(logits[0]))[:2].tolist())
+        toks = sample_tokens(
+            jnp.arange(b, dtype=jnp.int32), jnp.zeros(b, jnp.int32),
+            logits, jnp.full(b, 1.5, jnp.float32),
+            jnp.ones(b, jnp.float32), jnp.full(b, 2, jnp.int32),
+        )
+        assert set(np.asarray(toks).tolist()) <= top2
+        assert len(set(np.asarray(toks).tolist())) == 2  # both reachable
+
+    def test_top_p_restricts_support(self, rng):
+        from uccl_tpu.models.sampling import sample_tokens
+
+        # one dominant token holding > 0.5 of the mass: top_p=0.5 keeps
+        # only it (the head always survives), so sampling is deterministic
+        v, b = 8, 32
+        row = np.zeros(v, np.float32)
+        row[3] = 8.0
+        logits = jnp.tile(jnp.asarray(row)[None], (b, 1))
+        toks = sample_tokens(
+            jnp.arange(b, dtype=jnp.int32), jnp.zeros(b, jnp.int32),
+            logits, jnp.ones(b, jnp.float32),
+            jnp.full(b, 0.5, jnp.float32), jnp.zeros(b, jnp.int32),
+        )
+        assert np.array_equal(np.asarray(toks), np.full(b, 3))
+
+    def test_histogram_tracks_softmax(self, rng):
+        """The residual-distribution property: across many seeds at one
+        position, the empirical distribution of lockstep samples tracks
+        softmax(logits/T) — the distribution the spec-decode commit loop
+        emits on rejection (the sampled target token IS the residual for
+        a point-mass proposal)."""
+        from uccl_tpu.models.sampling import sample_tokens
+
+        v, n = 4, 4096
+        row = np.array([0.0, 0.5, 1.0, 1.5], np.float32)
+        p_want = np.exp(row) / np.exp(row).sum()
+        toks = np.asarray(sample_tokens(
+            jnp.arange(n, dtype=jnp.int32), jnp.zeros(n, jnp.int32),
+            jnp.tile(jnp.asarray(row)[None], (n, 1)),
+            jnp.ones(n, jnp.float32), jnp.ones(n, jnp.float32),
+            jnp.zeros(n, jnp.int32),
+        ))
+        p_got = np.bincount(toks, minlength=v) / n
+        assert np.abs(p_got - p_want).max() < 0.04  # ~5 sigma at n=4096
+
+    def test_window_matches_per_position_rows(self, rng):
+        """sample_window column j ≡ sample_tokens at position pos0+j on
+        the same logits row — the identity that makes verify-window
+        samples exactly vanilla decode's draws."""
+        from uccl_tpu.models.sampling import sample_tokens, sample_window
+
+        b, s, v = 2, 3, 16
+        logits = jnp.asarray(rng.normal(size=(b, s, v)).astype(np.float32))
+        seeds = jnp.asarray([5, 9], jnp.int32)
+        pos0 = jnp.asarray([4, 0], jnp.int32)
+        temp = jnp.full(b, 0.8, jnp.float32)
+        top_p = jnp.full(b, 0.95, jnp.float32)
+        top_k = jnp.full(b, 3, jnp.int32)
+        win = np.asarray(sample_window(seeds, pos0, logits, temp, top_p,
+                                       top_k))
+        for j in range(s):
+            col = np.asarray(sample_tokens(
+                seeds, pos0 + j, logits[:, j], temp, top_p, top_k
+            ))
+            assert np.array_equal(win[:, j], col), j
+
+
+class TestAdapterStore:
+    def _cfg(self):
+        from uccl_tpu.models import dense
+
+        return dense.DenseConfig(
+            vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            head_dim=8, ffn=64,
+        )
+
+    def test_acquire_release_refcount_and_lru(self):
+        from uccl_tpu.serving import adapters as mod
+
+        cfg = self._cfg()
+        store = _store_for(cfg, max_rank=2, capacity=2)
+        h0 = mod._HITS.total()
+        m0 = mod._MISSES.total()
+        e0 = mod._EVICTIONS.total()
+        for t in ("a", "b", "c"):
+            store.publish(t, _lora_for(cfg, 2, seed=hash(t) % 97))
+        assert store.acquire(None) == 0  # zero-rank fast path, never pinned
+        ra = store.acquire("a")          # miss: stage
+        rb = store.acquire("b")          # miss: stage (store now full)
+        assert ra != rb and 0 not in (ra, rb)
+        assert store.acquire("a") == ra  # hit while resident
+        with pytest.raises(RuntimeError):
+            store.acquire("c")           # both rows pinned
+        store.release(ra)
+        store.release(ra)                # refcount 0 → evictable
+        rc = store.acquire("c")          # LRU-evicts a's row
+        assert rc == ra
+        with pytest.raises(KeyError):
+            store.acquire("nope")        # unpublished
+        store.release(rb)
+        store.release(rc)
+        assert mod._HITS.total() - h0 == 1
+        # 4 misses: a, b, the DENIED c attempt (a miss before discovering
+        # every row was pinned), then c's successful restage
+        assert mod._MISSES.total() - m0 == 4
+        assert mod._EVICTIONS.total() - e0 == 1
+
+    def test_device_tables_rank_padding_and_zero_row(self):
+        cfg = self._cfg()
+        store = _store_for(cfg, max_rank=4, capacity=2)
+        tree = _lora_for(cfg, 2, seed=1)  # rank 2 under max_rank 4
+        store.publish("acme", tree)
+        row = store.acquire("acme")
+        tabs = store.device_tables()
+        a_q, b_q = tabs["wq"]
+        assert a_q.shape == (cfg.n_layers, 3, cfg.dim, 4)
+        assert np.all(np.asarray(a_q[:, 0]) == 0.0)  # row 0 = adapter-free
+        # staged content: real ranks verbatim, the padding ranks zero
+        assert np.array_equal(np.asarray(a_q[:, row, :, :2]),
+                              np.asarray(tree["wq"]["a"]))
+        assert np.all(np.asarray(a_q[:, row, :, 2:]) == 0.0)
+        assert np.array_equal(np.asarray(tabs["wv"][1][:, row, :2]),
+                              np.asarray(tree["wv"]["b"]))
+        store.release(row)
+
+    def test_publish_refresh_bumps_version_and_restages(self):
+        cfg = self._cfg()
+        store = _store_for(cfg, max_rank=2, capacity=2)
+        v1 = store.publish("acme", _lora_for(cfg, 2, seed=1))
+        row = store.acquire("acme")
+        t2 = _lora_for(cfg, 2, seed=2)
+        v2 = store.publish("acme", t2)  # live refresh, row stays pinned
+        assert v2 == v1 + 1 and store.version("acme") == v2
+        a_q = store.device_tables()["wq"][0]
+        assert np.array_equal(np.asarray(a_q[:, row]),
+                              np.asarray(t2["wq"]["a"]))
+        store.release(row)
+
+    def test_rank_over_max_rejected(self):
+        cfg = self._cfg()
+        store = _store_for(cfg, max_rank=2, capacity=2)
+        with pytest.raises(ValueError):
+            store.publish("big", _lora_for(cfg, 4, seed=1))
+
+    def test_weight_push_ingest_round_trip(self):
+        """The distribution path: adapters travel as versioned
+        WeightPublisher snapshots; ``ingest`` maps ``adapter/<tenant>``
+        names onto store tenants and pins the snapshot version."""
+        from uccl_tpu.p2p.weight_push import WeightPublisher
+
+        cfg = self._cfg()
+        tree = _lora_for(cfg, 2, seed=3)
+        pub = WeightPublisher()
+        pub.publish("adapter/acme", tree)
+        pub.publish("adapter/acme", _lora_for(cfg, 2, seed=4))  # v2
+        snap = pub.get("adapter/acme")
+        store = _store_for(cfg, max_rank=2, capacity=2)
+        assert store.ingest(snap) == 2
+        assert store.has("acme") and store.version("acme") == 2
+        row = store.acquire("acme")
+        want = snap.tree()["wq"]["a"]
+        got = store.device_tables()["wq"][0][:, row]
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+        store.release(row)
+
+
+class TestTenantFairScheduler:
+    def _req(self, rid, tenant, cost=8, preemptions=0):
+        r = Request(rid=rid, prompt=np.zeros(cost // 2, np.int32),
+                    max_new_tokens=cost - cost // 2, tenant=tenant)
+        r.preemptions = preemptions
+        return r
+
+    def _drain_order(self, sched, pool):
+        order = []
+        while sched.qsize:
+            got = sched.admit(pool)
+            if not got:
+                break
+            for slot, req in got:
+                order.append(req.tenant)
+                pool.free(slot)
+        return order
+
+    def test_drr_interleaves_flooding_tenant(self):
+        """Backlog buys nothing: with tenant A 10-deep and tenant B
+        2-deep at equal request cost, B's head admits within the first
+        round — not after A's flood."""
+        sched = TenantFairScheduler(quantum=8)
+        for i in range(10):
+            sched.submit(self._req(i, "A"))
+        for i in range(2):
+            sched.submit(self._req(100 + i, "B"))
+        order = self._drain_order(sched, SlotPool(1))
+        assert order.index("B") <= 1
+        assert sorted(order) == ["A"] * 10 + ["B"] * 2
+
+    def test_token_bucket_is_a_hard_ceiling(self):
+        """Above its rate a tenant holds in queue even with free slots;
+        the bucket refills with (injected) clock time and caps at burst."""
+        clk = {"t": 0.0}
+        sched = TenantFairScheduler(quantum=100, rate=10.0, burst=10.0,
+                                    clock=lambda: clk["t"])
+        for i in range(3):
+            sched.submit(self._req(i, "A", cost=10))
+        pool = SlotPool(2)
+        got = sched.admit(pool)
+        assert len(got) == 1  # burst covers exactly one request
+        pool.free(got[0][0])
+        assert sched.admit(pool) == []  # bucket empty, slots free
+        clk["t"] = 1.0  # +10 tokens
+        got = sched.admit(pool)
+        assert len(got) == 1
+        pool.free(got[0][0])
+        clk["t"] = 100.0  # refill caps at burst → still just one admission
+        got = sched.admit(pool)
+        assert len(got) == 1
+
+    def test_preempted_request_not_recharged(self):
+        clk = {"t": 0.0}
+        sched = TenantFairScheduler(quantum=100, rate=10.0, burst=10.0,
+                                    clock=lambda: clk["t"])
+        sched.submit(self._req(0, "A", cost=10))
+        pool = SlotPool(1)
+        (slot, req), = sched.admit(pool)
+        pool.free(slot)
+        req.preemptions = 1
+        sched.requeue(req)  # resume path: billed at first admission
+        assert len(sched.admit(pool)) == 1  # admits on an empty bucket
+
+    def test_deficit_accumulates_across_rounds(self):
+        """A request costlier than one quantum admits after enough visits
+        — DRR never starves large requests."""
+        sched = TenantFairScheduler(quantum=4)
+        sched.submit(self._req(0, "A", cost=20))
+        sched.submit(self._req(1, "B", cost=4))
+        order = self._drain_order(sched, SlotPool(1))
+        assert sorted(order) == ["A", "B"]
+
+    def test_fifo_surfaces_route_through_tenant_queues(self):
+        sched = TenantFairScheduler()
+        reqs = [self._req(i, t) for i, t in enumerate("ABA")]
+        for r in reqs:
+            sched.submit(r)
+        assert sched.qsize == 3
+        assert sched.cancel(reqs[1].rid)
+        assert sched.qsize == 2
+        assert {r.rid for r in sched.take_all()} == {0, 2}
+
+    def test_engine_rejects_tenant_fair_plus_priority_classes(self,
+                                                              dense_setup):
+        _, _, backend = dense_setup
+        with pytest.raises(ValueError):
+            ServingEngine(backend, tenant_fair=True, priority_classes=True)
+
+
+def _sampled_oracle(params, cfg, req):
+    from uccl_tpu.models.inference import generate
+
+    toks = generate(params, jnp.asarray(req.prompt)[None], cfg,
+                    max_new_tokens=req.max_new_tokens, max_seq=MAX_SEQ,
+                    sampling=req.sampling)
+    return np.asarray(toks)[0, : req.n_generated].tolist()
+
+
+class TestDenseSampledOracle:
+    def test_same_seed_bit_identity_mixed_batch(self, dense_setup):
+        """The acceptance anchor: 2 slots, 6 staggered requests (slot
+        reuse) mixing greedy and sampled rows with distinct seeds /
+        temperatures / truncations — every sequence bit-equals the
+        sampled one-shot oracle at the same seed."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(0)
+        eng = ServingEngine(backend)
+        sp = [
+            SamplingParams(temperature=0.8, seed=1),
+            None,  # greedy neighbor in a sampled batch
+            SamplingParams(temperature=1.2, top_k=7, seed=2),
+            SamplingParams(temperature=0.7, top_p=0.9, seed=3),
+            SamplingParams(temperature=1.0, top_p=0.8, top_k=9, seed=4),
+            SamplingParams(temperature=0.8, seed=1),  # seed reuse, new slot
+        ]
+        reqs = [eng.submit(_prompt(rng, 5), max_new_tokens=6,
+                           sampling=sp[0]),
+                eng.submit(_prompt(rng, 3), max_new_tokens=4,
+                           sampling=sp[1])]
+        eng.step()
+        eng.step()
+        for (n, m), s in zip(((8, 5), (2, 6), (6, 3), (7, 5)), sp[2:]):
+            reqs.append(eng.submit(_prompt(rng, n), max_new_tokens=m,
+                                   sampling=s))
+        eng.drain()
+        assert eng.pool.leaked() == 0
+        for r in reqs:
+            assert r.n_generated == r.max_new_tokens
+            assert r.out_tokens == _sampled_oracle(params, cfg, r), r.rid
+        # seed is the whole entropy source: equal prompt + equal seed on
+        # DIFFERENT slots at different times → identical tokens
+        twin = eng.submit(reqs[0].prompt.copy(), max_new_tokens=6,
+                          sampling=sp[0])
+        eng.drain()
+        assert twin.out_tokens == reqs[0].out_tokens
+
+    def test_sampled_not_equal_greedy(self, dense_setup):
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(3)
+        prompt = _prompt(rng, 5)
+        eng = ServingEngine(backend)
+        hot = eng.submit(prompt.copy(), max_new_tokens=6,
+                         sampling=SamplingParams(temperature=2.0, seed=11))
+        cold = eng.submit(prompt.copy(), max_new_tokens=6)
+        eng.drain()
+        assert hot.out_tokens == _sampled_oracle(params, cfg, hot)
+        assert cold.out_tokens == _sampled_oracle(params, cfg, cold)
+        assert hot.out_tokens != cold.out_tokens, (
+            "temperature-2 sampling should diverge from greedy here; if "
+            "not, this fixture stopped exercising the sampled path"
+        )
+
+    def test_sampled_chunked_spec_exact(self, dense_setup):
+        """Chunked prefill + speculative decoding + sampling compose:
+        lockstep keys make the spec_k>0 commits same-seed EXACT, and the
+        chunk cursor never perturbs a position's key."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(1)
+        eng = ServingEngine(backend, prefill_chunk=3, spec_k=2)
+        sp = [SamplingParams(temperature=0.9, seed=21),
+              SamplingParams(temperature=0.9, top_k=5, seed=22),
+              None,
+              SamplingParams(temperature=1.1, top_p=0.85, seed=23)]
+        reqs = [eng.submit(_prompt(rng, 7), max_new_tokens=6,
+                           sampling=sp[0]),
+                eng.submit(_prompt(rng, 4), max_new_tokens=5,
+                           sampling=sp[1])]
+        eng.step()
+        eng.step()
+        reqs.append(eng.submit(_prompt(rng, 8), max_new_tokens=4,
+                               sampling=sp[2]))
+        reqs.append(eng.submit(_prompt(rng, 5), max_new_tokens=6,
+                               sampling=sp[3]))
+        eng.drain()
+        assert eng.pool.leaked() == 0
+        for r in reqs:
+            assert r.out_tokens == _sampled_oracle(params, cfg, r), r.rid
+
+    def test_spec_equals_vanilla_at_same_seed(self, dense_setup):
+        """spec_k>0 ≡ spec_k=0 at equal seeds, request for request — the
+        strongest form of the distribution-identity bar."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(2)
+        prompts = [_prompt(rng, n) for n in (5, 3, 8, 6)]
+        sp = [SamplingParams(temperature=0.8, seed=31 + i)
+              for i in range(4)]
+
+        def run(spec_k):
+            eng = ServingEngine(backend, spec_k=spec_k)
+            reqs = [eng.submit(p.copy(), max_new_tokens=5, sampling=s)
+                    for p, s in zip(prompts, sp)]
+            eng.drain()
+            assert eng.pool.leaked() == 0
+            return [r.out_tokens for r in reqs]
+
+        assert run(None) == run(2)
+
+    def test_spec_resample_counter_counts_sampled_rejections(self,
+                                                             dense_setup):
+        from uccl_tpu.serving import engine as eng_mod
+
+        cfg, params, backend = dense_setup
+        before = eng_mod._SPEC_RESAMPLE.total()
+        eng = ServingEngine(backend, spec_k=2)
+        # a motif prompt makes the prompt-lookup drafter actually PROPOSE
+        # (random prompts can starve it of n-gram matches); temperature-2
+        # sampling then rejects some proposal at this seed
+        r = eng.submit(np.tile(np.array([7, 9], np.int32), 6),
+                       max_new_tokens=8,
+                       sampling=SamplingParams(temperature=2.0, seed=6))
+        eng.drain()
+        assert r.out_tokens == _sampled_oracle(params, cfg, r)
+        assert eng.metrics.snapshot()["spec_proposed"] > 0, (
+            "drafter never proposed — the fixture stopped exercising the "
+            "rejection path; pick a seed/motif that yields proposals"
+        )
+        assert eng_mod._SPEC_RESAMPLE.total() > before
+
+
+class TestDenseLoRA:
+    def test_fused_mixed_ranks_vs_materialized(self, dense_setup):
+        """One batch holds a rank-2 adapter, a rank-4 adapter (rank
+        padding in one compiled program) and an adapter-free request —
+        each bit-equals generate() on its own dense-materialized
+        ``W + B@A`` params, and the adapter-free neighbor is untouched."""
+        from uccl_tpu.models.inference import generate
+
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(0)
+        store = _store_for(cfg, max_rank=4, capacity=2)
+        trees = {"acme": _lora_for(cfg, 2, seed=1),
+                 "beta": _lora_for(cfg, 4, seed=2)}
+        for t, tree in trees.items():
+            store.publish(t, tree)
+        eng = ServingEngine(backend, adapters=store)
+        prompt = _prompt(rng, 6)
+        ra = eng.submit(prompt.copy(), max_new_tokens=6, adapter="acme")
+        rb = eng.submit(prompt.copy(), max_new_tokens=6, adapter="beta")
+        rn = eng.submit(prompt.copy(), max_new_tokens=6)
+        eng.drain()
+        assert eng.pool.leaked() == 0
+        assert store.n_resident == 2  # retire released the pins
+
+        def want(req, tree):
+            p = materialize(params, tree) if tree is not None else params
+            toks = generate(p, jnp.asarray(req.prompt)[None], cfg,
+                            max_new_tokens=req.max_new_tokens,
+                            max_seq=MAX_SEQ)
+            return np.asarray(toks)[0, : req.n_generated].tolist()
+
+        wa, wb, wn = (want(ra, trees["acme"]), want(rb, trees["beta"]),
+                      want(rn, None))
+        assert ra.out_tokens == wa and rb.out_tokens == wb
+        assert rn.out_tokens == wn
+        assert len({tuple(wa), tuple(wb), tuple(wn)}) == 3, (
+            "adapters failed to change the argmax — raise the LoRA scale "
+            "or this test proves nothing"
+        )
+
+    def test_lru_restage_under_bounded_store_stays_exact(self, dense_setup):
+        """capacity=1: each alternating request evicts the other tenant's
+        row; outputs stay exact through evict → restage cycles and the
+        eviction counter records them."""
+        from uccl_tpu.serving import adapters as mod
+
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(1)
+        store = _store_for(cfg, max_rank=2, capacity=1)
+        trees = {"a": _lora_for(cfg, 2, seed=3),
+                 "b": _lora_for(cfg, 2, seed=4)}
+        for t, tree in trees.items():
+            store.publish(t, tree)
+        e0 = mod._EVICTIONS.total()
+        eng = ServingEngine(backend, adapters=store)
+        prompt = _prompt(rng, 5)
+        outs = {}
+        for name in ("a", "b", "a", "b"):
+            r = eng.submit(prompt.copy(), max_new_tokens=5, adapter=name)
+            eng.drain()
+            outs.setdefault(name, []).append(r.out_tokens)
+        assert mod._EVICTIONS.total() - e0 >= 3
+        for name, runs in outs.items():
+            assert runs[0] == runs[1], (name, "restage changed tokens")
+        assert outs["a"][0] != outs["b"][0]
+
+    def test_sampling_composes_with_adapters(self, dense_setup):
+        from uccl_tpu.models.inference import generate
+
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(2)
+        store = _store_for(cfg, max_rank=2, capacity=2)
+        tree = _lora_for(cfg, 2, seed=5)
+        store.publish("acme", tree)
+        eng = ServingEngine(backend, adapters=store)
+        sp = SamplingParams(temperature=0.9, seed=41)
+        r = eng.submit(_prompt(rng, 6), max_new_tokens=6, adapter="acme",
+                       sampling=sp)
+        eng.drain()
+        toks = generate(materialize(params, tree),
+                        jnp.asarray(r.prompt)[None], cfg,
+                        max_new_tokens=r.max_new_tokens, max_seq=MAX_SEQ,
+                        sampling=sp)
+        assert r.out_tokens == np.asarray(toks)[0, : r.n_generated].tolist()
+
+    def test_submit_unknown_adapter_rejected(self, dense_setup):
+        cfg, params, backend = dense_setup
+        store = _store_for(cfg, max_rank=2, capacity=2)
+        eng = ServingEngine(backend, adapters=store)
+        with pytest.raises(ValueError):
+            eng.submit(np.zeros(4, np.int32), max_new_tokens=2,
+                       adapter="ghost")
+        eng2 = ServingEngine(backend)
+        with pytest.raises(ValueError):
+            eng2.submit(np.zeros(4, np.int32), max_new_tokens=2,
+                        adapter="acme")  # no store configured
+
+
+class TestPrefixCacheTenancy:
+    def _engine(self, backend, store=None):
+        return ServingEngine(backend, prefill_chunk=4,
+                             prefix_cache=PrefixCache(4), adapters=store,
+                             tenant_fair=True)
+
+    def test_cross_tenant_hit_attempt_is_a_miss(self, dense_setup):
+        """Trie keys are namespaced by tenant: tenant B re-sending tenant
+        A's exact prompt must NOT reuse A's parked KV (cross-tenant KV
+        bleed), while A's own re-send hits."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(0)
+        prompt = _prompt(rng, 8)
+        eng = self._engine(backend)
+        r0 = eng.submit(prompt.copy(), max_new_tokens=4, tenant="acme")
+        eng.drain()
+        assert r0.cache_hit_len == 0
+        r1 = eng.submit(prompt.copy(), max_new_tokens=4, tenant="acme")
+        eng.drain()
+        assert r1.cache_hit_len > 0  # same tenant: real reuse
+        r2 = eng.submit(prompt.copy(), max_new_tokens=4, tenant="beta")
+        eng.drain()
+        assert r2.cache_hit_len == 0, "cross-tenant prefix reuse"
+        assert r2.out_tokens == r1.out_tokens == r0.out_tokens
+        assert eng.pool.leaked() == 0
+
+    def test_adapter_version_bump_invalidates_prefix(self, dense_setup):
+        """The namespace includes the adapter VERSION: a wv delta lands in
+        the V cache, so KV parked under v1 is wrong for v2 — a republish
+        must turn the next same-prompt request into a miss."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(1)
+        store = _store_for(cfg, max_rank=2, capacity=2)
+        store.publish("acme", _lora_for(cfg, 2, seed=6))
+        eng = self._engine(backend, store)
+        prompt = _prompt(rng, 8)
+        eng.submit(prompt.copy(), max_new_tokens=4, tenant="t",
+                   adapter="acme")
+        eng.drain()
+        warm = eng.submit(prompt.copy(), max_new_tokens=4, tenant="t",
+                          adapter="acme")
+        eng.drain()
+        assert warm.cache_hit_len > 0
+        store.publish("acme", _lora_for(cfg, 2, seed=7))  # v2
+        stale = eng.submit(prompt.copy(), max_new_tokens=4, tenant="t",
+                           adapter="acme")
+        eng.drain()
+        assert stale.cache_hit_len == 0, "stale adapter-version KV reuse"
+        assert eng.pool.leaked() == 0
+
+
+class TestTenantMetrics:
+    def test_per_tenant_series_and_counters(self, dense_setup):
+        from uccl_tpu.serving import engine as eng_mod
+
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(0)
+        before = {
+            t: v for t, v in (
+                (s.get("tenant"), v)
+                for s, v in eng_mod._TENANT_REQS.samples()
+            )
+        }
+        eng = ServingEngine(backend, tenant_fair=True)
+        for t in ("acme", "beta", "acme"):
+            eng.submit(_prompt(rng, 4), max_new_tokens=3, tenant=t)
+        eng.drain()
+        snap = eng.metrics.snapshot()
+        per = snap["per_tenant"]
+        assert set(per) == {"acme", "beta"}
+        assert per["acme"]["completed"] == 2
+        assert per["beta"]["output_tokens"] == 3
+        after = {
+            t: v for t, v in (
+                (s.get("tenant"), v)
+                for s, v in eng_mod._TENANT_REQS.samples()
+            )
+        }
+        assert after.get("acme", 0) - before.get("acme", 0) == 2
+        assert after.get("beta", 0) - before.get("beta", 0) == 1
+        lines = "\n".join(
+            eng.metrics.prometheus_lines(snap, prefix="uccl_serving")
+        )
+        assert 'uccl_serving_tenant_completed{tenant="acme"' in lines
+
+
+@pytest.fixture(scope="module")
+def moe_setup(devices):
+    """ONE 2-shard server/backend + ONE world-1 oracle server (the
+    test_serving rule: shard_map compiles are the expensive kind)."""
+    from jax.sharding import Mesh
+
+    from uccl_tpu.models.moe_inference import (
+        MoEServeConfig, MoEServer, init_params,
+    )
+
+    cfg = MoEServeConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=8, moe_experts=8, moe_topk=2, moe_ffn=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    srv = MoEServer(cfg, Mesh(np.array(devices[:2]), ("dp",)))
+    backend = MoEBackend(
+        srv, srv.shard_params(params), batch_local=1, max_seq=MAX_SEQ,
+    )
+    srv1 = MoEServer(cfg, Mesh(np.array(devices[:1]), ("dp",)))
+    return cfg, params, backend, srv1
+
+
+@pytest.mark.slow
+class TestMoETenancy:
+    def _oracle(self, srv1, placed1, req):
+        toks = srv1.generate(placed1, jnp.asarray(req.prompt)[None, None],
+                             req.max_new_tokens, MAX_SEQ, impl="ll",
+                             sampling=req.sampling)
+        return np.asarray(toks)[0, 0, : req.n_generated].tolist()
+
+    def test_sampled_spec_bit_identity(self, moe_setup):
+        cfg, params, backend, srv1 = moe_setup
+        placed1 = srv1.shard_params(params)
+        rng = np.random.default_rng(0)
+        eng = ServingEngine(backend, spec_k=2, tenant_fair=True)
+        sp = [SamplingParams(temperature=0.8, seed=51),
+              None,
+              SamplingParams(temperature=1.1, top_p=0.9, top_k=7,
+                             seed=52)]
+        reqs = [eng.submit(_prompt(rng, 5), max_new_tokens=5,
+                           sampling=sp[0], tenant="acme")]
+        eng.step()
+        reqs.append(eng.submit(_prompt(rng, 3), max_new_tokens=4,
+                               sampling=sp[1], tenant="beta"))
+        reqs.append(eng.submit(_prompt(rng, 6), max_new_tokens=5,
+                               sampling=sp[2], tenant="acme"))
+        eng.drain()
+        assert eng.pool.leaked() == 0
+        for r in reqs:
+            assert r.out_tokens == self._oracle(srv1, placed1, r), r.rid
+        assert set(eng.metrics.snapshot()["per_tenant"]) == {"acme",
+                                                             "beta"}
+
+    def test_fused_lora_vs_materialized(self, moe_setup):
+        cfg, params, backend, srv1 = moe_setup
+        rng = np.random.default_rng(1)
+        store = _store_for(cfg, max_rank=4, capacity=2)
+        trees = {"acme": _lora_for(cfg, 2, seed=8),
+                 "beta": _lora_for(cfg, 4, seed=9)}
+        for t, tree in trees.items():
+            store.publish(t, tree)
+        eng = ServingEngine(backend, adapters=store)
+        prompt = _prompt(rng, 6)
+        ra = eng.submit(prompt.copy(), max_new_tokens=5, adapter="acme")
+        rb = eng.submit(prompt.copy(), max_new_tokens=5, adapter="beta")
+        eng.drain()
+        rn = eng.submit(prompt.copy(), max_new_tokens=5)
+        eng.drain()
+        assert eng.pool.leaked() == 0
+        placed = {t: srv1.shard_params(materialize(params, tree))
+                  for t, tree in trees.items()}
+        placed[None] = srv1.shard_params(params)
+
+        def want(req):
+            toks = srv1.generate(placed[req.adapter],
+                                 jnp.asarray(req.prompt)[None, None],
+                                 req.max_new_tokens, MAX_SEQ, impl="ll")
+            return np.asarray(toks)[0, 0, : req.n_generated].tolist()
+
+        wa, wb, wn = want(ra), want(rb), want(rn)
+        assert ra.out_tokens == wa and rb.out_tokens == wb
+        assert rn.out_tokens == wn
+        assert len({tuple(wa), tuple(wb), tuple(wn)}) >= 2, (
+            "adapters failed to change the MoE argmax — raise the scale"
+        )

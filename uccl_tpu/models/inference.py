@@ -20,6 +20,9 @@ from jax import lax
 
 from uccl_tpu.models.dense import DenseConfig
 from uccl_tpu.models.layers import rms_norm, rope
+from uccl_tpu.models.sampling import (
+    broadcast_params, sample_tokens, sample_window,
+)
 from uccl_tpu.utils.lru import LRUFnCache
 
 
@@ -262,8 +265,22 @@ def _slot_row_copy(k, v, lengths, dst, src, n):
     return k, v, lengths.at[dst].set(n)
 
 
+def _lora_delta(h, table, ids, layer):
+    """Batched per-slot fused LoRA delta (ISSUE 18): gather each slot's
+    rank-padded (A, B) pair from the stacked tables by adapter row id and
+    add ``(h @ A) @ B`` beside the base matmul. ``table``: (A [L, T, H,
+    R_max], B [L, T, R_max, out]); ``ids``: [B] int32 — row 0 is all
+    zeros, so adapter-free slots compute an exact-0.0 delta (the zero-rank
+    fast path sharing one compiled program with mixed-rank neighbors)."""
+    a, bb = table
+    al = a[layer][ids].astype(h.dtype)   # [B, H, R_max]
+    bl = bb[layer][ids].astype(h.dtype)  # [B, R_max, out]
+    return jnp.einsum("bsr,bro->bso", jnp.einsum("bsh,bhr->bsr", h, al), bl)
+
+
 def _forward_slots(
-    params, tokens, cache: SlotKVCache, start, write_mask, cfg, ffn=None
+    params, tokens, cache: SlotKVCache, start, write_mask, cfg, ffn=None,
+    adapters=None, adapter_ids=None,
 ) -> Tuple[jax.Array, SlotKVCache]:
     """Masked batched forward: tokens [B, S] at positions [start_b, start_b+S).
 
@@ -273,6 +290,11 @@ def _forward_slots(
     an idle slot's dummy token. Lengths are NOT advanced here; the callers
     own the per-slot length bookkeeping. ``ffn`` is the same dense-block
     override hook as :func:`_forward_cached` (the MoE serving loop uses it).
+
+    ``adapters`` = ``{"wq": (A, B), "wv": (A, B)}`` stacked LoRA tables +
+    ``adapter_ids`` [B] fuse a per-slot low-rank delta onto the query and
+    value projections (:func:`_lora_delta`); None leaves the base program
+    byte-identical to the pre-adapter form.
     """
     b, s = tokens.shape
     smax = cache.k.shape[2]
@@ -287,9 +309,14 @@ def _forward_slots(
         lp = jax.tree.map(lambda a: a[i], params["blocks"])
         h = rms_norm(x, lp["ln1"], cfg.norm_eps)
         d = cfg.head_dim
-        q = (h @ lp["wq"].astype(h.dtype)).reshape(b, s, cfg.n_heads, d)
+        q2 = h @ lp["wq"].astype(h.dtype)
+        v2 = h @ lp["wv"].astype(h.dtype)
+        if adapters is not None:
+            q2 = q2 + _lora_delta(h, adapters["wq"], adapter_ids, i)
+            v2 = v2 + _lora_delta(h, adapters["wv"], adapter_ids, i)
+        q = q2.reshape(b, s, cfg.n_heads, d)
         kk = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
-        v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, d)
+        v = v2.reshape(b, s, cfg.n_kv_heads, d)
         q = rope(q, positions, cfg.rope_theta)
         kk = rope(kk, positions, cfg.rope_theta)
         k_cache = cache.k[i].at[bidx, pos_write].set(kk, mode="drop")
@@ -315,7 +342,8 @@ def _forward_slots(
 
 def prefill_slots(
     params, tokens, prompt_lens, new_mask, cache: SlotKVCache,
-    cfg: DenseConfig, start=None,
+    cfg: DenseConfig, start=None, sampling=None, adapters=None,
+    adapter_ids=None,
 ) -> Tuple[jax.Array, SlotKVCache]:
     """Masked batched prefill of newly admitted slots — resumable.
 
@@ -332,15 +360,20 @@ def prefill_slots(
     overwrites position L before any read of L). Garbage beyond a
     non-dividing final chunk's prompt end is dead the same way.
 
-    Returns (greedy token [B_slots] — meaningful only for rows whose window
+    Returns (next token [B_slots] — meaningful only for rows whose window
     reaches the prompt end, i.e. start + S >= prompt_lens; callers ignore
     the rest — and cache with lengths set to min(start+S, prompt_lens) on
-    admitted slots).
+    admitted slots). The token is the greedy argmax, or — with
+    ``sampling`` = per-slot ``(seeds, pos0, temp, top_p, top_k)`` arrays —
+    the lockstep-keyed sample at output position ``pos0`` (the engine
+    passes zeros: the first token is output index 0; ``temp <= 0`` rows
+    stay greedy).
     """
     if start is None:
         start = jnp.zeros_like(prompt_lens)
     logits, cache = _forward_slots(
-        params, tokens, cache, start, new_mask, cfg
+        params, tokens, cache, start, new_mask, cfg,
+        adapters=adapters, adapter_ids=adapter_ids,
     )
     # each slot's last valid prompt position WITHIN this window; clipped so
     # mid-prefill rows (prompt end beyond the window) gather in-bounds —
@@ -350,7 +383,11 @@ def prefill_slots(
     last = jnp.take_along_axis(
         logits, last_idx[:, None, None], axis=1
     )[:, 0]  # [B, V]
-    tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    if sampling is None:
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    else:
+        seeds, pos0, temp, top_p, top_k = sampling
+        tok = sample_tokens(seeds, pos0, last, temp, top_p, top_k)
     lengths = jnp.where(
         new_mask, jnp.minimum(start + s, prompt_lens), cache.lengths
     )
@@ -358,7 +395,8 @@ def prefill_slots(
 
 
 def verify_slots(
-    params, tokens, active, cache: SlotKVCache, cfg: DenseConfig
+    params, tokens, active, cache: SlotKVCache, cfg: DenseConfig,
+    sampling=None, adapters=None, adapter_ids=None,
 ) -> Tuple[jax.Array, jax.Array, SlotKVCache]:
     """Batched draft verification — the speculative-decoding primitive,
     generalizing :func:`decode_step_slots` from one token to a window.
@@ -381,12 +419,29 @@ def verify_slots(
     it, and attention never reads past its own query position. Rollback is
     the cursor, never a cache scrub.
 
-    Returns (greedy tokens [B_slots, S], n_accepted [B_slots], cache').
+    With ``sampling`` = per-slot ``(seeds, pos0, temp, top_p, top_k)``
+    arrays, window column ``j`` is SAMPLED under the lockstep key for
+    output position ``pos0 + j`` instead of argmaxed, and the same
+    acceptance rule against the sampled targets IS proper rejection
+    sampling for this engine's deterministic drafters: the proposal q is a
+    point mass at the draft token d, so the accept probability
+    min(1, p(d)/q(d)) = p(d) — exactly the probability the lockstep
+    sample t_j equals d — and conditional on rejection the already-drawn
+    t_j is distributed as the residual. Committing ``tok`` rows is
+    therefore bit-identical to vanilla sampled decode at equal seeds
+    (docs/SERVING.md spells out the math).
+
+    Returns (target tokens [B_slots, S], n_accepted [B_slots], cache').
     """
     logits, out = _forward_slots(
-        params, tokens, cache, cache.lengths, active, cfg
+        params, tokens, cache, cache.lengths, active, cfg,
+        adapters=adapters, adapter_ids=adapter_ids,
     )
-    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+    if sampling is None:
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+    else:
+        seeds, pos0, temp, top_p, top_k = sampling
+        tok = sample_window(seeds, pos0, logits, temp, top_p, top_k)
     n_acc = greedy_acceptance(tokens, tok)
     lengths = spec_advance(cache.lengths, active, n_acc)
     return tok, n_acc, SlotKVCache(out.k, out.v, lengths)
@@ -411,17 +466,21 @@ def spec_advance(lengths, active, n_acc):
 
 
 def decode_step_slots(
-    params, token, active, cache: SlotKVCache, cfg: DenseConfig
+    params, token, active, cache: SlotKVCache, cfg: DenseConfig,
+    sampling=None, adapters=None, adapter_ids=None,
 ) -> Tuple[jax.Array, SlotKVCache]:
     """One masked autoregressive step over the slot pool — the S=1 case of
     :func:`verify_slots` (no draft: nothing to accept, advance by one).
 
     token: [B_slots] (inactive slots feed a dummy); active: [B_slots] bool.
     Active slots write their new KV at their own length and advance by one;
-    inactive slots neither write nor advance. Returns (next greedy token
-    [B_slots], cache').
+    inactive slots neither write nor advance. Returns (next greedy-or-
+    sampled token [B_slots], cache'); ``sampling``'s ``pos0`` is each
+    slot's output index for the token this step emits.
     """
-    tok, _, cache = verify_slots(params, token[:, None], active, cache, cfg)
+    tok, _, cache = verify_slots(params, token[:, None], active, cache, cfg,
+                                 sampling=sampling, adapters=adapters,
+                                 adapter_ids=adapter_ids)
     return tok[:, 0], cache
 
 
@@ -438,37 +497,78 @@ def generate(
     *,
     max_new_tokens: int = 32,
     max_seq: int = 256,
+    sampling=None,
 ) -> jax.Array:
-    """Greedy generation. prompt: [B, S] → [B, max_new_tokens].
+    """Greedy (or, with ``sampling``, stochastic) generation.
+    prompt: [B, S] → [B, max_new_tokens].
 
     One jitted program (prefill + a decode ``lax.scan``), cached per
     (cfg, shapes, N): params enter as jit ARGUMENTS, so repeat calls at
     the same shapes are pure cache hits. The old form ran the scan
     eagerly — params were baked into the staged scan as constants, every
     call re-traced, and the constants could exceed a remote-compile
-    request limit (PERF.md round-5 tunnel lessons)."""
+    request limit (PERF.md round-5 tunnel lessons).
+
+    ``sampling`` duck-types :class:`~uccl_tpu.serving.sampling.
+    SamplingParams` (seed / temperature / top_p / top_k). The scalars
+    enter as TRACED jit arguments — one compiled sampled program serves
+    every parameter value — and every batch row runs under the request's
+    seed with lockstep keys per output index, making this the vanilla
+    sampled oracle the serving engine is bit-identical to. ``sampling is
+    None`` keeps the greedy program byte-identical to before."""
     if prompt.shape[1] + max_new_tokens > max_seq:
         raise ValueError(
             f"prompt {prompt.shape[1]} + new {max_new_tokens} tokens exceed "
             f"max_seq {max_seq}: the cache would overflow"
         )
-    key = (repr(cfg), prompt.shape, max_new_tokens, max_seq)
+    key = (repr(cfg), prompt.shape, max_new_tokens, max_seq,
+           sampling is not None)
 
     def build():
-        def run(p, t):
+        if sampling is None:
+            def run(p, t):
+                logits, cache = prefill(p, t, cfg, max_seq)
+
+                def body(carry, _):
+                    logits, cache = carry
+                    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    logits, cache = decode_step(p, tok, cache, cfg)
+                    return (logits, cache), tok
+
+                (_, _), toks = lax.scan(
+                    body, (logits, cache), None, length=max_new_tokens
+                )
+                return toks.T  # [B, T]
+
+            return jax.jit(run)
+
+        def run(p, t, seed, temp, top_p, top_k):
+            b = t.shape[0]
+            seeds, temps, tps, tks = broadcast_params(
+                b, seed, temp, top_p, top_k
+            )
             logits, cache = prefill(p, t, cfg, max_seq)
 
-            def body(carry, _):
+            def body(carry, i):
                 logits, cache = carry
-                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # scan step i emits output index i: the lockstep key is a
+                # pure function of (seed, i), matching the engine exactly
+                tok = sample_tokens(seeds, jnp.full((b,), i, jnp.int32),
+                                    logits, temps, tps, tks)
                 logits, cache = decode_step(p, tok, cache, cfg)
                 return (logits, cache), tok
 
             (_, _), toks = lax.scan(
-                body, (logits, cache), None, length=max_new_tokens
+                body, (logits, cache),
+                jnp.arange(max_new_tokens, dtype=jnp.int32),
             )
             return toks.T  # [B, T]
 
         return jax.jit(run)
 
-    return _GEN_CACHE.get(key, build)(params, prompt)
+    fn = _GEN_CACHE.get(key, build)
+    if sampling is None:
+        return fn(params, prompt)
+    return fn(params, prompt, jnp.int32(int(sampling.seed)),
+              jnp.float32(sampling.temperature),
+              jnp.float32(sampling.top_p), jnp.int32(sampling.top_k))

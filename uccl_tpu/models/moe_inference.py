@@ -40,6 +40,9 @@ from uccl_tpu.models.inference import (
     KVCache, SlotKVCache, _forward_cached, _forward_slots,
     greedy_acceptance, spec_advance,
 )
+from uccl_tpu.models.sampling import (
+    broadcast_params, sample_tokens, sample_window,
+)
 from uccl_tpu.utils.lru import LRUFnCache
 
 _AXIS = "dp"  # the EP/serving axis of the mesh
@@ -225,18 +228,22 @@ def _forward_shard(params, tokens, k_cache, v_cache, length,
 
 
 def _forward_shard_slots(params, tokens, k_cache, v_cache, lengths, start,
-                         write_mask, cfg: MoEServeConfig, impl: str):
+                         write_mask, cfg: MoEServeConfig, impl: str,
+                         adapters=None, adapter_ids=None):
     """Per-shard masked slot forward (the continuous-batching primitive):
     the dense slot-pool loop (inference._forward_slots — per-slot positions,
     write-gated KV, per-slot attention masks) with the EP MoE FFN. Idle
     slots' dummy tokens do route through the experts — harmless: expert
     GEMM rows are independent and the ample serving capacity_factor keeps
     the wire drop-free, so active rows are bit-identical to a batch
-    without the dummies."""
+    without the dummies. ``adapters``/``adapter_ids`` are the per-slot
+    fused LoRA tables (inference._lora_delta) — the attention projections
+    are dense-stack code, so the ONE fusion point serves both stacks."""
     cache = SlotKVCache(k_cache, v_cache, lengths)
     logits, cache = _forward_slots(
         params, tokens, cache, start, write_mask, cfg,
         ffn=_moe_block(cfg, impl),
+        adapters=adapters, adapter_ids=adapter_ids,
     )
     return logits, cache.k, cache.v
 
@@ -407,8 +414,42 @@ class MoEServer:
         self._check_drop_free()
         return MoESlotCache.empty(self.cfg, self.world, batch_local, max_seq)
 
+    @staticmethod
+    def _extra_args(sampling, adapters, adapter_ids):
+        """Flatten the optional sampled/adapted arguments into the flat
+        P(dp)-sharded arg list ``_shard_mapped`` expects: 5 gridded
+        [W, B_loc] sampling arrays, then 4 broadcast [W, ...] adapter
+        tables + gridded adapter ids. The caller grids/broadcasts; the
+        shard fns strip the leading shard dim."""
+        extra = []
+        if sampling is not None:
+            extra.extend(sampling)
+        if adapters is not None:
+            extra.extend([adapters["wq"][0], adapters["wq"][1],
+                          adapters["wv"][0], adapters["wv"][1],
+                          adapter_ids])
+        return extra
+
+    @staticmethod
+    def _split_extra(rest, sampled: bool, adapted: bool):
+        """Inverse of :meth:`_extra_args` inside a shard fn (leading shard
+        dim stripped): returns (sampling tuple | None, adapters | None,
+        adapter_ids | None)."""
+        rest = list(rest)
+        samp = None
+        if sampled:
+            samp = tuple(r[0] for r in rest[:5])
+            rest = rest[5:]
+        adp = ids = None
+        if adapted:
+            adp = {"wq": (rest[0][0], rest[1][0]),
+                   "wv": (rest[2][0], rest[3][0])}
+            ids = rest[4][0]
+        return samp, adp, ids
+
     def prefill_slots(self, params, tokens, prompt_lens, new_mask,
-                      cache: MoESlotCache, start=None):
+                      cache: MoESlotCache, start=None, sampling=None,
+                      adapters=None, adapter_ids=None):
         """Masked batched prefill of newly admitted slots (sorted EP path)
         — resumable, mirroring :func:`inference.prefill_slots`.
 
@@ -422,36 +463,54 @@ class MoEServer:
         ``new_mask`` keep their KV rows and lengths — mid-decode neighbors
         are untouched. Returns (greedy token [W, B_loc] — meaningful only
         for rows whose window reaches the prompt end — and cache with
-        lengths set to min(start+S, prompt_lens) on admitted slots)."""
+        lengths set to min(start+S, prompt_lens) on admitted slots).
+
+        ``sampling``: per-slot gridded [W, B_loc] ``(seeds, pos0, temp,
+        top_p, top_k)`` arrays — the window-end token is then the
+        lockstep-keyed sample instead of the argmax (mirrors
+        :func:`inference.prefill_slots`). ``adapters``/``adapter_ids``
+        fuse the per-slot LoRA delta (tables broadcast [W, ...],
+        ids gridded [W, B_loc])."""
         self._check_drop_free()
         cfg = self.cfg
         s = tokens.shape[-1]
         if start is None:
             start = jnp.zeros_like(prompt_lens)
+        sampled, adapted = sampling is not None, adapters is not None
+        extra = self._extra_args(sampling, adapters, adapter_ids)
 
-        def f(p, tok, lens, mask, off, kc, vc, ln):
+        def f(p, tok, lens, mask, off, kc, vc, ln, *rest):
+            samp, adp, ids = self._split_extra(rest, sampled, adapted)
             logits, nk, nv = _forward_shard_slots(
                 _strip_shard(p), tok[0], kc[0], vc[0], ln[0],
                 off[0], mask[0], cfg, "sort",
+                adapters=adp, adapter_ids=ids,
             )
             last_idx = jnp.clip(lens[0] - 1 - off[0], 0, s - 1)
             last = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1
             )[:, 0]
-            t = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            if samp is None:
+                t = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            else:
+                seeds, pos0, temp, top_p, top_k = samp
+                t = sample_tokens(seeds, pos0, last, temp, top_p, top_k)
             nlen = jnp.where(
                 mask[0], jnp.minimum(off[0] + s, lens[0]), ln[0]
             )
             return t[None], nk[None], nv[None], nlen[None]
 
-        key = ("prefill_slots", tokens.shape, cache.k.shape)
-        fn = self._fn(key, lambda: self._shard_mapped(f, 7, 4))
+        key = ("prefill_slots", tokens.shape, cache.k.shape,
+               sampled, adapted)
+        fn = self._fn(key, lambda: self._shard_mapped(f, 7 + len(extra), 4))
         tok, nk, nv, nlen = fn(params, tokens, prompt_lens, new_mask,
-                               start, cache.k, cache.v, cache.lengths)
+                               start, cache.k, cache.v, cache.lengths,
+                               *extra)
         return tok, MoESlotCache(nk, nv, nlen)
 
     def verify_slots(self, params, tokens, active, cache: MoESlotCache,
-                     impl: str = "sort"):
+                     impl: str = "sort", sampling=None, adapters=None,
+                     adapter_ids=None):
         """Batched draft verification over the slot pool — the speculative-
         decoding primitive, generalizing :meth:`decode_step_slots` from one
         token to a window (mirrors :func:`inference.verify_slots`).
@@ -465,40 +524,66 @@ class MoEServer:
         before attending). Routes through the sorted EP path by default —
         the multi-token regime, like prefill; the drop-free capacity check
         keeps every routing exact regardless of window width. Returns
-        (greedy tokens [W, B_loc, S], n_accepted [W, B_loc], cache')."""
+        (target tokens [W, B_loc, S], n_accepted [W, B_loc], cache').
+
+        With ``sampling`` (gridded [W, B_loc] per-slot arrays), window
+        column j is sampled under the lockstep key for output position
+        ``pos0 + j`` — the same acceptance rule against sampled targets
+        is exact rejection sampling for deterministic drafters
+        (:func:`inference.verify_slots`, docs/SERVING.md)."""
         self._check_drop_free()
         cfg = self.cfg
+        sampled, adapted = sampling is not None, adapters is not None
+        extra = self._extra_args(sampling, adapters, adapter_ids)
 
-        def f(p, tok, mask, kc, vc, ln):
+        def f(p, tok, mask, kc, vc, ln, *rest):
+            samp, adp, ids = self._split_extra(rest, sampled, adapted)
             logits, nk, nv = _forward_shard_slots(
                 _strip_shard(p), tok[0], kc[0], vc[0], ln[0],
                 ln[0], mask[0], cfg, impl,
+                adapters=adp, adapter_ids=ids,
             )
-            t = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B_loc, S]
+            if samp is None:
+                t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                seeds, pos0, temp, top_p, top_k = samp
+                t = sample_window(seeds, pos0, logits, temp, top_p, top_k)
             n_acc = greedy_acceptance(tok[0], t)
             nlen = spec_advance(ln[0], mask[0], n_acc)
             return t[None], n_acc[None], nk[None], nv[None], nlen[None]
 
-        key = ("verify_slots", impl, tokens.shape, cache.k.shape)
-        fn = self._fn(key, lambda: self._shard_mapped(f, 5, 5))
+        key = ("verify_slots", impl, tokens.shape, cache.k.shape,
+               sampled, adapted)
+        fn = self._fn(key, lambda: self._shard_mapped(f, 5 + len(extra), 5))
         tok, n_acc, nk, nv, nlen = fn(params, tokens, active,
-                                      cache.k, cache.v, cache.lengths)
+                                      cache.k, cache.v, cache.lengths,
+                                      *extra)
         return tok, n_acc, MoESlotCache(nk, nv, nlen)
 
     def decode_step_slots(self, params, token, active, cache: MoESlotCache,
-                          impl: str = "ll"):
+                          impl: str = "ll", sampling=None, adapters=None,
+                          adapter_ids=None):
         """One masked autoregressive step over the slot pool (packed LL EP
         path by default) — the S=1 case of :meth:`verify_slots`.
         token/active: [W, B_loc]; inactive slots neither write KV nor
-        advance their length. Returns (next greedy token [W, B_loc],
-        cache')."""
+        advance their length. Returns (next greedy-or-sampled token
+        [W, B_loc], cache')."""
         tok, _, cache = self.verify_slots(params, token[..., None], active,
-                                          cache, impl=impl)
+                                          cache, impl=impl,
+                                          sampling=sampling,
+                                          adapters=adapters,
+                                          adapter_ids=adapter_ids)
         return tok[..., 0], cache
 
     def generate(self, params, prompt, new_tokens: int, max_seq: int,
-                 impl: str = "ll"):
-        """Greedy decode. prompt: [W, B_loc, S] → tokens [W, B_loc, N].
+                 impl: str = "ll", sampling=None):
+        """Greedy (or, with ``sampling``, stochastic) decode.
+        prompt: [W, B_loc, S] → tokens [W, B_loc, N].
+
+        ``sampling`` duck-types SamplingParams: every grid row runs under
+        the request's seed with lockstep keys per output index, and the
+        scalars enter as traced jit arguments — the sampled one-shot
+        oracle of the MoE serving stack (mirrors ``inference.generate``).
 
         The decode loop is ONE jitted ``lax.scan`` over ``new_tokens``
         (cached per (impl, N, shapes) like every other program here), not
@@ -516,25 +601,68 @@ class MoEServer:
                 f"exceed max_seq {max_seq}: the cache would overflow"
             )
         logits, cache = self.prefill(params, prompt, max_seq)
-        tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        key = ("gen", impl, new_tokens, tok0.shape, cache.k.shape)
+        if sampling is None:
+            tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            key = ("gen", impl, new_tokens, tok0.shape, cache.k.shape)
+
+            def build():
+                def gen(p, tok, kc, vc, ln):
+                    def body(carry, _):
+                        tok, kc, vc, ln = carry
+                        lg, c2 = self._forward(
+                            p, tok[..., None], MoEKVCache(kc, vc, ln), impl
+                        )
+                        ntok = jnp.argmax(lg[:, :, 0],
+                                          axis=-1).astype(jnp.int32)
+                        return (ntok, c2.k, c2.v, c2.length), tok
+
+                    _, toks = lax.scan(
+                        body, (tok, kc, vc, ln), None, length=new_tokens
+                    )
+                    return jnp.moveaxis(toks, 0, -1)  # [W, B_loc, N]
+
+                return jax.jit(gen)
+
+            fn = self._fn(key, build)
+            return fn(params, tok0, cache.k, cache.v, cache.length)
+
+        key = ("gen_sampled", impl, new_tokens, logits.shape, cache.k.shape)
 
         def build():
-            def gen(p, tok, kc, vc, ln):
-                def body(carry, _):
+            def gen(p, lg0, kc, vc, ln, seed, temp, top_p, top_k):
+                w, b, v = lg0.shape
+                seeds, temps, tps, tks = broadcast_params(
+                    w * b, seed, temp, top_p, top_k
+                )
+
+                def samp(lg, pos):
+                    t = sample_tokens(
+                        seeds, jnp.full((w * b,), pos, jnp.int32),
+                        lg.reshape(w * b, v), temps, tps, tks,
+                    )
+                    return t.reshape(w, b)
+
+                tok0 = samp(lg0, jnp.int32(0))
+
+                def body(carry, i):
                     tok, kc, vc, ln = carry
                     lg, c2 = self._forward(
                         p, tok[..., None], MoEKVCache(kc, vc, ln), impl
                     )
-                    ntok = jnp.argmax(lg[:, :, 0], axis=-1).astype(jnp.int32)
+                    # scan step i emits output index i and samples i+1
+                    ntok = samp(lg[:, :, 0], i + 1)
                     return (ntok, c2.k, c2.v, c2.length), tok
 
                 _, toks = lax.scan(
-                    body, (tok, kc, vc, ln), None, length=new_tokens
+                    body, (tok0, kc, vc, ln),
+                    jnp.arange(new_tokens, dtype=jnp.int32),
                 )
                 return jnp.moveaxis(toks, 0, -1)  # [W, B_loc, N]
 
             return jax.jit(gen)
 
         fn = self._fn(key, build)
-        return fn(params, tok0, cache.k, cache.v, cache.length)
+        return fn(params, logits, cache.k, cache.v, cache.length,
+                  jnp.int32(int(sampling.seed)),
+                  jnp.float32(sampling.temperature),
+                  jnp.float32(sampling.top_p), jnp.int32(sampling.top_k))

@@ -1,0 +1,101 @@
+"""Stochastic token sampling shared by both serving stacks (ISSUE 18).
+
+ONE sampling definition, used by the slot primitives (``prefill_slots`` /
+``decode_step_slots`` / ``verify_slots`` on the dense AND MoE stacks) and
+by the one-shot ``generate`` oracles. The engine's sampled-exactness
+contract — at equal seeds, engine output is bit-identical to the vanilla
+sampled oracle — rests on this module the same way greedy exactness rests
+on ``jnp.argmax``: both paths call literally the same function on
+bit-identical logits rows, and every per-row computation is independent
+(vmapped), so batch composition cannot change a row's sample.
+
+**Counter-based lockstep keys.** The PRNG key for a request's output
+position ``i`` is ``fold_in(PRNGKey(seed), i)`` — a pure function of
+(seed, output index), independent of HOW the engine reached that index.
+Chunked prefill, slot reuse, preemption/resume, and speculative verify
+windows all derive the identical key for the identical position, which is
+what makes spec_k>0 commits same-seed EXACT (not merely distribution-
+identical) against spec_k=0: see ``docs/SERVING.md``.
+
+**Per-row temperature 0 means greedy.** ``temp <= 0`` rows return the
+argmax, so one compiled sampled program serves mixed greedy/sampled
+batches with no extra mask array, and the scalar-default row is plain
+greedy decode.
+
+Masking order is top-k then top-p (nucleus over the k-survivors), the
+common serving convention. Ties at the k-th logit all survive (the rule is
+``z >= kth``, deterministic); nucleus keeps every token whose preceding
+cumulative mass is < top_p, so the most probable token always survives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_key(seed, pos):
+    """The lockstep key for output position ``pos`` of a request seeded
+    ``seed`` — both arguments may be traced (works under jit and vmap)."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+
+
+def _sample_one(seed, pos, logits, temp, top_p, top_k):
+    """Sample one token from one row. All scalars traced; logits [V].
+
+    temp <= 0 → greedy argmax (exact, no key consumed in the result);
+    otherwise temperature-scale, top-k mask, top-p nucleus mask, then
+    ``jax.random.categorical`` under the position's lockstep key.
+    """
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    z = logits.astype(jnp.float32) / jnp.maximum(temp, jnp.float32(1e-6))
+    # top-k: keep the k highest logits (k <= 0 or k >= V disables)
+    sorted_desc = jnp.sort(z)[::-1]
+    k_eff = jnp.where((top_k <= 0) | (top_k >= v), v, top_k)
+    kth = sorted_desc[jnp.clip(k_eff - 1, 0, v - 1)]
+    z = jnp.where(z >= kth, z, -jnp.inf)
+    # top-p: nucleus over the k-survivors; a token stays if the cumulative
+    # mass strictly before it is < top_p (the head token always stays)
+    probs = jax.nn.softmax(z)
+    order = jnp.argsort(-probs)
+    sp = probs[order]
+    keep_sorted = (jnp.cumsum(sp) - sp) < top_p
+    keep = jnp.zeros((v,), bool).at[order].set(keep_sorted)
+    z = jnp.where(keep, z, -jnp.inf)
+    sampled = jax.random.categorical(fold_key(seed, pos), z).astype(jnp.int32)
+    return jnp.where(temp > jnp.float32(0.0), sampled, greedy)
+
+
+# batched row sampling: seeds/pos/temp/top_p/top_k [B], logits [B, V] → [B]
+sample_tokens = jax.vmap(_sample_one, in_axes=(0, 0, 0, 0, 0, 0))
+
+
+def sample_window(seeds, pos0, logits, temp, top_p, top_k):
+    """Sample a verify/prefill window: logits [B, S, V] → tokens [B, S].
+
+    Window column ``j`` of row ``b`` uses the lockstep key for output
+    position ``pos0[b] + j`` — the verify window's samples are EXACTLY the
+    tokens vanilla decode would draw at those positions, which is what
+    turns greedy-prefix acceptance into proper rejection sampling for a
+    deterministic drafter (docs/SERVING.md)."""
+    s = logits.shape[1]
+    pos = pos0[:, None] + jnp.arange(s, dtype=pos0.dtype)[None, :]  # [B, S]
+    over_s = jax.vmap(_sample_one, in_axes=(None, 0, 0, None, None, None))
+    return jax.vmap(over_s, in_axes=(0, 0, 0, 0, 0, 0))(
+        seeds, pos, logits, temp, top_p, top_k
+    )
+
+
+def broadcast_params(n, seed, temp, top_p, top_k):
+    """Broadcast one request's scalar sampling params (traced or not) to
+    per-row arrays ``(seeds, temp, top_p, top_k)`` of length ``n`` — the
+    oracle-side helper: ``generate(..., sampling=...)`` runs every batch
+    row under the request's seed, with the scalars entering as TRACED jit
+    arguments so one compiled program serves all sampling values."""
+    return (
+        jnp.full((n,), seed, jnp.int32),
+        jnp.full((n,), temp, jnp.float32),
+        jnp.full((n,), top_p, jnp.float32),
+        jnp.full((n,), top_k, jnp.int32),
+    )

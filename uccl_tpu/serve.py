@@ -181,7 +181,8 @@ def _serve_continuous(args, saved_cfg):
 
     from uccl_tpu import obs
     from uccl_tpu.serving import (
-        DenseBackend, MoEBackend, Router, ServingEngine, ServingMetrics,
+        AdapterStore, DenseBackend, MoEBackend, Router, SamplingParams,
+        ServingEngine, ServingMetrics, make_lora, materialize,
         replicate_backend,
     )
     from uccl_tpu.serving.loadgen import (
@@ -200,6 +201,25 @@ def _serve_continuous(args, saved_cfg):
         raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
     if not (0.0 <= args.interactive_frac <= 1.0):
         raise SystemExit("--interactive-frac must be in [0, 1]")
+    if args.temperature < 0:
+        raise SystemExit(f"--temperature must be >= 0, got "
+                         f"{args.temperature}")
+    if not (0.0 < args.top_p <= 1.0):
+        raise SystemExit(f"--top-p must be in (0, 1], got {args.top_p}")
+    if args.top_k < 0:
+        raise SystemExit(f"--top-k must be >= 0, got {args.top_k}")
+    if args.tenants < 0:
+        raise SystemExit(f"--tenants must be >= 0, got {args.tenants}")
+    if args.tenants and args.priority_classes:
+        raise SystemExit("--tenants and --priority-classes are mutually "
+                         "exclusive admission policies (per-tenant DRR "
+                         "has no class ladder)")
+    if args.adapter_rank < 0:
+        raise SystemExit(f"--adapter-rank must be >= 0, got "
+                         f"{args.adapter_rank}")
+    if args.adapter_rank and not args.tenants:
+        raise SystemExit("--adapter-rank needs --tenants (adapters are "
+                         "per-tenant)")
     if args.step_tokens and not args.prefill_chunk:
         raise SystemExit("--step-tokens needs --prefill-chunk (the "
                          "whole-prompt path has no sub-step unit to budget)")
@@ -212,6 +232,27 @@ def _serve_continuous(args, saved_cfg):
     max_seq = args.max_seq or (args.prompt_len + args.new_tokens)
     if args.prompt_len + args.new_tokens > max_seq:
         raise SystemExit("--prompt-len + --new-tokens exceed --max-seq")
+
+    # per-tenant LoRA adapters: one published adapter per synthetic
+    # tenant; the engine fuses them as batched per-slot deltas and the
+    # oracle re-derives each request from dense-materialized W+BA params
+    head_dim = args.dim // args.heads
+    store = None
+    lora_trees = {}
+    if args.adapter_rank:
+        store = AdapterStore(
+            args.layers, args.dim, args.heads * head_dim,
+            args.kv_heads * head_dim, max_rank=args.adapter_rank,
+            capacity=max(4, args.slots),
+        )
+        for j in range(args.tenants):
+            tree = make_lora(
+                jax.random.PRNGKey(args.seed * 7919 + j + 1), args.layers,
+                args.dim, args.heads * head_dim,
+                args.kv_heads * head_dim, args.adapter_rank,
+            )
+            lora_trees[f"t{j}"] = tree
+            store.publish(f"t{j}", tree)
 
     step = None
     world = 1
@@ -239,10 +280,22 @@ def _serve_continuous(args, saved_cfg):
         )
         vocab = dcfg.vocab
 
+        mat_params = {}
+
         def oracle(req):
+            # adapted requests verify against dense-materialized W+BA
+            # params (cached per adapter) — the fused-delta exactness bar
+            p = params
+            if req.adapter is not None:
+                if req.adapter not in mat_params:
+                    mat_params[req.adapter] = materialize(
+                        params, lora_trees[req.adapter]
+                    )
+                p = mat_params[req.adapter]
             toks = generate(
-                params, jnp.asarray(req.prompt)[None], dcfg,
+                p, jnp.asarray(req.prompt)[None], dcfg,
                 max_new_tokens=req.max_new_tokens, max_seq=max_seq,
+                sampling=req.sampling,
             )
             return np.asarray(toks)[0, : req.n_generated]
     else:
@@ -296,15 +349,24 @@ def _serve_continuous(args, saved_cfg):
             # one-shot generate on a world-1 mesh: sharding is
             # semantics-free (the tested parity property), so the 1-shard
             # program is the cheapest exact oracle. Built once — its _fns
-            # cache then makes per-request calls pure cache hits.
-            if not oracle_srv:
+            # cache then makes per-request calls pure cache hits. Adapted
+            # requests verify against dense-materialized W+BA params,
+            # sharded once per adapter.
+            if "srv" not in oracle_srv:
                 srv1 = MoEServer(cfg, make_mesh(MeshConfig(dp=1),
                                                 jax.devices()[:1]))
-                oracle_srv["srv"] = (srv1, srv1.shard_params(params))
-            srv1, placed1 = oracle_srv["srv"]
+                oracle_srv["srv"] = srv1
+                oracle_srv[None] = srv1.shard_params(params)
+            srv1 = oracle_srv["srv"]
+            if req.adapter not in oracle_srv:
+                oracle_srv[req.adapter] = srv1.shard_params(
+                    materialize(params, lora_trees[req.adapter])
+                )
             toks = srv1.generate(
-                placed1, jnp.asarray(req.prompt)[None, None],
+                oracle_srv[req.adapter],
+                jnp.asarray(req.prompt)[None, None],
                 req.max_new_tokens, max_seq, impl=impl,
+                sampling=req.sampling,
             )
             return np.asarray(toks)[0, 0, : req.n_generated]
 
@@ -318,6 +380,7 @@ def _serve_continuous(args, saved_cfg):
         step_tokens=args.step_tokens or None,
         spec_k=args.spec_k or None,
         priority_classes=args.priority_classes, preempt=preempt,
+        adapters=store, tenant_fair=bool(args.tenants) or None,
     ) for b in backends]
     target = engines[0] if args.replicas == 1 else Router(engines)
 
@@ -332,6 +395,18 @@ def _serve_continuous(args, saved_cfg):
     priorities = (assign_classes(rng, args.requests, args.interactive_frac,
                                  pattern=args.class_pattern)
                   if args.priority_classes else None)
+    # tenants round-robin the arrival order; per-request seeds are
+    # --seed + i (lockstep counter keys keep --check-oracle bit-exact)
+    tenant_labels = ([f"t{i % args.tenants}" for i in range(args.requests)]
+                     if args.tenants else None)
+    adapter_labels = (list(tenant_labels) if args.adapter_rank else None)
+    samplings = None
+    if args.temperature > 0:
+        samplings = [
+            SamplingParams(temperature=args.temperature, top_p=args.top_p,
+                           top_k=args.top_k, seed=args.seed + i)
+            for i in range(args.requests)
+        ]
     if args.replicas == 1:
         warm_engine(target, lens, max_seq, args.new_tokens)
     else:
@@ -351,7 +426,8 @@ def _serve_continuous(args, saved_cfg):
               f"(+ /snapshot)", flush=True)
     try:
         reqs, wall = drive(target, prompts, arrivals, args.new_tokens,
-                           priorities=priorities)
+                           priorities=priorities, tenants=tenant_labels,
+                           samplings=samplings, adapters=adapter_labels)
     finally:
         if metrics_srv is not None:
             metrics_srv.close()
@@ -387,6 +463,11 @@ def _serve_continuous(args, saved_cfg):
         "preempt": preempt,
         "interactive_frac": (args.interactive_frac
                              if args.priority_classes else None),
+        "temperature": args.temperature or None,
+        "top_p": args.top_p if args.temperature else None,
+        "top_k": args.top_k if args.temperature else None,
+        "tenants": args.tenants or None,
+        "adapter_rank": args.adapter_rank or None,
         "wall_s": round(wall, 3), "ttft_hist_ms": ttft_hist_ms, **snap,
     }
     if reqs:
@@ -505,6 +586,31 @@ def main(argv=None):
                          "batch work so every interactive arrival finds "
                          "the slots occupied (the deterministic "
                          "preemption smoke fixture)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="server: stochastic sampling temperature "
+                         "(0 = greedy). Request i samples under "
+                         "per-request seed --seed+i with lockstep "
+                         "counter-based keys, so --check-oracle stays "
+                         "bit-exact against the SAMPLED one-shot "
+                         "generate oracle at the same seed")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="server: nucleus sampling mass in (0, 1] "
+                         "(active with --temperature > 0)")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="server: top-k truncation, 0 = off (active "
+                         "with --temperature > 0)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="server: N synthetic tenants round-robin over "
+                         "the arrival stream, admitted via per-tenant "
+                         "deficit round-robin (TenantFairScheduler); "
+                         "metrics gain tenant= labeled series. 0 = one "
+                         "implicit tenant, plain FIFO")
+    ap.add_argument("--adapter-rank", type=int, default=0,
+                    help="server: per-tenant LoRA adapters of this rank "
+                         "(needs --tenants), applied as batched fused "
+                         "per-slot deltas; --check-oracle verifies "
+                         "against dense-materialized W+BA params. "
+                         "0 = no adapters")
     ap.add_argument("--check-oracle", action="store_true",
                     help="server: verify every completed request is "
                          "bit-identical to the one-shot generate oracle "

@@ -7,10 +7,14 @@ with every request's tokens bit-identical to the one-shot ``generate``
 oracle.
 """
 
+from uccl_tpu.serving.adapters import (  # noqa: F401
+    AdapterStore, make_lora, materialize,
+)
 from uccl_tpu.serving.engine import (  # noqa: F401
     ChunkEvent, DenseBackend, MoEBackend, ServingEngine,
     replicate_backend,
 )
+from uccl_tpu.serving.sampling import SamplingParams  # noqa: F401
 from uccl_tpu.serving.metrics import (  # noqa: F401
     ServingMetrics, percentile, percentiles_ms,
 )
@@ -25,6 +29,7 @@ from uccl_tpu.serving.request import Request, RequestState  # noqa: F401
 from uccl_tpu.serving.router import Router, replica_signals  # noqa: F401
 from uccl_tpu.serving.scheduler import (  # noqa: F401
     PRIORITY_CLASSES, FIFOScheduler, PriorityScheduler,
+    TenantFairScheduler,
 )
 from uccl_tpu.serving.slots import SlotPool  # noqa: F401
 from uccl_tpu.serving.spec import Drafter, NGramDrafter  # noqa: F401
@@ -36,8 +41,10 @@ __all__ = [
     "ChunkEvent", "DenseBackend", "MoEBackend", "ServingEngine",
     "ServingMetrics", "percentile", "percentiles_ms", "PrefixCache",
     "Request", "RequestState", "FIFOScheduler", "PriorityScheduler",
-    "PRIORITY_CLASSES", "Router", "replica_signals", "SlotPool",
+    "TenantFairScheduler", "PRIORITY_CLASSES", "Router",
+    "replica_signals", "SlotPool",
     "Drafter", "NGramDrafter", "replicate_backend",
+    "SamplingParams", "AdapterStore", "make_lora", "materialize",
     "FailureDetector", "HEALTHY", "SUSPECT", "DEAD", "abandon_engine",
     "TieredKVCache", "HostKVTier", "KvTierServer", "RemoteKVTier",
     "TierRef",

@@ -37,8 +37,12 @@ import numpy as np
 from uccl_tpu import obs
 from uccl_tpu.serving.metrics import ServingMetrics
 from uccl_tpu.serving.request import Request, RequestState, now
+from uccl_tpu.serving.sampling import (
+    SamplingParams, pack as pack_sampling, slot_arrays, stamp_slot,
+)
 from uccl_tpu.serving.scheduler import (
     PRIORITY_CLASSES, FIFOScheduler, PriorityScheduler,
+    TenantFairScheduler,
 )
 from uccl_tpu.serving.slots import SlotPool
 from uccl_tpu.serving.spec import (
@@ -81,6 +85,50 @@ _RESUMES = obs.counter(
     "preempted requests re-admitted with their KV restored (bit-exact "
     "continuation at the saved cursor)",
 )
+_SPEC_RESAMPLE = obs.counter(
+    "spec_resample_total",
+    "sampled verify windows with a rejected draft: the committed token at "
+    "the first rejection is the residual-distribution resample (the "
+    "rejection-sampling correction, docs/SERVING.md)",
+)
+_TENANT_REQS = obs.counter(
+    "serving_tenant_requests_total",
+    "requests finished per tenant (labels: tenant)",
+)
+_TENANT_TOKS = obs.counter(
+    "serving_tenant_tokens_total",
+    "generated tokens delivered per tenant (labels: tenant)",
+)
+
+
+def _flat_extra(sampling, adapters) -> list:
+    """Flatten the optional sampled/adapted arguments into positional jit
+    args of fixed count: 5 per-slot sampling arrays, then 4 adapter tables
+    + per-slot row ids. The compiled-fn cache keys carry the two presence
+    flags, so the argmax/-adapter-free programs stay byte-identical."""
+    extra = []
+    if sampling is not None:
+        extra.extend(sampling)
+    if adapters is not None:
+        tables, ids = adapters
+        extra.extend([tables["wq"][0], tables["wq"][1],
+                      tables["wv"][0], tables["wv"][1], ids])
+    return extra
+
+
+def _split_extra(rest, sampled: bool, adapted: bool):
+    """Inverse of :func:`_flat_extra` inside a jitted run fn: returns
+    (sampling tuple | None, adapter tables | None, adapter ids | None)."""
+    rest = list(rest)
+    samp = None
+    if sampled:
+        samp = tuple(rest[:5])
+        rest = rest[5:]
+    adp = ids = None
+    if adapted:
+        adp = {"wq": (rest[0], rest[1]), "wv": (rest[2], rest[3])}
+        ids = rest[4]
+    return samp, adp, ids
 
 
 @dataclass
@@ -132,25 +180,27 @@ class DenseBackend:
         self._fns = fns if fns is not None else LRUFnCache(16)
         self._jax = jax
 
-    def _prefill_fn(self, s: int):
+    def _prefill_fn(self, s: int, sampled: bool, adapted: bool):
         jax = self._jax
         cfg = self.cfg
 
         def build():
             from uccl_tpu.models.inference import SlotKVCache, prefill_slots
 
-            def run(p, tok, lens, mask, off, kc, vc, ln):
+            def run(p, tok, lens, mask, off, kc, vc, ln, *rest):
+                samp, adp, ids = _split_extra(rest, sampled, adapted)
                 t, cache = prefill_slots(
                     p, tok, lens, mask, SlotKVCache(kc, vc, ln), cfg,
-                    start=off,
+                    start=off, sampling=samp, adapters=adp,
+                    adapter_ids=ids,
                 )
                 return t, cache.k, cache.v, cache.lengths
 
             return jax.jit(run)
 
-        return self._fns.get(("prefill", s), build)
+        return self._fns.get(("prefill", s, sampled, adapted), build)
 
-    def _decode_fn(self):
+    def _decode_fn(self, sampled: bool, adapted: bool):
         jax = self._jax
         cfg = self.cfg
 
@@ -159,64 +209,77 @@ class DenseBackend:
                 SlotKVCache, decode_step_slots,
             )
 
-            def run(p, tok, mask, kc, vc, ln):
+            def run(p, tok, mask, kc, vc, ln, *rest):
+                samp, adp, ids = _split_extra(rest, sampled, adapted)
                 t, cache = decode_step_slots(
-                    p, tok, mask, SlotKVCache(kc, vc, ln), cfg
+                    p, tok, mask, SlotKVCache(kc, vc, ln), cfg,
+                    sampling=samp, adapters=adp, adapter_ids=ids,
                 )
                 return t, cache.k, cache.v, cache.lengths
 
             return jax.jit(run)
 
-        return self._fns.get(("decode",), build)
+        return self._fns.get(("decode", sampled, adapted), build)
 
-    def _verify_fn(self, s: int):
+    def _verify_fn(self, s: int, sampled: bool, adapted: bool):
         jax = self._jax
         cfg = self.cfg
 
         def build():
             from uccl_tpu.models.inference import SlotKVCache, verify_slots
 
-            def run(p, tok, mask, kc, vc, ln):
+            def run(p, tok, mask, kc, vc, ln, *rest):
+                samp, adp, ids = _split_extra(rest, sampled, adapted)
                 t, n_acc, cache = verify_slots(
-                    p, tok, mask, SlotKVCache(kc, vc, ln), cfg
+                    p, tok, mask, SlotKVCache(kc, vc, ln), cfg,
+                    sampling=samp, adapters=adp, adapter_ids=ids,
                 )
                 return t, n_acc, cache.k, cache.v, cache.lengths
 
             return jax.jit(run)
 
-        return self._fns.get(("verify", s), build)
+        return self._fns.get(("verify", s, sampled, adapted), build)
 
     def prefill(self, tokens: np.ndarray, lens: np.ndarray,
                 mask: np.ndarray,
-                start: Optional[np.ndarray] = None) -> np.ndarray:
+                start: Optional[np.ndarray] = None,
+                sampling=None, adapters=None) -> np.ndarray:
         from uccl_tpu.models.inference import SlotKVCache
 
         if start is None:
             start = np.zeros(tokens.shape[0], np.int32)
-        fn = self._prefill_fn(tokens.shape[1])
+        fn = self._prefill_fn(tokens.shape[1], sampling is not None,
+                              adapters is not None)
         t, k, v, ln = fn(self.params, tokens, lens, mask, start,
-                         self.cache.k, self.cache.v, self.cache.lengths)
+                         self.cache.k, self.cache.v, self.cache.lengths,
+                         *_flat_extra(sampling, adapters))
         self.cache = SlotKVCache(k, v, ln)
         return np.asarray(t)
 
-    def decode(self, tokens: np.ndarray, active: np.ndarray) -> np.ndarray:
+    def decode(self, tokens: np.ndarray, active: np.ndarray,
+               sampling=None, adapters=None) -> np.ndarray:
         from uccl_tpu.models.inference import SlotKVCache
 
-        fn = self._decode_fn()
+        fn = self._decode_fn(sampling is not None, adapters is not None)
         t, k, v, ln = fn(self.params, tokens, active,
-                         self.cache.k, self.cache.v, self.cache.lengths)
+                         self.cache.k, self.cache.v, self.cache.lengths,
+                         *_flat_extra(sampling, adapters))
         self.cache = SlotKVCache(k, v, ln)
         return np.asarray(t)
 
-    def verify(self, tokens: np.ndarray, active: np.ndarray):
+    def verify(self, tokens: np.ndarray, active: np.ndarray,
+               sampling=None, adapters=None):
         """One batched [n_slots, k+1] draft-verify window (spec decode):
-        returns (greedy tokens [n_slots, k+1], n_accepted [n_slots])."""
+        returns (target tokens [n_slots, k+1], n_accepted [n_slots]) —
+        greedy argmaxes, or lockstep-keyed samples under ``sampling``."""
         from uccl_tpu.models.inference import SlotKVCache
 
-        fn = self._verify_fn(tokens.shape[1])
+        fn = self._verify_fn(tokens.shape[1], sampling is not None,
+                             adapters is not None)
         t, n_acc, k, v, ln = fn(self.params, tokens, active,
                                 self.cache.k, self.cache.v,
-                                self.cache.lengths)
+                                self.cache.lengths,
+                                *_flat_extra(sampling, adapters))
         self.cache = SlotKVCache(k, v, ln)
         return np.asarray(t), np.asarray(n_acc)
 
@@ -259,32 +322,64 @@ class MoEBackend:
                                      + flat.shape[1:]).astype(dtype)
         )
 
+    def _extra(self, sampling, adapters):
+        """Grid the flat per-slot sampled/adapted arguments onto the
+        [W, B_loc] shard layout: sampling arrays and adapter ids grid like
+        tokens; the stacked adapter tables broadcast a leading [W] dim
+        (every shard applies the same tables to its local rows)."""
+        import jax.numpy as jnp
+
+        samp = adp = ids = None
+        if sampling is not None:
+            seeds, pos0, temp, top_p, top_k = sampling
+            samp = (self._grid(seeds, np.int32),
+                    self._grid(pos0, np.int32),
+                    self._grid(temp, np.float32),
+                    self._grid(top_p, np.float32),
+                    self._grid(top_k, np.int32))
+        if adapters is not None:
+            tables, flat_ids = adapters
+            adp = {t: (jnp.broadcast_to(a, (self.world,) + a.shape),
+                       jnp.broadcast_to(b, (self.world,) + b.shape))
+                   for t, (a, b) in tables.items()}
+            ids = self._grid(flat_ids, np.int32)
+        return samp, adp, ids
+
     def prefill(self, tokens: np.ndarray, lens: np.ndarray,
                 mask: np.ndarray,
-                start: Optional[np.ndarray] = None) -> np.ndarray:
+                start: Optional[np.ndarray] = None,
+                sampling=None, adapters=None) -> np.ndarray:
         if start is None:
             start = np.zeros(tokens.shape[0], np.int32)
+        samp, adp, ids = self._extra(sampling, adapters)
         t, self.cache = self.server.prefill_slots(
             self.params, self._grid(tokens, np.int32),
             self._grid(lens, np.int32), self._grid(mask, bool), self.cache,
             start=self._grid(start, np.int32),
+            sampling=samp, adapters=adp, adapter_ids=ids,
         )
         return np.asarray(t).reshape(self.n_slots)
 
-    def decode(self, tokens: np.ndarray, active: np.ndarray) -> np.ndarray:
+    def decode(self, tokens: np.ndarray, active: np.ndarray,
+               sampling=None, adapters=None) -> np.ndarray:
+        samp, adp, ids = self._extra(sampling, adapters)
         t, self.cache = self.server.decode_step_slots(
             self.params, self._grid(tokens, np.int32),
             self._grid(active, bool), self.cache, impl=self.decode_impl,
+            sampling=samp, adapters=adp, adapter_ids=ids,
         )
         return np.asarray(t).reshape(self.n_slots)
 
-    def verify(self, tokens: np.ndarray, active: np.ndarray):
+    def verify(self, tokens: np.ndarray, active: np.ndarray,
+               sampling=None, adapters=None):
         """One batched [n_slots, k+1] draft-verify window (spec decode),
         through the sorted EP path — the multi-token regime, like prefill.
-        Returns (greedy tokens [n_slots, k+1], n_accepted [n_slots])."""
+        Returns (target tokens [n_slots, k+1], n_accepted [n_slots])."""
+        samp, adp, ids = self._extra(sampling, adapters)
         t, n_acc, self.cache = self.server.verify_slots(
             self.params, self._grid(tokens, np.int32),
             self._grid(active, bool), self.cache,
+            sampling=samp, adapters=adp, adapter_ids=ids,
         )
         s = tokens.shape[1]
         return (np.asarray(t).reshape(self.n_slots, s),
@@ -414,7 +509,9 @@ class ServingEngine:
                  spec_k: Optional[int] = None,
                  drafter=None,
                  priority_classes: bool = False,
-                 preempt: bool = False):
+                 preempt: bool = False,
+                 adapters=None,
+                 tenant_fair=None):
         if spec_k is not None:
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
@@ -466,6 +563,17 @@ class ServingEngine:
                 "chunk_sink requires prefill_chunk: the whole-prompt path "
                 "emits no per-chunk availability events"
             )
+        if tenant_fair and priority_classes:
+            raise ValueError(
+                "tenant_fair and priority_classes are mutually exclusive "
+                "admission policies: per-tenant DRR has no class ladder "
+                "(within a tenant, order is FIFO)"
+            )
+        if adapters is not None and not hasattr(adapters, "acquire"):
+            raise ValueError(
+                "adapters must be an AdapterStore "
+                "(uccl_tpu.serving.adapters)"
+            )
         if preempt:
             if not priority_classes:
                 raise ValueError(
@@ -490,11 +598,22 @@ class ServingEngine:
         self.chunk_sink = chunk_sink
         self.priority_classes = priority_classes
         self.preempt = preempt
+        self.adapters = adapters
+        self.tenant_fair = bool(tenant_fair)
         self.pool = SlotPool(backend.n_slots)
-        self.sched = (PriorityScheduler(max_queue=max_queue)
-                      if priority_classes
-                      else FIFOScheduler(max_queue=max_queue))
+        if tenant_fair:
+            kw = dict(tenant_fair) if isinstance(tenant_fair, dict) else {}
+            self.sched = TenantFairScheduler(max_queue=max_queue, **kw)
+        elif priority_classes:
+            self.sched = PriorityScheduler(max_queue=max_queue)
+        else:
+            self.sched = FIFOScheduler(max_queue=max_queue)
         self.metrics = ServingMetrics()
+        # per-slot sampling rows + adapter table row ids: stamped at
+        # admission, cleared at retire/preempt — the batched calls ship
+        # copies so a mid-step mutation can never race a device program
+        self._sampling = slot_arrays(backend.n_slots)
+        self._adapter_ids = np.zeros(backend.n_slots, np.int32)
         self._by_slot = {}  # slot -> Request (every occupied slot)
         self._prefilling = {}  # slot -> Request mid-prefill (chunked mode)
         self.dead = False  # killed (chaos / failure injection): step() raises
@@ -515,6 +634,9 @@ class ServingEngine:
                eos_id: Optional[int] = None,
                priority: str = "interactive",
                deadline_ms: Optional[float] = None,
+               tenant: str = "default",
+               sampling: Optional[SamplingParams] = None,
+               adapter: Optional[str] = None,
                trace=None) -> Optional[Request]:
         """Queue one request. Returns the Request, or None when rejected by
         backpressure (bounded queue full). ``priority`` picks the SLO class
@@ -526,7 +648,16 @@ class ServingEngine:
         ``trace`` carries an upstream :class:`~uccl_tpu.obs.TraceContext`
         (the Router, or a disagg prefill worker relaying its own ingress
         mint); None mints a fresh one here — either way every request owns
-        a fleet-unique trace_id stamped on its lifecycle events."""
+        a fleet-unique trace_id stamped on its lifecycle events.
+
+        ``tenant`` is the request's isolation identity (ISSUE 18): its
+        fair-scheduling queue under ``tenant_fair``, its metrics label,
+        and its prefix-cache namespace — two tenants never share cached
+        KV. ``sampling`` (a :class:`SamplingParams`) switches the request
+        from greedy to lockstep-seeded stochastic decoding; ``adapter``
+        names a published LoRA adapter in the engine's
+        :class:`~uccl_tpu.serving.adapters.AdapterStore` to fuse onto
+        this request's slot."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must be non-empty")
@@ -546,11 +677,32 @@ class ServingEngine:
             )
         if deadline_ms is not None and deadline_ms <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        if not tenant or not isinstance(tenant, str):
+            raise ValueError(f"tenant must be a non-empty string, got "
+                             f"{tenant!r}")
+        if sampling is not None and not isinstance(sampling,
+                                                   SamplingParams):
+            raise ValueError(
+                f"sampling must be a SamplingParams, got "
+                f"{type(sampling).__name__}"
+            )
+        if adapter is not None:
+            if self.adapters is None:
+                raise ValueError(
+                    "adapter requires an engine AdapterStore "
+                    "(ServingEngine(adapters=...))"
+                )
+            if not self.adapters.has(adapter):
+                raise ValueError(
+                    f"no published adapter for {adapter!r} (publish or "
+                    f"ingest it first)"
+                )
         ctx = trace if trace is not None else obs.new_context()
         req = Request(
             rid=self._next_rid, prompt=prompt,
             max_new_tokens=max_new_tokens, eos_id=eos_id, t_submit=now(),
-            priority=priority, deadline_ms=deadline_ms,
+            priority=priority, deadline_ms=deadline_ms, tenant=tenant,
+            sampling=sampling, adapter=adapter,
             trace_id=ctx.trace_id, span_id=ctx.span_id,
         )
         self._next_rid += 1
@@ -558,7 +710,7 @@ class ServingEngine:
         obs.instant("submit", track=req.track, rid=req.rid,
                     prompt_len=int(prompt.size),
                     max_new_tokens=max_new_tokens, cls=priority,
-                    trace_id=req.trace_id)
+                    tenant=tenant, trace_id=req.trace_id)
         if not self.sched.submit(req):
             self.metrics.on_reject(req)
             _REJECTS.inc()
@@ -599,6 +751,8 @@ class ServingEngine:
     def adopt(self, prompt, first_token, *, max_new_tokens: int = 16,
               eos_id: Optional[int] = None, slot: Optional[int] = None,
               priority: str = "interactive",
+              tenant: str = "default",
+              sampling: Optional[SamplingParams] = None,
               queue_s: Optional[float] = None,
               prefill_s: Optional[float] = None,
               transfer_s: Optional[float] = None,
@@ -643,7 +797,7 @@ class ServingEngine:
         req = Request(
             rid=self._next_rid, prompt=prompt,
             max_new_tokens=max_new_tokens, eos_id=eos_id, t_submit=t,
-            priority=priority,
+            priority=priority, tenant=tenant, sampling=sampling,
             trace_id=ctx.trace_id, span_id=ctx.span_id,
         )
         self._next_rid += 1
@@ -659,6 +813,7 @@ class ServingEngine:
         req.state = RequestState.ACTIVE
         req.prefill_pos = prompt.size
         req.t_admit = t
+        self._stamp_admit(slot, req)
         self.metrics.on_submit(req)
         self.metrics.on_admit(req)
         self.metrics.on_adopt(req, queue_s=queue_s, prefill_s=prefill_s,
@@ -693,7 +848,8 @@ class ServingEngine:
         router counts each on the dead engine's ``lost`` term)."""
         queued = self.sched.take_all()
         active = list(self._by_slot.values())
-        for slot in list(self._by_slot):
+        for slot, r in list(self._by_slot.items()):
+            self._release_slot(slot, r)
             self.pool.free(slot)
         self._by_slot.clear()
         self._prefilling.clear()
@@ -789,8 +945,10 @@ class ServingEngine:
                 continue
             req.state = RequestState.PARTIAL_PREFILL
             req.prefill_pos = 0
+            self._stamp_admit(slot, req)
             if self.prefix_cache is not None:
-                matched, donor = self.prefix_cache.match(req.prompt)
+                matched, donor = self.prefix_cache.match(req.prompt,
+                                                         self._ns(req))
                 if matched > 0:
                     # resume at the cached boundary: land the donor's KV
                     # rows [0, matched) in the fresh slot — a device-to-
@@ -858,7 +1016,8 @@ class ServingEngine:
         protect = None
         head = self.sched.peek()
         if head is not None:
-            protect = self.prefix_cache.peek_donor(head.prompt)
+            protect = self.prefix_cache.peek_donor(head.prompt,
+                                                   self._ns(head))
         if self.prefix_cache.evict_lru(self.pool, protect=protect,
                                        demote=demote) is not None:
             return True
@@ -914,6 +1073,7 @@ class ServingEngine:
         victim._saved_last_tok = int(self._last_tok[slot])
         self._by_slot.pop(slot)
         self._prefilling.pop(slot, None)
+        self._release_slot(slot, victim)
         self.pool.free(slot)
         victim.slot = None
         victim.state = RequestState.PREEMPTED
@@ -941,6 +1101,10 @@ class ServingEngine:
             req._saved_kv = None
         self._last_tok[slot] = np.int32(req._saved_last_tok)
         req._saved_last_tok = None
+        # re-stamp sampling + adapter: the adapter may land on a DIFFERENT
+        # table row than before preemption — row contents are the same
+        # published weights, so the fused math is unchanged
+        self._stamp_admit(slot, req)
         self._by_slot[slot] = req
         if req.prefill_pos < req.prompt.size:
             req.state = RequestState.PARTIAL_PREFILL
@@ -992,6 +1156,79 @@ class ServingEngine:
             self._stats_name = None
 
     # -- internals ----------------------------------------------------------
+    def _ns(self, req: Request) -> str:
+        """The request's prefix-cache namespace: tenant, plus adapter
+        identity AND version when one is fused — adapter deltas land on
+        ``wv``, so cached KV rows are adapter-dependent and a re-published
+        adapter must never hit its predecessor's rows. The default tenant
+        with no adapter maps to the root namespace (single-tenant engines
+        are unchanged)."""
+        if req.adapter is not None:
+            return (f"{req.tenant}|{req.adapter}"
+                    f"@{self.adapters.version(req.adapter)}")
+        if req.tenant != "default":
+            return req.tenant
+        return ""
+
+    def _stamp_admit(self, slot: int, req: Request) -> None:
+        """Slot-entry bookkeeping for sampling + adapters: write the
+        request's sampling row and pin its adapter into a device table
+        row (0 = the zero-rank fast path). Runs at every slot grant —
+        fresh admission, preemption resume, adopt."""
+        stamp_slot(self._sampling, slot, req.sampling)
+        row = 0
+        if req.adapter is not None:
+            row = self.adapters.acquire(req.adapter)
+        req._adapter_row = row
+        self._adapter_ids[slot] = row
+
+    def _release_slot(self, slot: int, req: Request) -> None:
+        """Undo :meth:`_stamp_admit` when the request leaves its slot
+        (retire or preemption): greedy the sampling row, zero the adapter
+        id, unpin the adapter table row."""
+        stamp_slot(self._sampling, slot, None)
+        self._adapter_ids[slot] = 0
+        if req._adapter_row:
+            self.adapters.release(req._adapter_row)
+            req._adapter_row = 0
+
+    def _sampling_for(self, rows, pos0=None):
+        """The packed per-slot sampling tuple for a batched call covering
+        ``rows`` ((slot, req) pairs) — None when every covered request is
+        greedy, so the argmax programs stay byte-identical to the
+        pre-sampling engine. ``pos0`` is each slot's output index for the
+        first token the call emits (None = zeros: prefill's first token
+        is output index 0)."""
+        if not any(r.sampling is not None for _, r in rows):
+            return None
+        if pos0 is None:
+            pos0 = np.zeros(self.backend.n_slots, np.int32)
+        return pack_sampling(self._sampling, pos0)
+
+    def _adapters_for(self, rows):
+        """The (device tables, per-slot row ids) pair for a batched call —
+        None when no covered request fused an adapter (id-0 rows would
+        compute an exact-0.0 delta, but skipping keeps the adapter-free
+        programs byte-identical)."""
+        if self.adapters is None or not any(r._adapter_row
+                                            for _, r in rows):
+            return None
+        return (self.adapters.device_tables(), self._adapter_ids.copy())
+
+    def _extra_kw(self, rows, pos0=None) -> dict:
+        """Backend-call kwargs for ``rows`` — sampling/adapters keys only
+        when actually needed, so greedy adapter-free engines keep calling
+        backends (including the test stubs and any external backend
+        implementation) with the pre-sampling signature."""
+        kw = {}
+        samp = self._sampling_for(rows, pos0)
+        if samp is not None:
+            kw["sampling"] = samp
+        adp = self._adapters_for(rows)
+        if adp is not None:
+            kw["adapters"] = adp
+        return kw
+
     def _prefill(self, newly, finished) -> None:
         n = self.backend.n_slots
         s_bucket = _bucket(max(r.prompt.size for _, r in newly),
@@ -1003,6 +1240,7 @@ class ServingEngine:
             tokens[slot, :req.prompt.size] = req.prompt
             lens[slot] = req.prompt.size
             mask[slot] = True
+            self._stamp_admit(slot, req)
             self.metrics.on_admit(req)
             obs.instant("admit", track=req.track, slot=slot)
         _PREFILL_TOKENS.inc(sum(int(r.prompt.size) for _, r in newly),
@@ -1010,7 +1248,8 @@ class ServingEngine:
         tr = obs.get_tracer()
         ts0 = tr.now_us() if tr is not None else 0.0
         t0 = now()
-        tok = self.backend.prefill(tokens, lens, mask)
+        tok = self.backend.prefill(tokens, lens, mask,
+                                   **self._extra_kw(newly))
         self.metrics.on_prefill(now() - t0, len(newly))
         t_done = now()
         if tr is not None:
@@ -1055,7 +1294,9 @@ class ServingEngine:
         tr = obs.get_tracer()
         ts0 = tr.now_us() if tr is not None else 0.0
         t0 = now()
-        tok = self.backend.prefill(tokens, lens, mask, start=start)
+        rows = list(self._prefilling.items())
+        tok = self.backend.prefill(tokens, lens, mask, start=start,
+                                   **self._extra_kw(rows))
         self.metrics.on_prefill(now() - t0, len(self._prefilling),
                                 chunked=True)
         t_done = now()
@@ -1102,12 +1343,16 @@ class ServingEngine:
             self._spec_decode(decoding, finished)
             return
         active = np.zeros(self.backend.n_slots, bool)
-        for slot in decoding:
+        pos0 = np.zeros(self.backend.n_slots, np.int32)
+        for slot, req in decoding.items():
             active[slot] = True
+            pos0[slot] = req.n_generated  # this step's output index
+        rows = list(decoding.items())
         tr = obs.get_tracer()
         ts0 = tr.now_us() if tr is not None else 0.0
         t0 = now()
-        tok = self.backend.decode(self._last_tok.copy(), active)
+        tok = self.backend.decode(self._last_tok.copy(), active,
+                                  **self._extra_kw(rows, pos0))
         self.metrics.on_decode_step(now() - t0, len(decoding),
                                     tokens=len(decoding))
         t_done = now()
@@ -1134,6 +1379,7 @@ class ServingEngine:
         tokens = np.zeros((n, k + 1), np.int32)
         active = np.zeros(n, bool)
         proposed = np.zeros(n, np.int32)
+        pos0 = np.zeros(n, np.int32)
         for slot, req in decoding.items():
             tokens[slot, 0] = self._last_tok[slot]
             d = np.asarray(self.drafter.draft(req.context(), k),
@@ -1142,10 +1388,13 @@ class ServingEngine:
                 tokens[slot, 1:1 + d.size] = d
             proposed[slot] = d.size
             active[slot] = True
+            pos0[slot] = req.n_generated  # window column j → pos0 + j
+        rows = list(decoding.items())
         tr = obs.get_tracer()
         ts0 = tr.now_us() if tr is not None else 0.0
         t0 = now()
-        tok, n_acc = self.backend.verify(tokens, active)
+        tok, n_acc = self.backend.verify(tokens, active,
+                                         **self._extra_kw(rows, pos0))
         dt = now() - t0
         t_done = now()
         if tr is not None:
@@ -1173,6 +1422,13 @@ class ServingEngine:
             # abstention as k rejections
             p = int(proposed[slot])
             acc = min(m, p)
+            if (acc < p and req.sampling is not None
+                    and req.sampling.temperature > 0):
+                # a sampled window hit a rejection: the committed token at
+                # the rejection position IS the residual resample (the
+                # deterministic-drafter rejection-sampling coupling —
+                # docs/SERVING.md), so meter the correction
+                _SPEC_RESAMPLE.inc()
             _SPEC_TOKENS.inc(acc, outcome="accepted")
             _SPEC_TOKENS.inc(p - acc, outcome="rejected")
             _SPEC_TOKENS.inc(1, outcome="bonus")
@@ -1205,15 +1461,20 @@ class ServingEngine:
             return
         req.state = RequestState.FINISHED
         req.t_finish = t
+        self._release_slot(slot, req)
         # park-on-retire: with a prefix cache, the retiring slot's prompt
         # KV stays resident as a reuse donor (LRU-evicted under admission
-        # pressure) instead of being freed
+        # pressure) instead of being freed — under the request's tenant/
+        # adapter namespace, so a cross-tenant prompt never hits these rows
         parked = (self.prefix_cache is not None
-                  and self.prefix_cache.park(self.pool, slot, req.prompt))
+                  and self.prefix_cache.park(self.pool, slot, req.prompt,
+                                             self._ns(req)))
         if not parked:
             self.pool.free(slot)
         self._by_slot.pop(slot, None)
         self.metrics.on_finish(req)
+        _TENANT_REQS.inc(tenant=req.tenant)
+        _TENANT_TOKS.inc(req.n_generated, tenant=req.tenant)
         obs.instant("finish", track=req.track, reason=req.finish_reason,
                     tokens=req.n_generated, parked=parked,
                     trace_id=req.trace_id)

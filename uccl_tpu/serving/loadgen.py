@@ -226,14 +226,16 @@ def warm_replicas(router, lens, max_seq: int, new_tokens: int) -> None:
 
 def drive(engine, prompts, arrivals, max_new_tokens,
           eos_id: Optional[int] = None, priorities=None,
-          deadlines_ms=None) -> Tuple[List[Request], float]:
+          deadlines_ms=None, tenants=None, samplings=None,
+          adapters=None) -> Tuple[List[Request], float]:
     """Run the arrival stream to completion: submit requests as their
     arrival offsets come due (wall clock), stepping the engine whenever it
     has work. ``engine`` is a ServingEngine or a Router (same submit/step/
     has_work surface). ``max_new_tokens`` is one budget for every request
     or a per-request list (mixed workloads: short interactive turns over
-    long batch jobs). ``priorities`` / ``deadlines_ms`` are optional
-    per-request lists (None entries = the submit defaults). Returns
+    long batch jobs). ``priorities`` / ``deadlines_ms`` / ``tenants`` /
+    ``samplings`` / ``adapters`` are optional per-request lists (None
+    entries = the submit defaults). Returns
     (accepted requests, wall seconds); rejected submissions (bounded
     queue) are counted in the engine's metrics but not returned — expired
     requests ARE returned (they were accepted) and finish as EXPIRED."""
@@ -248,6 +250,12 @@ def drive(engine, prompts, arrivals, max_new_tokens,
                 kw["priority"] = priorities[i]
             if deadlines_ms is not None and deadlines_ms[i] is not None:
                 kw["deadline_ms"] = deadlines_ms[i]
+            if tenants is not None and tenants[i] is not None:
+                kw["tenant"] = tenants[i]
+            if samplings is not None and samplings[i] is not None:
+                kw["sampling"] = samplings[i]
+            if adapters is not None and adapters[i] is not None:
+                kw["adapter"] = adapters[i]
             mnt = (max_new_tokens[i]
                    if isinstance(max_new_tokens, (list, tuple))
                    else max_new_tokens)

@@ -169,6 +169,16 @@ class ServingMetrics:
         self.class_ttft_s: Dict[str, List[float]] = {}
         self.class_tpot_s: Dict[str, List[float]] = {}
         self.class_queue_wait_s: Dict[str, List[float]] = {}
+        # per-tenant series (ISSUE 18): isolation is judged per tenant —
+        # the multi-tenant bench derives each tenant's SLO attainment and
+        # goodput share from these, so an overloading neighbor's damage
+        # (or the fair scheduler's lack thereof) is directly visible
+        self.tenant_submitted: Dict[str, int] = {}
+        self.tenant_completed: Dict[str, int] = {}
+        self.tenant_output_tokens: Dict[str, int] = {}
+        self.tenant_ttft_s: Dict[str, List[float]] = {}
+        self.tenant_tpot_s: Dict[str, List[float]] = {}
+        self.tenant_queue_wait_s: Dict[str, List[float]] = {}
         self.t_first_submit: Optional[float] = None
         self.t_last_finish: Optional[float] = None
 
@@ -177,6 +187,8 @@ class ServingMetrics:
         self.submitted += 1
         self.class_submitted[req.priority] = \
             self.class_submitted.get(req.priority, 0) + 1
+        self.tenant_submitted[req.tenant] = \
+            self.tenant_submitted.get(req.tenant, 0) + 1
         if self.t_first_submit is None:
             self.t_first_submit = req.t_submit
 
@@ -202,6 +214,9 @@ class ServingMetrics:
             self.class_queue_wait_s.setdefault(req.priority, []).append(
                 req.queue_wait
             )
+            self.tenant_queue_wait_s.setdefault(req.tenant, []).append(
+                req.queue_wait
+            )
 
     def on_preempt(self, req: Request) -> None:
         """A lower-class request was paused at a chunk boundary (its KV
@@ -219,6 +234,7 @@ class ServingMetrics:
             self.ttft_s.append(req.ttft)
             TTFT_HIST.observe(req.ttft)
             self.class_ttft_s.setdefault(req.priority, []).append(req.ttft)
+            self.tenant_ttft_s.setdefault(req.tenant, []).append(req.ttft)
 
     def on_adopt(self, req: Request, *, queue_s: Optional[float] = None,
                  prefill_s: Optional[float] = None,
@@ -244,12 +260,17 @@ class ServingMetrics:
         self.completed += 1
         self.class_completed[req.priority] = \
             self.class_completed.get(req.priority, 0) + 1
+        self.tenant_completed[req.tenant] = \
+            self.tenant_completed.get(req.tenant, 0) + 1
         self.output_tokens += req.n_generated
+        self.tenant_output_tokens[req.tenant] = \
+            self.tenant_output_tokens.get(req.tenant, 0) + req.n_generated
         self.t_last_finish = req.t_finish
         if req.tpot is not None:
             self.tpot_s.append(req.tpot)
             TPOT_HIST.observe(req.tpot)
             self.class_tpot_s.setdefault(req.priority, []).append(req.tpot)
+            self.tenant_tpot_s.setdefault(req.tenant, []).append(req.tpot)
         if req.latency is not None:
             self.latency_s.append(req.latency)
 
@@ -362,6 +383,26 @@ class ServingMetrics:
                 }
                 for cls, n in sorted(self.class_submitted.items())
             }
+        # per-tenant SLO surfaces, same emission rule: a single-tenant
+        # engine's snapshot stays byte-compatible with the pre-tenancy one
+        if len(self.tenant_submitted) > 1:
+            snap["per_tenant"] = {
+                t: {
+                    "submitted": n,
+                    "completed": self.tenant_completed.get(t, 0),
+                    "output_tokens": self.tenant_output_tokens.get(t, 0),
+                    "ttft_ms": percentiles_ms(
+                        self.tenant_ttft_s.get(t, [])
+                    ),
+                    "tpot_ms": percentiles_ms(
+                        self.tenant_tpot_s.get(t, [])
+                    ),
+                    "queue_wait_ms": percentiles_ms(
+                        self.tenant_queue_wait_s.get(t, [])
+                    ),
+                }
+                for t, n in sorted(self.tenant_submitted.items())
+            }
         if self.adopted:
             snap["adopted"] = self.adopted
             snap["disagg_queue_ms"] = percentiles_ms(self.disagg_queue_s)
@@ -446,6 +487,28 @@ class ServingMetrics:
                         elif isinstance(mv, (int, float)) \
                                 and not isinstance(mv, bool):
                             lines.append(f'{mname}{{cls="{c}"}} {mv}')
+                continue
+            if k == "per_tenant" and isinstance(v, dict):
+                # one series per (tenant, metric[, quantile]) — the
+                # isolation surfaces check_obs --tenants greps for
+                for ten, metrics in v.items():
+                    tl = escape_label_value(str(ten))
+                    for mk, mv in metrics.items():
+                        mname = sanitize_name(f"{prefix}_tenant_{mk}")
+                        if isinstance(mv, dict):
+                            for q, qv in mv.items():
+                                if isinstance(qv, (int, float)) \
+                                        and not isinstance(qv, bool):
+                                    lines.append(
+                                        f'{mname}{{tenant="{tl}",'
+                                        f'q="{escape_label_value(str(q))}"'
+                                        f"}} {qv}"
+                                    )
+                        elif isinstance(mv, (int, float)) \
+                                and not isinstance(mv, bool):
+                            lines.append(
+                                f'{mname}{{tenant="{tl}"}} {mv}'
+                            )
                 continue
             if isinstance(v, dict):
                 for q, qv in v.items():

@@ -144,25 +144,32 @@ class PrefixCache:
         self._seq += 1
         self._resident[resident] = (depth, self._seq)
 
-    def _chunks(self, prompt: np.ndarray, n: int):
+    def _chunks(self, prompt: np.ndarray, n: int, ns: str = ""):
+        """Chunk keys of ``prompt``, namespaced (ISSUE 18): every key is
+        prefixed with ``ns`` bytes, so two tenants (or two adapter
+        versions of one tenant) sharing a system prompt occupy DISJOINT
+        trie branches — adapter-divergent KV can never cross-hit. A
+        cross-namespace lookup walks into the other namespace's branch
+        root, finds nothing, and counts an ordinary miss."""
         c = self.chunk
+        tag = ns.encode() + b"\x00" if ns else b""
         p = np.ascontiguousarray(np.asarray(prompt, np.int32))
         for i in range(n):
-            yield p[i * c:(i + 1) * c].tobytes()
+            yield tag + p[i * c:(i + 1) * c].tobytes()
 
     def _stamp_gauges(self) -> None:
         _RESIDENT.set(self.n_resident)
         _RESIDENT_TOKENS.set(self._t0_tokens)
 
     # -- lookup -----------------------------------------------------------
-    def _lookup(self, prompt) -> Tuple[int, Optional[object]]:
+    def _lookup(self, prompt, ns: str = "") -> Tuple[int, Optional[object]]:
         """Side-effect-free deepest-usable-prefix walk (no counters, no
         LRU refresh) — shared by :meth:`match` and :meth:`peek_donor`."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         usable = (prompt.size - 1) // self.chunk  # ≥1 token must remain
         node, best = self._root, None
         depth = 0
-        for key in self._chunks(prompt, usable):
+        for key in self._chunks(prompt, usable, ns):
             node = node.children.get(key)
             if node is None:
                 break
@@ -180,9 +187,12 @@ class PrefixCache:
                                    self._resident[s][1]))
         return depth * self.chunk, donor
 
-    def match(self, prompt) -> Tuple[int, Optional[object]]:
+    def match(self, prompt, ns: str = "") -> Tuple[int, Optional[object]]:
         """Deepest cached chunk-aligned prefix of ``prompt`` that is usable
-        for resumption. Returns ``(matched_len, donor)`` with
+        for resumption, WITHIN namespace ``ns`` (the engine passes the
+        request's tenant + adapter version — a cross-tenant or
+        cross-adapter-version attempt counts a miss, never a hit).
+        Returns ``(matched_len, donor)`` with
         ``matched_len`` a multiple of ``chunk`` and ``donor`` a parked slot
         id (int, tier 0) or a tier ref; ``(0, None)`` on a miss.
 
@@ -201,7 +211,7 @@ class PrefixCache:
         skipped, and the per-tier split of ``kv_tier_hits_total`` keeps
         summing to ``prefix_cache_hits_total``.
         """
-        matched, donor = self._lookup(prompt)
+        matched, donor = self._lookup(prompt, ns)
         if donor is None:
             _MISSES.inc()
             return 0, None
@@ -224,22 +234,22 @@ class PrefixCache:
         so it is a miss in every ledger that matters."""
         _MISSES.inc()
 
-    def peek_donor(self, prompt) -> Optional[object]:
+    def peek_donor(self, prompt, ns: str = "") -> Optional[object]:
         """The resident :meth:`match` would reuse for ``prompt``, with no
         counter or LRU side effects — the engine protects it from being
         its own admission's eviction victim."""
-        return self._lookup(prompt)[1]
+        return self._lookup(prompt, ns)[1]
 
-    def covered(self, prompt) -> Optional[object]:
+    def covered(self, prompt, ns: str = "") -> Optional[object]:
         """If the trie already caches ``prompt``'s full-chunk prefix at
-        maximal depth, return a resident holding it (parking another copy
-        would waste a slot); else None."""
+        maximal depth in namespace ``ns``, return a resident holding it
+        (parking another copy would waste a slot); else None."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         k = prompt.size // self.chunk
         if k < 1:
             return None
         node = self._root
-        for key in self._chunks(prompt, k):
+        for key in self._chunks(prompt, k, ns):
             node = node.children.get(key)
             if node is None:
                 return None
@@ -267,8 +277,10 @@ class PrefixCache:
             self._t0_tokens += len(path) * self.chunk
         self._stamp_gauges()
 
-    def park(self, pool, slot: int, prompt) -> bool:
-        """Try to keep a retiring request's slot resident as a donor.
+    def park(self, pool, slot: int, prompt, ns: str = "") -> bool:
+        """Try to keep a retiring request's slot resident as a donor,
+        keyed in namespace ``ns`` (the retiring request's tenant +
+        adapter version — its KV is only ever a donor within it).
 
         Returns True when the slot was parked (caller must NOT free it);
         False when caching is useless — prompt shorter than one chunk, or
@@ -287,14 +299,14 @@ class PrefixCache:
         k = prompt.size // self.chunk
         if k < 1:
             return False
-        existing = self.covered(prompt)
+        existing = self.covered(prompt, ns)
         if existing is not None:
             if isinstance(existing, int) or self._resident[existing][0] > k:
                 self._touch(existing)
                 return False
             # deep-tier ref at exactly depth k: supersede it with the slot
             self._remove(existing)
-        self._insert(slot, list(self._chunks(prompt, k)))
+        self._insert(slot, list(self._chunks(prompt, k, ns)))
         pool.park(slot)
         return True
 

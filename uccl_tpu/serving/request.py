@@ -66,6 +66,15 @@ class Request:
     cache_hit_exact: bool = True
     adopted: bool = False  # entered via adopt() (disagg decode side), not submit()
     priority: str = "interactive"  # SLO class: "interactive" | "batch"
+    tenant: str = "default"  # multi-tenant identity: fair-scheduling queue,
+    # per-tenant metrics label, prefix-cache namespace (ISSUE 18)
+    # per-request sampling policy (serving/sampling.py SamplingParams);
+    # None = greedy — the engine's exactness oracle is then the sampled
+    # one-shot generate at the same seed instead of the argmax one
+    sampling: Optional[object] = None
+    adapter: Optional[str] = None  # LoRA adapter tenant name (AdapterStore)
+    _adapter_row: int = field(default=0, repr=False, compare=False)
+    # device table row pinned at admit (0 = the zero-rank fast path)
     deadline_ms: Optional[float] = None  # admission deadline after submit
     # distributed-tracing identity (obs/context.py): trace_id is minted
     # once at ingress (submit / Router.submit) and carried VERBATIM across

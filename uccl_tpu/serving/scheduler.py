@@ -22,8 +22,8 @@ victim resumes before any later same-class arrival.
 
 from __future__ import annotations
 
-from collections import deque
-from typing import Callable, List, Optional, Tuple
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional, Tuple
 
 from uccl_tpu.serving.request import Request, RequestState, now
 from uccl_tpu.serving.slots import SlotPool
@@ -157,18 +157,24 @@ class FIFOScheduler:
                                         and make_room()):
                 break
             req = queue.popleft()
-            if (req.deadline_ms is not None
-                    and req.state is RequestState.QUEUED):
-                self._n_deadlined -= 1  # made it in before the deadline
-            slot = pool.admit(req.rid)
-            assert slot is not None  # n_free was checked
-            req.slot = slot
-            req.state = RequestState.ACTIVE
-            req.t_admit = now()
-            req.admit_seq = self._admit_seq
-            self._admit_seq += 1
-            admitted.append((slot, req))
+            admitted.append((self._place(pool, req), req))
         return admitted
+
+    def _place(self, pool: SlotPool, req: Request) -> int:
+        """Shared per-admission bookkeeping (deadline counter, slot grant,
+        state + timing stamps) — every scheduler's admit loop funnels
+        through this once it has chosen a request and verified capacity."""
+        if (req.deadline_ms is not None
+                and req.state is RequestState.QUEUED):
+            self._n_deadlined -= 1  # made it in before the deadline
+        slot = pool.admit(req.rid)
+        assert slot is not None  # n_free was checked by the caller
+        req.slot = slot
+        req.state = RequestState.ACTIVE
+        req.t_admit = now()
+        req.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        return slot
 
 
 class PriorityScheduler(FIFOScheduler):
@@ -209,3 +215,151 @@ class PriorityScheduler(FIFOScheduler):
 
     def requeue(self, req: Request) -> None:
         self._class_queue(req).appendleft(req)
+
+
+class TenantFairScheduler(FIFOScheduler):
+    """Per-tenant fair admission (ISSUE 18): one FIFO queue per tenant,
+    **deficit round-robin** across tenants, plus optional per-tenant
+    **token-bucket** rate limits.
+
+    DRR (the classic Shreedhar/Varghese discipline, in request-token
+    units): admission visits tenants round-robin; each visit grants the
+    tenant one ``quantum`` of deficit, and its queue head is admitted
+    while the deficit covers the request's token cost
+    (``prompt + max_new_tokens``). A tenant with a thousand queued
+    requests therefore gets the same admission *rate* as a tenant with
+    one — backlog buys nothing — which is exactly the isolation the
+    multi-tenant bench proves: an overloading tenant cannot push a
+    victim's SLO attainment down (docs/SERVING.md). An emptied queue
+    forfeits its deficit (the DRR rule that stops idle tenants hoarding
+    credit).
+
+    The token bucket (``rate`` tokens/sec, capacity ``burst``) is the
+    hard per-tenant ceiling ON TOP of DRR's work-conserving share: a
+    tenant above its rate holds in queue even when slots are free.
+    ``rate=None`` (default) disables it — DRR alone is work-conserving.
+    A preempted request is NOT re-charged on resume (its tokens were
+    billed at first admission).
+
+    Per-tenant fairness and priority classes are mutually exclusive
+    surfaces (the engine enforces it): within a tenant, order is FIFO.
+    ``clock`` is injectable for deterministic bucket tests.
+    """
+
+    def __init__(self, max_queue: Optional[int] = None, *,
+                 quantum: int = 64, rate: Optional[float] = None,
+                 burst: Optional[float] = None, clock=now):
+        super().__init__(max_queue=max_queue)
+        if quantum < 1:
+            raise ValueError(f"quantum must be >= 1, got {quantum}")
+        if rate is not None and rate <= 0:
+            raise ValueError(f"rate must be > 0 tokens/sec, got {rate}")
+        if burst is not None and burst <= 0:
+            raise ValueError(f"burst must be > 0 tokens, got {burst}")
+        self.quantum = int(quantum)
+        self.rate = rate
+        self.burst = float(burst) if burst is not None else (
+            4.0 * rate if rate is not None else 0.0
+        )
+        self._clock = clock
+        self._by_tenant: "OrderedDict[str, deque]" = OrderedDict()
+        self._deficit: Dict[str, float] = {}
+        self._bucket: Dict[str, float] = {}
+        self._rr: deque = deque()  # round-robin rotation of tenant names
+        self._last_refill: Optional[float] = None
+
+    def _queues(self) -> List[deque]:
+        return list(self._by_tenant.values())
+
+    def _tenant_queue(self, tenant: str) -> deque:
+        q = self._by_tenant.get(tenant)
+        if q is None:
+            q = self._by_tenant[tenant] = deque()
+            self._deficit[tenant] = 0.0
+            self._bucket[tenant] = self.burst
+            self._rr.append(tenant)
+        return q
+
+    @staticmethod
+    def _cost(req: Request) -> int:
+        """A request's token cost: the prompt it prefills plus the budget
+        it may decode — the unit both the deficit and the bucket meter."""
+        return int(req.prompt.size) + int(req.max_new_tokens)
+
+    def submit(self, req: Request) -> bool:
+        if self.max_queue is not None and self.qsize >= self.max_queue:
+            req.state = RequestState.REJECTED
+            return False
+        self._tenant_queue(req.tenant).append(req)
+        if req.deadline_ms is not None:
+            self._n_deadlined += 1
+        return True
+
+    def requeue(self, req: Request) -> None:
+        self._tenant_queue(req.tenant).appendleft(req)
+
+    def _refill(self) -> None:
+        if self.rate is None:
+            return
+        t = self._clock()
+        if self._last_refill is not None:
+            dt = max(0.0, t - self._last_refill)
+            for tenant in self._bucket:
+                self._bucket[tenant] = min(
+                    self.burst, self._bucket[tenant] + self.rate * dt
+                )
+        self._last_refill = t
+
+    def admit(self, pool: SlotPool, limit: Optional[int] = None,
+              make_room: Optional[Callable[[], bool]] = None,
+              ) -> List[Tuple[int, Request]]:
+        admitted: List[Tuple[int, Request]] = []
+        self._refill()
+        while limit is None or len(admitted) < limit:
+            progress = deficit_short = False
+            for _ in range(len(self._rr)):
+                if limit is not None and len(admitted) >= limit:
+                    break
+                tenant = self._rr[0]
+                self._rr.rotate(-1)
+                q = self._by_tenant[tenant]
+                if not q:
+                    self._deficit[tenant] = 0.0  # idle forfeits credit
+                    continue
+                self._deficit[tenant] += self.quantum
+                while q and (limit is None or len(admitted) < limit):
+                    req = q[0]
+                    # a resumed preemption was billed at first admission
+                    charge = 0 if req.preemptions else self._cost(req)
+                    if (self.rate is not None
+                            and self._bucket[tenant] < charge):
+                        break  # rate-limited: holds even with free slots
+                    if self._deficit[tenant] < charge:
+                        deficit_short = True  # next round grants more
+                        break
+                    if not pool.n_free and not (make_room is not None
+                                                and make_room()):
+                        # The POOL is the blocker, not fairness — park the
+                        # wheel back on the denied tenant and retract this
+                        # visit's unspent grant (re-granted on resume).
+                        # Without the park, every admit call walks a full
+                        # rotation and the freed slot always lands on the
+                        # front tenant: observed starvation of every other
+                        # tenant under a 1-slot pool.
+                        self._rr.rotate(1)
+                        self._deficit[tenant] = max(
+                            0.0, self._deficit[tenant] - self.quantum
+                        )
+                        return admitted
+                    q.popleft()
+                    slot = self._place(pool, req)
+                    self._deficit[tenant] -= charge
+                    if self.rate is not None:
+                        self._bucket[tenant] -= charge
+                    admitted.append((slot, req))
+                    progress = True
+                if not q:
+                    self._deficit[tenant] = 0.0
+            if not progress and not deficit_short:
+                break  # every queued tenant is rate-limited
+        return admitted

@@ -173,6 +173,23 @@ class TestSamplingMath:
         )
         assert np.array_equal(np.asarray(toks), np.full(b, 3))
 
+    def test_top_p_one_is_exact_noop(self):
+        """``top_p=1.0`` documents "nucleus disabled" — and must be an
+        EXACT no-op. Over a peaked distribution the float32 cumulative
+        sum rounds to exactly 1.0 before the tail, so the ``< top_p``
+        test alone masks extreme-tail tokens; the disable has to keep
+        every token unconditionally."""
+        from uccl_tpu.models.sampling import _nucleus_keep
+
+        z = jnp.asarray([40.0] + [-40.0] * 7, jnp.float32)
+        # adversarial precondition: the running mass really hits 1.0
+        # at the head, so `cum_before < 1.0` is False for every tail token
+        head_mass = jnp.sort(jax.nn.softmax(z))[::-1][0]
+        assert float(head_mass) == 1.0
+        assert bool(jnp.all(_nucleus_keep(z, jnp.float32(1.0))))
+        # a real nucleus over the same row still truncates
+        assert not bool(jnp.all(_nucleus_keep(z, jnp.float32(0.5))))
+
     def test_histogram_tracks_softmax(self, rng):
         """The residual-distribution property: across many seeds at one
         position, the empirical distribution of lockstep samples tracks
@@ -292,6 +309,40 @@ class TestAdapterStore:
         with pytest.raises(ValueError):
             store.publish("big", _lora_for(cfg, 4, seed=1))
 
+    def test_archive_eviction_prunes_pub_seq(self):
+        """``max_published`` eviction drops the victim's publish-order
+        stamp with it — leaving it would leak one ``_pub_seq`` entry per
+        evicted tenant under publish/evict churn."""
+        cfg = self._cfg()
+        store = _store_for(cfg, max_rank=2, capacity=2, max_published=2)
+        for i in range(6):
+            store.publish(f"t{i}", _lora_for(cfg, 2, seed=i + 1))
+        assert len(store._published) == 2
+        assert set(store._pub_seq) == set(store._published)
+
+    def test_can_acquire_and_row_accounting(self):
+        """The non-raising admission gate: ``can_acquire`` predicts
+        whether ``acquire`` would succeed, and ``n_available_rows`` with
+        ``exclude`` models a batch that is about to pin its resident
+        adapters (their unpinned rows are not available to stage into)."""
+        cfg = self._cfg()
+        store = _store_for(cfg, max_rank=2, capacity=2)
+        for i, t in enumerate(("a", "b", "c")):
+            store.publish(t, _lora_for(cfg, 2, seed=i + 1))
+        assert store.can_acquire(None)         # row 0, always
+        assert not store.can_acquire("ghost")  # unpublished
+        assert store.n_available_rows() == 2
+        ra, rb = store.acquire("a"), store.acquire("b")
+        assert store.n_available_rows() == 0
+        assert store.can_acquire("a")          # resident: refcount hit
+        assert not store.can_acquire("c")      # every row pinned
+        store.release(ra)
+        assert store.n_available_rows() == 1
+        assert store.is_resident("a")          # unpinned, still resident
+        assert store.n_available_rows(exclude={"a"}) == 0
+        assert store.can_acquire("c")          # a's row is evictable
+        store.release(rb)
+
     def test_weight_push_ingest_round_trip(self):
         """The distribution path: adapters travel as versioned
         WeightPublisher snapshots; ``ingest`` maps ``adapter/<tenant>``
@@ -377,6 +428,30 @@ class TestTenantFairScheduler:
         req.preemptions = 1
         sched.requeue(req)  # resume path: billed at first admission
         assert len(sched.admit(pool)) == 1  # admits on an empty bucket
+
+    def test_oversized_request_rejected_at_submit(self):
+        """A request costlier than ``burst`` could NEVER be admitted (the
+        bucket refills only up to burst), so it must fail fast at submit
+        — not sit at its tenant's queue head forever, wedging every later
+        request behind a charge the bucket cannot cover (livelock)."""
+        clk = {"t": 0.0}
+        sched = TenantFairScheduler(quantum=100, rate=1.0, burst=4.0,
+                                    clock=lambda: clk["t"])
+        big = self._req(0, "A", cost=16)
+        assert not sched.submit(big)
+        assert big.state is RequestState.REJECTED
+        assert big.finish_reason == "oversized"
+        assert sched.qsize == 0
+        # the tenant is NOT wedged: a fitting request still flows, even
+        # across unlimited refill time
+        sched.submit(self._req(1, "A", cost=4))
+        clk["t"] = 1e6
+        assert len(sched.admit(SlotPool(1))) == 1
+        # cost == burst is admissible; no rate limit admits any cost
+        assert TenantFairScheduler(rate=1.0, burst=4.0).submit(
+            self._req(2, "A", cost=4))
+        assert TenantFairScheduler(quantum=100).submit(
+            self._req(3, "A", cost=16))
 
     def test_deficit_accumulates_across_rounds(self):
         """A request costlier than one quantum admits after enough visits
@@ -632,6 +707,73 @@ class TestDenseLoRA:
                         adapter="acme")  # no store configured
 
 
+class TestAdapterAdmissionGate:
+    """Admission-boundary re-validation of adapters (engine._gate_admitted):
+    submit-time checks go stale while a request queues — the gate must
+    defer (rows exhausted) or reject (adapter archive-evicted) instead of
+    letting ``acquire`` raise mid-``step()`` after the slot was granted."""
+
+    def _two_tenant_store(self, cfg, capacity):
+        store = _store_for(cfg, max_rank=2, capacity=capacity)
+        store.publish("a", _lora_for(cfg, 2, seed=8))
+        store.publish("b", _lora_for(cfg, 2, seed=9))
+        return store
+
+    def test_exhausted_store_defers_whole_prompt(self, dense_setup):
+        """More concurrent distinct adapters than table rows: the batch
+        the scheduler admits would exhaust the store mid-stamp (pre-gate:
+        RuntimeError inside step(), engine dead, pool inconsistent). The
+        overflow request defers in queue until the first retire unpins
+        its row; both finish."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(3)
+        store = self._two_tenant_store(cfg, capacity=1)
+        eng = ServingEngine(backend, adapters=store)
+        ra = eng.submit(_prompt(rng, 4), max_new_tokens=3, adapter="a")
+        rb = eng.submit(_prompt(rng, 4), max_new_tokens=3, adapter="b")
+        done = eng.drain()
+        assert {r.rid for r in done} == {ra.rid, rb.rid}
+        assert ra.state is RequestState.FINISHED
+        assert rb.state is RequestState.FINISHED
+        assert eng.pool.leaked() == 0
+        assert store.n_resident == 1  # b evicted a's unpinned row
+
+    def test_exhausted_store_defers_chunked(self, dense_setup):
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(4)
+        store = self._two_tenant_store(cfg, capacity=1)
+        eng = ServingEngine(backend, prefill_chunk=2, adapters=store)
+        ra = eng.submit(_prompt(rng, 4), max_new_tokens=3, adapter="a")
+        rb = eng.submit(_prompt(rng, 4), max_new_tokens=3, adapter="b")
+        eng.drain()
+        assert ra.state is RequestState.FINISHED
+        assert rb.state is RequestState.FINISHED
+        assert eng.pool.leaked() == 0
+
+    def test_archive_evicted_while_queued_is_rejected(self, dense_setup):
+        """An adapter archive-evicted (max_published) after submit but
+        before admission can never run again: the request exits REJECTED
+        with ``finish_reason="adapter_lost"`` (pre-gate: KeyError
+        mid-step) and later submissions keep flowing."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(5)
+        store = _store_for(cfg, max_rank=2, capacity=2, max_published=2)
+        store.publish("a", _lora_for(cfg, 2, seed=10))
+        eng = ServingEngine(backend, adapters=store)
+        r = eng.submit(_prompt(rng, 4), max_new_tokens=3, adapter="a")
+        store.publish("b", _lora_for(cfg, 2, seed=11))
+        store.publish("c", _lora_for(cfg, 2, seed=12))  # evicts "a"
+        assert not store.has("a")
+        done = eng.drain()
+        assert done == []
+        assert r.state is RequestState.REJECTED
+        assert r.finish_reason == "adapter_lost"
+        assert eng.pool.leaked() == 0
+        ok = eng.submit(_prompt(rng, 4), max_new_tokens=3, adapter="b")
+        eng.drain()
+        assert ok.state is RequestState.FINISHED
+
+
 class TestPrefixCacheTenancy:
     def _engine(self, backend, store=None):
         return ServingEngine(backend, prefill_chunk=4,
@@ -680,6 +822,31 @@ class TestPrefixCacheTenancy:
                            adapter="acme")
         eng.drain()
         assert stale.cache_hit_len == 0, "stale adapter-version KV reuse"
+        assert eng.pool.leaked() == 0
+
+    def test_republish_in_flight_parks_under_admitted_version(
+            self, dense_setup):
+        """The park namespace is CAPTURED at admission, not recomputed at
+        retire: republishing while a request is in flight must not park
+        its v1-computed KV under the v2 namespace — a later v2 request
+        would silently reuse wrong rows (the exact contamination the
+        versioned namespace exists to prevent)."""
+        cfg, params, backend = dense_setup
+        rng = np.random.default_rng(6)
+        store = _store_for(cfg, max_rank=2, capacity=2)
+        store.publish("acme", _lora_for(cfg, 2, seed=13))
+        eng = self._engine(backend, store)
+        prompt = _prompt(rng, 8)
+        r1 = eng.submit(prompt.copy(), max_new_tokens=4, tenant="t",
+                        adapter="acme")
+        eng.step()  # admitted: namespace frozen at v1
+        store.publish("acme", _lora_for(cfg, 2, seed=14))  # v2 mid-flight
+        eng.drain()
+        assert r1.state is RequestState.FINISHED
+        r2 = eng.submit(prompt.copy(), max_new_tokens=4, tenant="t",
+                        adapter="acme")
+        eng.drain()
+        assert r2.cache_hit_len == 0, "v1-derived KV served to v2"
         assert eng.pool.leaked() == 0
 
 

@@ -26,6 +26,9 @@ Masking order is top-k then top-p (nucleus over the k-survivors), the
 common serving convention. Ties at the k-th logit all survive (the rule is
 ``z >= kth``, deterministic); nucleus keeps every token whose preceding
 cumulative mass is < top_p, so the most probable token always survives.
+``top_p >= 1`` disables the nucleus mask EXACTLY (every token kept), not
+merely approximately: the cumulative-mass test is bypassed, so float32
+rounding of the running sum to 1.0 can never mask an extreme-tail token.
 """
 
 from __future__ import annotations
@@ -38,6 +41,22 @@ def fold_key(seed, pos):
     """The lockstep key for output position ``pos`` of a request seeded
     ``seed`` — both arguments may be traced (works under jit and vmap)."""
     return jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+
+
+def _nucleus_keep(z, top_p):
+    """Boolean top-p keep mask over one logits row ``z`` [V]: a token
+    stays if the cumulative mass strictly before it (descending order) is
+    ``< top_p`` — the head token always stays. ``top_p >= 1`` keeps
+    EVERYTHING unconditionally: over a peaked distribution the float32
+    cumulative sum rounds to exactly 1.0 before the tail, so the ``<``
+    test alone would mask extreme-tail tokens even though ``top_p=1.0``
+    is documented as disabling the nucleus."""
+    probs = jax.nn.softmax(z)
+    order = jnp.argsort(-probs)
+    sp = probs[order]
+    keep_sorted = (((jnp.cumsum(sp) - sp) < top_p)
+                   | (top_p >= jnp.float32(1.0)))
+    return jnp.zeros(z.shape, bool).at[order].set(keep_sorted)
 
 
 def _sample_one(seed, pos, logits, temp, top_p, top_k):
@@ -55,14 +74,8 @@ def _sample_one(seed, pos, logits, temp, top_p, top_k):
     k_eff = jnp.where((top_k <= 0) | (top_k >= v), v, top_k)
     kth = sorted_desc[jnp.clip(k_eff - 1, 0, v - 1)]
     z = jnp.where(z >= kth, z, -jnp.inf)
-    # top-p: nucleus over the k-survivors; a token stays if the cumulative
-    # mass strictly before it is < top_p (the head token always stays)
-    probs = jax.nn.softmax(z)
-    order = jnp.argsort(-probs)
-    sp = probs[order]
-    keep_sorted = (jnp.cumsum(sp) - sp) < top_p
-    keep = jnp.zeros((v,), bool).at[order].set(keep_sorted)
-    z = jnp.where(keep, z, -jnp.inf)
+    # top-p: nucleus over the k-survivors
+    z = jnp.where(_nucleus_keep(z, top_p), z, -jnp.inf)
     sampled = jax.random.categorical(fold_key(seed, pos), z).astype(jnp.int32)
     return jnp.where(temp > jnp.float32(0.0), sampled, greedy)
 

@@ -188,10 +188,13 @@ class AdapterStore:
         if (self.max_published is not None
                 and len(self._published) > self.max_published):
             # drop the least-recently published NON-resident archive copy
+            # (and its publish-order stamp — leaving it would leak one
+            # _pub_seq entry per evicted tenant under publish/evict churn)
             victims = [t for t in self._published if t not in self._rows]
             if victims:
-                del self._published[min(victims,
-                                        key=self._pub_seq.__getitem__)]
+                victim = min(victims, key=self._pub_seq.__getitem__)
+                del self._published[victim]
+                del self._pub_seq[victim]
         return int(version)
 
     def ingest(self, snapshot) -> int:
@@ -204,6 +207,40 @@ class AdapterStore:
 
     def has(self, tenant: str) -> bool:
         return tenant in self._published
+
+    def can_acquire(self, tenant: Optional[str]) -> bool:
+        """True when :meth:`acquire` would succeed without raising: no
+        adapter (row 0), an already-resident row, or a published adapter
+        with a free or unpinned (evictable) table row to land on.
+        Non-mutating — the engine's admission gate, so a request whose
+        adapter cannot be pinned right now is deferred in queue instead
+        of crashing ``step()`` mid-admission."""
+        if tenant is None:
+            return True
+        if tenant not in self._published:
+            return False
+        return tenant in self._rows or self.n_available_rows() > 0
+
+    def is_resident(self, tenant: str) -> bool:
+        """True when the tenant's adapter currently occupies a table row
+        (an acquire would be a refcount hit, never needing a free row)."""
+        return tenant in self._rows
+
+    def n_available_rows(self, exclude=()) -> int:
+        """Rows a NON-resident acquire could land on right now: free rows
+        plus unpinned resident rows (eviction candidates), minus unpinned
+        rows whose tenant is in ``exclude``. The engine's batch admission
+        gate passes the resident adapters the batch is about to pin as
+        ``exclude``, so one batch can never plan more fresh stagings than
+        the table can hold once its own resident hits are pinned."""
+        n = 0
+        for r in range(1, self.capacity + 1):
+            t = self._row_tenant[r]
+            if t is None:
+                n += 1
+            elif self._refcount[r] == 0 and t not in exclude:
+                n += 1
+        return n
 
     def version(self, tenant: str) -> int:
         return int(self._published[tenant]["version"])

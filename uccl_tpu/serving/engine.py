@@ -57,7 +57,8 @@ from uccl_tpu.utils.lru import LRUFnCache
 # obs.enable_tracing() and cost one bool check otherwise.
 _REJECTS = obs.counter(
     "serving_admission_rejected_total",
-    "requests rejected at submit by queue backpressure",
+    "requests rejected at submit: queue backpressure, or a token-bucket "
+    "cost that exceeds the tenant's burst (could never be admitted)",
 )
 _OCCUPANCY = obs.gauge(
     "serving_slot_occupancy", "KV slot-pool occupancy after the last step"
@@ -73,7 +74,8 @@ _PREFILL_TOKENS = obs.counter(
 _DROPPED = obs.counter(
     "serving_rejected_total",
     "queued requests dropped before admission: reason=deadline (aged out "
-    "of the queue) or reason=cancel (caller withdrew it)",
+    "of the queue), reason=cancel (caller withdrew it), or "
+    "reason=adapter_lost (the adapter was archive-evicted while queued)",
 )
 _PREEMPTS = obs.counter(
     "serving_preempted_total",
@@ -883,7 +885,7 @@ class ServingEngine:
             obs.instant("expire", track=req.track, rid=req.rid,
                         deadline_ms=req.deadline_ms)
         if self.prefill_chunk is None:
-            newly = self.sched.admit(self.pool)
+            newly, _ = self._gate_admitted(self.sched.admit(self.pool))
             if newly:
                 self._prefill(newly, finished)
             if self._by_slot:
@@ -925,6 +927,11 @@ class ServingEngine:
                                      make_room=self._make_room)
             if not batch:
                 break
+            batch, deferred = self._gate_admitted(batch)
+            if not batch:
+                if deferred:
+                    break  # adapter rows exhausted: retry next step
+                continue  # adapter-lost rejection: try the next head
             if limit is not None:
                 limit -= 1
             slot, req = batch[0]
@@ -1162,13 +1169,82 @@ class ServingEngine:
         ``wv``, so cached KV rows are adapter-dependent and a re-published
         adapter must never hit its predecessor's rows. The default tenant
         with no adapter maps to the root namespace (single-tenant engines
-        are unchanged)."""
+        are unchanged).
+
+        The namespace is CAPTURED at first admission (``_stamp_admit``)
+        and reused verbatim for the retire-time park: a request's KV was
+        computed under the adapter version pinned when it entered its
+        slot, so a republish while it is in flight must not relabel the
+        rows with the NEW version — that would hand v1-derived KV to v2
+        requests, the exact contamination the versioning exists to stop.
+        Before admission (queued peek/match) the current version is the
+        right answer — that IS the version admission would pin."""
+        if req._cache_ns is not None:
+            return req._cache_ns
         if req.adapter is not None:
             return (f"{req.tenant}|{req.adapter}"
                     f"@{self.adapters.version(req.adapter)}")
         if req.tenant != "default":
             return req.tenant
         return ""
+
+    def _gate_admitted(self, batch):
+        """Re-validate adapters for a just-admitted batch, BEFORE any slot
+        is stamped. Submit-time validation can go stale while a request
+        queues: an adapter archive-evicted under ``max_published`` can
+        never run again (the request exits REJECTED, ``adapter_lost``),
+        and a batch needing more fresh table rows than are free or
+        evictable must wait (DEFERRED back to the queue head — a retire
+        will unpin a row — together with every later admission of the
+        batch, so FIFO order within a tenant is preserved). Without this
+        gate ``adapters.acquire`` raises inside ``step()`` AFTER the
+        scheduler popped the request and the pool granted the slot,
+        crashing the engine with inconsistent queue/pool state.
+
+        The row budget is batch-aware: resident adapters the batch will
+        pin are excluded from the available count (``n_available_rows``),
+        so one batch can never plan a staging that evicts a row a later
+        admission of the same batch needs. Returns ``(survivors,
+        deferred_any)``; the scheduler never re-bills a requeued request
+        (``req.billed``), so deferral retries cost the tenant nothing."""
+        if self.adapters is None:
+            return batch, False
+        batch_resident = {r.adapter for _, r in batch
+                          if r.adapter is not None
+                          and self.adapters.is_resident(r.adapter)}
+        avail = self.adapters.n_available_rows(exclude=batch_resident)
+        staged = set()  # fresh (non-resident) adapters this batch stages
+        ok, deferred = [], []
+        for slot, req in batch:
+            gate = None
+            if deferred:
+                gate = "defer"
+            elif req.adapter is not None:
+                if not self.adapters.has(req.adapter):
+                    gate = "lost"
+                elif (not self.adapters.is_resident(req.adapter)
+                        and req.adapter not in staged):
+                    if len(staged) >= avail:
+                        gate = "defer"
+                    else:
+                        staged.add(req.adapter)
+            if gate is None:
+                ok.append((slot, req))
+                continue
+            self.pool.free(slot)
+            if gate == "lost":
+                req.state = RequestState.REJECTED
+                req.slot = None
+                req.finish_reason = "adapter_lost"
+                self.metrics.on_expire(req)
+                _DROPPED.inc(reason="adapter_lost")
+                obs.instant("reject", track=req.track, rid=req.rid,
+                            reason="adapter_lost")
+            else:
+                deferred.append(req)
+        for req in reversed(deferred):
+            self.sched.defer(req)
+        return ok, bool(deferred)
 
     def _stamp_admit(self, slot: int, req: Request) -> None:
         """Slot-entry bookkeeping for sampling + adapters: write the
@@ -1181,6 +1257,11 @@ class ServingEngine:
             row = self.adapters.acquire(req.adapter)
         req._adapter_row = row
         self._adapter_ids[slot] = row
+        if req._cache_ns is None:
+            # first slot grant: freeze the namespace under the adapter
+            # version just pinned (resume/adopt re-grants keep the
+            # original — their KV predates any later republish)
+            req._cache_ns = self._ns(req)
 
     def _release_slot(self, slot: int, req: Request) -> None:
         """Undo :meth:`_stamp_admit` when the request leaves its slot

@@ -75,6 +75,16 @@ class Request:
     adapter: Optional[str] = None  # LoRA adapter tenant name (AdapterStore)
     _adapter_row: int = field(default=0, repr=False, compare=False)
     # device table row pinned at admit (0 = the zero-rank fast path)
+    # prefix-cache namespace captured at FIRST admission (engine._ns):
+    # the adapter version the KV was actually computed under, so a
+    # republish while this request is in flight can never park its rows
+    # into the new version's namespace (cross-version contamination)
+    _cache_ns: Optional[str] = field(default=None, repr=False,
+                                     compare=False)
+    # set by TenantFairScheduler when this request's token cost is charged
+    # (first admission); a requeued copy — preemption resume, engine
+    # adapter-deferral — is never re-billed
+    billed: bool = field(default=False, repr=False, compare=False)
     deadline_ms: Optional[float] = None  # admission deadline after submit
     # distributed-tracing identity (obs/context.py): trace_id is minted
     # once at ingress (submit / Router.submit) and carried VERBATIM across
@@ -84,7 +94,9 @@ class Request:
     span_id: Optional[str] = None
     preemptions: int = 0  # times this request was paused for a higher class
     out_tokens: List[int] = field(default_factory=list)
-    finish_reason: Optional[str] = None  # "eos" | "length" | "deadline" | "cancel"
+    finish_reason: Optional[str] = None  # "eos" | "length" | "deadline" |
+    # "cancel" | "oversized" (cost > token-bucket burst, rejected at
+    # submit) | "adapter_lost" (adapter archive-evicted while queued)
     t_submit: float = 0.0
     t_admit: Optional[float] = None
     t_first_token: Optional[float] = None

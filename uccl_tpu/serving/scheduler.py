@@ -95,6 +95,26 @@ class FIFOScheduler:
         — the request already passed backpressure at submit."""
         self._queue.appendleft(req)
 
+    def defer(self, req: Request) -> None:
+        """Undo a just-granted admission: the engine's admission gate
+        denied the request AFTER :meth:`admit` popped it (e.g. no adapter
+        table row free), so put it back at the queue head with the
+        admission stamps reverted — deadline aging and ``cancel`` apply
+        exactly as before the attempt. A preemption victim waiting to
+        resume (saved-token marker set) returns to PREEMPTED, which stays
+        exempt from admission-deadline expiry; anything else is QUEUED
+        again. The caller frees the granted slot; a fair scheduler never
+        re-bills (``req.billed``)."""
+        if req._saved_last_tok is not None:
+            req.state = RequestState.PREEMPTED
+        else:
+            req.state = RequestState.QUEUED
+            if req.deadline_ms is not None:
+                # _place counted it admitted-in-time; it wasn't admitted
+                self._n_deadlined += 1
+        req.slot = None
+        self.requeue(req)
+
     def expire(self, t: float) -> List[Request]:
         """Drop every QUEUED request whose admission deadline passed at
         engine-clock ``t`` (state → EXPIRED, finish_reason "deadline").
@@ -238,8 +258,11 @@ class TenantFairScheduler(FIFOScheduler):
     hard per-tenant ceiling ON TOP of DRR's work-conserving share: a
     tenant above its rate holds in queue even when slots are free.
     ``rate=None`` (default) disables it — DRR alone is work-conserving.
-    A preempted request is NOT re-charged on resume (its tokens were
-    billed at first admission).
+    A request whose cost exceeds ``burst`` is REJECTED at submit: the
+    bucket refills only up to ``burst``, so such a request could never
+    be admitted and would otherwise wedge its tenant's queue head
+    forever (livelock). A preempted or engine-deferred request is NOT
+    re-charged on requeue (its tokens were billed at first admission).
 
     Per-tenant fairness and priority classes are mutually exclusive
     surfaces (the engine enforces it): within a tenant, order is FIFO.
@@ -290,6 +313,14 @@ class TenantFairScheduler(FIFOScheduler):
         if self.max_queue is not None and self.qsize >= self.max_queue:
             req.state = RequestState.REJECTED
             return False
+        if self.rate is not None and self._cost(req) > self.burst:
+            # the bucket never holds more than `burst` tokens, so this
+            # request's charge could never be covered: fail fast at
+            # submit instead of silently blocking the tenant's FIFO head
+            # for every later request (admission livelock)
+            req.state = RequestState.REJECTED
+            req.finish_reason = "oversized"
+            return False
         self._tenant_queue(req.tenant).append(req)
         if req.deadline_ms is not None:
             self._n_deadlined += 1
@@ -329,8 +360,9 @@ class TenantFairScheduler(FIFOScheduler):
                 self._deficit[tenant] += self.quantum
                 while q and (limit is None or len(admitted) < limit):
                     req = q[0]
-                    # a resumed preemption was billed at first admission
-                    charge = 0 if req.preemptions else self._cost(req)
+                    # a requeued request (preemption resume, engine
+                    # adapter-deferral) was billed at first admission
+                    charge = 0 if req.billed else self._cost(req)
                     if (self.rate is not None
                             and self._bucket[tenant] < charge):
                         break  # rate-limited: holds even with free slots
@@ -356,6 +388,7 @@ class TenantFairScheduler(FIFOScheduler):
                     self._deficit[tenant] -= charge
                     if self.rate is not None:
                         self._bucket[tenant] -= charge
+                    req.billed = True
                     admitted.append((slot, req))
                     progress = True
                 if not q:

@@ -925,6 +925,8 @@ def run_router_arm(args, jax, stack, rate, n_slots, prefill_chunk,
     })
     if "per_class" in snap:
         arm["per_class"] = snap["per_class"]
+    if "per_tenant" in snap:
+        arm["per_tenant"] = snap["per_tenant"]
     arm["obs"] = obs.REGISTRY.snapshot()["metrics"]
     return arm
 

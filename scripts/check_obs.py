@@ -977,6 +977,76 @@ def check_fleet_metrics(path: str) -> None:
           f"percentile cross-check(s) within one bucket width")
 
 
+def check_fleet_cache_metrics(path: str, bench_json: str) -> None:
+    """The fleet prefix-cache smoke arm (benchmarks/fleet_bench.py): a
+    prefix computed on one worker PROCESS must land as a counted,
+    wire-audited hit on another — >= 1 ``fleet_cache_hits_total`` with
+    nonzero ``p2p_bytes_total{verb="kv_tier"}`` in the federated prom and
+    a live per-replica ``fleet_dir_resident_entries`` gauge; the bench
+    JSON must show the directory arm computing strictly fewer prefill
+    tokens AND reaching first token sooner than the no-directory arm,
+    every arm bit-exact vs the one-shot oracle with request conservation,
+    and the chaos arm absorbing the owner kill (counted dial error +
+    directory invalidation, never a wrong byte)."""
+    samples = _parse_prom_labeled(path)
+    hits = sum(v for n, lab, v in samples
+               if n == "fleet_cache_hits_total" and "replica" in lab)
+    if hits < 1:
+        fail(f"{path}: zero replica-labeled fleet_cache_hits_total — no "
+             f"cross-worker prefix import was ever counted")
+    wire = sum(v for n, lab, v in samples
+               if n == "p2p_bytes_total" and lab.get("verb") == "kv_tier"
+               and "replica" in lab)
+    if wire <= 0:
+        fail(f"{path}: fleet hits without p2p_bytes_total{{verb="
+             f"\"kv_tier\"}} bytes — the 'import' never crossed the wire")
+    resident = [(lab.get("replica"), v) for n, lab, v in samples
+                if n == "fleet_dir_resident_entries" and "replica" in lab]
+    if not any(v > 0 for _, v in resident):
+        fail(f"{path}: no live fleet_dir_resident_entries gauge — the "
+             f"directory view is invisible (samples: {resident})")
+
+    with open(bench_json) as f:
+        bench = json.load(f)
+    arms = bench.get("arms", {})
+    for need in ("no_directory", "directory", "chaos"):
+        if need not in arms:
+            fail(f"{bench_json}: missing arm {need!r} (have "
+                 f"{sorted(arms)})")
+    for name, arm in arms.items():
+        if not arm.get("oracle_exact"):
+            fail(f"{bench_json}: arm {name!r} not bit-exact vs the "
+                 f"one-shot oracle — the fleet path corrupted KV")
+        if not arm.get("conserved"):
+            fail(f"{bench_json}: arm {name!r} leaked slots or lost "
+                 f"requests (conservation broken)")
+    d, b = arms["directory"], arms["no_directory"]
+    if d.get("fleet_hits", 0) < 1:
+        fail(f"{bench_json}: directory arm counted no fleet hits")
+    if d["computed_prefill_tokens"] >= b["computed_prefill_tokens"]:
+        fail(f"{bench_json}: directory arm computed "
+             f"{d['computed_prefill_tokens']} prefill tokens vs baseline "
+             f"{b['computed_prefill_tokens']} — the directory saved "
+             f"nothing")
+    if d["ttft_ms_mean"] >= b["ttft_ms_mean"]:
+        fail(f"{bench_json}: directory TTFT {d['ttft_ms_mean']} ms not "
+             f"below baseline {b['ttft_ms_mean']} ms — importing cost "
+             f"more than recomputing")
+    c = arms["chaos"]
+    if c.get("invalidations", 0) < 1:
+        fail(f"{bench_json}: chaos arm swept no directory entries — the "
+             f"dead owner's refs are still live")
+    if c.get("dial_errors", 0) < 1:
+        fail(f"{bench_json}: chaos arm never dialed the dead owner — the "
+             f"kill landed after the measured window")
+    print(f"check_obs: fleet cache OK — {int(hits)} cross-worker hit(s), "
+          f"{int(wire)} kv_tier wire bytes, "
+          f"{d['computed_prefill_tokens']}/{b['computed_prefill_tokens']} "
+          f"computed prefill tokens, TTFT {d['ttft_ms_mean']}/"
+          f"{b['ttft_ms_mean']} ms, chaos invalidations "
+          f"{int(c['invalidations'])}")
+
+
 def main(argv) -> None:
     if len(argv) == 4 and argv[1] == "--fleet":
         check_fleet_trace(argv[2])
@@ -1027,6 +1097,10 @@ def main(argv) -> None:
         check_tenants_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
         return
+    if len(argv) == 4 and argv[1] == "--fleet-cache":
+        check_fleet_cache_metrics(argv[2], argv[3])
+        print("check_obs: ALL OK")
+        return
     if len(argv) != 3:
         fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
@@ -1040,7 +1114,8 @@ def main(argv) -> None:
              "check_obs.py --transport METRICS_PROM [BENCH_JSON] | "
              "check_obs.py --spec METRICS_PROM | "
              "check_obs.py --router METRICS_PROM | "
-             "check_obs.py --fleet MERGED_TRACE FLEET_PROM")
+             "check_obs.py --fleet MERGED_TRACE FLEET_PROM | "
+             "check_obs.py --fleet-cache FLEET_PROM BENCH_JSON")
     check_trace(argv[1])
     check_metrics(argv[2])
     print("check_obs: ALL OK")

@@ -105,6 +105,12 @@ python -m uccl_tpu.obs.aggregate --out /tmp/qa_fleet.prom \
   prefill=/tmp/qa_disagg_metrics.prom decode=/tmp/qa_disagg_metrics.decode.prom; check $?
 python scripts/check_obs.py --fleet /tmp/qa_fleet_merged.json /tmp/qa_fleet.prom; check $?
 
+note "fleet prefix-cache smoke tier (2 prefill-worker processes over one directory: a prefix computed on worker 0 lands as a counter-audited cross-worker hit on worker 1 with fewer computed prefill tokens + lower TTFT than the no-directory arm, chaos arm kills the owner mid-stream with its entries invalidated, every arm oracle-exact)"
+JAX_PLATFORMS=cpu timeout 600 python benchmarks/fleet_bench.py --smoke \
+  --metrics-out /tmp/qa_fleetcache_metrics.prom \
+  --json-out /tmp/qa_fleetcache_bench.json; check $?
+python scripts/check_obs.py --fleet-cache /tmp/qa_fleetcache_metrics.prom /tmp/qa_fleetcache_bench.json; check $?
+
 note "observability smoke tier (2-slot serving run traced end to end: Chrome-trace lifecycle timelines + Prometheus metrics validate)"
 JAX_PLATFORMS=cpu timeout 600 python -m uccl_tpu.serve --server --devices 2 --slots 2 \
   --requests 6 --prompt-len 8 --new-tokens 4 --arrival-rate 50 --check-oracle \

@@ -282,3 +282,64 @@ def test_heal_then_broadcast_and_a2a(rng):
         b, a = results[r]
         assert b == 2.0
         assert a == [1.0, 2.0]
+
+
+def test_scheduled_a2a_demotes_degraded_edges(rng):
+    """Cross-pod scheduled a2a consults link quality (ISSUE 19): an edge
+    whose channel EWMA sank below ``path_floor`` is demoted to the
+    invocation tail instead of stalling healthy rounds behind it. The
+    degraded view is asymmetric ON PURPOSE — link scores are per-endpoint
+    observations, not SPMD state — and the exchange must stay bit-correct
+    anyway (reordering is a purely local decision: sends are one-sided
+    and licensed two invocations back, recvs wait on exact call indices
+    over independent channels)."""
+    from uccl_tpu import obs
+    from uccl_tpu.ep import a2a_sched
+
+    world = 3
+    m = np.ones((world, world)) - np.eye(world)
+    sched = a2a_sched.wire_schedule(m, world)
+    xs = [rng.standard_normal((world, 6)).astype(np.float32)
+          for _ in range(world)]
+
+    def body(g, r):
+        o1 = g.all_to_all(xs[r], schedule=sched)  # builds the mesh
+        if r == 0:
+            # only rank 0 sees its link to rank 1 as sick
+            g._mesh[1]._link_ewma = 0.0
+        o2 = g.all_to_all(xs[r] + 1.0, schedule=sched, path_floor=0.5)
+        # and the next interleaved replay still lines up (the demotion
+        # changed wait order only, never call indices)
+        o3 = g.all_to_all(xs[r] + 2.0, schedule=sched)
+        return o1, o2, o3
+
+    s0 = obs.counter("dcn_a2a_demotions_total").get(dir="send")
+    r0 = obs.counter("dcn_a2a_demotions_total").get(dir="recv")
+    outs = _run_group(world, body)
+    for i, (o1, o2, o3) in enumerate(outs):
+        for j in range(world):
+            np.testing.assert_array_equal(o1[j], xs[j][i])
+            np.testing.assert_array_equal(o2[j], xs[j][i] + 1.0)
+            np.testing.assert_array_equal(o3[j], xs[j][i] + 2.0)
+    # rank 0 demoted exactly its send to and recv from rank 1
+    assert obs.counter("dcn_a2a_demotions_total").get(dir="send") == s0 + 1
+    assert obs.counter("dcn_a2a_demotions_total").get(dir="recv") == r0 + 1
+
+
+def test_scheduled_a2a_without_floor_matches(rng):
+    """The scheduled path (no floor) replays the contention-aware round
+    interleaving and stays exact — the ISSUE 19 restructure must not
+    have changed the default order's semantics."""
+    from uccl_tpu.ep import a2a_sched
+
+    world = 4
+    mat = np.abs(rng.standard_normal((world, world)))
+    np.fill_diagonal(mat, 0)
+    sched = a2a_sched.wire_schedule(mat, world)
+    xs = [rng.standard_normal((world, 9)).astype(np.float32)
+          for _ in range(world)]
+    outs = _run_group(
+        world, lambda g, r: g.all_to_all(xs[r], schedule=sched))
+    for i, out in enumerate(outs):
+        for j in range(world):
+            np.testing.assert_array_equal(out[j], xs[j][i])

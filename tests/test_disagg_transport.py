@@ -167,3 +167,81 @@ class TestLossyChannelFanIn:
         assert len(de.metrics.disagg_transfer_s) == 1
         assert de.metrics.disagg_transfer_s[0] >= 0
         assert r.out_tokens == _oracle(params, cfg, r)
+
+
+@pytest.mark.slow
+class TestFanIn3x2BitExact:
+    def test_three_prefill_two_decode_bit_exact(self, dense_setup):
+        """The N×M plane (ISSUE 19): THREE prefill engines × TWO decode
+        workers, six channel bonds through shared per-engine fan-out
+        sinks. Every adopted request — spread so each engine serves both
+        decode pools and each pool adopts from all three engines — must
+        stay bit-identical to the one-shot oracle, and tenants must ride
+        BEGIN to the adopting side's per-tenant series."""
+        from uccl_tpu.p2p import Endpoint
+        from uccl_tpu.serving.disagg import (
+            DecodeWorker, _ChunkFanout, add_local_prefill,
+        )
+
+        cfg, params, DenseBackend = dense_setup
+        pes = [ServingEngine(DenseBackend(params, cfg, n_slots=2,
+                                          max_seq=MAX_SEQ),
+                             prefill_chunk=4) for _ in range(3)]
+        des = [ServingEngine(DenseBackend(params, cfg, n_slots=4,
+                                          max_seq=MAX_SEQ))
+               for _ in range(2)]
+        dws = [DecodeWorker(de, Endpoint(), pull_rate_bps=64e6)
+               for de in des]
+        pws = {}
+        for i, pe in enumerate(pes):
+            for j, dw in enumerate(dws):
+                pws[(i, j)] = add_local_prefill(
+                    dw, pe, transport="channel", n_paths=2,
+                    chunk_bytes=8 << 10, pull=True)
+        for pe in pes:
+            assert isinstance(pe.chunk_sink, _ChunkFanout)
+            assert len(pe.chunk_sink.sinks) == 2
+
+        def pump(n_done, done, deadline_s=180.0):
+            deadline = time.monotonic() + deadline_s
+            while len(done) < n_done:
+                for pw in pws.values():
+                    pw.step()
+                for dw in dws:
+                    done.extend(dw.step())
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"3x2 stalled at {len(done)}")
+            return done
+
+        try:
+            rng = np.random.default_rng(29)
+            prompts = [rng.integers(0, 64, 6 + i).astype(np.int32)
+                       for i in range(6)]
+            done = []
+            for i, p in enumerate(prompts):
+                r = pws[(i % 3, i % 2)].submit(
+                    p, max_new_tokens=4,
+                    tenant="acme" if i % 2 else "default")
+                assert r is not None
+                for pw in pws.values():
+                    pw.step()
+                for dw in dws:
+                    done.extend(dw.step())
+            pump(6, done)
+        finally:
+            for dw in dws:
+                dw.close()
+
+        assert len(done) == 6
+        for r in done:
+            assert r.adopted
+            assert r.out_tokens == _oracle(params, cfg, r), r.rid
+        # the spread was real: both decode pools adopted 3 requests
+        for de in des:
+            assert de.metrics.snapshot()["completed"] == 3
+            assert de.pool.leaked() == 0
+        for pe in pes:
+            assert pe.pool.leaked() == 0
+        # tenants rode BEGIN across all six bonds
+        assert sorted(r.tenant for r in done) \
+            == ["acme"] * 3 + ["default"] * 3

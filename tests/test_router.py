@@ -424,11 +424,13 @@ class TestRouter:
                 return self._bp
 
             def submit(self, prompt, *, max_new_tokens=16, eos_id=None,
-                       priority="interactive", trace=None):
+                       priority="interactive", tenant="default",
+                       trace=None):
                 return self.engine.submit(prompt,
                                           max_new_tokens=max_new_tokens,
                                           eos_id=eos_id,
-                                          priority=priority, trace=trace)
+                                          priority=priority,
+                                          tenant=tenant, trace=trace)
 
             def step(self):
                 if self.engine.has_work():

@@ -480,7 +480,8 @@ class DcnGroup:
                 )
             raise IOError(f"all_to_all: unexpected control message {m[:8]!r}")
 
-    def all_to_all(self, x: np.ndarray, schedule=None) -> np.ndarray:
+    def all_to_all(self, x: np.ndarray, schedule=None,
+                   path_floor: Optional[float] = None) -> np.ndarray:
         """x: [world, ...] — row j goes to rank j; out[i] = rank i's row for us.
 
         This is the cross-pod EP exchange primitive (the DCN leg of a
@@ -502,6 +503,24 @@ class DcnGroup:
         deadlock). Every write still rides the multipath Channel (SACK +
         PathQuality steering). Same bytes, same result, any order; all
         pods must pass the SAME schedule (it is SPMD state).
+
+        ``path_floor`` (scheduled path only, ISSUE 19) — consult each
+        mesh channel's cross-transfer
+        :meth:`~uccl_tpu.p2p.channel.Channel.link_score`: edges whose
+        link EWMA has sunk below the floor are **demoted** to the tail of
+        this invocation instead of stalling the healthy rounds behind a
+        sick link. The execution order becomes all sends (healthy rounds
+        first, degraded last — a send never blocks on peer progress
+        within an invocation: the deferred license it waits for was
+        shipped two invocations ago and the write itself is one-sided),
+        then all recvs (same split — each blocks only on its own peer's
+        data frame, on an independent channel, tagged with its exact call
+        index). That makes the reordering a purely LOCAL decision: ranks
+        may disagree about which edges are degraded (link scores are
+        per-endpoint observations, not SPMD state) and the exchange still
+        cannot deadlock — only the waits' order changes, never the
+        landing regions or call indices. Demotions land on
+        ``dcn_a2a_demotions_total{dir}``.
         """
         n = self.active_world
         if x.shape[0] != n:
@@ -567,6 +586,8 @@ class DcnGroup:
                     raise ValueError(
                         f"schedule round {r} does not carry pair ({s}, {d})"
                     )
+        sends: List[int] = []  # designated peer positions, round order
+        recvs: List[int] = []
         for r, perm in enumerate(perms):
             if sorted(perm) != list(range(n)):
                 raise ValueError(
@@ -576,9 +597,45 @@ class DcnGroup:
             dst_pos = perm[me]
             src_pos = perm.index(me)
             if dst_pos != me and int(k_mat[me, dst_pos]) == r:
-                _send_row(dst_pos)
+                sends.append(dst_pos)
             if src_pos != me and int(k_mat[src_pos, me]) == r:
-                _recv_row(src_pos)
+                recvs.append(src_pos)
+        if path_floor is None:
+            # round-interleaved (the contention-aware order the schedule
+            # encodes): K designates each of my edges to exactly one
+            # round, so zipping the two lists back is the original loop
+            si = ri = 0
+            for r, perm in enumerate(perms):
+                if si < len(sends) and int(k_mat[me, sends[si]]) == r:
+                    _send_row(sends[si])
+                    si += 1
+                if ri < len(recvs) and int(k_mat[recvs[ri], me]) == r:
+                    _recv_row(recvs[ri])
+                    ri += 1
+            return out
+
+        def _degraded(pos: int) -> bool:
+            score = self._mesh[self._active[pos]].link_score()
+            return score is not None and score < path_floor
+
+        demoted_s = [p for p in sends if _degraded(p)]
+        demoted_r = [p for p in recvs if _degraded(p)]
+        if demoted_s or demoted_r:
+            from uccl_tpu.obs import counters as _obsc
+
+            c = _obsc.counter(
+                "dcn_a2a_demotions_total",
+                "scheduled-a2a edges pushed to the invocation tail "
+                "because their link-quality EWMA sank below path_floor",
+            )
+            if demoted_s:
+                c.inc(len(demoted_s), dir="send")
+            if demoted_r:
+                c.inc(len(demoted_r), dir="recv")
+        for p in [q for q in sends if q not in demoted_s] + demoted_s:
+            _send_row(p)
+        for p in [q for q in recvs if q not in demoted_r] + demoted_r:
+            _recv_row(p)
         return out
 
     def broadcast(self, x: np.ndarray, root: int = 0) -> np.ndarray:

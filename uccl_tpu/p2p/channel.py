@@ -193,6 +193,12 @@ class Channel:
         # fixed window_bytes cap. Enable via enable_window_cc().
         self.window_cc = None
         self._last_win = None  # last transfer's SackTxWindow (stats)
+        # persistent link-quality EWMA (ISSUE 19): the per-transfer
+        # PathQuality resets with each SackTxWindow, so cross-transfer
+        # consumers (the DCN scheduled-a2a demotion) fold each finished
+        # window's WORST per-path delivery score here. None until the
+        # first windowed transfer completes or fails.
+        self._link_ewma: Optional[float] = None
         self._abandoned: List[int] = []  # timed-out xids awaiting terminal
         self._grant_xids: List[int] = []  # fire-and-forget grant writes
         self._cc_probe_logged = False  # log-once guard for probe errors
@@ -710,6 +716,18 @@ class Channel:
             )
             _CHAN_SRTT.set(win.srtt_us)
             _CHAN_RTO.set(win.rto_s * 1e3)
+            worst = min(win.paths.score)
+            self._link_ewma = (worst if self._link_ewma is None
+                               else 0.5 * self._link_ewma + 0.5 * worst)
+
+    def link_score(self) -> Optional[float]:
+        """Cross-transfer link quality in [0, 1]: an EWMA (over completed
+        windowed transfers) of the worst per-path delivery score — the
+        pessimistic signal a scheduler reads to demote this link's edges
+        (``DcnGroup.all_to_all(path_floor=...)``) while the per-transfer
+        PathQuality keeps steering chunks WITHIN the link. None until a
+        windowed transfer has run."""
+        return self._link_ewma
 
     def transport_stats(self) -> dict:
         """Snapshot of the windowed transport's state: last transfer's
@@ -726,6 +744,7 @@ class Channel:
             pull_sent=self._pull_sent,
             pull_credit=(int(self._credit_buf[0])
                          if self._credit_buf is not None else 0),
+            link_score=self._link_ewma,
         )
         return st
 

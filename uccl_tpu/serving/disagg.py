@@ -249,6 +249,22 @@ class _TxStream:
     cache_hit_len: int = 0  # rows reused from the prefix cache
 
 
+class _ChunkFanout:
+    """One prefill engine's chunk sink shared by several
+    :class:`PrefillWorker` bonds — the N×M plane (ISSUE 19): each bond
+    streams to a DIFFERENT decode worker over its own conn. Every bond
+    sees every event and picks up only the rids it opened (``_on_chunks``
+    drops unknown rids; a rid is submitted through exactly one bond), so
+    no slab is ever exported or shipped twice."""
+
+    def __init__(self):
+        self.sinks: List = []
+
+    def __call__(self, events) -> None:
+        for s in self.sinks:
+            s(events)
+
+
 class PrefillWorker:
     """The prefill-fleet role: a chunked-prefill ``ServingEngine`` whose
     per-chunk KV output streams to one decode worker as it is computed.
@@ -272,13 +288,17 @@ class PrefillWorker:
     def submit(self, prompt, *, max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
                priority: str = "interactive",
+               tenant: str = "default",
                trace=None) -> Optional[Request]:
         """Open a KV stream and queue the prompt on the prefill engine
         (``max_new_tokens=1`` locally — this fleet never decodes; the
         requested budget rides the BEGIN message to the decode side).
         ``priority`` orders this fleet's own prefill queue (when its
         engine runs priority classes) and rides BEGIN so the adopted
-        request keeps its class label decode-side. ``trace`` carries a
+        request keeps its class label decode-side. ``tenant`` rides the
+        same way: it namespaces this fleet's prefix cache AND labels the
+        decode side's adoption, so fleet-merged per-tenant series stay
+        truthful across the process split. ``trace`` carries a
         router-minted :class:`~uccl_tpu.obs.TraceContext` (None mints one
         here); it rides BEGIN verbatim so the decode side's spans join the
         same fleet-wide timeline. Returns the local Request, or None on
@@ -286,7 +306,8 @@ class PrefillWorker:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         ctx = trace if trace is not None else obs.new_context()
         req = self.engine.submit(prompt, max_new_tokens=1,
-                                 priority=priority, trace=ctx)
+                                 priority=priority, tenant=tenant,
+                                 trace=ctx)
         if req is None:
             return None
         st = _TxStream(req.rid, prompt, max_new_tokens, eos_id,
@@ -295,7 +316,7 @@ class PrefillWorker:
         st.begin_msg = {
             "t": "begin", "rid": req.rid, "prompt": prompt.tolist(),
             "max_new_tokens": max_new_tokens, "eos_id": eos_id,
-            "priority": priority,
+            "priority": priority, "tenant": tenant,
             "t_submit": st.t_submit_wall,
             "trace": ctx.to_wire(),
         }
@@ -922,6 +943,7 @@ class DecodeWorker:
             max_new_tokens=int(begin["max_new_tokens"]),
             eos_id=begin["eos_id"], slot=slot,
             priority=begin.get("priority", "interactive"),
+            tenant=begin.get("tenant", "default"),
             queue_s=t_admit - t_submit, prefill_s=t_done - t_admit,
             transfer_s=t_adopt - t_done,
             trace=trace,
@@ -1114,7 +1136,10 @@ def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,
     without ack)."""
     if engine.prefill_chunk is None:
         raise ValueError("PrefillWorker needs a chunked engine")
-    if engine.chunk_sink is not None:
+    sink = engine.chunk_sink
+    if sink is None:
+        sink = _ChunkFanout()
+    elif not isinstance(sink, _ChunkFanout):
         raise ValueError("engine already has a chunk_sink")
     hello = json.loads(ep.recv(conn, timeout_ms=timeout_ms))
     assert hello.get("t") == "hello", hello
@@ -1154,7 +1179,8 @@ def _init_prefill_worker(pw: PrefillWorker, engine: ServingEngine, ep,
     pw.clock_rtt_s = None
     pw._clock_pings_left = 8
     pw._send_clock_ping()
-    engine.chunk_sink = pw._on_chunks
+    sink.sinks.append(pw._on_chunks)
+    engine.chunk_sink = sink
 
 
 def drive_pair(pw: PrefillWorker, dw: DecodeWorker, prompts, arrivals,

@@ -598,6 +598,7 @@ class ServingEngine:
         if kv_tiers is not None:
             kv_tiers.attach(backend, prefix_cache)
         self.chunk_sink = chunk_sink
+        self.fleet = None  # FleetWorker once attach_fleet() is called
         self.priority_classes = priority_classes
         self.preempt = preempt
         self.adapters = adapters
@@ -630,6 +631,28 @@ class ServingEngine:
             ServingEngine._stats_seq += 1
             self._stats_name = "serving" if n == 0 else f"serving-{n}"
             self.metrics.register(self, self._stats_name)
+
+    def attach_fleet(self, fleet) -> None:
+        """Bind this engine to the fleet prefix-cache plane
+        (``serving/fleet.py``, ISSUE 19): ``fleet.fetch`` is consulted
+        when an admission misses the local trie, and the fleet's
+        publisher (when it carries one) becomes the trie's residency
+        listener so parked entries are advertised in the shared
+        directory. Requires a chunked engine with a prefix cache — the
+        fleet is an extension of the trie, not a replacement."""
+        if self.prefix_cache is None or self.prefill_chunk is None:
+            raise ValueError(
+                "attach_fleet requires prefill_chunk + prefix_cache: the "
+                "fleet directory indexes chunk-aligned trie entries"
+            )
+        self.fleet = fleet
+        pub = getattr(fleet, "publisher", None)
+        if pub is not None:
+            if pub.backend is None:
+                pub.backend = self.backend
+            if pub.tiers is None:
+                pub.tiers = self.kv_tiers
+            self.prefix_cache.listener = pub
 
     # -- submission ---------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: int = 16,
@@ -954,6 +977,7 @@ class ServingEngine:
             req.prefill_pos = 0
             self._stamp_admit(slot, req)
             if self.prefix_cache is not None:
+                hit_exact, hit_tag = True, None
                 matched, donor = self.prefix_cache.match(req.prompt,
                                                          self._ns(req))
                 if matched > 0:
@@ -981,17 +1005,28 @@ class ServingEngine:
                         self.prefix_cache.replace_ref(donor, None)
                         self.prefix_cache.count_stale_miss()
                         matched = 0
+                    if matched > 0:
+                        hit_exact = getattr(donor, "exact", True)
+                        hit_tag = (int(donor)
+                                   if isinstance(donor, (int, np.integer))
+                                   else repr(donor))
+                if matched == 0 and self.fleet is not None:
+                    # local miss (already counted): consult the fleet
+                    # directory — a peer may hold this prefix, in which
+                    # case its entry is fetched over the T2 wire path
+                    # into THIS request's slot (fleet.py; a stale owner
+                    # degrades back to the cold miss, never wrong bytes)
+                    matched, hit_exact = self.fleet.fetch(
+                        req.prompt, self._ns(req), slot, self.backend)
+                    if matched > 0:
+                        hit_tag = f"fleet:{matched}"
                 if matched > 0:
                     req.prefill_pos = matched
                     req.cache_hit_len = matched
-                    req.cache_hit_exact = getattr(donor, "exact", True)
+                    req.cache_hit_exact = hit_exact
                     _PREFILL_TOKENS.inc(matched, kind="skipped")
                     obs.instant("prefix_hit", track=req.track, slot=slot,
-                                donor=(int(donor)
-                                       if isinstance(donor,
-                                                     (int, np.integer))
-                                       else repr(donor)),
-                                matched=matched)
+                                donor=hit_tag, matched=matched)
                     events.append(ChunkEvent(req, slot, 0, matched,
                                              False, None, True))
             self._by_slot[slot] = req

@@ -100,6 +100,12 @@ _ATTACHED = obs.counter(
     "replicas added to a live router (warm-spare admission / elastic "
     "up-scale)",
 )
+_CACHE_STEERED = obs.counter(
+    "serving_router_cache_steered_total",
+    "admissions whose winning replica was ranked with a non-zero cached "
+    "prefix (local trie or fleet-directory longest-prefix match) — the "
+    "cache-aware steering signal actually changing placement",
+)
 # declared in serving/health.py (one family, shared label space)
 _RECOVERED_COUNTER = obs.counter("serving_recovered_total")
 
@@ -139,11 +145,17 @@ class Router:
     """
 
     def __init__(self, replicas: List, *, bp_tokens: int = 64,
-                 detector: Optional[FailureDetector] = None):
+                 detector: Optional[FailureDetector] = None,
+                 directory=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas = list(replicas)
         self.bp_tokens = bp_tokens
+        # optional fleet prefix-cache directory (serving/fleet.py): ranking
+        # then credits the replica OWNING the deepest published prefix of
+        # the submitted prompt, so shared system prompts steer toward the
+        # worker that already holds their KV (cache-aware steering)
+        self.directory = directory
         self.routed = [0] * len(self.replicas)  # per-replica admit counts
         # stable per-replica ids: counter labels and detector peers keep
         # their identity across detach/attach (list indices shift)
@@ -254,7 +266,8 @@ class Router:
                     continue
                 new_req = self._submit_to(
                     i, req.prompt, max_new_tokens=req.max_new_tokens,
-                    eos_id=req.eos_id, priority=req.priority, trace=ctx,
+                    eos_id=req.eos_id, priority=req.priority,
+                    tenant=req.tenant, trace=ctx,
                     deadline_ms=ddl,
                 )
                 if new_req is not None:
@@ -276,21 +289,51 @@ class Router:
                         trace_id=req.trace_id)
 
     # -- the routing decision ------------------------------------------
-    def _ranked(self) -> Tuple[List[Tuple[tuple, int]], Dict[int, Dict]]:
+    def _prefix_tokens(self, i: int, prompt, ns: str,
+                       dir_hit=None) -> int:
+        """Cached-prefix depth (tokens) replica ``i`` could resume this
+        prompt from: the deepest of its own trie's longest-prefix match
+        (side-effect-free — no counters, no LRU refresh) and the fleet
+        directory's deepest entry WHEN this replica owns it. In debt-token
+        units by construction: every matched token is prefill work the
+        replica does not have to do."""
+        eng = engine_of(self.replicas[i])
+        best = 0
+        cache = eng.prefix_cache
+        if cache is not None:
+            best = cache._lookup(prompt, ns)[0]
+        if dir_hit is not None:
+            fleet = getattr(eng, "fleet", None)
+            if fleet is not None and dir_hit.get("owner") == fleet.worker:
+                best = max(best, int(dir_hit.get("tokens", 0)))
+        return best
+
+    def _ranked(self, prompt=None, tenant: str = "default"
+                ) -> Tuple[List[Tuple[tuple, int]], Dict[int, Dict]]:
         """ROUTABLE replicas ranked least-loaded first (dead, draining
-        and detector-suspect replicas are excluded). The index tail
-        rotates with the total routed count so exactly-equal replicas
-        take turns instead of always electing replica 0 (cold-start
-        skew)."""
+        and detector-suspect replicas are excluded). With ``prompt`` the
+        rank also credits cached prefixes (local trie / fleet directory
+        longest-prefix match) against the debt term — cache-aware
+        steering. The index tail rotates with the total routed count so
+        exactly-equal replicas take turns instead of always electing
+        replica 0 (cold-start skew)."""
         n = len(self.replicas)
         rot = sum(self.routed) % n
+        ns = "" if tenant == "default" else tenant
+        dir_hit = None
+        if prompt is not None and self.directory is not None:
+            dir_hit = self.directory.lookup(prompt, ns)
         ranked = []
         for i, r in enumerate(self.replicas):
             if not self._routable(i):
                 continue
             s = replica_signals(r)
+            if prompt is not None:
+                s["prefix_tokens"] = self._prefix_tokens(
+                    i, prompt, ns, dir_hit)
             key = (
-                s["debt_tokens"] + self.bp_tokens * s["backpressure"],
+                s["debt_tokens"] + self.bp_tokens * s["backpressure"]
+                - s.get("prefix_tokens", 0),
                 -s["free_slots"],
                 s["queue_wait_ms"],
                 (i - rot) % n,
@@ -301,6 +344,7 @@ class Router:
 
     def _submit_to(self, i: int, prompt, *, max_new_tokens: int,
                    eos_id, priority: str, trace,
+                   tenant: str = "default",
                    deadline_ms: Optional[float] = None
                    ) -> Optional[Request]:
         """One admission attempt against replica ``i`` (engine or disagg
@@ -311,17 +355,21 @@ class Router:
         if replica is eng:
             return eng.submit(prompt, max_new_tokens=max_new_tokens,
                               eos_id=eos_id, priority=priority,
+                              tenant=tenant,
                               deadline_ms=deadline_ms, trace=trace)
-        # disagg prefill worker: the decode budget and the class label
-        # ride the BEGIN message (the worker's own engine schedules its
-        # prefill queue by the same class)
+        # disagg prefill worker: the decode budget, the class label and
+        # the tenant ride the BEGIN message (the worker's own engine
+        # schedules its prefill queue by the same class, and the decode
+        # side adopts under the same tenant so fleet-merged per-tenant
+        # series stay truthful)
         return replica.submit(prompt, max_new_tokens=max_new_tokens,
                               eos_id=eos_id, priority=priority,
-                              trace=trace)
+                              tenant=tenant, trace=trace)
 
     def submit(self, prompt, *, max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
                priority: str = "interactive",
+               tenant: str = "default",
                deadline_ms: Optional[float] = None) -> Optional[Request]:
         """Admit one request to the least-loaded replica; on rejection,
         spill to the next-ranked; None when every replica rejected.
@@ -340,11 +388,12 @@ class Router:
         # disagg peer's, across processes) share one trace_id — a spilled
         # retry is the same request, so the context survives the loop
         ctx = obs.new_context()
-        ranked, signals = self._ranked()
+        ranked, signals = self._ranked(prompt=prompt, tenant=tenant)
         for rank, (_, i) in enumerate(ranked):
             req = self._submit_to(i, prompt,
                                   max_new_tokens=max_new_tokens,
                                   eos_id=eos_id, priority=priority,
+                                  tenant=tenant,
                                   trace=ctx, deadline_ms=deadline_ms)
             if req is None:
                 continue  # bounded queue raced the signal read — spill
@@ -352,8 +401,11 @@ class Router:
             _ROUTED.inc(replica=str(self._pids[i]))
             if rank > 0:
                 _SPILLOVER.inc()
+            if signals[i].get("prefix_tokens", 0) > 0:
+                _CACHE_STEERED.inc()
             obs.instant("route", track="router", replica=self._pids[i],
                         rank=rank, rid=req.rid, cls=priority,
+                        tenant=tenant,
                         trace_id=ctx.trace_id, **signals[i])
             return req
         _ROUTER_REJECTS.inc(reason="saturated")

@@ -37,6 +37,17 @@ the evidence landing on real counters —
 control drop) and the combined fleet conservation snapshot is dumped via
 ``--metrics-out`` for ``scripts/check_obs.py --chaos`` to audit. Each arm
 also emits one JSON line (``--json-out``) labeled off counter deltas.
+
+With ``--flight-dir`` the run doubles as the **flight-recorder
+acceptance arm** (``scripts/check_obs.py --flight``): the SACK and
+control-plane storm thresholds are armed, and every injected fault class
+must land EXACTLY ONE attributable post-mortem bundle — the router kill
+and the post-GRANT kill each a ``peer_dead``, the control-notif drops a
+``ctrl_storm``, the data-plane drops a ``retx_storm``, and a deliberately
+tight SLO objective evaluated over the faulted window a ``slo_burn``.
+A clean phase then re-runs an unfaulted drive with the SAME thresholds
+armed into a fresh recorder (``<flight-dir>/clean``) and must produce
+zero bundles and zero burn alerts.
 """
 
 from __future__ import annotations
@@ -371,6 +382,26 @@ def run_disagg_arm(args, jax):
             )
         if dw.engine.pool.n_free != dw.engine.pool.n_slots:
             raise SystemExit("reclaimed slot did not return to the pool")
+        from uccl_tpu.obs import flight as flight_mod
+        n_dead = 0
+        if flight_mod.enabled():
+            # flight acceptance: the lease clock may have won the
+            # reclaim race above, but the peer_dead POST-MORTEM needs
+            # the detector transition — keep ticking until every conn
+            # the silent prefill side fed is DEAD (both directions go
+            # silent together: pe is killed and pw never pumps again),
+            # so the bundle count per arm is deterministic
+            dead_deadline = time.monotonic() + 30.0
+            while any(detector.state(p) != "dead"
+                      for p in detector.peers()):
+                dw.poll()
+                time.sleep(0.005)
+                if time.monotonic() > dead_deadline:
+                    raise SystemExit(
+                        "flight arm: detector never declared the killed "
+                        "prefill peer DEAD"
+                    )
+            n_dead = len(detector.peers())
         deltas = dict(zip(
             ("retry_begin", "retry_grant", "retry_final", "ctrl_dropped"),
             (a - b for a, b in zip(_counters(*_DISAGG_COUNTERS), c0)),
@@ -386,6 +417,7 @@ def run_disagg_arm(args, jax):
             "oracle_exact": True, "leases_expired": int(expired),
             "decode_leaked": dw.engine.pool.leaked(),
             "conservation_ok": True, "recovered": deltas,
+            "flight_peer_dead": n_dead,
         }
         print(json.dumps(arm), flush=True)
         _ = doomed
@@ -398,6 +430,104 @@ def run_disagg_arm(args, jax):
             pass
         pw.ep.close()
         dw.ep.close()
+
+
+def run_clean_phase(args, jax) -> int:
+    """The zero-dump half of the flight acceptance claim: an unfaulted
+    drive with the SAME storm thresholds armed (into a fresh recorder the
+    caller just enabled) plus a lenient burn monitor over it. Returns the
+    number of burn alerts fired (must be 0; the caller asserts the
+    recorder stayed empty)."""
+    from uccl_tpu.obs import slo as slo_mod
+    from uccl_tpu.serving import ServingEngine
+
+    clock = [0.0]
+    mon = slo_mod.BurnRateMonitor(
+        slo_mod.serving_objectives(ttft_s=120.0, tpot_s=120.0,
+                                   queue_wait_s=120.0, step_s=120.0,
+                                   target=0.99),
+        windows=((60.0, 1.0),), clock=lambda: clock[0])
+    mon.sample()
+    backends, params, cfg = _make_dense(
+        args, jax, args.slots, args.prompt_len + args.new_tokens, 1
+    )
+    eng = ServingEngine(backends[0], prefill_chunk=args.prefill_chunk)
+    rng = np.random.default_rng(args.seed + 7)
+    for _ in range(3):
+        prompt = rng.integers(0, args.vocab,
+                              args.prompt_len).astype(np.int32)
+        eng.submit(prompt, max_new_tokens=args.new_tokens)
+        eng.drain()
+    eng.close()
+    clock[0] = 61.0
+    return len(mon.evaluate())
+
+
+def run_flight_checks(args, jax, arms, slo_mon, slo_clock) -> dict:
+    """After the faulted arms: fire the tight-SLO burn, assert every
+    injected fault class landed EXACTLY ONE attributable bundle, then run
+    the clean phase (same thresholds armed, fresh recorder) and assert
+    zero dumps + zero burn alerts. Returns the ``chaos_flight`` JSON arm
+    ``scripts/check_obs.py --flight`` re-audits against the bundles and
+    the exported counters."""
+    import os
+    from collections import Counter
+
+    from uccl_tpu import obs
+    from uccl_tpu.obs import flight as flight_mod
+
+    # space past the recorder's min_interval_s: the disagg arm's last
+    # bundle just landed, and the slo_burn dump must not be rate-limited
+    time.sleep(0.3)
+    slo_clock[0] = 61.0
+    burn_alerts = slo_mon.evaluate()
+    if not burn_alerts:
+        raise SystemExit("flight arm: the tight SLO objective fired no "
+                         "burn alert over the faulted window")
+
+    # expectations derived from the faults that actually bit — each is
+    # asserted to have bitten, so the arm can never pass vacuously
+    expected = Counter()
+    for arm in arms:
+        if arm.get("bench") == "chaos_router" and arm.get("killed_at_s"):
+            expected["peer_dead"] += 1
+        expected["peer_dead"] += arm.get("flight_peer_dead", 0)
+    if obs.counter("disagg_ctrl_retries_total").total() < 1:
+        raise SystemExit("flight arm: control-plane chaos never bit "
+                         "(no ctrl retries)")
+    if obs.counter("p2p_channel_retx_total").total() < 1:
+        raise SystemExit("flight arm: data-plane chaos never bit "
+                         "(no retransmits)")
+    expected["ctrl_storm"] = 1
+    expected["retx_storm"] = 1
+    expected["slo_burn"] = 1
+
+    rec = flight_mod.get_recorder()
+    names = sorted(os.path.basename(p) for p in rec.bundles)
+    kinds = Counter(n.split("_", 2)[2][:-len(".json")] for n in names)
+    if dict(kinds) != dict(expected):
+        raise SystemExit(
+            f"FLIGHT ATTRIBUTION MISMATCH: bundles {dict(kinds)} vs "
+            f"expected {dict(expected)} ({names})"
+        )
+
+    clean_dir = os.path.join(args.flight_dir, "clean")
+    clean_rec = flight_mod.enable(clean_dir)
+    clean_alerts = run_clean_phase(args, jax)
+    if clean_rec.bundles or clean_alerts:
+        raise SystemExit(
+            f"CLEAN RUN NOT CLEAN: {len(clean_rec.bundles)} bundle(s), "
+            f"{clean_alerts} burn alert(s) with no fault injected"
+        )
+    arm = {
+        "bench": "chaos_flight", "flight_dir": args.flight_dir,
+        "expected": dict(expected), "bundles": names,
+        "burn_alerts": len(burn_alerts),
+        "clean_dir": clean_dir, "clean_bundles": 0,
+        "clean_burn_alerts": 0,
+    }
+    print(json.dumps(arm), flush=True)
+    return arm
 
 
 def main():
@@ -445,6 +575,27 @@ def main():
     obs.add_cli_args(ap)
     args = ap.parse_args()
     obs.setup_from_args(args)
+    flight_on = bool(getattr(args, "flight_dir", ""))
+    slo_mon, slo_clock = None, [0.0]
+    if flight_on:
+        from uccl_tpu.obs import slo as slo_mod
+        from uccl_tpu.p2p import sack as sack_mod
+        from uccl_tpu.serving import disagg as disagg_mod
+
+        # one retransmit / one control retry proves the trigger path
+        # end-to-end at smoke sizes (a deployment arms its real loss
+        # budget); the seeded drop injectors make the bite deterministic
+        # and run_flight_checks asserts each fault class actually bit
+        sack_mod.arm_flight(storm_after=1)
+        disagg_mod.arm_ctrl_flight(storm_after=1)
+        # a deliberately unmeetable objective sampled BEFORE the faulted
+        # arms: the diff window over their TTFTs must burn
+        slo_mon = slo_mod.BurnRateMonitor(
+            [slo_mod.Objective(name="ttft_tight",
+                               metric="serving_ttft_seconds",
+                               threshold_s=1e-6, target=0.99)],
+            windows=((60.0, 1.0),), clock=lambda: slo_clock[0])
+        slo_mon.sample()
     if args.smoke:
         args.replicas, args.requests = 2, 10
         args.ctrl_drop = 0.05
@@ -464,6 +615,10 @@ def main():
             raise SystemExit(f"unknown arm {arm_name!r}")
         arms.append(arm)
         fleet_metrics.extend(ms)
+
+    if flight_on:
+        arms.append(run_flight_checks(args, jax, arms, slo_mon,
+                                      slo_clock))
 
     # the FLEET conservation snapshot: every engine the chaos touched
     # (survivors, victims, both disagg roles) merged — check_obs --chaos

@@ -114,7 +114,8 @@ def _counters():
 
 def fleet_worker(idx: int, arm: str, n_requests: int,
                  new_tokens: int, store_port: int, result_q,
-                 trace_out: str, metrics_out: str) -> None:
+                 trace_out: str, metrics_out: str,
+                 flight_dir: str = "") -> None:
     """One prefill-worker process: engine + cache (+ FleetWorker)."""
     from uccl_tpu import obs
     from uccl_tpu.p2p import Endpoint
@@ -126,6 +127,16 @@ def fleet_worker(idx: int, arm: str, n_requests: int,
 
     if trace_out:
         obs.enable_tracing()
+    recorder = None
+    if flight_dir:
+        # per-process recorder: the chaos arm's survivors must each land
+        # EXACTLY ONE peer_dead bundle when they dial the corpse (the
+        # dial-failure and fail-latch sites share the key fleet:<owner>,
+        # so the recorder dedupes them into one), clean arms none
+        from uccl_tpu.obs import flight as flight_mod
+
+        recorder = flight_mod.enable(
+            os.path.join(flight_dir, f"{arm}-w{idx}"))
 
     cfg, params = _make_model()
     eng = ServingEngine(
@@ -217,6 +228,9 @@ def fleet_worker(idx: int, arm: str, n_requests: int,
         "completed_expected": len(reqs) + (1 if idx == 0 else 0),
         "completed": int(snap["completed"]),
         "leaked": int(eng.pool.leaked()),
+        "flight_bundles": (sorted(os.path.basename(p)
+                                  for p in recorder.bundles)
+                           if recorder is not None else []),
     }
     if metrics_out:
         obs.write_metrics(
@@ -255,7 +269,8 @@ def _oracle_check(cfg, params, reports, cache) -> bool:
 
 
 def run_arm(arm: str, *, n_workers: int, n_requests: int, new_tokens: int,
-            trace_out: str, metrics_out: str, oracle_cache) -> dict:
+            trace_out: str, metrics_out: str, oracle_cache,
+            flight_dir: str = "") -> dict:
     from uccl_tpu.p2p.store import StoreClient, StoreServer
 
     cfg, params = _make_model()
@@ -265,7 +280,8 @@ def run_arm(arm: str, *, n_workers: int, n_requests: int, new_tokens: int,
     procs = [
         ctx.Process(target=fleet_worker,
                     args=(i, arm, n_requests, new_tokens,
-                          srv.port, result_q, trace_out, metrics_out))
+                          srv.port, result_q, trace_out, metrics_out,
+                          flight_dir))
         for i in range(n_workers)
     ]
     t0 = time.perf_counter()
@@ -300,6 +316,24 @@ def run_arm(arm: str, *, n_workers: int, n_requests: int, new_tokens: int,
     conserved = (not alive and all(r["leaked"] == 0 for r in reports)
                  and all(r["completed"] == r["completed_expected"]
                          for r in reports))
+    flight_ok = True
+    flight_bundles = {f"w{r['idx']}": r.get("flight_bundles", [])
+                      for r in reports}
+    if flight_dir:
+        # exactly one attributable dump per injected fault, zero on
+        # clean arms: each chaos survivor dials the corpse once and must
+        # land a single peer_dead bundle; no fault -> no bundle
+        for r in reports:
+            names = r.get("flight_bundles", [])
+            if arm == "chaos" and r["idx"] != 0:
+                want = (len(names) == 1
+                        and names[0].endswith("_peer_dead.json"))
+            else:
+                want = not names
+            if not want:
+                print(f"FLIGHT MISMATCH arm={arm} w{r['idx']}: "
+                      f"bundles={names}")
+                flight_ok = False
     summary = {
         "arm": arm,
         "workers": n_workers,
@@ -320,6 +354,8 @@ def run_arm(arm: str, *, n_workers: int, n_requests: int, new_tokens: int,
             for r in reports},
         "oracle_exact": bool(oracle_exact),
         "conserved": bool(conserved),
+        "flight_ok": bool(flight_ok),
+        "flight_bundles": flight_bundles,
         "wall_s": round(wall_s, 2),
     }
     print("bench=serving_fleet " + " ".join(
@@ -340,6 +376,11 @@ def main() -> int:
     ap.add_argument("--metrics-out", default="")
     ap.add_argument("--json-out", default="")
     ap.add_argument("--trace-out", default="")
+    ap.add_argument("--flight-dir", default="",
+                    help="arm a per-worker flight recorder (bundles in "
+                         "<dir>/<arm>-wN/); the chaos arm must dump "
+                         "exactly one peer_dead per survivor, clean "
+                         "arms none")
     args = ap.parse_args()
     if args.smoke:
         args.workers, args.requests = 2, 2
@@ -354,9 +395,11 @@ def main() -> int:
         arms[arm] = run_arm(
             arm, n_workers=args.workers, n_requests=args.requests,
             new_tokens=args.new_tokens, trace_out=args.trace_out,
-            metrics_out=args.metrics_out, oracle_cache=oracle_cache)
+            metrics_out=args.metrics_out, oracle_cache=oracle_cache,
+            flight_dir=args.flight_dir)
 
-    ok = all(a["oracle_exact"] and a["conserved"] for a in arms.values())
+    ok = all(a["oracle_exact"] and a["conserved"] and a["flight_ok"]
+             for a in arms.values())
     if "directory" in arms and "no_directory" in arms:
         d, b = arms["directory"], arms["no_directory"]
         saved = b["computed_prefill_tokens"] - d["computed_prefill_tokens"]

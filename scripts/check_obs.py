@@ -1047,6 +1047,107 @@ def check_fleet_cache_metrics(path: str, bench_json: str) -> None:
           f"{int(c['invalidations'])}")
 
 
+def check_flight_metrics(path: str, bench_json: str) -> None:
+    """``--flight`` mode: the flight-recorder acceptance gate. Re-audits
+    the ``chaos_flight`` arm chaos_bench emitted with ``--flight-dir``:
+
+    * every bundle on disk is schema-valid, its filename kind matches its
+      ``trigger.kind``, and — count-before-snapshot — its OWN dump is
+      visible in its embedded counter snapshot;
+    * the per-trigger bundle census equals the arm's ``expected`` map
+      (one attributable dump per injected fault class, nothing extra),
+      and the final exported ``obs_flight_dumps_total{trigger=}`` agrees;
+    * the doctor replays every bundle to the root cause its trigger kind
+      maps to (``uccl_tpu.doctor.ROOT_CAUSE``);
+    * the faulted window burned (``obs_slo_burn_alerts_total >= 1``) while
+      the clean phase produced zero bundles and zero burn alerts.
+    """
+    import glob
+    import os
+    import sys as _sys
+
+    with open(bench_json) as f:
+        arms = [json.loads(ln) for ln in f if ln.strip()]
+    flight_arms = [a for a in arms if a.get("bench") == "chaos_flight"]
+    if not flight_arms:
+        fail(f"{bench_json}: no chaos_flight arm — run chaos_bench with "
+             f"--flight-dir")
+    arm = flight_arms[0]
+    expected = {k: int(v) for k, v in arm["expected"].items()}
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from uccl_tpu import doctor as doctor_mod
+
+    def dumps_counted(prom_text: str, kind: str) -> float:
+        want = f'obs_flight_dumps_total{{trigger="{kind}"}}'
+        return sum(float(ln.rsplit(" ", 1)[1])
+                   for ln in prom_text.splitlines()
+                   if ln.startswith(want))
+
+    bundles = sorted(glob.glob(os.path.join(arm["flight_dir"],
+                                            "flight_*.json")))
+    if not bundles:
+        fail(f"{arm['flight_dir']}: no flight bundles on disk")
+    census: dict = {}
+    for bp in bundles:
+        try:
+            b = doctor_mod.load_bundle(bp)
+        except SystemExit:
+            raise
+        except Exception as e:
+            fail(f"{bp}: unloadable bundle ({type(e).__name__}: {e})")
+        kind = b["trigger"]["kind"]
+        census[kind] = census.get(kind, 0) + 1
+        for key in ("trigger", "host", "events", "metrics_prom",
+                    "registry", "state"):
+            if key not in b:
+                fail(f"{bp}: bundle missing {key!r}")
+        fname_kind = os.path.basename(bp).split("_", 2)[2][:-len(".json")]
+        if fname_kind != kind:
+            fail(f"{bp}: filename kind {fname_kind!r} != trigger.kind "
+                 f"{kind!r}")
+        if dumps_counted(b["metrics_prom"], kind) < 1:
+            fail(f"{bp}: its own dump is missing from the embedded "
+                 f"obs_flight_dumps_total{{trigger={kind!r}}} snapshot — "
+                 f"count-before-snapshot broke")
+        verdict = doctor_mod.diagnose(b)
+        want_cause = doctor_mod.ROOT_CAUSE.get(kind)
+        if verdict["root_cause"] != want_cause:
+            fail(f"{bp}: doctor root cause {verdict['root_cause']!r} != "
+                 f"{want_cause!r} for trigger {kind!r}")
+    if census != expected:
+        fail(f"{arm['flight_dir']}: bundle census {census} != injected "
+             f"fault classes {expected} — dumps are not one-per-fault")
+
+    with open(path) as f:
+        text = f.read()
+    lines = text.splitlines()
+    for kind, n in expected.items():
+        got = dumps_counted(text, kind)
+        if got != n:
+            fail(f"{path}: obs_flight_dumps_total{{trigger={kind!r}}} = "
+                 f"{got}, bundle census says {n}")
+    total = _prom_total(lines, "obs_flight_dumps_total", path)
+    if total != sum(expected.values()):
+        fail(f"{path}: obs_flight_dumps_total sums to {total}, expected "
+             f"{sum(expected.values())} — an unattributed dump fired")
+    if _prom_total(lines, "obs_slo_burn_alerts_total", path) < 1:
+        fail(f"{path}: the faulted window never burned "
+             f"(obs_slo_burn_alerts_total < 1)")
+    if not any(ln.startswith("obs_trace_events_dropped_total")
+               for ln in lines):
+        fail(f"{path}: obs_trace_events_dropped_total series missing")
+    if arm.get("clean_bundles") != 0 or arm.get("clean_burn_alerts") != 0:
+        fail(f"{bench_json}: clean phase was not clean: {arm}")
+    leftover = glob.glob(os.path.join(arm["clean_dir"], "flight_*.json"))
+    if leftover:
+        fail(f"{arm['clean_dir']}: clean phase left bundles: {leftover}")
+    print(f"check_obs --flight: {len(bundles)} bundle(s), "
+          f"{len(expected)} fault class(es) attributed, doctor verdicts "
+          f"match, clean phase empty")
+
+
 def main(argv) -> None:
     if len(argv) == 4 and argv[1] == "--fleet":
         check_fleet_trace(argv[2])
@@ -1101,6 +1202,10 @@ def main(argv) -> None:
         check_fleet_cache_metrics(argv[2], argv[3])
         print("check_obs: ALL OK")
         return
+    if len(argv) == 4 and argv[1] == "--flight":
+        check_flight_metrics(argv[2], argv[3])
+        print("check_obs: ALL OK")
+        return
     if len(argv) != 3:
         fail("usage: check_obs.py TRACE_JSON METRICS_PROM | "
              "check_obs.py --quant METRICS_PROM WIRE_DTYPE | "
@@ -1115,7 +1220,8 @@ def main(argv) -> None:
              "check_obs.py --spec METRICS_PROM | "
              "check_obs.py --router METRICS_PROM | "
              "check_obs.py --fleet MERGED_TRACE FLEET_PROM | "
-             "check_obs.py --fleet-cache FLEET_PROM BENCH_JSON")
+             "check_obs.py --fleet-cache FLEET_PROM BENCH_JSON | "
+             "check_obs.py --flight METRICS_PROM BENCH_JSON")
     check_trace(argv[1])
     check_metrics(argv[2])
     print("check_obs: ALL OK")

@@ -88,10 +88,13 @@ timeout 600 python benchmarks/incast_bench.py --smoke \
   --json-out /tmp/qa_transport_bench.json; check $?
 python scripts/check_obs.py --transport /tmp/qa_transport_metrics.prom /tmp/qa_transport_bench.json; check $?
 
-note "chaos smoke tier (1 of 2 replicas killed mid-run + 5% control-notif drop + 5% data drop + post-GRANT kill: recovered outputs oracle-exact, extended conservation incl. lost, >=1 reclaimed lease, zero leaked slots — all counter-audited)"
+note "chaos smoke tier (1 of 2 replicas killed mid-run + 5% control-notif drop + 5% data drop + post-GRANT kill: recovered outputs oracle-exact, extended conservation incl. lost, >=1 reclaimed lease, zero leaked slots — all counter-audited; flight recorder armed: one attributable post-mortem bundle per injected fault class, doctor root causes match, clean phase dumps nothing)"
+rm -rf /tmp/qa_flight && mkdir -p /tmp/qa_flight
 JAX_PLATFORMS=cpu timeout 600 python benchmarks/chaos_bench.py --smoke \
+  --flight-dir /tmp/qa_flight \
   --metrics-out /tmp/qa_chaos_metrics.prom --json-out /tmp/qa_chaos_bench.json; check $?
 python scripts/check_obs.py --chaos /tmp/qa_chaos_metrics.prom /tmp/qa_chaos_bench.json; check $?
+python scripts/check_obs.py --flight /tmp/qa_chaos_metrics.prom /tmp/qa_chaos_bench.json; check $?
 
 note "disagg serving smoke tier (prefill+decode worker pair over p2p: chunk-streamed KV, >=1 prefix-cache hit, oracle-exact, telemetry validated; per-role trace/metrics dumps feed the fleet tier below)"
 UCCL_TPU_EXAMPLE_CPU=1 JAX_PLATFORMS=cpu timeout 600 python examples/disagg_kv.py --cpu \
@@ -105,8 +108,10 @@ python -m uccl_tpu.obs.aggregate --out /tmp/qa_fleet.prom \
   prefill=/tmp/qa_disagg_metrics.prom decode=/tmp/qa_disagg_metrics.decode.prom; check $?
 python scripts/check_obs.py --fleet /tmp/qa_fleet_merged.json /tmp/qa_fleet.prom; check $?
 
-note "fleet prefix-cache smoke tier (2 prefill-worker processes over one directory: a prefix computed on worker 0 lands as a counter-audited cross-worker hit on worker 1 with fewer computed prefill tokens + lower TTFT than the no-directory arm, chaos arm kills the owner mid-stream with its entries invalidated, every arm oracle-exact)"
+note "fleet prefix-cache smoke tier (2 prefill-worker processes over one directory: a prefix computed on worker 0 lands as a counter-audited cross-worker hit on worker 1 with fewer computed prefill tokens + lower TTFT than the no-directory arm, chaos arm kills the owner mid-stream with its entries invalidated + exactly one peer_dead flight bundle per survivor, every arm oracle-exact)"
+rm -rf /tmp/qa_fleet_flight && mkdir -p /tmp/qa_fleet_flight
 JAX_PLATFORMS=cpu timeout 600 python benchmarks/fleet_bench.py --smoke \
+  --flight-dir /tmp/qa_fleet_flight \
   --metrics-out /tmp/qa_fleetcache_metrics.prom \
   --json-out /tmp/qa_fleetcache_bench.json; check $?
 python scripts/check_obs.py --fleet-cache /tmp/qa_fleetcache_metrics.prom /tmp/qa_fleetcache_bench.json; check $?

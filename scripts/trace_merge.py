@@ -49,15 +49,31 @@ def fail(msg: str) -> None:
     sys.exit(1)
 
 
-def load_trace(path: str) -> Dict:
+def warn(msg: str) -> None:
+    print(f"trace_merge: WARNING — {msg}", file=sys.stderr)
+
+
+def load_trace(path: str, strict: bool = False) -> Dict:
+    """Load one per-process trace. A trace without the clock-handshake
+    record (``otherData.clock.wall_epoch_us``) is *unanchored*: under
+    ``--strict`` that is fatal, otherwise it is merged UNADJUSTED (its
+    timestamps keep their own epoch) with a warning — a partial fleet
+    view beats crashing out of the whole merge when one worker died
+    before its clock exchange."""
     with open(path) as f:
         trace = json.load(f)
     if not isinstance(trace.get("traceEvents"), list):
         fail(f"{path}: no traceEvents list")
     clock = trace.get("otherData", {}).get("clock")
-    if not isinstance(clock, dict) or "wall_epoch_us" not in clock:
-        fail(f"{path}: no otherData.clock.wall_epoch_us — cannot align an "
-             f"unanchored trace (dump it with a tracer from this PR on)")
+    anchored = isinstance(clock, dict) and "wall_epoch_us" in clock
+    if not anchored:
+        if strict:
+            fail(f"{path}: no otherData.clock.wall_epoch_us — cannot "
+                 f"align an unanchored trace (--strict)")
+        warn(f"{path}: no otherData.clock.wall_epoch_us — merging "
+             f"UNADJUSTED (its timeline may not align with the anchored "
+             f"files; cross-file causal checks are skipped)")
+    trace["_anchored"] = anchored
     return trace
 
 
@@ -68,28 +84,40 @@ def process_name_of(trace: Dict, path: str) -> str:
     return os.path.splitext(os.path.basename(path))[0]
 
 
-def merge_traces(paths: List[str]) -> Dict:
+def merge_traces(paths: List[str], strict: bool = False) -> Dict:
     """Load, align and concatenate; returns the merged trace dict
-    (validation is separate — :func:`validate_merged`)."""
-    traces = [load_trace(p) for p in paths]
+    (validation is separate — :func:`validate_merged`). Unanchored files
+    (no clock handshake) merge with shift 0 — their own timeline —
+    unless ``strict`` makes that fatal."""
+    traces = [load_trace(p, strict=strict) for p in paths]
     # per-file alignment base: wall anchor corrected by the process's
-    # estimated offset from the reference clock (0 when never synced)
+    # estimated offset from the reference clock (0 when never synced);
+    # None for an unanchored file — it cannot participate in alignment
     bases = []
     for p, t in zip(paths, traces):
+        if not t["_anchored"]:
+            bases.append(None)
+            continue
         clock = t["otherData"]["clock"]
         bases.append(float(clock["wall_epoch_us"])
                      - float(clock.get("offset_us", 0.0)))
-    t0 = min(bases)
+    anchored_bases = [b for b in bases if b is not None]
+    t0 = min(anchored_bases) if anchored_bases else 0.0
     out: List[Dict] = []
-    meta = {"merged_from": [], "producer": "uccl_tpu trace_merge"}
+    meta = {"merged_from": [], "producer": "uccl_tpu trace_merge",
+            # the wall epoch (us) of the merged timeline's ts 0 — what
+            # `doctor --trace` uses to place flight bundles on this
+            # timeline; 0.0 when every input was unanchored
+            "merged_wall_epoch_us": t0}
     for i, (path, trace, base) in enumerate(zip(paths, traces, bases)):
         pid = i + 1
-        shift = base - t0
+        shift = (base - t0) if base is not None else 0.0
         name = process_name_of(trace, path)
         meta["merged_from"].append({
             "path": path, "pid": pid, "process_name": name,
             "shift_us": round(shift, 3),
-            "clock": trace["otherData"]["clock"],
+            "anchored": trace["_anchored"],
+            "clock": trace["otherData"].get("clock"),
             "dropped_events": trace["otherData"].get("dropped_events", 0),
         })
         for ev in trace["traceEvents"]:
@@ -107,6 +135,12 @@ def validate_merged(merged: Dict) -> Dict:
     """Named-failure validation of a merged trace; returns summary stats
     (events, trace_ids, cross-process request count)."""
     evs = merged["traceEvents"]
+    # pids merged without a clock anchor sit on their own timeline —
+    # cross-clock causal order is meaningless for chains touching them
+    unanchored_pids = {
+        m["pid"] for m in merged["otherData"].get("merged_from", ())
+        if not m.get("anchored", True)
+    }
     b, e = Counter(), Counter()
     flows: Dict[str, Dict] = defaultdict(lambda: {"s": [], "f": []})
     by_trace: Dict[str, List[Dict]] = defaultdict(list)
@@ -129,8 +163,14 @@ def validate_merged(merged: Dict) -> Dict:
         if sf["f"] and not sf["s"]:
             fail(f"flow id {fid}: finish without a start — the s/f pair "
                  f"did not resolve across the merged files")
-    # causal order per trace_id on the ALIGNED timeline
+    # causal order per trace_id on the ALIGNED timeline (skipped for
+    # chains that touch an unanchored pid — their ts were never aligned)
+    skipped_causal = 0
     for tid, tevs in by_trace.items():
+        if unanchored_pids and any(
+                ev["pid"] in unanchored_pids for ev in tevs):
+            skipped_causal += 1
+            continue
         stages = {}
         for ev in tevs:
             n = ev["name"]
@@ -159,8 +199,15 @@ def validate_merged(merged: Dict) -> Dict:
                 and {ev["pid"] for ev in sf["s"]}
                 != {ev["pid"] for ev in sf["f"]}):
             cross += 1
-    return {"events": len(evs), "trace_ids": len(by_trace),
-            "cross_process_requests": cross}
+    stats = {"events": len(evs), "trace_ids": len(by_trace),
+             "cross_process_requests": cross}
+    if unanchored_pids:
+        stats["unanchored_files"] = len(unanchored_pids)
+        stats["causal_checks_skipped"] = skipped_causal
+        warn(f"{len(unanchored_pids)} unanchored file(s) merged "
+             f"unadjusted; causal order skipped for {skipped_causal} "
+             f"trace id(s)")
+    return stats
 
 
 def main(argv=None) -> int:
@@ -170,10 +217,14 @@ def main(argv=None) -> int:
     )
     ap.add_argument("inputs", nargs="+", help="per-process trace JSONs")
     ap.add_argument("--out", required=True, help="merged trace path")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail (exit 1) on a trace missing the clock "
+                         "handshake instead of merging it unadjusted "
+                         "with a warning")
     args = ap.parse_args(argv)
     if len(args.inputs) < 2:
         fail("need >= 2 traces to merge")
-    merged = merge_traces(args.inputs)
+    merged = merge_traces(args.inputs, strict=args.strict)
     stats = validate_merged(merged)
     merged["otherData"]["stats"] = stats
     with open(args.out, "w") as f:

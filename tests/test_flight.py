@@ -1,0 +1,436 @@
+"""Tier-1 unit path for the black-box observability stack: flight
+recorder trigger-to-bundle semantics, SLO burn-rate monitors, the
+transport/health trigger hooks, the doctor forensic CLI, trace_merge
+graceful degradation, and the federator edge cases.
+
+Everything here is in-process with injectable clocks — the heavy
+end-to-end attribution arms (chaos_bench/fleet_bench with
+``--flight-dir``) live behind the qa.sh chaos tier; this file is the
+fast gate that keeps the recorder's contract honest on every tier-1
+run.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from uccl_tpu import doctor as doctor_mod
+from uccl_tpu import obs
+from uccl_tpu.obs import aggregate as agg_mod
+from uccl_tpu.obs import counters as obs_counters
+from uccl_tpu.obs import flight as flight_mod
+from uccl_tpu.obs import slo as slo_mod
+from uccl_tpu.obs import tracer as tracer_mod
+from uccl_tpu.p2p import sack as sack_mod
+from uccl_tpu.p2p.sack import FAST, NEW, RTO, SackTxWindow
+from uccl_tpu.serving.health import FailureDetector
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    """scripts/ is not a package — load a script module by path."""
+    path = os.path.join(_REPO, "scripts", name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Flight recorder, tracer and sack arming are process-global —
+    every test leaves them off so no other test inherits a live
+    recorder."""
+    yield
+    flight_mod.disable()
+    obs.disable_tracing()
+    sack_mod.arm_flight(None, None)
+
+
+# -- flight recorder core ----------------------------------------------------
+
+def test_trigger_writes_schema_bundle(tmp_path):
+    rec = flight_mod.enable(str(tmp_path))
+    path = rec.trigger("retx_storm", key="w0", retx_fast=3, chunks=10)
+    assert path is not None and os.path.basename(path) == \
+        "flight_001_retx_storm.json"
+    with open(path) as f:
+        b = json.load(f)
+    assert b["schema"] == "uccl_tpu.flight/1"
+    assert b["seq"] == 1
+    assert b["trigger"]["kind"] == "retx_storm"
+    assert b["trigger"]["key"] == "w0"
+    assert b["trigger"]["context"] == {"retx_fast": 3, "chunks": 10}
+    for k in ("host", "events", "state", "metrics_prom", "registry"):
+        assert k in b
+    assert b["host"]["pid"] == os.getpid()
+    assert rec.bundles == [path]
+    # count-before-snapshot: the bundle's own registry text shows this
+    # very dump (what check_obs --flight asserts per bundle)
+    own = [ln for ln in b["metrics_prom"].splitlines()
+           if ln.startswith('obs_flight_dumps_total{trigger="retx_storm"}')]
+    assert own and float(own[0].rsplit(" ", 1)[1]) >= 1
+
+
+def test_unknown_trigger_kind_raises(tmp_path):
+    rec = flight_mod.enable(str(tmp_path))
+    with pytest.raises(ValueError, match="unknown flight trigger"):
+        rec.trigger("made_up_kind")
+
+
+def test_dedup_rate_and_cap_suppression(tmp_path):
+    clk = [0.0]
+    rec = flight_mod.enable(str(tmp_path), min_interval_s=10.0,
+                            max_dumps=2, clock=lambda: clk[0])
+    sup = flight_mod._SUPPRESSED
+
+    def sup_count(reason):
+        return sum(v for lbl, v in sup.samples()
+                   if lbl.get("reason") == reason)
+
+    base = {r: sup_count(r) for r in ("dedup", "rate", "cap")}
+    assert rec.trigger("peer_dead", key="a") is not None
+    # same (kind, key) again -> one fault, one bundle
+    assert rec.trigger("peer_dead", key="a") is None
+    assert sup_count("dedup") == base["dedup"] + 1
+    # different key but inside min_interval_s -> rate-limited
+    assert rec.trigger("peer_dead", key="b") is None
+    assert sup_count("rate") == base["rate"] + 1
+    clk[0] = 11.0
+    assert rec.trigger("peer_dead", key="c") is not None
+    # recorder full -> capped even after the interval passes
+    clk[0] = 22.0
+    assert rec.trigger("peer_dead", key="d") is None
+    assert sup_count("cap") == base["cap"] + 1
+    assert len(rec.bundles) == 2
+
+
+def test_reenable_resets_dedup_state(tmp_path):
+    rec1 = flight_mod.enable(str(tmp_path / "run1"))
+    assert rec1.trigger("peer_dead", key="x") is not None
+    # a new enable() replaces the singleton with fresh dedup state —
+    # the bench's clean phase relies on this
+    rec2 = flight_mod.enable(str(tmp_path / "run2"))
+    assert flight_mod.get_recorder() is rec2
+    assert rec2.trigger("peer_dead", key="x") is not None
+    assert len(rec2.bundles) == 1
+
+
+def test_module_trigger_noop_when_disabled():
+    flight_mod.disable()
+    assert not flight_mod.enabled()
+    assert flight_mod.trigger("peer_dead", key="z") is None
+
+
+def test_state_providers_and_broken_provider(tmp_path):
+    rec = flight_mod.enable(str(tmp_path))
+    rec.register_provider("good", lambda: {"depth": 4})
+    rec.register_provider("bad", lambda: 1 / 0)
+    path = rec.trigger("step_stall", dur_s=0.5)
+    with open(path) as f:
+        b = json.load(f)
+    assert b["state"]["good"] == {"depth": 4}
+    # a raising provider must not lose the dump — its error is frozen
+    assert "ZeroDivisionError" in b["state"]["bad"]["error"]
+
+
+def test_record_exception_and_excepthook_idempotent(tmp_path):
+    rec = flight_mod.enable(str(tmp_path), min_interval_s=0.0)
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as e:
+        path = flight_mod.record_exception(e, where="unit")
+    with open(path) as f:
+        b = json.load(f)
+    assert b["trigger"]["kind"] == "uncaught_exception"
+    assert b["trigger"]["key"] == "unit:RuntimeError"
+    assert b["trigger"]["context"]["exc_type"] == "RuntimeError"
+    assert "boom" in b["trigger"]["context"]["traceback_tail"]
+
+    prev_hook, prev_state = sys.excepthook, flight_mod._prev_excepthook
+    try:
+        flight_mod.install_excepthook("unit")
+        installed = sys.excepthook
+        flight_mod.install_excepthook("unit")  # second install is a no-op
+        assert sys.excepthook is installed
+    finally:
+        sys.excepthook = prev_hook
+        flight_mod._prev_excepthook = prev_state
+
+
+def test_tracer_ring_overflow_counts_dropped():
+    before = tracer_mod._EVENTS_DROPPED.total()
+    tr = obs.enable_tracing(1)
+    tr.instant("a")
+    tr.instant("b")
+    tr.instant("c")
+    assert tr.dropped == 2
+    assert tracer_mod._EVENTS_DROPPED.total() == before + 2
+
+
+# -- SLO burn-rate monitors --------------------------------------------------
+
+def _slo_setup(threshold_s=0.1):
+    reg = obs_counters.Registry()
+    fam = reg.histogram("unit_ttft_seconds", buckets=[0.01, 0.1, 1.0])
+    clk = [0.0]
+    obj = slo_mod.Objective(name="ttft", metric="unit_ttft_seconds",
+                            threshold_s=threshold_s, target=0.99)
+    mon = slo_mod.BurnRateMonitor([obj], windows=((60.0, 1.0),),
+                                  registry=reg, clock=lambda: clk[0])
+    return reg, fam, clk, mon
+
+
+def test_slo_clean_window_no_alerts():
+    _reg, fam, clk, mon = _slo_setup()
+    for _ in range(5):
+        fam.observe(0.05)  # compliant: under the 0.1 s objective
+    mon.sample()
+    clk[0] = 61.0
+    assert mon.evaluate() == []
+    assert mon.alerts_fired == 0
+
+
+def test_slo_burn_alerts_counts_and_flight(tmp_path):
+    rec = flight_mod.enable(str(tmp_path))
+    _reg, fam, clk, mon = _slo_setup()
+    mon.sample()
+    for _ in range(5):
+        fam.observe(0.5)  # every request past the objective
+    clk[0] = 61.0
+    before = slo_mod._ALERTS.total()
+    alerts = mon.evaluate()
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a.objective == "ttft" and a.window_s == 60.0
+    assert a.violations == 5 and a.total == 5
+    assert a.error_rate == 1.0 and a.burn == pytest.approx(100.0)
+    assert mon.alerts_fired == 1
+    assert slo_mod._ALERTS.total() == before + 1
+    assert [os.path.basename(p) for p in rec.bundles] == \
+        ["flight_001_slo_burn.json"]
+    # emit=False re-evaluates without side effects (doctor's replay path)
+    assert len(mon.evaluate(emit=False)) == 1
+    assert mon.alerts_fired == 1
+    assert slo_mod._ALERTS.total() == before + 1
+    assert len(rec.bundles) == 1
+
+
+def test_slo_counter_reset_clamps_to_current():
+    _reg, fam, clk, mon = _slo_setup()
+    for _ in range(10):
+        fam.observe(0.5)
+    mon.sample()
+    # restarted worker: cumulative counts drop below the snapshot
+    fam.clear()
+    fam.observe(0.5)
+    fam.observe(0.5)
+    clk[0] = 61.0
+    alerts = mon.evaluate(emit=False)
+    assert len(alerts) == 1
+    assert alerts[0].total == 2 and alerts[0].violations == 2
+
+
+def test_slo_objective_target_validation():
+    with pytest.raises(ValueError):
+        slo_mod.Objective(name="x", metric="m", threshold_s=1.0,
+                          target=1.0)
+
+
+# -- transport / health trigger hooks ----------------------------------------
+
+def test_sack_armed_storm_and_backoff_trigger(tmp_path):
+    rec = flight_mod.enable(str(tmp_path), min_interval_s=0.0)
+    sack_mod.arm_flight(storm_after=2, rto_backoff_s=0.0)
+    win = SackTxWindow([100] * 4, n_paths=2)
+    for seq in range(4):
+        win.mark_sent(seq, 0, NEW, 0.0)
+    # an RTO retransmit past the armed backoff ceiling -> rto_backoff
+    win.mark_sent(0, 1, RTO, 1.0)
+    # second retransmit reaches storm_after=2 -> retx_storm
+    win.mark_sent(1, 1, FAST, 1.1)
+    kinds = [os.path.basename(p) for p in rec.bundles]
+    assert kinds == ["flight_001_rto_backoff.json",
+                     "flight_002_retx_storm.json"]
+    # more retx on a FRESH window: the process-wide sack:<kind> key
+    # dedupes — one sustained loss episode, one bundle per fault class
+    win2 = SackTxWindow([100] * 4, n_paths=2)
+    for seq in range(4):
+        win2.mark_sent(seq, 0, NEW, 2.0)
+    win2.mark_sent(0, 1, RTO, 3.0)
+    win2.mark_sent(1, 1, FAST, 3.1)
+    assert len(rec.bundles) == 2
+
+
+def test_sack_unarmed_never_triggers(tmp_path):
+    rec = flight_mod.enable(str(tmp_path), min_interval_s=0.0)
+    sack_mod.arm_flight(None, None)
+    win = SackTxWindow([100] * 4, n_paths=2)
+    for seq in range(4):
+        win.mark_sent(seq, 0, NEW, 0.0)
+    win.mark_sent(0, 1, RTO, 1.0)
+    win.mark_sent(1, 1, FAST, 1.1)
+    assert rec.bundles == []
+
+
+def test_health_dead_peer_fires_flight_per_detector(tmp_path):
+    rec = flight_mod.enable(str(tmp_path), min_interval_s=0.0)
+    clk = [0.0]
+    det1 = FailureDetector(suspect_after_s=0.5, dead_after_s=1.5,
+                           clock=lambda: clk[0])
+    det2 = FailureDetector(suspect_after_s=0.5, dead_after_s=1.5,
+                           clock=lambda: clk[0])
+    # two detectors (router + disagg) can both track a peer named "0" —
+    # each death gets its own bundle because the dedup key carries the
+    # detector identity
+    det1.register("0")
+    det2.register("0")
+    clk[0] = 2.0
+    det1.tick()
+    det2.tick()
+    kinds = [os.path.basename(p) for p in rec.bundles]
+    assert kinds == ["flight_001_peer_dead.json",
+                     "flight_002_peer_dead.json"]
+    # DEAD is terminal: further ticks re-fire nothing
+    clk[0] = 4.0
+    det1.tick()
+    assert len(rec.bundles) == 2
+    b = doctor_mod.load_bundle(rec.bundles[0])
+    assert b["trigger"]["context"]["peer"] == "0"
+    assert doctor_mod.diagnose(b)["root_cause"] == "replica_failure"
+
+
+# -- doctor ------------------------------------------------------------------
+
+def test_doctor_root_causes_and_json_cli(tmp_path, capsys):
+    rec = flight_mod.enable(str(tmp_path), min_interval_s=0.0)
+    rec.trigger("peer_dead", key="h:0", peer="r0", source="health")
+    rec.trigger("retx_storm", key="s", retx_fast=3, retx_rto=1,
+                chunks=10, path_scores=[1.0, 0.2])
+    rec.trigger("ctrl_storm", key="c", retries=4)
+    try:
+        raise KeyError("gone")
+    except KeyError as e:
+        flight_mod.record_exception(e, where="unit")
+    flight_mod.disable()
+
+    assert doctor_mod.main([str(tmp_path), "--json"]) == 0
+    verdicts = json.loads(capsys.readouterr().out)
+    assert [v["root_cause"] for v in verdicts] == [
+        "replica_failure", "path_loss", "control_plane_loss",
+        "driver_crash"]
+    storm = verdicts[1]
+    assert storm["details"]["retx_fast"] == 3
+    assert storm["details"]["worst_path"] == 1
+    # prose mode renders every bundle too
+    assert doctor_mod.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "root cause: path_loss" in out
+    assert "4 bundle(s) examined" in out
+
+
+def test_doctor_rejects_non_bundle(tmp_path):
+    p = tmp_path / "flight_001_bogus.json"
+    p.write_text(json.dumps({"schema": "nope"}))
+    with pytest.raises(ValueError, match="not a flight bundle"):
+        doctor_mod.load_bundle(str(p))
+    assert doctor_mod.main([str(p)]) == 1
+
+
+# -- trace_merge graceful degradation ----------------------------------------
+
+def _write_trace(path, events, clock=None):
+    doc = {"traceEvents": events, "otherData": {}}
+    if clock is not None:
+        doc["otherData"]["clock"] = clock
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_trace_merge_unanchored_degrades_not_fails(tmp_path):
+    tm = _load_script("trace_merge.py")
+    tid = "a" * 16
+    anchored = _write_trace(
+        tmp_path / "router.json",
+        [{"name": "submit", "ph": "i", "ts": 100.0, "tid": 1,
+          "args": {"trace_id": tid}}],
+        clock={"wall_epoch_us": 1000.0})
+    orphan = _write_trace(
+        tmp_path / "worker.json",
+        [{"name": "grant", "ph": "i", "ts": 50.0, "tid": 1,
+          "args": {"trace_id": tid}}])
+    merged = tm.merge_traces([anchored, orphan])
+    meta = merged["otherData"]
+    assert meta["merged_wall_epoch_us"] == 1000.0
+    assert [m["anchored"] for m in meta["merged_from"]] == [True, False]
+    # unanchored file merges with shift 0 — its own timeline
+    assert meta["merged_from"][1]["shift_us"] == 0.0
+    # grant@50 "precedes" submit@100 but the chain touches the
+    # unanchored pid, so the causal check is skipped, not failed
+    stats = tm.validate_merged(merged)
+    assert stats["unanchored_files"] == 1
+    assert stats["causal_checks_skipped"] == 1
+
+
+def test_trace_merge_strict_rejects_unanchored(tmp_path):
+    tm = _load_script("trace_merge.py")
+    orphan = _write_trace(
+        tmp_path / "worker.json",
+        [{"name": "grant", "ph": "i", "ts": 50.0, "tid": 1}])
+    with pytest.raises(SystemExit):
+        tm.merge_traces([orphan], strict=True)
+
+
+def test_trace_merge_aligns_anchored_epochs(tmp_path):
+    tm = _load_script("trace_merge.py")
+    a = _write_trace(tmp_path / "a.json",
+                     [{"name": "x", "ph": "i", "ts": 5.0, "tid": 1}],
+                     clock={"wall_epoch_us": 1000.0})
+    b = _write_trace(tmp_path / "b.json",
+                     [{"name": "y", "ph": "i", "ts": 10.0, "tid": 1}],
+                     clock={"wall_epoch_us": 3000.0})
+    merged = tm.merge_traces([a, b])
+    by_name = {ev["name"]: ev for ev in merged["traceEvents"]}
+    assert by_name["x"]["ts"] == 5.0
+    assert by_name["y"]["ts"] == 2010.0  # 10 + (3000 - 1000)
+
+
+# -- federator edge cases ----------------------------------------------------
+
+_ZERO_HIST = """# TYPE h histogram
+h_bucket{le="0.1"} 0
+h_bucket{le="+Inf"} 0
+h_sum 0
+h_count 0
+"""
+
+_LIVE_HIST = """# TYPE h histogram
+h_bucket{le="0.1"} 3
+h_bucket{le="+Inf"} 5
+h_sum 1.5
+h_count 5
+"""
+
+
+def test_aggregate_tolerates_all_zero_worker():
+    agg = agg_mod.aggregate([("w0", _ZERO_HIST), ("w1", _LIVE_HIST)])
+    assert agg["fleet"]["h_count"][()] == 5.0
+    assert agg["fleet"]["h_bucket"][(("le", "+Inf"),)] == 5.0
+
+
+def test_aggregate_rejects_mismatched_bucket_bounds():
+    other = _LIVE_HIST.replace('le="0.1"', 'le="0.2"')
+    with pytest.raises(ValueError, match="mismatched bucket bounds"):
+        agg_mod.aggregate([("w0", _ZERO_HIST), ("w1", other)])
+
+
+def test_counter_resets_flags_restarted_replica():
+    prev = agg_mod.aggregate([("w0", "# TYPE c counter\nc 10\n")])
+    cur = agg_mod.aggregate([("w0", "# TYPE c counter\nc 2\n")])
+    assert agg_mod.counter_resets(prev, cur) == [("w0", "c", (), 10.0,
+                                                  2.0)]
+    assert agg_mod.counter_resets(prev, prev) == []

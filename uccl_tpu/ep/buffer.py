@@ -290,6 +290,22 @@ class Buffer:
         }
         self._last_dispatch = None  # (topk_idx ref, capacity)
         self._last_ll = None  # (group_sizes ref, r_max, hidden, wire_fp8)
+        # flight-bundle face (obs/flight.py): host-resident EP state only
+        # — stats() syncs saved device refs, which a post-mortem dump
+        # mid-failure must never do
+        from uccl_tpu.obs import flight as _obsf
+
+        _obsf.register_provider("ep_buffer", self._flight_state)
+
+    def _flight_state(self) -> dict:
+        return {
+            "world": self.world,
+            "num_experts": self.num_experts,
+            "wire": self.wire,
+            "wire_dtype": str(self.wire_dtype),
+            "a2a_sched": self.a2a_sched,
+            "ops": dict(self._op_counts),
+        }
 
     # ------------------------------------------------------------------
     def _axis_name(self):

@@ -54,6 +54,23 @@ from uccl_tpu.obs.export import (  # noqa: F401
     write_metrics, write_trace,
 )
 from uccl_tpu.obs.chrome_trace import to_chrome_trace  # noqa: F401
+from uccl_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder, TRIGGERS, install_excepthook, record_exception,
+)
+from uccl_tpu.obs.flight import enable as enable_flight  # noqa: F401
+from uccl_tpu.obs.flight import disable as disable_flight  # noqa: F401
+from uccl_tpu.obs.flight import enabled as flight_enabled  # noqa: F401
+from uccl_tpu.obs.flight import get_recorder as get_flight  # noqa: F401
+from uccl_tpu.obs.flight import (  # noqa: F401
+    register_provider as flight_provider,
+)
+from uccl_tpu.obs.flight import trigger as flight_trigger  # noqa: F401
+from uccl_tpu.obs.flight import (  # noqa: F401
+    unregister_provider as flight_unregister,
+)
+from uccl_tpu.obs.slo import (  # noqa: F401
+    Alert, BurnRateMonitor, Objective, serving_objectives,
+)
 
 __all__ = [
     "REGISTRY", "CounterFamily", "GaugeFamily", "HistogramFamily",
@@ -67,4 +84,8 @@ __all__ = [
     "SCHEMA_VERSION", "MetricsServer", "add_cli_args", "dump_at_exit",
     "dump_from_args", "json_snapshot", "prometheus_text", "setup_from_args",
     "write_metrics", "write_trace", "to_chrome_trace",
+    "FlightRecorder", "TRIGGERS", "enable_flight", "disable_flight",
+    "flight_enabled", "get_flight", "flight_trigger", "flight_provider",
+    "flight_unregister", "record_exception", "install_excepthook",
+    "Alert", "BurnRateMonitor", "Objective", "serving_objectives",
 ]

@@ -40,7 +40,7 @@ from uccl_tpu.obs.counters import (
 
 __all__ = [
     "parse_prometheus", "scrape", "aggregate", "fleet_text",
-    "fleet_quantile", "main",
+    "fleet_quantile", "counter_resets", "main",
 ]
 
 # one sample line: name{labels} value (labels optional; the value is
@@ -117,6 +117,72 @@ def scrape(target: str, timeout_s: float = 5.0) -> str:
         return f.read()
 
 
+def _le_sort_key(le: str) -> float:
+    return float("inf") if le == "+Inf" else float(le)
+
+
+def _check_bucket_bounds(
+        types: Dict[str, str],
+        per_replica: Dict[str, Dict[LabelKey, Dict[str, float]]]) -> None:
+    """Hard-fail when two replicas export the same histogram family with
+    DIFFERENT bucket bounds — summing mismatched ``le`` grids yields a
+    silently wrong fleet distribution (each replica's counts land in a
+    grid the other never observed into), which is worse than no answer."""
+    for name, by_label in per_replica.items():
+        if (not name.endswith("_bucket")
+                or _series_kind(name, types) != "histogram"):
+            continue
+        # non-le label set -> replica -> its set of le bounds
+        groups: Dict[LabelKey, Dict[str, set]] = {}
+        for labels, by_rep in by_label.items():
+            d = dict(labels)
+            le = d.pop("le", None)
+            if le is None:
+                continue
+            key = tuple(sorted(d.items()))
+            for rep in by_rep:
+                groups.setdefault(key, {}).setdefault(rep, set()).add(le)
+        for key, reps in groups.items():
+            bounds = {rep: tuple(sorted(les, key=_le_sort_key))
+                      for rep, les in reps.items()}
+            if len(set(bounds.values())) > 1:
+                detail = "; ".join(
+                    f"{rep}: [{', '.join(b)}]"
+                    for rep, b in sorted(bounds.items())
+                )
+                lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                raise ValueError(
+                    f"histogram {name!r}"
+                    + (f" {{{lbl}}}" if lbl else "")
+                    + f" has mismatched bucket bounds across replicas — "
+                      f"summing them would be silently wrong ({detail})"
+                )
+
+
+def counter_resets(prev: Dict, cur: Dict) -> List[Tuple]:
+    """Restarted-worker detection between two :func:`aggregate`
+    snapshots of the SAME targets: a cumulative series (counter or
+    histogram component) can only grow within one process lifetime, so a
+    per-replica DECREASE means that replica restarted and its counters
+    reset to zero — naive deltas (``cur - prev``) go negative and any
+    rate computed over the pair is garbage. Returns
+    ``[(replica, series, labels, prev_value, cur_value), ...]``,
+    empty when every cumulative series grew monotonically."""
+    resets: List[Tuple] = []
+    for name, by_label in cur["per_replica"].items():
+        if _series_kind(name, cur["types"]) not in ("counter",
+                                                    "histogram"):
+            continue
+        prev_by_label = prev["per_replica"].get(name, {})
+        for labels, by_rep in by_label.items():
+            prev_reps = prev_by_label.get(labels, {})
+            for rep, v in by_rep.items():
+                pv = prev_reps.get(rep)
+                if pv is not None and v < pv:
+                    resets.append((rep, name, labels, pv, v))
+    return resets
+
+
 def aggregate(scrapes: Sequence[Tuple[str, str]]) -> Dict:
     """Federate ``[(replica label, prometheus text), ...]`` into one
     snapshot dict: ``types``, ``per_replica`` (name → label-tuple →
@@ -140,6 +206,7 @@ def aggregate(scrapes: Sequence[Tuple[str, str]]) -> Dict:
             slot = per_replica.setdefault(name, {})
             for labels, v in by_label.items():
                 slot.setdefault(labels, {})[label] = v
+    _check_bucket_bounds(types, per_replica)
     for name, by_label in per_replica.items():
         if _series_kind(name, types) not in ("counter", "histogram"):
             continue

@@ -225,13 +225,24 @@ def add_cli_args(ap) -> None:
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="serve live /metrics + /snapshot on this local "
                          "port for the run's duration (0 = off)")
+    ap.add_argument("--flight-dir", default="",
+                    help="arm the flight recorder: post-mortem bundles "
+                         "(obs/flight.py) land in this directory on "
+                         "trigger; the driver's excepthook is installed "
+                         "so an uncaught crash dumps too")
 
 
 def setup_from_args(args, capacity: int = 65536) -> None:
-    """Enable the tracer when the CLI asked for a trace. Call before the
-    instrumented work starts."""
+    """Enable the tracer when the CLI asked for a trace, and arm the
+    flight recorder when it asked for a bundle directory. Call before
+    the instrumented work starts."""
     if getattr(args, "trace_out", ""):
         _tracer.enable(capacity)
+    if getattr(args, "flight_dir", ""):
+        from uccl_tpu.obs import flight as _flight
+
+        _flight.enable(args.flight_dir)
+        _flight.install_excepthook()
 
 
 _dumped_args: set = set()  # id(args) namespaces an explicit dump already ran

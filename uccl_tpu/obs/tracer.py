@@ -48,6 +48,17 @@ import time
 from collections import deque
 from typing import Dict, List, NamedTuple, Optional
 
+from uccl_tpu.obs.counters import counter as _counter
+
+# Ring overflow as a REGISTRY counter, not only `Tracer.dropped`: the
+# per-process attribute reaches the Chrome trace's otherData, but a
+# fleet federator only sees what Prometheus text carries — this family
+# makes trace loss visible across workers (obs/aggregate.py sums it).
+_EVENTS_DROPPED = _counter(
+    "obs_trace_events_dropped_total",
+    "trace events evicted from the bounded ring before export — "
+    "nonzero means the Chrome trace is missing its oldest history")
+
 __all__ = [
     "Event", "Tracer", "enable", "disable", "enabled", "get_tracer",
     "span", "instant", "begin", "end", "complete",
@@ -127,6 +138,7 @@ class Tracer:
         with self._lock:
             if len(self._buf) == self.capacity:
                 self.dropped += 1
+                _EVENTS_DROPPED.inc()
             self._buf.append(ev)
 
     def instant(self, name: str, track: Optional[str] = None,

@@ -588,6 +588,11 @@ class Channel:
             rto_max_s=max(0.2, timeout_ms / 1e3),
         )
         self._last_win = win
+        # flight bundles capture this channel's live transport face
+        # (cwnd, SACK splits, path EWMAs) for the duration of the
+        # transfer — last writer wins across concurrent channels, and
+        # the trigger's own context carries its window's stats anyway
+        obs.flight_provider("transport", self.transport_stats)
         cc = self.window_cc
         inflight = {}  # xid -> (seq, t_issue, path); attempt-granular
         last_progress = time.monotonic()

@@ -30,6 +30,28 @@ NEW = "new"
 FAST = "fast"  # SACK-gap fast retransmit after dupack_k duplicate acks
 RTO = "rto"    # retransmission timeout (exponential backoff) / path death
 
+# Flight-recorder arming (docs/OBSERVABILITY.md). Both thresholds are
+# process-wide and default OFF: a handful of retransmits is the normal
+# cost of a lossy path, not a pathology. A chaos arm (or a deployment
+# that knows its loss budget) arms them; every subsequently created
+# window then fires AT MOST one ``retx_storm`` / ``rto_backoff`` flight
+# trigger, carrying its own stats() as the post-mortem context.
+_FLIGHT = {"storm_after": None, "rto_backoff_s": None}
+
+
+def arm_flight(storm_after: Optional[int] = None,
+               rto_backoff_s: Optional[float] = None) -> None:
+    """Arm (or with Nones, disarm) the transport flight triggers:
+    ``storm_after`` = total retransmits within ONE window that count as
+    a storm; ``rto_backoff_s`` = a backed-off per-chunk RTO this large
+    means sustained loss/blackout rather than isolated drops."""
+    _FLIGHT["storm_after"] = storm_after
+    _FLIGHT["rto_backoff_s"] = rto_backoff_s
+
+
+def flight_armed() -> Dict[str, Optional[float]]:
+    return dict(_FLIGHT)
+
 
 class PathQuality:
     """Per-path delivery EWMA + smoothed RTT + in-flight load.
@@ -153,6 +175,7 @@ class SackTxWindow:
         self.retx_rto = 0
         self._next_new = 0
         self._inflight_bytes = 0  # sent & un-acked, kept incrementally
+        self._flight_fired = set()  # trigger kinds already fired here
 
     # -- progress --------------------------------------------------------
     def done(self) -> bool:
@@ -306,6 +329,19 @@ class SackTxWindow:
                 self.retx_fast += 1
             else:
                 self.retx_rto += 1
+                # the RTO that just expired for this attempt — past the
+                # armed ceiling it is a blackout, not a drop
+                limit = _FLIGHT["rto_backoff_s"]
+                if (limit is not None
+                        and self._backoff_rto(c) >= limit):
+                    self._fire_flight("rto_backoff", seq=seq, path=path,
+                                      backoff_s=self._backoff_rto(c),
+                                      backoff_limit_s=limit)
+            storm = _FLIGHT["storm_after"]
+            if (storm is not None
+                    and self.retx_fast + self.retx_rto >= storm):
+                self._fire_flight("retx_storm", seq=seq, path=path,
+                                  storm_after=storm)
         c.n_tx += 1
         c.t_last_tx = now
         c.last_path = path
@@ -313,6 +349,22 @@ class SackTxWindow:
         c.fast_pending = False
         c.err_pending = False
         self.paths.on_sent(path)
+
+    def _fire_flight(self, kind: str, **ctx) -> None:
+        """At most one flight dump per (window, kind) locally, and the
+        PROCESS-WIDE key ``sack:<kind>`` dedupes across windows too —
+        one sustained loss episode spans many transfer windows, and one
+        post-mortem per fault class is the recorder's contract (later
+        windows' storms are counted suppressed, not dumped). Context
+        carries the full window stats so the bundle is self-sufficient
+        even when no transport provider is registered."""
+        if kind in self._flight_fired:
+            return
+        self._flight_fired.add(kind)
+        from uccl_tpu.obs import flight as _flight_mod
+
+        _flight_mod.trigger(kind, key=f"sack:{kind}",
+                            **ctx, **self.stats())
 
     def exhausted(self, now: float) -> List[int]:
         """Chunks due for another transmission with no attempts left —

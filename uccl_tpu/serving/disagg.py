@@ -127,6 +127,32 @@ def set_ctrl_drop(rate: float, seed: int = 0) -> None:
     _CTRL_DROP["rng"] = random.Random(seed)
 
 
+# Flight-recorder arming for the notif plane: past ``storm_after``
+# process-wide control retransmissions, ONE ``ctrl_storm`` bundle fires
+# (docs/OBSERVABILITY.md) — a retry or two is the idempotent plane doing
+# its job; a storm means the plane is lossy or the peer unresponsive.
+_CTRL_FLIGHT: Dict[str, object] = {"storm_after": None, "fired": False,
+                                   "retries": 0}
+
+
+def arm_ctrl_flight(storm_after: Optional[int] = None) -> None:
+    _CTRL_FLIGHT["storm_after"] = storm_after
+    _CTRL_FLIGHT["fired"] = False
+    _CTRL_FLIGHT["retries"] = 0
+
+
+def _note_ctrl_retry(msg: str) -> None:
+    _CTRL_RETRIES.inc(msg=msg)
+    _CTRL_FLIGHT["retries"] += 1
+    storm = _CTRL_FLIGHT["storm_after"]
+    if (storm is not None and not _CTRL_FLIGHT["fired"]
+            and _CTRL_FLIGHT["retries"] >= storm):
+        _CTRL_FLIGHT["fired"] = True
+        obs.flight_trigger("ctrl_storm", key="disagg:ctrl",
+                           retries=_CTRL_FLIGHT["retries"],
+                           storm_after=storm, last_msg=msg)
+
+
 # -- wire format ------------------------------------------------------------
 @dataclass(frozen=True)
 class KVWireFormat:
@@ -447,7 +473,7 @@ class PrefillWorker:
                     and now_m - st.t_begin_sent > self._ctrl_retry_s):
                 # GRANT (or the BEGIN itself) lost: resend, idempotent
                 st.t_begin_sent = now_m
-                _CTRL_RETRIES.inc(msg="begin")
+                _note_ctrl_retry("begin")
                 _send_msg(self.ep, self.conn, st.begin_msg)
             if st.remote_slot is not None and st.slabs:
                 self._ship(st)
@@ -482,7 +508,7 @@ class PrefillWorker:
         for rid, ent in self._finaled.items():
             if now_m - ent["t_sent"] > self._ctrl_retry_s:
                 ent["t_sent"] = now_m
-                _CTRL_RETRIES.inc(msg="final")
+                _note_ctrl_retry("final")
                 _send_msg(self.ep, self.conn, ent["msg"])
 
     def _send_clock_ping(self) -> None:
@@ -737,7 +763,7 @@ class DecodeWorker:
                     # not from the first (lost) GRANT
                     granted["t_grant"] = time.monotonic()
                     granted.pop("expired", None)
-                    _CTRL_RETRIES.inc(msg="grant")
+                    _note_ctrl_retry("grant")
                     _send_msg(self.ep, conn, {
                         "t": "grant", "rid": key[1],
                         "slot": granted["slot"],

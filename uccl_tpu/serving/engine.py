@@ -513,7 +513,8 @@ class ServingEngine:
                  priority_classes: bool = False,
                  preempt: bool = False,
                  adapters=None,
-                 tenant_fair=None):
+                 tenant_fair=None,
+                 step_stall_s: Optional[float] = None):
         if spec_k is not None:
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
@@ -622,6 +623,16 @@ class ServingEngine:
         self.dead = False  # killed (chaos / failure injection): step() raises
         self._last_tok = np.zeros(backend.n_slots, np.int32)
         self._next_rid = 0
+        if step_stall_s is not None and step_stall_s <= 0:
+            raise ValueError(
+                f"step_stall_s must be > 0, got {step_stall_s}"
+            )
+        self.step_stall_s = step_stall_s  # flight step_stall budget (off=None)
+        self._conservation_fired = False
+        # flight-bundle face: slot/scheduler occupancy at dump time (a
+        # no-op unless a recorder is armed when the engine is built)
+        self._flight_name = f"engine:{id(self):x}"
+        obs.flight_provider(self._flight_name, self._flight_state)
         self._stats_name: Optional[str] = None
         if register_stats:
             # unique per engine: a second registered engine must not
@@ -915,13 +926,21 @@ class ServingEngine:
                 self._decode(finished)
         else:
             self._step_chunked(finished)
-        self.metrics.on_step(now() - t0)
+        dt = now() - t0
+        self.metrics.on_step(dt)
         if tr is not None:
             tr.complete("engine.step", ts0, tr.now_us() - ts0, "engine",
                         active=len(self._by_slot), queued=self.sched.qsize,
                         finished=len(finished))
         _OCCUPANCY.set(self.pool.occupancy)
         _HIGH_WATER.set(self.pool.high_water)
+        if self.step_stall_s is not None and dt > self.step_stall_s:
+            obs.flight_trigger(
+                "step_stall", key=self._flight_name, dur_s=round(dt, 6),
+                budget_s=self.step_stall_s,
+                occupancy=round(self.pool.occupancy, 4),
+                queued=self.sched.qsize, active=len(self._by_slot))
+        self._check_conservation()
         return finished
 
     def _step_chunked(self, finished) -> None:
@@ -1189,6 +1208,44 @@ class ServingEngine:
         self.metrics = ServingMetrics()
         reset_latency_histograms()
 
+    def _flight_state(self) -> dict:
+        """What a post-mortem bundle captures of this engine: the slot
+        and queue occupancy the scheduler-facing narrative needs, never
+        request payloads."""
+        return {
+            "dead": self.dead,
+            "n_slots": self.pool.n_slots,
+            "occupancy": round(self.pool.occupancy, 4),
+            "high_water": self.pool.high_water,
+            "active": len(self._by_slot),
+            "prefilling": len(self._prefilling),
+            "queued": self.sched.qsize,
+            "scheduler": self.sched.debug_state(),
+            "conservation": self._conservation_terms(),
+        }
+
+    def _conservation_terms(self) -> dict:
+        m = self.metrics
+        return {"submitted": m.submitted, "completed": m.completed,
+                "active": len(self._by_slot), "queued": self.sched.qsize,
+                "rejected": m.rejected, "expired": m.expired,
+                "lost": m.lost}
+
+    def _check_conservation(self) -> None:
+        """The serving invariant, re-asserted at every step boundary:
+        submitted == completed + active + queued + rejected + expired +
+        lost. A violation is unrecoverable accounting damage — freeze
+        the evidence ONCE (the first broken step is the interesting one;
+        later steps inherit the same corruption)."""
+        if self._conservation_fired:
+            return
+        t = self._conservation_terms()
+        rhs = sum(v for k, v in t.items() if k != "submitted")
+        if t["submitted"] != rhs:
+            self._conservation_fired = True
+            obs.flight_trigger("conservation", key=self._flight_name,
+                               terms=t, rhs=rhs)
+
     def close(self) -> None:
         # only tear down the stats export THIS engine registered — a
         # second engine with register_stats=False must not unhook the
@@ -1196,6 +1253,7 @@ class ServingEngine:
         if self._stats_name is not None:
             self.metrics.unregister(self._stats_name)
             self._stats_name = None
+        obs.flight_unregister(self._flight_name)
 
     # -- internals ----------------------------------------------------------
     def _ns(self, req: Request) -> str:

@@ -433,6 +433,14 @@ class FleetCacheClient:
                 _ERRORS.inc(reason="dial")
                 _log.warning("fleet: dialing %s failed (%s: %s)", owner,
                              type(e).__name__, e)
+                # an advertised owner that cannot be dialed is a dead
+                # peer from this worker's vantage — same post-mortem
+                # moment as a health-detector DEAD transition
+                obs.flight_trigger(
+                    "peer_dead", key=f"fleet:{owner}", peer=owner,
+                    source="fleet_dial", exc=f"{type(e).__name__}: {e}",
+                    directory_entries=len(getattr(
+                        self.directory, "_entries", ()) or ()))
         self._remotes[owner] = remote
         return remote
 
@@ -452,7 +460,12 @@ class FleetCacheClient:
                     remote.close()
                 except Exception:
                     pass
-            self.directory.invalidate_owner(owner)
+            swept = self.directory.invalidate_owner(owner)
+            obs.flight_trigger(
+                "peer_dead", key=f"fleet:{owner}", peer=owner,
+                source="fleet_fetch", fails=n,
+                exc=f"{type(exc).__name__}: {exc}",
+                entries_invalidated=swept)
 
     def fetch(self, prompt, ns: str, slot: int, backend) -> Tuple[int, bool]:
         """Serve a local miss from the fleet if possible. Returns

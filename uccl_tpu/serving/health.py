@@ -177,6 +177,20 @@ class FailureDetector:
                 _PEER_STATE.set(2, peer=p.name)
                 obs.instant("peer_dead", track="health", peer=p.name,
                             silent_s=round(age, 4))
+                # terminal transition = post-mortem moment: freeze the
+                # ring + registry before recovery churns them (one
+                # bundle per peer — the recorder dedupes on the key)
+                obs.flight_trigger(
+                    "peer_dead",
+                    # the detector's identity is part of the key: two
+                    # detectors (router + disagg) may both track a peer
+                    # named "0", and each death deserves its own bundle
+                    key=f"health:{id(self):x}:{p.name}", peer=p.name,
+                    source="health", silent_s=round(age, 4),
+                    suspect_after_s=self.suspect_after_s,
+                    dead_after_s=self.dead_after_s,
+                    transitions=[(s, round(ts, 4))
+                                 for s, ts in p.transitions])
                 _log.warning("peer %s DEAD after %.3fs silence",
                              p.name, age)
                 fired.append((p.name, DEAD))

@@ -68,6 +68,14 @@ class FIFOScheduler:
         signal sums outstanding work over these)."""
         return [r for q in self._queues() for r in q]
 
+    def debug_state(self) -> dict:
+        """Flight-bundle face (obs/flight.py): queue shape only, no
+        Request bodies — a post-mortem dump must stay small and must not
+        carry prompt content."""
+        return {"kind": type(self).__name__, "qsize": self.qsize,
+                "max_queue": self.max_queue,
+                "deadlined": self._n_deadlined}
+
     def take_all(self) -> List[Request]:
         """Remove and return EVERY queued request, in admission order —
         the dead-replica evacuation (the router resubmits them to
@@ -236,6 +244,12 @@ class PriorityScheduler(FIFOScheduler):
     def requeue(self, req: Request) -> None:
         self._class_queue(req).appendleft(req)
 
+    def debug_state(self) -> dict:
+        st = super().debug_state()
+        st["by_class"] = {cls: len(q)
+                         for cls, q in self._by_class.items()}
+        return st
+
 
 class TenantFairScheduler(FIFOScheduler):
     """Per-tenant fair admission (ISSUE 18): one FIFO queue per tenant,
@@ -396,3 +410,14 @@ class TenantFairScheduler(FIFOScheduler):
             if not progress and not deficit_short:
                 break  # every queued tenant is rate-limited
         return admitted
+
+    def debug_state(self) -> dict:
+        st = super().debug_state()
+        st["by_tenant"] = {t: len(q)
+                          for t, q in self._by_tenant.items() if q}
+        st["deficit"] = {t: round(v, 2)
+                         for t, v in self._deficit.items() if v}
+        if self.rate is not None:
+            st["bucket"] = {t: round(v, 2)
+                            for t, v in self._bucket.items()}
+        return st
